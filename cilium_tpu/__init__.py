@@ -1,0 +1,21 @@
+"""cilium-tpu: a TPU-native policy-enforcement framework.
+
+A from-scratch re-design of the capabilities of Cilium (reference:
+yandooo/cilium v1.2.90) with the L7 policy-verdict hot path executed on TPU:
+
+- ``cilium_tpu.policy``   — rule AST + policy compiler (reference: pkg/policy)
+- ``cilium_tpu.regex``    — POSIX-ERE/RE2-subset -> packed NFA transition tables
+- ``cilium_tpu.ops``      — JAX/Pallas device ops (NFA step, LPM, tokenizers)
+- ``cilium_tpu.models``   — per-protocol verdict pipelines (r2d2, HTTP, Kafka,
+                            Cassandra, memcached) — the "model families"
+- ``cilium_tpu.parallel`` — mesh/sharding helpers (data-parallel flow sharding)
+- ``cilium_tpu.proxylib`` — streaming parser framework with the reference's
+                            OnData PASS/DROP/INJECT/MORE contract
+                            (reference: proxylib/proxylib)
+- ``cilium_tpu.runtime``  — batching engine feeding fixed-size frame batches
+                            to the device
+- ``cilium_tpu.datapath`` — packed L4 policy tables + CIDR prefilter arrays
+                            (reference: pkg/maps/policymap, daemon/prefilter)
+"""
+
+__version__ = "0.1.0"

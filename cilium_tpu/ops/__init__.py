@@ -1,0 +1,19 @@
+"""Device ops: the JAX/XLA compute path of the framework.
+
+Every op here is a pure function over fixed-shape arrays, jit-safe, and
+batched on the leading "flows" axis so it shards data-parallel over the mesh
+(``cilium_tpu.parallel``).  These replace the reference's per-packet /
+per-request scalar hot loops:
+
+- ``nfa``          — batched multi-pattern regex-NFA evaluation
+                     (replaces proxylib rule walks + Envoy std::regex,
+                     reference: proxylib/proxylib/policymap.go:91,
+                     envoy/cilium_network_policy.h:50-76)
+- ``lpm``          — batched longest-prefix-match over packed CIDR arrays
+                     (replaces the XDP LPM trie, reference: bpf/bpf_xdp.c:44-90)
+- ``policy_table`` — batched L4 policy-map lookups
+                     (replaces bpf/lib/policy.h:47 __policy_can_access)
+- ``bytescan``     — fixed-width byte-parallel field extraction primitives
+                     (delimiter finding, field splits) used by the protocol
+                     tokenizers in ``cilium_tpu.models``
+"""

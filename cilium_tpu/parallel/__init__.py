@@ -1,0 +1,13 @@
+"""Mesh/sharding helpers: data-parallel flow sharding over TPU meshes.
+
+The reference scales by running one agent+datapath per node with cluster
+state converging over a kvstore (reference: pkg/kvstore/store, SURVEY §2.10).
+The TPU-native equivalent scales the verdict plane by sharding the *flow*
+(batch) axis of every device op over an ICI mesh; rule tables are replicated
+(they are small after byte-class compression) until they exceed chip HBM, at
+which point the state axis shards too.
+"""
+
+from .mesh import FLOW_AXIS, RULE_AXIS, flow_mesh, flow_sharding, replicated
+
+__all__ = ["FLOW_AXIS", "RULE_AXIS", "flow_mesh", "flow_sharding", "replicated"]

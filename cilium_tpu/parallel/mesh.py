@@ -1,0 +1,36 @@
+"""Device mesh construction and canonical shardings.
+
+Axes:
+  "flows" — data-parallel axis; every batch dimension (frames, CIDR lookup
+            keys, policy-map lookup keys) shards here.
+  "rules" — model-parallel axis for rule sets too large for one chip's HBM;
+            1 by default.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+FLOW_AXIS = "flows"
+RULE_AXIS = "rules"
+
+
+def flow_mesh(n_flow: int | None = None, n_rule: int = 1, devices=None) -> Mesh:
+    """Build a (flows, rules) mesh over ``devices`` (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_flow is None:
+        n_flow = len(devices) // n_rule
+    devs = np.asarray(devices[: n_flow * n_rule]).reshape(n_flow, n_rule)
+    return Mesh(devs, (FLOW_AXIS, RULE_AXIS))
+
+
+def flow_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (flow/batch) axis across the flow axis."""
+    return NamedSharding(mesh, P(FLOW_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

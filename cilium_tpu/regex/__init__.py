@@ -1,0 +1,31 @@
+"""Regex -> packed NFA transition tables for TPU evaluation.
+
+The reference enforces L7 rules with three regex engines: Go ``regexp`` (RE2)
+in proxylib parsers (reference: proxylib/r2d2/r2d2parser.go:103), POSIX
+extended regex declared for agent-side HTTP rules (reference:
+pkg/policy/api/http.go:22-27), and ``std::regex`` inside Envoy (reference:
+envoy/cilium_network_policy.h:50-76).  This package implements the common
+subset of those dialects, compiled to a byte-level epsilon-free NFA whose
+transition relation is packed into dense per-byte-class matrices so a batch of
+flows can be advanced with one MXU matmul per input byte.
+
+Semantics: *search* ("contains a match"), matching Go ``regexp.MatchString``,
+which is what proxylib rule matching uses.  ``^``/``$`` anchor to string
+start/end.  ``.`` matches any byte except ``\n`` (RE2 default).
+"""
+
+from .parse import ParseError, parse
+from .nfa import CompiledPattern, compile_pattern
+from .tables import NfaTables, compile_patterns
+from .pymatch import py_search, tables_search
+
+__all__ = [
+    "ParseError",
+    "parse",
+    "CompiledPattern",
+    "compile_pattern",
+    "NfaTables",
+    "compile_patterns",
+    "py_search",
+    "tables_search",
+]

@@ -1,0 +1,267 @@
+"""AST -> epsilon-free byte NFA with search ("contains match") semantics.
+
+Matches the observable behavior of Go ``regexp.MatchString`` as used by the
+reference's proxylib rule matchers (reference: proxylib/r2d2/r2d2parser.go:79,
+proxylib/cassandra/cassandraparser.go rule matching) and the agent-side
+validation of HTTP rules (reference: pkg/policy/api/http.go:66).
+
+Design notes (TPU-first):
+
+* Anchors are compiled via two virtual symbols, BEGIN and END, conceptually
+  processed before the first and after the last input byte.  Both are folded
+  out of the device loop at compile time: the exported ``start`` set is the
+  post-BEGIN state set, and ``accept_via_end`` marks states that reach an
+  accepting state by consuming END.  The device kernel therefore advances the
+  state set exactly once per real input byte.
+* A wrapper start state with a self-loop over every byte provides unanchored
+  search; acceptance is *sticky* (recorded per step), so "contains a match"
+  is an OR-reduction the kernel folds into its scan carry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .parse import ALL_BYTES, ParseError, parse
+
+END = 256
+BEGIN = 257
+
+# Hard cap on epsilon-free states for one compiled pattern set; transition
+# tables are dense [C, S, S] so S bounds both HBM footprint and matmul cost.
+MAX_STATES = 4096
+
+
+class _Builder:
+    """Thompson construction over (byteset | BEGIN | EOL | eps) edges."""
+
+    def __init__(self):
+        self.eps: list[list[int]] = []
+        self.edges: list[list[tuple[frozenset, int]]] = []
+
+    def new_state(self) -> int:
+        self.eps.append([])
+        self.edges.append([])
+        return len(self.eps) - 1
+
+    def add_eps(self, a: int, b: int) -> None:
+        self.eps[a].append(b)
+
+    def add_edge(self, a: int, syms: frozenset, b: int) -> None:
+        self.edges[a].append((syms, b))
+
+    # Each build returns (entry, exit) state pair.
+    def build(self, node) -> tuple[int, int]:
+        kind = node[0]
+        if kind == "empty":
+            s = self.new_state()
+            return s, s
+        if kind == "lit":
+            a, b = self.new_state(), self.new_state()
+            self.add_edge(a, node[1], b)
+            return a, b
+        if kind == "bol":
+            a, b = self.new_state(), self.new_state()
+            self.add_edge(a, frozenset([BEGIN]), b)
+            return a, b
+        if kind == "eol":
+            a, b = self.new_state(), self.new_state()
+            self.add_edge(a, frozenset([END]), b)
+            return a, b
+        if kind == "cat":
+            items = node[1]
+            entry, cur = None, None
+            for item in items:
+                a, b = self.build(item)
+                if entry is None:
+                    entry = a
+                else:
+                    self.add_eps(cur, a)
+                cur = b
+            return entry, cur
+        if kind == "alt":
+            a, b = self.new_state(), self.new_state()
+            for branch in node[1]:
+                x, y = self.build(branch)
+                self.add_eps(a, x)
+                self.add_eps(y, b)
+            return a, b
+        if kind == "star":
+            a, b = self.new_state(), self.new_state()
+            x, y = self.build(node[1])
+            self.add_eps(a, x)
+            self.add_eps(a, b)
+            self.add_eps(y, x)
+            self.add_eps(y, b)
+            return a, b
+        if kind == "plus":
+            x, y = self.build(node[1])
+            b = self.new_state()
+            self.add_eps(y, x)
+            self.add_eps(y, b)
+            return x, b
+        if kind == "opt":
+            a, b = self.new_state(), self.new_state()
+            x, y = self.build(node[1])
+            self.add_eps(a, x)
+            self.add_eps(y, b)
+            self.add_eps(a, b)
+            return a, b
+        if kind == "rep":
+            _, inner, m, n = node
+            a = self.new_state()
+            cur = a
+            for _ in range(m):
+                x, y = self.build(inner)
+                self.add_eps(cur, x)
+                cur = y
+            if n is None:
+                x, y = self.build(inner)
+                self.add_eps(cur, x)
+                self.add_eps(y, x)
+                self.add_eps(y, cur)
+                b = self.new_state()
+                self.add_eps(cur, b)
+                return a, b
+            b = self.new_state()
+            self.add_eps(cur, b)
+            for _ in range(n - m):
+                x, y = self.build(inner)
+                self.add_eps(cur, x)
+                cur = y
+                self.add_eps(cur, b)
+            return a, b
+        raise ParseError(f"unknown AST node {kind}")
+
+
+@dataclass
+class CompiledPattern:
+    """Epsilon-free NFA over bytes 0..255.
+
+    transitions: per-state list of (byteset, target-state) pairs
+    start: state set after the virtual BEGIN step
+    accept: states whose epsilon-closure is accepting
+    accept_via_end: states reaching acceptance by consuming the virtual END
+    """
+
+    n_states: int
+    transitions: list[list[tuple[frozenset, int]]]
+    start: frozenset
+    accept: frozenset
+    accept_via_end: frozenset
+
+    def matches_empty(self) -> bool:
+        return bool(self.start & (self.accept | self.accept_via_end))
+
+
+def compile_pattern(pattern: str) -> CompiledPattern:
+    """Compile ``pattern`` to an epsilon-free search NFA."""
+    ast = parse(pattern)
+
+    b = _Builder()
+    # Unanchored-search wrapper: self-loop over every byte and BEGIN.
+    wrapper = b.new_state()
+    b.add_edge(wrapper, ALL_BYTES | frozenset([BEGIN]), wrapper)
+    entry, exit_ = b.build(ast)
+    b.add_eps(wrapper, entry)
+    final = exit_
+
+    n = len(b.eps)
+
+    # epsilon closures (iterative DFS per state)
+    closures: list[frozenset] = []
+    for s in range(n):
+        seen = {s}
+        stack = [s]
+        while stack:
+            q = stack.pop()
+            for d in b.eps[q]:
+                if d not in seen:
+                    seen.add(d)
+                    stack.append(d)
+        closures.append(frozenset(seen))
+
+    def closure_of(states) -> frozenset:
+        out: set[int] = set()
+        for s in states:
+            out |= closures[s]
+        return frozenset(out)
+
+    # Raw symbol move: from closed state s, on symbol sym.
+    def move(states: frozenset, pred) -> frozenset:
+        out: set[int] = set()
+        for s in states:
+            for syms, d in b.edges[s]:
+                if pred(syms):
+                    out |= closures[d]
+        return frozenset(out)
+
+    def anchor_fixpoint(states: frozenset, sym: int) -> frozenset:
+        """Anchors are zero-width assertions: asserting ^ (or $) twice at the
+        same position is legal (``^(^a)``, ``(a$)$``), but our encoding
+        consumes a virtual symbol per anchor edge — so take the transitive
+        closure over anchor moves."""
+        cur = states
+        while True:
+            nxt = cur | move(cur, lambda syms: sym in syms)
+            if nxt == cur:
+                return cur
+            cur = nxt
+
+    raw_start = closures[wrapper]
+    # Post-BEGIN state set.  The wrapper's BEGIN self-loop keeps unanchored
+    # starts alive; the fixpoint admits stacked ^ anchors across groups.
+    start = anchor_fixpoint(
+        move(raw_start, lambda syms: BEGIN in syms), BEGIN
+    )
+
+    accepting_raw = frozenset([final])
+
+    def is_accepting(cl: frozenset) -> bool:
+        return bool(cl & accepting_raw)
+
+    # Restrict to states reachable over byte transitions from `start`.
+    reachable = set(start)
+    frontier = list(start)
+    while frontier:
+        s = frontier.pop()
+        for syms, d in b.edges[s]:
+            if syms & ALL_BYTES:
+                for t in closures[d]:
+                    if t not in reachable:
+                        reachable.add(t)
+                        frontier.append(t)
+    if len(reachable) > MAX_STATES:
+        raise ParseError(
+            f"pattern compiles to {len(reachable)} NFA states (max {MAX_STATES})"
+        )
+
+    # Renumber reachable states densely.
+    order = sorted(reachable)
+    index = {s: i for i, s in enumerate(order)}
+
+    transitions: list[list[tuple[frozenset, int]]] = [[] for _ in order]
+    accept: set[int] = set()
+    accept_via_end: set[int] = set()
+    for s in order:
+        cl = closures[s]
+        if is_accepting(cl):
+            accept.add(index[s])
+        # END moves to fixpoint from the closure of s (stacked $ anchors)
+        end_set = anchor_fixpoint(move(cl, lambda syms: END in syms), END)
+        if any(is_accepting(closures[t]) for t in end_set):
+            accept_via_end.add(index[s])
+        # byte transitions from the closure of s
+        for q in cl:
+            for syms, d in b.edges[q]:
+                byte_syms = syms & ALL_BYTES
+                if byte_syms:
+                    transitions[index[s]].append((byte_syms, index[d]))
+
+    return CompiledPattern(
+        n_states=len(order),
+        transitions=transitions,
+        start=frozenset(index[s] for s in start),
+        accept=frozenset(accept),
+        accept_via_end=frozenset(accept_via_end),
+    )

@@ -1,0 +1,353 @@
+"""Regex parser: RE2/POSIX-ERE common subset -> AST over the byte alphabet.
+
+Supported syntax (the subset exercised by the reference's policy rules —
+HTTP path/method/host regexes, proxylib ``file``/``query_table``/key-prefix
+rules): literals (UTF-8 encoded to bytes), ``.``, character classes
+``[...]``/``[^...]`` with ranges, POSIX classes ``[[:alpha:]]`` etc.,
+perl classes ``\\d \\w \\s`` (+ negations), escapes, grouping ``( )`` and
+``(?: )``, alternation ``|``, quantifiers ``* + ? {m} {m,} {m,n}`` (with an
+optional non-greedy ``?`` suffix, which is irrelevant for accept/reject
+semantics and ignored), and anchors ``^ $``.
+
+AST nodes are plain tuples:
+  ("empty",)                  - matches empty string
+  ("lit", frozenset[int])     - one byte drawn from the set
+  ("cat", [node, ...])
+  ("alt", [node, ...])
+  ("star", node)              - zero or more
+  ("plus", node)              - one or more
+  ("opt", node)               - zero or one
+  ("rep", node, m, n)         - m..n repetitions (n may be None = unbounded)
+  ("bol",)                    - ^ anchor
+  ("eol",)                    - $ anchor
+"""
+
+from __future__ import annotations
+
+DOT_EXCLUDES_NEWLINE = True
+
+# Maximum counted-repetition bound: keeps Thompson state counts sane for
+# adversarial rules ({1000} would otherwise explode the transition table).
+MAX_REPEAT = 256
+
+
+class ParseError(ValueError):
+    """Raised when a pattern is outside the supported dialect subset."""
+
+
+_PERL_CLASSES = {
+    "d": frozenset(range(0x30, 0x3A)),
+    "w": frozenset(
+        list(range(0x30, 0x3A))
+        + list(range(0x41, 0x5B))
+        + list(range(0x61, 0x7B))
+        + [0x5F]
+    ),
+    # RE2 \s is [\t\n\f\r ] — no vertical tab, unlike POSIX [[:space:]].
+    "s": frozenset([0x20, 0x09, 0x0A, 0x0C, 0x0D]),
+}
+
+_POSIX_CLASSES = {
+    "alpha": frozenset(list(range(0x41, 0x5B)) + list(range(0x61, 0x7B))),
+    "digit": frozenset(range(0x30, 0x3A)),
+    "alnum": frozenset(
+        list(range(0x30, 0x3A)) + list(range(0x41, 0x5B)) + list(range(0x61, 0x7B))
+    ),
+    "upper": frozenset(range(0x41, 0x5B)),
+    "lower": frozenset(range(0x61, 0x7B)),
+    "space": frozenset([0x20, 0x09, 0x0A, 0x0B, 0x0C, 0x0D]),
+    "blank": frozenset([0x20, 0x09]),
+    "punct": frozenset(
+        b for b in range(0x21, 0x7F) if not (chr(b).isalnum())
+    ),
+    "xdigit": frozenset(
+        list(range(0x30, 0x3A)) + list(range(0x41, 0x47)) + list(range(0x61, 0x67))
+    ),
+    "print": frozenset(range(0x20, 0x7F)),
+    "graph": frozenset(range(0x21, 0x7F)),
+    "cntrl": frozenset(list(range(0x00, 0x20)) + [0x7F]),
+}
+
+_ESCAPE_LITERALS = {
+    "n": 0x0A,
+    "r": 0x0D,
+    "t": 0x09,
+    "f": 0x0C,
+    "v": 0x0B,
+    "a": 0x07,
+    "0": 0x00,
+}
+
+ALL_BYTES = frozenset(range(256))
+DOT_BYTES = frozenset(b for b in range(256) if b != 0x0A) if DOT_EXCLUDES_NEWLINE else ALL_BYTES
+
+
+class _Parser:
+    def __init__(self, pattern: str):
+        # Patterns arrive as str; operate on their UTF-8 bytes so multi-byte
+        # literals match byte streams exactly.
+        self.data = pattern.encode("utf-8")
+        self.pos = 0
+
+    def error(self, msg: str) -> ParseError:
+        return ParseError(f"{msg} at offset {self.pos} in pattern {self.data!r}")
+
+    def peek(self) -> int | None:
+        return self.data[self.pos] if self.pos < len(self.data) else None
+
+    def next(self) -> int:
+        b = self.data[self.pos]
+        self.pos += 1
+        return b
+
+    def eof(self) -> bool:
+        return self.pos >= len(self.data)
+
+    # --- grammar: alt -> cat ('|' cat)* ; cat -> rep* ; rep -> atom quant*
+    def parse_alt(self):
+        branches = [self.parse_cat()]
+        while self.peek() == 0x7C:  # '|'
+            self.next()
+            branches.append(self.parse_cat())
+        if len(branches) == 1:
+            return branches[0]
+        return ("alt", branches)
+
+    def parse_cat(self):
+        items = []
+        while not self.eof() and self.peek() not in (0x7C, 0x29):  # '|' ')'
+            items.append(self.parse_rep())
+        if not items:
+            return ("empty",)
+        if len(items) == 1:
+            return items[0]
+        return ("cat", items)
+
+    def parse_rep(self):
+        atom = self.parse_atom()
+        quantified = self._parse_one_quantifier(atom)
+        if quantified is atom:
+            return atom
+        # swallow a non-greedy marker: greediness can't change whether a
+        # search succeeds, only which span it reports.
+        if self.peek() == 0x3F:
+            self.next()
+        # Go/RE2 reject stacked quantifiers (a**, a*+, a{2}{3}); silently
+        # reinterpreting them as nested greedy repetition would change match
+        # semantics vs the reference, so reject them too.
+        if self._parse_one_quantifier(quantified) is not quantified:
+            raise self.error("nested repetition operator")
+        return quantified
+
+    def _parse_one_quantifier(self, atom):
+        """Apply at most one quantifier to ``atom``; returns ``atom``
+        unchanged if no quantifier follows."""
+        c = self.peek()
+        if c == 0x2A:  # '*'
+            self.next()
+            self._check_quantifiable(atom)
+            return ("star", atom)
+        if c == 0x2B:  # '+'
+            self.next()
+            self._check_quantifiable(atom)
+            return ("plus", atom)
+        if c == 0x3F:  # '?'
+            self.next()
+            self._check_quantifiable(atom)
+            return ("opt", atom)
+        if c == 0x7B:  # '{'
+            saved = self.pos
+            rep = self._try_parse_counted()
+            if rep is None:
+                self.pos = saved
+                return atom
+            self._check_quantifiable(atom)
+            m, n = rep
+            return ("rep", atom, m, n)
+        return atom
+
+    def _check_quantifiable(self, atom):
+        if atom[0] in ("bol", "eol", "empty"):
+            raise self.error("quantifier applied to anchor or empty expression")
+
+    def _try_parse_counted(self):
+        """Parse {m}, {m,}, {m,n} after consuming nothing.  Returns (m, n)
+        with n=None for unbounded, or None if not a valid counted repetition
+        (in which case '{' is treated as a literal, matching Go/RE2)."""
+        assert self.peek() == 0x7B
+        self.next()
+        digits = bytearray()
+        while self.peek() is not None and 0x30 <= self.peek() <= 0x39:
+            digits.append(self.next())
+        if not digits:
+            return None
+        m = int(digits.decode())
+        n = m
+        if self.peek() == 0x2C:  # ','
+            self.next()
+            digits2 = bytearray()
+            while self.peek() is not None and 0x30 <= self.peek() <= 0x39:
+                digits2.append(self.next())
+            n = int(digits2.decode()) if digits2 else None
+        if self.peek() != 0x7D:  # '}'
+            return None
+        self.next()
+        if n is not None and n < m:
+            raise self.error(f"invalid repetition bound {{{m},{n}}}")
+        if m > MAX_REPEAT or (n is not None and n > MAX_REPEAT):
+            raise self.error(f"repetition bound exceeds {MAX_REPEAT}")
+        return (m, n)
+
+    def parse_atom(self):
+        c = self.peek()
+        if c is None:
+            return ("empty",)
+        if c == 0x28:  # '('
+            self.next()
+            if self.peek() == 0x3F:  # '(?'
+                self.next()
+                if self.peek() == 0x3A:  # '(?:'
+                    self.next()
+                else:
+                    raise self.error("unsupported group flag (only (?: supported)")
+            inner = self.parse_alt()
+            if self.peek() != 0x29:
+                raise self.error("missing )")
+            self.next()
+            return inner
+        if c == 0x5B:  # '['
+            return self.parse_class()
+        if c == 0x2E:  # '.'
+            self.next()
+            return ("lit", DOT_BYTES)
+        if c == 0x5E:  # '^'
+            self.next()
+            return ("bol",)
+        if c == 0x24:  # '$'
+            self.next()
+            return ("eol",)
+        if c == 0x5C:  # backslash
+            self.next()
+            return ("lit", self.parse_escape(in_class=False))
+        if c in (0x2A, 0x2B, 0x3F):
+            raise self.error("quantifier with nothing to repeat")
+        if c == 0x29:
+            raise self.error("unmatched )")
+        self.next()
+        return ("lit", frozenset([c]))
+
+    def parse_escape(self, in_class: bool) -> frozenset:
+        if self.eof():
+            raise self.error("trailing backslash")
+        c = self.next()
+        ch = chr(c)
+        if ch in _PERL_CLASSES:
+            return _PERL_CLASSES[ch]
+        if ch.lower() in _PERL_CLASSES and ch.isupper():
+            return ALL_BYTES - _PERL_CLASSES[ch.lower()]
+        if ch in _ESCAPE_LITERALS:
+            return frozenset([_ESCAPE_LITERALS[ch]])
+        if ch == "x":
+            hex_digits = bytearray()
+            if self.peek() == 0x7B:  # \x{...}
+                self.next()
+                while self.peek() is not None and self.peek() != 0x7D:
+                    hex_digits.append(self.next())
+                if self.peek() != 0x7D:
+                    raise self.error("missing } in \\x{}")
+                self.next()
+                try:
+                    cp = int(hex_digits.decode(), 16)
+                except ValueError:
+                    raise self.error("invalid \\x{} escape")
+                if cp > 0x10FFFF:
+                    raise self.error("codepoint out of range")
+                # Multi-byte codepoints in \x{} would need a 'cat' result;
+                # restrict to single-byte values (covers policy rule corpus).
+                if cp > 0xFF:
+                    raise self.error("\\x{} above 0xFF unsupported")
+                return frozenset([cp])
+            for _ in range(2):
+                if self.peek() is None:
+                    raise self.error("truncated \\x escape")
+                hex_digits.append(self.next())
+            try:
+                return frozenset([int(hex_digits.decode(), 16)])
+            except ValueError:
+                raise self.error("invalid \\x escape")
+        if ch.isalnum():
+            raise self.error(f"unsupported escape \\{ch}")
+        # escaped punctuation is the literal byte
+        return frozenset([c])
+
+    def parse_class(self) -> tuple:
+        assert self.next() == 0x5B
+        negate = False
+        if self.peek() == 0x5E:
+            negate = True
+            self.next()
+        members: set[int] = set()
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                raise self.error("missing ]")
+            if c == 0x5D and not first:  # ']'
+                self.next()
+                break
+            first = False
+            # POSIX class [[:name:]]
+            if c == 0x5B and self.data[self.pos : self.pos + 2] == b"[:":
+                end = self.data.find(b":]", self.pos + 2)
+                if end < 0:
+                    raise self.error("unterminated [:class:]")
+                name = self.data[self.pos + 2 : end].decode()
+                if name not in _POSIX_CLASSES:
+                    raise self.error(f"unknown POSIX class [:{name}:]")
+                members |= _POSIX_CLASSES[name]
+                self.pos = end + 2
+                continue
+            if c == 0x5C:
+                self.next()
+                esc = self.parse_escape(in_class=True)
+                if len(esc) > 1:
+                    members |= esc
+                    continue
+                lo = next(iter(esc))
+            else:
+                lo = self.next()
+            # possible range lo-hi
+            if (
+                self.peek() == 0x2D
+                and self.pos + 1 < len(self.data)
+                and self.data[self.pos + 1] != 0x5D
+            ):
+                self.next()  # '-'
+                if self.peek() == 0x5C:
+                    self.next()
+                    esc = self.parse_escape(in_class=True)
+                    if len(esc) != 1:
+                        raise self.error("class shorthand cannot end a range")
+                    hi = next(iter(esc))
+                else:
+                    hi = self.next()
+                if hi < lo:
+                    raise self.error("inverted class range")
+                members |= set(range(lo, hi + 1))
+            else:
+                members.add(lo)
+        result = frozenset(members)
+        if negate:
+            result = ALL_BYTES - result
+        if not result:
+            raise self.error("empty character class")
+        return ("lit", result)
+
+
+def parse(pattern: str):
+    """Parse ``pattern`` into an AST; raises ParseError outside the subset."""
+    p = _Parser(pattern)
+    ast = p.parse_alt()
+    if not p.eof():
+        raise p.error("unexpected trailing input")
+    return ast

@@ -1,0 +1,55 @@
+"""CPU reference evaluation of compiled NFAs and packed tables.
+
+Two evaluators:
+
+* ``py_search``     — walks the ``CompiledPattern`` state sets directly;
+                      the semantic oracle for the regex compiler itself.
+* ``tables_search`` — numpy evaluation of the packed ``NfaTables`` using the
+                      exact update rule the TPU kernel uses (boolean matvec +
+                      sticky accept), so device results can be checked
+                      bit-identical against it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .nfa import CompiledPattern
+from .tables import NfaTables
+
+
+def py_search(c: CompiledPattern, data: bytes) -> bool:
+    """True iff ``data`` contains a match ("search" semantics)."""
+    state = set(c.start)
+    if state & c.accept:
+        return True
+    for byte in data:
+        nxt: set[int] = set()
+        for s in state:
+            for byteset, d in c.transitions[s]:
+                if byte in byteset:
+                    nxt.add(d)
+        state = nxt
+        if state & c.accept:
+            return True
+        if not state:
+            return False
+    return bool(state & c.accept_via_end)
+
+
+def tables_search(t: NfaTables, data: bytes) -> np.ndarray:
+    """Evaluate all patterns in ``t`` against ``data``.
+
+    Returns a [R] bool array: pattern matched somewhere in ``data``.
+    Mirrors the device scan: state' = (state @ delta[cls]) > 0, with sticky
+    accept per step and the END-folded accept on the final state.
+    """
+    state = t.start.astype(np.int32)
+    accepted = (t.accept @ state) > 0  # [R]
+    for byte in data:
+        cls = int(t.classmap[byte])
+        state = (state @ t.delta[cls].astype(np.int32)) > 0
+        state = state.astype(np.int32)
+        accepted |= (t.accept @ state) > 0
+    accepted |= (t.accept_final @ state) > 0
+    return accepted

@@ -1,0 +1,158 @@
+"""Pack a set of compiled patterns into dense NFA transition tables.
+
+The packed form is what the TPU verdict kernel consumes
+(``cilium_tpu.ops.nfa``): one boolean transition matrix per *byte class*
+(bytes with identical transition behavior share a class, which typically
+compresses 256 columns to a handful for real policy rule sets — cf. the
+reference's rule corpus in examples/policies and proxylib test policies),
+a start-state vector, and one accept vector per pattern.
+
+Pure function rules -> arrays, mirroring how the reference compiles policy
+into packed BPF map entries (reference: pkg/maps/policymap/policymap.go:64)
+— except the "map" here is a dense matrix the MXU can multiply through.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .nfa import CompiledPattern, compile_pattern
+from .parse import ParseError
+
+MAX_TOTAL_STATES = 8192
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclass
+class NfaTables:
+    """Dense multi-pattern NFA tables.
+
+    classmap: [256] int32   byte -> byte-class id
+    delta:    [C, S, S] uint8   delta[c, s, t] = 1 iff s -(class c)-> t
+    start:    [S] bool          post-BEGIN start state set
+    accept:   [R, S] bool       per-pattern sticky-accept states
+    accept_final: [R, S] bool   accept | accept-via-END (checked on the
+                                final carried state only)
+    matches_empty: [R] bool     pattern matches the empty string
+    """
+
+    n_states: int
+    n_classes: int
+    n_patterns: int
+    classmap: np.ndarray
+    delta: np.ndarray
+    start: np.ndarray
+    accept: np.ndarray
+    accept_final: np.ndarray
+    matches_empty: np.ndarray
+    patterns: list[str] = field(default_factory=list)
+
+    def pad_states(self, multiple: int = 8) -> "NfaTables":
+        """Pad the state axis (dead padding states) for friendlier matmul
+        tiling; padding states have no transitions and are never set."""
+        s_pad = _round_up(max(self.n_states, 1), multiple)
+        if s_pad == self.n_states:
+            return self
+        d = np.zeros((self.n_classes, s_pad, s_pad), dtype=np.uint8)
+        d[:, : self.n_states, : self.n_states] = self.delta
+        st = np.zeros((s_pad,), dtype=bool)
+        st[: self.n_states] = self.start
+        acc = np.zeros((self.n_patterns, s_pad), dtype=bool)
+        acc[:, : self.n_states] = self.accept
+        accf = np.zeros((self.n_patterns, s_pad), dtype=bool)
+        accf[:, : self.n_states] = self.accept_final
+        return NfaTables(
+            n_states=s_pad,
+            n_classes=self.n_classes,
+            n_patterns=self.n_patterns,
+            classmap=self.classmap,
+            delta=d,
+            start=st,
+            accept=acc,
+            accept_final=accf,
+            matches_empty=self.matches_empty,
+            patterns=self.patterns,
+        )
+
+
+def compile_patterns(patterns: list[str], pad_to: int = 8) -> NfaTables:
+    """Compile ``patterns`` into one packed multi-pattern table set.
+
+    Patterns are united into a single NFA with disjoint state spaces (plus a
+    shared dense numbering); per-pattern accept vectors let one device pass
+    answer "which rules matched" for a whole rule set at once.
+    """
+    compiled: list[CompiledPattern] = [compile_pattern(p) for p in patterns]
+
+    total = sum(c.n_states for c in compiled)
+    if total > MAX_TOTAL_STATES:
+        raise ParseError(
+            f"rule set compiles to {total} NFA states (max {MAX_TOTAL_STATES})"
+        )
+    n_r = len(compiled)
+    offsets = np.cumsum([0] + [c.n_states for c in compiled])[:-1]
+
+    start = np.zeros((max(total, 1),), dtype=bool)
+    accept = np.zeros((n_r, max(total, 1)), dtype=bool)
+    accept_final = np.zeros((n_r, max(total, 1)), dtype=bool)
+    matches_empty = np.zeros((n_r,), dtype=bool)
+
+    # trans_by_byte[b] : list of (src, dst) global pairs for byte b
+    # Build a [256, S, S] dense relation incrementally but memory-safely by
+    # first collecting per-byte edge lists.
+    edge_lists: list[list[tuple[int, int]]] = [[] for _ in range(256)]
+    for r, c in enumerate(compiled):
+        off = int(offsets[r])
+        for s in c.start:
+            start[off + s] = True
+        for s in c.accept:
+            accept[r, off + s] = True
+        for s in c.accept | c.accept_via_end:
+            accept_final[r, off + s] = True
+        matches_empty[r] = c.matches_empty()
+        for s, edges in enumerate(c.transitions):
+            for byteset, d in edges:
+                for byte in byteset:
+                    edge_lists[byte].append((off + s, off + d))
+
+    # Byte classes: bytes with identical edge sets share a class.
+    sig_to_class: dict[tuple, int] = {}
+    classmap = np.zeros((256,), dtype=np.int32)
+    class_edges: list[list[tuple[int, int]]] = []
+    for byte in range(256):
+        sig = tuple(sorted(set(edge_lists[byte])))
+        cls = sig_to_class.get(sig)
+        if cls is None:
+            cls = len(sig_to_class)
+            sig_to_class[sig] = cls
+            class_edges.append(sorted(set(edge_lists[byte])))
+        classmap[byte] = cls
+
+    n_classes = len(class_edges)
+    s_dim = max(total, 1)
+    delta = np.zeros((n_classes, s_dim, s_dim), dtype=np.uint8)
+    for cls, edges in enumerate(class_edges):
+        if edges:
+            src, dst = zip(*edges)
+            delta[cls, list(src), list(dst)] = 1
+
+    tables = NfaTables(
+        n_states=s_dim,
+        n_classes=n_classes,
+        n_patterns=n_r,
+        classmap=classmap,
+        delta=delta,
+        start=start,
+        accept=accept,
+        accept_final=accept_final,
+        matches_empty=matches_empty,
+        patterns=list(patterns),
+    )
+    if pad_to > 1:
+        tables = tables.pad_states(pad_to)
+    return tables

@@ -1,0 +1,14 @@
+"""Test configuration: force a virtual 8-device CPU mesh for sharding tests.
+
+Must run before the first ``import jax`` anywhere in the test session.
+Benchmarks (bench.py) do NOT import this and run on the real TPU chip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,227 @@
+"""Regex compiler correctness: NFA vs Python `re` search semantics.
+
+The corpus covers the rule shapes the reference's policies use: HTTP path
+regexes (reference: pkg/policy/api/http.go), proxylib `file` rules
+(reference: proxylib/r2d2/r2d2parser.go:47), Cassandra table patterns, and
+memcached key prefixes — plus adversarial syntax cases.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from cilium_tpu.regex import (
+    ParseError,
+    compile_pattern,
+    compile_patterns,
+    py_search,
+    tables_search,
+)
+
+PATTERNS = [
+    r"abc",
+    r"^abc",
+    r"abc$",
+    r"^abc$",
+    r"^$",
+    r"a.c",
+    r"a.*c",
+    r"a.+c",
+    r"ab?c",
+    r"a|b|c",
+    r"(ab|cd)+",
+    r"(?:ab|cd)e",
+    r"[abc]",
+    r"[^abc]",
+    r"[a-z0-9_]+",
+    r"[-a-z]",
+    r"[a-z-]",
+    r"[]a]",
+    r"\d+",
+    r"\w+@\w+",
+    r"\s",
+    r"\S+",
+    r"a{3}",
+    r"a{2,}",
+    r"a{2,4}",
+    r"(ab){2,3}",
+    r"/public/.*",
+    r"^/public/.*$",
+    r"/api/v[0-9]+/users/[0-9]+",
+    r"GET|POST",
+    r"^(GET|HEAD)$",
+    r"foo\.com",
+    r".*\.example\.com",
+    r"^/jedi_svc\.public.*",
+    r"^/?index\.html$",
+    r"key_[[:alnum:]]+",
+    r"[[:digit:]]{1,3}\.[[:digit:]]{1,3}",
+    r"a\x41b",
+    r"\x{42}",
+    r"a$|^b",
+    r"x(y(z|w)*)+",
+    r"(a|b)*abb",
+    r"\.well-known/.*",
+    r"^deathstar\..*",
+    r"",
+    r"a**",  # (a*)* — valid in Go/POSIX as repeated quantifier? Go rejects; re accepts? see test
+]
+
+SUBJECTS = [
+    b"",
+    b"a",
+    b"abc",
+    b"xabcx",
+    b"ab",
+    b"aabbcc",
+    b"aaaa",
+    b"abab",
+    b"ababab",
+    b"cd",
+    b"abcd",
+    b"cde",
+    b"xyz",
+    b"a_c",
+    b"anc",
+    b"a\nc",
+    b"123",
+    b"foo@bar",
+    b"foo.com",
+    b"xfooycom",
+    b"/public/readme.txt",
+    b"/private/public/x",
+    b"/publicX",
+    b"/api/v2/users/42",
+    b"/api/vX/users/42",
+    b"GET",
+    b"POST",
+    b"HEAD",
+    b"GETX",
+    b"www.example.com",
+    b"example.org",
+    b"/jedi_svc.publicmethod",
+    b"/index.html",
+    b"index.html",
+    b"/x/index.html",
+    b"key_abc123",
+    b"key_!",
+    b"10.0.0.1",
+    b"aAb",
+    b"B",
+    b"b",
+    b"xb",
+    b"xyzw",
+    b"xyzwyz",
+    b"abb",
+    b"babb",
+    b"aabb",
+    b".well-known/acme",
+    b"deathstar.default.svc",
+    b"xdeathstar.x",
+    b"a" * 100,
+    b"ERROR\r\n",
+    b"READ /public/file1\r\n",
+    bytes(range(256)),
+]
+
+
+def _re_search(pattern: str, data: bytes) -> bool:
+    if "[:" in pattern:
+        return None  # Python re lacks POSIX classes; tested separately below
+    try:
+        rx = re.compile(pattern.encode("utf-8"))
+    except re.error:
+        return None
+    return rx.search(data) is not None
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_pattern_vs_re(pattern):
+    try:
+        compiled = compile_pattern(pattern)
+    except ParseError:
+        pytest.skip(f"outside supported subset: {pattern!r}")
+    for subject in SUBJECTS:
+        expected = _re_search(pattern, subject)
+        if expected is None:
+            continue
+        got = py_search(compiled, subject)
+        assert got == expected, (
+            f"pattern {pattern!r} on {subject!r}: nfa={got} re={expected}"
+        )
+
+
+def test_tables_match_py_search():
+    patterns = [p for p in PATTERNS if p not in (r"a**",)]
+    valid = []
+    for p in patterns:
+        try:
+            compile_pattern(p)
+            valid.append(p)
+        except ParseError:
+            pass
+    tables = compile_patterns(valid)
+    for subject in SUBJECTS:
+        got = tables_search(tables, subject)
+        for r, p in enumerate(valid):
+            expected = py_search(compile_pattern(p), subject)
+            assert bool(got[r]) == expected, f"{p!r} on {subject!r}"
+
+
+def test_byte_class_compression():
+    tables = compile_patterns([r"/public/.*", r"GET|POST"])
+    # Distinct behaviors: '/', 'p', 'u', 'b', 'l', 'i', 'c', G,E,T,P,O,S, other
+    assert tables.n_classes < 32
+    assert tables.classmap.shape == (256,)
+
+
+def test_posix_classes():
+    c = compile_pattern(r"key_[[:alnum:]]+")
+    assert py_search(c, b"key_abc123")
+    assert not py_search(c, b"key_!")
+    d = compile_pattern(r"[[:digit:]]{1,3}\.[[:digit:]]{1,3}")
+    assert py_search(d, b"10.0")
+    assert not py_search(d, b"ab.cd")
+
+
+def test_empty_pattern_matches_everything():
+    c = compile_pattern("")
+    assert py_search(c, b"")
+    assert py_search(c, b"anything")
+
+
+def test_anchored_end_only_at_end():
+    c = compile_pattern(r"abc$")
+    assert py_search(c, b"xxabc")
+    assert not py_search(c, b"abcx")
+
+
+def test_parse_errors():
+    for bad in [r"(", r")", r"a)", r"[z-a]", r"(?P<x>a)", r"*a", r"a{300}",
+                r"a**", r"a*+", r"a{2}{3}", r"a*??", r"\x{}", r"\x{GG}"]:
+        with pytest.raises(ParseError):
+            compile_pattern(bad)
+
+
+def test_stacked_anchors_across_groups():
+    # Anchors are zero-width: asserting twice at the same position is legal.
+    assert py_search(compile_pattern(r"^(^a)"), b"a")
+    assert py_search(compile_pattern(r"(a$)$"), b"xa")
+    assert not py_search(compile_pattern(r"(a$)$"), b"ab")
+    assert py_search(compile_pattern(r"^^abc$$"), b"abc")
+    assert not py_search(compile_pattern(r"^^abc$$"), b"xabc")
+
+
+def test_re2_whitespace_class():
+    # RE2 \s is [\t\n\f\r ] — no vertical tab (0x0B), unlike Python re.
+    assert not py_search(compile_pattern(r"\s"), b"\x0b")
+    assert py_search(compile_pattern(r"\s"), b"\t")
+    assert py_search(compile_pattern(r"\S"), b"\x0b")
+
+
+def test_state_padding():
+    tables = compile_patterns([r"ab"], pad_to=8)
+    assert tables.n_states % 8 == 0
+    assert tables_search(tables, b"xabx")[0]
+    assert not tables_search(tables, b"ba")[0]
