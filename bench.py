@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Headline benchmark: L7 verdicts/sec/chip on the r2d2 batch pipeline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is the ratio against the driver-defined north-star target of
+1M L7 verdicts/sec/chip (BASELINE.json; the reference publishes no absolute
+numbers, see BASELINE.md).
+
+Measures the full device path per batch — host byte-buffer -> device
+transfer -> frame -> tokenize -> NFA match -> verdicts back on host — on
+the real TPU chip, using benchmark config 1 from BASELINE.json (the
+proxylib/r2d2 OnData workload, reference: proxylib/r2d2/r2d2parser.go) with
+a mixed allow/deny message corpus.  Also reports (stderr) the self-measured
+CPU oracle throughput (the ported in-process proxylib, BASELINE.md's
+requirement) and the verdict cross-check against it.
+"""
+
+import json
+import random
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from cilium_tpu.models.r2d2 import build_r2d2_model, r2d2_verdicts
+    from cilium_tpu.proxylib import (
+        NetworkPolicy,
+        PortNetworkPolicy,
+        PortNetworkPolicyRule,
+        open_module,
+        find_instance,
+        reset_module_registry,
+        FilterResult,
+        PASS,
+    )
+    from cilium_tpu.proxylib.instance import on_new_connection
+
+    dev = jax.devices()[0]
+    print(f"bench: device={dev}", file=sys.stderr)
+
+    # Benchmark policy: config 1/2 mix — cmd ACL + file regex (the r2d2
+    # analog of "GET /public/.*").
+    policy_cfg = NetworkPolicy(
+        name="bench",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=80,
+                rules=[
+                    PortNetworkPolicyRule(
+                        l7_proto="r2d2",
+                        l7_rules=[
+                            {"cmd": "READ", "file": "/public/.*"},
+                            {"cmd": "HALT"},
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+    reset_module_registry()
+    mod = open_module([], True)
+    ins = find_instance(mod)
+    ins.policy_update([policy_cfg])
+    model = build_r2d2_model(ins.policy_map()["bench"], ingress=True, port=80)
+
+    # Message corpus: ~50% allowed.
+    rng = random.Random(7)
+    msgs = []
+    for _ in range(1024):
+        roll = rng.random()
+        if roll < 0.35:
+            msgs.append(f"READ /public/file{rng.randrange(1000)}.txt\r\n".encode())
+        elif roll < 0.5:
+            msgs.append(b"HALT\r\n")
+        elif roll < 0.75:
+            msgs.append(f"READ /private/file{rng.randrange(1000)}\r\n".encode())
+        else:
+            msgs.append(f"WRITE /public/f{rng.randrange(1000)}\r\n".encode())
+
+    F = 8192
+    L = 64
+    base = np.zeros((F, L), dtype=np.uint8)
+    lengths = np.zeros((F,), dtype=np.int32)
+    for i in range(F):
+        m = msgs[i % len(msgs)]
+        base[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        lengths[i] = len(m)
+    remotes = np.ones((F,), dtype=np.int32)
+
+    # Warm up / compile.
+    complete, msg_len, allow = r2d2_verdicts(model, base, lengths, remotes)
+    allow.block_until_ready()
+
+    # Timed: include host->device transfer of fresh batches each iter.
+    iters = 30
+    t0 = time.perf_counter()
+    for it in range(iters):
+        # touch the buffer so no caching of device arrays is possible
+        batch = base.copy()
+        c, ml, a = r2d2_verdicts(model, batch, lengths, remotes)
+    a.block_until_ready()
+    dt = time.perf_counter() - t0
+    verdicts_per_sec = F * iters / dt
+
+    # CPU oracle baseline (ported in-process proxylib, single thread).
+    n_cpu = 2000
+    res, conn = on_new_connection(
+        mod, "r2d2", 1, True, 1, 2, "1.1.1.1:1", "2.2.2.2:80", "bench"
+    )
+    assert res == FilterResult.OK
+    t0 = time.perf_counter()
+    oracle_allows = []
+    for i in range(n_cpu):
+        ops = []
+        conn.on_data(False, False, [msgs[i % len(msgs)]], ops)
+        oracle_allows.append(ops[0][0] == PASS)
+        conn.reply_buf.take()
+    cpu_dt = time.perf_counter() - t0
+    cpu_per_sec = n_cpu / cpu_dt
+
+    # Bit-identical cross-check on the first cycle of the corpus.
+    dev_allow = np.asarray(allow)
+    mismatches = sum(
+        1
+        for i in range(min(n_cpu, F))
+        if bool(dev_allow[i]) != oracle_allows[i % len(oracle_allows)]
+    )
+    print(
+        f"bench: tpu={verdicts_per_sec:,.0f}/s cpu_oracle={cpu_per_sec:,.0f}/s "
+        f"mismatches={mismatches}/{min(n_cpu, F)} batch={F} iters={iters}",
+        file=sys.stderr,
+    )
+    assert mismatches == 0, "device verdicts diverge from oracle"
+
+    print(
+        json.dumps(
+            {
+                "metric": "r2d2_l7_verdicts_per_sec_per_chip",
+                "value": round(verdicts_per_sec),
+                "unit": "verdicts/s",
+                "vs_baseline": round(verdicts_per_sec / 1_000_000, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

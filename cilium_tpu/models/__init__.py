@@ -1,0 +1,21 @@
+"""Per-protocol batch verdict pipelines — the framework's "model families".
+
+Each model compiles one policy rule set into device arrays and evaluates
+whole [flows, bytes] batches at once, replacing the reference's sequential
+per-request parse+match:
+
+- ``r2d2``      — toy line protocol (reference: proxylib/r2d2)
+- ``http``      — HTTP path/method/host/header rules
+                  (reference: envoy/cilium_l7policy.cc, pkg/policy/api/http.go)
+- ``kafka``     — Kafka request ACLs (reference: pkg/kafka/policy.go)
+- ``cassandra`` — CQL query filtering (reference: proxylib/cassandra)
+- ``memcached`` — memcache command/key rules (reference: proxylib/memcached)
+
+Every model is validated bit-identical against the streaming oracle in
+``cilium_tpu.proxylib`` — the same strategy as the reference's op/byte-exact
+proxylib test harness.
+"""
+
+from .base import ConstVerdict, VerdictModel
+
+__all__ = ["ConstVerdict", "VerdictModel"]

@@ -1,0 +1,72 @@
+"""Shared verdict-model plumbing: remote-ID sets and the port cascade.
+
+The device formula for one compiled port rule set
+(reference semantics: proxylib/proxylib/policymap.go:91-171):
+
+    allow[f] = OR_r ( remote_ok[f, r] AND l7_match[f, r] )
+
+with the degenerate cases (no L7 rules anywhere / empty rule list) folding
+to a constant at build time.  The port cascade (exact port, then wildcard 0,
+reference: policymap.go:208-236) ORs two such results and is resolved when
+the model is built for a concrete port.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_REMOTES = 32
+
+
+@dataclass
+class ConstVerdict:
+    """A rule set whose outcome doesn't depend on the payload."""
+
+    allow: bool
+
+    def __call__(self, *_args, **_kwargs):
+        return self.allow
+
+
+class VerdictModel:
+    """Base for device-backed batch verdict models."""
+
+    n_rules: int = 0
+
+
+def pack_remote_sets(remote_sets: list[frozenset[int]]) -> tuple[np.ndarray, np.ndarray]:
+    """Pack per-rule allowed-remote sets into [R, MAX_REMOTES] int32 plus a
+    per-rule 'empty set allows any remote' flag (reference:
+    policymap.go:92-98)."""
+    r = len(remote_sets)
+    ids = np.zeros((r, MAX_REMOTES), dtype=np.int32)
+    any_remote = np.zeros((r,), dtype=bool)
+    for i, s in enumerate(remote_sets):
+        if not s:
+            any_remote[i] = True
+            continue
+        if len(s) > MAX_REMOTES:
+            raise ValueError(
+                f"rule allows {len(s)} remotes (max {MAX_REMOTES}); "
+                "shard the rule or raise MAX_REMOTES"
+            )
+        ids[i, : len(s)] = sorted(s)
+        # pad with the first id so padding never matches a real remote 0
+        ids[i, len(s):] = ids[i, 0]
+    return ids, any_remote
+
+
+def remote_ok(
+    remote_ids: jax.Array,  # [F] int32
+    packed_ids: jax.Array,  # [R, MAX_REMOTES] int32
+    any_remote: jax.Array,  # [R] bool
+) -> jax.Array:
+    """[F, R] bool: flow f's remote is allowed by rule r."""
+    hit = jnp.any(
+        remote_ids[:, None, None] == packed_ids[None, :, :], axis=2
+    )  # [F, R]
+    return hit | any_remote[None, :]

@@ -1,0 +1,156 @@
+"""r2d2 batch verdict model — the minimum end-to-end TPU slice.
+
+Replaces the reference's per-request parse+match
+(reference: proxylib/r2d2/r2d2parser.go:151-214 + proxylib/proxylib/
+policymap.go rule walk) with one device pass over a [flows, bytes] batch:
+
+  1. frame:    first CRLF per flow               (ops.bytescan)
+  2. tokenize: cmd = bytes before first space; file = bytes after it when
+               the message has exactly one space (msg.split(" ") semantics)
+  3. match:    cmd exact-compare + file regex NFA + remote-ID set, reduced
+               across the flattened (rule, matcher) rows
+
+Build is a pure function ``PolicyInstance -> device arrays``; evaluation is
+jitted and shards on the flow axis.  Bit-identical to the streaming oracle
+(tests/test_r2d2_model.py fuzzes both against each other).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.bytescan import count_byte, first_occurrence, first_subsequence2, spans_equal_prefix
+from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
+from ..proxylib.parsers.r2d2 import R2d2Rule
+from ..proxylib.policy import CompiledPortRules, PolicyInstance
+from ..regex import compile_patterns
+from .base import ConstVerdict, VerdictModel, pack_remote_sets, remote_ok
+
+MAX_CMD = 8  # longest r2d2 command is "RESET" (5)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class R2d2BatchModel(VerdictModel):
+    nfa: DeviceNfa  # file-regex NFA, one pattern per row
+    cmd_needle: jax.Array  # [R, MAX_CMD] uint8
+    cmd_len: jax.Array  # [R] int32
+    cmd_any: jax.Array  # [R] bool
+    remote_ids: jax.Array  # [R, MAX_REMOTES] int32
+    any_remote: jax.Array  # [R] bool
+
+    def tree_flatten(self):
+        return (
+            (self.nfa, self.cmd_needle, self.cmd_len, self.cmd_any,
+             self.remote_ids, self.any_remote),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+    def __call__(self, data, lengths, remotes):
+        return r2d2_verdicts(self, data, lengths, remotes)
+
+
+def _collect_rows(rules: CompiledPortRules):
+    """Flatten (rule, matcher) pairs into device rows.  A rule with no L7
+    matchers contributes one always-match row (remote check only)."""
+    rows = []  # (remote_set, cmd_exact, file_pattern)
+    for rule in rules.rules:
+        matchers = rule.l7_matchers or [None]
+        for m in matchers:
+            if m is None:
+                rows.append((rule.allowed_remotes, "", ""))
+            else:
+                assert isinstance(m, R2d2Rule), f"not an r2d2 rule: {m!r}"
+                rows.append((rule.allowed_remotes, m.cmd_exact, m.file_regex))
+    return rows
+
+
+def build_r2d2_model(
+    policy: PolicyInstance | None, ingress: bool, port: int
+) -> ConstVerdict | R2d2BatchModel:
+    """Compile the effective rule set for (policy, direction, port) into a
+    batch model.  Applies the reference's port cascade at build time:
+    exact-port rules OR wildcard-port rules; missing policy or no matching
+    port entry -> constant deny (reference: policymap.go:208-236,
+    instance.go:157-165)."""
+    if policy is None:
+        return ConstVerdict(False)
+    side = policy.ingress if ingress else policy.egress
+    rows = []
+    for key in (port, 0):
+        rules = side.by_port.get(key)
+        if rules is None:
+            continue
+        if not rules.have_l7_rules or not rules.rules:
+            # Whole set allows any payload from anyone on this port.
+            return ConstVerdict(True)
+        rows.extend(_collect_rows(rules))
+    if not rows:
+        return ConstVerdict(False)
+
+    remote_sets = [r[0] for r in rows]
+    packed_ids, any_remote = pack_remote_sets(remote_sets)
+
+    n = len(rows)
+    cmd_needle = np.zeros((n, MAX_CMD), dtype=np.uint8)
+    cmd_len = np.zeros((n,), dtype=np.int32)
+    cmd_any = np.zeros((n,), dtype=bool)
+    for i, (_, cmd, _f) in enumerate(rows):
+        b = cmd.encode()
+        cmd_needle[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        cmd_len[i] = len(b)
+        cmd_any[i] = len(b) == 0
+
+    tables = compile_patterns([r[2] for r in rows])
+    return R2d2BatchModel(
+        nfa=device_nfa(tables),
+        cmd_needle=jnp.asarray(cmd_needle),
+        cmd_len=jnp.asarray(cmd_len),
+        cmd_any=jnp.asarray(cmd_any),
+        remote_ids=jnp.asarray(packed_ids),
+        any_remote=jnp.asarray(any_remote),
+    )
+
+
+@jax.jit
+def r2d2_verdicts(
+    model: R2d2BatchModel,
+    data: jax.Array,  # [F, L] uint8 — buffered stream per flow
+    lengths: jax.Array,  # [F] int32
+    remotes: jax.Array,  # [F] int32 — source security identity
+):
+    """Returns (complete [F] bool, msg_len [F] int32, allow [F] bool).
+
+    msg_len counts the CRLF (the oracle's PASS/DROP byte count,
+    reference: r2d2parser.go:166).  allow is meaningful only where
+    complete.
+    """
+    crlf = first_subsequence2(data, lengths, 0x0D, 0x0A)  # [F]
+    complete = crlf < lengths
+    msg_len = crlf + 2
+
+    sp = first_occurrence(data, crlf, 0x20)  # first space within msg
+    n_spaces = count_byte(data, crlf, 0x20)
+    one_space = n_spaces == 1
+    file_start = jnp.where(one_space, sp + 1, 0)
+    file_end = jnp.where(one_space, crlf, 0)
+
+    cmd_ok = (
+        spans_equal_prefix(
+            data, jnp.zeros_like(sp), sp, model.cmd_needle, model.cmd_len
+        )
+        | model.cmd_any[None, :]
+    )  # [F, R]
+    file_ok = nfa_search_spans(model.nfa, data, file_start, file_end)  # [F, R]
+    rem_ok = remote_ok(remotes, model.remote_ids, model.any_remote)  # [F, R]
+
+    allow = jnp.any(cmd_ok & file_ok & rem_ok, axis=1)
+    return complete, msg_len, allow

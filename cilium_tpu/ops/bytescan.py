@@ -1,0 +1,79 @@
+"""Byte-parallel field extraction primitives.
+
+The reference's parsers scan byte streams sequentially per request
+(reference: proxylib/r2d2/r2d2parser.go:151-167 splits on "\\r\\n" and " ").
+On TPU the same extraction is a handful of vectorized reductions over the
+whole [flows, bytes] batch at once; everything here is jit-safe with static
+shapes.
+
+Positions are int32; "not found" is encoded as ``length`` (one past the
+span), which composes directly with span-based ops downstream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def first_occurrence(data: jax.Array, lengths: jax.Array, byte: int) -> jax.Array:
+    """Index of the first ``byte`` within each flow's valid span, or
+    ``lengths[f]`` if absent.  data: [F, L] uint8, lengths: [F] int32."""
+    f, l = data.shape
+    pos = jnp.arange(l, dtype=jnp.int32)[None, :]
+    valid = pos < lengths[:, None]
+    hit = (data == jnp.uint8(byte)) & valid
+    return jnp.min(jnp.where(hit, pos, lengths[:, None]), axis=1)
+
+
+def first_subsequence2(
+    data: jax.Array, lengths: jax.Array, b0: int, b1: int
+) -> jax.Array:
+    """Index of the first two-byte sequence ``b0 b1`` (e.g. CRLF) fully
+    inside each flow's valid span, or ``lengths[f]`` if absent."""
+    f, l = data.shape
+    pos = jnp.arange(l, dtype=jnp.int32)[None, :]
+    nxt = jnp.concatenate(
+        [data[:, 1:], jnp.zeros((f, 1), dtype=data.dtype)], axis=1
+    )
+    valid = (pos + 1) < lengths[:, None]
+    hit = (data == jnp.uint8(b0)) & (nxt == jnp.uint8(b1)) & valid
+    return jnp.min(jnp.where(hit, pos, lengths[:, None]), axis=1)
+
+
+def count_byte(data: jax.Array, lengths: jax.Array, byte: int) -> jax.Array:
+    """Occurrences of ``byte`` within each flow's valid span -> [F] int32."""
+    f, l = data.shape
+    pos = jnp.arange(l, dtype=jnp.int32)[None, :]
+    valid = pos < lengths[:, None]
+    return jnp.sum(((data == jnp.uint8(byte)) & valid).astype(jnp.int32), axis=1)
+
+
+def spans_equal_prefix(
+    data: jax.Array,
+    start: jax.Array,
+    end: jax.Array,
+    needle: jax.Array,
+    needle_len: jax.Array,
+) -> jax.Array:
+    """Per (flow, needle): does data[f, start[f]:end[f]] equal needle[r]?
+
+    data: [F, L] uint8; start/end: [F] int32;
+    needle: [R, N] uint8 (zero-padded); needle_len: [R] int32.
+    Returns [F, R] bool.  Used for exact-token matches (r2d2 cmd, Kafka
+    apikey names) without a gather in the inner loop.
+    """
+    f, l = data.shape
+    r, n = needle.shape
+    span_len = end - start  # [F]
+    len_ok = span_len[:, None] == needle_len[None, :]  # [F, R]
+    # Window the first N bytes of each span; when span_len == needle_len the
+    # masked positions below cover exactly the span.
+    idx = start[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]  # [F, N]
+    idx = jnp.minimum(idx, l - 1)
+    window = jnp.take_along_axis(data, idx.astype(jnp.int32), axis=1)  # [F, N]
+    eq = window[:, None, :] == needle[None, :, :]  # [F, R, N]
+    bytes_needed = (
+        jnp.arange(n, dtype=jnp.int32)[None, None, :] < needle_len[None, :, None]
+    )
+    return len_ok & jnp.all(eq | ~bytes_needed, axis=2)

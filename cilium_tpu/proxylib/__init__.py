@@ -1,0 +1,80 @@
+"""Streaming L7 parser framework with the reference proxylib's contracts.
+
+This is the host-side verdict oracle and streaming front-end of the
+framework.  It reproduces, in Python, the exact observable behavior of the
+reference's cgo shared library (reference: proxylib/proxylib.go,
+proxylib/proxylib/{connection,policymap,instance,parserfactory}.go):
+
+- the per-connection ``OnData`` loop emitting ``PASS/DROP/INJECT/MORE/NOP``
+  ops with byte counts (reference: proxylib/proxylib/connection.go:118-174)
+- the policy-match cascade PolicyMap -> PolicyInstance ->
+  PortNetworkPolicies -> PortNetworkPolicyRules -> PortNetworkPolicyRule
+  (reference: proxylib/proxylib/policymap.go)
+- the module/instance lifecycle keyed on (node-id, xds-path,
+  access-log-path) (reference: proxylib/proxylib/instance.go:85-116)
+
+Protocol parsers registered here are *also* the host halves of the TPU batch
+pipelines in ``cilium_tpu.models``: both consume the same compiled rule
+artifacts, so batch verdicts can be checked bit-identical against this
+in-process oracle (the strategy of the reference's own op/byte-exact test
+harness, reference: proxylib/proxylib/test_util.go:95-120).
+"""
+
+from .types import (
+    OpType,
+    OpError,
+    FilterResult,
+    MORE,
+    PASS,
+    DROP,
+    INJECT,
+    ERROR,
+    NOP,
+)
+from .parser import (
+    Parser,
+    ParserFactory,
+    register_parser_factory,
+    get_parser_factory,
+    register_l7_rule_parser,
+    get_l7_rule_parser,
+    PolicyParseError,
+    parse_error,
+)
+from .npds import (
+    NetworkPolicy,
+    PortNetworkPolicy,
+    PortNetworkPolicyRule,
+    TCP,
+    UDP,
+)
+from .policy import PolicyInstance, PolicyMap, build_policy_map
+from .connection import Connection, FILTER_OPS_CAPACITY
+from .instance import (
+    Instance,
+    open_instance,
+    find_instance,
+    close_instance,
+    open_module,
+    close_module,
+    reset_module_registry,
+)
+from .accesslog import LogEntry, EntryType, MemoryAccessLogger
+
+# Parser registrations (import side effects, like the reference's init()).
+from . import parsers as _parsers  # noqa: F401
+
+__all__ = [
+    "OpType", "OpError", "FilterResult",
+    "MORE", "PASS", "DROP", "INJECT", "ERROR", "NOP",
+    "Parser", "ParserFactory",
+    "register_parser_factory", "get_parser_factory",
+    "register_l7_rule_parser", "get_l7_rule_parser",
+    "PolicyParseError", "parse_error",
+    "NetworkPolicy", "PortNetworkPolicy", "PortNetworkPolicyRule", "TCP", "UDP",
+    "PolicyInstance", "PolicyMap", "build_policy_map",
+    "Connection", "FILTER_OPS_CAPACITY",
+    "Instance", "open_instance", "find_instance", "close_instance",
+    "open_module", "close_module", "reset_module_registry",
+    "LogEntry", "EntryType", "MemoryAccessLogger",
+]

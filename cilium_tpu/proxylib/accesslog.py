@@ -1,0 +1,65 @@
+"""Access-log entries and loggers.
+
+Reference: the proxylib access logger sends protobuf ``cilium.LogEntry``
+over a unix socket to the agent (reference: proxylib/accesslog/client.go,
+received by pkg/envoy/accesslog_server.go:90).  Here the canonical record is
+a dataclass; ``MemoryAccessLogger`` is the in-process sink used by tests and
+the oracle harness, and ``cilium_tpu.runtime.accesslog`` provides the
+socket-backed sink that feeds the monitor stream.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class EntryType(enum.IntEnum):
+    Request = 0
+    Response = 1
+    Denied = 2
+
+
+@dataclass
+class LogEntry:
+    timestamp: int = 0
+    is_ingress: bool = False
+    entry_type: EntryType = EntryType.Request
+    policy_name: str = ""
+    source_security_id: int = 0
+    destination_security_id: int = 0
+    source_address: str = ""
+    destination_address: str = ""
+    proto: str = ""
+    fields: dict[str, Any] = field(default_factory=dict)
+
+
+class MemoryAccessLogger:
+    """In-memory logger with the AccessLogger interface
+    (reference: proxylib/proxylib/instance.go:34-38)."""
+
+    def __init__(self, path: str = ""):
+        self._path = path
+        self.entries: list[LogEntry] = []
+
+    def log(self, entry: LogEntry) -> None:
+        if not entry.timestamp:
+            entry.timestamp = time.time_ns()
+        self.entries.append(entry)
+
+    def close(self) -> None:
+        pass
+
+    def path(self) -> str:
+        return self._path
+
+    def counts(self) -> tuple[int, int]:
+        """(passes, drops) — drop = Denied entries, like the reference's
+        checkAccessLogs (reference: proxylib/proxylib_test.go:119-139)."""
+        drops = sum(1 for e in self.entries if e.entry_type == EntryType.Denied)
+        return len(self.entries) - drops, drops
+
+    def clear(self) -> None:
+        self.entries.clear()

@@ -1,0 +1,113 @@
+"""Network-policy wire model (the NPDS ``cilium.NetworkPolicy`` analog).
+
+The reference distributes per-endpoint L7 policy as protobuf over a gRPC
+xDS channel (reference: pkg/envoy/cilium/npds.pb.go, pushed by
+pkg/envoy/server.go:628).  This framework's equivalent wire model is a plain
+dataclass tree (serialized as JSON/dict over the control channel); the
+fields mirror the proto so the policy compiler and test policies translate
+one-to-one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+TCP = "TCP"
+UDP = "UDP"
+
+
+@dataclass
+class PortNetworkPolicyRule:
+    """One allow-rule on a port.
+
+    remote_policies: allowed remote identities (empty = any remote)
+    l7_proto:        which registered L7 rule parser interprets l7_rules
+                     (reference: policymap.go:70-76 — falls back to the
+                     rule-kind name, here 'http'/'kafka' when those typed
+                     rule lists are used)
+    l7_rules:        generic key/value rules (r2d2, cassandra, memcached)
+    http_rules:      typed HTTP rules (dicts with path/method/host/headers)
+    kafka_rules:     typed Kafka rules (dicts with apikey/topic/clientid...)
+    """
+
+    remote_policies: list[int] = field(default_factory=list)
+    l7_proto: str = ""
+    l7_rules: list[dict[str, str]] | None = None
+    http_rules: list[dict[str, Any]] | None = None
+    kafka_rules: list[dict[str, Any]] | None = None
+
+    def l7_kind(self) -> str:
+        """The effective L7 parser name (proto 'oneof' name fallback)."""
+        if self.l7_proto:
+            return self.l7_proto
+        if self.http_rules is not None:
+            return "http"
+        if self.kafka_rules is not None:
+            return "kafka"
+        return ""
+
+    def has_l7(self) -> bool:
+        return (
+            self.l7_kind() != ""
+            or self.l7_rules is not None
+            or self.http_rules is not None
+            or self.kafka_rules is not None
+        )
+
+
+@dataclass
+class PortNetworkPolicy:
+    port: int = 0  # 0 = wildcard port
+    protocol: str = TCP
+    rules: list[PortNetworkPolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicy:
+    name: str = ""  # endpoint policy name (IP in the reference)
+    policy: int = 0  # endpoint identity
+    ingress_per_port_policies: list[PortNetworkPolicy] = field(default_factory=list)
+    egress_per_port_policies: list[PortNetworkPolicy] = field(default_factory=list)
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("NetworkPolicy requires a name")
+        for pp in list(self.ingress_per_port_policies) + list(
+            self.egress_per_port_policies
+        ):
+            if not (0 <= pp.port <= 65535):
+                raise ValueError(f"invalid port {pp.port}")
+            if pp.protocol not in (TCP, UDP):
+                raise ValueError(f"invalid protocol {pp.protocol}")
+
+
+def policy_from_dict(d: dict) -> NetworkPolicy:
+    """Build a NetworkPolicy from a plain dict (the JSON wire form)."""
+
+    def rule(rd: dict) -> PortNetworkPolicyRule:
+        return PortNetworkPolicyRule(
+            remote_policies=list(rd.get("remote_policies", [])),
+            l7_proto=rd.get("l7_proto", ""),
+            l7_rules=rd.get("l7_rules"),
+            http_rules=rd.get("http_rules"),
+            kafka_rules=rd.get("kafka_rules"),
+        )
+
+    def port_policy(pd: dict) -> PortNetworkPolicy:
+        return PortNetworkPolicy(
+            port=pd.get("port", 0),
+            protocol=pd.get("protocol", TCP),
+            rules=[rule(r) for r in pd.get("rules", [])],
+        )
+
+    return NetworkPolicy(
+        name=d.get("name", ""),
+        policy=d.get("policy", 0),
+        ingress_per_port_policies=[
+            port_policy(p) for p in d.get("ingress_per_port_policies", [])
+        ],
+        egress_per_port_policies=[
+            port_policy(p) for p in d.get("egress_per_port_policies", [])
+        ],
+    )

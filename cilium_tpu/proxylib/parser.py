@@ -1,0 +1,72 @@
+"""Parser and L7-rule-parser registries.
+
+Reference: proxylib/proxylib/parserfactory.go (Parser/ParserFactory,
+RegisterParserFactory) and proxylib/proxylib/policymap.go:35-51
+(L7RuleParser, RegisterL7RuleParser, ParseError).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol, runtime_checkable
+
+from .types import OpType
+
+
+@runtime_checkable
+class Parser(Protocol):
+    """Per-connection streaming protocol parser.
+
+    ``on_data(reply, end_stream, data)`` sees the currently buffered data
+    for one direction (a list of byte chunks, always starting on a frame
+    boundary) and returns one ``(op, n_bytes)`` decision:
+
+      MORE n   — keep the data buffered; call again once >= n more bytes
+      PASS n   — allow n bytes
+      DROP n   — drop n bytes; called again with the remainder
+      INJECT n — splice n bytes from the inject buffer into this direction
+      NOP      — nothing to do (no more input expected)
+      ERROR    — unparseable protocol; connection will be closed
+
+    Reference: proxylib/proxylib/parserfactory.go:22-57.
+    """
+
+    def on_data(self, reply: bool, end_stream: bool, data: list[bytes]) -> tuple[OpType, int]:
+        ...
+
+
+class ParserFactory(Protocol):
+    def create(self, connection) -> Parser | None:
+        """Create a parser for a new connection; None rejects it (POLICY_DROP)."""
+        ...
+
+
+class PolicyParseError(Exception):
+    """Raised while compiling a pushed policy; the whole policy update is
+    rejected without touching the active policy map (reference:
+    proxylib/proxylib/policymap.go:49-51, instance.go:168-176)."""
+
+
+def parse_error(reason: str, config=None):
+    raise PolicyParseError(f"NPDS: {reason} (config: {config!r})")
+
+
+_parser_factories: dict[str, ParserFactory] = {}
+# l7 rule parser: (rule_kv_list, full_rule_config) -> list of matcher objects
+# with a .matches(l7_data) -> bool method.
+_l7_rule_parsers: dict[str, Callable] = {}
+
+
+def register_parser_factory(name: str, factory: ParserFactory) -> None:
+    _parser_factories[name] = factory
+
+
+def get_parser_factory(name: str) -> ParserFactory | None:
+    return _parser_factories.get(name)
+
+
+def register_l7_rule_parser(name: str, fn: Callable) -> None:
+    _l7_rule_parsers[name] = fn
+
+
+def get_l7_rule_parser(name: str):
+    return _l7_rule_parsers.get(name)

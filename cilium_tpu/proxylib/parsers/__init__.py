@@ -1,0 +1,10 @@
+"""Protocol parser registrations (import side effects).
+
+Like the reference's per-parser ``init()`` functions
+(reference: proxylib/r2d2/r2d2parser.go:133-137).
+"""
+
+from . import testparsers  # noqa: F401
+from . import r2d2  # noqa: F401
+from . import cassandra  # noqa: F401
+from . import memcached  # noqa: F401

@@ -1,0 +1,4 @@
+"""Cassandra CQL parser — implemented in cilium_tpu.proxylib.parsers.cassandra (phase 4).
+
+Reference: proxylib/cassandra/cassandraparser.go.
+"""

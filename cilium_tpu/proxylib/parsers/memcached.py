@@ -1,0 +1,4 @@
+"""Memcached parser — implemented in cilium_tpu.proxylib.parsers.memcached (phase 4).
+
+Reference: proxylib/memcached/parser.go.
+"""

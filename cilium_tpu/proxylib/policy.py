@@ -1,0 +1,143 @@
+"""Compiled policy-match cascade.
+
+Bit-for-bit the reference's verdict semantics
+(reference: proxylib/proxylib/policymap.go):
+
+- rule level (:91-111): remote id must be in the allowed set if non-empty;
+  any L7 rule matching allows; an empty L7 rule list allows any payload.
+- rules level (:150-171): no L7 rules at all -> allow (BPF verdict final);
+  empty rule list -> allow; otherwise first matching rule allows.
+- port level (:208-236): exact port, then wildcard port 0; a port with a
+  policy that matches nothing -> drop; NO policy for the port -> drop.
+- unknown L7 parser (:128-133): drop-all for that port.
+- UDP port policies are ignored (:182-184); non-TCP otherwise rejected.
+- duplicate port numbers rejected (:188-190); mismatched L7 types on one
+  port rejected (:138-144).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from .npds import TCP, UDP, NetworkPolicy, PortNetworkPolicy, PortNetworkPolicyRule
+from .parser import get_l7_rule_parser, parse_error
+
+
+@dataclass
+class CompiledRule:
+    allowed_remotes: frozenset[int]
+    l7_matchers: list[Any]  # objects with .matches(l7_data) -> bool
+
+    def matches(self, remote_id: int, l7_data) -> bool:
+        if self.allowed_remotes and remote_id not in self.allowed_remotes:
+            return False
+        if self.l7_matchers:
+            return any(m.matches(l7_data) for m in self.l7_matchers)
+        return True  # empty set matches any payload
+
+
+@dataclass
+class CompiledPortRules:
+    rules: list[CompiledRule] = field(default_factory=list)
+    have_l7_rules: bool = False
+
+    def matches(self, remote_id: int, l7_data) -> bool:
+        if not self.have_l7_rules:
+            # No L7 rules: the datapath's L3/L4 verdict is final; emulate by
+            # allowing (reference: policymap.go:151-158).
+            return True
+        if not self.rules:
+            return True
+        return any(r.matches(remote_id, l7_data) for r in self.rules)
+
+
+def _compile_rule(config: PortNetworkPolicyRule) -> tuple[CompiledRule | None, bool]:
+    """Returns (compiled, ok).  ok=False => unknown L7 parser: the whole
+    port becomes drop-all (reference: policymap.go:128-133)."""
+    rule = CompiledRule(
+        allowed_remotes=frozenset(config.remote_policies), l7_matchers=[]
+    )
+    kind = config.l7_kind()
+    if kind:
+        parser = get_l7_rule_parser(kind)
+        if parser is None:
+            return rule, False
+        rule.l7_matchers = parser(config)
+    return rule, True
+
+
+@dataclass
+class CompiledPortPolicies:
+    by_port: dict[int, CompiledPortRules] = field(default_factory=dict)
+
+    def matches(self, port: int, remote_id: int, l7_data) -> bool:
+        rules = self.by_port.get(port)
+        if rules is not None and rules.matches(remote_id, l7_data):
+            return True
+        wc = self.by_port.get(0)
+        if wc is not None and wc.matches(remote_id, l7_data):
+            return True
+        return False
+
+
+def _compile_port_policies(configs: list[PortNetworkPolicy]) -> CompiledPortPolicies:
+    out = CompiledPortPolicies()
+    for pp in configs:
+        if pp.protocol == UDP:
+            continue  # ignored (reference: policymap.go:182-184)
+        if pp.protocol != TCP:
+            parse_error(f"Invalid transport protocol {pp.protocol}", pp)
+        if pp.port in out.by_port:
+            parse_error(f"Duplicate port number {pp.port}", configs)
+
+        compiled = CompiledPortRules()
+        ok = True
+        first_kind = ""
+        for rc in pp.rules:
+            rule, rule_ok = _compile_rule(rc)
+            if not rule_ok:
+                # Unknown L7 parser: the port is SKIPPED, so lookups find no
+                # policy and drop (reference: policymap.go:196-203 only
+                # installs the port when rules compiled ok).
+                ok = False
+                break
+            if rule.l7_matchers:
+                compiled.have_l7_rules = True
+            kind = rc.l7_kind()
+            if kind:
+                if not first_kind:
+                    first_kind = kind
+                elif kind != first_kind:
+                    parse_error("Mismatching L7 types on the same port", configs)
+            compiled.rules.append(rule)
+        if ok:
+            out.by_port[pp.port] = compiled
+    return out
+
+
+@dataclass
+class PolicyInstance:
+    config: NetworkPolicy
+    ingress: CompiledPortPolicies
+    egress: CompiledPortPolicies
+
+    def matches(self, ingress: bool, port: int, remote_id: int, l7_data) -> bool:
+        side = self.ingress if ingress else self.egress
+        return side.matches(port, remote_id, l7_data)
+
+
+PolicyMap = dict[str, PolicyInstance]
+
+
+def compile_policy(config: NetworkPolicy) -> PolicyInstance:
+    config.validate()
+    return PolicyInstance(
+        config=config,
+        ingress=_compile_port_policies(config.ingress_per_port_policies),
+        egress=_compile_port_policies(config.egress_per_port_policies),
+    )
+
+
+def build_policy_map(configs: list[NetworkPolicy]) -> PolicyMap:
+    return {c.name: compile_policy(c) for c in configs}

@@ -1,0 +1,46 @@
+"""Filter op and result codes — numerically identical to the reference ABI.
+
+Values mirror proxylib/proxylib/types.h so the native C++ datapath shim
+(``native/``) shares the enum encoding with the reference's Envoy-side
+consumer (reference: envoy/cilium_proxylib.cc:201-260 applies these ops).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class OpType(enum.IntEnum):
+    MORE = 0
+    PASS = 1
+    DROP = 2
+    INJECT = 3
+    ERROR = 4
+    # Internal only, never exposed to the datapath caller
+    # (reference: proxylib/proxylib/types.go:36)
+    NOP = 256
+
+
+MORE = OpType.MORE
+PASS = OpType.PASS
+DROP = OpType.DROP
+INJECT = OpType.INJECT
+ERROR = OpType.ERROR
+NOP = OpType.NOP
+
+
+class OpError(enum.IntEnum):
+    ERROR_INVALID_OP_LENGTH = 1
+    ERROR_INVALID_FRAME_TYPE = 2
+    ERROR_INVALID_FRAME_LENGTH = 3
+
+
+class FilterResult(enum.IntEnum):
+    OK = 0
+    POLICY_DROP = 1
+    PARSER_ERROR = 2
+    UNKNOWN_PARSER = 3
+    UNKNOWN_CONNECTION = 4
+    INVALID_ADDRESS = 5
+    INVALID_INSTANCE = 6
+    UNKNOWN_ERROR = 7

@@ -1,0 +1,9 @@
+"""Host-side runtime: batching engine, sidecar service, access-log plumbing.
+
+The reference's per-connection hot loop lives in Envoy's GoFilter::OnIO
+(reference: envoy/cilium_proxylib.cc:125-260) which calls into proxylib
+per connection.  Here the runtime instead aggregates many connections'
+buffered frames into fixed-shape [flows, bytes] batches, dispatches one
+device computation, and fans the verdicts back out into per-connection op
+lists that honor the same PASS/DROP/INJECT/MORE contract.
+"""

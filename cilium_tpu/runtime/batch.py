@@ -1,0 +1,192 @@
+"""Flow-batching engine: buffered streams -> device batches -> filter ops.
+
+Maps the streaming OnData contract (reference: proxylib/proxylib/
+connection.go:118-174) onto fixed-shape device dispatch:
+
+- each flow keeps a byte buffer (the datapath's retained-data buffer in the
+  reference, see parserfactory.go:34-40)
+- one engine step packs the first unconsumed frame of every active flow
+  into a [F, L] batch, runs the model once, and converts per-flow verdicts
+  into (PASS n | DROP n + inject) ops, consuming the frame
+- flows whose buffer holds no complete frame get MORE (retain bytes)
+- steps repeat until no flow has a complete frame (multi-frame buffers
+  drain across steps, preserving per-flow op order)
+
+Verdict-op mapping is the r2d2 parser's (reference: r2d2parser.go:188-213):
+allow -> PASS msg_len; deny -> inject b"ERROR\\r\\n" into the reply
+direction + DROP msg_len; reply direction always passes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models.base import ConstVerdict
+from ..proxylib.accesslog import EntryType, LogEntry
+from ..proxylib.types import DROP, MORE, PASS, OpType
+
+
+@dataclass
+class FlowState:
+    flow_id: int
+    remote_id: int
+    policy_name: str = ""
+    ingress: bool = True
+    dst_id: int = 0
+    src_addr: str = ""
+    dst_addr: str = ""
+    buffer: bytearray = field(default_factory=bytearray)
+    ops: list[tuple[OpType, int]] = field(default_factory=list)
+    reply_inject: bytearray = field(default_factory=bytearray)
+    # Mirrors the streaming path's caller-owned inject buffer capacity
+    # (reference: connection.go:190-209): injected bytes beyond this are
+    # truncated, never buffered unboundedly.
+    inject_capacity: int = 1024
+
+
+class R2d2BatchEngine:
+    """Batch engine for the r2d2 model (the flagship end-to-end slice)."""
+
+    def __init__(self, model, capacity: int = 2048, width: int = 256, logger=None):
+        self.model = model
+        self.capacity = capacity
+        self.width = width
+        self.logger = logger
+        self.flows: dict[int, FlowState] = {}
+
+    def flow(
+        self,
+        flow_id: int,
+        remote_id: int,
+        policy_name: str = "",
+        ingress: bool = True,
+        dst_id: int = 0,
+        src_addr: str = "",
+        dst_addr: str = "",
+    ) -> FlowState:
+        st = self.flows.get(flow_id)
+        if st is None:
+            st = FlowState(
+                flow_id=flow_id,
+                remote_id=remote_id,
+                policy_name=policy_name,
+                ingress=ingress,
+                dst_id=dst_id,
+                src_addr=src_addr,
+                dst_addr=dst_addr,
+            )
+            self.flows[flow_id] = st
+        return st
+
+    def feed(self, flow_id: int, data: bytes, remote_id: int = 0, policy_name: str = "", **flow_kwargs) -> None:
+        self.flow(flow_id, remote_id, policy_name, **flow_kwargs).buffer += data
+
+    def pump(self) -> None:
+        """Run device steps until no flow has a complete frame; appends ops
+        to each flow's op list."""
+        ops_before = {fid: len(st.ops) for fid, st in self.flows.items()}
+        while self._step():
+            pass
+        # The streaming parser is re-invoked on the remainder after every
+        # PASS/DROP and answers MORE 1 when no CRLF is left (reference:
+        # r2d2parser.go:158-161) — flows that saw activity or still hold
+        # bytes end the round with MORE 1 for op-sequence parity.
+        for fid, st in self.flows.items():
+            grew = len(st.ops) > ops_before.get(fid, 0)
+            if (st.buffer or grew) and (not st.ops or st.ops[-1][0] != MORE):
+                st.ops.append((MORE, 1))
+
+    def _step(self) -> bool:
+        # Group flows with a complete frame by the batch width needed to
+        # hold it (power-of-two buckets >= the configured width), so frames
+        # longer than the default width still get verdicts instead of
+        # buffering forever — the streaming parser sees its whole buffer
+        # (reference: r2d2parser.go:154 joins all buffered data).
+        buckets: dict[int, list[FlowState]] = {}
+        for st in self.flows.values():
+            idx = st.buffer.find(b"\r\n")
+            if idx < 0:
+                continue
+            w = self.width
+            while idx + 2 > w:
+                w *= 2
+            buckets.setdefault(w, []).append(st)
+        if not buckets:
+            return False
+        any_work = False
+        for w, active in sorted(buckets.items()):
+            for chunk_start in range(0, len(active), self.capacity):
+                chunk = active[chunk_start : chunk_start + self.capacity]
+                any_work |= self._run_chunk(chunk, w)
+        return any_work
+
+    def _run_chunk(self, chunk: list[FlowState], width: int | None = None) -> bool:
+        width = width or self.width
+        f = len(chunk)
+        if isinstance(self.model, ConstVerdict):
+            for st in chunk:
+                idx = bytes(st.buffer).find(b"\r\n")
+                msg_len = idx + 2
+                self._emit(st, bytes(st.buffer[:idx]), bool(self.model.allow), msg_len)
+            return True
+
+        # Pad the flow axis to a power of two so the jitted model sees a
+        # small fixed set of shapes instead of recompiling per chunk size;
+        # padding rows have length 0 -> incomplete -> ignored on emit.
+        f_pad = 1
+        while f_pad < f:
+            f_pad *= 2
+        data = np.zeros((f_pad, width), dtype=np.uint8)
+        lengths = np.zeros((f_pad,), dtype=np.int32)
+        remotes = np.zeros((f_pad,), dtype=np.int32)
+        for i, st in enumerate(chunk):
+            n = min(len(st.buffer), width)
+            data[i, :n] = np.frombuffer(bytes(st.buffer[:n]), dtype=np.uint8)
+            lengths[i] = n
+            remotes[i] = st.remote_id
+
+        complete, msg_len, allow = self.model(data, lengths, remotes)
+        complete = np.asarray(complete)
+        msg_len = np.asarray(msg_len)
+        allow = np.asarray(allow)
+
+        for i, st in enumerate(chunk):
+            if not complete[i]:
+                continue
+            n = int(msg_len[i])
+            self._emit(st, bytes(st.buffer[: n - 2]), bool(allow[i]), n)
+        return True
+
+    def _emit(self, st: FlowState, msg: bytes, allow: bool, msg_len: int) -> None:
+        if self.logger is not None:
+            fields = msg.decode("utf-8", "surrogateescape").split(" ")
+            file_ = fields[1] if len(fields) == 2 else ""
+            self.logger.log(
+                LogEntry(
+                    is_ingress=st.ingress,
+                    entry_type=EntryType.Request if allow else EntryType.Denied,
+                    policy_name=st.policy_name,
+                    source_security_id=st.remote_id,
+                    destination_security_id=st.dst_id,
+                    source_address=st.src_addr,
+                    destination_address=st.dst_addr,
+                    proto="r2d2",
+                    fields={"cmd": fields[0] if fields else "", "file": file_},
+                )
+            )
+        if allow:
+            st.ops.append((PASS, msg_len))
+        else:
+            room = st.inject_capacity - len(st.reply_inject)
+            st.reply_inject += b"ERROR\r\n"[: max(room, 0)]
+            st.ops.append((DROP, msg_len))
+        del st.buffer[:msg_len]
+
+    def take_ops(self, flow_id: int) -> tuple[list[tuple[OpType, int]], bytes]:
+        st = self.flows[flow_id]
+        ops, inject = st.ops, bytes(st.reply_inject)
+        st.ops = []
+        st.reply_inject = bytearray()
+        return ops, inject

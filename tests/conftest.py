@@ -1,14 +1,12 @@
 """Test configuration: force a virtual 8-device CPU mesh for sharding tests.
 
-Must run before the first ``import jax`` anywhere in the test session.
-Benchmarks (bench.py) do NOT import this and run on the real TPU chip.
+The axon TPU plugin (sitecustomize) overrides JAX_PLATFORMS at import time,
+so env vars alone don't stick — the config must be updated programmatically
+before the first backend use.  Benchmarks (bench.py) do NOT use this and run
+on the real TPU chip.
 """
 
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,64 @@
+"""Test harness mirroring the reference's op/byte-exact oracle.
+
+Reference: proxylib/proxylib/test_util.go (CheckNewConnection/CheckOnData
+assert exact FilterOp sequences and injected reply bytes).
+"""
+
+from __future__ import annotations
+
+from cilium_tpu.proxylib import FilterResult
+from cilium_tpu.proxylib import instance as inst
+
+_connection_id = 0
+
+
+def new_connection(
+    module_id: int,
+    proto: str,
+    ingress: bool,
+    src_id: int,
+    dst_id: int,
+    src_addr: str,
+    dst_addr: str,
+    policy_name: str,
+    buf_size: int = 1024,
+):
+    global _connection_id
+    _connection_id += 1
+    return inst.on_new_connection(
+        module_id,
+        proto,
+        _connection_id,
+        ingress,
+        src_id,
+        dst_id,
+        src_addr,
+        dst_addr,
+        policy_name,
+        orig_buf_capacity=buf_size,
+        reply_buf_capacity=buf_size,
+    )
+
+
+def check_on_data(
+    conn,
+    reply: bool,
+    end_stream: bool,
+    data: list[bytes],
+    exp_ops: list[tuple],
+    exp_result=FilterResult.OK,
+    exp_reply_buf: bytes = b"",
+):
+    """Assert the exact op sequence and injected reply bytes
+    (reference: test_util.go:95-120)."""
+    ops: list[tuple] = []
+    res = conn.on_data(reply, end_stream, data, ops)
+    assert res == exp_result, f"result {res!r} != {exp_result!r}"
+    assert len(ops) == len(exp_ops), f"ops {ops} != expected {exp_ops}"
+    for got, exp in zip(ops, exp_ops):
+        assert got[0] == exp[0] and got[1] == exp[1], f"ops {ops} != {exp_ops}"
+    got_reply = conn.reply_buf.take()
+    # The reference truncates the expectation to the (caller-owned) buffer
+    # capacity (reference: helpers_test.go checkBuf).
+    exp = exp_reply_buf[: conn.reply_buf.capacity]
+    assert got_reply == exp, f"inject buf {got_reply!r} != {exp!r}"

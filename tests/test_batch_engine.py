@@ -1,0 +1,94 @@
+"""Batch engine vs streaming oracle: op sequences, injects, logs."""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.models.r2d2 import build_r2d2_model
+from cilium_tpu.proxylib import (
+    DROP,
+    MORE,
+    PASS,
+    MemoryAccessLogger,
+    NetworkPolicy,
+    PortNetworkPolicy,
+    PortNetworkPolicyRule,
+    find_instance,
+    open_module,
+    reset_module_registry,
+)
+from cilium_tpu.runtime.batch import R2d2BatchEngine
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    reset_module_registry()
+    yield
+    reset_module_registry()
+
+
+def _engine(width=256, logger=None):
+    mod = open_module([], True)
+    ins = find_instance(mod)
+    ins.policy_update(
+        [
+            NetworkPolicy(
+                name="p",
+                policy=2,
+                ingress_per_port_policies=[
+                    PortNetworkPolicy(
+                        port=80,
+                        rules=[
+                            PortNetworkPolicyRule(
+                                l7_proto="r2d2",
+                                l7_rules=[{"cmd": "READ", "file": "/public/.*"}],
+                            )
+                        ],
+                    )
+                ],
+            )
+        ]
+    )
+    model = build_r2d2_model(ins.policy_map()["p"], True, 80)
+    return R2d2BatchEngine(model, width=width, logger=logger)
+
+
+def test_split_frames_and_multi_frame_feed():
+    logger = MemoryAccessLogger()
+    eng = _engine(logger=logger)
+    eng.feed(1, b"READ /pub", remote_id=1, policy_name="p")
+    eng.pump()
+    assert eng.take_ops(1) == ([(MORE, 1)], b"")
+    eng.feed(1, b"lic/a.txt\r\nWRITE /x\r\n")
+    eng.feed(2, b"HALT\r\nREAD /public/b\r\n", remote_id=9, policy_name="p")
+    eng.pump()
+    assert eng.take_ops(1) == ([(PASS, 20), (DROP, 10), (MORE, 1)], b"ERROR\r\n")
+    assert eng.take_ops(2) == ([(DROP, 6), (PASS, 16), (MORE, 1)], b"ERROR\r\n")
+    assert logger.counts() == (2, 2)
+
+
+def test_oversized_frame_widens_batch():
+    """A frame longer than the configured batch width must still get a
+    verdict (the streaming parser sees its whole buffer; reference:
+    r2d2parser.go:154)."""
+    eng = _engine(width=64)
+    msg = b"READ /public/" + b"x" * 100 + b"\r\n"
+    eng.feed(1, msg, remote_id=1)
+    eng.pump()
+    ops, inject = eng.take_ops(1)
+    assert ops == [(PASS, len(msg)), (MORE, 1)]
+    assert inject == b""
+
+
+def test_large_flow_count_chunks():
+    eng = _engine()
+    eng.capacity = 8  # force chunking
+    for i in range(20):
+        msg = b"READ /public/a\r\n" if i % 2 == 0 else b"RESET\r\n"
+        eng.feed(i, msg, remote_id=1)
+    eng.pump()
+    for i in range(20):
+        ops, inject = eng.take_ops(i)
+        if i % 2 == 0:
+            assert ops == [(PASS, 16), (MORE, 1)] and inject == b""
+        else:
+            assert ops == [(DROP, 7), (MORE, 1)] and inject == b"ERROR\r\n"
