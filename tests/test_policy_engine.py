@@ -1,0 +1,735 @@
+"""Policy engine tests: labels, selectors, rule validation, repository
+resolution and merge semantics.
+
+Golden cases modeled on the reference's test strategy (reference:
+pkg/policy/l4Filter_test.go case table, pkg/policy/repository_test.go,
+pkg/policy/api/rule_validation_test.go).
+"""
+
+import pytest
+
+from cilium_tpu.labels import (
+    Label,
+    LabelArray,
+    Labels,
+    get_extended_key_from,
+    parse_label,
+    parse_select_label,
+)
+from cilium_tpu.labels.cidr import ip_string_to_label
+from cilium_tpu.policy import (
+    CIDRRule,
+    Decision,
+    DPort,
+    EgressRule,
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PARSER_TYPE_HTTP,
+    PARSER_TYPE_KAFKA,
+    PolicyMergeError,
+    PolicyValidationError,
+    PortProtocol,
+    PortRule,
+    PortRuleHTTP,
+    PortRuleKafka,
+    PortRuleL7,
+    Repository,
+    Rule,
+    SearchContext,
+    SelectorRequirement,
+    WILDCARD_SELECTOR,
+    parse_proxy_id,
+    proxy_id,
+    rules_from_json,
+    rules_to_json,
+)
+from cilium_tpu.policy.api import (
+    KAFKA_CONSUME_KEYS,
+    KAFKA_PRODUCE_KEYS,
+    compute_resultant_cidr_set,
+)
+
+
+def sel(*lbls: str) -> EndpointSelector:
+    return EndpointSelector.from_labels(*(parse_select_label(l) for l in lbls))
+
+
+def ctx_to(*lbls: str) -> SearchContext:
+    return SearchContext(to_labels=LabelArray.parse_select(*lbls))
+
+
+# ---------------------------------------------------------------------------
+# labels
+
+
+class TestLabels:
+    def test_parse_label_forms(self):
+        l = parse_label("k8s:role=frontend")
+        assert (l.source, l.key, l.value) == ("k8s", "role", "frontend")
+        l = parse_label("$host")
+        assert (l.source, l.key) == ("reserved", "host")
+        l = parse_label("reserved:world")
+        assert (l.source, l.key) == ("reserved", "world")
+        l = parse_label("foo=bar")
+        assert (l.source, l.key, l.value) == ("unspec", "foo", "bar")
+        assert parse_select_label("foo=bar").source == "any"
+
+    def test_extended_key(self):
+        assert get_extended_key_from("k8s:foo=bar") == "k8s.foo"
+        assert get_extended_key_from("foo=bar") == "any.foo"
+        assert Label.new("k8s:x", "1").extended_key == "k8s.x"
+
+    def test_any_source_matches_all_sources(self):
+        any_l = parse_select_label("role=frontend")
+        k8s_l = parse_label("k8s:role=frontend")
+        assert any_l.equals(k8s_l)
+        assert not k8s_l.equals(parse_label("container:role=frontend"))
+
+    def test_label_array_contains(self):
+        arr = LabelArray.parse("k8s:a=1", "k8s:b=2")
+        assert arr.contains(LabelArray.parse_select("a=1"))
+        assert not arr.contains(LabelArray.parse_select("a=2"))
+        assert arr.contains(LabelArray())
+
+    def test_labels_sha(self):
+        l1 = Labels.from_model(["k8s:a=1", "k8s:b=2"])
+        l2 = Labels.from_model(["k8s:b=2", "k8s:a=1"])
+        assert l1.sha256_sum() == l2.sha256_sum()
+
+    def test_cidr_label(self):
+        l = ip_string_to_label("10.0.0.0/8")
+        assert l.source == "cidr"
+        assert l.key == "10.0.0.0/8"
+        l = ip_string_to_label("192.0.2.3")
+        assert l.key == "192.0.2.3/32"
+        assert ip_string_to_label("f00d::1").key == "f00d--1/128"
+        assert ip_string_to_label("not-an-ip") is None
+
+
+# ---------------------------------------------------------------------------
+# selectors
+
+
+class TestSelectors:
+    def test_wildcard_matches_everything(self):
+        assert WILDCARD_SELECTOR.matches(LabelArray.parse_select("anything"))
+        assert WILDCARD_SELECTOR.matches(LabelArray())
+        assert WILDCARD_SELECTOR.is_wildcard()
+
+    def test_match_labels(self):
+        s = sel("role=frontend")
+        assert s.matches(LabelArray.parse("k8s:role=frontend"))
+        assert not s.matches(LabelArray.parse("k8s:role=backend"))
+        assert not s.matches(LabelArray())
+
+    def test_reserved_all_label_short_circuits(self):
+        s = sel("reserved:all")
+        assert s.matches(LabelArray.parse("k8s:whatever=x"))
+        assert s.matches(LabelArray())
+
+    def test_match_expressions(self):
+        s = EndpointSelector.from_dict(
+            None,
+            [SelectorRequirement("env", "In", ("prod", "staging"))],
+        )
+        assert s.matches(LabelArray.parse_select("env=prod"))
+        assert not s.matches(LabelArray.parse_select("env=dev"))
+        s = EndpointSelector.from_dict(None, [SelectorRequirement("env", "Exists")])
+        assert s.matches(LabelArray.parse_select("env=x"))
+        assert not s.matches(LabelArray.parse_select("other=x"))
+        s = EndpointSelector.from_dict(
+            None, [SelectorRequirement("env", "DoesNotExist")]
+        )
+        assert s.matches(LabelArray.parse_select("other=x"))
+        # NotIn matches when key is absent (k8s semantics).
+        s = EndpointSelector.from_dict(
+            None, [SelectorRequirement("env", "NotIn", ("prod",))]
+        )
+        assert s.matches(LabelArray.parse_select("other=x"))
+        assert not s.matches(LabelArray.parse_select("env=prod"))
+
+    def test_requirement_validation(self):
+        with pytest.raises(PolicyValidationError):
+            SelectorRequirement("k", "In").validate()
+        with pytest.raises(PolicyValidationError):
+            SelectorRequirement("k", "Exists", ("v",)).validate()
+        with pytest.raises(PolicyValidationError):
+            SelectorRequirement("k", "Bogus").validate()
+
+
+# ---------------------------------------------------------------------------
+# rule validation (reference: rule_validation_test.go)
+
+
+class TestSanitize:
+    def test_nil_selector_rejected(self):
+        with pytest.raises(PolicyValidationError):
+            Rule().sanitize()
+
+    def test_l3_member_exclusivity(self):
+        r = Rule(
+            endpoint_selector=WILDCARD_SELECTOR,
+            ingress=[
+                IngressRule(
+                    from_endpoints=[sel("a")],
+                    from_cidr=["10.0.0.0/8"],
+                )
+            ],
+        )
+        with pytest.raises(PolicyValidationError, match="[Cc]ombining"):
+            r.sanitize()
+
+    def test_cidr_with_to_ports_rejected_ingress(self):
+        r = Rule(
+            endpoint_selector=WILDCARD_SELECTOR,
+            ingress=[
+                IngressRule(
+                    from_cidr=["10.0.0.0/8"],
+                    to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+                )
+            ],
+        )
+        with pytest.raises(PolicyValidationError, match="ToPorts"):
+            r.sanitize()
+
+    def test_cidr_with_to_ports_allowed_egress(self):
+        r = Rule(
+            endpoint_selector=WILDCARD_SELECTOR,
+            egress=[
+                EgressRule(
+                    to_cidr=["10.0.0.0/8"],
+                    to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+                )
+            ],
+        )
+        r.sanitize()  # L3-dependent L4 is supported on all egress members
+
+    def test_l7_requires_tcp(self):
+        r = Rule(
+            endpoint_selector=WILDCARD_SELECTOR,
+            ingress=[
+                IngressRule(
+                    to_ports=[
+                        PortRule(
+                            ports=[PortProtocol("53", "UDP")],
+                            rules=L7Rules(http=[PortRuleHTTP(path="/")]),
+                        )
+                    ]
+                )
+            ],
+        )
+        with pytest.raises(PolicyValidationError, match="TCP"):
+            r.sanitize()
+
+    def test_mixed_l7_types_rejected(self):
+        pr = PortRule(
+            ports=[PortProtocol("80", "TCP")],
+            rules=L7Rules(
+                http=[PortRuleHTTP(path="/")], kafka=[PortRuleKafka(topic="t")]
+            ),
+        )
+        with pytest.raises(PolicyValidationError, match="multiple L7"):
+            pr.sanitize()
+
+    def test_port_validation(self):
+        with pytest.raises(PolicyValidationError):
+            PortProtocol("0", "TCP").sanitize()
+        with pytest.raises(PolicyValidationError):
+            PortProtocol("notaport", "TCP").sanitize()
+        with pytest.raises(PolicyValidationError):
+            PortProtocol("80", "SCTP").sanitize()
+        assert PortProtocol("80", "tcp").sanitize().protocol == "TCP"
+        assert PortProtocol("80", "").sanitize().protocol == "ANY"
+
+    def test_l7_without_l7proto_rejected(self):
+        pr = PortRule(
+            ports=[PortProtocol("80", "TCP")],
+            rules=L7Rules(l7=[PortRuleL7({"cmd": "READ"})]),
+        )
+        with pytest.raises(PolicyValidationError, match="l7proto"):
+            pr.sanitize()
+
+    def test_cidr_exception_containment(self):
+        CIDRRule("10.0.0.0/8", ("10.96.0.0/12",)).sanitize()
+        with pytest.raises(PolicyValidationError, match="does not contain"):
+            CIDRRule("10.0.0.0/8", ("192.168.0.0/16",)).sanitize()
+
+    def test_kafka_role_apikey_exclusive(self):
+        k = PortRuleKafka(role="produce", api_key="fetch")
+        with pytest.raises(PolicyValidationError):
+            k.sanitize()
+
+    def test_kafka_role_expansion(self):
+        k = PortRuleKafka(role="produce")
+        k.sanitize()
+        assert k.api_keys_int == KAFKA_PRODUCE_KEYS
+        assert k.check_api_key_role(0) and k.check_api_key_role(18)
+        assert not k.check_api_key_role(1)
+        k = PortRuleKafka(role="consume")
+        k.sanitize()
+        assert k.api_keys_int == KAFKA_CONSUME_KEYS
+        k = PortRuleKafka(api_key="fetch")
+        k.sanitize()
+        assert k.api_keys_int == (1,)
+        k = PortRuleKafka()
+        k.sanitize()
+        assert k.check_api_key_role(33)  # wildcard
+
+    def test_invalid_regex_rejected(self):
+        with pytest.raises(PolicyValidationError):
+            PortRuleHTTP(path="([unclosed").sanitize()
+
+
+# ---------------------------------------------------------------------------
+# CIDR set computation
+
+
+class TestCIDR:
+    def test_resultant_cidr_set(self):
+        out = compute_resultant_cidr_set(
+            [CIDRRule("10.0.0.0/24", ("10.0.0.0/25",))]
+        )
+        assert out == ["10.0.0.128/25"]
+
+    def test_resultant_no_exceptions(self):
+        assert compute_resultant_cidr_set([CIDRRule("10.0.0.0/8")]) == ["10.0.0.0/8"]
+
+
+# ---------------------------------------------------------------------------
+# repository basics (reference: repository_test.go TestAddSearchDelete)
+
+
+class TestRepository:
+    def test_add_search_delete_revision(self):
+        repo = Repository()
+        lbls1 = LabelArray.parse("tag1", "tag2")
+        r1 = Rule(endpoint_selector=sel("foo"), labels=lbls1)
+        rev0 = repo.get_revision()
+        rev = repo.add(r1)
+        assert rev > rev0
+        assert repo.search(LabelArray.parse("tag1")) == [r1]
+        rev2, deleted = repo.delete_by_labels(LabelArray.parse("tag1"))
+        assert deleted == 1 and rev2 > rev
+        assert repo.num_rules() == 0
+        # deleting nothing does not bump
+        rev3, deleted = repo.delete_by_labels(LabelArray.parse("tag1"))
+        assert deleted == 0 and rev3 == rev2
+
+    def test_can_reach_ingress(self):
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("bar"),
+                ingress=[IngressRule(from_endpoints=[sel("foo")])],
+            )
+        )
+        ctx = SearchContext(
+            from_labels=LabelArray.parse_select("foo"),
+            to_labels=LabelArray.parse_select("bar"),
+        )
+        assert repo.allows_ingress(ctx) == Decision.ALLOWED
+        ctx_bad = SearchContext(
+            from_labels=LabelArray.parse_select("baz"),
+            to_labels=LabelArray.parse_select("bar"),
+        )
+        assert repo.allows_ingress(ctx_bad) == Decision.DENIED
+
+    def test_from_requires_denies(self):
+        # reference: repository_test.go TestCanReachIngress requires cases
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("bar"),
+                ingress=[IngressRule(from_endpoints=[sel("foo")])],
+            )
+        )
+        repo.add(
+            Rule(
+                endpoint_selector=sel("bar"),
+                ingress=[IngressRule(from_requires=[sel("team=A")])],
+            )
+        )
+        ok = SearchContext(
+            from_labels=LabelArray.parse_select("foo", "team=A"),
+            to_labels=LabelArray.parse_select("bar"),
+        )
+        assert repo.allows_ingress(ok) == Decision.ALLOWED
+        bad = SearchContext(
+            from_labels=LabelArray.parse_select("foo"),
+            to_labels=LabelArray.parse_select("bar"),
+        )
+        assert repo.allows_ingress(bad) == Decision.DENIED
+
+    def test_egress_requires(self):
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("foo"),
+                egress=[EgressRule(to_endpoints=[sel("bar")])],
+            )
+        )
+        repo.add(
+            Rule(
+                endpoint_selector=sel("foo"),
+                egress=[EgressRule(to_requires=[sel("zone=pci")])],
+            )
+        )
+        ok = SearchContext(
+            from_labels=LabelArray.parse_select("foo"),
+            to_labels=LabelArray.parse_select("bar", "zone=pci"),
+        )
+        assert repo.allows_egress(ok) == Decision.ALLOWED
+        bad = SearchContext(
+            from_labels=LabelArray.parse_select("foo"),
+            to_labels=LabelArray.parse_select("bar"),
+        )
+        assert repo.allows_egress(bad) == Decision.DENIED
+
+
+# ---------------------------------------------------------------------------
+# L4 resolution & merge (reference: l4Filter_test.go case table)
+
+
+def http_port_rule(port="80", path="/"):
+    return PortRule(
+        ports=[PortProtocol(port, "TCP")],
+        rules=L7Rules(http=[PortRuleHTTP(method="GET", path=path)]),
+    )
+
+
+def plain_port_rule(port="80", proto="TCP"):
+    return PortRule(ports=[PortProtocol(port, proto)])
+
+
+class TestL4Resolution:
+    def test_case1_allow_all_l3_l4_merge(self):
+        # Two identical wildcard-L3 rules on 80/TCP merge to one filter.
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[plain_port_rule()],
+                    ),
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[plain_port_rule()],
+                    ),
+                ],
+            )
+        )
+        l4 = repo.resolve_l4_ingress_policy(ctx_to("a"))
+        assert set(l4) == {"80/TCP"}
+        f = l4["80/TCP"]
+        assert f.allows_all_at_l3()
+        assert f.l7_parser == ""
+        assert not f.is_redirect()
+
+    def test_case2_l7_shadowed_by_allow_all(self):
+        # Rule 1 wildcard L7, rule 2 restricted L7 on same port: merged filter
+        # keeps HTTP parser; the wildcard selector's rules include both.
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[plain_port_rule()],
+                    ),
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[http_port_rule()],
+                    ),
+                ],
+            )
+        )
+        l4 = repo.resolve_l4_ingress_policy(ctx_to("a"))
+        f = l4["80/TCP"]
+        assert f.l7_parser == PARSER_TYPE_HTTP
+        assert f.is_redirect()
+        # wildcardL3L4Rules wildcards L7 for L3/L4-only allows on this port:
+        wild_rules = f.l7_rules_per_ep[WILDCARD_SELECTOR]
+        assert any(h.path == "" and h.method == "" for h in wild_rules.http)
+
+    def test_case3_duplicate_http_rules_dedup(self):
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[http_port_rule()],
+                    ),
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[http_port_rule()],
+                    ),
+                ],
+            )
+        )
+        l4 = repo.resolve_l4_ingress_policy(ctx_to("a"))
+        f = l4["80/TCP"]
+        assert len(f.l7_rules_per_ep[WILDCARD_SELECTOR].http) == 1
+
+    def test_case5_conflicting_parsers(self):
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[
+                            PortRule(
+                                ports=[PortProtocol("80", "TCP")],
+                                rules=L7Rules(
+                                    l7proto="testing", l7=[PortRuleL7({"cmd": "X"})]
+                                ),
+                            )
+                        ],
+                    ),
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[http_port_rule()],
+                    ),
+                ],
+            )
+        )
+        with pytest.raises(PolicyMergeError, match="parsers"):
+            repo.resolve_l4_ingress_policy(ctx_to("a"))
+
+    def test_case6_superset_collapses_to_wildcard(self):
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[sel("id=a")],
+                        to_ports=[plain_port_rule()],
+                    ),
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[plain_port_rule()],
+                    ),
+                ],
+            )
+        )
+        l4 = repo.resolve_l4_ingress_policy(ctx_to("a"))
+        f = l4["80/TCP"]
+        assert f.endpoints == [WILDCARD_SELECTOR]
+
+    def test_case10_distinct_l3_same_l7(self):
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[sel("id=a")], to_ports=[http_port_rule()]
+                    ),
+                    IngressRule(
+                        from_endpoints=[sel("id=c")], to_ports=[http_port_rule()]
+                    ),
+                ],
+            )
+        )
+        l4 = repo.resolve_l4_ingress_policy(ctx_to("a"))
+        f = l4["80/TCP"]
+        assert len(f.l7_rules_per_ep) == 2
+        assert not f.allows_all_at_l3()
+        assert len(f.endpoints) == 2
+
+    def test_proto_any_expands(self):
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[WILDCARD_SELECTOR],
+                        to_ports=[plain_port_rule("53", "ANY")],
+                    )
+                ],
+            )
+        )
+        l4 = repo.resolve_l4_ingress_policy(ctx_to("a"))
+        assert set(l4) == {"53/TCP", "53/UDP"}
+
+    def test_l3_only_rule_wildcards_l7(self):
+        # reference: repository_test.go TestWildcardL3RulesIngress — an
+        # L3-only allow for id=a wildcards the L7 rules of the redirect.
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[IngressRule(from_endpoints=[sel("id=a")])],
+            )
+        )
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[sel("id=b")], to_ports=[http_port_rule()]
+                    )
+                ],
+            )
+        )
+        l4 = repo.resolve_l4_ingress_policy(ctx_to("a"))
+        f = l4["80/TCP"]
+        a_rules = f.l7_rules_per_ep.get(sel("id=a"))
+        assert a_rules is not None
+        assert any(h.path == "" for h in a_rules.http)
+
+    def test_egress_resolution(self):
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("foo"),
+                egress=[
+                    EgressRule(
+                        to_endpoints=[sel("db")],
+                        to_ports=[plain_port_rule("5432")],
+                    )
+                ],
+            )
+        )
+        ctx = SearchContext(from_labels=LabelArray.parse_select("foo"))
+        l4 = repo.resolve_l4_egress_policy(ctx)
+        assert set(l4) == {"5432/TCP"}
+        assert not l4["5432/TCP"].ingress
+
+    def test_from_requires_folded_into_l4(self):
+        # reference: repository_test.go TestL3DependentL4IngressFromRequires
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[sel("id=b")],
+                        to_ports=[plain_port_rule()],
+                    ),
+                    IngressRule(from_requires=[sel("zone=z")]),
+                ],
+            )
+        )
+        l4 = repo.resolve_l4_ingress_policy(ctx_to("a"))
+        f = l4["80/TCP"]
+        assert len(f.endpoints) == 1
+        ep = f.endpoints[0]
+        # selector must now require both id=b and zone=z
+        assert ep.matches(LabelArray.parse_select("id=b", "zone=z"))
+        assert not ep.matches(LabelArray.parse_select("id=b"))
+
+
+# ---------------------------------------------------------------------------
+# CIDR policy resolution
+
+
+class TestCIDRResolution:
+    def test_resolve_cidr_policy(self):
+        repo = Repository()
+        repo.add(
+            Rule(
+                endpoint_selector=sel("a"),
+                ingress=[IngressRule(from_cidr=["10.0.0.0/8"])],
+                egress=[
+                    EgressRule(
+                        to_cidr_set=[CIDRRule("192.168.0.0/16", ("192.168.1.0/24",))]
+                    )
+                ],
+            )
+        )
+        cp = repo.resolve_cidr_policy(ctx_to("a"))
+        assert "10.0.0.0/8" in cp.ingress.map
+        assert cp.ingress.ipv4_prefix_count[8] == 1
+        # exception carved out of egress set
+        assert "192.168.1.0/24" not in cp.egress.map
+        assert len(cp.egress.map) > 0
+        s6, s4 = cp.to_lpm_data()
+        assert s4 == sorted(s4, reverse=True)
+        assert 0 in s4 and 32 in s4
+
+    def test_ingress_cidr_l4_skipped(self):
+        # CIDR+L4 ingress is handled via L4 resolution, not CIDR policy.
+        repo = Repository()
+        r = Rule(
+            endpoint_selector=sel("a"),
+            egress=[
+                EgressRule(
+                    to_cidr=["10.0.0.0/8"],
+                    to_ports=[plain_port_rule()],
+                )
+            ],
+        )
+        repo.add(r)
+        cp = repo.resolve_cidr_policy(ctx_to("a"))
+        # egress CIDR+L4 still counted for prefix lengths
+        assert "10.0.0.0/8" in cp.egress.map
+
+
+# ---------------------------------------------------------------------------
+# proxy ID
+
+
+class TestProxyID:
+    def test_round_trip(self):
+        pid = proxy_id(42, True, "TCP", 80)
+        assert parse_proxy_id(pid) == (42, True, "TCP", 80)
+        pid = proxy_id(7, False, "UDP", 53)
+        assert parse_proxy_id(pid) == (7, False, "UDP", 53)
+        with pytest.raises(ValueError):
+            parse_proxy_id("bogus")
+
+
+# ---------------------------------------------------------------------------
+# JSON serialization round trip (reference policy document schema)
+
+
+SAMPLE_POLICY = """
+[{
+  "endpointSelector": {"matchLabels": {"role": "backend"}},
+  "labels": ["k8s:io.cilium.k8s.policy.name=rule1"],
+  "ingress": [{
+    "fromEndpoints": [{"matchLabels": {"role": "frontend"}}],
+    "toPorts": [{
+      "ports": [{"port": "80", "protocol": "TCP"}],
+      "rules": {"http": [{"method": "GET", "path": "/public/.*"}]}
+    }]
+  }],
+  "egress": [{
+    "toCIDRSet": [{"cidr": "10.0.0.0/8", "except": ["10.96.0.0/12"]}]
+  }]
+}]
+"""
+
+
+class TestSerialization:
+    def test_round_trip(self):
+        rules = rules_from_json(SAMPLE_POLICY)
+        assert len(rules) == 1
+        r = rules[0]
+        r.sanitize()
+        assert r.endpoint_selector.matches(LabelArray.parse("k8s:role=backend"))
+        assert r.ingress[0].to_ports[0].rules.http[0].path == "/public/.*"
+        assert r.egress[0].to_cidr_set[0].except_cidrs == ("10.96.0.0/12",)
+        # round trip preserves resolution behavior
+        text = rules_to_json(rules)
+        rules2 = rules_from_json(text)
+        repo = Repository()
+        repo.add(rules2[0])
+        ctx = SearchContext(
+            from_labels=LabelArray.parse_select("role=frontend"),
+            to_labels=LabelArray.parse_select("role=backend"),
+            dports=[DPort(80, "TCP")],
+        )
+        l4 = repo.resolve_l4_ingress_policy(ctx)
+        assert "80/TCP" in l4
+        assert l4["80/TCP"].l7_parser == PARSER_TYPE_HTTP
