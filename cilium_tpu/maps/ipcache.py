@@ -1,0 +1,68 @@
+"""IP -> identity cache map with LPM semantics.
+
+reference: pkg/maps/ipcache (BPF ipcache LPM/hash map) + bpf/lib/eps.h
+(lookup_ip4_remote_endpoint).  Host-authoritative prefix -> identity table;
+``to_device`` exports a DeviceLpm so identity derivation for F source
+addresses is one batched longest-prefix sweep (the bpf_netdev.c ingress
+identity path, reference: bpf/bpf_netdev.c identity from ipcache).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+
+from ..ops.lpm import DeviceLpm, build_lpm
+
+
+@dataclass
+class RemoteEndpointInfo:
+    """reference: bpf/lib/common.h:175 remote_endpoint_info."""
+
+    sec_label: int
+    tunnel_endpoint: int = 0
+
+
+class IpcacheMap:
+    """Host IP->identity map (reference: pkg/maps/ipcache/ipcache.go)."""
+
+    def __init__(self) -> None:
+        # key -> (parsed network, info); networks parsed once on upsert.
+        self.v4: dict[str, tuple] = {}
+        self.v6: dict[str, tuple] = {}
+
+    def upsert(self, prefix: str, sec_label: int, tunnel_endpoint: int = 0) -> None:
+        net = ipaddress.ip_network(prefix, strict=False)
+        key = str(net)
+        info = RemoteEndpointInfo(sec_label, tunnel_endpoint)
+        (self.v4 if net.version == 4 else self.v6)[key] = (net, info)
+
+    def delete(self, prefix: str) -> bool:
+        net = ipaddress.ip_network(prefix, strict=False)
+        key = str(net)
+        table = self.v4 if net.version == 4 else self.v6
+        return table.pop(key, None) is not None
+
+    def lookup(self, ip: str) -> RemoteEndpointInfo | None:
+        """Host-side LPM lookup."""
+        addr = ipaddress.ip_address(ip)
+        table = self.v4 if addr.version == 4 else self.v6
+        best = None
+        best_len = -1
+        for net, info in table.values():
+            if addr in net and net.prefixlen > best_len:
+                best, best_len = info, net.prefixlen
+        return best
+
+    def dump(self):
+        return sorted((k, v[1]) for k, v in self.v4.items()) + sorted(
+            (k, v[1]) for k, v in self.v6.items()
+        )
+
+    def to_device(self, v6: bool = False, pad_to: int | None = None) -> DeviceLpm:
+        table = self.v6 if v6 else self.v4
+        return build_lpm(
+            [(prefix, info.sec_label) for prefix, (_, info) in table.items()],
+            v6=v6,
+            pad_to=pad_to,
+        )

@@ -1,0 +1,221 @@
+"""Load-balancer service/backend maps and batched backend selection.
+
+reference: bpf/lib/lb.h (lb4_lookup_service :604, lb4_lookup_slave :637,
+lb4_select_slave :158 — hash-based slave pick) and pkg/maps/lbmap (service
++ RevNAT bookkeeping).  Services are keyed {vip, dport, slave}; slave 0 is
+the master entry holding the backend count; slaves 1..count are backends.
+Backend selection for F flows is one device pass: hash the flow 5-tuple,
+``slave = hash % count + 1``, gather the backend.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Packed layouts (reference: bpf/lib/common.h:427-445).
+_LB4_KEY_FMT = "<IHH"  # address, dport, slave
+_LB4_SERVICE_FMT = "<IHHHH"  # target, port, count, rev_nat_index, weight
+LB4_KEY_SIZE = struct.calcsize(_LB4_KEY_FMT)  # 8
+LB4_SERVICE_SIZE = struct.calcsize(_LB4_SERVICE_FMT)  # 12
+
+
+@dataclass(frozen=True)
+class LbKey:
+    address: int
+    dport: int = 0
+    slave: int = 0
+
+    def pack(self) -> bytes:
+        return struct.pack(_LB4_KEY_FMT, self.address, self.dport, self.slave)
+
+
+@dataclass
+class LbBackend:
+    """lb4_service value (reference: common.h:433)."""
+
+    target: int = 0  # backend IPv4 (or 0 in the master entry)
+    port: int = 0
+    count: int = 0  # only meaningful in the master entry
+    rev_nat_index: int = 0
+    weight: int = 0
+
+    def pack(self) -> bytes:
+        return struct.pack(
+            _LB4_SERVICE_FMT, self.target, self.port, self.count,
+            self.rev_nat_index, self.weight,
+        )
+
+
+class LbMap:
+    """Host service table (reference: pkg/maps/lbmap)."""
+
+    def __init__(self) -> None:
+        self.services: dict[LbKey, LbBackend] = {}
+        self.revnat: dict[int, tuple[int, int]] = {}  # index -> (vip, port)
+
+    def upsert_service(
+        self, vip: int, dport: int, backends: list[tuple[int, int]],
+        rev_nat_index: int = 0,
+    ) -> None:
+        """Install a service with its backends; master entry at slave 0,
+        backends at slaves 1..n (reference: lbmap service layout)."""
+        # Remove old slaves beyond the new count.
+        old = self.services.get(LbKey(vip, dport, 0))
+        if old is not None:
+            for s in range(len(backends) + 1, old.count + 1):
+                self.services.pop(LbKey(vip, dport, s), None)
+        self.services[LbKey(vip, dport, 0)] = LbBackend(
+            count=len(backends), rev_nat_index=rev_nat_index
+        )
+        for i, (target, port) in enumerate(backends, start=1):
+            self.services[LbKey(vip, dport, i)] = LbBackend(
+                target=target, port=port, rev_nat_index=rev_nat_index
+            )
+        if rev_nat_index:
+            self.revnat[rev_nat_index] = (vip, dport)
+
+    def delete_service(self, vip: int, dport: int) -> bool:
+        master = self.services.pop(LbKey(vip, dport, 0), None)
+        if master is None:
+            return False
+        for s in range(1, master.count + 1):
+            self.services.pop(LbKey(vip, dport, s), None)
+        return True
+
+    def lookup_service(self, vip: int, dport: int) -> LbBackend | None:
+        """L4 first, then L3 wildcard-port (reference: lb.h:604-630)."""
+        if dport:
+            svc = self.services.get(LbKey(vip, dport, 0))
+            if svc is not None and svc.count:
+                return svc
+        svc = self.services.get(LbKey(vip, 0, 0))
+        if svc is not None and svc.count:
+            return svc
+        return None
+
+    def select_backend(self, vip: int, dport: int, flow_hash: int):
+        """Host-side backend pick (reference: lb.h lb4_select_slave +
+        lb4_lookup_slave): slave = hash % count + 1.  The hash is treated
+        as a uint32 bit pattern so host and device picks agree."""
+        key_port = dport
+        svc = self.services.get(LbKey(vip, dport, 0)) if dport else None
+        if svc is None or not svc.count:
+            key_port = 0
+            svc = self.services.get(LbKey(vip, 0, 0))
+        if svc is None or not svc.count:
+            return None
+        slave = ((flow_hash & 0xFFFFFFFF) % svc.count) + 1
+        return self.services.get(LbKey(vip, key_port, slave))
+
+    def dump(self):
+        return sorted(
+            self.services.items(),
+            key=lambda kv: (kv[0].address, kv[0].dport, kv[0].slave),
+        )
+
+    def to_device(self, max_backends: int = 16) -> "DeviceLbMap":
+        """Export as dense [S, max_backends] backend arrays per service."""
+        masters = [
+            (k, v) for k, v in self.services.items() if k.slave == 0 and v.count
+        ]
+        s = max(len(masters), 1)
+        vips = np.zeros((s,), np.int64)
+        ports = np.zeros((s,), np.int64)
+        counts = np.zeros((s,), np.int32)
+        revnat = np.zeros((s,), np.int32)
+        b_target = np.zeros((s, max_backends), np.int64)
+        b_port = np.zeros((s, max_backends), np.int32)
+        valid = np.zeros((s,), bool)
+        for i, (k, master) in enumerate(masters):
+            vips[i] = k.address
+            ports[i] = k.dport
+            counts[i] = min(master.count, max_backends)
+            revnat[i] = master.rev_nat_index
+            valid[i] = True
+            for b in range(counts[i]):
+                be = self.services.get(LbKey(k.address, k.dport, b + 1))
+                if be is not None:
+                    b_target[i, b] = be.target
+                    b_port[i, b] = be.port
+        return DeviceLbMap(
+            vips=jnp.asarray(vips.astype(np.uint32).view(np.int32)),
+            ports=jnp.asarray(ports.astype(np.int32)),
+            counts=jnp.asarray(counts),
+            revnat=jnp.asarray(revnat),
+            b_target=jnp.asarray(b_target.astype(np.uint32).view(np.int32)),
+            b_port=jnp.asarray(b_port),
+            valid=jnp.asarray(valid),
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DeviceLbMap:
+    vips: jax.Array  # [S] int32
+    ports: jax.Array  # [S] int32
+    counts: jax.Array  # [S] int32
+    revnat: jax.Array  # [S] int32
+    b_target: jax.Array  # [S, B] int32
+    b_port: jax.Array  # [S, B] int32
+    valid: jax.Array  # [S] bool
+
+    def tree_flatten(self):
+        return (
+            (self.vips, self.ports, self.counts, self.revnat,
+             self.b_target, self.b_port, self.valid),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+def lb4_select_backend_batch(dlb: DeviceLbMap, vips, dports, flow_hashes):
+    """Batched service lookup + backend selection.
+
+    Args: [F] int32 arrays (vips as uint32 bit patterns).
+    Returns (found [F] bool, target [F] int32, port [F] int32,
+    rev_nat_index [F] int32).
+    reference: bpf/lib/lb.h:604 (L4 then wildcard-port), :158 (hash pick).
+    """
+    vips = jnp.asarray(vips, jnp.int32)
+    dports = jnp.asarray(dports, jnp.int32)
+    flow_hashes = jnp.asarray(flow_hashes, jnp.int32)
+
+    def service_match(port_query):
+        m = (
+            dlb.valid[None, :]
+            & (dlb.vips[None, :] == vips[:, None])
+            & (dlb.ports[None, :] == port_query[:, None])
+        )  # [F, S]
+        found = jnp.any(m, axis=1)
+        idx = jnp.argmax(m, axis=1)
+        return found, idx
+
+    f_l4, i_l4 = service_match(dports)
+    f_l3, i_l3 = service_match(jnp.zeros_like(dports))
+    found = f_l4 | f_l3
+    idx = jnp.where(f_l4, i_l4, i_l3)
+
+    count = jnp.maximum(dlb.counts[idx], 1)
+    # Hash is a uint32 bit pattern (negative int32 views reinterpreted),
+    # matching the host path's `hash & 0xFFFFFFFF`.
+    slave = (
+        flow_hashes.astype(jnp.uint32) % count.astype(jnp.uint32)
+    ).astype(jnp.int32)  # 0-based into backend arrays
+    target = dlb.b_target[idx, slave]
+    port = dlb.b_port[idx, slave]
+    rev = dlb.revnat[idx]
+    zero = jnp.zeros_like(target)
+    return (
+        found,
+        jnp.where(found, target, zero),
+        jnp.where(found, port, zero),
+        jnp.where(found, rev, zero),
+    )

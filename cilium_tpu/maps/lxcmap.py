@@ -1,0 +1,62 @@
+"""Local endpoint map (reference: pkg/maps/lxcmap + bpf/lib/common.h:164
+endpoint_info): endpoint IP/ID -> interface + MAC info for local delivery."""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+# ifindex, unused, lxc_id, flags, 4 alignment-pad bytes (mac_t is __u64),
+# mac, node_mac, pad[4] (reference: common.h:164-173, mac_t at :59).
+_ENDPOINT_INFO_FMT = "<IHHI4xQQ16x"
+ENDPOINT_INFO_SIZE = struct.calcsize(_ENDPOINT_INFO_FMT)  # 48
+
+ENDPOINT_F_HOST = 1
+
+
+@dataclass
+class EndpointInfo:
+    ifindex: int = 0
+    lxc_id: int = 0
+    flags: int = 0
+    mac: int = 0
+    node_mac: int = 0
+
+    def pack(self) -> bytes:
+        return struct.pack(
+            _ENDPOINT_INFO_FMT, self.ifindex, 0, self.lxc_id, self.flags,
+            self.mac, self.node_mac,
+        )
+
+    @property
+    def is_host(self) -> bool:
+        return bool(self.flags & ENDPOINT_F_HOST)
+
+
+class LxcMap:
+    """Host map of local endpoints keyed by IP string or endpoint ID."""
+
+    def __init__(self) -> None:
+        self.by_ip: dict[str, EndpointInfo] = {}
+        self.by_id: dict[int, EndpointInfo] = {}
+
+    def upsert(self, ip: str, ep_id: int, info: EndpointInfo) -> None:
+        info.lxc_id = ep_id
+        self.by_ip[ip] = info
+        self.by_id[ep_id] = info
+
+    def delete_ip(self, ip: str) -> bool:
+        info = self.by_ip.pop(ip, None)
+        if info is not None:
+            self.by_id.pop(info.lxc_id, None)
+            return True
+        return False
+
+    def lookup_ip(self, ip: str) -> EndpointInfo | None:
+        return self.by_ip.get(ip)
+
+    def lookup_id(self, ep_id: int) -> EndpointInfo | None:
+        return self.by_id.get(ep_id)
+
+    def dump(self):
+        return sorted(self.by_ip.items())

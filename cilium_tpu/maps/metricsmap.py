@@ -1,0 +1,47 @@
+"""Datapath metrics map: per-(reason, direction) packet/byte counters.
+
+reference: bpf/lib/metrics.h (update_metrics) + pkg/maps/metricsmap
+(metrics_key {reason, dir}, metrics_value {count, bytes}); reason 0 is
+"forwarded", >0 are drop reasons.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+REASON_FORWARDED = 0
+
+DIR_INGRESS = 1
+DIR_EGRESS = 2
+
+_DIR_NAMES = {DIR_INGRESS: "INGRESS", DIR_EGRESS: "EGRESS"}
+
+
+@dataclass
+class MetricsValue:
+    count: int = 0
+    bytes: int = 0
+
+
+class MetricsMap:
+    """Host metrics counters (reference: pkg/maps/metricsmap)."""
+
+    def __init__(self) -> None:
+        self.values: dict[tuple[int, int], MetricsValue] = {}
+
+    def update(self, reason: int, direction: int, count: int = 1,
+               nbytes: int = 0) -> None:
+        v = self.values.setdefault((reason, direction), MetricsValue())
+        v.count += count
+        v.bytes += nbytes
+
+    def get(self, reason: int, direction: int) -> MetricsValue:
+        return self.values.get((reason, direction), MetricsValue())
+
+    def dump(self):
+        return sorted(
+            (
+                (_DIR_NAMES.get(d, str(d)), reason, v.count, v.bytes)
+                for (reason, d), v in self.values.items()
+            )
+        )

@@ -1,0 +1,83 @@
+"""Batched exact-match lookup into packed key tables.
+
+The TPU replacement for per-packet BPF hash-map lookups (reference:
+bpf/lib/policy.h:47 map_lookup_elem on POLICY_MAP): instead of one hash
+probe per packet, F flows look up N table entries in one data-parallel
+broadcast compare.  For the rule-table sizes policy maps reach (hundreds to
+a few thousand entries) an [F, N] compare is a single fused VPU pass and
+beats hash emulation on TPU, which has no efficient scatter/probe loop.
+
+Keys are column arrays (struct-of-arrays) so each field compare vectorizes;
+the table is padded to a fixed shape for jit stability.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DeviceTable:
+    """Packed column-oriented lookup table resident on device.
+
+    cols: tuple of [N] int32 arrays, one per key field.
+    values: [N, V] int32 value columns.
+    valid: [N] bool — padding rows are invalid.
+    """
+
+    cols: tuple
+    values: jax.Array
+    valid: jax.Array
+
+    def tree_flatten(self):
+        return ((self.cols, self.values, self.valid), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+def pack_table(
+    keys: np.ndarray, values: np.ndarray, pad_to: int | None = None
+) -> DeviceTable:
+    """Build a DeviceTable from [N, K] int key rows and [N, V] int values."""
+    keys = np.asarray(keys, dtype=np.int32)
+    values = np.asarray(values, dtype=np.int32)
+    if keys.ndim != 2:
+        raise ValueError("keys must be [N, K]")
+    n, k = keys.shape
+    size = pad_to if pad_to is not None else max(n, 1)
+    if size < n:
+        raise ValueError(f"pad_to {size} < table size {n}")
+    pk = np.zeros((size, k), dtype=np.int32)
+    pv = np.zeros((size, values.shape[1] if values.ndim == 2 else 1), np.int32)
+    valid = np.zeros((size,), dtype=bool)
+    pk[:n] = keys
+    pv[:n] = values.reshape(n, -1)
+    valid[:n] = True
+    return DeviceTable(
+        cols=tuple(jnp.asarray(pk[:, i]) for i in range(k)),
+        values=jnp.asarray(pv),
+        valid=jnp.asarray(valid),
+    )
+
+
+def exact_lookup(table: DeviceTable, *query_cols) -> tuple[jax.Array, jax.Array]:
+    """Look up F queries (one [F] int32 array per key field).
+
+    Returns (found [F] bool, values [F, V] int32; zeros when not found).
+    First matching row wins (tables are deduplicated on build).
+    """
+    f = query_cols[0].shape[0]
+    matched = table.valid[None, :]  # [F, N]
+    for col, q in zip(table.cols, query_cols):
+        matched = matched & (col[None, :] == q[:, None])
+    found = jnp.any(matched, axis=1)
+    idx = jnp.argmax(matched, axis=1)
+    vals = jnp.where(found[:, None], table.values[idx], 0)
+    return found, vals

@@ -192,9 +192,11 @@ class Repository:
         return [r for r in self.rules if r.labels.contains(lbls)]
 
     def contains_all(self, needed: list[LabelArray]) -> bool:
-        """reference: repository.go:510."""
+        """Every needed label set must contain some rule's (non-empty)
+        labels (reference: repository.go:510 ContainsAllRLocked)."""
         return all(
-            any(r.labels.contains(n) for r in self.rules) for n in needed
+            any(r.labels and n.contains(r.labels) for r in self.rules)
+            for n in needed
         )
 
     def get_rules_matching(self, lbls: LabelArray) -> tuple[bool, bool]:

@@ -1,0 +1,343 @@
+"""Datapath map tests: policy-map cascade, LPM, conntrack, LB selection.
+
+Oracle strategy mirrors the reference's test approach (reference:
+pkg/maps/* unit tests + bpf unit-test.c LPM assertions): host reference
+implementations are the oracle; batched device ops must agree exactly.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.alignchecker import check_struct_alignments
+from cilium_tpu.maps import (
+    CtKey4,
+    CtMap,
+    DIR_EGRESS,
+    DIR_INGRESS,
+    IpcacheMap,
+    LbMap,
+    MetricsMap,
+    PolicyEntry,
+    PolicyKey,
+    PolicyMap,
+    ProxyMap,
+    lb4_select_backend_batch,
+    policy_can_access_batch,
+)
+from cilium_tpu.maps.ctmap import PROTO_TCP, TCP_FIN, TCP_SYN
+from cilium_tpu.maps.proxymap import ProxyKey4
+from cilium_tpu.ops.lpm import (
+    build_lpm,
+    ipv4_to_words,
+    ipv6_to_words,
+    lpm_lookup,
+    prefilter_check_batch,
+)
+from cilium_tpu.ops.maplookup import exact_lookup, pack_table
+
+
+def test_struct_alignments():
+    check_struct_alignments()
+
+
+class TestPolicyMapHost:
+    def test_pack_abi_sizes(self):
+        assert len(PolicyKey(1, 80, 6, DIR_INGRESS).pack()) == 8
+        assert len(PolicyEntry(8080).pack()) == 24
+
+    def test_pack_round_trip(self):
+        k = PolicyKey(1000, 8080, 6, DIR_EGRESS)
+        assert PolicyKey.unpack(k.pack()) == k
+        e = PolicyEntry(9090, 7, 1234)
+        e2 = PolicyEntry.unpack(e.pack())
+        assert (e2.proxy_port, e2.packets, e2.bytes) == (9090, 7, 1234)
+
+    def test_lookup_cascade(self):
+        pm = PolicyMap()
+        pm.allow(100, 80, 6, DIR_INGRESS, proxy_port=9000)  # L4 + redirect
+        pm.allow(200, direction=DIR_INGRESS)  # L3-only
+        pm.allow(0, 53, 17, DIR_INGRESS)  # wildcard-identity L4
+        # exact L4 hit with proxy port
+        assert pm.lookup(100, 80, 6) == (True, 9000)
+        # L3-only fallback allows any port, no redirect
+        assert pm.lookup(200, 443, 6) == (True, 0)
+        # wildcard identity
+        assert pm.lookup(999, 53, 17) == (True, 0)
+        # miss -> deny
+        assert pm.lookup(999, 80, 6) == (False, 0)
+        # egress keys don't answer ingress
+        pm2 = PolicyMap()
+        pm2.allow(5, 80, 6, DIR_EGRESS)
+        assert pm2.lookup(5, 80, 6, DIR_INGRESS) == (False, 0)
+        assert pm2.lookup(5, 80, 6, DIR_EGRESS) == (True, 0)
+
+    def test_delete_and_dump_order(self):
+        pm = PolicyMap()
+        pm.allow(30, direction=DIR_EGRESS)
+        pm.allow(20, direction=DIR_INGRESS)
+        pm.allow(10, direction=DIR_INGRESS)
+        dump = pm.dump()
+        assert [(k.direction, k.identity) for k, _ in dump] == [
+            (DIR_INGRESS, 10), (DIR_INGRESS, 20), (DIR_EGRESS, 30)
+        ]
+        assert pm.delete(20, direction=DIR_INGRESS)
+        assert not pm.delete(20, direction=DIR_INGRESS)
+
+
+class TestPolicyMapDevice:
+    def test_batch_matches_host_oracle(self):
+        rng = np.random.RandomState(3)
+        pm = PolicyMap()
+        # random table
+        for _ in range(50):
+            ident = int(rng.randint(0, 20))
+            dport = int(rng.choice([0, 80, 443, 53]))
+            proto = 0 if dport == 0 else int(rng.choice([6, 17]))
+            pm.allow(ident, dport, proto, DIR_INGRESS,
+                     proxy_port=int(rng.choice([0, 9000])))
+        dmap = pm.to_device()
+        f = 256
+        idents = rng.randint(0, 25, f).astype(np.int32)
+        dports = rng.choice([80, 443, 53, 22], f).astype(np.int32)
+        protos = rng.choice([6, 17], f).astype(np.int32)
+        allowed, proxy = policy_can_access_batch(dmap, idents, dports, protos)
+        allowed = np.asarray(allowed)
+        proxy = np.asarray(proxy)
+        for i in range(f):
+            want_allowed, want_proxy = pm.lookup(
+                int(idents[i]), int(dports[i]), int(protos[i])
+            )
+            assert allowed[i] == want_allowed, i
+            if want_allowed:
+                assert proxy[i] == want_proxy, i
+
+    def test_l3_only_never_redirects(self):
+        pm = PolicyMap()
+        pm.allow(7, direction=DIR_INGRESS)
+        pm.allow(7, 80, 6, DIR_INGRESS, proxy_port=9999)
+        dmap = pm.to_device()
+        allowed, proxy = policy_can_access_batch(
+            dmap,
+            np.array([7, 7], np.int32),
+            np.array([80, 443], np.int32),
+            np.array([6, 6], np.int32),
+        )
+        assert np.asarray(allowed).tolist() == [True, True]
+        # port 80 redirects; port 443 falls back to L3-only with no redirect
+        assert np.asarray(proxy).tolist() == [9999, 0]
+
+
+class TestExactLookup:
+    def test_basic(self):
+        t = pack_table(
+            np.array([[1, 2], [3, 4]]), np.array([[10], [20]]), pad_to=8
+        )
+        found, vals = exact_lookup(
+            t, np.array([1, 3, 5], np.int32), np.array([2, 4, 6], np.int32)
+        )
+        assert np.asarray(found).tolist() == [True, True, False]
+        assert np.asarray(vals)[:, 0].tolist() == [10, 20, 0]
+
+    def test_padding_rows_never_match(self):
+        t = pack_table(np.array([[0]]), np.array([[5]]), pad_to=4)
+        found, vals = exact_lookup(t, np.array([0, 0], np.int32))
+        assert np.asarray(found).tolist() == [True, True]
+        assert np.asarray(vals)[:, 0].tolist() == [5, 5]
+
+
+class TestLpm:
+    def test_v4_longest_prefix_wins(self):
+        lpm = build_lpm(
+            [("10.0.0.0/8", 1), ("10.1.0.0/16", 2), ("10.1.2.0/24", 3),
+             ("0.0.0.0/0", 9)]
+        )
+        found, value, plen = lpm_lookup(
+            lpm, *ipv4_to_words(["10.1.2.3", "10.1.9.9", "10.9.9.9", "8.8.8.8"])
+        )
+        assert np.asarray(found).all()
+        assert np.asarray(value).tolist() == [3, 2, 1, 9]
+        assert np.asarray(plen).tolist() == [24, 16, 8, 0]
+
+    def test_v4_miss(self):
+        lpm = build_lpm([("192.168.0.0/16", 1)])
+        found, value, plen = lpm_lookup(lpm, *ipv4_to_words(["10.0.0.1"]))
+        assert not np.asarray(found)[0]
+        assert np.asarray(plen)[0] == -1
+
+    def test_v6(self):
+        lpm = build_lpm(
+            [("f00d::/16", 1), ("f00d:abcd::/32", 2), ("::/0", 7)], v6=True
+        )
+        found, value, plen = lpm_lookup(
+            lpm, *ipv6_to_words(["f00d:abcd::1", "f00d:1::1", "2001::1"])
+        )
+        assert np.asarray(found).all()
+        assert np.asarray(value).tolist() == [2, 1, 7]
+
+    def test_host_bits_normalized(self):
+        lpm = build_lpm([("10.1.2.3/8", 1)])  # host bits set in input
+        found, value, _ = lpm_lookup(lpm, *ipv4_to_words(["10.200.0.1"]))
+        assert np.asarray(found)[0] and np.asarray(value)[0] == 1
+
+    def test_prefilter_verdict(self):
+        # XDP prefilter: hit = drop (reference: bpf_xdp.c check_v4)
+        lpm = build_lpm([("203.0.113.0/24", 1)])
+        drop = prefilter_check_batch(
+            lpm, *ipv4_to_words(["203.0.113.50", "198.51.100.1"])
+        )
+        assert np.asarray(drop).tolist() == [True, False]
+
+    def test_against_python_oracle(self):
+        import ipaddress
+
+        rng = np.random.RandomState(7)
+        prefixes = []
+        for i in range(40):
+            addr = ipaddress.IPv4Address(int(rng.randint(0, 2**31)))
+            plen = int(rng.randint(1, 33))
+            net = ipaddress.ip_network(f"{addr}/{plen}", strict=False)
+            prefixes.append((str(net), i + 1))
+        lpm = build_lpm(prefixes)
+        queries = [str(ipaddress.IPv4Address(int(rng.randint(0, 2**31))))
+                   for _ in range(128)]
+        # every prefix's own network address must hit itself or a longer one
+        queries += [p.split("/")[0] for p, _ in prefixes]
+        found, value, plen = lpm_lookup(lpm, *ipv4_to_words(queries))
+        found, value, plen = map(np.asarray, (found, value, plen))
+        nets = [(ipaddress.ip_network(p), v) for p, v in prefixes]
+        for i, q in enumerate(queries):
+            addr = ipaddress.ip_address(q)
+            best_len, best_val = -1, 0
+            for net, v in nets:
+                if addr in net and net.prefixlen > best_len:
+                    best_len, best_val = net.prefixlen, v
+            assert found[i] == (best_len >= 0), q
+            if best_len >= 0:
+                assert plen[i] == best_len, q
+                # value must correspond to SOME prefix of the winning length
+                # containing q (ties between equal-length dups allowed)
+                winners = {
+                    v for net, v in nets
+                    if net.prefixlen == best_len and addr in net
+                }
+                assert value[i] in winners, q
+
+
+class TestCtMap:
+    def test_create_lookup_expiry(self):
+        t = [0.0]
+        ct = CtMap(clock=lambda: t[0])
+        key = CtKey4(0x0A000001, 0x0A000002, 80, 5555, PROTO_TCP)
+        ct.create(key, src_sec_id=42)
+        e = ct.lookup(key, tcp_flags=TCP_SYN)
+        assert e is not None and e.src_sec_id == 42
+        assert not e.seen_non_syn
+        e = ct.lookup(key, tcp_flags=0x10)
+        assert e.seen_non_syn
+        # expiry
+        t[0] = 30000
+        assert ct.lookup(key) is None
+
+    def test_fin_shortens_lifetime(self):
+        t = [0.0]
+        ct = CtMap(clock=lambda: t[0])
+        key = CtKey4(1, 2, 80, 1000, PROTO_TCP)
+        ct.create(key)
+        e = ct.lookup(key, tcp_flags=TCP_FIN)
+        assert e.tx_closing
+        assert e.lifetime == 10  # TCP_CLOSING_LIFETIME
+        t[0] = 11
+        assert ct.lookup(key) is None
+
+    def test_gc(self):
+        t = [0.0]
+        ct = CtMap(clock=lambda: t[0])
+        ct.create(CtKey4(1, 2, 80, 1000, 17))  # UDP: 60s
+        ct.create(CtKey4(1, 2, 80, 1001, PROTO_TCP))
+        t[0] = 100
+        assert ct.gc() == 1
+        assert len(ct.entries) == 1
+        # filter-based GC (reference: GCFilter matchers)
+        assert ct.gc(filter_fn=lambda k, e: k.sport == 1001) == 1
+        assert len(ct.entries) == 0
+
+
+class TestLbMap:
+    def test_host_selection(self):
+        lb = LbMap()
+        vip = 0x0A000001
+        lb.upsert_service(vip, 80, [(0x0B000001, 8080), (0x0B000002, 8080)],
+                          rev_nat_index=3)
+        svc = lb.lookup_service(vip, 80)
+        assert svc.count == 2
+        picks = {lb.select_backend(vip, 80, h).target for h in range(10)}
+        assert picks == {0x0B000001, 0x0B000002}
+        # wildcard-port fallback
+        lb2 = LbMap()
+        lb2.upsert_service(vip, 0, [(0x0C000001, 9090)])
+        assert lb2.lookup_service(vip, 443).count == 1
+        # delete removes slaves
+        assert lb.delete_service(vip, 80)
+        assert lb.lookup_service(vip, 80) is None
+        assert len(lb.services) == 0
+
+    def test_device_matches_host(self):
+        lb = LbMap()
+        vip1, vip2 = 0x0A000001, 0x0A000002
+        lb.upsert_service(vip1, 80, [(0x0B000001, 8080), (0x0B000002, 8081),
+                                     (0x0B000003, 8082)], rev_nat_index=1)
+        lb.upsert_service(vip2, 0, [(0x0C000001, 9090)], rev_nat_index=2)
+        dlb = lb.to_device()
+        vips = np.array([vip1, vip1, vip2, 0x0A000009], np.int64).astype(
+            np.uint32).view(np.int32)
+        dports = np.array([80, 80, 443, 80], np.int32)
+        hashes = np.array([0, 1, 5, 2], np.int32)
+        found, target, port, rev = lb4_select_backend_batch(
+            dlb, vips, dports, hashes
+        )
+        found = np.asarray(found)
+        assert found.tolist() == [True, True, True, False]
+        # against host oracle (slave = hash % count + 1 -> 0-based idx)
+        t = np.asarray(target)
+        assert t[0] == lb.select_backend(vip1, 80, 0).target
+        assert t[1] == lb.select_backend(vip1, 80, 1).target
+        assert t[2] == lb.select_backend(vip2, 443, 5).target
+        assert np.asarray(rev).tolist()[:3] == [1, 1, 2]
+
+
+class TestIpcache:
+    def test_lpm_identity(self):
+        ipc = IpcacheMap()
+        ipc.upsert("10.0.0.0/8", 100)
+        ipc.upsert("10.1.0.0/16", 200, tunnel_endpoint=0x01020304)
+        assert ipc.lookup("10.1.2.3").sec_label == 200
+        assert ipc.lookup("10.2.2.3").sec_label == 100
+        assert ipc.lookup("192.168.1.1") is None
+        dev = ipc.to_device()
+        found, value, _ = lpm_lookup(dev, *ipv4_to_words(["10.1.2.3"]))
+        assert np.asarray(value)[0] == 200
+        assert ipc.delete("10.1.0.0/16")
+        assert ipc.lookup("10.1.2.3").sec_label == 100
+
+
+class TestProxyMap:
+    def test_orig_dst_round_trip(self):
+        t = [0.0]
+        pm = ProxyMap(clock=lambda: t[0])
+        key = ProxyKey4(1, 2, 40000, 9000, 6)
+        pm.create(key, orig_daddr=0x0A000005, orig_dport=80, identity=1234)
+        v = pm.lookup(key)
+        assert (v.orig_daddr, v.orig_dport, v.identity) == (0x0A000005, 80, 1234)
+        t[0] = 100000
+        assert pm.lookup(key) is None
+
+
+class TestMetricsMap:
+    def test_counters(self):
+        m = MetricsMap()
+        m.update(0, 1, count=2, nbytes=100)
+        m.update(132, 2)
+        assert m.get(0, 1).count == 2
+        assert m.get(0, 1).bytes == 100
+        assert m.get(132, 2).count == 1
+        assert len(m.dump()) == 2
