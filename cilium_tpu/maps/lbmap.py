@@ -64,11 +64,14 @@ class LbMap:
     ) -> None:
         """Install a service with its backends; master entry at slave 0,
         backends at slaves 1..n (reference: lbmap service layout)."""
-        # Remove old slaves beyond the new count.
+        # Remove old slaves beyond the new count, and the old RevNAT entry
+        # if the service's rev_nat_index changed.
         old = self.services.get(LbKey(vip, dport, 0))
         if old is not None:
             for s in range(len(backends) + 1, old.count + 1):
                 self.services.pop(LbKey(vip, dport, s), None)
+            if old.rev_nat_index and old.rev_nat_index != rev_nat_index:
+                self.revnat.pop(old.rev_nat_index, None)
         self.services[LbKey(vip, dport, 0)] = LbBackend(
             count=len(backends), rev_nat_index=rev_nat_index
         )
@@ -85,6 +88,8 @@ class LbMap:
             return False
         for s in range(1, master.count + 1):
             self.services.pop(LbKey(vip, dport, s), None)
+        if master.rev_nat_index:
+            self.revnat.pop(master.rev_nat_index, None)
         return True
 
     def lookup_service(self, vip: int, dport: int) -> LbBackend | None:
@@ -118,11 +123,20 @@ class LbMap:
             key=lambda kv: (kv[0].address, kv[0].dport, kv[0].slave),
         )
 
-    def to_device(self, max_backends: int = 16) -> "DeviceLbMap":
-        """Export as dense [S, max_backends] backend arrays per service."""
+    def to_device(self, max_backends: int | None = None) -> "DeviceLbMap":
+        """Export as dense [S, max_backends] backend arrays per service.
+        max_backends defaults to the widest service so no backend is ever
+        silently dropped; an explicit value smaller than that is an error."""
         masters = [
             (k, v) for k, v in self.services.items() if k.slave == 0 and v.count
         ]
+        widest = max((v.count for _, v in masters), default=1)
+        if max_backends is None:
+            max_backends = widest
+        elif max_backends < widest:
+            raise ValueError(
+                f"max_backends {max_backends} < widest service {widest}"
+            )
         s = max(len(masters), 1)
         vips = np.zeros((s,), np.int64)
         ports = np.zeros((s,), np.int64)

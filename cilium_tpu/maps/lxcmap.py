@@ -41,6 +41,16 @@ class LxcMap:
         self.by_id: dict[int, EndpointInfo] = {}
 
     def upsert(self, ip: str, ep_id: int, info: EndpointInfo) -> None:
+        # Clear any stale index entries from a previous IP or ID of this
+        # endpoint so neither index dangles.
+        old_by_id = self.by_id.get(ep_id)
+        if old_by_id is not None:
+            for old_ip, i in list(self.by_ip.items()):
+                if i is old_by_id and old_ip != ip:
+                    del self.by_ip[old_ip]
+        old_by_ip = self.by_ip.get(ip)
+        if old_by_ip is not None and old_by_ip.lxc_id != ep_id:
+            self.by_id.pop(old_by_ip.lxc_id, None)
         info.lxc_id = ep_id
         self.by_ip[ip] = info
         self.by_id[ep_id] = info

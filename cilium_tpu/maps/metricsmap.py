@@ -11,10 +11,13 @@ from dataclasses import dataclass
 
 REASON_FORWARDED = 0
 
-DIR_INGRESS = 1
-DIR_EGRESS = 2
+# The metrics map's direction encoding differs from policy_key's 0/1 bit
+# (reference: bpf/lib/common.h metrics_key dir 1=ingress 2=egress vs
+# policy_key egress bit) — distinct names to prevent cross-map mixups.
+METRIC_DIR_INGRESS = 1
+METRIC_DIR_EGRESS = 2
 
-_DIR_NAMES = {DIR_INGRESS: "INGRESS", DIR_EGRESS: "EGRESS"}
+_DIR_NAMES = {METRIC_DIR_INGRESS: "INGRESS", METRIC_DIR_EGRESS: "EGRESS"}
 
 
 @dataclass

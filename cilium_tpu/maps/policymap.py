@@ -155,13 +155,19 @@ class PolicyMap:
     def to_device(self, pad_to: int | None = None) -> "DevicePolicyMap":
         items = list(self.entries.items())
         n = len(items)
+        if pad_to is None:
+            # Pad to the next power of two (min 64) so repeated policy
+            # updates reuse jit caches instead of recompiling per size.
+            pad_to = 64
+            while pad_to < n:
+                pad_to *= 2
         keys = np.zeros((n, 4), np.int64)
         vals = np.zeros((n, 1), np.int64)
         for i, (k, e) in enumerate(items):
             keys[i] = (k.identity, k.dest_port, k.proto, k.direction)
             vals[i, 0] = e.proxy_port
         return DevicePolicyMap(
-            table=pack_table(keys, vals, pad_to=pad_to or max(n, 1))
+            table=pack_table(keys, vals, pad_to=pad_to)
         )
 
 

@@ -83,6 +83,8 @@ def build_lpm(
         vals.append(value)
     n = len(nets)
     size = pad_to if pad_to is not None else max(n, 1)
+    if size < n:
+        raise ValueError(f"pad_to {size} < table size {n}")
     plen = np.zeros((size,), np.int64)
     values = np.zeros((size,), np.int32)
     valid = np.zeros((size,), bool)

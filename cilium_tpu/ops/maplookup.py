@@ -73,7 +73,11 @@ def exact_lookup(table: DeviceTable, *query_cols) -> tuple[jax.Array, jax.Array]
     Returns (found [F] bool, values [F, V] int32; zeros when not found).
     First matching row wins (tables are deduplicated on build).
     """
-    f = query_cols[0].shape[0]
+    if len(query_cols) != len(table.cols):
+        raise ValueError(
+            f"query has {len(query_cols)} columns, table has "
+            f"{len(table.cols)} — every key field must be matched"
+        )
     matched = table.valid[None, :]  # [F, N]
     for col, q in zip(table.cols, query_cols):
         matched = matched & (col[None, :] == q[:, None])

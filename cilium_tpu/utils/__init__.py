@@ -1,0 +1,6 @@
+"""Support infrastructure: controllers, triggers, completions, reverts,
+span timing, backoff, metrics, logging, configuration.
+
+The array-native framework's equivalent of the reference's pkg/{controller,
+trigger,completion,revert,spanstat,backoff,metrics,logging,option,defaults}.
+"""

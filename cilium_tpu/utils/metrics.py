@@ -1,0 +1,199 @@
+"""Metrics registry with Prometheus text exposition.
+
+reference: pkg/metrics/metrics.go:51-430 — counters/gauges/histograms for
+endpoint counts, regeneration times, policy revision, drop/forward counts,
+proxy redirects; exported in Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from dataclasses import dataclass, field
+from typing import Iterable
+
+NAMESPACE = "cilium_tpu"
+
+
+def _fmt_labels(label_names, label_values) -> str:
+    if not label_names:
+        return ""
+    inner = ",".join(
+        f'{k}="{v}"' for k, v in zip(label_names, label_values)
+    )
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: tuple = ()) -> None:
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple, float] = {}
+        self._mutex = threading.Lock()
+
+    def inc(self, *label_values, amount: float = 1.0) -> None:
+        with self._mutex:
+            self._values[label_values] = self._values.get(label_values, 0.0) + amount
+
+    def get(self, *label_values) -> float:
+        return self._values.get(label_values, 0.0)
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        if not self._values and not self.label_names:
+            yield f"{self.name} 0"
+        for lv, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(self.label_names, lv)} {v:g}"
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str, label_names: tuple = ()) -> None:
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple, float] = {}
+        self._mutex = threading.Lock()
+
+    def set(self, value: float, *label_values) -> None:
+        with self._mutex:
+            self._values[label_values] = value
+
+    def inc(self, *label_values, amount: float = 1.0) -> None:
+        with self._mutex:
+            self._values[label_values] = self._values.get(label_values, 0.0) + amount
+
+    def dec(self, *label_values) -> None:
+        self.inc(*label_values, amount=-1.0)
+
+    def get(self, *label_values) -> float:
+        return self._values.get(label_values, 0.0)
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        if not self._values and not self.label_names:
+            yield f"{self.name} 0"
+        for lv, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(self.label_names, lv)} {v:g}"
+
+
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10
+)
+
+
+class Histogram:
+    def __init__(
+        self, name: str, help_: str, label_names: tuple = (),
+        buckets: tuple = DEFAULT_BUCKETS,
+    ) -> None:
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+        self._mutex = threading.Lock()
+
+    def observe(self, value: float, *label_values) -> None:
+        with self._mutex:
+            counts = self._counts.setdefault(
+                label_values, [0] * len(self.buckets)
+            )
+            # Cumulative buckets: value counts into every bucket with
+            # bound >= value (le is inclusive).
+            for j in range(bisect_left(self.buckets, value), len(self.buckets)):
+                counts[j] += 1
+            self._sums[label_values] = self._sums.get(label_values, 0.0) + value
+            self._totals[label_values] = self._totals.get(label_values, 0) + 1
+
+    def get_count(self, *label_values) -> int:
+        return self._totals.get(label_values, 0)
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        for lv in sorted(self._totals):
+            counts = self._counts[lv]
+            for j, b in enumerate(self.buckets):
+                labels = _fmt_labels(
+                    self.label_names + ("le",), lv + (f"{b:g}",)
+                )
+                yield f"{self.name}_bucket{labels} {counts[j]}"
+            labels_inf = _fmt_labels(self.label_names + ("le",), lv + ("+Inf",))
+            yield f"{self.name}_bucket{labels_inf} {self._totals[lv]}"
+            yield (
+                f"{self.name}_sum{_fmt_labels(self.label_names, lv)} "
+                f"{self._sums[lv]:g}"
+            )
+            yield (
+                f"{self.name}_count{_fmt_labels(self.label_names, lv)} "
+                f"{self._totals[lv]}"
+            )
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._collectors: list = []
+        self._mutex = threading.Lock()
+
+    def register(self, collector):
+        with self._mutex:
+            self._collectors.append(collector)
+        return collector
+
+    def counter(self, name, help_, label_names=()):
+        return self.register(Counter(f"{NAMESPACE}_{name}", help_, label_names))
+
+    def gauge(self, name, help_, label_names=()):
+        return self.register(Gauge(f"{NAMESPACE}_{name}", help_, label_names))
+
+    def histogram(self, name, help_, label_names=(), buckets=DEFAULT_BUCKETS):
+        return self.register(
+            Histogram(f"{NAMESPACE}_{name}", help_, label_names, buckets)
+        )
+
+    def expose(self) -> str:
+        """Prometheus text format."""
+        lines: list[str] = []
+        with self._mutex:
+            collectors = list(self._collectors)
+        for c in collectors:
+            lines.extend(c.collect())
+        return "\n".join(lines) + "\n"
+
+
+# Global registry + the reference's core metric set
+# (reference: pkg/metrics/metrics.go:51-430).
+registry = Registry()
+
+EndpointCount = registry.gauge("endpoint_count", "Number of endpoints managed")
+EndpointRegenerationCount = registry.counter(
+    "endpoint_regenerations_total",
+    "Count of all endpoint regenerations",
+    ("outcome",),
+)
+EndpointRegenerationTime = registry.histogram(
+    "endpoint_regeneration_seconds",
+    "Endpoint regeneration time",
+)
+PolicyRevision = registry.gauge("policy_max_revision", "Highest policy revision")
+PolicyCount = registry.gauge("policy_count", "Number of policy rules loaded")
+PolicyImportErrors = registry.counter(
+    "policy_import_errors_total", "Number of policy imports that failed"
+)
+DropCount = registry.counter(
+    "drop_count_total", "Dropped packets/requests", ("reason", "direction")
+)
+ForwardCount = registry.counter(
+    "forward_count_total", "Forwarded packets/requests", ("direction",)
+)
+ProxyVerdicts = registry.counter(
+    "proxy_verdicts_total", "L7 proxy verdicts", ("l7_protocol", "verdict")
+)
+ProxyBatches = registry.counter(
+    "proxy_batches_total", "Device verdict batches dispatched"
+)

@@ -1,0 +1,168 @@
+"""Daemon configuration and runtime-mutable options.
+
+reference: pkg/option — a typed config snapshot (config.go:168
+daemonConfig) populated from flags, plus a runtime-mutable option map with
+per-option verify/parse and change hooks (option.go), overlayable
+per-endpoint (endpoint.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from . import defaults
+
+# Boolean runtime options (reference: pkg/option/option.go option lib).
+OPTION_DEBUG = "Debug"
+OPTION_DROP_NOTIFY = "DropNotification"
+OPTION_TRACE_NOTIFY = "TraceNotification"
+OPTION_POLICY_VERDICT_NOTIFY = "PolicyVerdictNotification"
+OPTION_CONNTRACK = "Conntrack"
+OPTION_POLICY_ENABLED = "Policy"
+
+
+@dataclass
+class OptionSpec:
+    name: str
+    description: str = ""
+    immutable: bool = False
+    # parse raw string -> canonical value; default accepts true/false
+    parse: Optional[Callable[[str], Any]] = None
+
+
+def _parse_bool(v: str) -> bool:
+    s = str(v).lower()
+    if s in ("true", "enabled", "on", "1"):
+        return True
+    if s in ("false", "disabled", "off", "0"):
+        return False
+    raise ValueError(f"invalid option value {v!r}")
+
+
+AVAILABLE_OPTIONS: dict[str, OptionSpec] = {
+    OPTION_DEBUG: OptionSpec(OPTION_DEBUG, "Enable debugging"),
+    OPTION_DROP_NOTIFY: OptionSpec(OPTION_DROP_NOTIFY, "Drop notifications"),
+    OPTION_TRACE_NOTIFY: OptionSpec(OPTION_TRACE_NOTIFY, "Trace notifications"),
+    OPTION_POLICY_VERDICT_NOTIFY: OptionSpec(
+        OPTION_POLICY_VERDICT_NOTIFY, "Policy verdict notifications"
+    ),
+    OPTION_CONNTRACK: OptionSpec(OPTION_CONNTRACK, "Connection tracking"),
+    OPTION_POLICY_ENABLED: OptionSpec(OPTION_POLICY_ENABLED, "Policy enforcement"),
+}
+
+
+class OptionMap:
+    """Mutable option set with change hooks (reference: option.go
+    BoolOptions + changedOption at daemon/daemon.go:1440)."""
+
+    def __init__(self, parent: "OptionMap | None" = None) -> None:
+        self._values: dict[str, bool] = {}
+        self._parent = parent
+        self._hooks: list[Callable[[str, bool], None]] = []
+        self._mutex = threading.RLock()
+
+    def get(self, name: str) -> bool:
+        with self._mutex:
+            if name in self._values:
+                return self._values[name]
+        if self._parent is not None:
+            return self._parent.get(name)
+        return False
+
+    def set(self, name: str, value) -> bool:
+        """Set; returns True if the effective value changed."""
+        spec = AVAILABLE_OPTIONS.get(name)
+        if spec is None:
+            raise KeyError(f"unknown option {name!r}")
+        if spec.immutable:
+            raise PermissionError(f"option {name!r} is immutable")
+        parse = spec.parse or _parse_bool
+        v = parse(value) if isinstance(value, str) else bool(value)
+        with self._mutex:
+            old = self.get(name)
+            self._values[name] = v
+            changed = old != v
+            hooks = list(self._hooks)
+        if changed:
+            for h in hooks:
+                h(name, v)
+        return changed
+
+    def delete(self, name: str) -> None:
+        """Remove the local override (per-endpoint overlay semantics)."""
+        with self._mutex:
+            self._values.pop(name, None)
+
+    def add_change_hook(self, hook: Callable[[str, bool], None]) -> None:
+        self._hooks.append(hook)
+
+    def snapshot(self) -> dict[str, bool]:
+        with self._mutex:
+            out = dict(self._parent.snapshot()) if self._parent else {}
+            out.update(self._values)
+            return out
+
+
+@dataclass
+class DaemonConfig:
+    """Typed config snapshot (reference: pkg/option/config.go:168)."""
+
+    # Paths
+    run_dir: str = defaults.RUNTIME_PATH
+    state_dir: str = defaults.STATE_DIR
+    socket_path: str = defaults.SOCK_PATH
+    monitor_socket_path: str = defaults.MONITOR_SOCK_PATH
+    access_log_path: str = ""
+
+    # Cluster
+    cluster_name: str = defaults.CLUSTER_NAME
+    cluster_id: int = 0
+
+    # Policy
+    enable_policy: str = "default"  # default | always | never
+    allow_localhost: str = "auto"  # auto | always | policy
+    host_allows_world: bool = False
+
+    # Proxy
+    proxy_port_min: int = defaults.PROXY_PORT_MIN
+    proxy_port_max: int = defaults.PROXY_PORT_MAX
+
+    # Device batching (TPU runtime)
+    batch_flows: int = defaults.BATCH_FLOWS
+    batch_width: int = defaults.BATCH_WIDTH
+    batch_timeout_ms: float = defaults.BATCH_TIMEOUT_MS
+
+    # Modes
+    dry_mode: bool = False  # reference: DryMode, pkg/endpoint/bpf.go:510
+    restore_state: bool = True
+
+    # kvstore
+    kvstore: str = "local"  # local | etcd
+    kvstore_opts: dict = field(default_factory=dict)
+
+    # Monitor
+    monitor_queue_size: int = defaults.MONITOR_QUEUE_SIZE
+
+    # Runtime options
+    opts: OptionMap = field(default_factory=OptionMap)
+
+    def always_allow_localhost(self) -> bool:
+        """reference: config.go AlwaysAllowLocalhost."""
+        return self.allow_localhost == "always"
+
+    def validate(self) -> None:
+        """reference: config.go:338 Validate."""
+        if self.enable_policy not in ("default", "always", "never"):
+            raise ValueError(f"invalid enable_policy {self.enable_policy!r}")
+        if not 0 < self.proxy_port_min < self.proxy_port_max <= 65535:
+            raise ValueError("invalid proxy port range")
+        if self.batch_flows <= 0 or self.batch_width <= 0:
+            raise ValueError("batch dimensions must be positive")
+        if self.cluster_id < 0 or self.cluster_id > 255:
+            raise ValueError("cluster-id must be in [0, 255]")
+
+
+# Global config (reference: option.Config singleton).
+config = DaemonConfig()

@@ -1,0 +1,259 @@
+"""Support infra tests: controller backoff, trigger folding, completion
+deadlines, revert ordering, spanstat, metrics exposition, options."""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.utils.backoff import Exponential
+from cilium_tpu.utils.completion import Completion, CompletionError, WaitGroup
+from cilium_tpu.utils.controller import (
+    Controller,
+    ControllerManager,
+    ControllerParams,
+)
+from cilium_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+from cilium_tpu.utils.option import DaemonConfig, OptionMap
+from cilium_tpu.utils.revert import FinalizeList, RevertStack
+from cilium_tpu.utils.spanstat import SpanStat, SpanStats
+from cilium_tpu.utils.trigger import Trigger
+
+
+class TestController:
+    def test_runs_and_counts(self):
+        ran = threading.Event()
+        calls = []
+        mgr = ControllerManager()
+        mgr.update_controller(
+            "t1",
+            ControllerParams(do_func=lambda: (calls.append(1), ran.set())),
+        )
+        assert ran.wait(2)
+        c = mgr.lookup("t1")
+        deadline = time.monotonic() + 2
+        while c.status().success_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        st = c.status()
+        assert st.success_count >= 1 and st.failure_count == 0
+        mgr.remove_all()
+
+    def test_error_backoff_and_recovery(self):
+        attempts = []
+
+        def do():
+            attempts.append(time.monotonic())
+            if len(attempts) < 3:
+                raise RuntimeError("boom")
+
+        mgr = ControllerManager()
+        mgr.update_controller(
+            "t2", ControllerParams(do_func=do, error_retry_base=0.05)
+        )
+        c = mgr.lookup("t2")
+        deadline = time.monotonic() + 5
+        while c.status().success_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        st = c.status()
+        assert st.failure_count == 2
+        assert st.success_count >= 1
+        assert st.consecutive_errors == 0
+        assert st.last_error == ""
+        # second retry gap (2*base) should exceed the first (1*base)
+        gap1 = attempts[1] - attempts[0]
+        gap2 = attempts[2] - attempts[1]
+        assert gap2 > gap1 * 1.5
+        mgr.remove_all()
+
+    def test_update_runs_immediately(self):
+        count = []
+        mgr = ControllerManager()
+        mgr.update_controller("t3", ControllerParams(do_func=lambda: count.append(1)))
+        c = mgr.lookup("t3")
+        deadline = time.monotonic() + 2
+        while len(count) < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        n = len(count)
+        c.update()
+        deadline = time.monotonic() + 2
+        while len(count) <= n and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(count) > n
+        assert mgr.remove_controller("t3")
+        assert not mgr.remove_controller("t3")
+
+    def test_stop_func_called(self):
+        stopped = threading.Event()
+        mgr = ControllerManager()
+        mgr.update_controller(
+            "t4",
+            ControllerParams(do_func=lambda: None, stop_func=stopped.set),
+        )
+        mgr.remove_controller("t4")
+        assert stopped.wait(2)
+
+
+class TestTrigger:
+    def test_folding_with_min_interval(self):
+        calls = []
+        t = Trigger(lambda: calls.append(time.monotonic()),
+                    min_interval=0.1, name="x")
+        for _ in range(20):
+            t.trigger()
+            time.sleep(0.005)
+        deadline = time.monotonic() + 2
+        while not calls and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.25)
+        t.shutdown()
+        # 20 triggers over ~0.1s fold into far fewer calls
+        assert 1 <= len(calls) <= 4
+        if len(calls) >= 2:
+            assert calls[1] - calls[0] >= 0.09
+
+    def test_no_interval_runs_each_burst(self):
+        calls = []
+        t = Trigger(lambda: calls.append(1), name="y")
+        t.trigger()
+        deadline = time.monotonic() + 2
+        while not calls and time.monotonic() < deadline:
+            time.sleep(0.01)
+        t.shutdown()
+        assert calls
+
+
+class TestCompletion:
+    def test_wait_all_completed(self):
+        wg = WaitGroup()
+        c1 = wg.add_completion()
+        c2 = wg.add_completion()
+        threading.Timer(0.05, c1.complete).start()
+        threading.Timer(0.08, c2.complete).start()
+        wg.wait(timeout=2)
+        assert c1.completed and c2.completed
+
+    def test_deadline(self):
+        wg = WaitGroup()
+        wg.add_completion()  # never completed
+        with pytest.raises(CompletionError):
+            wg.wait(timeout=0.05)
+
+    def test_standalone_completion(self):
+        c = Completion()
+        assert not c.completed
+        c.complete()
+        assert c.wait(0)
+
+
+class TestRevert:
+    def test_reverse_order(self):
+        order = []
+        s = RevertStack()
+        s.push(lambda: order.append(1))
+        s.push(lambda: order.append(2))
+        s.push(lambda: order.append(3))
+        s.revert()
+        assert order == [3, 2, 1]
+        assert len(s) == 0
+
+    def test_finalize(self):
+        order = []
+        f = FinalizeList()
+        f.append(lambda: order.append("a"))
+        f.append(lambda: order.append("b"))
+        f.finalize()
+        assert order == ["a", "b"]
+
+
+class TestSpanStat:
+    def test_accumulation(self):
+        s = SpanStat()
+        s.start()
+        time.sleep(0.01)
+        d = s.end(success=True)
+        assert d > 0 and s.num_success == 1
+        s.start()
+        s.end(success=False)
+        assert s.num_failure == 1
+        assert s.total() >= d
+
+    def test_named_spans(self):
+        st = SpanStats()
+        st.span("policy").start()
+        st.span("policy").end()
+        assert "policy" in st.report()
+
+
+class TestBackoff:
+    def test_growth_and_cap(self):
+        b = Exponential(min_duration=1, max_duration=8, factor=2, jitter=False)
+        assert [b.duration(i) for i in (1, 2, 3, 4, 5)] == [1, 2, 4, 8, 8]
+
+    def test_jitter_bounds(self):
+        b = Exponential(min_duration=2, factor=2, jitter=True)
+        for i in range(1, 6):
+            d = b.duration(i)
+            nominal = 2 * 2 ** (i - 1)
+            assert nominal / 2 <= d <= nominal
+
+
+class TestMetrics:
+    def test_counter_gauge(self):
+        r = Registry()
+        c = r.counter("reqs_total", "requests", ("code",))
+        c.inc("200")
+        c.inc("200")
+        c.inc("500")
+        assert c.get("200") == 2
+        g = r.gauge("eps", "endpoints")
+        g.set(5)
+        g.inc()
+        assert g.get() == 6
+        text = r.expose()
+        assert 'cilium_tpu_reqs_total{code="200"} 2' in text
+        assert "cilium_tpu_eps 6" in text
+        assert "# TYPE cilium_tpu_reqs_total counter" in text
+
+    def test_histogram(self):
+        r = Registry()
+        h = r.histogram("lat", "latency", buckets=(0.1, 1, 10))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(100)
+        text = r.expose()
+        assert 'cilium_tpu_lat_bucket{le="0.1"} 1' in text
+        assert 'cilium_tpu_lat_bucket{le="1"} 2' in text
+        assert 'cilium_tpu_lat_bucket{le="+Inf"} 3' in text
+        assert "cilium_tpu_lat_count 3" in text
+        assert h.get_count() == 3
+
+
+class TestOptions:
+    def test_option_map_hooks_and_overlay(self):
+        changes = []
+        base = OptionMap()
+        base.add_change_hook(lambda n, v: changes.append((n, v)))
+        assert base.set("Debug", "true")
+        assert not base.set("Debug", True)  # unchanged
+        assert changes == [("Debug", True)]
+        # per-endpoint overlay
+        ep = OptionMap(parent=base)
+        assert ep.get("Debug") is True
+        ep.set("Debug", False)
+        assert ep.get("Debug") is False and base.get("Debug") is True
+        ep.delete("Debug")
+        assert ep.get("Debug") is True
+        with pytest.raises(KeyError):
+            base.set("Nope", True)
+        with pytest.raises(ValueError):
+            base.set("Debug", "maybe")
+
+    def test_daemon_config_validate(self):
+        cfg = DaemonConfig()
+        cfg.validate()
+        cfg.enable_policy = "bogus"
+        with pytest.raises(ValueError):
+            cfg.validate()
+        cfg = DaemonConfig(proxy_port_min=5000, proxy_port_max=4000)
+        with pytest.raises(ValueError):
+            cfg.validate()
