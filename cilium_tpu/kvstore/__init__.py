@@ -1,0 +1,63 @@
+"""Key-value store client layer — the distributed state backbone.
+
+reference: pkg/kvstore — identity allocation, node discovery, ipcache and
+service propagation all converge through a kvstore (etcd/consul in the
+reference) via BackendOperations (backend.go:86): Get/Set/CAS primitives,
+path locks, leases, and prefix watchers feeding event channels.
+
+Backends here: ``LocalBackend`` (in-process, threadsafe, full watch/lease
+semantics — the default for single-host and tests) and ``FileBackend``
+(JSON-file persisted, surviving restarts).  An etcd backend can slot in
+behind the same interface where a cluster store is available; the consumer
+layers (allocator, store, ipcache) only use BackendOperations.
+"""
+
+from .backend import (
+    Backend,
+    CAP_CREATE_IF_EXISTS,
+    EventType,
+    KeyValueEvent,
+    KvstoreError,
+    LockError,
+    Watcher,
+)
+from .local import FileBackend, LocalBackend
+
+_default_client: Backend | None = None
+
+
+def setup_client(backend: Backend) -> Backend:
+    """Install the process-global client (reference: kvstore.Client())."""
+    global _default_client
+    _default_client = backend
+    return backend
+
+
+def client() -> Backend:
+    global _default_client
+    if _default_client is None:
+        _default_client = LocalBackend()
+    return _default_client
+
+
+def close_client() -> None:
+    global _default_client
+    if _default_client is not None:
+        _default_client.close()
+        _default_client = None
+
+
+__all__ = [
+    "Backend",
+    "CAP_CREATE_IF_EXISTS",
+    "EventType",
+    "FileBackend",
+    "KeyValueEvent",
+    "KvstoreError",
+    "LocalBackend",
+    "LockError",
+    "Watcher",
+    "client",
+    "close_client",
+    "setup_client",
+]

@@ -1,0 +1,272 @@
+"""Cluster-wide ID allocator over the kvstore.
+
+reference: pkg/kvstore/allocator/allocator.go:136 — allocates small numeric
+IDs for arbitrary keys (label sets) cluster-wide:
+
+  <prefix>/id/<numericID>          -> key string        (master key)
+  <prefix>/value/<key>/<nodename>  -> numericID         (per-node use ref)
+
+Allocation first reuses an existing master key for the value (so all nodes
+converge on one ID per key), otherwise claims a free ID with an atomic
+create.  Node value keys are lease-attached: a dying node's references
+disappear, and GC removes master keys with no remaining references.
+A watcher keeps a local id->key cache in sync with remote allocations.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .backend import Backend, EventType, KvstoreError, Watcher
+
+
+class AllocatorError(KvstoreError):
+    pass
+
+
+class IdPool:
+    """Pool of allocatable IDs (reference: pkg/kvstore/allocator/idpool.go)."""
+
+    def __init__(self, start: int, end: int) -> None:
+        self.start = start
+        self.end = end
+        self._free: set[int] = set(range(start, end + 1))
+        self._mutex = threading.Lock()
+
+    def lease_random(self) -> Optional[int]:
+        with self._mutex:
+            if not self._free:
+                return None
+            val = random.choice(tuple(self._free))
+            self._free.discard(val)
+            return val
+
+    def remove(self, id_: int) -> None:
+        with self._mutex:
+            self._free.discard(id_)
+
+    def insert(self, id_: int) -> None:
+        with self._mutex:
+            if self.start <= id_ <= self.end:
+                self._free.add(id_)
+
+
+@dataclass
+class AllocatorEvent:
+    typ: EventType
+    id: int
+    key: str
+
+
+class Allocator:
+    """reference: allocator.go:136 Allocator."""
+
+    def __init__(
+        self,
+        backend: Backend,
+        base_path: str,
+        suffix: str,
+        min_id: int = 256,
+        max_id: int = 65535,
+        events: Callable[[AllocatorEvent], None] | None = None,
+    ) -> None:
+        self.backend = backend
+        self.base_path = base_path.rstrip("/")
+        self.suffix = suffix  # this node's name
+        self.id_pool = IdPool(min_id, max_id)
+        self.events = events
+        # local cache: key -> (id, refcount) (reference: localkeys.go)
+        self._local: dict[str, list[int]] = {}
+        # remote cache: id -> key (reference: allocator cache.go)
+        self.cache: dict[int, str] = {}
+        self._mutex = threading.RLock()
+        self._watcher: Watcher | None = None
+        self._sync_from_store()
+
+    # -- paths -------------------------------------------------------------
+
+    def _id_path(self, id_: int) -> str:
+        return f"{self.base_path}/id/{id_}"
+
+    def _value_prefix(self, key: str) -> str:
+        return f"{self.base_path}/value/{self.backend.encode(key.encode())}"
+
+    def _value_path(self, key: str) -> str:
+        return f"{self._value_prefix(key)}/{self.suffix}"
+
+    # -- init --------------------------------------------------------------
+
+    def _fire_event(self, ev: AllocatorEvent) -> None:
+        """Direct event dispatch, used only while no watcher runs — once
+        start_watch is active the watcher delivers every master-key change
+        and a direct callback would double-fire."""
+        if self.events and self._watcher is None:
+            self.events(ev)
+
+    def _sync_from_store(self) -> None:
+        for k, v in self.backend.list_prefix(f"{self.base_path}/id/").items():
+            try:
+                id_ = int(k.rsplit("/", 1)[1])
+            except ValueError:
+                continue
+            self.id_pool.remove(id_)
+            self.cache[id_] = v.decode()
+
+    # -- allocation --------------------------------------------------------
+
+    def allocate(self, key: str) -> tuple[int, bool]:
+        """Allocate or reuse the cluster-wide ID for key; returns
+        (id, is_new) (reference: allocator.go:240 Allocate)."""
+        with self._mutex:
+            entry = self._local.get(key)
+            if entry is not None:
+                entry[1] += 1
+                return entry[0], False
+
+        lock = self.backend.lock_path(f"{self.base_path}/locks/{key}")
+        try:
+            # Re-check under the lock: another same-node thread may have
+            # allocated while we waited; bump its refcount instead of
+            # resetting it to 1 (which would release prematurely).
+            with self._mutex:
+                entry = self._local.get(key)
+                if entry is not None:
+                    entry[1] += 1
+                    return entry[0], False
+
+            existing = self._lookup_key(key)
+            if existing is not None:
+                # Reuse the cluster-wide ID; register our reference.
+                self.backend.set(self._value_path(key), str(existing).encode(),
+                                 lease=True)
+                self.id_pool.remove(existing)
+                with self._mutex:
+                    self._local[key] = [existing, 1]
+                    self.cache[existing] = key
+                return existing, False
+
+            for _ in range(32):  # bounded retries on races
+                id_ = self.id_pool.lease_random()
+                if id_ is None:
+                    raise AllocatorError("ID space exhausted")
+                if self.backend.create_only(self._id_path(id_), key.encode()):
+                    self.backend.set(self._value_path(key),
+                                     str(id_).encode(), lease=True)
+                    with self._mutex:
+                        self._local[key] = [id_, 1]
+                        self.cache[id_] = key
+                    self._fire_event(AllocatorEvent(EventType.CREATE, id_, key))
+                    return id_, True
+                # Another node claimed this ID concurrently.
+            raise AllocatorError(f"unable to allocate ID for key {key!r}")
+        finally:
+            lock.unlock()
+
+    def _lookup_key(self, key: str) -> Optional[int]:
+        """Find an existing master ID for key (reference: GetNoCache path)."""
+        for k, v in self.backend.list_prefix(f"{self.base_path}/id/").items():
+            if v.decode() == key:
+                try:
+                    return int(k.rsplit("/", 1)[1])
+                except ValueError:
+                    continue
+        return None
+
+    def get(self, key: str) -> Optional[int]:
+        """ID for key from cache, if any (reference: allocator.Get)."""
+        with self._mutex:
+            entry = self._local.get(key)
+            if entry is not None:
+                return entry[0]
+            for id_, k in self.cache.items():
+                if k == key:
+                    return id_
+        return None
+
+    def get_by_id(self, id_: int) -> Optional[str]:
+        with self._mutex:
+            return self.cache.get(id_)
+
+    def release(self, key: str) -> bool:
+        """Drop one local reference; removes our value key at zero
+        (reference: allocator.go Release)."""
+        with self._mutex:
+            entry = self._local.get(key)
+            if entry is None:
+                return False
+            entry[1] -= 1
+            if entry[1] > 0:
+                return True
+            del self._local[key]
+        self.backend.delete(self._value_path(key))
+        return True
+
+    def run_gc(self) -> int:
+        """Remove master keys with no value references; returns count
+        (reference: allocator.go RunGC)."""
+        removed = 0
+        for k, v in list(
+            self.backend.list_prefix(f"{self.base_path}/id/").items()
+        ):
+            key = v.decode()
+            # Serialize against allocate() on the same key: without the
+            # lock, GC could delete a master key between another node's
+            # reuse-lookup and its value-ref write, causing ID reuse for a
+            # different key.
+            lock = self.backend.lock_path(f"{self.base_path}/locks/{key}")
+            try:
+                if self.backend.get(k) is None:
+                    continue  # already removed while we waited
+                if self.backend.list_prefix(self._value_prefix(key) + "/"):
+                    continue  # referenced again
+                self.backend.delete(k)
+                try:
+                    id_ = int(k.rsplit("/", 1)[1])
+                except ValueError:
+                    continue
+                self.id_pool.insert(id_)
+                with self._mutex:
+                    self.cache.pop(id_, None)
+                self._fire_event(AllocatorEvent(EventType.DELETE, id_, key))
+                removed += 1
+            finally:
+                lock.unlock()
+        return removed
+
+    # -- watch -------------------------------------------------------------
+
+    def start_watch(self) -> Watcher:
+        """Watch master keys, keeping the remote cache in sync and firing
+        the events callback (reference: allocator cache.go watcher)."""
+        w = self.backend.list_and_watch("allocator", f"{self.base_path}/id/")
+        self._watcher = w
+
+        def run() -> None:
+            for ev in w:
+                if ev.typ == EventType.LIST_DONE:
+                    continue
+                try:
+                    id_ = int(ev.key.rsplit("/", 1)[1])
+                except (ValueError, IndexError):
+                    continue
+                with self._mutex:
+                    if ev.typ == EventType.DELETE:
+                        key = self.cache.pop(id_, "")
+                        self.id_pool.insert(id_)
+                    else:
+                        key = ev.value.decode()
+                        self.cache[id_] = key
+                        self.id_pool.remove(id_)
+                if self.events:
+                    self.events(AllocatorEvent(ev.typ, id_, key))
+
+        t = threading.Thread(target=run, name="allocator-watch", daemon=True)
+        t.start()
+        return w
+
+    def stop_watch(self) -> None:
+        if self._watcher is not None:
+            self._watcher.stop()

@@ -1,0 +1,121 @@
+"""Backend interface and watcher events (reference: pkg/kvstore/backend.go
+BackendOperations, events.go KeyValueEvent)."""
+
+from __future__ import annotations
+
+import abc
+import enum
+import queue
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+
+class KvstoreError(RuntimeError):
+    pass
+
+
+class LockError(KvstoreError):
+    pass
+
+
+class EventType(enum.Enum):
+    """reference: pkg/kvstore/events.go."""
+
+    CREATE = "create"
+    MODIFY = "modify"
+    DELETE = "delete"
+    LIST_DONE = "listDone"
+
+
+@dataclass
+class KeyValueEvent:
+    typ: EventType
+    key: str = ""
+    value: bytes = b""
+
+
+class Watcher:
+    """Prefix watcher with an event queue (reference: kvstore.Watcher)."""
+
+    def __init__(self, name: str, prefix: str, chan_size: int = 128) -> None:
+        self.name = name
+        self.prefix = prefix
+        self.events: "queue.Queue[KeyValueEvent]" = queue.Queue(maxsize=chan_size)
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def next_event(self, timeout: float | None = None) -> Optional[KeyValueEvent]:
+        try:
+            return self.events.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def __iter__(self) -> Iterator[KeyValueEvent]:
+        while not self._stopped:
+            ev = self.next_event(timeout=0.2)
+            if ev is not None:
+                yield ev
+
+
+CAP_CREATE_IF_EXISTS = 1
+
+
+class Backend(abc.ABC):
+    """reference: backend.go:86 BackendOperations."""
+
+    @abc.abstractmethod
+    def status(self) -> str: ...
+
+    @abc.abstractmethod
+    def lock_path(self, path: str, timeout: float | None = None): ...
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    def get_prefix(self, prefix: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    def set(self, key: str, value: bytes, lease: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> None: ...
+
+    @abc.abstractmethod
+    def delete_prefix(self, prefix: str) -> None: ...
+
+    @abc.abstractmethod
+    def create_only(self, key: str, value: bytes, lease: bool = False) -> bool: ...
+
+    @abc.abstractmethod
+    def create_if_exists(self, cond_key: str, key: str, value: bytes,
+                         lease: bool = False) -> bool: ...
+
+    @abc.abstractmethod
+    def list_prefix(self, prefix: str) -> dict[str, bytes]: ...
+
+    @abc.abstractmethod
+    def list_and_watch(self, name: str, prefix: str,
+                       chan_size: int = 128) -> Watcher: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    def get_capabilities(self) -> int:
+        return CAP_CREATE_IF_EXISTS
+
+    def encode(self, data: bytes) -> str:
+        import base64
+
+        return base64.b64encode(data).decode()
+
+    def decode(self, s: str) -> bytes:
+        import base64
+
+        return base64.b64decode(s)

@@ -1,0 +1,109 @@
+"""Shared key collection over the kvstore.
+
+reference: pkg/kvstore/store/store.go — a generic collection of keys shared
+across nodes: each node owns and keeps alive its local keys (lease +
+periodic sync), a watcher mirrors all remote keys into a local map, and
+observers are notified on updates/deletes.  Node discovery and service
+propagation ride on this.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .backend import Backend, EventType
+
+
+class SharedStore:
+    """reference: store.go SharedStore."""
+
+    def __init__(
+        self,
+        backend: Backend,
+        prefix: str,
+        node_name: str,
+        on_update: Callable[[str, dict], None] | None = None,
+        on_delete: Callable[[str], None] | None = None,
+    ) -> None:
+        self.backend = backend
+        self.prefix = prefix.rstrip("/")
+        self.node_name = node_name
+        self.on_update = on_update
+        self.on_delete = on_delete
+        self._local: dict[str, dict] = {}
+        self._shared: dict[str, dict] = {}
+        self._mutex = threading.RLock()
+        self._watcher = None
+        self._start_watch()
+
+    def _key_path(self, name: str) -> str:
+        return f"{self.prefix}/{name}"
+
+    def update_local_key_sync(self, name: str, value: dict) -> None:
+        """Publish/refresh one of our keys (reference:
+        store.go UpdateLocalKeySync)."""
+        with self._mutex:
+            self._local[name] = value
+        self.backend.set(
+            self._key_path(name), json.dumps(value).encode(), lease=True
+        )
+
+    def delete_local_key(self, name: str) -> None:
+        with self._mutex:
+            self._local.pop(name, None)
+        self.backend.delete(self._key_path(name))
+
+    def get_shared_keys(self) -> dict[str, dict]:
+        with self._mutex:
+            return dict(self._shared)
+
+    def get(self, name: str) -> Optional[dict]:
+        with self._mutex:
+            return self._shared.get(name)
+
+    def sync_local_keys(self) -> None:
+        """Re-publish all local keys (periodic keepalive refresh,
+        reference: store.go syncLocalKeys)."""
+        with self._mutex:
+            local = dict(self._local)
+        for name, value in local.items():
+            self.backend.set(
+                self._key_path(name), json.dumps(value).encode(), lease=True
+            )
+
+    def _start_watch(self) -> None:
+        w = self.backend.list_and_watch(f"store-{self.prefix}", self.prefix + "/")
+        self._watcher = w
+
+        def run() -> None:
+            for ev in w:
+                if ev.typ == EventType.LIST_DONE:
+                    continue
+                name = ev.key[len(self.prefix) + 1:]
+                if ev.typ == EventType.DELETE:
+                    with self._mutex:
+                        self._shared.pop(name, None)
+                    if self.on_delete:
+                        self.on_delete(name)
+                else:
+                    try:
+                        value = json.loads(ev.value.decode())
+                    except ValueError:
+                        continue
+                    with self._mutex:
+                        self._shared[name] = value
+                    if self.on_update:
+                        self.on_update(name, value)
+
+        threading.Thread(
+            target=run, name=f"store-watch-{self.prefix}", daemon=True
+        ).start()
+
+    def close(self) -> None:
+        if self._watcher is not None:
+            self._watcher.stop()
+        for name in list(self._local):
+            self.delete_local_key(name)

@@ -1,0 +1,305 @@
+"""kvstore, allocator, identity, ipcache, node discovery tests.
+
+Multi-node convergence is exercised by running several allocator/store
+clients against one shared backend — the same strategy as the reference's
+kvstore tests against a real etcd (reference: pkg/kvstore/*_test.go,
+Makefile:88 start-kvstores), without the external process.
+"""
+
+import time
+
+import pytest
+
+from cilium_tpu.identity import (
+    Identity,
+    IdentityAllocator,
+    MIN_USER_IDENTITY,
+    RESERVED_HOST,
+    RESERVED_WORLD,
+    ReservedIdentities,
+    look_up_reserved_identity,
+)
+from cilium_tpu.ipcache import (
+    IPIdentityCache,
+    IPIdentityPair,
+    KvstoreIPSync,
+    datapath_listener,
+)
+from cilium_tpu.kvstore import LocalBackend, FileBackend
+from cilium_tpu.kvstore.allocator import Allocator, AllocatorError
+from cilium_tpu.kvstore.backend import EventType
+from cilium_tpu.kvstore.store import SharedStore
+from cilium_tpu.labels import Labels
+from cilium_tpu.maps.ipcache import IpcacheMap
+from cilium_tpu.node import Node, NodeDiscovery
+
+
+def wait_for(cond, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+class TestLocalBackend:
+    def test_crud(self):
+        b = LocalBackend()
+        assert b.get("k") is None
+        b.set("a/k1", b"v1")
+        b.set("a/k2", b"v2")
+        assert b.get("a/k1") == b"v1"
+        assert b.get_prefix("a/") == b"v1"
+        assert set(b.list_prefix("a/")) == {"a/k1", "a/k2"}
+        b.delete("a/k1")
+        assert b.get("a/k1") is None
+        b.delete_prefix("a/")
+        assert b.list_prefix("a/") == {}
+
+    def test_create_only_atomic(self):
+        b = LocalBackend()
+        assert b.create_only("k", b"1")
+        assert not b.create_only("k", b"2")
+        assert b.get("k") == b"1"
+
+    def test_create_if_exists(self):
+        b = LocalBackend()
+        assert not b.create_if_exists("cond", "k", b"v")
+        b.set("cond", b"x")
+        assert b.create_if_exists("cond", "k", b"v")
+        assert b.get("k") == b"v"
+
+    def test_watch_list_then_live(self):
+        b = LocalBackend()
+        b.set("p/a", b"1")
+        w = b.list_and_watch("t", "p/")
+        ev = w.next_event(1)
+        assert ev.typ == EventType.CREATE and ev.key == "p/a"
+        assert w.next_event(1).typ == EventType.LIST_DONE
+        b.set("p/b", b"2")
+        b.delete("p/b")
+        assert w.next_event(1).typ == EventType.CREATE
+        assert w.next_event(1).typ == EventType.DELETE
+        # outside prefix: not delivered
+        b.set("q/x", b"3")
+        assert w.next_event(0.05) is None
+        w.stop()
+
+    def test_lease_revoked_on_close(self):
+        b = LocalBackend()
+        b.set("leased", b"1", lease=True)
+        b.set("durable", b"2")
+        b.close()
+        assert b.get("leased") is None
+        assert b.get("durable") == b"2"
+
+    def test_lock_path(self):
+        b = LocalBackend()
+        l1 = b.lock_path("x")
+        with pytest.raises(Exception):
+            b.lock_path("x", timeout=0.05)
+        l1.unlock()
+        b.lock_path("x", timeout=0.5).unlock()
+
+    def test_file_backend_persists(self, tmp_path):
+        path = str(tmp_path / "kv.json")
+        b1 = FileBackend(path)
+        b1.set("persist/me", b"hello")
+        b1.set("lease/me", b"bye", lease=True)
+        b1._persist()
+        b2 = FileBackend(path)
+        assert b2.get("persist/me") == b"hello"
+        assert b2.get("lease/me") is None  # leases don't survive restart
+
+
+class TestAllocator:
+    def test_allocate_reuse_and_refcount(self):
+        b = LocalBackend()
+        a = Allocator(b, "test/ids", "node1", min_id=10, max_id=20)
+        id1, new1 = a.allocate("key-a")
+        assert new1 and 10 <= id1 <= 20
+        id2, new2 = a.allocate("key-a")
+        assert id2 == id1 and not new2
+        id3, _ = a.allocate("key-b")
+        assert id3 != id1
+        # release: refcount 2 -> 1 keeps the value key
+        assert a.release("key-a")
+        assert b.list_prefix(a._value_prefix("key-a") + "/")
+        assert a.release("key-a")
+        assert not b.list_prefix(a._value_prefix("key-a") + "/")
+
+    def test_cross_node_convergence(self):
+        b = LocalBackend()
+        a1 = Allocator(b, "test/ids", "node1", min_id=10, max_id=1000)
+        a2 = Allocator(b, "test/ids", "node2", min_id=10, max_id=1000)
+        id1, new1 = a1.allocate("shared-key")
+        id2, new2 = a2.allocate("shared-key")
+        assert id1 == id2
+        assert new1 and not new2
+
+    def test_gc_removes_unreferenced(self):
+        b = LocalBackend()
+        a = Allocator(b, "test/ids", "node1", min_id=10, max_id=20)
+        id1, _ = a.allocate("k")
+        a.release("k")
+        assert a.run_gc() == 1
+        assert b.get(a._id_path(id1)) is None
+        # ID is reusable again
+        id2, _ = a.allocate("k2")
+        a.release("k2")
+
+    def test_exhaustion(self):
+        b = LocalBackend()
+        a = Allocator(b, "test/ids", "n", min_id=1, max_id=2)
+        a.allocate("x")
+        a.allocate("y")
+        with pytest.raises(AllocatorError):
+            a.allocate("z")
+
+    def test_watch_updates_cache(self):
+        b = LocalBackend()
+        a1 = Allocator(b, "test/ids", "node1", min_id=10, max_id=99)
+        a1.start_watch()
+        a2 = Allocator(b, "test/ids", "node2", min_id=10, max_id=99)
+        id_, _ = a2.allocate("remote-key")
+        assert wait_for(lambda: a1.get_by_id(id_) == "remote-key")
+
+    def test_restart_syncs_existing(self):
+        b = LocalBackend()
+        a1 = Allocator(b, "test/ids", "node1", min_id=10, max_id=99)
+        id_, _ = a1.allocate("persisted")
+        a3 = Allocator(b, "test/ids", "node1-restarted", min_id=10, max_id=99)
+        assert a3.get_by_id(id_) == "persisted"
+        # restarted node reuses, not reallocates
+        id2, new = a3.allocate("persisted")
+        assert id2 == id_ and not new
+
+
+class TestIdentity:
+    def test_reserved(self):
+        assert ReservedIdentities["host"].id == RESERVED_HOST
+        assert look_up_reserved_identity(RESERVED_WORLD).labels.get_model() == [
+            "reserved:world"
+        ]
+
+    def test_allocate_reserved_labels(self):
+        alloc = IdentityAllocator(backend=LocalBackend())
+        lbls = Labels.from_model(["reserved:host"])
+        ident, new = alloc.allocate(lbls)
+        assert ident.id == RESERVED_HOST and not new
+
+    def test_allocate_user_identity_round_trip(self):
+        b = LocalBackend()
+        alloc = IdentityAllocator(backend=b)
+        lbls = Labels.from_model(["k8s:app=web", "k8s:env=prod"])
+        ident, new = alloc.allocate(lbls)
+        assert new and ident.id >= MIN_USER_IDENTITY
+        # same labels, same identity
+        ident2, new2 = alloc.allocate(lbls)
+        assert ident2.id == ident.id and not new2
+        # lookup by id recovers the labels
+        got = alloc.lookup_by_id(ident.id)
+        assert got is not None and got.labels.equals(lbls)
+        assert alloc.lookup(lbls).id == ident.id
+        # cache includes reserved + allocated
+        cache = alloc.get_identity_cache()
+        assert RESERVED_HOST in cache and ident.id in cache
+
+    def test_cross_node_identity(self):
+        b = LocalBackend()
+        a1 = IdentityAllocator(backend=b, node_name="n1")
+        a2 = IdentityAllocator(backend=b, node_name="n2")
+        lbls = Labels.from_model(["k8s:app=db"])
+        i1, _ = a1.allocate(lbls)
+        i2, _ = a2.allocate(lbls)
+        assert i1.id == i2.id
+
+    def test_owner_notified_on_remote_change(self):
+        b = LocalBackend()
+        notified = []
+        a1 = IdentityAllocator(backend=b, node_name="n1",
+                               owner_notify=lambda: notified.append(1))
+        a2 = IdentityAllocator(backend=b, node_name="n2")
+        a2.allocate(Labels.from_model(["k8s:app=x"]))
+        assert wait_for(lambda: len(notified) > 0)
+
+
+class TestIPCache:
+    def test_upsert_delete_listeners(self):
+        c = IPIdentityCache()
+        events = []
+        c.add_listener(lambda e, ip, p: events.append((e, ip)))
+        assert c.upsert("10.0.0.1", 100)
+        assert not c.upsert("10.0.0.1", 100)  # unchanged
+        assert c.upsert("10.0.0.1", 200)  # identity change
+        assert c.lookup_by_ip("10.0.0.1") == 200
+        assert c.lookup_by_identity(200) == ["10.0.0.1"]
+        assert c.delete("10.0.0.1")
+        assert not c.delete("10.0.0.1")
+        assert events == [
+            ("upsert", "10.0.0.1"), ("upsert", "10.0.0.1"),
+            ("delete", "10.0.0.1"),
+        ]
+
+    def test_listener_replays_existing(self):
+        c = IPIdentityCache()
+        c.upsert("10.0.0.2", 7)
+        seen = []
+        c.add_listener(lambda e, ip, p: seen.append((e, ip, p.identity)))
+        assert seen == [("upsert", "10.0.0.2", 7)]
+
+    def test_datapath_listener_mirrors_map(self):
+        c = IPIdentityCache()
+        m = IpcacheMap()
+        c.add_listener(datapath_listener(m))
+        c.upsert("10.0.0.3", 55)
+        assert m.lookup("10.0.0.3").sec_label == 55
+        c.delete("10.0.0.3")
+        assert m.lookup("10.0.0.3") is None
+
+    def test_kvstore_sync_two_nodes(self):
+        b = LocalBackend()
+        c1 = IPIdentityCache()
+        c2 = IPIdentityCache()
+        s1 = KvstoreIPSync(c1, backend=b)
+        s2 = KvstoreIPSync(c2, backend=b)
+        s2.start_watcher()
+        s1.upsert_to_kvstore(IPIdentityPair("10.1.0.1", 321))
+        assert wait_for(lambda: c2.lookup_by_ip("10.1.0.1") == 321)
+        s1.delete_from_kvstore("10.1.0.1")
+        assert wait_for(lambda: c2.lookup_by_ip("10.1.0.1") is None)
+        s2.stop()
+
+
+class TestSharedStoreAndNodes:
+    def test_store_sync(self):
+        b = LocalBackend()
+        seen = {}
+        s1 = SharedStore(b, "test/store", "n1")
+        s2 = SharedStore(b, "test/store", "n2",
+                         on_update=lambda n, v: seen.update({n: v}))
+        s1.update_local_key_sync("n1", {"x": 1})
+        assert wait_for(lambda: s2.get("n1") == {"x": 1})
+        assert seen["n1"] == {"x": 1}
+        s1.delete_local_key("n1")
+        assert wait_for(lambda: s2.get("n1") is None)
+
+    def test_node_discovery(self):
+        b = LocalBackend()
+        n1 = NodeDiscovery(Node(name="node1", ipv4_address="192.168.0.1",
+                                ipv4_alloc_cidr="10.1.0.0/16"), backend=b)
+        updates = []
+        n2 = NodeDiscovery(Node(name="node2", ipv4_address="192.168.0.2"),
+                           backend=b, on_node_update=lambda n: updates.append(n.name))
+        assert wait_for(lambda: "default/node1" in n2.get_nodes())
+        got = n2.get_nodes()["default/node1"]
+        assert got.ipv4_alloc_cidr == "10.1.0.0/16"
+        # local update propagates
+        n1.update_local(ipv4_health_ip="10.1.0.4")
+        assert wait_for(
+            lambda: n2.get_nodes()["default/node1"].ipv4_health_ip == "10.1.0.4"
+        )
+        n1.close()
+        assert wait_for(lambda: "default/node1" not in n2.get_nodes())
+        n2.close()
