@@ -92,14 +92,31 @@ def _labels_key(lbls: Labels) -> str:
     return lbls.sorted_list().decode()
 
 
+def _is_label_part(part: str) -> bool:
+    """A serialized label is ``source:key=value`` — ':' before '='."""
+    c = part.find(":")
+    e = part.find("=")
+    return c > 0 and e > c
+
+
 def _key_labels(key: str) -> Labels:
     out = Labels()
+    last: Label | None = None
     for part in key.split(";"):
         if not part:
             continue
+        if not _is_label_part(part):
+            # Fragment of a value that itself contained ';' — re-join onto
+            # the previous label rather than crashing the watch thread.
+            if last is not None:
+                last = Label(key=last.key, value=last.value + ";" + part,
+                             source=last.source)
+                out.upsert(last)
+            continue
         src, rest = part.split(":", 1)
-        k, v = rest.split("=", 1) if "=" in rest else (rest, "")
-        out.upsert(Label(key=k, value=v, source=src))
+        k, v = rest.split("=", 1)
+        last = Label(key=k, value=v, source=src)
+        out.upsert(last)
     return out
 
 

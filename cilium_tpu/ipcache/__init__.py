@@ -58,6 +58,8 @@ class IPIdentityCache:
                host_ip: str = "") -> bool:
         """reference: ipcache.go:217 Upsert; returns False if unchanged."""
         pair = IPIdentityPair(ip, identity, tunnel_endpoint, host_ip)
+        # Notification happens under the mutex so listener (datapath map)
+        # update order always matches cache mutation order.
         with self._mutex:
             old = self._cache.get(ip)
             if (old is not None and old.identity == identity
@@ -65,19 +67,17 @@ class IPIdentityCache:
                     and old.host_ip == host_ip):
                 return False
             self._cache[ip] = pair
-            listeners = list(self._listeners)
-        for l in listeners:
-            l("upsert", ip, pair)
+            for l in self._listeners:
+                l("upsert", ip, pair)
         return True
 
     def delete(self, ip: str) -> bool:
         with self._mutex:
             pair = self._cache.pop(ip, None)
-            listeners = list(self._listeners)
-        if pair is None:
-            return False
-        for l in listeners:
-            l("delete", ip, None)
+            if pair is None:
+                return False
+            for l in self._listeners:
+                l("delete", ip, None)
         return True
 
     def lookup_by_ip(self, ip: str) -> Optional[int]:
@@ -134,11 +134,15 @@ class KvstoreIPSync:
         )
         self._watcher = w
 
+        prefix = f"{IP_IDENTITIES_PATH}/{self.cache.cluster}/"
+
         def run() -> None:
             for ev in w:
                 if ev.typ == EventType.LIST_DONE:
                     continue
-                ip = ev.key.rsplit("/", 1)[1]
+                # Strip the watch prefix, not rsplit: the ip may itself be a
+                # CIDR prefix containing '/'.
+                ip = ev.key[len(prefix):]
                 if ev.typ == EventType.DELETE:
                     self.cache.delete(ip)
                 else:

@@ -200,8 +200,19 @@ class Allocator:
             entry[1] -= 1
             if entry[1] > 0:
                 return True
-            del self._local[key]
-        self.backend.delete(self._value_path(key))
+        # Zero references: serialize the value-ref delete against
+        # allocate() on the same key so we can't destroy a reference a
+        # concurrent allocate just re-created.
+        lock = self.backend.lock_path(f"{self.base_path}/locks/{key}")
+        try:
+            with self._mutex:
+                entry = self._local.get(key)
+                if entry is None or entry[1] > 0:
+                    return True  # re-acquired while we waited
+                del self._local[key]
+            self.backend.delete(self._value_path(key))
+        finally:
+            lock.unlock()
         return True
 
     def run_gc(self) -> int:
@@ -261,7 +272,10 @@ class Allocator:
                         self.cache[id_] = key
                         self.id_pool.remove(id_)
                 if self.events:
-                    self.events(AllocatorEvent(ev.typ, id_, key))
+                    try:
+                        self.events(AllocatorEvent(ev.typ, id_, key))
+                    except Exception:  # noqa: BLE001 — a bad callback must
+                        pass  # not kill the watch loop
 
         t = threading.Thread(target=run, name="allocator-watch", daemon=True)
         t.start()

@@ -37,10 +37,13 @@ class KeyValueEvent:
 class Watcher:
     """Prefix watcher with an event queue (reference: kvstore.Watcher)."""
 
-    def __init__(self, name: str, prefix: str, chan_size: int = 128) -> None:
+    def __init__(self, name: str, prefix: str, chan_size: int = 0) -> None:
+        # Unbounded: the snapshot replay in list_and_watch runs under the
+        # backend mutex before any consumer exists, so a bounded queue
+        # would deadlock the whole backend on large prefixes.
         self.name = name
         self.prefix = prefix
-        self.events: "queue.Queue[KeyValueEvent]" = queue.Queue(maxsize=chan_size)
+        self.events: "queue.Queue[KeyValueEvent]" = queue.Queue(maxsize=0)
         self._stopped = False
 
     def stop(self) -> None:
@@ -111,11 +114,13 @@ class Backend(abc.ABC):
         return CAP_CREATE_IF_EXISTS
 
     def encode(self, data: bytes) -> str:
+        # URL-safe: standard base64 contains '/', which would let one
+        # encoded key alias another's '/'-delimited kvstore subtree.
         import base64
 
-        return base64.b64encode(data).decode()
+        return base64.urlsafe_b64encode(data).decode()
 
     def decode(self, s: str) -> bytes:
         import base64
 
-        return base64.b64decode(s)
+        return base64.urlsafe_b64decode(s)

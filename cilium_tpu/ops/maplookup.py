@@ -58,7 +58,8 @@ def pack_table(
     pv = np.zeros((size, values.shape[1] if values.ndim == 2 else 1), np.int32)
     valid = np.zeros((size,), dtype=bool)
     pk[:n] = keys
-    pv[:n] = values.reshape(n, -1)
+    if n:
+        pv[:n] = values.reshape(n, -1)
     valid[:n] = True
     return DeviceTable(
         cols=tuple(jnp.asarray(pk[:, i]) for i in range(k)),

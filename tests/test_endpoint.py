@@ -1,0 +1,356 @@
+"""Endpoint lifecycle tests: state machine, regeneration pipeline, policy
+map sync, redirects, restore, manager, build queue.
+
+Modeled on the reference's endpoint + daemon policy tests (reference:
+pkg/endpoint tests, daemon/policy_test.go:481 — rules in, expected
+per-identity policy map entries out).
+"""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.endpoint import (
+    BuildQueue,
+    Endpoint,
+    EndpointManager,
+    EndpointState,
+)
+from cilium_tpu.endpoint.endpoint import LOCALHOST_KEY
+from cilium_tpu.identity import Identity, RESERVED_HOST
+from cilium_tpu.labels import Labels
+from cilium_tpu.maps.policymap import DIR_EGRESS, DIR_INGRESS, PolicyKey
+from cilium_tpu.policy import (
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleL7,
+    Repository,
+    Rule,
+    set_policy_enabled,
+)
+from cilium_tpu.proxy import ProxyManager
+from cilium_tpu.utils.option import config as global_config
+from cilium_tpu.labels import parse_select_label
+
+
+def sel(*lbls):
+    return EndpointSelector.from_labels(*(parse_select_label(l) for l in lbls))
+
+
+class FakeOwner:
+    def __init__(self):
+        self.repo = Repository()
+        self.identity_cache = {}
+        self.proxy = ProxyManager()
+
+    def get_policy_repository(self):
+        return self.repo
+
+    def get_identity_cache(self):
+        return dict(self.identity_cache)
+
+    def get_proxy_manager(self):
+        return self.proxy
+
+
+@pytest.fixture(autouse=True)
+def _default_enforcement():
+    set_policy_enabled("default")
+    global_config.allow_localhost = "auto"
+    global_config.host_allows_world = False
+    yield
+    set_policy_enabled("default")
+
+
+def make_endpoint(ep_id=100, identity_id=1000, labels=("k8s:app=server",)):
+    ep = Endpoint(ep_id, ipv4="10.0.0.10")
+    ep.set_identity(Identity(id=identity_id, labels=Labels.from_model(labels)))
+    ep.state = EndpointState.WAITING_TO_REGENERATE
+    return ep
+
+
+class TestStateMachine:
+    def test_valid_lifecycle(self):
+        ep = Endpoint(1)
+        assert ep.set_state(EndpointState.WAITING_FOR_IDENTITY)
+        assert ep.set_state(EndpointState.READY)
+        assert ep.set_state(EndpointState.WAITING_TO_REGENERATE)
+        assert ep.set_state(EndpointState.REGENERATING)
+        assert ep.set_state(EndpointState.READY)
+        assert ep.set_state(EndpointState.DISCONNECTING)
+        assert ep.set_state(EndpointState.DISCONNECTED)
+
+    def test_invalid_transitions_rejected(self):
+        ep = Endpoint(1)
+        assert not ep.set_state(EndpointState.READY)  # creating -> ready
+        ep.state = EndpointState.DISCONNECTED
+        assert not ep.set_state(EndpointState.READY)
+        assert not ep.set_state(EndpointState.DISCONNECTED)  # same state
+
+
+class TestRegeneration:
+    def test_l3_l4_map_entries(self):
+        owner = FakeOwner()
+        server_lbls = Labels.from_model(["k8s:app=server"])
+        client_lbls = Labels.from_model(["k8s:app=client"])
+        owner.identity_cache = {1000: server_lbls, 2000: client_lbls}
+        # L4 rule: client -> server on 80/TCP; plus L3-only from client.
+        owner.repo.add(
+            Rule(
+                endpoint_selector=sel("app=server"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[sel("app=client")],
+                        to_ports=[
+                            PortRule(ports=[PortProtocol("80", "TCP")])
+                        ],
+                    )
+                ],
+            )
+        )
+        ep = make_endpoint()
+        assert ep.regenerate(owner)
+        assert ep.state == EndpointState.READY
+        # desired state contains the L4 key for the client identity
+        assert PolicyKey(2000, 80, 6, DIR_INGRESS) in ep.desired_map_state
+        # no entry for the server identity itself (rule doesn't allow it)
+        assert PolicyKey(1000, 80, 6, DIR_INGRESS) not in ep.desired_map_state
+        # egress not enforced (no egress rules select the ep) -> allow-all
+        # entries for all identities
+        assert PolicyKey(2000, 0, 0, DIR_EGRESS) in ep.desired_map_state
+        # realized matches desired after sync
+        assert set(ep.realized_map_state) == set(ep.desired_map_state)
+        # the host policy map answers the datapath question
+        allowed, port = ep.policy_map.lookup(2000, 80, 6, DIR_INGRESS)
+        assert allowed and port == 0
+        allowed, _ = ep.policy_map.lookup(3000, 80, 6, DIR_INGRESS)
+        assert not allowed
+        # device export present
+        assert ep.device_policy_map is not None
+
+    def test_redirect_allocates_proxy_port(self):
+        owner = FakeOwner()
+        owner.identity_cache = {
+            1000: Labels.from_model(["k8s:app=server"]),
+            2000: Labels.from_model(["k8s:app=client"]),
+        }
+        owner.repo.add(
+            Rule(
+                endpoint_selector=sel("app=server"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[sel("app=client")],
+                        to_ports=[
+                            PortRule(
+                                ports=[PortProtocol("80", "TCP")],
+                                rules=L7Rules(
+                                    l7proto="r2d2",
+                                    l7=[PortRuleL7({"cmd": "READ"})],
+                                ),
+                            )
+                        ],
+                    )
+                ],
+            )
+        )
+        ep = make_endpoint()
+        assert ep.regenerate(owner)
+        key = PolicyKey(2000, 80, 6, DIR_INGRESS)
+        assert key in ep.desired_map_state
+        port = ep.desired_map_state[key].proxy_port
+        assert 10000 <= port < 20000
+        # redirect registered under the endpoint's proxy ID
+        pid = f"{ep.id}:ingress:TCP:80"
+        assert owner.proxy.get(pid).proxy_port == port
+        # localhost allowed because a redirect exists (policy.go:262)
+        assert LOCALHOST_KEY in ep.desired_map_state
+        # datapath lookup returns the proxy port
+        allowed, got = ep.policy_map.lookup(2000, 80, 6, DIR_INGRESS)
+        assert allowed and got == port
+        # second regeneration reuses the same port
+        ep.force_policy_compute = True
+        ep.set_state(EndpointState.WAITING_TO_REGENERATE)
+        assert ep.regenerate(owner)
+        assert ep.desired_map_state[key].proxy_port == port
+
+    def test_redirect_removed_when_rule_deleted(self):
+        owner = FakeOwner()
+        owner.identity_cache = {
+            1000: Labels.from_model(["k8s:app=server"]),
+        }
+        from cilium_tpu.labels import LabelArray
+
+        owner.repo.add(
+            Rule(
+                endpoint_selector=sel("app=server"),
+                labels=LabelArray.parse("rule=l7"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[sel("app=server")],
+                        to_ports=[
+                            PortRule(
+                                ports=[PortProtocol("80", "TCP")],
+                                rules=L7Rules(
+                                    l7proto="r2d2",
+                                    l7=[PortRuleL7({"cmd": "READ"})],
+                                ),
+                            )
+                        ],
+                    )
+                ],
+            )
+        )
+        ep = make_endpoint()
+        assert ep.regenerate(owner)
+        pid = f"{ep.id}:ingress:TCP:80"
+        assert owner.proxy.get(pid) is not None
+        owner.repo.delete_by_labels(LabelArray.parse("rule=l7"))
+        ep.set_state(EndpointState.WAITING_TO_REGENERATE)
+        assert ep.regenerate(owner)
+        assert owner.proxy.get(pid) is None
+        assert pid not in ep.realized_redirects
+
+    def test_enforcement_modes(self):
+        owner = FakeOwner()
+        owner.identity_cache = {2000: Labels.from_model(["k8s:app=client"])}
+        ep = make_endpoint()
+        # never: no enforcement, allow-all entries both directions
+        set_policy_enabled("never")
+        assert ep.regenerate(owner)
+        assert PolicyKey(2000, 0, 0, DIR_INGRESS) in ep.desired_map_state
+        assert PolicyKey(2000, 0, 0, DIR_EGRESS) in ep.desired_map_state
+        # always: enforcement with no rules -> no L3 allows
+        set_policy_enabled("always")
+        ep.force_policy_compute = True
+        ep.set_state(EndpointState.WAITING_TO_REGENERATE)
+        assert ep.regenerate(owner)
+        assert PolicyKey(2000, 0, 0, DIR_INGRESS) not in ep.desired_map_state
+
+    def test_revision_skip(self):
+        owner = FakeOwner()
+        owner.identity_cache = {1000: Labels.from_model(["k8s:app=server"])}
+        ep = make_endpoint()
+        assert ep.regenerate_policy(owner)
+        # same revision, same identity cache: skipped
+        assert not ep.regenerate_policy(owner)
+        owner.repo.bump_revision()
+        assert ep.regenerate_policy(owner)
+        # identity cache change forces recompute
+        owner.identity_cache[2000] = Labels.from_model(["k8s:app=client"])
+        assert ep.regenerate_policy(owner)
+
+    def test_sync_deletes_stale_keys(self):
+        owner = FakeOwner()
+        owner.identity_cache = {2000: Labels.from_model(["k8s:app=client"])}
+        set_policy_enabled("never")
+        ep = make_endpoint()
+        assert ep.regenerate(owner)
+        assert ep.policy_map.exists(2000, 0, 0, DIR_INGRESS)
+        # drop the identity: its keys must be deleted on next sync
+        owner.identity_cache = {}
+        ep.force_policy_compute = True
+        ep.set_state(EndpointState.WAITING_TO_REGENERATE)
+        assert ep.regenerate(owner)
+        assert not ep.policy_map.exists(2000, 0, 0, DIR_INGRESS)
+
+
+class TestRestore:
+    def test_round_trip(self, tmp_path):
+        ep = make_endpoint(ep_id=42)
+        ep.policy_revision = 7
+        path = ep.write_state(str(tmp_path))
+        assert path.endswith("42/ep_config.json")
+        restored = Endpoint.restore_from_dir(str(tmp_path))
+        assert len(restored) == 1
+        r = restored[0]
+        assert r.id == 42
+        assert r.ipv4 == "10.0.0.10"
+        assert r.security_identity.id == 1000
+        assert r.policy_revision == 7
+        assert r.state == EndpointState.RESTORING
+
+    def test_corrupt_state_skipped(self, tmp_path):
+        d = tmp_path / "13"
+        d.mkdir()
+        (d / "ep_config.json").write_text("{nope")
+        ep = make_endpoint(ep_id=14)
+        ep.write_state(str(tmp_path))
+        restored = Endpoint.restore_from_dir(str(tmp_path))
+        assert [e.id for e in restored] == [14]
+
+
+class TestManager:
+    def test_indexes(self):
+        mgr = EndpointManager()
+        ep = make_endpoint(ep_id=5)
+        ep.container_name = "web-1"
+        mgr.insert(ep)
+        assert mgr.lookup(5) is ep
+        assert mgr.lookup_container("web-1") is ep
+        assert mgr.lookup_ipv4("10.0.0.10") is ep
+        assert len(mgr) == 1
+        assert mgr.remove(ep)
+        assert mgr.lookup(5) is None
+        assert not mgr.remove(ep)
+
+    def test_trigger_policy_updates(self):
+        mgr = EndpointManager()
+        for i in range(3):
+            e = make_endpoint(ep_id=i + 1)
+            e.ipv4 = f"10.0.0.{i+1}"
+            mgr.insert(e)
+        seen = []
+        assert mgr.trigger_policy_updates(lambda ep: seen.append(ep.id)) == 3
+        assert seen == [1, 2, 3]
+
+
+class TestBuildQueue:
+    def test_builds_run(self):
+        built = []
+        q = BuildQueue(lambda x: built.append(x), workers=2)
+        for i in range(10):
+            q.enqueue(i)
+        assert q.wait_idle(5)
+        assert sorted(built) == list(range(10))
+        q.stop()
+
+    def test_duplicate_folding(self):
+        started = threading.Event()
+        release = threading.Event()
+        built = []
+
+        def build(x):
+            built.append(x)
+            started.set()
+            release.wait(5)
+
+        q = BuildQueue(build, workers=1)
+        q.enqueue("ep1", key="ep1")
+        assert started.wait(2)
+        # while ep1 is building, repeated enqueues fold into one rebuild
+        q.enqueue("ep1", key="ep1")
+        q.enqueue("ep1", key="ep1")
+        q.enqueue("ep1", key="ep1")
+        release.set()
+        assert q.wait_idle(5)
+        assert built == ["ep1", "ep1"]  # initial + one folded rebuild
+        q.stop()
+
+    def test_build_errors_do_not_kill_workers(self):
+        built = []
+
+        def build(x):
+            if x == "bad":
+                raise RuntimeError("boom")
+            built.append(x)
+
+        q = BuildQueue(build, workers=1)
+        q.enqueue("bad")
+        q.enqueue("good")
+        assert q.wait_idle(5)
+        assert built == ["good"]
+        q.stop()
