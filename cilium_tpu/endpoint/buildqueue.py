@@ -75,9 +75,14 @@ class BuildQueue:
                 with self._mutex:
                     self._building.discard(key)
                     requeued = self._requeue_items.pop(key, None)
+                    if requeued is not None:
+                        # Mark pending before releasing the mutex so
+                        # wait_idle can't observe a false idle between
+                        # the pop and the re-enqueue.
+                        self._pending.add(key)
                     self._idle.notify_all()
                 if requeued is not None:
-                    self.enqueue(requeued, key)
+                    self._queue.put((key, requeued))
                 self._queue.task_done()
 
     def wait_idle(self, timeout: float = 10.0) -> bool:

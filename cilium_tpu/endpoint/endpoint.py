@@ -419,7 +419,10 @@ class Endpoint:
         """Full regeneration (reference: policy.go:812 Regenerate +
         :642 regenerate): policy recompute -> redirects -> map sync ->
         device export."""
-        self.set_state(EndpointState.REGENERATING, reason)
+        if not self.set_state(EndpointState.REGENERATING, reason):
+            # Disconnecting/disconnected endpoints must not regenerate:
+            # doing so would recreate redirects torn down by the daemon.
+            return False
         stats = self.stats
         ok = False
         try:
@@ -449,7 +452,9 @@ class Endpoint:
         finally:
             outcome = "success" if ok else "fail"
             EndpointRegenerationCount.inc(outcome)
-            EndpointRegenerationTime.observe(stats.span("policy").total())
+            EndpointRegenerationTime.observe(
+                sum(stats.report().values())
+            )
             self.set_state(
                 EndpointState.READY if ok else EndpointState.NOT_READY,
                 "regeneration " + outcome,

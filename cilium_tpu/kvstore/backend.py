@@ -37,9 +37,9 @@ class KeyValueEvent:
 class Watcher:
     """Prefix watcher with an event queue (reference: kvstore.Watcher)."""
 
-    def __init__(self, name: str, prefix: str, chan_size: int = 0) -> None:
-        # Unbounded: the snapshot replay in list_and_watch runs under the
-        # backend mutex before any consumer exists, so a bounded queue
+    def __init__(self, name: str, prefix: str) -> None:
+        # Unbounded queue: the snapshot replay in list_and_watch runs under
+        # the backend mutex before any consumer exists, so a bounded queue
         # would deadlock the whole backend on large prefixes.
         self.name = name
         self.prefix = prefix
@@ -104,8 +104,7 @@ class Backend(abc.ABC):
     def list_prefix(self, prefix: str) -> dict[str, bytes]: ...
 
     @abc.abstractmethod
-    def list_and_watch(self, name: str, prefix: str,
-                       chan_size: int = 128) -> Watcher: ...
+    def list_and_watch(self, name: str, prefix: str) -> Watcher: ...
 
     @abc.abstractmethod
     def close(self) -> None: ...

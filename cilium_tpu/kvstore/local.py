@@ -150,11 +150,10 @@ class LocalBackend(Backend):
 
     # -- watch -------------------------------------------------------------
 
-    def list_and_watch(self, name: str, prefix: str,
-                       chan_size: int = 128) -> Watcher:
+    def list_and_watch(self, name: str, prefix: str) -> Watcher:
         """reference: backend.go:139 — list current keys as CREATE events,
         then a LIST_DONE marker, then live events."""
-        w = Watcher(name, prefix, chan_size)
+        w = Watcher(name, prefix)
         with self._mutex:
             # Snapshot replay and registration are atomic with mutations so
             # no live event can precede (and be overwritten by) the snapshot.

@@ -1,0 +1,80 @@
+"""Build device verdict models from resolved L4 policy filters.
+
+The bridge from the policy engine's output (L4Filter with per-selector
+L7 rules, reference: pkg/policy/l4.go L7DataMap) to the device models:
+selectors are expanded against the identity cache into allowed-remote
+sets (the same expansion the reference does when pushing NPDS policy to
+proxies, reference: pkg/envoy/server.go:607 getNetworkPolicy), and the
+rules compile into the per-protocol batch model.
+"""
+
+from __future__ import annotations
+
+from ..policy.api import PortRuleHTTP, PortRuleKafka
+from ..policy.l4 import L4Filter, PARSER_TYPE_HTTP, PARSER_TYPE_KAFKA
+from .base import ConstVerdict
+from .http import build_http_model
+from .kafka import build_kafka_model
+
+
+def expand_selector_remotes(sel, identity_cache: dict) -> frozenset | None:
+    """Identities whose labels the selector matches.  None means wildcard
+    (any remote); an empty frozenset means the selector currently matches
+    NO identity — callers must drop such rows, not wildcard them."""
+    if sel.is_wildcard():
+        return None
+    return frozenset(
+        numeric
+        for numeric, lbls in identity_cache.items()
+        if sel.matches(lbls.to_array())
+    )
+
+
+def _remote_rows(sel, identity_cache: dict):
+    """Resolve a selector to the pack_remote_sets convention (empty set =
+    wildcard) or None when the row must be skipped (fail closed: a
+    selector matching no known identity allows nobody)."""
+    remotes = expand_selector_remotes(sel, identity_cache)
+    if remotes is None:
+        return frozenset()  # wildcard
+    if not remotes:
+        return None  # matches nothing: skip
+    return remotes
+
+
+def build_model_for_filter(f: L4Filter, identity_cache: dict):
+    """Compile an L4Filter's L7 rules into a device batch model.
+
+    Returns a model callable or ConstVerdict.  Generic (l7proto) rules are
+    served by the proxylib parser pipeline instead (cilium_tpu.proxylib),
+    mirroring the reference's dispatch (pkg/proxy/proxy.go:229-236).
+    """
+    if f.l7_parser == PARSER_TYPE_HTTP:
+        rows: list[tuple[frozenset, PortRuleHTTP]] = []
+        for sel, l7 in f.l7_rules_per_ep.items():
+            remotes = _remote_rows(sel, identity_cache)
+            if remotes is None:
+                continue
+            if len(l7) == 0:
+                # L3-override wildcard: allow-all row for these remotes
+                # (reference: l4.go:209-227 endpointsWithL3Override).
+                rows.append((remotes, PortRuleHTTP()))
+            for h in l7.http:
+                rows.append((remotes, h))
+        return build_http_model(rows)
+
+    if f.l7_parser == PARSER_TYPE_KAFKA:
+        krows: list[tuple[frozenset, PortRuleKafka]] = []
+        for sel, l7 in f.l7_rules_per_ep.items():
+            remotes = _remote_rows(sel, identity_cache)
+            if remotes is None:
+                continue
+            if len(l7) == 0:
+                wildcard = PortRuleKafka()
+                wildcard.sanitize()
+                krows.append((remotes, wildcard))
+            for k in l7.kafka:
+                krows.append((remotes, k))
+        return build_kafka_model(krows)
+
+    return ConstVerdict(True)  # no L7 restrictions at this layer

@@ -1,0 +1,198 @@
+"""HTTP batch verdict model: request-line + header policy on device.
+
+Replaces the reference's per-request std::regex walk in the Envoy filter
+(reference: envoy/cilium_l7policy.cc:51 + cilium_network_policy.h:50-76
+HttpNetworkPolicyRule: anchored regex on path/method/host, exact header
+presence) and the agent-side rule model (reference:
+pkg/policy/api/http.go:28 PortRuleHTTP) with one device pass:
+
+  1. tokenize the request line ([F, L] uint8): method span = [0, sp1),
+     path span = (sp1, sp2) — pure bytescan, no host round-trip
+  2. anchored NFA match of per-rule method/path regexes on those spans
+  3. host regex + exact header lines matched as CRLF-delimited patterns
+     searched over the whole request head
+  4. a rule allows iff all its present components match; request allowed
+     iff any rule with a matching remote allows.
+
+Deny maps to a 403 response injected by the runtime engine
+(reference: cilium_l7policy.cc 403 body injection).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.bytescan import first_occurrence, first_subsequence2
+from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
+from ..policy.api import PortRuleHTTP
+from ..regex import compile_patterns
+from .base import ConstVerdict, pack_remote_sets, remote_ok
+
+_RE_META = set("\\^$.[]|()*+?{}")
+
+
+def re_escape(s: str) -> str:
+    """Escape a literal for the POSIX-extended regex compiler."""
+    return "".join("\\" + c if c in _RE_META else c for c in s)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class HttpBatchModel:
+    line_nfa: DeviceNfa  # method+path patterns (anchored), 2 per rule
+    head_nfa: DeviceNfa | None  # host/header patterns over the head
+    # Mapping from flattened head patterns to rules:
+    head_rule: jax.Array  # [P] int32 — owning rule row
+    head_count: jax.Array  # [R] int32 — number of head patterns per rule
+    remote_ids: jax.Array  # [R, MAX_REMOTES] int32
+    any_remote: jax.Array  # [R] bool
+    n_rules: int = 0
+
+    def tree_flatten(self):
+        return (
+            (self.line_nfa, self.head_nfa, self.head_rule, self.head_count,
+             self.remote_ids, self.any_remote),
+            (self.n_rules,),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, n_rules=aux[0])
+
+    def __call__(self, data, lengths, remotes):
+        return http_verdicts(self, data, lengths, remotes)
+
+
+def build_http_model(
+    rules_with_remotes: list[tuple[frozenset, PortRuleHTTP]],
+) -> HttpBatchModel | ConstVerdict:
+    """Compile (allowed_remote_set, PortRuleHTTP) rows into device NFAs.
+
+    Empty fields wildcard (reference: http.go — omitted fields allow all).
+    """
+    if not rules_with_remotes:
+        return ConstVerdict(False)
+
+    line_patterns: list[str] = []
+    head_patterns: list[str] = []
+    head_rule: list[int] = []
+    head_count: list[int] = []
+
+    for i, (_, h) in enumerate(rules_with_remotes):
+        # Anchored full matches (Envoy regex_match semantics,
+        # cilium_network_policy.h:50).
+        line_patterns.append(f"^({h.method})$" if h.method else "^.*$")
+        line_patterns.append(f"^({h.path})$" if h.path else "^.*$")
+        n_head = 0
+        if h.host:
+            # Field names are case-insensitive and OWS after ':' is
+            # optional (RFC 9110); match any casing and whitespace run.
+            head_patterns.append(
+                f"\r\n[Hh][Oo][Ss][Tt]:[ \t]*({h.host})[ \t]*\r\n"
+            )
+            head_rule.append(i)
+            n_head += 1
+        for header in h.headers:
+            head_patterns.append("\r\n" + re_escape(header) + "\r\n")
+            head_rule.append(i)
+            n_head += 1
+        head_count.append(n_head)
+
+    r = len(rules_with_remotes)
+    packed_ids, any_remote = pack_remote_sets(
+        [rs for rs, _ in rules_with_remotes]
+    )
+    return HttpBatchModel(
+        line_nfa=device_nfa(compile_patterns(line_patterns)),
+        head_nfa=(
+            device_nfa(compile_patterns(head_patterns))
+            if head_patterns
+            else None
+        ),
+        head_rule=jnp.asarray(np.asarray(head_rule, np.int32).reshape(-1)),
+        head_count=jnp.asarray(np.asarray(head_count, np.int32)),
+        remote_ids=jnp.asarray(packed_ids),
+        any_remote=jnp.asarray(any_remote),
+        n_rules=r,
+    )
+
+
+def _first_occurrence_after(data, start, end, byte):
+    """First ``byte`` at position > start and < end, else end."""
+    f, l = data.shape
+    pos = jnp.arange(l, dtype=jnp.int32)[None, :]
+    valid = (pos > start[:, None]) & (pos < end[:, None])
+    hit = (data == jnp.uint8(byte)) & valid
+    return jnp.min(jnp.where(hit, pos, end[:, None]), axis=1)
+
+
+@jax.jit
+def http_verdicts(
+    model: HttpBatchModel,
+    data: jax.Array,  # [F, L] uint8 — complete request heads
+    lengths: jax.Array,  # [F] int32 — head length incl. final CRLFCRLF
+    remotes: jax.Array,  # [F] int32
+):
+    """Returns (complete [F] bool, head_len [F] int32, allow [F] bool)."""
+    lengths = jnp.asarray(lengths, jnp.int32)
+    remotes = jnp.asarray(remotes, jnp.int32)
+
+    # Head completeness: first CRLFCRLF.
+    crlf2 = _first_crlfcrlf(data, lengths)
+    complete = crlf2 < lengths
+    head_len = crlf2 + 4
+
+    # Request line tokenize.
+    line_end = first_subsequence2(data, lengths, 0x0D, 0x0A)  # [F]
+    sp1 = first_occurrence(data, line_end, 0x20)
+    sp2 = _first_occurrence_after(data, sp1, line_end, 0x20)
+
+    # Anchored method/path matches: [F, 2R].
+    m_hits = nfa_search_spans(model.line_nfa, data, jnp.zeros_like(sp1), sp1)
+    p_hits = nfa_search_spans(model.line_nfa, data, sp1 + 1, sp2)
+    r = model.n_rules
+    idx = jnp.arange(r)
+    method_ok = m_hits[:, idx * 2]
+    path_ok = p_hits[:, idx * 2 + 1]
+
+    # Host/header patterns searched over the head region starting at the
+    # request line's CRLF (so every header line is CRLF-framed).
+    if model.head_nfa is not None:
+        h_hits = nfa_search_spans(
+            model.head_nfa, data, line_end, head_len - 2
+        )  # [F, P]
+        # all-of per rule: count matches per rule == head_count
+        per_rule = jnp.zeros((h_hits.shape[0], r), jnp.int32)
+        per_rule = per_rule.at[:, model.head_rule].add(
+            h_hits.astype(jnp.int32)
+        )
+        head_ok = per_rule >= model.head_count[None, :]
+    else:
+        head_ok = jnp.ones((data.shape[0], r), bool)
+
+    rok = remote_ok(remotes, model.remote_ids, model.any_remote)
+    allow = jnp.any(method_ok & path_ok & head_ok & rok, axis=1)
+    return complete, head_len, allow & complete
+
+
+def _first_crlfcrlf(data: jax.Array, lengths: jax.Array) -> jax.Array:
+    f, l = data.shape
+    pos = jnp.arange(l, dtype=jnp.int32)[None, :]
+
+    def shifted(k):
+        return jnp.concatenate(
+            [data[:, k:], jnp.zeros((f, k), dtype=data.dtype)], axis=1
+        )
+
+    hit = (
+        (data == 0x0D)
+        & (shifted(1) == 0x0A)
+        & (shifted(2) == 0x0D)
+        & (shifted(3) == 0x0A)
+        & ((pos + 3) < lengths[:, None])
+    )
+    return jnp.min(jnp.where(hit, pos, lengths[:, None]), axis=1)

@@ -1,0 +1,278 @@
+"""Kafka batch verdict model: topic ACLs as one device pass.
+
+Replaces the reference's per-request rule walk (reference:
+pkg/kafka/policy.go:200 MatchesRule over []PortRuleKafka) with a batched
+evaluation over [F] parsed request headers and [F, T] topic lists:
+
+  base[f, r]   = api-key-mask ∧ version ∧ clientID/nil-request handling
+  simple[f]    = ∃r: (rule topic empty ∨ no topics) ∧ base
+  cover[f, t]  = ∃r: rule topic == topic[f, t] ∧ base
+  allowed[f]   = simple ∨ (topics present ∧ ∀t cover)
+
+Requests are parsed host-side (cilium_tpu.kafka.request — the wire format
+is variable-length and branchy, poor fit for the MXU) into fixed-shape
+tensors; all rule matching runs on device.  Bit-identical to the host
+oracle (cilium_tpu.kafka.policy.matches_rule), fuzz-checked in tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kafka.request import (
+    FIND_COORDINATOR_KEY,
+    PARSED_TOPIC_KEYS,
+    RequestMessage,
+    TOPIC_API_KEYS,
+)
+from ..policy.api import PortRuleKafka
+from .base import ConstVerdict, pack_remote_sets, remote_ok
+
+MAX_API_KEY = 64
+MAX_TOPICS = 8  # topics per request tensor; overflowing requests are
+# flagged and must be decided by the host oracle (fail closed on device)
+MAX_TOPIC_LEN = 256  # Kafka topics are <= 249 chars (api/kafka.go:238)
+MAX_CLIENT_LEN = 64
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class KafkaBatchModel:
+    api_key_mask: jax.Array  # [R, MAX_API_KEY] bool
+    version: jax.Array  # [R] int32
+    version_any: jax.Array  # [R] bool
+    client: jax.Array  # [R, MAX_CLIENT_LEN] uint8
+    client_len: jax.Array  # [R] int32
+    client_any: jax.Array  # [R] bool
+    topic: jax.Array  # [R, MAX_TOPIC_LEN] uint8
+    topic_len: jax.Array  # [R] int32
+    topic_any: jax.Array  # [R] bool
+    is_topic_key: jax.Array  # [MAX_API_KEY] bool
+    remote_ids: jax.Array  # [R, MAX_REMOTES] int32
+    any_remote: jax.Array  # [R] bool
+
+    def tree_flatten(self):
+        return (
+            (self.api_key_mask, self.version, self.version_any, self.client,
+             self.client_len, self.client_any, self.topic, self.topic_len,
+             self.topic_any, self.is_topic_key, self.remote_ids,
+             self.any_remote),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+    def __call__(self, batch, remotes):
+        return kafka_verdicts(self, batch, remotes)
+
+
+def _pad_bytes(s: str, width: int) -> tuple[np.ndarray, int]:
+    b = s.encode()[:width]
+    out = np.zeros((width,), np.uint8)
+    out[: len(b)] = np.frombuffer(b, np.uint8)
+    return out, len(b)
+
+
+def build_kafka_model(
+    rules_with_remotes: list[tuple[frozenset, PortRuleKafka]],
+) -> KafkaBatchModel | ConstVerdict:
+    """Compile (allowed_remote_set, rule) rows into device arrays.  Rules
+    must be sanitized (role expansion done, reference:
+    api/kafka.go Sanitize)."""
+    if not rules_with_remotes:
+        return ConstVerdict(False)
+    n = len(rules_with_remotes)
+    api_key_mask = np.zeros((n, MAX_API_KEY), bool)
+    version = np.zeros((n,), np.int32)
+    version_any = np.zeros((n,), bool)
+    client = np.zeros((n, MAX_CLIENT_LEN), np.uint8)
+    client_len = np.zeros((n,), np.int32)
+    client_any = np.zeros((n,), bool)
+    topic = np.zeros((n, MAX_TOPIC_LEN), np.uint8)
+    topic_len = np.zeros((n,), np.int32)
+    topic_any = np.zeros((n,), bool)
+
+    for i, (_, r) in enumerate(rules_with_remotes):
+        if len(r.topic.encode()) > MAX_TOPIC_LEN:
+            raise ValueError(f"rule topic exceeds {MAX_TOPIC_LEN} bytes")
+        if len(r.client_id.encode()) > MAX_CLIENT_LEN:
+            raise ValueError(f"rule clientID exceeds {MAX_CLIENT_LEN} bytes")
+        if r.api_keys_int:
+            for k in r.api_keys_int:
+                if 0 <= k < MAX_API_KEY:
+                    api_key_mask[i, k] = True
+        else:
+            api_key_mask[i, :] = True  # wildcard (CheckAPIKeyRole)
+        v, wildcard = r.get_api_version()
+        version[i] = v
+        version_any[i] = wildcard
+        client[i], client_len[i] = _pad_bytes(r.client_id, MAX_CLIENT_LEN)
+        client_any[i] = r.client_id == ""
+        topic[i], topic_len[i] = _pad_bytes(r.topic, MAX_TOPIC_LEN)
+        topic_any[i] = r.topic == ""
+
+    is_topic_key = np.zeros((MAX_API_KEY,), bool)
+    for k in TOPIC_API_KEYS:
+        if k < MAX_API_KEY:
+            is_topic_key[k] = True
+
+    packed_ids, any_remote = pack_remote_sets(
+        [rs for rs, _ in rules_with_remotes]
+    )
+    return KafkaBatchModel(
+        api_key_mask=jnp.asarray(api_key_mask),
+        version=jnp.asarray(version),
+        version_any=jnp.asarray(version_any),
+        client=jnp.asarray(client),
+        client_len=jnp.asarray(client_len),
+        client_any=jnp.asarray(client_any),
+        topic=jnp.asarray(topic),
+        topic_len=jnp.asarray(topic_len),
+        topic_any=jnp.asarray(topic_any),
+        is_topic_key=jnp.asarray(is_topic_key),
+        remote_ids=jnp.asarray(packed_ids),
+        any_remote=jnp.asarray(any_remote),
+    )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class KafkaRequestBatch:
+    """Fixed-shape encoding of F parsed requests."""
+
+    api_key: np.ndarray  # [F] int32
+    api_version: np.ndarray  # [F] int32
+    client: np.ndarray  # [F, MAX_CLIENT_LEN] uint8
+    client_len: np.ndarray  # [F] int32
+    topics: np.ndarray  # [F, MAX_TOPICS, MAX_TOPIC_LEN] uint8
+    topic_len: np.ndarray  # [F, MAX_TOPICS] int32
+    topic_count: np.ndarray  # [F] int32
+    parsed: np.ndarray  # [F] bool
+    overflow: np.ndarray  # [F] bool — exceeds tensor limits; host decides
+
+    def tree_flatten(self):
+        return (
+            (self.api_key, self.api_version, self.client, self.client_len,
+             self.topics, self.topic_len, self.topic_count, self.parsed,
+             self.overflow),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+def encode_requests(reqs: list[RequestMessage]) -> KafkaRequestBatch:
+    """Host-side tensorization of parsed requests; deduplicates topics
+    (MatchesRule's map semantics — reference: policy.go:204-208).
+    Requests exceeding the tensor limits are flagged ``overflow``: the
+    device denies them and the caller re-evaluates with the host oracle
+    (cilium_tpu.kafka.policy.matches_rule) — never a silent truncation."""
+    f = len(reqs)
+    batch = KafkaRequestBatch(
+        api_key=np.zeros((f,), np.int32),
+        api_version=np.zeros((f,), np.int32),
+        client=np.zeros((f, MAX_CLIENT_LEN), np.uint8),
+        client_len=np.zeros((f,), np.int32),
+        topics=np.zeros((f, MAX_TOPICS, MAX_TOPIC_LEN), np.uint8),
+        topic_len=np.zeros((f, MAX_TOPICS), np.int32),
+        topic_count=np.zeros((f,), np.int32),
+        parsed=np.zeros((f,), bool),
+        overflow=np.zeros((f,), bool),
+    )
+    for i, r in enumerate(reqs):
+        batch.api_key[i] = r.api_key
+        batch.api_version[i] = r.api_version
+        distinct = list(dict.fromkeys(r.get_topics()))
+        if (len(distinct) > MAX_TOPICS
+                or len(r.client_id.encode()) > MAX_CLIENT_LEN
+                or any(len(t.encode()) > MAX_TOPIC_LEN for t in distinct)):
+            batch.overflow[i] = True
+            continue
+        batch.client[i], batch.client_len[i] = _pad_bytes(
+            r.client_id, MAX_CLIENT_LEN
+        )
+        batch.topic_count[i] = len(distinct)
+        for t, name in enumerate(distinct):
+            batch.topics[i, t], batch.topic_len[i, t] = _pad_bytes(
+                name, MAX_TOPIC_LEN
+            )
+        batch.parsed[i] = r.parsed and r.api_key in PARSED_TOPIC_KEYS
+    return batch
+
+
+@jax.jit
+def kafka_verdicts(
+    model: KafkaBatchModel, batch: KafkaRequestBatch, remotes
+):
+    """Returns allowed [F] bool; bit-identical to matches_rule."""
+    api_key = jnp.asarray(batch.api_key)
+    api_version = jnp.asarray(batch.api_version)
+    client = jnp.asarray(batch.client)
+    client_len = jnp.asarray(batch.client_len)
+    topics = jnp.asarray(batch.topics)
+    topic_len = jnp.asarray(batch.topic_len)
+    topic_count = jnp.asarray(batch.topic_count)
+    parsed = jnp.asarray(batch.parsed)
+    remotes = jnp.asarray(remotes, jnp.int32)
+
+    key_clamped = jnp.clip(api_key, 0, MAX_API_KEY - 1)
+    in_range = (api_key >= 0) & (api_key < MAX_API_KEY)
+
+    # [F, R] api-key role + version gates (policy.go:152-159).
+    key_ok = model.api_key_mask[:, :].T[key_clamped] & in_range[:, None]
+    ver_ok = model.version_any[None, :] | (
+        model.version[None, :] == api_version[:, None]
+    )
+
+    # clientID equality [F, R]: lengths equal and padded bytes equal.
+    client_eq = (client_len[:, None] == model.client_len[None, :]) & jnp.all(
+        client[:, None, :] == model.client[None, :, :], axis=-1
+    )
+
+    # Per-request-type extra gate (ruleMatches switch, policy.go:161-195).
+    simple_rule = model.topic_any & model.client_any  # no extra conditions
+    is_fc = api_key == FIND_COORDINATOR_KEY
+    nil_topic_reject = (~model.topic_any[None, :]) & (
+        model.is_topic_key[key_clamped] & in_range
+    )[:, None]
+    extra = jnp.where(
+        simple_rule[None, :],
+        True,
+        jnp.where(
+            parsed[:, None],
+            model.client_any[None, :] | client_eq,
+            jnp.where(is_fc[:, None], True, ~nil_topic_reject),
+        ),
+    )
+
+    rok = remote_ok(remotes, model.remote_ids, model.any_remote)  # [F, R]
+    base = key_ok & ver_ok & extra & rok  # [F, R]
+
+    # First branch: topic-less rule OR topic-less request (policy.go:210).
+    simple = jnp.any(
+        base & (model.topic_any[None, :] | (topic_count == 0)[:, None]),
+        axis=1,
+    )
+
+    # Topic coverage: [F, T, R] exact compares.
+    t_eq = (topic_len[:, :, None] == model.topic_len[None, None, :]) & jnp.all(
+        topics[:, :, None, :] == model.topic[None, None, :, :], axis=-1
+    )
+    cover = jnp.any(
+        t_eq & (~model.topic_any)[None, None, :] & base[:, None, :], axis=2
+    )  # [F, T]
+    t_idx = jnp.arange(cover.shape[1])[None, :]
+    active = t_idx < topic_count[:, None]
+    all_covered = jnp.all(cover | ~active, axis=1) & (topic_count > 0)
+
+    # Overflowed requests are denied on device; the engine re-evaluates
+    # them with the host oracle.
+    return (simple | all_covered) & ~jnp.asarray(batch.overflow)

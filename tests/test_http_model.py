@@ -1,0 +1,200 @@
+"""HTTP batch model tests: request-line tokenize, anchored regex matching,
+host/header rules, remote sets — fuzz-checked against a Python re oracle
+implementing the Envoy filter semantics (reference:
+envoy/cilium_network_policy.h:50-76 regex_match on path/method/host,
+exact header presence)."""
+
+import random
+import re
+
+import numpy as np
+
+from cilium_tpu.models.base import ConstVerdict
+from cilium_tpu.models.http import build_http_model, http_verdicts, re_escape
+from cilium_tpu.policy.api import PortRuleHTTP
+
+
+def encode(requests: list[bytes], width: int = 512):
+    data = np.zeros((len(requests), width), np.uint8)
+    lengths = np.zeros((len(requests),), np.int32)
+    for i, r in enumerate(requests):
+        b = r[:width]
+        data[i, : len(b)] = np.frombuffer(b, np.uint8)
+        lengths[i] = len(b)
+    return data, lengths
+
+
+def req(method="GET", path="/", headers=()):
+    head = f"{method} {path} HTTP/1.1\r\n".encode()
+    for h in headers:
+        head += h.encode() + b"\r\n"
+    return head + b"\r\n"
+
+
+def oracle(request: bytes, rules, remote, remote_sets):
+    """Envoy-side semantics: any rule (with matching remote) whose present
+    fields all match allows."""
+    head = request.split(b"\r\n\r\n")[0] + b"\r\n"
+    lines = head.decode().split("\r\n")
+    try:
+        method, path, _ = lines[0].split(" ", 2)
+    except ValueError:
+        return False
+    headers = lines[1:-1]
+    host = ""
+    for h in headers:
+        if h.lower().startswith("host: "):
+            host = h[6:]
+    for rule, remotes in zip(rules, remote_sets):
+        if remotes and remote not in remotes:
+            continue
+        if rule.method and not re.fullmatch(rule.method, method):
+            continue
+        if rule.path and not re.fullmatch(rule.path, path):
+            continue
+        if rule.host and not re.fullmatch(rule.host, host):
+            continue
+        if any(h not in headers for h in rule.headers):
+            continue
+        return True
+    return False
+
+
+def run_model(rules_with_remotes, requests, remotes=None):
+    model = build_http_model(rules_with_remotes)
+    data, lengths = encode(requests)
+    if remotes is None:
+        remotes = np.ones((len(requests),), np.int32)
+    complete, head_len, allow = http_verdicts(model, data, lengths, remotes)
+    return (
+        np.asarray(complete),
+        np.asarray(head_len),
+        np.asarray(allow),
+        model,
+    )
+
+
+class TestHttpModel:
+    def test_path_method(self):
+        rules = [(frozenset(), PortRuleHTTP(method="GET", path="/public/.*"))]
+        reqs = [
+            req("GET", "/public/index.html"),
+            req("GET", "/private/secret"),
+            req("POST", "/public/upload"),
+            req("GET", "/public/"),
+        ]
+        complete, _, allow, _ = run_model(rules, reqs)
+        assert complete.all()
+        assert allow.tolist() == [True, False, False, True]
+
+    def test_wildcard_rule_allows_all(self):
+        rules = [(frozenset(), PortRuleHTTP())]
+        _, _, allow, _ = run_model(rules, [req("DELETE", "/x")])
+        assert allow.tolist() == [True]
+
+    def test_empty_rules_deny(self):
+        m = build_http_model([])
+        assert isinstance(m, ConstVerdict) and not m.allow
+
+    def test_host_rule(self):
+        rules = [(frozenset(), PortRuleHTTP(host="api\\.example\\.com"))]
+        allowed = req("GET", "/", ["Host: api.example.com"])
+        denied = req("GET", "/", ["Host: evil.example.com"])
+        none = req("GET", "/")
+        _, _, allow, _ = run_model(rules, [allowed, denied, none])
+        assert allow.tolist() == [True, False, False]
+
+    def test_host_header_case_and_ows(self):
+        # Field names are case-insensitive, OWS after ':' optional
+        # (RFC 9110); all spellings must match the host rule.
+        rules = [(frozenset(), PortRuleHTTP(host="api\\.example\\.com"))]
+        variants = [
+            req("GET", "/", ["HOST: api.example.com"]),
+            req("GET", "/", ["host:api.example.com"]),
+            req("GET", "/", ["Host:  api.example.com "]),
+        ]
+        _, _, allow, _ = run_model(rules, variants)
+        assert allow.tolist() == [True, True, True]
+
+    def test_header_presence(self):
+        rules = [
+            (frozenset(), PortRuleHTTP(headers=("X-Token: secret",)))
+        ]
+        with_h = req("GET", "/", ["X-Token: secret"])
+        wrong_val = req("GET", "/", ["X-Token: other"])
+        without = req("GET", "/")
+        _, _, allow, _ = run_model(rules, [with_h, wrong_val, without])
+        assert allow.tolist() == [True, False, False]
+
+    def test_multiple_conditions_all_required(self):
+        rules = [
+            (
+                frozenset(),
+                PortRuleHTTP(
+                    method="POST",
+                    path="/api/v[0-9]+/.*",
+                    headers=("Content-Type: application/json",),
+                ),
+            )
+        ]
+        good = req("POST", "/api/v2/submit",
+                   ["Content-Type: application/json"])
+        bad_hdr = req("POST", "/api/v2/submit", ["Content-Type: text/xml"])
+        bad_path = req("POST", "/api/vx/submit",
+                       ["Content-Type: application/json"])
+        _, _, allow, _ = run_model(rules, [good, bad_hdr, bad_path])
+        assert allow.tolist() == [True, False, False]
+
+    def test_incomplete_head(self):
+        rules = [(frozenset(), PortRuleHTTP())]
+        partial = b"GET / HTTP/1.1\r\nHost: x\r\n"  # no terminating CRLFCRLF
+        complete, _, allow, _ = run_model(rules, [partial])
+        assert not complete[0] and not allow[0]
+
+    def test_remote_sets(self):
+        rules = [
+            (frozenset({100}), PortRuleHTTP(path="/a")),
+            (frozenset({200}), PortRuleHTTP(path="/b")),
+        ]
+        reqs = [req("GET", "/a"), req("GET", "/a"), req("GET", "/b")]
+        _, _, allow, _ = run_model(
+            rules, reqs, np.array([100, 200, 200], np.int32)
+        )
+        assert allow.tolist() == [True, False, True]
+
+    def test_head_len(self):
+        rules = [(frozenset(), PortRuleHTTP())]
+        r = req("GET", "/x", ["A: b"])
+        _, head_len, _, _ = run_model(rules, [r])
+        assert head_len[0] == len(r)
+
+    def test_re_escape(self):
+        assert re_escape("X-T.k*n: a+b") == "X-T\\.k\\*n: a\\+b"
+
+    def test_fuzz_against_re_oracle(self):
+        rng = random.Random(5)
+        rule_sets = [
+            [PortRuleHTTP(method="GET|HEAD", path="/pub(lic)?/.*")],
+            [PortRuleHTTP(path="/a/[0-9]+"), PortRuleHTTP(method="PUT")],
+            [PortRuleHTTP(host=".*\\.internal")],
+            [PortRuleHTTP(method="GET", headers=("X-A: 1", "X-B: 2"))],
+        ]
+        methods = ["GET", "PUT", "HEAD", "POST"]
+        paths = ["/public/x", "/pub/y", "/a/12", "/a/xy", "/other"]
+        hosts = [None, "svc.internal", "svc.external"]
+        hdrs = [[], ["X-A: 1"], ["X-A: 1", "X-B: 2"], ["X-B: 2"]]
+        for rules in rule_sets:
+            rows = [(frozenset(), r) for r in rules]
+            reqs = []
+            for _ in range(48):
+                headers = list(rng.choice(hdrs))
+                host = rng.choice(hosts)
+                if host:
+                    headers = [f"Host: {host}"] + headers
+                reqs.append(
+                    req(rng.choice(methods), rng.choice(paths), headers)
+                )
+            _, _, allow, _ = run_model(rows, reqs)
+            for i, r in enumerate(reqs):
+                want = oracle(r, rules, 1, [frozenset()] * len(rules))
+                assert allow[i] == want, (r, rules)

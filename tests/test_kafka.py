@@ -1,0 +1,248 @@
+"""Kafka tests: wire parsing, policy matching oracle, device model
+bit-exactness (fuzzed against the host oracle), correlation cache.
+
+reference test strategy: pkg/kafka/*_test.go request frame fixtures +
+policy matching tables.
+"""
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from cilium_tpu.kafka import (
+    CorrelationCache,
+    KafkaParseError,
+    RequestMessage,
+    ResponseMessage,
+    matches_rule,
+    parse_request,
+)
+from cilium_tpu.kafka.request import frame_length
+from cilium_tpu.models.kafka import (
+    build_kafka_model,
+    encode_requests,
+    kafka_verdicts,
+)
+from cilium_tpu.policy.api import PortRuleKafka
+
+
+# -- wire format builders ----------------------------------------------------
+
+def _str(s):
+    b = s.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+def _frame(payload: bytes) -> bytes:
+    return struct.pack(">i", len(payload)) + payload
+
+
+def _header(api_key, version, cid, client):
+    return struct.pack(">hhi", api_key, version, cid) + _str(client)
+
+
+def produce_request(topics, cid=7, client="producer-1", version=2):
+    body = struct.pack(">hi", 1, 1000)  # acks, timeout
+    body += struct.pack(">i", len(topics))
+    for t in topics:
+        body += _str(t)
+        body += struct.pack(">i", 1)  # one partition
+        body += struct.pack(">i", 0)  # partition id
+        body += struct.pack(">i", 4) + b"recs"  # record set
+    return _frame(_header(0, version, cid, client) + body)
+
+
+def fetch_request(topics, cid=9, client="consumer-1", version=2):
+    body = struct.pack(">iii", -1, 100, 1)
+    body += struct.pack(">i", len(topics))
+    for t in topics:
+        body += _str(t)
+        body += struct.pack(">i", 1)
+        body += struct.pack(">iqi", 0, 0, 1048576)
+    return _frame(_header(1, version, cid, client) + body)
+
+
+def metadata_request(topics, cid=3, client="admin", version=1):
+    body = struct.pack(">i", len(topics))
+    for t in topics:
+        body += _str(t)
+    return _frame(_header(3, version, cid, client) + body)
+
+
+def heartbeat_request(cid=5, client="hb"):
+    # api key 12 — header-only parse
+    return _frame(_header(12, 0, cid, client) + b"\x00\x00")
+
+
+def rule(**kw):
+    r = PortRuleKafka(**kw)
+    r.sanitize()
+    return r
+
+
+class TestParse:
+    def test_produce(self):
+        req = parse_request(produce_request(["topic-a", "topic-b"]))
+        assert req.api_key == 0 and req.api_version == 2
+        assert req.correlation_id == 7
+        assert req.client_id == "producer-1"
+        assert req.get_topics() == ["topic-a", "topic-b"]
+        assert req.parsed
+
+    def test_fetch_and_metadata(self):
+        assert parse_request(fetch_request(["t1"])).get_topics() == ["t1"]
+        assert parse_request(metadata_request(["t1", "t2"])).get_topics() == [
+            "t1", "t2"
+        ]
+
+    def test_header_only(self):
+        req = parse_request(heartbeat_request())
+        assert req.api_key == 12
+        assert not req.parsed and req.get_topics() == []
+
+    def test_truncated(self):
+        with pytest.raises(KafkaParseError):
+            parse_request(b"\x00\x00")
+        with pytest.raises(KafkaParseError):
+            parse_request(struct.pack(">i", 100) + b"short")
+
+    def test_frame_length(self):
+        f = produce_request(["t"])
+        assert frame_length(f) == len(f)
+        assert frame_length(b"\x00\x00") is None
+
+    def test_correlation_rewrite_in_raw(self):
+        req = parse_request(produce_request(["t"], cid=42))
+        req.set_correlation_id(99)
+        assert parse_request(req.raw).correlation_id == 99
+
+    def test_error_response(self):
+        req = parse_request(produce_request(["secret"], cid=13))
+        resp = req.create_response()
+        assert ResponseMessage.parse_correlation_id(resp.raw) == 13
+        assert b"secret" in resp.raw
+
+
+class TestPolicyOracle:
+    def test_wildcard_rule(self):
+        req = parse_request(produce_request(["any"]))
+        assert matches_rule(req, [rule()])
+        assert not matches_rule(req, [])
+
+    def test_topic_acl(self):
+        req = parse_request(produce_request(["allowed"]))
+        assert matches_rule(req, [rule(topic="allowed")])
+        assert not matches_rule(req, [rule(topic="other")])
+
+    def test_all_topics_must_be_allowed(self):
+        req = parse_request(produce_request(["a", "b"]))
+        assert not matches_rule(req, [rule(topic="a")])
+        assert matches_rule(req, [rule(topic="a"), rule(topic="b")])
+
+    def test_role_produce(self):
+        prod = rule(role="produce", topic="t")
+        assert matches_rule(parse_request(produce_request(["t"])), [prod])
+        assert matches_rule(parse_request(metadata_request(["t"])), [prod])
+        assert not matches_rule(parse_request(fetch_request(["t"])), [prod])
+
+    def test_role_consume(self):
+        cons = rule(role="consume", topic="t")
+        assert matches_rule(parse_request(fetch_request(["t"])), [cons])
+        assert not matches_rule(parse_request(produce_request(["t"])), [cons])
+        # heartbeat (key 12) is in the consume role, header-only, and the
+        # topic rule can't reject it (not a topic API key)
+        assert matches_rule(parse_request(heartbeat_request()), [cons])
+
+    def test_api_version(self):
+        req = parse_request(produce_request(["t"], version=2))
+        assert matches_rule(req, [rule(api_version="2")])
+        assert not matches_rule(req, [rule(api_version="1")])
+
+    def test_client_id(self):
+        req = parse_request(produce_request(["t"], client="producer-1"))
+        assert matches_rule(req, [rule(topic="t", client_id="producer-1")])
+        assert not matches_rule(req, [rule(topic="t", client_id="other")])
+
+    def test_topicless_request_with_topic_rule(self):
+        # Parsed metadata request with no topics: topic rule passes through
+        # ruleMatches (clientID check only) — reference behavior.
+        req = parse_request(metadata_request([]))
+        assert matches_rule(req, [rule(topic="t")])
+
+
+class TestDeviceModel:
+    def _requests(self, rng, n):
+        reqs = []
+        topics_pool = ["a", "b", "c", "events", "logs"]
+        clients = ["producer-1", "consumer-1", "admin", ""]
+        for _ in range(n):
+            kind = rng.randrange(5)
+            topics = rng.sample(topics_pool, k=rng.randrange(0, 3))
+            client = rng.choice(clients)
+            version = rng.randrange(0, 3)
+            if kind == 0:
+                f = produce_request(topics, client=client, version=version)
+            elif kind == 1:
+                f = fetch_request(topics, client=client, version=version)
+            elif kind == 2:
+                f = metadata_request(topics, client=client, version=version)
+            else:
+                f = heartbeat_request(client=client)
+            reqs.append(parse_request(f))
+        return reqs
+
+    def test_fuzz_matches_host_oracle(self):
+        rng = random.Random(11)
+        rule_sets = [
+            [rule()],
+            [rule(topic="a"), rule(topic="b")],
+            [rule(role="produce", topic="events")],
+            [rule(role="consume")],
+            [rule(api_version="2", topic="a")],
+            [rule(client_id="producer-1")],
+            [rule(topic="a", client_id="consumer-1"), rule(topic="logs")],
+        ]
+        for rules in rule_sets:
+            model = build_kafka_model([(frozenset(), r) for r in rules])
+            reqs = self._requests(rng, 64)
+            batch = encode_requests(reqs)
+            remotes = np.ones((len(reqs),), np.int32)
+            got = np.asarray(kafka_verdicts(model, batch, remotes))
+            for i, req in enumerate(reqs):
+                want = matches_rule(req, rules)
+                assert got[i] == want, (
+                    f"mismatch rules={rules} req=(key={req.api_key} "
+                    f"v={req.api_version} topics={req.topics} "
+                    f"client={req.client_id})"
+                )
+
+    def test_remote_sets(self):
+        model = build_kafka_model(
+            [(frozenset({100}), rule(topic="a"))]
+        )
+        reqs = [parse_request(produce_request(["a"]))] * 2
+        batch = encode_requests(reqs)
+        got = np.asarray(
+            kafka_verdicts(model, batch, np.array([100, 200], np.int32))
+        )
+        assert got.tolist() == [True, False]
+
+    def test_empty_ruleset_denies(self):
+        from cilium_tpu.models.base import ConstVerdict
+
+        m = build_kafka_model([])
+        assert isinstance(m, ConstVerdict) and not m.allow
+
+
+class TestCorrelationCache:
+    def test_rewrite_and_restore(self):
+        cache = CorrelationCache()
+        req = parse_request(produce_request(["t"], cid=1234))
+        new_id = cache.handle_request(req)
+        assert req.correlation_id == new_id != 1234
+        assert cache.correlate(new_id) is req
+        assert cache.restore_response_id(new_id) == 1234
+        assert cache.restore_response_id(new_id) is None
+        assert len(cache) == 0
