@@ -1,0 +1,166 @@
+"""Access log unix-socket server + client.
+
+reference: pkg/envoy/accesslog_server.go:45 (server accepting protobuf
+LogEntry frames from proxies over a unix socket, converting to
+accesslog.LogRecord and feeding monitor + logger) and
+proxylib/accesslog/client.go (sender).  Framing: 4-byte big-endian length
++ JSON record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Callable
+
+from ..utils.logging import get_logger
+from .record import LogRecord
+
+log = get_logger("accesslog")
+
+
+class AccessLogServer:
+    """reference: accesslog_server.go:45 StartAccessLogServer."""
+
+    def __init__(
+        self,
+        path: str,
+        on_record: Callable[[LogRecord], None] | None = None,
+    ) -> None:
+        self.path = path
+        self.on_record = on_record
+        self.records: list[LogRecord] = []
+        self._mutex = threading.Lock()
+        if os.path.exists(path):
+            os.unlink(path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(16)
+        self._stop = threading.Event()
+        threading.Thread(
+            target=self._accept_loop, name="accesslog-server", daemon=True
+        ).start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(None)
+            while True:
+                hdr = b""
+                while len(hdr) < 4:
+                    chunk = conn.recv(4 - len(hdr))
+                    if not chunk:
+                        return
+                    hdr += chunk
+                (n,) = struct.unpack(">I", hdr)
+                if n > 16 * 1024 * 1024:
+                    log.with_field("size", n).warning(
+                        "oversized access log frame; closing"
+                    )
+                    return
+                body = b""
+                while len(body) < n:
+                    chunk = conn.recv(n - len(body))
+                    if not chunk:
+                        return
+                    body += chunk
+                try:
+                    rec = LogRecord.from_dict(json.loads(body.decode()))
+                except (ValueError, TypeError) as e:
+                    log.with_field("error", str(e)).warning(
+                        "bad access log record"
+                    )
+                    continue
+                self._handle(rec)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, rec: LogRecord) -> None:
+        with self._mutex:
+            self.records.append(rec)
+            if len(self.records) > 65536:
+                self.records = self.records[-32768:]
+        if self.on_record is not None:
+            try:
+                self.on_record(rec)
+            except Exception:  # noqa: BLE001 — consumers never break intake
+                pass
+
+    def drain(self) -> list[LogRecord]:
+        with self._mutex:
+            out = self.records
+            self.records = []
+            return out
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        finally:
+            if os.path.exists(self.path):
+                os.unlink(self.path)
+
+
+class AccessLogClient:
+    """Sender side (reference: proxylib/accesslog/client.go)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._sock: socket.socket | None = None
+        self._mutex = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(self.path)
+        return s
+
+    def log(self, rec: LogRecord) -> bool:
+        """Send one record; reconnects once on failure (reference:
+        client.go Log with reconnect)."""
+        data = json.dumps(rec.to_dict()).encode()
+        frame = struct.pack(">I", len(data)) + data
+        with self._mutex:
+            for _ in range(2):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    self._sock.sendall(frame)
+                    return True
+                except OSError:
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                    self._sock = None
+        return False
+
+    def close(self) -> None:
+        with self._mutex:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

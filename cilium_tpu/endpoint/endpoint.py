@@ -423,6 +423,9 @@ class Endpoint:
             # Disconnecting/disconnected endpoints must not regenerate:
             # doing so would recreate redirects torn down by the daemon.
             return False
+        # Fresh spans per regeneration so the histogram observes this
+        # run's duration, not the endpoint's lifetime accumulation.
+        self.stats = SpanStats()
         stats = self.stats
         ok = False
         try:

@@ -33,10 +33,8 @@ PARSED_TOPIC_KEYS = frozenset(
 )
 
 # API keys carrying a topic in the request (reference: policy.go:27
-# isTopicAPIKey).
-TOPIC_API_KEYS = frozenset(
-    [0, 1, 2, 3, 4, 5, 6, 8, 9, 19, 20, 21, 23, 24, 27, 28, 34, 35, 37]
-)
+# isTopicAPIKey) — single source of truth in policy.api.
+from ..policy.api import KAFKA_TOPIC_API_KEYS as TOPIC_API_KEYS  # noqa: E402
 
 ERROR_TOPIC_AUTHORIZATION_FAILED = 29
 
@@ -149,16 +147,6 @@ class ResponseMessage:
         if len(frame) < 8:
             raise KafkaParseError("response frame too short")
         return struct.unpack_from(">i", frame, 4)[0]
-
-
-def _parse_topic_array_entries(r: _Reader, parse_entry) -> list[str]:
-    n = r.int32()
-    if n < 0 or n > 1_000_000:
-        raise KafkaParseError(f"implausible array count {n}")
-    out = []
-    for _ in range(n):
-        out.append(parse_entry(r))
-    return out
 
 
 def _parse_topics(r: _Reader, api_key: int, api_version: int) -> list[str]:
@@ -284,19 +272,21 @@ def frame_length(buf: bytes) -> Optional[int]:
 
 
 def _error_response_body(req: RequestMessage, error_code: int) -> bytes:
-    """Minimal valid error response per API key (reference:
-    request.go:158 createXXXResponse family): every inspected topic gets
-    the error code; other request types get an empty/ignorable body."""
+    """Version-aware error response per API key (reference:
+    request.go:158 CreateResponse family): every inspected topic gets the
+    error code in a body shaped for the request's api_version, so clients
+    receive a clean TOPIC_AUTHORIZATION_FAILED instead of a parse error."""
     w = bytearray()
+    v = req.api_version
 
-    def put16(v):
-        w.extend(struct.pack(">h", v))
+    def put16(x):
+        w.extend(struct.pack(">h", x))
 
-    def put32(v):
-        w.extend(struct.pack(">i", v))
+    def put32(x):
+        w.extend(struct.pack(">i", x))
 
-    def put64(v):
-        w.extend(struct.pack(">q", v))
+    def put64(x):
+        w.extend(struct.pack(">q", x))
 
     def put_str(s):
         b = s.encode()
@@ -311,7 +301,13 @@ def _error_response_body(req: RequestMessage, error_code: int) -> bytes:
             put32(0)  # partition
             put16(error_code)
             put64(-1)  # base_offset
+            if v >= 2:
+                put64(-1)  # log_append_time
+        if v >= 1:
+            put32(0)  # throttle_time_ms (trailing for produce)
     elif req.api_key == FETCH_KEY:
+        if v >= 1:
+            put32(0)  # throttle_time_ms (leading for fetch)
         put32(len(req.topics))
         for t in req.topics:
             put_str(t)
@@ -319,16 +315,65 @@ def _error_response_body(req: RequestMessage, error_code: int) -> bytes:
             put32(0)  # partition
             put16(error_code)
             put64(-1)  # high_watermark
+            if v >= 4:
+                put64(-1)  # last_stable_offset
+                if v >= 5:
+                    put64(-1)  # log_start_offset
+                put32(0)  # aborted_transactions count
             put32(0)  # record set size
+    elif req.api_key == OFFSETS_KEY:
+        if v >= 2:
+            put32(0)  # throttle_time_ms
+        put32(len(req.topics))
+        for t in req.topics:
+            put_str(t)
+            put32(1)
+            put32(0)  # partition
+            put16(error_code)
+            if v == 0:
+                put32(0)  # offsets array (empty)
+            else:
+                put64(-1)  # timestamp
+                put64(-1)  # offset
     elif req.api_key == METADATA_KEY:
+        if v >= 3:
+            put32(0)  # throttle_time_ms
         put32(0)  # brokers
+        if v >= 2:
+            put_str("")  # cluster_id
+        if v >= 1:
+            put32(-1)  # controller_id
         put32(len(req.topics))
         for t in req.topics:
             put16(error_code)
             put_str(t)
+            if v >= 1:
+                w.extend(b"\x00")  # is_internal
             put32(0)  # partitions
+    elif req.api_key == OFFSET_COMMIT_KEY:
+        if v >= 3:
+            put32(0)  # throttle_time_ms
+        put32(len(req.topics))
+        for t in req.topics:
+            put_str(t)
+            put32(1)
+            put32(0)  # partition
+            put16(error_code)
+    elif req.api_key == OFFSET_FETCH_KEY:
+        if v >= 3:
+            put32(0)  # throttle_time_ms
+        put32(len(req.topics))
+        for t in req.topics:
+            put_str(t)
+            put32(1)
+            put32(0)  # partition
+            put64(-1)  # offset
+            put_str("")  # metadata
+            put16(error_code)
+        if v >= 2:
+            put16(error_code)  # top-level error
     else:
-        # Generic: topic-less or uninspected request types get an empty
-        # body; clients treat the missing payload as a broker error.
+        # Uninspected request types get an empty body; clients treat the
+        # missing payload as a broker error.
         pass
     return bytes(w)

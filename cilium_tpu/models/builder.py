@@ -30,16 +30,23 @@ def expand_selector_remotes(sel, identity_cache: dict) -> frozenset | None:
     )
 
 
-def _remote_rows(sel, identity_cache: dict):
-    """Resolve a selector to the pack_remote_sets convention (empty set =
-    wildcard) or None when the row must be skipped (fail closed: a
-    selector matching no known identity allows nobody)."""
+def _remote_rows(sel, identity_cache: dict) -> list[frozenset] | None:
+    """Resolve a selector to pack_remote_sets-convention sets (empty set =
+    wildcard), chunked so no set exceeds MAX_REMOTES (broad selectors
+    split into several rows).  None means the row must be skipped (fail
+    closed: a selector matching no known identity allows nobody)."""
+    from .base import MAX_REMOTES
+
     remotes = expand_selector_remotes(sel, identity_cache)
     if remotes is None:
-        return frozenset()  # wildcard
+        return [frozenset()]  # wildcard
     if not remotes:
         return None  # matches nothing: skip
-    return remotes
+    ordered = sorted(remotes)
+    return [
+        frozenset(ordered[i:i + MAX_REMOTES])
+        for i in range(0, len(ordered), MAX_REMOTES)
+    ]
 
 
 def build_model_for_filter(f: L4Filter, identity_cache: dict):
@@ -52,29 +59,31 @@ def build_model_for_filter(f: L4Filter, identity_cache: dict):
     if f.l7_parser == PARSER_TYPE_HTTP:
         rows: list[tuple[frozenset, PortRuleHTTP]] = []
         for sel, l7 in f.l7_rules_per_ep.items():
-            remotes = _remote_rows(sel, identity_cache)
-            if remotes is None:
+            remote_chunks = _remote_rows(sel, identity_cache)
+            if remote_chunks is None:
                 continue
-            if len(l7) == 0:
-                # L3-override wildcard: allow-all row for these remotes
-                # (reference: l4.go:209-227 endpointsWithL3Override).
-                rows.append((remotes, PortRuleHTTP()))
-            for h in l7.http:
-                rows.append((remotes, h))
+            for remotes in remote_chunks:
+                if len(l7) == 0:
+                    # L3-override wildcard: allow-all row for these remotes
+                    # (reference: l4.go:209-227 endpointsWithL3Override).
+                    rows.append((remotes, PortRuleHTTP()))
+                for h in l7.http:
+                    rows.append((remotes, h))
         return build_http_model(rows)
 
     if f.l7_parser == PARSER_TYPE_KAFKA:
         krows: list[tuple[frozenset, PortRuleKafka]] = []
         for sel, l7 in f.l7_rules_per_ep.items():
-            remotes = _remote_rows(sel, identity_cache)
-            if remotes is None:
+            remote_chunks = _remote_rows(sel, identity_cache)
+            if remote_chunks is None:
                 continue
-            if len(l7) == 0:
-                wildcard = PortRuleKafka()
-                wildcard.sanitize()
-                krows.append((remotes, wildcard))
-            for k in l7.kafka:
-                krows.append((remotes, k))
+            for remotes in remote_chunks:
+                if len(l7) == 0:
+                    wildcard = PortRuleKafka()
+                    wildcard.sanitize()
+                    krows.append((remotes, wildcard))
+                for k in l7.kafka:
+                    krows.append((remotes, k))
         return build_kafka_model(krows)
 
     return ConstVerdict(True)  # no L7 restrictions at this layer

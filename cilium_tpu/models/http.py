@@ -40,6 +40,33 @@ def re_escape(s: str) -> str:
     return "".join("\\" + c if c in _RE_META else c for c in s)
 
 
+def _ci_literal(s: str) -> str:
+    """Case-insensitive regex for a literal (header field names are
+    case-insensitive, RFC 9110)."""
+    out = []
+    for c in s:
+        if c.isalpha():
+            out.append(f"[{c.upper()}{c.lower()}]")
+        elif c in _RE_META:
+            out.append("\\" + c)
+        else:
+            out.append(c)
+    return "".join(out)
+
+
+def _header_pattern(header: str) -> str:
+    """'Name: value' -> CRLF-framed pattern with case-insensitive name and
+    optional OWS around the value (matching the Host handling and the
+    reference's case-insensitive header lookup)."""
+    name, sep, value = header.partition(":")
+    if not sep:
+        return "\r\n" + re_escape(header) + "\r\n"
+    return (
+        "\r\n" + _ci_literal(name) + ":[ \t]*"
+        + re_escape(value.strip()) + "[ \t]*\r\n"
+    )
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class HttpBatchModel:
@@ -97,7 +124,7 @@ def build_http_model(
             head_rule.append(i)
             n_head += 1
         for header in h.headers:
-            head_patterns.append("\r\n" + re_escape(header) + "\r\n")
+            head_patterns.append(_header_pattern(header))
             head_rule.append(i)
             n_head += 1
         head_count.append(n_head)

@@ -192,6 +192,7 @@ def encode_requests(reqs: list[RequestMessage]) -> KafkaRequestBatch:
         batch.api_version[i] = r.api_version
         distinct = list(dict.fromkeys(r.get_topics()))
         if (len(distinct) > MAX_TOPICS
+                or not 0 <= r.api_key < MAX_API_KEY
                 or len(r.client_id.encode()) > MAX_CLIENT_LEN
                 or any(len(t.encode()) > MAX_TOPIC_LEN for t in distinct)):
             batch.overflow[i] = True

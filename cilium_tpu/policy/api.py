@@ -401,9 +401,12 @@ KAFKA_MAX_TOPIC_LEN = 255
 # this port preserves that exact behavior.
 _KAFKA_TOPIC_RE = re.compile(r"^[a-zA-Z0-9._\-\\]+$")
 
-# API keys whose requests carry topics (reference: kafka.go:107-133).
+# API keys whose requests carry topics — the behavioral set the matcher
+# uses (reference: pkg/kafka/policy.go:27 isTopicAPIKey; note kafka.go's
+# constant block also lists FindCoordinator/JoinGroup, but isTopicAPIKey,
+# which decides verdicts, does not).
 KAFKA_TOPIC_API_KEYS = frozenset(
-    [0, 1, 2, 3, 4, 5, 6, 8, 9, 10, 11, 19, 20, 21, 23, 24, 27, 28, 34, 35, 37]
+    [0, 1, 2, 3, 4, 5, 6, 8, 9, 19, 20, 21, 23, 24, 27, 28, 34, 35, 37]
 )
 
 

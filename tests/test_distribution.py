@@ -1,0 +1,244 @@
+"""Distribution (xDS analog) + access log tests.
+
+reference test strategy: pkg/envoy/xds/server_e2e_test.go (ACK/NACK/version
+races over a fake stream), accesslog server tests.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.accesslog import (
+    AccessLogClient,
+    AccessLogServer,
+    AccessLogger,
+    HttpLogEntry,
+    LogRecord,
+    VERDICT_DENIED,
+)
+from cilium_tpu.distribution import (
+    AckingMutator,
+    Cache,
+    DistributionServer,
+    TYPE_NETWORK_POLICY,
+)
+from cilium_tpu.distribution.sock import (
+    SocketDistributionServer,
+    recv_frame,
+    send_frame,
+)
+from cilium_tpu.utils.completion import Completion, CompletionError, WaitGroup
+
+
+class TestCache:
+    def test_versioning(self):
+        c = Cache()
+        v0 = c.version
+        v1, updated, _ = c.upsert(TYPE_NETWORK_POLICY, "ep1", {"p": 1})
+        assert updated and v1 > v0
+        # identical upsert: no version bump
+        v2, updated, _ = c.upsert(TYPE_NETWORK_POLICY, "ep1", {"p": 1})
+        assert not updated and v2 == v1
+        # changed resource bumps
+        v3, updated, _ = c.upsert(TYPE_NETWORK_POLICY, "ep1", {"p": 2})
+        assert updated and v3 > v1
+        assert c.lookup(TYPE_NETWORK_POLICY, "ep1") == {"p": 2}
+
+    def test_get_resources_since(self):
+        c = Cache()
+        c.upsert(TYPE_NETWORK_POLICY, "a", 1)
+        v, _, _ = c.upsert(TYPE_NETWORK_POLICY, "b", 2)
+        assert c.get_resources(TYPE_NETWORK_POLICY, since_version=v) is None
+        vr = c.get_resources(TYPE_NETWORK_POLICY, since_version=v - 1)
+        assert vr is not None and set(vr.resources) == {"a", "b"}
+
+    def test_revert(self):
+        c = Cache()
+        c.upsert(TYPE_NETWORK_POLICY, "a", 1)
+        _, _, revert = c.upsert(TYPE_NETWORK_POLICY, "a", 2)
+        revert()
+        assert c.lookup(TYPE_NETWORK_POLICY, "a") == 1
+        _, _, revert = c.delete(TYPE_NETWORK_POLICY, "a")
+        assert c.lookup(TYPE_NETWORK_POLICY, "a") is None
+        revert()
+        assert c.lookup(TYPE_NETWORK_POLICY, "a") == 1
+
+
+class TestServer:
+    def test_subscribe_initial_and_updates(self):
+        c = Cache()
+        c.upsert(TYPE_NETWORK_POLICY, "ep1", {"rules": []})
+        s = DistributionServer(c)
+        sub = s.subscribe("node1", TYPE_NETWORK_POLICY)
+        vr = sub.next(1)
+        assert vr is not None and "ep1" in vr.resources
+        c.upsert(TYPE_NETWORK_POLICY, "ep2", {"rules": [1]})
+        vr = sub.next(1)
+        assert vr is not None and set(vr.resources) == {"ep1", "ep2"}
+
+    def test_ack_tracking(self):
+        c = Cache()
+        s = DistributionServer(c)
+        sub = s.subscribe("node1", TYPE_NETWORK_POLICY)
+        v, _, _ = c.upsert(TYPE_NETWORK_POLICY, "ep1", 1)
+        s.ack(sub, v)
+        assert s.node_acked_version("node1", TYPE_NETWORK_POLICY) == v
+        # NACK does not advance
+        v2, _, _ = c.upsert(TYPE_NETWORK_POLICY, "ep1", 2)
+        s.ack(sub, v2, nack=True)
+        assert s.node_acked_version("node1", TYPE_NETWORK_POLICY) == v
+
+
+class TestAckingMutator:
+    def test_completion_on_all_acks(self):
+        c = Cache()
+        s = DistributionServer(c)
+        m = AckingMutator(c, s)
+        sub1 = s.subscribe("n1", TYPE_NETWORK_POLICY)
+        sub2 = s.subscribe("n2", TYPE_NETWORK_POLICY)
+        comp = Completion()
+        m.upsert(TYPE_NETWORK_POLICY, "ep1", {"r": 1}, ["n1", "n2"], comp)
+        vr1 = sub1.next(1)
+        s.ack(sub1, vr1.version)
+        assert not comp.completed  # n2 still pending
+        vr2 = sub2.next(1)
+        s.ack(sub2, vr2.version)
+        assert comp.wait(1)
+        assert m.pending_count() == 0
+
+    def test_nack_leaves_pending(self):
+        c = Cache()
+        s = DistributionServer(c)
+        m = AckingMutator(c, s)
+        sub = s.subscribe("n1", TYPE_NETWORK_POLICY)
+        comp = Completion()
+        m.upsert(TYPE_NETWORK_POLICY, "ep1", {"r": 1}, ["n1"], comp)
+        vr = sub.next(1)
+        s.ack(sub, vr.version, nack=True)
+        assert not comp.completed
+        wg = WaitGroup()
+        with pytest.raises(CompletionError):
+            # policy application would time out and revert here
+            # (reference: pkg/endpoint/bpf.go:555)
+            _wait(comp, 0.05)
+
+    def test_already_acked_completes_immediately(self):
+        c = Cache()
+        s = DistributionServer(c)
+        m = AckingMutator(c, s)
+        sub = s.subscribe("n1", TYPE_NETWORK_POLICY)
+        v, _, _ = c.upsert(TYPE_NETWORK_POLICY, "x", 1)
+        s.ack(sub, v + 10)  # node ahead of anything we'll push
+        comp = Completion()
+        m.upsert(TYPE_NETWORK_POLICY, "x", 1, ["n1"], comp)
+        assert comp.completed
+
+    def test_later_version_ack_completes_older_pending(self):
+        c = Cache()
+        s = DistributionServer(c)
+        m = AckingMutator(c, s)
+        sub = s.subscribe("n1", TYPE_NETWORK_POLICY)
+        c1 = Completion()
+        c2 = Completion()
+        m.upsert(TYPE_NETWORK_POLICY, "a", 1, ["n1"], c1)
+        m.upsert(TYPE_NETWORK_POLICY, "b", 2, ["n1"], c2)
+        # drain stream; ack only the final version
+        last = None
+        while True:
+            vr = sub.next(0.2)
+            if vr is None:
+                break
+            last = vr
+        s.ack(sub, last.version)
+        assert c1.wait(1) and c2.wait(1)
+
+
+def _wait(comp, timeout):
+    if not comp.wait(timeout):
+        raise CompletionError("deadline")
+
+
+class TestSocketTransport:
+    def test_sidecar_subscription_roundtrip(self, tmp_path):
+        import socket as socketlib
+
+        c = Cache()
+        s = DistributionServer(c)
+        sock_path = str(tmp_path / "dist.sock")
+        srv = SocketDistributionServer(s, sock_path)
+        try:
+            c.upsert(TYPE_NETWORK_POLICY, "ep1", {"rules": ["a"]})
+            client = socketlib.socket(socketlib.AF_UNIX,
+                                      socketlib.SOCK_STREAM)
+            client.connect(sock_path)
+            send_frame(client, {
+                "subscribe": {"node": "sidecar1",
+                              "type_url": TYPE_NETWORK_POLICY}
+            })
+            msg = recv_frame(client)
+            assert msg["resources"] == {"ep1": {"rules": ["a"]}}
+            # ack flows back into the server
+            send_frame(client, {"ack": {"version": msg["version"]}})
+            deadline = time.monotonic() + 2
+            while (s.node_acked_version("sidecar1", TYPE_NETWORK_POLICY)
+                   != msg["version"] and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert (s.node_acked_version("sidecar1", TYPE_NETWORK_POLICY)
+                    == msg["version"])
+            # live update
+            c.upsert(TYPE_NETWORK_POLICY, "ep2", {"rules": ["b"]})
+            msg2 = recv_frame(client)
+            assert "ep2" in msg2["resources"]
+            client.close()
+        finally:
+            srv.close()
+
+
+class TestAccessLog:
+    def test_client_server_roundtrip(self, tmp_path):
+        path = str(tmp_path / "access.sock")
+        got = []
+        srv = AccessLogServer(path, on_record=got.append)
+        try:
+            client = AccessLogClient(path)
+            rec = LogRecord(
+                verdict=VERDICT_DENIED,
+                http=HttpLogEntry(code=403, method="GET", url="/private"),
+            )
+            assert client.log(rec)
+            deadline = time.monotonic() + 2
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert got and got[0].verdict == VERDICT_DENIED
+            assert got[0].http.code == 403
+            client.close()
+        finally:
+            srv.close()
+
+    def test_logger_enrichment_and_file(self, tmp_path):
+        import json
+
+        from cilium_tpu.endpoint import Endpoint
+        from cilium_tpu.identity import Identity
+        from cilium_tpu.labels import Labels
+
+        ep = Endpoint(7, ipv4="10.0.0.7")
+        ep.set_identity(Identity(id=555, labels=Labels.from_model(
+            ["k8s:app=x"])))
+        logfile = str(tmp_path / "access.log")
+        notified = []
+        logger = AccessLogger(
+            endpoint_lookup=lambda eid: ep if eid == 7 else None,
+            notify=notified.append,
+            logfile_path=logfile,
+        )
+        rec = LogRecord()
+        rec.destination.id = 7
+        logger.log(rec)
+        assert rec.destination.identity == 555
+        assert rec.destination.labels == ["k8s:app=x"]
+        assert notified
+        with open(logfile) as f:
+            line = json.loads(f.readline())
+        assert line["destination"]["identity"] == 555
