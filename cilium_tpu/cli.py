@@ -1,0 +1,339 @@
+"""cilium-tpu CLI — the operator interface.
+
+reference: cilium/cmd (cobra command tree: status, policy, endpoint,
+identity, bpf map dumps, monitor, prefilter, config, metrics).  Speaks the
+REST API on the agent's unix socket; `monitor` attaches to the monitor
+socket.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .api import ApiClient, ApiError
+from .utils import defaults
+
+VERSION = "0.1.0"
+
+
+def _client(args) -> ApiClient:
+    return ApiClient(args.socket)
+
+
+def _print(obj, as_json: bool) -> None:
+    if as_json or isinstance(obj, str):
+        print(obj if isinstance(obj, str) else json.dumps(obj, indent=2))
+    else:
+        print(json.dumps(obj, indent=2))
+
+
+def cmd_status(args):
+    st = _client(args).get("/v1/status")
+    if args.json:
+        _print(st, True)
+        return 0
+    print(f"KVStore:        {st['kvstore']['state']}  "
+          f"({st['kvstore']['status']})")
+    print(f"Cilium:         {st['cilium']['state']}  "
+          f"uptime {st['cilium']['uptime_s']}s")
+    print(f"Cluster:        {st['cluster']} node {st['node']}")
+    print(f"Policy:         revision {st['policy']['revision']}, "
+          f"{st['policy']['rules']} rules")
+    eps = st["endpoints"]
+    states = " ".join(f"{k}={v}" for k, v in eps["by_state"].items())
+    print(f"Endpoints:      {eps['total']} ({states})")
+    print(f"Identities:     {st['identity']['allocated']}")
+    print(f"IPCache:        {st['ipcache']['entries']} entries")
+    print(f"Proxy:          {st['proxy']['redirects']} redirects on "
+          f"{st['proxy']['port_range']}")
+    if args.all_controllers:
+        print("Controllers:")
+        for c in st["controllers"]:
+            mark = "OK " if not c["last_error"] else "ERR"
+            print(f"  {mark} {c['name']} success={c['success']} "
+                  f"failure={c['failure']} {c['last_error']}")
+    return 0
+
+
+def cmd_policy_get(args):
+    _print(_client(args).get("/v1/policy"), args.json)
+    return 0
+
+
+def cmd_policy_import(args):
+    text = (
+        sys.stdin.read() if args.file == "-" else open(args.file).read()
+    )
+    out = _client(args).put("/v1/policy", text)
+    print(f"Revision: {out['revision']}")
+    return 0
+
+
+def cmd_policy_delete(args):
+    out = _client(args).delete("/v1/policy", args.labels)
+    print(f"Revision: {out['revision']}, deleted {out['deleted']} rules")
+    return 0
+
+
+def cmd_policy_trace(args):
+    route = f"/v1/policy/resolve?from={args.src}&to={args.dst}"
+    if args.dport:
+        route += f"&dport={args.dport}"
+    out = _client(args).get(route)
+    if args.verbose and out.get("trace"):
+        print(out["trace"])
+    print(f"Verdict: {out['verdict']}")
+    return 0 if out["verdict"] == "allowed" else 1
+
+
+def cmd_endpoint_list(args):
+    eps = _client(args).get("/v1/endpoint")
+    if args.json:
+        _print(eps, True)
+        return 0
+    print(f"{'ID':<8}{'STATE':<24}{'IDENTITY':<10}{'IPV4':<16}LABELS")
+    for ep in eps:
+        print(f"{ep['id']:<8}{ep['state']:<24}{ep['identity']:<10}"
+              f"{ep['ipv4']:<16}{','.join(ep['labels'])}")
+    return 0
+
+
+def cmd_endpoint_get(args):
+    _print(_client(args).get(f"/v1/endpoint/{args.id}"), args.json)
+    return 0
+
+
+def cmd_endpoint_create(args):
+    spec = {"ipv4": args.ipv4, "labels": args.label or []}
+    out = _client(args).put(f"/v1/endpoint/{args.id}", spec)
+    _print(out, args.json)
+    return 0
+
+
+def cmd_endpoint_delete(args):
+    _client(args).delete(f"/v1/endpoint/{args.id}")
+    print(f"Endpoint {args.id} deleted")
+    return 0
+
+
+def cmd_endpoint_regenerate(args):
+    _client(args).post(f"/v1/endpoint/{args.id}/regenerate")
+    print(f"Endpoint {args.id} regeneration queued")
+    return 0
+
+
+def cmd_identity_list(args):
+    _print(_client(args).get("/v1/identity"), args.json)
+    return 0
+
+
+def cmd_identity_get(args):
+    _print(_client(args).get(f"/v1/identity/{args.id}"), args.json)
+    return 0
+
+
+def cmd_ipcache(args):
+    _print(_client(args).get("/v1/ipcache"), args.json)
+    return 0
+
+
+def cmd_map_list(args):
+    for name in _client(args).get("/v1/map"):
+        print(name)
+    return 0
+
+
+def cmd_map_get(args):
+    _print(_client(args).get(f"/v1/map/{args.name}"), args.json)
+    return 0
+
+
+def cmd_prefilter_list(args):
+    _print(_client(args).get("/v1/prefilter"), args.json)
+    return 0
+
+
+def cmd_prefilter_update(args):
+    out = _client(args).patch(
+        "/v1/prefilter", {"revision": args.revision, "cidrs": args.cidr}
+    )
+    print(f"Revision: {out['revision']}")
+    return 0
+
+
+def cmd_prefilter_delete(args):
+    out = _client(args).delete(
+        "/v1/prefilter", {"revision": args.revision, "cidrs": args.cidr}
+    )
+    print(f"Revision: {out['revision']}")
+    return 0
+
+
+def cmd_config(args):
+    c = _client(args)
+    if args.option:
+        changes = {}
+        for opt in args.option:
+            k, _, v = opt.partition("=")
+            changes[k] = v or "true"
+        out = c.patch("/v1/config", {"options": changes})
+        _print(out, args.json)
+    else:
+        _print(c.get("/v1/config"), args.json)
+    return 0
+
+
+def cmd_metrics(args):
+    print(_client(args).get("/metrics"), end="")
+    return 0
+
+
+def cmd_monitor(args):
+    from .monitor import MonitorClient, format_event
+
+    client = MonitorClient(args.monitor_socket)
+    print("Listening for events...", file=sys.stderr)
+    try:
+        while True:
+            ev = client.next_event(timeout=1.0)
+            if ev is None:
+                continue
+            if args.json:
+                print(json.dumps(ev.to_dict()))
+            else:
+                print(format_event(ev))
+            sys.stdout.flush()
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.close()
+
+
+def cmd_version(args):
+    print(f"cilium-tpu {VERSION}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cilium-tpu",
+        description="CLI for the TPU-native cilium agent",
+    )
+    p.add_argument("--socket", default=defaults.SOCK_PATH,
+                   help="agent API unix socket")
+    p.add_argument("--json", action="store_true", help="JSON output")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("status", help="daemon status")
+    s.add_argument("--all-controllers", action="store_true")
+    s.set_defaults(fn=cmd_status)
+
+    pol = sub.add_parser("policy", help="policy management").add_subparsers(
+        dest="sub", required=True
+    )
+    x = pol.add_parser("get")
+    x.set_defaults(fn=cmd_policy_get)
+    x = pol.add_parser("import")
+    x.add_argument("file", help="policy JSON file, or - for stdin")
+    x.set_defaults(fn=cmd_policy_import)
+    x = pol.add_parser("delete")
+    x.add_argument("labels", nargs="+")
+    x.set_defaults(fn=cmd_policy_delete)
+    x = pol.add_parser("trace")
+    x.add_argument("--src", required=True, help="comma-separated labels")
+    x.add_argument("--dst", required=True)
+    x.add_argument("--dport", default="")
+    x.add_argument("-v", "--verbose", action="store_true")
+    x.set_defaults(fn=cmd_policy_trace)
+
+    ep = sub.add_parser("endpoint", help="endpoints").add_subparsers(
+        dest="sub", required=True
+    )
+    x = ep.add_parser("list")
+    x.set_defaults(fn=cmd_endpoint_list)
+    x = ep.add_parser("get")
+    x.add_argument("id", type=int)
+    x.set_defaults(fn=cmd_endpoint_get)
+    x = ep.add_parser("create")
+    x.add_argument("id", type=int)
+    x.add_argument("--ipv4", default="")
+    x.add_argument("-l", "--label", action="append")
+    x.set_defaults(fn=cmd_endpoint_create)
+    x = ep.add_parser("delete")
+    x.add_argument("id", type=int)
+    x.set_defaults(fn=cmd_endpoint_delete)
+    x = ep.add_parser("regenerate")
+    x.add_argument("id", type=int)
+    x.set_defaults(fn=cmd_endpoint_regenerate)
+
+    ident = sub.add_parser("identity", help="identities").add_subparsers(
+        dest="sub", required=True
+    )
+    x = ident.add_parser("list")
+    x.set_defaults(fn=cmd_identity_list)
+    x = ident.add_parser("get")
+    x.add_argument("id", type=int)
+    x.set_defaults(fn=cmd_identity_get)
+
+    x = sub.add_parser("ipcache", help="IP to identity mappings")
+    x.set_defaults(fn=cmd_ipcache)
+
+    mp = sub.add_parser("map", help="datapath maps").add_subparsers(
+        dest="sub", required=True
+    )
+    x = mp.add_parser("list")
+    x.set_defaults(fn=cmd_map_list)
+    x = mp.add_parser("get")
+    x.add_argument("name")
+    x.set_defaults(fn=cmd_map_get)
+
+    pf = sub.add_parser("prefilter", help="CIDR prefilter").add_subparsers(
+        dest="sub", required=True
+    )
+    x = pf.add_parser("list")
+    x.set_defaults(fn=cmd_prefilter_list)
+    x = pf.add_parser("update")
+    x.add_argument("--revision", type=int, required=True)
+    x.add_argument("--cidr", action="append", required=True)
+    x.set_defaults(fn=cmd_prefilter_update)
+    x = pf.add_parser("delete")
+    x.add_argument("--revision", type=int, required=True)
+    x.add_argument("--cidr", action="append", required=True)
+    x.set_defaults(fn=cmd_prefilter_delete)
+
+    x = sub.add_parser("config", help="get/set daemon options")
+    x.add_argument("option", nargs="*", help="Option=value pairs")
+    x.set_defaults(fn=cmd_config)
+
+    x = sub.add_parser("metrics", help="Prometheus metrics")
+    x.set_defaults(fn=cmd_metrics)
+
+    x = sub.add_parser("monitor", help="live event stream")
+    x.add_argument("--monitor-socket", default=defaults.MONITOR_SOCK_PATH)
+    x.set_defaults(fn=cmd_monitor)
+
+    x = sub.add_parser("version")
+    x.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except (ConnectionRefusedError, FileNotFoundError):
+        print(
+            f"Error: cannot reach the agent on {args.socket} "
+            "(is cilium-tpu-agent running?)",
+            file=sys.stderr,
+        )
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,498 @@
+"""Daemon core (reference: daemon/daemon.go NewDaemon + daemon/policy.go).
+
+Construction order mirrors the reference's bootstrap (daemon.go:1090):
+struct-alignment check, kvstore client, policy repository, endpoint
+builders, identity allocator (owner callback -> policy recalc trigger),
+ipcache watcher feeding the datapath map, proxy support, distribution
+server, monitor, access log, status controllers, endpoint restore.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..accesslog import AccessLogger
+from ..alignchecker import check_struct_alignments
+from ..datapath import PreFilter
+from ..distribution import (
+    AckingMutator,
+    Cache,
+    DistributionServer,
+    TYPE_NETWORK_POLICY,
+    TYPE_NETWORK_POLICY_HOSTS,
+)
+from ..endpoint import BuildQueue, Endpoint, EndpointManager, EndpointState
+from ..identity import IdentityAllocator
+from ..ipcache import (
+    IPIdentityCache,
+    IPIdentityPair,
+    KvstoreIPSync,
+    datapath_listener,
+)
+from ..kvstore import FileBackend, LocalBackend, setup_client
+from ..labels import Labels, LabelArray
+from ..maps import CtMap, IpcacheMap, LbMap, MetricsMap
+from ..monitor import (
+    AGENT_NOTIFY_POLICY_UPDATED,
+    AGENT_NOTIFY_START,
+    Monitor,
+)
+from ..policy import Repository, Rule, SearchContext, Tracing, init_entities
+from ..proxy import ProxyManager
+from ..utils import defaults
+from ..utils.controller import ControllerManager, ControllerParams
+from ..utils.logging import get_logger
+from ..utils.metrics import (
+    EndpointCount,
+    PolicyCount,
+    PolicyImportErrors,
+    PolicyRevision,
+    registry as metrics_registry,
+)
+from ..utils import option as option_mod
+from ..utils.option import DaemonConfig
+from ..utils.trigger import Trigger
+
+log = get_logger("daemon")
+
+
+class Daemon:
+    """reference: daemon/daemon.go Daemon."""
+
+    def __init__(self, config: DaemonConfig | None = None,
+                 node_name: str = "local") -> None:
+        self.config = config or DaemonConfig()
+        self.config.validate()
+        # Install as the process-global config: endpoints and other
+        # subsystems consult option.config (reference: option.Config
+        # singleton populated from flags).
+        option_mod.config = self.config
+        check_struct_alignments()  # reference: daemon bootstrap align check
+        init_entities(self.config.cluster_name)
+
+        self.node_name = node_name
+        self.controllers = ControllerManager()
+
+        # kvstore (reference: kvstore.Client setup)
+        if self.config.kvstore == "file":
+            path = self.config.kvstore_opts.get(
+                "path", os.path.join(self.config.run_dir, "kvstore.json")
+            )
+            self.kvstore = FileBackend(path)
+        else:
+            self.kvstore = LocalBackend()
+        setup_client(self.kvstore)
+
+        # Policy repository (reference: policy.NewPolicyRepository)
+        self.policy = Repository()
+        self._cidr_identities: dict[str, object] = {}
+
+        # Endpoint management + builders (reference: daemon.go:238)
+        self.endpoint_manager = EndpointManager()
+        workers = max(defaults.MIN_ENDPOINT_BUILDERS, os.cpu_count() or 1)
+        self.build_queue = BuildQueue(
+            self._build_endpoint, workers=workers
+        )
+
+        # Regeneration trigger folding policy events (reference:
+        # TriggerPolicyUpdates + pkg/trigger)
+        self.policy_trigger = Trigger(
+            self._trigger_policy_updates_now,
+            min_interval=0.05,
+            name="policy-regen",
+        )
+
+        # Identity allocation (reference: identity.InitIdentityAllocator)
+        self.identity_allocator = IdentityAllocator(
+            owner_notify=self.policy_trigger.trigger,
+            backend=self.kvstore,
+            node_name=node_name,
+        )
+
+        # ipcache + datapath map (reference: ipcache.InitIPIdentityWatcher)
+        self.ipcache = IPIdentityCache(self.config.cluster_name)
+        self.ipcache_map = IpcacheMap()
+        self.ipcache.add_listener(datapath_listener(self.ipcache_map))
+        self.ipcache_sync = KvstoreIPSync(self.ipcache, backend=self.kvstore)
+        self.ipcache_sync.start_watcher()
+
+        # Other datapath maps
+        self.ct_map = CtMap()
+        self.lb_map = LbMap()
+        self.metrics_map = MetricsMap()
+        self.prefilter = PreFilter()
+
+        # Proxy + runtime engines (reference: proxy.StartProxySupport)
+        self.proxy_manager = ProxyManager(
+            self.config.proxy_port_min,
+            self.config.proxy_port_max,
+            create_backend=self._create_proxy_backend,
+        )
+
+        # Policy distribution (reference: envoy.StartXDSServer)
+        self.dist_cache = Cache()
+        self.dist_server = DistributionServer(self.dist_cache)
+        self.acking_mutator = AckingMutator(self.dist_cache, self.dist_server)
+
+        # Monitor + access log
+        self.monitor = Monitor(self.config.monitor_queue_size)
+        self.access_logger = AccessLogger(
+            endpoint_lookup=self.endpoint_manager.lookup,
+            notify=lambda rec: self.monitor.notify(
+                _accesslog_event(rec)
+            ),
+        )
+
+        # Controllers (reference: pkg/controller usage across the daemon)
+        self.controllers.update_controller(
+            "metrics-sync",
+            ControllerParams(do_func=self._sync_metrics, run_interval=5.0),
+        )
+        self.controllers.update_controller(
+            "ct-gc",
+            ControllerParams(do_func=lambda: self.ct_map.gc(),
+                             run_interval=30.0),
+        )
+        self.controllers.update_controller(
+            "identity-gc",
+            ControllerParams(do_func=lambda: self.identity_allocator.gc(),
+                             run_interval=300.0),
+        )
+
+        # Initialize the accelerator backend once, on this thread, before
+        # builder threads race to first-touch it (concurrent first jax use
+        # from several threads is slow and can wedge plugin backends).
+        if not self.config.dry_mode:
+            try:
+                import jax
+
+                dev = jax.devices()[0]
+                log.with_field("device", str(dev)).info("device backend ready")
+            except Exception as e:  # noqa: BLE001 — degraded host-only mode
+                log.with_field("error", str(e)).warning(
+                    "no accelerator available; host-side verdicts only"
+                )
+
+        self._started = time.time()
+        self.monitor.send_agent_notification(
+            AGENT_NOTIFY_START, f"cilium-tpu agent started on {node_name}"
+        )
+
+        if self.config.restore_state:
+            self.restore_endpoints()
+
+    # -- EndpointOwner protocol -------------------------------------------
+
+    def get_policy_repository(self) -> Repository:
+        return self.policy
+
+    def get_identity_cache(self):
+        return self.identity_allocator.get_identity_cache()
+
+    def get_proxy_manager(self) -> ProxyManager:
+        return self.proxy_manager
+
+    # -- proxy backends ----------------------------------------------------
+
+    def _create_proxy_backend(self, redirect):
+        """Instantiate the runtime batch engine for a redirect; wired to
+        the per-protocol model builders (reference dispatch:
+        pkg/proxy/proxy.go:229-236)."""
+        from ..runtime.engines import create_engine_for_redirect
+
+        return create_engine_for_redirect(self, redirect)
+
+    # -- endpoint lifecycle ------------------------------------------------
+
+    def _build_endpoint(self, ep: Endpoint) -> None:
+        ok = ep.regenerate(self, "policy update")
+        if ok:
+            self._push_endpoint_policy(ep)
+            if not self.config.dry_mode:
+                ep.write_state(self._state_dir())
+
+    def _push_endpoint_policy(self, ep: Endpoint) -> None:
+        """Publish the endpoint's resolved policy to subscribed sidecars
+        (reference: pkg/envoy/server.go:628 UpdateNetworkPolicy)."""
+        if ep.desired_l4_policy is None:
+            return
+        resource = {
+            "endpoint_id": ep.id,
+            "policy_revision": ep.policy_revision,
+            "ingress_enforced": ep.ingress_policy_enabled,
+            "egress_enforced": ep.egress_policy_enabled,
+            "redirects": dict(ep.realized_redirects),
+        }
+        self.dist_cache.upsert(
+            TYPE_NETWORK_POLICY, str(ep.id), resource, force=False
+        )
+
+    def endpoint_create(
+        self, endpoint_id: int, ipv4: str = "",
+        labels: list[str] | None = None, container_name: str = "",
+    ) -> Endpoint:
+        """reference: daemon/endpoint.go createEndpoint."""
+        if self.endpoint_manager.lookup(endpoint_id) is not None:
+            raise ValueError(f"endpoint {endpoint_id} already exists")
+        ep = Endpoint(
+            endpoint_id, ipv4=ipv4, container_name=container_name,
+            labels=Labels.from_model(labels or []),
+        )
+        ep.set_state(EndpointState.WAITING_FOR_IDENTITY, "created")
+        identity, _ = self.identity_allocator.allocate(
+            ep.labels if ep.labels else Labels.from_model(["reserved:init"])
+        )
+        ep.set_identity(identity)
+        self.endpoint_manager.insert(ep)
+        EndpointCount.set(len(self.endpoint_manager))
+        if ipv4:
+            self.ipcache.upsert(ipv4, identity.id)
+            self.ipcache_sync.upsert_to_kvstore(
+                IPIdentityPair(ipv4, identity.id)
+            )
+        ep.set_state(EndpointState.WAITING_TO_REGENERATE, "identity ready")
+        self.build_queue.enqueue(ep, key=ep.id)
+        return ep
+
+    def endpoint_delete(self, endpoint_id: int) -> bool:
+        """reference: daemon/endpoint.go deleteEndpoint."""
+        ep = self.endpoint_manager.lookup(endpoint_id)
+        if ep is None:
+            return False
+        ep.set_state(EndpointState.DISCONNECTING, "delete")
+        self.proxy_manager.remove_endpoint_redirects(endpoint_id)
+        if ep.ipv4:
+            self.ipcache.delete(ep.ipv4)
+            self.ipcache_sync.delete_from_kvstore(ep.ipv4)
+        if ep.security_identity is not None:
+            self.identity_allocator.release(ep.security_identity)
+        self.endpoint_manager.remove(ep)
+        self.dist_cache.delete(TYPE_NETWORK_POLICY, str(endpoint_id))
+        ep.set_state(EndpointState.DISCONNECTED, "deleted")
+        EndpointCount.set(len(self.endpoint_manager))
+        # remove persisted state
+        ep_dir = os.path.join(self._state_dir(), str(endpoint_id))
+        cfg = os.path.join(ep_dir, "ep_config.json")
+        if os.path.isfile(cfg):
+            os.unlink(cfg)
+            try:
+                os.rmdir(ep_dir)
+            except OSError:
+                pass
+        return True
+
+    def endpoint_regenerate(self, endpoint_id: int) -> bool:
+        ep = self.endpoint_manager.lookup(endpoint_id)
+        if ep is None:
+            return False
+        ep.force_policy_compute = True
+        ep.set_state(EndpointState.WAITING_TO_REGENERATE, "api request")
+        self.build_queue.enqueue(ep, key=ep.id)
+        return True
+
+    def restore_endpoints(self) -> int:
+        """reference: daemon restoreOldEndpoints + regenerateRestored."""
+        restored = Endpoint.restore_from_dir(self._state_dir())
+        for ep in restored:
+            if self.endpoint_manager.lookup(ep.id) is not None:
+                continue
+            self.endpoint_manager.insert(ep)
+            if ep.security_identity is not None and ep.labels:
+                # Re-allocate to re-register this node's reference.
+                identity, _ = self.identity_allocator.allocate(
+                    ep.security_identity.labels
+                )
+                ep.set_identity(identity)
+            if ep.ipv4 and ep.security_identity is not None:
+                self.ipcache.upsert(ep.ipv4, ep.security_identity.id)
+            ep.set_state(EndpointState.WAITING_TO_REGENERATE, "restored")
+            self.build_queue.enqueue(ep, key=ep.id)
+        EndpointCount.set(len(self.endpoint_manager))
+        return len(restored)
+
+    def _state_dir(self) -> str:
+        d = os.path.join(self.config.run_dir, self.config.state_dir)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- policy ------------------------------------------------------------
+
+    def policy_add(self, rules: list[Rule]) -> int:
+        """reference: daemon/policy.go:171 PolicyAdd."""
+        for r in rules:
+            try:
+                r.sanitize()
+            except Exception:
+                PolicyImportErrors.inc()
+                raise
+        with self.policy.mutex:
+            rev = self.policy.add_list(rules)
+            prefixes = []
+            for r in rules:
+                prefixes.extend(r.get_cidr_prefixes())
+        # Every policy CIDR prefix gets a local identity + ipcache entry
+        # so the datapath can classify CIDR traffic (reference:
+        # daemon/policy.go:201 ipcache.AllocateCIDRs).
+        self._allocate_cidr_identities(prefixes)
+        PolicyRevision.set(rev)
+        PolicyCount.set(self.policy.num_rules())
+        self.monitor.send_agent_notification(
+            AGENT_NOTIFY_POLICY_UPDATED,
+            f"policy updated to revision {rev} ({len(rules)} rules)",
+            revision=rev,
+        )
+        self.trigger_policy_updates()
+        return rev
+
+    def _allocate_cidr_identities(self, prefixes: list[str]) -> None:
+        """reference: pkg/ipcache AllocateCIDRs — allocate an identity
+        carrying the cidr label per prefix and publish it to the ipcache."""
+        from ..labels.cidr import ip_string_to_label
+
+        for prefix in prefixes:
+            lbl = ip_string_to_label(prefix)
+            if lbl is None:
+                continue
+            lbls = Labels()
+            lbls.upsert(lbl)
+            ident, _ = self.identity_allocator.allocate(lbls)
+            self._cidr_identities[prefix] = ident
+            self.ipcache.upsert(prefix, ident.id)
+
+    def _release_unused_cidr_identities(self) -> None:
+        """Release CIDR identities no longer referenced by any rule
+        (reference: daemon/policy.go removedPrefixes refcounting)."""
+        live = set()
+        for r in self.policy.rules:
+            live.update(r.get_cidr_prefixes())
+        for prefix in list(self._cidr_identities):
+            if prefix not in live:
+                ident = self._cidr_identities.pop(prefix)
+                self.ipcache.delete(prefix)
+                self.identity_allocator.release(ident)
+
+    def policy_delete(self, labels: LabelArray) -> tuple[int, int]:
+        """reference: daemon/policy.go PolicyDelete."""
+        rev, deleted = self.policy.delete_by_labels(labels)
+        if deleted:
+            self._release_unused_cidr_identities()
+            PolicyRevision.set(rev)
+            PolicyCount.set(self.policy.num_rules())
+            self.monitor.send_agent_notification(
+                AGENT_NOTIFY_POLICY_UPDATED,
+                f"policy revision {rev}: {deleted} rules deleted",
+                revision=rev,
+            )
+            self.trigger_policy_updates()
+        return rev, deleted
+
+    def policy_get(self) -> str:
+        return self.policy.get_json()
+
+    def policy_trace(self, from_labels, to_labels, dports=None) -> tuple[str, str]:
+        """reference: cilium policy trace / daemon trace API."""
+        import io
+
+        ctx = SearchContext(
+            from_labels=from_labels, to_labels=to_labels, dports=dports or []
+        )
+        ctx.trace = Tracing.ENABLED
+        ctx.logging = io.StringIO()
+        verdict = self.policy.allows_ingress(ctx)
+        return str(verdict), ctx.logging.getvalue()
+
+    def trigger_policy_updates(self) -> None:
+        self.policy_trigger.trigger()
+
+    def _trigger_policy_updates_now(self) -> None:
+        self.endpoint_manager.trigger_policy_updates(
+            lambda ep: self.build_queue.enqueue(ep, key=ep.id)
+        )
+
+    # -- status ------------------------------------------------------------
+
+    def _sync_metrics(self) -> None:
+        EndpointCount.set(len(self.endpoint_manager))
+        PolicyRevision.set(self.policy.get_revision())
+        PolicyCount.set(self.policy.num_rules())
+
+    def status(self) -> dict:
+        """reference: daemon/status.go getStatus."""
+        return {
+            "cilium": {"state": "Ok", "uptime_s": round(
+                time.time() - self._started, 1)},
+            "kvstore": {"state": "Ok", "status": self.kvstore.status()},
+            "node": self.node_name,
+            "cluster": self.config.cluster_name,
+            "policy": {
+                "revision": self.policy.get_revision(),
+                "rules": self.policy.num_rules(),
+            },
+            "endpoints": {
+                "total": len(self.endpoint_manager),
+                "by_state": self._endpoints_by_state(),
+            },
+            "identity": {
+                "allocated": len(self.identity_allocator.get_identity_cache()),
+            },
+            "ipcache": {"entries": len(self.ipcache.dump())},
+            "proxy": {
+                "redirects": len(self.proxy_manager.redirects),
+                "port_range": (
+                    f"{self.config.proxy_port_min}-"
+                    f"{self.config.proxy_port_max}"
+                ),
+            },
+            "monitor": self.monitor.status(),
+            "controllers": [
+                {
+                    "name": s.name,
+                    "success": s.success_count,
+                    "failure": s.failure_count,
+                    "last_error": s.last_error,
+                }
+                for s in self.controllers.statuses()
+            ],
+        }
+
+    def _endpoints_by_state(self) -> dict:
+        out: dict[str, int] = {}
+        for ep in self.endpoint_manager.get_endpoints():
+            out[ep.state.value] = out.get(ep.state.value, 0) + 1
+        return out
+
+    def metrics_text(self) -> str:
+        return metrics_registry.expose()
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self) -> None:
+        self.policy_trigger.shutdown()
+        self.build_queue.stop()
+        self.controllers.remove_all()
+        self.ipcache_sync.stop()
+        self.identity_allocator.close()
+        self.kvstore.close()
+
+
+def _accesslog_event(rec):
+    from ..monitor.monitor import MSG_TYPE_ACCESS_LOG, MonitorEvent
+
+    proto = (
+        "http" if rec.http else "kafka" if rec.kafka
+        else (rec.l7.proto if rec.l7 else "?")
+    )
+    info = ""
+    if rec.http:
+        info = f"{rec.http.method} {rec.http.url} -> {rec.http.code}"
+    elif rec.kafka:
+        info = f"{rec.kafka.api_key} topics={rec.kafka.topics}"
+    elif rec.l7:
+        info = str(rec.l7.fields)
+    return MonitorEvent(
+        MSG_TYPE_ACCESS_LOG,
+        {"verdict": rec.verdict, "l7_protocol": proto, "info": info},
+    )

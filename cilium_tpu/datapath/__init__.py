@@ -1,0 +1,11 @@
+"""Datapath management: the array-native stand-in for BPF program loading.
+
+reference: pkg/datapath — where the reference compiles and attaches BPF
+programs (loader), manages the XDP prefilter (prefilter) and syncs routes,
+this build packs host-side maps into device arrays (cilium_tpu.maps/ops)
+and manages the prefilter deny-lists feeding the batched LPM op.
+"""
+
+from .prefilter import PreFilter
+
+__all__ = ["PreFilter"]

@@ -1,0 +1,79 @@
+"""XDP prefilter analog: revisioned CIDR deny-lists compiled to device LPM.
+
+reference: pkg/datapath/prefilter/prefilter.go — a pair of maps per
+protocol (v4/v6), Insert/Delete guarded by a revision counter so
+concurrent updates from stale readers are rejected; the datapath drops any
+packet whose source address matches (bpf/bpf_xdp.c check_v4).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+from typing import Optional
+
+from ..ops.lpm import DeviceLpm, build_lpm
+
+
+class PreFilter:
+    """reference: prefilter.go:125 Insert / :162 Delete."""
+
+    def __init__(self) -> None:
+        self.revision = 1
+        self._v4: set[str] = set()
+        self._v6: set[str] = set()
+        self._mutex = threading.RLock()
+        self._device_v4: Optional[DeviceLpm] = None
+        self._device_v6: Optional[DeviceLpm] = None
+        self._dirty = True
+
+    def insert(self, revision: int, cidrs: list[str]) -> int:
+        """Returns the new revision; raises on stale revision
+        (reference: prefilter.go revision check)."""
+        with self._mutex:
+            if revision != self.revision:
+                raise ValueError(
+                    f"stale prefilter revision {revision} != {self.revision}"
+                )
+            for c in cidrs:
+                net = ipaddress.ip_network(c, strict=False)
+                (self._v4 if net.version == 4 else self._v6).add(str(net))
+            self.revision += 1
+            self._dirty = True
+            return self.revision
+
+    def delete(self, revision: int, cidrs: list[str]) -> int:
+        with self._mutex:
+            if revision != self.revision:
+                raise ValueError(
+                    f"stale prefilter revision {revision} != {self.revision}"
+                )
+            for c in cidrs:
+                net = ipaddress.ip_network(c, strict=False)
+                target = self._v4 if net.version == 4 else self._v6
+                if str(net) not in target:
+                    raise KeyError(f"CIDR {net} not in prefilter")
+            for c in cidrs:
+                net = ipaddress.ip_network(c, strict=False)
+                (self._v4 if net.version == 4 else self._v6).discard(str(net))
+            self.revision += 1
+            self._dirty = True
+            return self.revision
+
+    def dump(self) -> tuple[int, list[str]]:
+        """reference: prefilter.go Dump — (revision, cidrs)."""
+        with self._mutex:
+            return self.revision, sorted(self._v4) + sorted(self._v6)
+
+    def device_lpm(self, v6: bool = False) -> DeviceLpm:
+        """Compile (cached until dirty) the deny-list to the device LPM."""
+        with self._mutex:
+            if self._dirty:
+                self._device_v4 = build_lpm(
+                    [(c, 1) for c in sorted(self._v4)], v6=False
+                )
+                self._device_v6 = build_lpm(
+                    [(c, 1) for c in sorted(self._v6)], v6=True
+                )
+                self._dirty = False
+            return self._device_v6 if v6 else self._device_v4

@@ -43,7 +43,8 @@ from ..utils.metrics import (
     EndpointRegenerationCount,
     EndpointRegenerationTime,
 )
-from ..utils.option import OptionMap, config as global_config
+from ..utils import option
+from ..utils.option import OptionMap
 from ..utils.spanstat import SpanStats
 
 log = get_logger("endpoint")
@@ -166,7 +167,7 @@ class Endpoint:
         self._prev_identity_cache: Optional[dict[int, object]] = None
 
         # Per-endpoint option overlay (reference: pkg/option/endpoint.go).
-        self.opts = OptionMap(parent=global_config.opts)
+        self.opts = OptionMap(parent=option.config.opts)
         self.stats = SpanStats()
 
     # -- state machine -----------------------------------------------------
@@ -260,7 +261,7 @@ class Endpoint:
 
     def _determine_allow_localhost(self, desired) -> None:
         """reference: policy.go:262 determineAllowLocalhost."""
-        if global_config.always_allow_localhost() or (
+        if option.config.always_allow_localhost() or (
             self.desired_l4_policy is not None
             and self.desired_l4_policy.has_redirect()
         ):
@@ -268,7 +269,7 @@ class Endpoint:
 
     def _determine_allow_world(self, desired) -> None:
         """reference: policy.go:281 determineAllowFromWorld (legacy)."""
-        if global_config.host_allows_world and LOCALHOST_KEY in desired:
+        if option.config.host_allows_world and LOCALHOST_KEY in desired:
             desired[WORLD_KEY] = PolicyMapStateEntry()
 
     def _compute_desired_l3_entries(self, repo, desired, identity_cache) -> None:
@@ -419,6 +420,17 @@ class Endpoint:
         """Full regeneration (reference: policy.go:812 Regenerate +
         :642 regenerate): policy recompute -> redirects -> map sync ->
         device export."""
+        if self.security_identity is None:
+            # No identity yet: policy cannot be computed; stay in the
+            # identity wait (reference: regeneratePolicy identity gate).
+            return False
+        # READY/NOT_READY endpoints pass through WAITING_TO_REGENERATE
+        # first (reference: the build queue sets waiting-to-regenerate on
+        # enqueue, regenerating on pickup).
+        if self.state not in (
+            EndpointState.WAITING_TO_REGENERATE, EndpointState.REGENERATING
+        ):
+            self.set_state(EndpointState.WAITING_TO_REGENERATE, reason)
         if not self.set_state(EndpointState.REGENERATING, reason):
             # Disconnecting/disconnected endpoints must not regenerate:
             # doing so would recreate redirects torn down by the daemon.
@@ -445,7 +457,7 @@ class Endpoint:
             # "Compile": pack the policy map into device arrays (the BPF
             # compile+attach analog, skipped in DryMode like the
             # reference's bpf.go:510).
-            if not global_config.dry_mode:
+            if not option.config.dry_mode:
                 stats.span("deviceExport").start()
                 self.device_policy_map = self.policy_map.to_device()
                 stats.span("deviceExport").end()

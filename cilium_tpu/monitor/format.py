@@ -1,0 +1,54 @@
+"""Human-readable event formatting for the monitor CLI.
+
+reference: pkg/monitor/{format,dissect}.go + cilium/cmd/monitor.go output.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .monitor import (
+    MSG_TYPE_ACCESS_LOG,
+    MSG_TYPE_AGENT,
+    MSG_TYPE_DEBUG,
+    MSG_TYPE_DROP,
+    MSG_TYPE_POLICY_VERDICT,
+    MSG_TYPE_TRACE,
+    MonitorEvent,
+)
+
+_PROTO = {6: "tcp", 17: "udp", 0: "any"}
+
+
+def format_event(ev: MonitorEvent) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(ev.timestamp))
+    p = ev.payload
+    if ev.type == MSG_TYPE_DROP:
+        return (
+            f"{ts} DROP: identity {p.get('src_identity')} -> "
+            f"{p.get('dst_identity')} dport {p.get('dport')}"
+            f"/{_PROTO.get(p.get('proto'), p.get('proto'))}"
+            + (f" ({p['l7']})" if p.get("l7") else "")
+        )
+    if ev.type == MSG_TYPE_POLICY_VERDICT:
+        redirect = (
+            f" redirect :{p['proxy_port']}" if p.get("proxy_port") else ""
+        )
+        return (
+            f"{ts} ALLOW: identity {p.get('src_identity')} -> "
+            f"{p.get('dst_identity')} dport {p.get('dport')}"
+            f"/{_PROTO.get(p.get('proto'), p.get('proto'))}{redirect}"
+            + (f" ({p['l7']})" if p.get("l7") else "")
+        )
+    if ev.type == MSG_TYPE_AGENT:
+        return f"{ts} AGENT: {p.get('text', '')}"
+    if ev.type == MSG_TYPE_ACCESS_LOG:
+        return (
+            f"{ts} L7: {p.get('verdict', '?')} "
+            f"{p.get('l7_protocol', '?')} {p.get('info', '')}"
+        )
+    if ev.type == MSG_TYPE_TRACE:
+        return f"{ts} TRACE: {p}"
+    if ev.type == MSG_TYPE_DEBUG:
+        return f"{ts} DEBUG: {p}"
+    return f"{ts} UNKNOWN({ev.type}): {p}"

@@ -1,0 +1,137 @@
+"""Monitor core: bounded event ring with subscriber fan-out.
+
+reference: monitor/monitor.go:106 (Monitor owning the perf reader and the
+listener set) + pkg/monitor message types (messages.go MessageType*).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..utils import defaults
+
+# Message types (reference: pkg/monitor/messages.go).
+MSG_TYPE_DROP = 1
+MSG_TYPE_DEBUG = 2
+MSG_TYPE_CAPTURE = 3
+MSG_TYPE_TRACE = 4
+MSG_TYPE_POLICY_VERDICT = 5
+MSG_TYPE_ACCESS_LOG = 6
+MSG_TYPE_AGENT = 7
+
+# Agent notification codes (reference: pkg/monitor AgentNotify*).
+AGENT_NOTIFY_START = 2
+AGENT_NOTIFY_ENDPOINT_REGENERATE_SUCCESS = 3
+AGENT_NOTIFY_ENDPOINT_REGENERATE_FAIL = 4
+AGENT_NOTIFY_POLICY_UPDATED = 5
+AGENT_NOTIFY_POLICY_DELETED = 6
+
+
+@dataclass
+class MonitorEvent:
+    type: int
+    payload: dict
+    timestamp: float = field(default_factory=time.time)
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "timestamp": self.timestamp,
+            "payload": self.payload,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "MonitorEvent":
+        return MonitorEvent(
+            type=d.get("type", 0),
+            payload=d.get("payload", {}),
+            timestamp=d.get("timestamp", 0.0),
+        )
+
+
+class Monitor:
+    """Bounded ring + listener fan-out (reference: monitor/monitor.go).
+
+    Lost events are counted, not blocked on — the perf-ring overflow
+    behavior (monitor.go lost-event accounting).
+    """
+
+    def __init__(self, queue_size: int = defaults.MONITOR_QUEUE_SIZE) -> None:
+        self.queue_size = queue_size
+        self._ring: list[MonitorEvent] = []
+        self._listeners: list[Callable[[MonitorEvent], None]] = []
+        self._mutex = threading.RLock()
+        self.events_seen = 0
+        self.events_lost = 0
+
+    def add_listener(self, listener: Callable[[MonitorEvent], None]) -> None:
+        with self._mutex:
+            self._listeners.append(listener)
+
+    def remove_listener(self, listener) -> None:
+        with self._mutex:
+            try:
+                self._listeners.remove(listener)
+            except ValueError:
+                pass
+
+    def notify(self, event: MonitorEvent) -> None:
+        with self._mutex:
+            self.events_seen += 1
+            self._ring.append(event)
+            if len(self._ring) > self.queue_size:
+                overflow = len(self._ring) - self.queue_size
+                self._ring = self._ring[overflow:]
+                self.events_lost += overflow
+            listeners = list(self._listeners)
+        for l in listeners:
+            try:
+                l(event)
+            except Exception:  # noqa: BLE001 — a bad listener never stalls
+                pass  # the stream
+
+    # Convenience emitters -------------------------------------------------
+
+    def send_agent_notification(self, code: int, text: str, **payload) -> None:
+        """reference: daemon/daemon.go:1647 SendNotification."""
+        self.notify(
+            MonitorEvent(
+                MSG_TYPE_AGENT, {"code": code, "text": text, **payload}
+            )
+        )
+
+    def send_verdict(
+        self, *, src_identity: int, dst_identity: int, dport: int, proto: int,
+        allowed: bool, proxy_port: int = 0, l7: dict | None = None,
+    ) -> None:
+        """Policy verdict event from the datapath ops/batch engines."""
+        self.notify(
+            MonitorEvent(
+                MSG_TYPE_POLICY_VERDICT if allowed else MSG_TYPE_DROP,
+                {
+                    "src_identity": src_identity,
+                    "dst_identity": dst_identity,
+                    "dport": dport,
+                    "proto": proto,
+                    "allowed": allowed,
+                    "proxy_port": proxy_port,
+                    **({"l7": l7} if l7 else {}),
+                },
+            )
+        )
+
+    def recent(self, n: int = 100) -> list[MonitorEvent]:
+        with self._mutex:
+            return self._ring[-n:]
+
+    def status(self) -> dict:
+        with self._mutex:
+            return {
+                "seen": self.events_seen,
+                "lost": self.events_lost,
+                "listeners": len(self._listeners),
+                "queued": len(self._ring),
+            }

@@ -1,0 +1,145 @@
+"""Monitor unix-socket pub/sub.
+
+reference: monitor/listener1_2.go — subscribers connect to the monitor
+socket and receive every event; slow subscribers drop events rather than
+stalling the stream.  Framing: 4-byte big-endian length + JSON event.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+from ..utils.logging import get_logger
+from .monitor import Monitor, MonitorEvent
+
+log = get_logger("monitor-server")
+
+
+class _Subscriber:
+    def __init__(self, conn: socket.socket) -> None:
+        self.conn = conn
+        self.queue: "queue.Queue[MonitorEvent]" = queue.Queue(maxsize=4096)
+        self.lost = 0
+
+
+class MonitorServer:
+    """reference: monitor/monitor.go serve loop + listener registry."""
+
+    def __init__(self, monitor: Monitor, path: str) -> None:
+        self.monitor = monitor
+        self.path = path
+        if os.path.exists(path):
+            os.unlink(path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(16)
+        self._subs: list[_Subscriber] = []
+        self._mutex = threading.Lock()
+        self._stop = threading.Event()
+        monitor.add_listener(self._fan_out)
+        threading.Thread(
+            target=self._accept_loop, name="monitor-server", daemon=True
+        ).start()
+
+    def _fan_out(self, ev: MonitorEvent) -> None:
+        with self._mutex:
+            subs = list(self._subs)
+        for s in subs:
+            try:
+                s.queue.put_nowait(ev)
+            except queue.Full:
+                s.lost += 1  # slow subscriber: drop, don't stall
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            sub = _Subscriber(conn)
+            with self._mutex:
+                self._subs.append(sub)
+            threading.Thread(
+                target=self._send_loop, args=(sub,), daemon=True
+            ).start()
+
+    def _send_loop(self, sub: _Subscriber) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    ev = sub.queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                data = json.dumps(ev.to_dict()).encode()
+                sub.conn.sendall(struct.pack(">I", len(data)) + data)
+        except OSError:
+            pass
+        finally:
+            with self._mutex:
+                try:
+                    self._subs.remove(sub)
+                except ValueError:
+                    pass
+            try:
+                sub.conn.close()
+            except OSError:
+                pass
+
+    def subscriber_count(self) -> int:
+        with self._mutex:
+            return len(self._subs)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        finally:
+            if os.path.exists(self.path):
+                os.unlink(self.path)
+
+
+class MonitorClient:
+    """Subscriber side (the `monitor` CLI command's transport)."""
+
+    def __init__(self, path: str) -> None:
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.connect(path)
+
+    def next_event(self, timeout: float | None = None) -> Optional[MonitorEvent]:
+        self._sock.settimeout(timeout)
+        try:
+            hdr = self._recv_exact(4)
+            if hdr is None:
+                return None
+            (n,) = struct.unpack(">I", hdr)
+            body = self._recv_exact(n)
+            if body is None:
+                return None
+            return MonitorEvent.from_dict(json.loads(body.decode()))
+        except socket.timeout:
+            return None
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

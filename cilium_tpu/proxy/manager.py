@@ -78,6 +78,11 @@ class ProxyManager:
                         f"{existing.l7_parser} -> {l4.l7_parser} not allowed"
                     )
                 existing.l4_filter = l4
+                # Rules or identity expansions may have changed: rebuild
+                # the serving engine's compiled model (reference: updated
+                # NPDS policy re-applied to the running proxy).
+                if self.create_backend is not None:
+                    existing.implementation = self.create_backend(existing)
                 return existing
             port = self._allocate_port()
             r = Redirect(

@@ -1,0 +1,27 @@
+"""Engine construction helpers."""
+
+from __future__ import annotations
+
+from ..models.builder import _remote_rows
+from ..policy.api import PortRuleKafka
+from ..policy.l4 import L4Filter
+
+
+def kafka_host_rows(
+    f: L4Filter, identity_cache: dict
+) -> list[tuple[frozenset, PortRuleKafka]]:
+    """(remotes, rule) rows for the host-oracle fallback path, mirroring
+    build_model_for_filter's expansion."""
+    rows: list[tuple[frozenset, PortRuleKafka]] = []
+    for sel, l7 in f.l7_rules_per_ep.items():
+        remote_chunks = _remote_rows(sel, identity_cache)
+        if remote_chunks is None:
+            continue
+        for remotes in remote_chunks:
+            if len(l7) == 0:
+                wildcard = PortRuleKafka()
+                wildcard.sanitize()
+                rows.append((remotes, wildcard))
+            for k in l7.kafka:
+                rows.append((remotes, k))
+    return rows

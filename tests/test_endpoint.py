@@ -33,7 +33,8 @@ from cilium_tpu.policy import (
     set_policy_enabled,
 )
 from cilium_tpu.proxy import ProxyManager
-from cilium_tpu.utils.option import config as global_config
+from cilium_tpu.utils import option
+from cilium_tpu.utils.option import DaemonConfig
 from cilium_tpu.labels import parse_select_label
 
 
@@ -59,9 +60,9 @@ class FakeOwner:
 
 @pytest.fixture(autouse=True)
 def _default_enforcement():
+    # Fresh global config: daemon tests install their own (dry-mode) one.
+    option.config = DaemonConfig()
     set_policy_enabled("default")
-    global_config.allow_localhost = "auto"
-    global_config.host_allows_world = False
     yield
     set_policy_enabled("default")
 
