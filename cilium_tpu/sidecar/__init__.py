@@ -1,0 +1,35 @@
+"""Sidecar verdict-service seam — the native ingestion boundary.
+
+The reference's L7 hot path crosses a process/language seam between the
+Envoy datapath and the verdict library: ``GoFilter::Instance::OnIO``
+(reference: envoy/cilium_proxylib.cc:125-214) calls the cgo exports in
+``proxylib/libcilium.h`` and applies the returned ``FilterOp`` list
+(PASS/DROP/INJECT/MORE, max 16 ops per call) to its byte buffers.
+
+This package is the TPU-native equivalent of that seam:
+
+- ``wire``     — a columnar binary protocol for per-connection byte batches
+                 and FilterOp verdict batches over a unix socket (the ABI
+                 analog of libcilium.h, shaped for numpy/device dispatch)
+- ``dispatch`` — the adaptive fill-vs-deadline batch dispatcher (consumes
+                 ``batch_timeout_ms``; bounds added latency while filling
+                 device batches)
+- ``service``  — the verdict service: module/policy registry + batched
+                 device models behind the wire protocol
+- ``client``   — a Python datapath shim (per-connection buffering, the
+                 OnIO byte-accounting contract) used by tests and benches
+
+The native C++ shim implementing the same client contract lives in
+``native/`` (built to ``libcilium_tpu_shim.so``).
+"""
+
+from .client import ShimConnection, SidecarClient
+from .dispatch import BatchDispatcher
+from .service import VerdictService
+
+__all__ = [
+    "BatchDispatcher",
+    "ShimConnection",
+    "SidecarClient",
+    "VerdictService",
+]

@@ -1,0 +1,140 @@
+"""Adaptive fill-vs-deadline batch dispatcher.
+
+Device dispatch is most efficient at full batches, but a request that
+arrives into an idle service must not wait a full batch's worth of fill
+time — the p99 budget is <1ms added latency (BASELINE.json north star).
+The dispatcher implements the standard fill-vs-deadline tradeoff:
+
+- a batch is dispatched immediately once pending work reaches
+  ``max_batch`` entries (fill), or
+- when the oldest pending entry has waited ``batch_timeout_ms``
+  (deadline), whichever comes first.
+
+The deadline timer arms when the first item lands in an empty queue, so
+an idle service adds at most ``batch_timeout_ms`` + one device pass to
+any request.  This is the consumer of ``DaemonConfig.batch_timeout_ms``
+(utils/option.py) — the reference has no device batching; its nearest
+analog is the per-request proxy dispatch in GoFilter::Instance::OnIO
+(reference: envoy/cilium_proxylib.cc:125), which this component amortizes
+across flows.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+
+class BatchDispatcher:
+    """Collects submitted items and hands batches to ``process`` on a
+    dedicated worker thread.
+
+    ``process(items)`` receives the pending list (oldest first).  Each
+    item carries a ``weight`` (entry count for wire requests) counted
+    toward the fill threshold.
+    """
+
+    def __init__(
+        self,
+        process: Callable[[list[Any]], None],
+        max_batch: int = 2048,
+        timeout_ms: float = 0.5,
+        name: str = "verdict-dispatch",
+    ):
+        self.process = process
+        self.max_batch = max_batch
+        self.timeout_s = timeout_ms / 1000.0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: list[Any] = []
+        self._pending_weight = 0
+        self._oldest_ts = 0.0
+        self._stopped = False
+        self._in_process_lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run, name=name, daemon=True)
+        # Dispatch telemetry (read by benches/status).
+        self.batches = 0
+        self.entries = 0
+        self.fill_dispatches = 0
+        self.deadline_dispatches = 0
+
+    def start(self) -> "BatchDispatcher":
+        self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._worker.join(timeout=5)
+
+    def submit(self, item: Any, weight: int = 1) -> None:
+        with self._cond:
+            if not self._pending:
+                self._oldest_ts = time.perf_counter()
+            self._pending.append(item)
+            self._pending_weight += weight
+            self._cond.notify()
+
+    def flush(self) -> None:
+        """Block until everything submitted so far has been processed."""
+        while True:
+            with self._cond:
+                if not self._pending:
+                    break
+            time.sleep(0.0005)
+        # One more beat for the batch currently in process().
+        with self._in_process_lock:
+            pass
+
+    def _take(self) -> tuple[list[Any], bool]:
+        """Wait for fill or deadline; returns (batch, was_deadline)."""
+        with self._cond:
+            while True:
+                if self._stopped:
+                    batch = self._pending
+                    self._pending = []
+                    self._pending_weight = 0
+                    return batch, False
+                if self._pending_weight >= self.max_batch:
+                    batch = self._pending
+                    self._pending = []
+                    self._pending_weight = 0
+                    return batch, False
+                if self._pending:
+                    wait = self.timeout_s - (time.perf_counter() - self._oldest_ts)
+                    if wait <= 0:
+                        batch = self._pending
+                        self._pending = []
+                        self._pending_weight = 0
+                        return batch, True
+                    self._cond.wait(wait)
+                else:
+                    self._cond.wait()
+
+    def _run(self) -> None:
+        while True:
+            batch, deadline = self._take()
+            if batch:
+                with self._in_process_lock:
+                    self.batches += 1
+                    self.entries += len(batch)
+                    if deadline:
+                        self.deadline_dispatches += 1
+                    else:
+                        self.fill_dispatches += 1
+                    try:
+                        self.process(batch)
+                    except Exception:  # noqa: BLE001 — worker must survive
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "batch process failed"
+                        )
+            if self._stopped and not batch:
+                return
+            if self._stopped:
+                with self._cond:
+                    if not self._pending:
+                        return

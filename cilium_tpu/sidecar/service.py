@@ -1,0 +1,980 @@
+"""The verdict service: batched device models behind the wire seam.
+
+The standalone-process analog of the reference's verdict library: where
+the reference loads ``libcilium.so`` into Envoy and parses per request
+(reference: envoy/cilium_proxylib.cc:125 OnIO -> proxylib OnData), this
+service accepts per-connection byte batches from datapath shims over a
+unix socket, aggregates them across shims with the adaptive
+fill-vs-deadline dispatcher, renders verdicts with the batched TPU
+models, and returns FilterOp lists.
+
+Verdict paths, fastest first:
+
+1. **Vectorized fast path** — request-direction entries that carry
+   exactly one complete frame for a flow with no buffered remainder are
+   lifted straight into a ``[n, width]`` device batch with O(1) numpy
+   gathers (no per-flow Python state), and ops are emitted from the
+   verdict arrays.  This is the steady-state hot loop.
+2. **Engine slow path** — stateful flows (partial frames, pipelined
+   frames, carried NFA state) go through the per-protocol batch engines
+   (runtime/batch.py, runtime/engines.py), still device-batched.
+3. **Oracle path** — protocols without a device model, and all reply
+   direction traffic, run the in-process streaming parsers
+   (proxylib/) — the same code that defines bit-exactness.
+
+Access logs on the fast path are recorded columnarly (verdict counters +
+the standard logger on a sampled subset is NOT used — every request is
+logged, but via one appended batch record) to keep host Python off the
+per-request critical path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import socket
+import threading
+
+import numpy as np
+
+from ..models.base import ConstVerdict
+from ..proxylib import instance as pl
+from ..proxylib.accesslog import EntryType, LogEntry
+from ..proxylib.npds import policy_from_dict
+from ..proxylib.types import DROP, MORE, PASS, FilterResult
+from ..runtime.batch import R2d2BatchEngine
+from ..utils.option import DaemonConfig
+from . import wire
+from .dispatch import BatchDispatcher
+
+log = logging.getLogger(__name__)
+
+
+class _SidecarConn:
+    """Service-side state for one datapath connection."""
+
+    __slots__ = ("conn", "client", "bufs", "engine", "fast_ok", "skip")
+
+    def __init__(self, conn, client, engine):
+        self.conn = conn  # in-process oracle Connection
+        self.client = client
+        # Mirror of the datapath's unconsumed buffer, per direction
+        # (False=orig/request, True=reply).
+        self.bufs = {False: bytearray(), True: bytearray()}
+        self.engine = engine  # batch engine for request direction, or None
+        self.fast_ok = engine is not None
+        # Bytes already covered by an earlier PASS/DROP verdict that
+        # overshot the then-buffered input (a parser may decide on a
+        # frame prefix, reference: libcilium.h OnData comment); they are
+        # consumed on arrival without re-parsing.
+        self.skip = {False: 0, True: 0}
+
+
+class _ColumnarLog:
+    """Batched access-log sink for the fast path: one record per device
+    batch instead of one Python object per request.  The per-batch ring
+    is bounded; the running counters are exact."""
+
+    def __init__(self, maxlen: int = 4096):
+        from collections import deque
+
+        self.batches = deque(maxlen=maxlen)
+        self.requests = 0
+        self.denied = 0
+
+    def log_batch(self, proto: str, n: int, denied: int) -> None:
+        self.requests += n
+        self.denied += denied
+        self.batches.append({"proto": proto, "n": n, "denied": denied})
+
+
+class VerdictService:
+    """Unix-socket verdict service.
+
+    One acceptor thread, one reader thread per shim connection, one
+    dispatcher worker owning all device dispatch (so device models are
+    only ever called from a single thread — jit caches stay warm and
+    per-flow engine state needs no locking beyond the dispatcher's
+    serialization).
+    """
+
+    def __init__(self, socket_path: str, config: DaemonConfig | None = None):
+        self.socket_path = socket_path
+        self.config = config or DaemonConfig()
+        self.dispatcher = BatchDispatcher(
+            self._process,
+            max_batch=self.config.batch_flows,
+            timeout_ms=self.config.batch_timeout_ms,
+        )
+        self._lock = threading.Lock()  # conn/engine registry
+        self._conns: dict[int, _SidecarConn] = {}
+        self._engines: dict[tuple, object] = {}
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._stopped = False
+        self.fast_log = _ColumnarLog()
+        # Vectorized-path conn table: parallel arrays indexed by conn_id
+        # (grown on demand) so batch eligibility and remote-identity
+        # lookups are O(1) numpy gathers instead of per-entry dict walks.
+        self._tab_size = 0
+        self._tab_engine = np.empty(0, np.int32)  # engine idx, -1 = none
+        self._tab_src = np.empty(0, np.int32)  # remote identity (src_id)
+        self._tab_dirty = np.empty(0, np.uint8)  # 1 = residual state
+        self._engine_objs: list[object] = []
+        self._engine_idx: dict[int, int] = {}  # id(engine) -> table idx
+        self._engine_free: list[int] = []
+        self._jit_cache: dict[type, object] = {}
+        self.vec_batches = 0
+        self.vec_entries = 0
+        # Completion pipeline: the dispatcher issues device calls without
+        # blocking (jax arrays are futures); this FIFO queue + worker
+        # materializes results and sends responses, so host batch
+        # assembly overlaps device compute and the device round-trip
+        # latency never stalls the dispatch loop.  FIFO order preserves
+        # per-connection op order across vec and entrywise rounds.
+        self._completions: "queue.Queue" = queue.Queue()
+        self._completion_thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "VerdictService":
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(16)
+        self.dispatcher.start()
+        self._completion_thread = threading.Thread(
+            target=self._completion_loop, name="verdict-complete", daemon=True
+        )
+        self._completion_thread.start()
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            if self._listener is not None:
+                self._listener.close()
+        except OSError:
+            pass
+        self.dispatcher.stop()
+        if self._completion_thread is not None:
+            self._completions.put(("stop",))
+            self._completion_thread.join(timeout=5)
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            client = _ClientHandler(self, sock)
+            t = threading.Thread(target=client.read_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- control plane (called from client reader threads) ----------------
+
+    def open_module(self, params, debug: bool) -> int:
+        return pl.open_module(params, debug)
+
+    def close_module(self, module_id: int) -> None:
+        pl.close_module(module_id)
+
+    def policy_update(self, module_id: int, policies_json: bytes) -> int:
+        ins = pl.find_instance(module_id)
+        if ins is None:
+            return int(FilterResult.INVALID_INSTANCE)
+        try:
+            configs = [policy_from_dict(d) for d in json.loads(policies_json)]
+            ins.policy_update(configs)
+        except Exception:  # noqa: BLE001 — NACK, active policy untouched
+            log.exception("policy update rejected")
+            return int(FilterResult.POLICY_DROP)
+        with self._lock:
+            # Drop engines compiled against the old policy map and free
+            # their table slots.
+            dropped = [
+                v for k, v in self._engines.items() if k[0] == module_id
+            ]
+            self._engines = {
+                k: v for k, v in self._engines.items() if k[0] != module_id
+            }
+            self._release_engines(dropped)
+            affected = [
+                sc for sc in self._conns.values() if sc.conn.instance is ins
+            ]
+            for sc in affected:
+                sc.engine = None
+                sc.fast_ok = False
+                cid = sc.conn.conn_id
+                if cid < self._tab_size:
+                    self._tab_engine[cid] = -1  # no vec until rebound
+        for sc in affected:
+            self._bind_engine(module_id, sc)
+            with self._lock:
+                self._tab_set_engine(
+                    sc.conn.conn_id, sc.engine if sc.fast_ok else None
+                )
+        return int(FilterResult.OK)
+
+    def new_connection(self, module_id, conn_id, ingress, src_id, dst_id,
+                       proto, src_addr, dst_addr, policy_name, client) -> int:
+        res, conn = pl.on_new_connection(
+            module_id, proto, conn_id, ingress, src_id, dst_id,
+            src_addr, dst_addr, policy_name,
+        )
+        if res != FilterResult.OK:
+            return int(res)
+        sc = _SidecarConn(conn, client, None)
+        self._bind_engine(module_id, sc)
+        with self._lock:
+            self._conns[conn_id] = sc
+            if self._tab_ensure(conn_id):
+                self._tab_src[conn_id] = conn.src_id
+                self._tab_dirty[conn_id] = 0
+            self._tab_set_engine(conn_id, sc.engine if sc.fast_ok else None)
+        return int(res)
+
+    _TAB_MAX = 1 << 22  # conns with larger ids use the entrywise path
+
+    def _tab_ensure(self, conn_id: int) -> bool:
+        """Grow the conn table to cover conn_id; False if out of range."""
+        if conn_id >= self._TAB_MAX:
+            return False
+        if conn_id >= self._tab_size:
+            new_size = max(4096, self._tab_size)
+            while new_size <= conn_id:
+                new_size *= 2
+            for name, fill, dt in (
+                ("_tab_engine", -1, np.int32),
+                ("_tab_src", 0, np.int32),
+                ("_tab_dirty", 0, np.uint8),
+            ):
+                arr = np.full(new_size, fill, dt)
+                arr[: self._tab_size] = getattr(self, name)
+                setattr(self, name, arr)
+            self._tab_size = new_size
+        return True
+
+    def _tab_set_engine(self, conn_id: int, engine) -> None:
+        if not self._tab_ensure(conn_id):
+            return
+        if engine is None:
+            self._tab_engine[conn_id] = -1
+            return
+        idx = self._engine_idx.get(id(engine))
+        if idx is None:
+            if self._engine_free:
+                idx = self._engine_free.pop()
+                self._engine_objs[idx] = engine
+            else:
+                idx = len(self._engine_objs)
+                self._engine_objs.append(engine)
+            self._engine_idx[id(engine)] = idx
+        self._tab_engine[conn_id] = idx
+
+    def _release_engines(self, engines: list) -> None:
+        """Return dropped engines' table slots to the free list so
+        superseded models (and their device buffers) can be collected."""
+        for eng in engines:
+            idx = self._engine_idx.pop(id(eng), None)
+            if idx is not None:
+                self._engine_objs[idx] = None
+                self._engine_free.append(idx)
+
+    def _tab_mark(self, conn_id: int, sc: "_SidecarConn") -> None:
+        """Refresh the dirty flag from actual residual state."""
+        if conn_id >= self._tab_size:
+            return
+        flow = sc.engine.flows.get(conn_id) if sc.engine is not None else None
+        dirty = bool(
+            (flow is not None and flow.buffer)
+            or sc.bufs[False]
+            or sc.bufs[True]
+            or sc.skip[False]
+            or sc.skip[True]
+        )
+        self._tab_dirty[conn_id] = 1 if dirty else 0
+
+    def _bind_engine(self, module_id: int, sc: _SidecarConn) -> None:
+        """Attach the device batch engine for this connection's
+        (policy, direction, port, proto), building the model on first use."""
+        conn = sc.conn
+        if conn.parser_name != "r2d2":
+            return  # other protocols: oracle path (device models pending)
+        key = (module_id, conn.policy_name, conn.ingress, conn.port, "r2d2")
+        with self._lock:
+            eng = self._engines.get(key)
+        if eng is None:
+            # Build and prewarm OUTSIDE the registry lock: XLA compiles
+            # are slow and must not stall unrelated control/data traffic.
+            from ..models.r2d2 import build_r2d2_model
+
+            ins = pl.find_instance(module_id)
+            policy = ins.policy_map().get(conn.policy_name)
+            model = build_r2d2_model(policy, conn.ingress, conn.port)
+            eng = R2d2BatchEngine(
+                model,
+                capacity=self.config.batch_flows,
+                width=self.config.batch_width,
+                logger=ins.access_logger,
+            )
+            self.prewarm(eng)
+            with self._lock:
+                # Double-checked insert: a racing binder may have won.
+                eng = self._engines.setdefault(key, eng)
+        sc.engine = eng
+        sc.fast_ok = True
+
+    def close_connection(self, conn_id: int, expect=None) -> None:
+        # Routed through the dispatcher by the caller so in-flight data
+        # for this conn is processed first.  ``expect`` pins the
+        # connection object captured at submit time: if the id was
+        # reused for a NEW connection before the deferred close ran, the
+        # fresh connection must survive.
+        with self._lock:
+            sc = self._conns.get(conn_id)
+            if sc is None or (expect is not None and sc is not expect):
+                return
+            del self._conns[conn_id]
+            if conn_id < self._tab_size:
+                self._tab_engine[conn_id] = -1
+                self._tab_dirty[conn_id] = 0
+        if sc.engine is not None:
+            sc.engine.close_flow(conn_id)
+        pl.close_connection(conn_id)
+
+    # -- data plane (dispatcher worker thread only) -----------------------
+
+    def submit_data(self, client, batch: wire.DataBatch) -> None:
+        self.dispatcher.submit(("data", client, batch), weight=batch.count)
+
+    def submit_close(self, conn_id: int) -> None:
+        with self._lock:
+            sc = self._conns.get(conn_id)
+        self.dispatcher.submit(("close", conn_id, sc), weight=0)
+
+    def _process(self, items: list) -> None:
+        """Dispatcher entry: triage aggregated items.
+
+        Whole DATA batches that are homogeneous (request direction,
+        single complete frame per entry, stateless conns on one engine)
+        take the fully vectorized path — O(1) numpy ops + one device
+        call, no per-entry Python.  Everything else falls to the
+        entrywise path below.  A vec-eligible batch is demoted if it
+        shares a connection with an entrywise batch in the same round,
+        preserving per-connection op order.
+        """
+        closes = [it[1:] for it in items if it[0] == "close"]
+        data_items = [it for it in items if it[0] in ("data", "mat")]
+        vec: list[tuple] = []  # (item, engine) — item kind "data" or "mat"
+        general: list = []  # (arrival_idx, item)
+        for k, it in enumerate(data_items):
+            if it[0] == "mat":
+                eng = self._matrix_eligible(it[2])
+                if eng is None:
+                    it = ("data", it[1], _matrix_to_batch(it[2]))
+            else:
+                eng = self._vec_eligible(it[2])
+            if eng is not None:
+                vec.append((k, it, eng))
+            else:
+                general.append((k, it))
+        if vec and general:
+            gen_conns = np.unique(
+                np.concatenate([it[2].conn_ids for _, it in general])
+            )
+            kept = []
+            for k, it, eng in vec:
+                if np.isin(it[2].conn_ids, gen_conns).any():
+                    if it[0] == "mat":
+                        it = ("data", it[1], _matrix_to_batch(it[2]))
+                    general.append((k, it))
+                else:
+                    kept.append((k, it, eng))
+            if len(kept) != len(vec):
+                # Re-establish arrival order among entrywise items.
+                general.sort(key=lambda rec: rec[0])
+            vec = kept
+        if vec:
+            self._run_vec([(it, eng) for _, it, eng in vec])
+        if general:
+            self._process_entrywise([it for _, it in general])
+        for close_args in closes:
+            self.close_connection(*close_args)
+
+    def _matrix_eligible(self, mb: wire.MatrixBatch):
+        """Engine for a fixed-width matrix batch, or None to fall back."""
+        n = mb.count
+        if n == 0 or mb.width != self.config.batch_width:
+            return None
+        cids = mb.conn_ids
+        if int(cids.max()) >= self._tab_size:
+            return None
+        idx = cids.astype(np.int64)
+        eng_idx = self._tab_engine[idx]
+        e0 = int(eng_idx[0])
+        if e0 < 0 or (eng_idx != e0).any():
+            return None
+        if self._tab_dirty[idx].any():
+            return None
+        lengths = mb.lengths
+        if int(lengths.min()) < 2 or int(lengths.max()) > mb.width:
+            return None
+        engine = self._engine_objs[e0]
+        if engine is None or isinstance(engine.model, ConstVerdict):
+            return None
+        rows = mb.rows
+        li = lengths.astype(np.int64)
+        ar = np.arange(n)
+        if not (
+            (rows[ar, li - 2] == 13) & (rows[ar, li - 1] == 10)
+        ).all():
+            return None
+        if ((rows == 13).sum(axis=1) != 1).any():
+            return None
+        return engine
+
+    def _vec_eligible(self, batch: wire.DataBatch):
+        """The engine serving every entry of this batch vectorized, or
+        None if any entry needs the entrywise path."""
+        n = batch.count
+        if n == 0:
+            return None
+        if batch.flags.any():  # reply or end_stream entries
+            return None
+        cids = batch.conn_ids
+        if int(cids.max()) >= self._tab_size:
+            return None
+        idx = cids.astype(np.int64)
+        eng_idx = self._tab_engine[idx]
+        e0 = int(eng_idx[0])
+        if e0 < 0 or (eng_idx != e0).any():
+            return None
+        if self._tab_dirty[idx].any():
+            return None
+        lengths = batch.lengths
+        if int(lengths.min()) < 2 or int(lengths.max()) > self.config.batch_width:
+            return None
+        engine = self._engine_objs[e0]
+        if engine is None or isinstance(engine.model, ConstVerdict):
+            return None
+        blob = np.frombuffer(batch.blob, np.uint8)
+        if len(blob) != int(lengths.sum()):
+            return None
+        offs = batch.offsets
+        ends = offs[1:]
+        if not ((blob[ends - 2] == 13) & (blob[ends - 1] == 10)).all():
+            return None
+        # Exactly one CR per entry => exactly one frame, ending at the
+        # entry boundary (r2d2 frames on the first CRLF).
+        crs = np.add.reduceat((blob == 13).astype(np.int32), offs[:-1])
+        if (crs != 1).any():
+            return None
+        return engine
+
+    # Fixed device batch buckets: padded shapes are drawn from this small
+    # set so XLA compiles each (bucket, width) once and never again — the
+    # anti-churn guard for mixed batch sizes.
+    MIN_BUCKET = 256
+
+    def _buckets(self) -> list[int]:
+        out = [self.MIN_BUCKET]
+        while out[-1] < self.config.batch_flows:
+            out.append(out[-1] * 2)
+        return out
+
+    def _model_call(self, model, data, lens, remotes):
+        """One jitted device dispatch per batch (models are registered
+        pytrees, so the jit cache keys on shapes and policy swaps reuse
+        the compiled executable)."""
+        fn = self._jit_cache.get(type(model))
+        if fn is None:
+            import jax
+
+            fn = jax.jit(type(model).__call__)
+            self._jit_cache[type(model)] = fn
+        return fn(model, data, lens, remotes)
+
+    def prewarm(self, engine) -> None:
+        """Compile the engine model for every bucket shape up front so
+        the first real batch never pays a compile."""
+        if isinstance(engine.model, ConstVerdict):
+            return
+        width = self.config.batch_width
+        for b in self._buckets():
+            out = self._model_call(
+                engine.model,
+                np.zeros((b, width), np.uint8),
+                np.zeros(b, np.int32),
+                np.zeros(b, np.int32),
+            )
+            np.asarray(out[-1])
+
+    def _run_vec(self, vec_items: list) -> None:
+        """One device call per engine chunk over the concatenated
+        batches, ops emitted columnar straight from the verdict arrays."""
+        groups: dict[int, list] = {}
+        for it, eng in vec_items:
+            groups.setdefault(id(eng), []).append((it, eng))
+        for group in groups.values():
+            engine = group[0][1]
+            mats = [it for it, _ in group if it[0] == "mat"]
+            datas = [it for it, _ in group if it[0] == "data"]
+            # Matrix items arrive pre-padded: device chunks are plain
+            # row-slices, no gather.  Aggregate across items so one
+            # device pass covers the whole round.
+            if mats:
+                if len(mats) == 1:
+                    m_rows = mats[0][2].rows
+                    m_lens = mats[0][2].lengths.astype(np.int32)
+                    m_ids = mats[0][2].conn_ids
+                else:
+                    m_rows = np.concatenate([it[2].rows for it in mats])
+                    m_lens = np.concatenate(
+                        [it[2].lengths for it in mats]
+                    ).astype(np.int32)
+                    m_ids = np.concatenate([it[2].conn_ids for it in mats])
+                issued = self._issue_chunks(engine, m_rows, m_lens, m_ids)
+                sends, start = [], 0
+                for _, client, mb in mats:
+                    sends.append(
+                        (client, mb.seq, mb.conn_ids, mb.lengths,
+                         start, start + mb.count)
+                    )
+                    start += mb.count
+                self._completions.put(("vec", issued, start, sends))
+            if not datas:
+                continue
+            batches = [it[2] for it in datas]
+            conn_ids = np.concatenate([b.conn_ids for b in batches])
+            lengths = np.concatenate(
+                [b.lengths for b in batches]
+            ).astype(np.int32)
+            blob = np.frombuffer(
+                b"".join(b.blob for b in batches), np.uint8
+            )
+            n = len(conn_ids)
+            width = self.config.batch_width
+            offs = np.concatenate(
+                ([0], np.cumsum(lengths.astype(np.int64)))
+            )[:-1]
+            col = np.arange(width)[None, :]
+            gather = offs[:, None] + col
+            mask = col < lengths[:, None]
+            rows = blob[np.minimum(gather, len(blob) - 1)] * mask
+            issued = self._issue_chunks(engine, rows, lengths, conn_ids)
+            sends, start = [], 0
+            for _, client, batch in datas:
+                sends.append(
+                    (client, batch.seq, conn_ids[start : start + batch.count],
+                     lengths[start : start + batch.count],
+                     start, start + batch.count)
+                )
+                start += batch.count
+            self._completions.put(("vec", issued, n, sends))
+
+    def _issue_chunks(self, engine, rows, lengths, conn_ids) -> list:
+        """Issue device calls over [n, width] rows in fixed bucket-shaped
+        chunks WITHOUT blocking; returns [(allow_future, a, b, cn)] for
+        the completion worker to materialize."""
+        n = len(conn_ids)
+        width = rows.shape[1]
+        issued = []
+        max_chunk = self.config.batch_flows
+        for a in range(0, n, max_chunk):
+            b = min(a + max_chunk, n)
+            cn = b - a
+            f_pad = self.MIN_BUCKET
+            while f_pad < cn:
+                f_pad *= 2
+            data = np.zeros((f_pad, width), np.uint8)
+            data[:cn] = rows[a:b]
+            lens = np.zeros(f_pad, np.int32)
+            lens[:cn] = lengths[a:b]
+            remotes = np.zeros(f_pad, np.int32)
+            remotes[:cn] = self._tab_src[conn_ids[a:b].astype(np.int64)]
+            _, _, chunk_allow = self._model_call(engine.model, data, lens, remotes)
+            issued.append((chunk_allow, a, b, cn))
+        return issued
+
+    def _completion_loop(self) -> None:
+        """Materializes issued device futures in FIFO order and sends
+        verdict batches — the only thread that blocks on the device.
+
+        All pending records are drained and their futures materialized
+        in ONE ``jax.device_get`` so device→host readbacks overlap: a
+        readback costs a full link round trip, and N sequential
+        readbacks would serialize at N round trips while one batched
+        readback pays ~1 (measured; essential when the chip is reached
+        through a high-latency tunnel)."""
+        import jax
+
+        while True:
+            rec = self._completions.get()
+            recs = [rec]
+            while True:
+                try:
+                    recs.append(self._completions.get_nowait())
+                except queue.Empty:
+                    break
+            stop = any(r[0] == "stop" for r in recs)
+            futs = [
+                fut
+                for r in recs
+                if r[0] == "vec"
+                for fut, _, _, _ in r[1]
+            ]
+            try:
+                vals = jax.device_get(futs) if futs else []
+            except Exception:  # noqa: BLE001
+                log.exception("device readback failed")
+                vals = [None] * len(futs)
+            vi = 0
+            for r in recs:
+                try:
+                    if r[0] == "vec":
+                        _, issued, n, sends = r
+                        allow = np.empty(n, bool)
+                        for _, a, b, cn in issued:
+                            v = vals[vi]
+                            vi += 1
+                            if v is None:
+                                allow[a:b] = False
+                            else:
+                                allow[a:b] = np.asarray(v)[:cn]
+                        self.fast_log.log_batch(
+                            "r2d2", n, int(n - allow.sum())
+                        )
+                        self.vec_batches += 1
+                        self.vec_entries += n
+                        for client, seq, ids, lens, a, b in sends:
+                            self._send_columnar(
+                                client, seq, ids, lens, allow[a:b]
+                            )
+                    elif r[0] == "ready":
+                        _, client, seq, entries = r
+                        client.send_verdicts(seq, entries)
+                except Exception:  # noqa: BLE001 — worker must survive
+                    log.exception("completion failed")
+            if stop:
+                return
+
+    _ERR_ROW = np.frombuffer(b"ERROR\r\n", np.uint8)
+
+    def _send_columnar(self, client, seq, conn_ids, lengths, allow) -> None:
+        """Columnar op assembly: every entry is (PASS|DROP frame, MORE 1)
+        — identical to the streaming oracle's op sequence for one
+        complete frame (reference: r2d2parser.go:158-213)."""
+        n = len(conn_ids)
+        denied = ~allow
+        ops = np.zeros(2 * n, wire.FILTER_OP)
+        ops["op"][0::2] = np.where(allow, int(PASS), int(DROP))
+        ops["n_bytes"][0::2] = lengths
+        ops["op"][1::2] = int(MORE)
+        ops["n_bytes"][1::2] = 1
+        nd = int(denied.sum())
+        inj_blob = (
+            np.broadcast_to(self._ERR_ROW, (nd, 7)).tobytes() if nd else b""
+        )
+        client.send(
+            wire.MSG_VERDICT_BATCH,
+            wire.pack_verdict_batch(
+                seq,
+                conn_ids,
+                np.zeros(n, np.uint32),
+                np.full(n, 2, np.uint32),
+                np.zeros(n, np.uint32),
+                np.where(denied, 7, 0).astype(np.uint32),
+                ops,
+                inj_blob,
+            ),
+        )
+
+    def _process_entrywise(self, items: list) -> None:
+        # Per-entry path, preserving per-connection order: an entry is
+        # fast only if nothing earlier in this round put its connection
+        # on the slow path.
+        responses: dict[int, list] = {}  # id(item) -> per-entry results
+        fast: list[tuple] = []  # (item_key, entry_idx, sc, data)
+        slow: list[tuple] = []
+        slow_conns: set[int] = set()
+
+        for item in items:
+            _, client, batch = item
+            key = id(item)
+            responses[key] = [None] * batch.count
+            with self._lock:
+                conns_snapshot = self._conns
+            for i in range(batch.count):
+                conn_id, reply, end_stream, data = batch.entry(i)
+                sc = conns_snapshot.get(conn_id)
+                if sc is None:
+                    responses[key][i] = (
+                        conn_id,
+                        int(FilterResult.UNKNOWN_CONNECTION),
+                        [],
+                        b"",
+                        b"",
+                    )
+                    continue
+                if sc.skip[reply]:
+                    take = min(sc.skip[reply], len(data))
+                    sc.skip[reply] -= take
+                    data = data[take:]
+                    if not data:
+                        self._tab_mark(conn_id, sc)
+                        responses[key][i] = (
+                            conn_id, int(FilterResult.OK), [], b"", b"",
+                        )
+                        continue
+                eng_flow = (
+                    sc.engine.flows.get(conn_id) if sc.engine is not None else None
+                )
+                if (
+                    sc.fast_ok
+                    and not reply
+                    and conn_id not in slow_conns
+                    and not sc.bufs[False]
+                    and (eng_flow is None or not eng_flow.buffer)
+                    and not isinstance(sc.engine.model, ConstVerdict)
+                    and len(data) >= 2
+                    and data.endswith(b"\r\n")
+                    and data.find(b"\r\n") == len(data) - 2
+                    and len(data) <= self.config.batch_width
+                ):
+                    fast.append((key, i, sc, conn_id, data))
+                else:
+                    slow_conns.add(conn_id)
+                    slow.append((key, i, sc, conn_id, reply, end_stream, data))
+
+        if fast:
+            self._run_fast(fast, responses)
+        for key, i, sc, conn_id, reply, end_stream, data in slow:
+            responses[key][i] = self._run_slow(sc, conn_id, reply, end_stream, data)
+            self._tab_mark(conn_id, sc)
+
+        # Emit one verdict batch per data item, in arrival order —
+        # through the completion queue so responses stay FIFO with any
+        # in-flight vec rounds.
+        for item in items:
+            _, client, batch = item
+            self._completions.put(
+                ("ready", client, batch.seq, responses[id(item)])
+            )
+
+    def _run_fast(self, fast: list, responses: dict) -> None:
+        """Vectorized single-frame path: entries grouped per engine, one
+        device call per group, ops emitted from the verdict arrays."""
+        groups: dict[int, list] = {}
+        for rec in fast:
+            groups.setdefault(id(rec[2].engine), []).append(rec)
+        for recs in groups.values():
+            engine = recs[0][2].engine
+            n = len(recs)
+            width = self.config.batch_width
+            f_pad = self.MIN_BUCKET  # bucketed shapes, no jit churn
+            while f_pad < n:
+                f_pad *= 2
+            data = np.zeros((f_pad, width), np.uint8)
+            lengths = np.zeros((f_pad,), np.int32)
+            remotes = np.zeros((f_pad,), np.int32)
+            for i, (_, _, sc, _, payload) in enumerate(recs):
+                arr = np.frombuffer(payload, np.uint8)
+                data[i, : len(arr)] = arr
+                lengths[i] = len(arr)
+                remotes[i] = sc.conn.src_id
+            complete, msg_len, allow = self._model_call(
+                engine.model, data, lengths, remotes
+            )
+            allow = np.asarray(allow)
+            denied = int(n - allow[:n].sum())
+            self.fast_log.log_batch("r2d2", n, denied)
+            for i, (key, idx, sc, conn_id, payload) in enumerate(recs):
+                if allow[i]:
+                    ops = [(int(PASS), len(payload)), (int(MORE), 1)]
+                    inj = b""
+                else:
+                    ops = [(int(DROP), len(payload)), (int(MORE), 1)]
+                    inj = b"ERROR\r\n"
+                responses[key][idx] = (
+                    conn_id,
+                    int(FilterResult.OK),
+                    ops,
+                    b"",
+                    inj,
+                )
+
+    def _run_slow(self, sc: _SidecarConn, conn_id: int, reply: bool,
+                  end_stream: bool, data: bytes):
+        """Stateful path: request direction through the batch engine when
+        available, otherwise the in-process oracle parser."""
+        if sc.engine is not None and not reply:
+            conn = sc.conn
+            sc.engine.feed(
+                conn_id,
+                data,
+                remote_id=conn.src_id,
+                policy_name=conn.policy_name,
+                ingress=conn.ingress,
+                dst_id=conn.dst_id,
+                src_addr=conn.src_addr,
+                dst_addr=conn.dst_addr,
+            )
+            sc.engine.pump()
+            ops, inject = sc.engine.take_ops(conn_id)
+            return (
+                conn_id,
+                int(FilterResult.OK),
+                [(int(op), int(nn)) for op, nn in ops],
+                b"",
+                inject,
+            )
+
+        # Oracle path: mirror the datapath buffer, loop while the parser
+        # fills the op array (reference: cilium_proxylib.cc:301 do-while).
+        buf = sc.bufs[reply]
+        buf += data
+        all_ops: list[tuple[int, int]] = []
+        result = FilterResult.OK
+        for _ in range(64):
+            ops: list = []
+            result = sc.conn.on_data(reply, end_stream, [bytes(buf)], ops)
+            for op, nbytes in ops:
+                all_ops.append((int(op), int(nbytes)))
+                if op in (PASS, DROP):
+                    take = min(nbytes, len(buf))
+                    del buf[:take]
+                    sc.skip[reply] += nbytes - take
+            if result != FilterResult.OK or len(ops) < wire.MAX_OPS_PER_ENTRY:
+                break
+        inj_orig = sc.conn.orig_buf.take()
+        inj_reply = sc.conn.reply_buf.take()
+        return (conn_id, int(result), all_ops, inj_orig, inj_reply)
+
+
+def _matrix_to_batch(mb: wire.MatrixBatch) -> wire.DataBatch:
+    """Fallback conversion for matrix batches that miss the vectorized
+    path: unpad rows into a variable-length DataBatch."""
+    parts = [
+        mb.rows[i, : int(mb.lengths[i])].tobytes() for i in range(mb.count)
+    ]
+    return wire.DataBatch(
+        mb.seq,
+        mb.conn_ids,
+        np.zeros(mb.count, np.uint8),
+        mb.lengths,
+        b"".join(parts),
+    )
+
+
+class _ClientHandler:
+    """Reader thread + serialized writer for one shim socket."""
+
+    def __init__(self, service: VerdictService, sock: socket.socket):
+        self.service = service
+        self.sock = sock
+        self._wlock = threading.Lock()
+        self.module_id = 0
+
+    def send(self, msg_type: int, payload: bytes) -> None:
+        with self._wlock:
+            try:
+                wire.send_msg(self.sock, msg_type, payload)
+            except OSError:
+                pass
+
+    def send_verdicts(self, seq: int, entries: list) -> None:
+        """entries: (conn_id, result, ops, inject_orig, inject_reply) —
+        op lists longer than the ABI capacity split into continuation
+        entries (reference: 16-op OnIO array, cilium_proxylib.cc:199)."""
+        conn_ids, results, op_counts = [], [], []
+        inj_o, inj_r = [], []
+        flat_ops: list[tuple[int, int]] = []
+        blob = bytearray()
+        for conn_id, result, ops, io, ir in entries:
+            chunks = [
+                ops[k : k + wire.MAX_OPS_PER_ENTRY]
+                for k in range(0, len(ops), wire.MAX_OPS_PER_ENTRY)
+            ] or [[]]
+            for ci, chunk in enumerate(chunks):
+                last = ci == len(chunks) - 1
+                conn_ids.append(conn_id)
+                results.append(result)
+                op_counts.append(len(chunk))
+                flat_ops.extend(chunk)
+                if last:
+                    inj_o.append(len(io))
+                    inj_r.append(len(ir))
+                    blob += io
+                    blob += ir
+                else:
+                    inj_o.append(0)
+                    inj_r.append(0)
+        ops_arr = np.zeros((len(flat_ops),), wire.FILTER_OP)
+        if flat_ops:
+            ops_arr["op"] = [o for o, _ in flat_ops]
+            ops_arr["n_bytes"] = [n for _, n in flat_ops]
+        self.send(
+            wire.MSG_VERDICT_BATCH,
+            wire.pack_verdict_batch(
+                seq, conn_ids, results, op_counts, inj_o, inj_r,
+                ops_arr, bytes(blob),
+            ),
+        )
+
+    def read_loop(self) -> None:
+        try:
+            while True:
+                msg_type, payload = wire.recv_msg(self.sock)
+                if msg_type == wire.MSG_DATA_BATCH:
+                    self.service.submit_data(
+                        self, wire.unpack_data_batch(payload)
+                    )
+                elif msg_type == wire.MSG_DATA_MATRIX:
+                    mb = wire.unpack_data_matrix(payload)
+                    self.service.dispatcher.submit(
+                        ("mat", self, mb), weight=mb.count
+                    )
+                elif msg_type == wire.MSG_CLOSE:
+                    self.service.submit_close(wire.unpack_close(payload))
+                elif msg_type == wire.MSG_NEW_CONNECTION:
+                    args = wire.unpack_new_connection(payload)
+                    res = self.service.new_connection(*args, client=self)
+                    self.send(
+                        wire.MSG_CONN_RESULT,
+                        np.array([args[1]], "<u8").tobytes()
+                        + np.array([res], "<u4").tobytes(),
+                    )
+                elif msg_type == wire.MSG_OPEN_MODULE:
+                    params, debug = wire.unpack_open_module(payload)
+                    self.module_id = self.service.open_module(params, debug)
+                    self.send(
+                        wire.MSG_MODULE_ID,
+                        np.array([self.module_id], "<u8").tobytes(),
+                    )
+                elif msg_type == wire.MSG_POLICY_UPDATE:
+                    module_id, pj = wire.unpack_policy_update(payload)
+                    status = self.service.policy_update(module_id, pj)
+                    self.send(wire.MSG_ACK, wire.pack_ack(status))
+                else:
+                    log.warning("unknown message type %d", msg_type)
+        except wire.ConnectionClosed:
+            pass
+        except OSError:
+            pass
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass

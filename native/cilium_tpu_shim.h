@@ -1,0 +1,104 @@
+/*
+ * cilium-tpu datapath shim — public C ABI.
+ *
+ * The native client of the verdict-service seam: the counterpart of the
+ * reference's Envoy-side consumer of libcilium.so (reference:
+ * envoy/cilium_proxylib.cc dlopen + GoFilter::Instance::OnIO;
+ * proxylib/libcilium.h cgo surface).  Where the reference crosses a cgo
+ * boundary in-process, this shim crosses a unix-socket wire boundary to
+ * the TPU verdict service (cilium_tpu/sidecar/service.py), buffering
+ * per-connection bytes and applying returned filter ops with the OnIO
+ * byte-accounting contract.
+ *
+ * Op/result enums and the FilterOp struct are numerically and
+ * layout-identical to the reference ABI (reference:
+ * proxylib/proxylib/types.h) so a consumer written against that contract
+ * can link against this shim unchanged.
+ */
+
+#ifndef CILIUM_TPU_SHIM_H
+#define CILIUM_TPU_SHIM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  CT_FILTEROP_MORE = 0,
+  CT_FILTEROP_PASS = 1,
+  CT_FILTEROP_DROP = 2,
+  CT_FILTEROP_INJECT = 3,
+  CT_FILTEROP_ERROR = 4,
+} CiliumTpuFilterOpType;
+
+typedef struct {
+  uint64_t op;     /* CiliumTpuFilterOpType */
+  int64_t n_bytes; /* > 0 */
+} CiliumTpuFilterOp;
+
+typedef enum {
+  CT_FILTER_OK = 0,
+  CT_FILTER_POLICY_DROP = 1,
+  CT_FILTER_PARSER_ERROR = 2,
+  CT_FILTER_UNKNOWN_PARSER = 3,
+  CT_FILTER_UNKNOWN_CONNECTION = 4,
+  CT_FILTER_INVALID_ADDRESS = 5,
+  CT_FILTER_INVALID_INSTANCE = 6,
+  CT_FILTER_UNKNOWN_ERROR = 7,
+} CiliumTpuFilterResult;
+
+/* Connect to the verdict service at socket_path and open a module
+ * (the OpenModule analog).  Returns a module handle, 0 on error. */
+uint64_t cilium_tpu_open(const char *socket_path, uint8_t debug);
+
+/* Close the module and its socket (the CloseModule analog). */
+void cilium_tpu_close_module(uint64_t module);
+
+/* Push a JSON-encoded NetworkPolicy list (the NPDS push analog).
+ * Returns a CiliumTpuFilterResult; non-OK leaves active policy
+ * untouched. */
+uint32_t cilium_tpu_policy_update_json(uint64_t module, const char *json,
+                                       size_t len);
+
+/* Register a connection (the OnNewConnection analog). */
+uint32_t cilium_tpu_on_new_connection(uint64_t module, const char *proto,
+                                      uint64_t conn_id, uint8_t ingress,
+                                      uint32_t src_id, uint32_t dst_id,
+                                      const char *src_addr,
+                                      const char *dst_addr,
+                                      const char *policy_name);
+
+/* Ship new bytes for one direction and receive filter ops (the OnData
+ * analog).  On entry *n_ops is the ops array capacity and
+ * *inject_orig_len / *inject_reply_len the inject buffer capacities; on
+ * return they hold the produced counts.  Ops beyond the capacity are
+ * retained shim-side and returned by the next call (continuation). */
+uint32_t cilium_tpu_on_data(uint64_t module, uint64_t conn_id, uint8_t reply,
+                            uint8_t end_stream, const uint8_t *data,
+                            int64_t len, CiliumTpuFilterOp *ops,
+                            int32_t *n_ops, uint8_t *inject_orig,
+                            int64_t *inject_orig_len, uint8_t *inject_reply,
+                            int64_t *inject_reply_len);
+
+/* Full datapath hot loop for one direction (the GoFilter::Instance::OnIO
+ * analog, reference: envoy/cilium_proxylib.cc:125-214): feeds input,
+ * applies pre-pass/pre-drop counters, outputs reverse-injected frames,
+ * then applies returned ops to the retained buffer.  Forwardable bytes
+ * are written to output (capacity out_cap); *out_len receives the
+ * count.  Returns a CiliumTpuFilterResult. */
+uint32_t cilium_tpu_on_io(uint64_t module, uint64_t conn_id, uint8_t reply,
+                          uint8_t end_stream, const uint8_t *input,
+                          int64_t in_len, uint8_t *output, int64_t out_cap,
+                          int64_t *out_len);
+
+/* Deregister a connection (the Close analog). */
+void cilium_tpu_close_connection(uint64_t module, uint64_t conn_id);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* CILIUM_TPU_SHIM_H */

@@ -1,0 +1,486 @@
+// cilium-tpu datapath shim implementation.
+//
+// Native client of the verdict-service wire protocol
+// (cilium_tpu/sidecar/wire.py).  Mirrors the role of the reference's
+// Envoy-side GoFilter (reference: envoy/cilium_proxylib.cc): per-module
+// socket, per-connection retained buffers and inject slices, and the
+// OnIO byte-accounting loop applying PASS/DROP/INJECT/MORE ops.
+//
+// Threading: one mutex per module serializes socket round trips; a
+// global registry mutex guards the handle tables.  Connections follow
+// the reference's assumption of single-threaded access per connection.
+
+#include "cilium_tpu_shim.h"
+
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint16_t kMagic = 0xC17A;
+constexpr uint16_t kMsgOpenModule = 1;
+constexpr uint16_t kMsgModuleId = 2;
+constexpr uint16_t kMsgNewConnection = 3;
+constexpr uint16_t kMsgConnResult = 4;
+constexpr uint16_t kMsgDataBatch = 5;
+constexpr uint16_t kMsgVerdictBatch = 6;
+constexpr uint16_t kMsgClose = 7;
+constexpr uint16_t kMsgPolicyUpdate = 8;
+constexpr uint16_t kMsgAck = 9;
+
+struct Direction {
+  std::string buffer;       // retained, not-yet-verdicted input
+  int64_t pass_bytes = 0;   // verdicted PASS beyond buffered input
+  int64_t drop_bytes = 0;   // verdicted DROP beyond buffered input
+  int64_t need_bytes = 0;   // parser's MORE threshold (informational)
+  std::string inject;       // per-direction inject slice
+};
+
+struct Connection {
+  Direction dirs[2];  // [0]=orig/request, [1]=reply
+  // Ops produced by the service but not yet handed to the caller
+  // (cilium_tpu_on_data continuation when the caller's array is small).
+  std::deque<CiliumTpuFilterOp> pending_ops[2];
+};
+
+struct Module {
+  int fd = -1;
+  uint64_t module_id = 0;
+  uint64_t next_seq = 1;
+  std::mutex io_mutex;
+  // Guards the conns map itself (insert/erase/find from different
+  // threads); per-connection state still follows the reference's
+  // single-thread-per-connection contract (proxylib/libcilium.h).
+  std::mutex conns_mutex;
+  std::map<uint64_t, std::unique_ptr<Connection>> conns;
+
+  Connection *find_conn(uint64_t conn_id) {
+    std::lock_guard<std::mutex> lk(conns_mutex);
+    auto it = conns.find(conn_id);
+    return it == conns.end() ? nullptr : it->second.get();
+  }
+};
+
+std::mutex g_registry_mutex;
+std::map<uint64_t, std::unique_ptr<Module>> g_modules;
+uint64_t g_next_handle = 1;
+
+Module *find_module(uint64_t handle) {
+  std::lock_guard<std::mutex> lk(g_registry_mutex);
+  auto it = g_modules.find(handle);
+  return it == g_modules.end() ? nullptr : it->second.get();
+}
+
+// --- low-level wire I/O ---------------------------------------------------
+
+bool send_all(int fd, const void *buf, size_t n) {
+  const char *p = static_cast<const char *>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void *buf, size_t n) {
+  char *p = static_cast<char *>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_msg(int fd, uint16_t type, const std::string &payload) {
+  char hdr[8];
+  uint16_t magic = kMagic;
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  memcpy(hdr, &magic, 2);
+  memcpy(hdr + 2, &type, 2);
+  memcpy(hdr + 4, &len, 4);
+  return send_all(fd, hdr, 8) &&
+         (payload.empty() || send_all(fd, payload.data(), payload.size()));
+}
+
+bool recv_msg(int fd, uint16_t *type, std::string *payload) {
+  char hdr[8];
+  if (!recv_all(fd, hdr, 8)) return false;
+  uint16_t magic;
+  uint32_t len;
+  memcpy(&magic, hdr, 2);
+  memcpy(type, hdr + 2, 2);
+  memcpy(&len, hdr + 4, 4);
+  if (magic != kMagic) return false;
+  payload->resize(len);
+  return len == 0 || recv_all(fd, &(*payload)[0], len);
+}
+
+template <typename T>
+void put(std::string *out, T v) {
+  out->append(reinterpret_cast<const char *>(&v), sizeof(T));
+}
+
+void put_str(std::string *out, const char *s) {
+  uint16_t n = s ? static_cast<uint16_t>(strlen(s)) : 0;
+  put<uint16_t>(out, n);
+  if (n) out->append(s, n);
+}
+
+template <typename T>
+T get(const std::string &buf, size_t *off) {
+  T v;
+  memcpy(&v, buf.data() + *off, sizeof(T));
+  *off += sizeof(T);
+  return v;
+}
+
+// One parsed verdict entry.
+struct VerdictEntry {
+  uint64_t conn_id;
+  uint32_t result;
+  std::vector<CiliumTpuFilterOp> ops;
+  std::string inject_orig;
+  std::string inject_reply;
+};
+
+// Bounds-checked parse: the wire peer is a trust boundary — a
+// truncated or corrupt payload must fail the message, never read out
+// of bounds.
+bool parse_verdict_batch(const std::string &p, uint64_t *seq,
+                         std::vector<VerdictEntry> *entries) {
+  size_t off = 0;
+  auto need = [&](size_t k) { return p.size() - off >= k; };
+  if (!need(12)) return false;
+  *seq = get<uint64_t>(p, &off);
+  uint32_t n = get<uint32_t>(p, &off);
+  if (n > (1u << 20)) return false;  // implausible entry count
+  if (!need(static_cast<size_t>(n) * (8 + 4 * 4))) return false;
+  std::vector<uint64_t> conn_ids(n);
+  std::vector<uint32_t> results(n), op_counts(n), inj_o(n), inj_r(n);
+  for (uint32_t i = 0; i < n; i++) conn_ids[i] = get<uint64_t>(p, &off);
+  for (uint32_t i = 0; i < n; i++) results[i] = get<uint32_t>(p, &off);
+  for (uint32_t i = 0; i < n; i++) op_counts[i] = get<uint32_t>(p, &off);
+  for (uint32_t i = 0; i < n; i++) inj_o[i] = get<uint32_t>(p, &off);
+  for (uint32_t i = 0; i < n; i++) inj_r[i] = get<uint32_t>(p, &off);
+  entries->resize(n);
+  for (uint32_t i = 0; i < n; i++) {
+    VerdictEntry &e = (*entries)[i];
+    e.conn_id = conn_ids[i];
+    e.result = results[i];
+    if (op_counts[i] > (1u << 16) ||
+        !need(static_cast<size_t>(op_counts[i]) * 16))
+      return false;
+    e.ops.resize(op_counts[i]);
+    for (uint32_t k = 0; k < op_counts[i]; k++) {
+      e.ops[k].op = get<uint64_t>(p, &off);
+      e.ops[k].n_bytes = get<int64_t>(p, &off);
+    }
+  }
+  for (uint32_t i = 0; i < n; i++) {
+    VerdictEntry &e = (*entries)[i];
+    if (!need(static_cast<size_t>(inj_o[i]) + inj_r[i])) return false;
+    e.inject_orig.assign(p.data() + off, inj_o[i]);
+    off += inj_o[i];
+    e.inject_reply.assign(p.data() + off, inj_r[i]);
+    off += inj_r[i];
+  }
+  return true;
+}
+
+// Synchronous round trip expecting a given reply type; caller holds
+// the module io_mutex.
+bool rpc(Module *m, uint16_t type, const std::string &payload,
+         uint16_t want_type, std::string *reply) {
+  if (!send_msg(m->fd, type, payload)) return false;
+  uint16_t got;
+  for (;;) {
+    if (!recv_msg(m->fd, &got, reply)) return false;
+    if (got == want_type) return true;
+    // Unexpected interleaved message (shouldn't happen with serialized
+    // round trips); skip it.
+  }
+}
+
+// Ship new bytes for a connection/direction; parse verdict entries and
+// append their ops/injects to the connection's pending queues.
+uint32_t on_data_rpc(Module *m, Connection *c, uint64_t conn_id, bool reply,
+                     bool end_stream, const uint8_t *data, int64_t len) {
+  std::lock_guard<std::mutex> lk(m->io_mutex);
+  uint64_t seq = m->next_seq++;
+  std::string payload;
+  put<uint64_t>(&payload, seq);
+  put<uint32_t>(&payload, 1);
+  put<uint64_t>(&payload, conn_id);
+  uint8_t flags = (reply ? 1 : 0) | (end_stream ? 2 : 0);
+  put<uint8_t>(&payload, flags);
+  put<uint32_t>(&payload, static_cast<uint32_t>(len));
+  if (len > 0) payload.append(reinterpret_cast<const char *>(data), len);
+
+  std::string rp;
+  if (!send_msg(m->fd, kMsgDataBatch, payload)) return CT_FILTER_UNKNOWN_ERROR;
+  for (;;) {
+    uint16_t got;
+    if (!recv_msg(m->fd, &got, &rp)) return CT_FILTER_UNKNOWN_ERROR;
+    if (got != kMsgVerdictBatch) continue;
+    uint64_t got_seq;
+    std::vector<VerdictEntry> entries;
+    if (!parse_verdict_batch(rp, &got_seq, &entries))
+      return CT_FILTER_UNKNOWN_ERROR;
+    if (got_seq != seq) continue;  // stale reply for another call
+    uint32_t result = CT_FILTER_OK;
+    for (auto &e : entries) {
+      if (e.result != CT_FILTER_OK) result = e.result;
+      c->dirs[0].inject += e.inject_orig;
+      c->dirs[1].inject += e.inject_reply;
+      for (auto &op : e.ops) c->pending_ops[reply ? 1 : 0].push_back(op);
+    }
+    return result;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t cilium_tpu_open(const char *socket_path, uint8_t debug) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return 0;
+  sockaddr_un addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, socket_path, sizeof(addr.sun_path) - 1);
+  if (::connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return 0;
+  }
+  auto m = std::make_unique<Module>();
+  m->fd = fd;
+
+  std::string payload;
+  put<uint8_t>(&payload, debug);
+  put<uint16_t>(&payload, 0);  // no params
+  std::string reply;
+  {
+    std::lock_guard<std::mutex> lk(m->io_mutex);
+    if (!rpc(m.get(), kMsgOpenModule, payload, kMsgModuleId, &reply) ||
+        reply.size() < 8) {
+      ::close(fd);
+      return 0;
+    }
+  }
+  size_t off = 0;
+  m->module_id = get<uint64_t>(reply, &off);
+  if (m->module_id == 0) {
+    ::close(fd);
+    return 0;
+  }
+  std::lock_guard<std::mutex> lk(g_registry_mutex);
+  uint64_t handle = g_next_handle++;
+  g_modules[handle] = std::move(m);
+  return handle;
+}
+
+void cilium_tpu_close_module(uint64_t module) {
+  std::lock_guard<std::mutex> lk(g_registry_mutex);
+  auto it = g_modules.find(module);
+  if (it == g_modules.end()) return;
+  ::close(it->second->fd);
+  g_modules.erase(it);
+}
+
+uint32_t cilium_tpu_policy_update_json(uint64_t module, const char *json,
+                                       size_t len) {
+  Module *m = find_module(module);
+  if (!m) return CT_FILTER_INVALID_INSTANCE;
+  std::string payload;
+  put<uint64_t>(&payload, m->module_id);
+  put<uint32_t>(&payload, static_cast<uint32_t>(len));
+  payload.append(json, len);
+  std::lock_guard<std::mutex> lk(m->io_mutex);
+  std::string reply;
+  if (!rpc(m, kMsgPolicyUpdate, payload, kMsgAck, &reply) || reply.size() < 4)
+    return CT_FILTER_UNKNOWN_ERROR;
+  size_t off = 0;
+  return get<uint32_t>(reply, &off);
+}
+
+uint32_t cilium_tpu_on_new_connection(uint64_t module, const char *proto,
+                                      uint64_t conn_id, uint8_t ingress,
+                                      uint32_t src_id, uint32_t dst_id,
+                                      const char *src_addr,
+                                      const char *dst_addr,
+                                      const char *policy_name) {
+  Module *m = find_module(module);
+  if (!m) return CT_FILTER_INVALID_INSTANCE;
+  std::string payload;
+  put<uint64_t>(&payload, m->module_id);
+  put<uint64_t>(&payload, conn_id);
+  put<uint8_t>(&payload, ingress);
+  put<uint32_t>(&payload, src_id);
+  put<uint32_t>(&payload, dst_id);
+  put_str(&payload, proto);
+  put_str(&payload, src_addr);
+  put_str(&payload, dst_addr);
+  put_str(&payload, policy_name);
+  std::lock_guard<std::mutex> lk(m->io_mutex);
+  std::string reply;
+  if (!rpc(m, kMsgNewConnection, payload, kMsgConnResult, &reply) ||
+      reply.size() < 12)
+    return CT_FILTER_UNKNOWN_ERROR;
+  size_t off = 8;  // skip echoed conn_id
+  uint32_t res = get<uint32_t>(reply, &off);
+  if (res == CT_FILTER_OK) {
+    std::lock_guard<std::mutex> ck(m->conns_mutex);
+    m->conns[conn_id] = std::make_unique<Connection>();
+  }
+  return res;
+}
+
+uint32_t cilium_tpu_on_data(uint64_t module, uint64_t conn_id, uint8_t reply,
+                            uint8_t end_stream, const uint8_t *data,
+                            int64_t len, CiliumTpuFilterOp *ops,
+                            int32_t *n_ops, uint8_t *inject_orig,
+                            int64_t *inject_orig_len, uint8_t *inject_reply,
+                            int64_t *inject_reply_len) {
+  Module *m = find_module(module);
+  if (!m) return CT_FILTER_INVALID_INSTANCE;
+  Connection *c = m->find_conn(conn_id);
+  if (!c) return CT_FILTER_UNKNOWN_CONNECTION;
+
+  uint32_t result = CT_FILTER_OK;
+  if (len > 0 || end_stream)
+    result = on_data_rpc(m, c, conn_id, reply, end_stream, data, len);
+
+  int d = reply ? 1 : 0;
+  int32_t cap = *n_ops, produced = 0;
+  while (produced < cap && !c->pending_ops[d].empty()) {
+    ops[produced++] = c->pending_ops[d].front();
+    c->pending_ops[d].pop_front();
+  }
+  *n_ops = produced;
+
+  // Hand the inject slices to the caller-owned buffers (the
+  // origBuf/replyBuf analog of OnNewConnection, libcilium.h).
+  auto drain = [](std::string &src, uint8_t *dst, int64_t *cap_len) {
+    int64_t n = std::min<int64_t>(*cap_len, src.size());
+    if (dst && n > 0) memcpy(dst, src.data(), n);
+    src.erase(0, n);
+    *cap_len = n;
+  };
+  if (inject_orig_len) drain(c->dirs[0].inject, inject_orig, inject_orig_len);
+  if (inject_reply_len)
+    drain(c->dirs[1].inject, inject_reply, inject_reply_len);
+  return result;
+}
+
+uint32_t cilium_tpu_on_io(uint64_t module, uint64_t conn_id, uint8_t reply,
+                          uint8_t end_stream, const uint8_t *input,
+                          int64_t in_len, uint8_t *output, int64_t out_cap,
+                          int64_t *out_len) {
+  *out_len = 0;
+  Module *m = find_module(module);
+  if (!m) return CT_FILTER_INVALID_INSTANCE;
+  Connection *c = m->find_conn(conn_id);
+  if (!c) return CT_FILTER_UNKNOWN_CONNECTION;
+  Direction &dir = c->dirs[reply ? 1 : 0];
+
+  std::string out;
+  std::string incoming(reinterpret_cast<const char *>(input),
+                       static_cast<size_t>(in_len));
+
+  // Pre-pass / pre-drop from an earlier verdict
+  // (reference: cilium_proxylib.cc:130-166).
+  size_t pos = 0;
+  if (dir.pass_bytes > 0) {
+    size_t take = std::min<size_t>(dir.pass_bytes, incoming.size());
+    out.append(incoming, 0, take);
+    dir.pass_bytes -= take;
+    pos = take;
+  } else if (dir.drop_bytes > 0) {
+    size_t take = std::min<size_t>(dir.drop_bytes, incoming.size());
+    dir.drop_bytes -= take;
+    pos = take;
+  }
+  dir.buffer.append(incoming, pos, std::string::npos);
+
+  // Reverse-injected frames first (reference: cilium_proxylib.cc:186-192).
+  if (!dir.inject.empty()) {
+    out += dir.inject;
+    dir.inject.clear();
+  }
+
+  uint32_t result = on_data_rpc(m, c, conn_id, reply, end_stream,
+                                reinterpret_cast<const uint8_t *>(
+                                    incoming.data()),
+                                incoming.size());
+  if (result != CT_FILTER_OK) return result;
+
+  int d = reply ? 1 : 0;
+  while (!c->pending_ops[d].empty()) {
+    CiliumTpuFilterOp op = c->pending_ops[d].front();
+    c->pending_ops[d].pop_front();
+    int64_t n = op.n_bytes;
+    switch (op.op) {
+      case CT_FILTEROP_MORE:
+        dir.need_bytes = static_cast<int64_t>(dir.buffer.size()) + n;
+        break;
+      case CT_FILTEROP_PASS: {
+        int64_t take = std::min<int64_t>(n, dir.buffer.size());
+        out.append(dir.buffer, 0, take);
+        dir.buffer.erase(0, take);
+        if (n > take) dir.pass_bytes = n - take;
+        break;
+      }
+      case CT_FILTEROP_DROP: {
+        int64_t take = std::min<int64_t>(n, dir.buffer.size());
+        dir.buffer.erase(0, take);
+        if (n > take) dir.drop_bytes = n - take;
+        break;
+      }
+      case CT_FILTEROP_INJECT: {
+        if (n > static_cast<int64_t>(dir.inject.size()))
+          return CT_FILTER_PARSER_ERROR;
+        out.append(dir.inject, 0, n);
+        dir.inject.erase(0, n);
+        break;
+      }
+      default:
+        return CT_FILTER_PARSER_ERROR;
+    }
+  }
+
+  if (static_cast<int64_t>(out.size()) > out_cap)
+    return CT_FILTER_UNKNOWN_ERROR;
+  if (!out.empty()) memcpy(output, out.data(), out.size());
+  *out_len = static_cast<int64_t>(out.size());
+  return CT_FILTER_OK;
+}
+
+void cilium_tpu_close_connection(uint64_t module, uint64_t conn_id) {
+  Module *m = find_module(module);
+  if (!m) return;
+  {
+    std::lock_guard<std::mutex> ck(m->conns_mutex);
+    m->conns.erase(conn_id);
+  }
+  std::string payload;
+  put<uint64_t>(&payload, conn_id);
+  std::lock_guard<std::mutex> lk(m->io_mutex);
+  send_msg(m->fd, kMsgClose, payload);
+}
+
+}  // extern "C"
