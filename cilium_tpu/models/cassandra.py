@@ -1,0 +1,182 @@
+"""Cassandra batch verdict model — device-side (action, table) ACL.
+
+Replaces the per-request rule walk of the reference's cassandra parser
+(reference: proxylib/cassandra/cassandraparser.go:58-95 Rule.Matches +
+proxylib/proxylib/policymap.go rule cascade) with one device pass over a
+batch of pre-tokenized requests.  The CQL tokenizer itself (stateful:
+keyspace tracking, prepared-statement cache) stays host-side in the
+streaming parser; what scales on device is the ACL:
+
+  allow[f] = OR_r ( remote_ok[f,r] AND
+                    (non_query[f] OR (action_ok[f,r] AND table_ok[f,r])) )
+
+- non_query: paths with <= 2 parts (non-query-like opcodes) match every
+  rule (cassandraparser.go:74-76)
+- action_ok: exact compare against the rule's query_action (or any)
+- table_ok: rule regex search over the table name via the shared NFA;
+  empty table name skips the table check (cassandraparser.go:87-91)
+
+Input layout [F, MAX_ACTION + MAX_TABLE] uint8: action bytes at offset
+0, table bytes at MAX_ACTION — one array, two spans, no gathers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.bytescan import spans_equal_prefix
+from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
+from ..proxylib.parsers.cassandra import CassandraRule
+from ..proxylib.policy import CompiledPortRules, PolicyInstance
+from ..regex import compile_patterns
+from .base import ConstVerdict, VerdictModel, pack_remote_sets, remote_ok
+
+MAX_ACTION = 32  # longest action is "create-materialized-view" (24)
+MAX_TABLE = 96
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class CassandraBatchModel(VerdictModel):
+    nfa: DeviceNfa  # query_table regex rows
+    action_needle: jax.Array  # [R, MAX_ACTION] uint8
+    action_len: jax.Array  # [R] int32
+    action_any: jax.Array  # [R] bool
+    table_none: jax.Array  # [R] bool — rule has no table regex
+    remote_ids: jax.Array  # [R, MAX_REMOTES] int32
+    any_remote: jax.Array  # [R] bool
+
+    def tree_flatten(self):
+        return (
+            (self.nfa, self.action_needle, self.action_len, self.action_any,
+             self.table_none, self.remote_ids, self.any_remote),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+    def __call__(self, data, action_len, table_len, non_query, remotes):
+        return cassandra_verdicts(
+            self, data, action_len, table_len, non_query, remotes
+        )
+
+
+def _collect_rows(rules: CompiledPortRules):
+    rows = []  # (remote_set, action_exact, table_regex)
+    for rule in rules.rules:
+        matchers = rule.l7_matchers or [None]
+        for m in matchers:
+            if m is None:
+                rows.append((rule.allowed_remotes, "", ""))
+            else:
+                assert isinstance(m, CassandraRule), f"not cassandra: {m!r}"
+                rows.append(
+                    (rule.allowed_remotes, m.query_action_exact, m.table_regex)
+                )
+    return rows
+
+
+def build_cassandra_model(
+    policy: PolicyInstance | None, ingress: bool, port: int
+) -> ConstVerdict | CassandraBatchModel:
+    """Port-cascade build, identical in structure to build_r2d2_model
+    (reference port cascade: proxylib/proxylib/policymap.go:208-236)."""
+    if policy is None:
+        return ConstVerdict(False)
+    side = policy.ingress if ingress else policy.egress
+    rows = []
+    for key in (port, 0):
+        rules = side.by_port.get(key)
+        if rules is None:
+            continue
+        if not rules.have_l7_rules or not rules.rules:
+            return ConstVerdict(True)
+        rows.extend(_collect_rows(rules))
+    if not rows:
+        return ConstVerdict(False)
+
+    packed_ids, any_remote = pack_remote_sets([r[0] for r in rows])
+    n = len(rows)
+    action_needle = np.zeros((n, MAX_ACTION), np.uint8)
+    action_len = np.zeros((n,), np.int32)
+    action_any = np.zeros((n,), bool)
+    table_none = np.zeros((n,), bool)
+    for i, (_, action, table) in enumerate(rows):
+        b = action.encode()
+        action_needle[i, : len(b)] = np.frombuffer(b, np.uint8)
+        action_len[i] = len(b)
+        action_any[i] = len(b) == 0
+        table_none[i] = table == ""
+
+    tables = compile_patterns([r[2] for r in rows])
+    return CassandraBatchModel(
+        nfa=device_nfa(tables),
+        action_needle=jnp.asarray(action_needle),
+        action_len=jnp.asarray(action_len),
+        action_any=jnp.asarray(action_any),
+        table_none=jnp.asarray(table_none),
+        remote_ids=jnp.asarray(packed_ids),
+        any_remote=jnp.asarray(any_remote),
+    )
+
+
+def encode_cassandra_batch(requests, f_pad: int | None = None):
+    """Host-side batch packing: [(action, table, non_query)] ->
+    (data [F, MAX_ACTION+MAX_TABLE], action_len, table_len, non_query,
+    overflow).  ``overflow[i]`` marks requests whose tokens exceed the
+    fixed widths — callers must fall back to the host oracle for those
+    (fail closed, same pattern as the Kafka topic overflow)."""
+    n = len(requests)
+    f = f_pad or n
+    data = np.zeros((f, MAX_ACTION + MAX_TABLE), np.uint8)
+    action_len = np.zeros((f,), np.int32)
+    table_len = np.zeros((f,), np.int32)
+    non_query = np.zeros((f,), bool)
+    overflow = np.zeros((n,), bool)
+    for i, (action, table, nq) in enumerate(requests):
+        ab = action.encode("utf-8", "surrogateescape")
+        tb = table.encode("utf-8", "surrogateescape")
+        if len(ab) > MAX_ACTION or len(tb) > MAX_TABLE:
+            overflow[i] = True
+            continue
+        data[i, : len(ab)] = np.frombuffer(ab, np.uint8)
+        data[i, MAX_ACTION : MAX_ACTION + len(tb)] = np.frombuffer(tb, np.uint8)
+        action_len[i] = len(ab)
+        table_len[i] = len(tb)
+        non_query[i] = nq
+    return data, action_len, table_len, non_query, overflow
+
+
+@jax.jit
+def cassandra_verdicts(
+    model: CassandraBatchModel,
+    data: jax.Array,  # [F, MAX_ACTION + MAX_TABLE] uint8
+    action_len: jax.Array,  # [F] int32
+    table_len: jax.Array,  # [F] int32
+    non_query: jax.Array,  # [F] bool
+    remotes: jax.Array,  # [F] int32
+) -> jax.Array:
+    """allow [F] bool."""
+    zeros = jnp.zeros_like(action_len)
+    action_ok = (
+        spans_equal_prefix(
+            data, zeros, action_len, model.action_needle, model.action_len
+        )
+        | model.action_any[None, :]
+    )  # [F, R]
+    table_start = jnp.full_like(table_len, MAX_ACTION)
+    table_hit = nfa_search_spans(
+        model.nfa, data, table_start, table_start + table_len
+    )  # [F, R]
+    table_ok = (
+        model.table_none[None, :] | (table_len == 0)[:, None] | table_hit
+    )
+    rem = remote_ok(remotes, model.remote_ids, model.any_remote)
+    l7_ok = non_query[:, None] | (action_ok & table_ok)
+    return jnp.any(rem & l7_ok, axis=1)

@@ -1,0 +1,243 @@
+"""Memcached batch verdict model — device-side (command/opcode, key) ACL.
+
+Replaces the per-request rule walk of the reference's memcached parsers
+(reference: proxylib/memcached/parser.go:47-110 Rule.Matches) with one
+device pass over pre-framed requests:
+
+  allow[f] = OR_r ( remote_ok AND cmd_ok AND (no_key OR key_ok) )
+
+- cmd_ok: binary flows index a [R, 256] opcode table; text flows index
+  a [R, NCMDS] command table over the global text-command vocabulary
+  (MEMCACHE_OPCODE_MAP); empty rules match everything
+- key_ok by rule mode: exact (span equality), prefix (span starts-with),
+  regex (shared NFA search), or none
+- multi-key frames (text multi-get) are judged host-side — the device
+  path covers the <= 1 key case, the overwhelming steady state; callers
+  fall back on overflow exactly like the Kafka topic path
+
+Framing (header fields, token split, reply sequencing, denial-inject
+ordering) stays host-side in the streaming parsers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.bytescan import spans_equal_prefix, spans_start_with
+from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
+from ..proxylib.parsers.memcached import MEMCACHE_OPCODE_MAP, MemcacheRule
+from ..proxylib.policy import CompiledPortRules, PolicyInstance
+from ..regex import compile_patterns
+from .base import ConstVerdict, VerdictModel, pack_remote_sets, remote_ok
+
+MAX_KEY = 96
+
+# Global text-command vocabulary (order fixed at import): every text
+# command any rule group can allow.  Flows carry an index into this.
+TEXT_COMMANDS: tuple[str, ...] = tuple(
+    sorted({c for text, _ in MEMCACHE_OPCODE_MAP.values() for c in text})
+)
+TEXT_COMMAND_INDEX = {c: i for i, c in enumerate(TEXT_COMMANDS)}
+
+KEY_MODE_NONE = 0
+KEY_MODE_EXACT = 1
+KEY_MODE_PREFIX = 2
+KEY_MODE_REGEX = 3
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class MemcacheBatchModel(VerdictModel):
+    nfa: DeviceNfa  # keyRegex rows ('' for non-regex rules)
+    op_tab: jax.Array  # [R, 256] bool — allowed binary opcodes
+    cmd_tab: jax.Array  # [R, NCMDS] bool — allowed text commands
+    empty_rule: jax.Array  # [R] bool — matches anything
+    key_mode: jax.Array  # [R] int32
+    key_needle: jax.Array  # [R, MAX_KEY] uint8
+    key_needle_len: jax.Array  # [R] int32
+    remote_ids: jax.Array  # [R, MAX_REMOTES] int32
+    any_remote: jax.Array  # [R] bool
+
+    def tree_flatten(self):
+        return (
+            (self.nfa, self.op_tab, self.cmd_tab, self.empty_rule,
+             self.key_mode, self.key_needle, self.key_needle_len,
+             self.remote_ids, self.any_remote),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+    def __call__(self, key_data, key_len, has_key, is_binary, opcode,
+                 cmd_id, remotes):
+        return memcache_verdicts(
+            self, key_data, key_len, has_key, is_binary, opcode, cmd_id,
+            remotes,
+        )
+
+
+def _collect_rows(rules: CompiledPortRules):
+    rows = []  # (remote_set, MemcacheRule | None)
+    for rule in rules.rules:
+        matchers = rule.l7_matchers or [None]
+        for m in matchers:
+            if m is not None and not isinstance(m, MemcacheRule):
+                raise AssertionError(f"not a memcache rule: {m!r}")
+            rows.append((rule.allowed_remotes, m))
+    return rows
+
+
+def build_memcache_model(
+    policy: PolicyInstance | None, ingress: bool, port: int
+) -> ConstVerdict | MemcacheBatchModel:
+    """Port-cascade build (reference: policymap.go:208-236)."""
+    if policy is None:
+        return ConstVerdict(False)
+    side = policy.ingress if ingress else policy.egress
+    rows = []
+    for key in (port, 0):
+        rules = side.by_port.get(key)
+        if rules is None:
+            continue
+        if not rules.have_l7_rules or not rules.rules:
+            return ConstVerdict(True)
+        rows.extend(_collect_rows(rules))
+    if not rows:
+        return ConstVerdict(False)
+
+    packed_ids, any_remote = pack_remote_sets([r[0] for r in rows])
+    n = len(rows)
+    op_tab = np.zeros((n, 256), bool)
+    cmd_tab = np.zeros((n, len(TEXT_COMMANDS)), bool)
+    empty_rule = np.zeros((n,), bool)
+    key_mode = np.zeros((n,), np.int32)
+    key_needle = np.zeros((n, MAX_KEY), np.uint8)
+    key_needle_len = np.zeros((n,), np.int32)
+    patterns = []
+    for i, (_, m) in enumerate(rows):
+        if m is None or m.empty:
+            empty_rule[i] = True
+            patterns.append("")
+            continue
+        for op in m.bin_opcodes:
+            op_tab[i, op] = True
+        for c in m.text_cmds:
+            cmd_tab[i, TEXT_COMMAND_INDEX[c]] = True
+        if m.key_exact:
+            key_mode[i] = KEY_MODE_EXACT
+            needle = m.key_exact
+        elif m.key_prefix:
+            key_mode[i] = KEY_MODE_PREFIX
+            needle = m.key_prefix
+        elif m.key_compiled is not None:
+            key_mode[i] = KEY_MODE_REGEX
+            needle = b""
+        else:
+            key_mode[i] = KEY_MODE_NONE
+            needle = b""
+        if len(needle) > MAX_KEY:
+            raise ValueError(
+                f"memcache key needle exceeds MAX_KEY ({len(needle)})"
+            )
+        key_needle[i, : len(needle)] = np.frombuffer(needle, np.uint8)
+        key_needle_len[i] = len(needle)
+        patterns.append(m.key_regex if key_mode[i] == KEY_MODE_REGEX else "")
+
+    tables = compile_patterns(patterns)
+    return MemcacheBatchModel(
+        nfa=device_nfa(tables),
+        op_tab=jnp.asarray(op_tab),
+        cmd_tab=jnp.asarray(cmd_tab),
+        empty_rule=jnp.asarray(empty_rule),
+        key_mode=jnp.asarray(key_mode),
+        key_needle=jnp.asarray(key_needle),
+        key_needle_len=jnp.asarray(key_needle_len),
+        remote_ids=jnp.asarray(packed_ids),
+        any_remote=jnp.asarray(any_remote),
+    )
+
+
+def encode_memcache_batch(frames, f_pad: int | None = None):
+    """Host-side batch packing: [(is_binary, opcode, command, keys)] ->
+    device arrays + overflow flags.  overflow marks frames the device
+    path cannot judge (multi-key, oversized key, unknown text command);
+    callers fall back to the host oracle for those."""
+    n = len(frames)
+    f = f_pad or n
+    key_data = np.zeros((f, MAX_KEY), np.uint8)
+    key_len = np.zeros((f,), np.int32)
+    has_key = np.zeros((f,), bool)
+    is_binary = np.zeros((f,), bool)
+    opcode = np.zeros((f,), np.int32)
+    cmd_id = np.zeros((f,), np.int32)
+    overflow = np.zeros((n,), bool)
+    for i, (binary, op, command, keys) in enumerate(frames):
+        if len(keys) > 1:
+            overflow[i] = True
+            continue
+        key = keys[0] if keys else None
+        if key is not None and len(key) > MAX_KEY:
+            overflow[i] = True
+            continue
+        is_binary[i] = binary
+        if binary:
+            opcode[i] = op
+        else:
+            idx = TEXT_COMMAND_INDEX.get(command)
+            if idx is None:
+                overflow[i] = True
+                continue
+            cmd_id[i] = idx
+        if key is not None:
+            has_key[i] = True
+            if key:
+                key_data[i, : len(key)] = np.frombuffer(key, np.uint8)
+            key_len[i] = len(key)
+    return key_data, key_len, has_key, is_binary, opcode, cmd_id, overflow
+
+
+@jax.jit
+def memcache_verdicts(
+    model: MemcacheBatchModel,
+    key_data: jax.Array,  # [F, MAX_KEY] uint8
+    key_len: jax.Array,  # [F] int32
+    has_key: jax.Array,  # [F] bool
+    is_binary: jax.Array,  # [F] bool
+    opcode: jax.Array,  # [F] int32
+    cmd_id: jax.Array,  # [F] int32
+    remotes: jax.Array,  # [F] int32
+) -> jax.Array:
+    """allow [F] bool."""
+    op_ok = model.op_tab[:, opcode].T  # [F, R]
+    cmd_ok_text = model.cmd_tab[:, cmd_id].T  # [F, R]
+    cmd_ok = jnp.where(is_binary[:, None], op_ok, cmd_ok_text)
+
+    zeros = jnp.zeros_like(key_len)
+    exact = spans_equal_prefix(
+        key_data, zeros, key_len, model.key_needle, model.key_needle_len
+    )
+    prefix = spans_start_with(
+        key_data, zeros, key_len, model.key_needle, model.key_needle_len
+    )
+    regex = nfa_search_spans(model.nfa, key_data, zeros, key_len)
+    mode = model.key_mode[None, :]
+    key_ok = jnp.where(
+        mode == KEY_MODE_EXACT,
+        exact,
+        jnp.where(
+            mode == KEY_MODE_PREFIX,
+            prefix,
+            jnp.where(mode == KEY_MODE_REGEX, regex, True),
+        ),
+    )
+    key_ok = ~has_key[:, None] | key_ok
+
+    rem = remote_ok(remotes, model.remote_ids, model.any_remote)
+    l7_ok = model.empty_rule[None, :] | (cmd_ok & key_ok)
+    return jnp.any(rem & l7_ok, axis=1)

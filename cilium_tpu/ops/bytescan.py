@@ -49,6 +49,34 @@ def count_byte(data: jax.Array, lengths: jax.Array, byte: int) -> jax.Array:
     return jnp.sum(((data == jnp.uint8(byte)) & valid).astype(jnp.int32), axis=1)
 
 
+def _spans_compare(
+    data: jax.Array,
+    start: jax.Array,
+    end: jax.Array,
+    needle: jax.Array,
+    needle_len: jax.Array,
+    prefix: bool,
+) -> jax.Array:
+    """Shared core: window each span's first N bytes against the
+    needles; ``prefix`` selects starts-with (span may be longer) vs
+    exact (lengths must match)."""
+    f, l = data.shape
+    r, n = needle.shape
+    span_len = end - start  # [F]
+    if prefix:
+        len_ok = span_len[:, None] >= needle_len[None, :]  # [F, R]
+    else:
+        len_ok = span_len[:, None] == needle_len[None, :]  # [F, R]
+    idx = start[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]  # [F, N]
+    idx = jnp.minimum(idx, l - 1)
+    window = jnp.take_along_axis(data, idx.astype(jnp.int32), axis=1)  # [F, N]
+    eq = window[:, None, :] == needle[None, :, :]  # [F, R, N]
+    bytes_needed = (
+        jnp.arange(n, dtype=jnp.int32)[None, None, :] < needle_len[None, :, None]
+    )
+    return len_ok & jnp.all(eq | ~bytes_needed, axis=2)
+
+
 def spans_equal_prefix(
     data: jax.Array,
     start: jax.Array,
@@ -63,17 +91,17 @@ def spans_equal_prefix(
     Returns [F, R] bool.  Used for exact-token matches (r2d2 cmd, Kafka
     apikey names) without a gather in the inner loop.
     """
-    f, l = data.shape
-    r, n = needle.shape
-    span_len = end - start  # [F]
-    len_ok = span_len[:, None] == needle_len[None, :]  # [F, R]
-    # Window the first N bytes of each span; when span_len == needle_len the
-    # masked positions below cover exactly the span.
-    idx = start[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]  # [F, N]
-    idx = jnp.minimum(idx, l - 1)
-    window = jnp.take_along_axis(data, idx.astype(jnp.int32), axis=1)  # [F, N]
-    eq = window[:, None, :] == needle[None, :, :]  # [F, R, N]
-    bytes_needed = (
-        jnp.arange(n, dtype=jnp.int32)[None, None, :] < needle_len[None, :, None]
-    )
-    return len_ok & jnp.all(eq | ~bytes_needed, axis=2)
+    return _spans_compare(data, start, end, needle, needle_len, prefix=False)
+
+
+def spans_start_with(
+    data: jax.Array,
+    start: jax.Array,
+    end: jax.Array,
+    needle: jax.Array,
+    needle_len: jax.Array,
+) -> jax.Array:
+    """Per (flow, needle): does data[f, start[f]:end[f]] START WITH
+    needle[r]?  Shapes as in spans_equal_prefix; returns [F, R] bool.
+    Used for prefix key matches (memcached keyPrefix)."""
+    return _spans_compare(data, start, end, needle, needle_len, prefix=True)

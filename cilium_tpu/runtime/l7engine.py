@@ -1,0 +1,399 @@
+"""Device-assisted L7 engines for stateful protocols (cassandra, memcached).
+
+The r2d2/HTTP/Kafka engines re-implement framing and emission around a
+pure device model.  Cassandra and memcached have deeply stateful
+connection semantics (prepared-statement caches, keyspace tracking,
+reply-intent queues with in-order denial injection), so this engine
+keeps the streaming oracle parser as the single source of framing/state
+truth and batches only the decision:
+
+1. **Peek**: extract match inputs for every complete frame in each
+   flow's buffer WITHOUT mutating parser state (clones for the
+   keyspace-tracking tokenizer).
+2. **Judge**: one device pass over the collected frames (cassandra
+   (action, table) ACL / memcached (command, key) ACL).
+3. **Drive**: run the oracle parser exactly as in-process proxylib —
+   its ``Connection.matches`` is answered from the precomputed device
+   verdicts (host fallback for overflow frames), so the op/byte/inject
+   stream is bit-identical to the oracle by construction.
+
+Reference seams: proxylib/proxylib/connection.go:118 (op loop),
+proxylib/cassandra/cassandraparser.go, proxylib/memcached/*.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from ..models.base import ConstVerdict
+from ..models.cassandra import cassandra_verdicts, encode_cassandra_batch
+from ..models.memcached import encode_memcache_batch, memcache_verdicts
+from ..proxylib.connection import Connection, InjectBuf
+from ..proxylib.parsers.cassandra import (
+    CASS_HDR_LEN,
+    CassandraParser,
+)
+from ..proxylib.parsers.memcached import (
+    BINARY_HEADER_SIZE,
+    BinaryMemcacheParser,
+    MemcacheMeta,
+    MemcacheParser,
+    TextMemcacheParser,
+)
+from ..proxylib.types import MORE, DROP, PASS, FilterResult
+
+
+class _EngineInstance:
+    """Duck-typed proxylib Instance: policy decisions come from the
+    engine's precomputed device verdicts, logging from its logger."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def policy_matches(self, policy_name, ingress, port, remote_id, l7):
+        q = self.engine._pending_verdicts.get(self.engine._driving_flow)
+        if q:
+            return bool(q.popleft())
+        # Host fallback: overflow frames or frames beyond the peek
+        # horizon — exact oracle decision.
+        policy = self.engine.policy
+        return policy is not None and policy.matches(
+            ingress, port, remote_id, l7
+        )
+
+    def log(self, entry) -> None:
+        if self.engine.logger is not None:
+            self.engine.logger.log(entry)
+
+
+class _EngineFlow:
+    __slots__ = ("conn", "parser", "bufs", "ops", "stalled", "skip")
+
+    def __init__(self, conn, parser):
+        self.conn = conn
+        self.parser = parser
+        self.bufs = {False: bytearray(), True: bytearray()}
+        self.ops = {False: [], True: []}
+        # Per-direction need-more marker: don't re-drive until new bytes.
+        self.stalled = {False: False, True: False}
+        # Bytes already covered by a PASS/DROP that overshot the buffered
+        # input (a parser may decide on a frame prefix — e.g. memcached
+        # binary bodies); consumed on arrival without re-parsing.
+        self.skip = {False: 0, True: 0}
+
+
+class DeviceAssistedEngine:
+    """Common pump for peek/judge/drive engines.
+
+    Subclasses implement ``_peek(flow, buf)`` returning the list of
+    device-encodable frame descriptors for complete request frames at
+    the head of ``buf`` (in order), or [] when none/fallback.
+    """
+
+    proto = ""
+    handles_reply = True
+
+    def __init__(self, policy, ingress: bool, port: int, model,
+                 logger=None, capacity: int = 2048):
+        self.policy = policy  # PolicyInstance for host fallback
+        self.ingress = ingress
+        self.port = port
+        self.model = model
+        self.logger = logger
+        self.capacity = capacity
+        self.flows: dict[int, _EngineFlow] = {}
+        self.instance = _EngineInstance(self)
+        self._pending_verdicts: dict[int, deque] = {}
+        self._driving_flow: int | None = None
+        self.device_judged = 0  # frames decided on device (telemetry)
+
+    # -- flow management --------------------------------------------------
+
+    def flow(self, flow_id: int, remote_id: int = 0, policy_name: str = "",
+             dst_id: int = 0, src_addr: str = "", dst_addr: str = "",
+             **_kw) -> _EngineFlow:
+        st = self.flows.get(flow_id)
+        if st is None:
+            conn = Connection(
+                instance=self.instance,
+                conn_id=flow_id,
+                ingress=self.ingress,
+                src_id=remote_id,
+                dst_id=dst_id,
+                src_addr=src_addr,
+                dst_addr=dst_addr or f"0.0.0.0:{self.port}",
+                policy_name=policy_name,
+                port=self.port,
+                parser_name=self.proto,
+                orig_buf=InjectBuf(4096),
+                reply_buf=InjectBuf(4096),
+            )
+            conn.parser = self._make_parser(conn)
+            st = _EngineFlow(conn, conn.parser)
+            self.flows[flow_id] = st
+        return st
+
+    def feed(self, flow_id: int, data: bytes, reply: bool = False,
+             remote_id: int = 0, **kw) -> None:
+        st = self.flow(flow_id, remote_id, **kw)
+        if st.skip[reply]:
+            take = min(st.skip[reply], len(data))
+            st.skip[reply] -= take
+            data = data[take:]
+            if not data:
+                return
+        st.bufs[reply] += data
+        st.stalled[reply] = False
+
+    def close_flow(self, flow_id: int) -> None:
+        self.flows.pop(flow_id, None)
+        self._pending_verdicts.pop(flow_id, None)
+
+    def take_ops(self, flow_id: int, reply: bool = False):
+        st = self.flows[flow_id]
+        ops = st.ops[reply]
+        st.ops[reply] = []
+        inject_orig = st.conn.orig_buf.take()
+        inject_reply = st.conn.reply_buf.take()
+        return ops, inject_orig, inject_reply
+
+    # -- the pump ---------------------------------------------------------
+
+    def pump(self) -> None:
+        while self._round():
+            pass
+
+    def _round(self) -> bool:
+        # 1. peek request-direction frames across flows
+        batch_entries: list[tuple[int, object]] = []
+        for fid, st in self.flows.items():
+            if st.stalled[False] or not st.bufs[False]:
+                continue
+            for desc in self._peek(st, bytes(st.bufs[False])):
+                batch_entries.append((fid, desc))
+        # 2. judge on device
+        self._pending_verdicts = {}
+        if batch_entries and not isinstance(self.model, ConstVerdict):
+            verdicts, overflow = self._judge(
+                [d for _, d in batch_entries],
+                np.asarray(
+                    [self.flows[fid].conn.src_id for fid, _ in batch_entries],
+                    np.int32,
+                ),
+            )
+            stopped: set[int] = set()
+            for i, (fid, _) in enumerate(batch_entries):
+                if fid in stopped:
+                    continue
+                if overflow[i]:
+                    # host fallback from this frame on, for THIS flow only
+                    stopped.add(fid)
+                    continue
+                self._pending_verdicts.setdefault(fid, deque()).append(
+                    bool(verdicts[i])
+                )
+                self.device_judged += 1
+        elif batch_entries and isinstance(self.model, ConstVerdict):
+            for fid, _ in batch_entries:
+                self._pending_verdicts.setdefault(fid, deque()).append(
+                    bool(self.model.allow)
+                )
+
+        # 3. drive the oracle op loop per (flow, direction)
+        progress = False
+        for fid, st in self.flows.items():
+            for reply in (False, True):
+                if st.stalled[reply] or not st.bufs[reply]:
+                    continue
+                self._driving_flow = fid if not reply else None
+                ops: list = []
+                res = st.conn.on_data(
+                    reply, False, [bytes(st.bufs[reply])], ops
+                )
+                self._driving_flow = None
+                consumed = 0
+                for op, n in ops:
+                    st.ops[reply].append((op, n))
+                    if op in (PASS, DROP):
+                        take = min(n, len(st.bufs[reply]) - consumed)
+                        consumed += take
+                        st.skip[reply] += n - take  # decide-on-prefix
+                if consumed:
+                    del st.bufs[reply][:consumed]
+                    progress = True
+                if res != FilterResult.OK:
+                    # parser error: ops carry ERROR; connection is dead
+                    st.stalled[False] = st.stalled[True] = True
+                elif not ops or ops[-1][0] == MORE or not st.bufs[reply]:
+                    st.stalled[reply] = True
+            # discard unused verdicts: next round re-peeks
+        self._pending_verdicts = {}
+        return progress
+
+    # -- subclass hooks ---------------------------------------------------
+
+    def _make_parser(self, conn):
+        raise NotImplementedError
+
+    def _peek(self, st: _EngineFlow, buf: bytes) -> list:
+        raise NotImplementedError
+
+    def _judge(self, descs: list, remotes: np.ndarray):
+        raise NotImplementedError
+
+
+class CassandraBatchEngine(DeviceAssistedEngine):
+    proto = "cassandra"
+
+    def _make_parser(self, conn):
+        return CassandraParser(conn)
+
+    class _PeekState:
+        """Non-mutating tokenizer context: keyspace evolves across the
+        peeked frames without touching the live parser.  The unprepared
+        error inject is swallowed by the null connection — the real
+        inject happens when the oracle drives the frame."""
+
+        _send_unprepared = CassandraParser._send_unprepared
+
+        def __init__(self, parser):
+            self.keyspace = parser.keyspace
+            self.prepared_path_by_stream_id = dict(
+                parser.prepared_path_by_stream_id
+            )
+            self.prepared_path_by_prepared_id = (
+                parser.prepared_path_by_prepared_id
+            )
+            self.connection = _NullConn()
+
+    def _peek(self, st, buf):
+        import struct
+
+        parser = st.parser
+        clone = self._PeekState(parser)
+        descs = []
+        off = 0
+        while True:
+            if len(buf) - off < CASS_HDR_LEN:
+                break
+            (request_len,) = struct.unpack_from(">I", buf, off + 5)
+            end = off + CASS_HDR_LEN + request_len
+            if end > len(buf):
+                break
+            frame = buf[off:end]
+            err, paths = CassandraParser._parse_request(clone, frame)
+            if err:
+                break  # oracle will ERROR on this frame; stop peeking
+            # All paths of the frame must match (batch opcode): encode
+            # each as a device row; the drive phase consumes one verdict
+            # per path in order (the oracle matches() per path).
+            for path in paths:
+                parts = path.split("/")
+                if len(parts) >= 4:
+                    descs.append((parts[2], parts[3], False))
+                else:
+                    descs.append(("", "", True))
+            off = end
+        return descs
+
+    def _judge(self, descs, remotes):
+        data, alen, tlen, nq, overflow = encode_cassandra_batch(descs)
+        allow = np.asarray(
+            cassandra_verdicts(self.model, data, alen, tlen, nq, remotes)
+        )
+        return allow, overflow
+
+
+class _NullConn:
+    """Inject sink for the peek pass (the real inject happens when the
+    oracle processes the frame)."""
+
+    def inject(self, reply, data):
+        return len(data)
+
+
+class MemcacheBatchEngine(DeviceAssistedEngine):
+    proto = "memcache"
+
+    def _make_parser(self, conn):
+        return MemcacheParser(conn)
+
+    def _peek(self, st, buf):
+        import struct
+
+        # Resolve the sniffed protocol (same rule as the unified parser).
+        inner = st.parser.parser
+        if inner is None:
+            if not buf:
+                return []
+            binary = buf[0] >= 128
+        else:
+            binary = isinstance(inner, BinaryMemcacheParser)
+
+        descs = []
+        off = 0
+        while True:
+            rest = buf[off:]
+            if binary:
+                if len(rest) < BINARY_HEADER_SIZE:
+                    break
+                (body_len,) = struct.unpack_from(">I", rest, 8)
+                (key_len,) = struct.unpack_from(">H", rest, 2)
+                extras_len = rest[4]
+                if key_len and len(rest) < BINARY_HEADER_SIZE + key_len + extras_len:
+                    break  # oracle asks MORE for the key
+                if not rest[0] & 0x80:
+                    break  # oracle errors out
+                key = rest[
+                    BINARY_HEADER_SIZE + extras_len :
+                    BINARY_HEADER_SIZE + extras_len + key_len
+                ]
+                descs.append((True, rest[1], "", [key]))
+                # The oracle decides once header+key are in, with
+                # pre-pass/drop for the body (decide-on-prefix).
+                off += BINARY_HEADER_SIZE + body_len
+                if off > len(buf):
+                    break
+            else:
+                linefeed = rest.find(b"\r\n")
+                if linefeed < 0:
+                    break
+                tokens = rest[:linefeed].split()
+                if not tokens:
+                    break
+                command = tokens[0]
+                cmd = command.decode("ascii", "replace")
+                keys: list[bytes] = []
+                frame_len = linefeed + 2
+                if command.startswith(b"get"):
+                    keys = tokens[1:]
+                elif command.startswith(b"gat"):
+                    keys = tokens[2:]
+                elif command in (b"set", b"add", b"replace", b"append",
+                                 b"prepend", b"cas"):
+                    keys = tokens[1:2]
+                    try:
+                        frame_len += int(tokens[4]) + 2
+                    except (IndexError, ValueError):
+                        break  # oracle errors
+                elif command in (b"delete", b"incr", b"decr", b"touch"):
+                    keys = tokens[1:2]
+                descs.append((False, 0, cmd, keys))
+                off += frame_len
+                if off > len(buf):
+                    break
+        return descs
+
+    def _judge(self, descs, remotes):
+        key_data, key_len, has_key, is_bin, opcode, cmd_id, overflow = (
+            encode_memcache_batch(descs)
+        )
+        allow = np.asarray(
+            memcache_verdicts(
+                self.model, key_data, key_len, has_key, is_bin, opcode,
+                cmd_id, remotes,
+            )
+        )
+        return allow, overflow

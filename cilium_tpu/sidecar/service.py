@@ -297,8 +297,14 @@ class VerdictService:
         if conn_id >= self._tab_size:
             return
         flow = sc.engine.flows.get(conn_id) if sc.engine is not None else None
+        buffered = False
+        if flow is not None:
+            if hasattr(flow, "buffer"):  # simple batch engines
+                buffered = bool(flow.buffer)
+            else:  # device-assisted engines: per-direction buffers
+                buffered = bool(flow.bufs[False] or flow.bufs[True])
         dirty = bool(
-            (flow is not None and flow.buffer)
+            buffered
             or sc.bufs[False]
             or sc.bufs[True]
             or sc.skip[False]
@@ -310,31 +316,59 @@ class VerdictService:
         """Attach the device batch engine for this connection's
         (policy, direction, port, proto), building the model on first use."""
         conn = sc.conn
-        if conn.parser_name != "r2d2":
-            return  # other protocols: oracle path (device models pending)
-        key = (module_id, conn.policy_name, conn.ingress, conn.port, "r2d2")
+        proto = conn.parser_name
+        if proto not in ("r2d2", "cassandra", "memcache"):
+            return  # other protocols: oracle path
+        key = (module_id, conn.policy_name, conn.ingress, conn.port, proto)
         with self._lock:
             eng = self._engines.get(key)
         if eng is None:
             # Build and prewarm OUTSIDE the registry lock: XLA compiles
             # are slow and must not stall unrelated control/data traffic.
-            from ..models.r2d2 import build_r2d2_model
-
             ins = pl.find_instance(module_id)
             policy = ins.policy_map().get(conn.policy_name)
-            model = build_r2d2_model(policy, conn.ingress, conn.port)
-            eng = R2d2BatchEngine(
-                model,
-                capacity=self.config.batch_flows,
-                width=self.config.batch_width,
-                logger=ins.access_logger,
-            )
-            self.prewarm(eng)
+            if proto == "r2d2":
+                from ..models.r2d2 import build_r2d2_model
+
+                model = build_r2d2_model(policy, conn.ingress, conn.port)
+                eng = R2d2BatchEngine(
+                    model,
+                    capacity=self.config.batch_flows,
+                    width=self.config.batch_width,
+                    logger=ins.access_logger,
+                )
+                self.prewarm(eng)
+            else:
+                from ..runtime.l7engine import (
+                    CassandraBatchEngine,
+                    MemcacheBatchEngine,
+                )
+
+                if proto == "cassandra":
+                    from ..models.cassandra import build_cassandra_model
+
+                    model = build_cassandra_model(
+                        policy, conn.ingress, conn.port
+                    )
+                    cls = CassandraBatchEngine
+                else:
+                    from ..models.memcached import build_memcache_model
+
+                    model = build_memcache_model(
+                        policy, conn.ingress, conn.port
+                    )
+                    cls = MemcacheBatchEngine
+                eng = cls(
+                    policy, conn.ingress, conn.port, model,
+                    logger=ins.access_logger,
+                    capacity=self.config.batch_flows,
+                )
             with self._lock:
                 # Double-checked insert: a racing binder may have won.
                 eng = self._engines.setdefault(key, eng)
         sc.engine = eng
-        sc.fast_ok = True
+        # Only the r2d2 engine is vectorized-path capable.
+        sc.fast_ok = proto == "r2d2"
 
     def close_connection(self, conn_id: int, expect=None) -> None:
         # Routed through the dispatcher by the caller so in-flight data
@@ -819,6 +853,28 @@ class VerdictService:
                   end_stream: bool, data: bytes):
         """Stateful path: request direction through the batch engine when
         available, otherwise the in-process oracle parser."""
+        if sc.engine is not None and getattr(sc.engine, "handles_reply", False):
+            # Device-assisted engine (cassandra/memcache): both directions.
+            conn = sc.conn
+            sc.engine.feed(
+                conn_id,
+                data,
+                reply=reply,
+                remote_id=conn.src_id,
+                policy_name=conn.policy_name,
+                dst_id=conn.dst_id,
+                src_addr=conn.src_addr,
+                dst_addr=conn.dst_addr,
+            )
+            sc.engine.pump()
+            ops, inj_orig, inj_reply = sc.engine.take_ops(conn_id, reply)
+            return (
+                conn_id,
+                int(FilterResult.OK),
+                [(int(op), int(nn)) for op, nn in ops],
+                inj_orig,
+                inj_reply,
+            )
         if sc.engine is not None and not reply:
             conn = sc.conn
             sc.engine.feed(

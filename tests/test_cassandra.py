@@ -1,0 +1,342 @@
+"""Cassandra parser oracle tests.
+
+Scenarios mirror reference proxylib/cassandra/cassandraparser_test.go
+(frame-level op/byte expectations, prepared-statement tracking,
+unauthorized/unprepared injects) plus the query tokenizer corner cases
+of cassandraparser.go:368-469.
+"""
+
+import struct
+
+import pytest
+
+from cilium_tpu.proxylib import (
+    DROP,
+    ERROR,
+    MORE,
+    PASS,
+    FilterResult,
+    NetworkPolicy,
+    PolicyParseError,
+    PortNetworkPolicy,
+    PortNetworkPolicyRule,
+    find_instance,
+    open_module,
+    reset_module_registry,
+)
+from cilium_tpu.proxylib.parsers.cassandra import (
+    UNAUTH_MSG_BASE,
+    UNPREPARED_MSG_BASE,
+    parse_query,
+)
+from cilium_tpu.proxylib.types import OpError
+
+from proxylib_harness import check_on_data, new_connection
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    reset_module_registry()
+    yield
+    reset_module_registry()
+
+
+def policy(rules, name="cp"):
+    return NetworkPolicy(
+        name=name,
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=9042,
+                rules=[
+                    PortNetworkPolicyRule(l7_proto="cassandra", l7_rules=rules)
+                ],
+            )
+        ],
+    )
+
+
+def setup_conn(rules):
+    mod = open_module([], True)
+    find_instance(mod).policy_update([policy(rules)])
+    res, conn = new_connection(
+        mod, "cassandra", True, 1, 2, "1.1.1.1:1", "2.2.2.2:9042", "cp"
+    )
+    assert res == FilterResult.OK
+    return conn
+
+
+def frame(opcode: int, body: bytes = b"", version: int = 4,
+          stream: int = 0, flags: int = 0) -> bytes:
+    return (
+        bytes([version, flags]) + struct.pack(">H", stream)
+        + bytes([opcode]) + struct.pack(">I", len(body)) + body
+    )
+
+
+def query_frame(cql: str, opcode: int = 0x07, stream: int = 0) -> bytes:
+    q = cql.encode()
+    # body: [long string] query + consistency + flags
+    body = struct.pack(">I", len(q)) + q + b"\x00\x01\x00"
+    return frame(opcode, body, stream=stream)
+
+
+def execute_frame(prepared_id: bytes, stream: int = 0) -> bytes:
+    body = struct.pack(">H", len(prepared_id)) + prepared_id + b"\x00\x01\x00"
+    return frame(0x0A, body, stream=stream)
+
+
+def prepared_result_frame(prepared_id: bytes, stream: int = 0) -> bytes:
+    body = (
+        struct.pack(">I", 0x0004)
+        + struct.pack(">H", len(prepared_id))
+        + prepared_id
+    )
+    return frame(0x08, body, version=0x84, stream=stream)
+
+
+def batch_frame(entries, stream: int = 0) -> bytes:
+    """entries: list of str (inline query) or bytes (prepared id)."""
+    body = b"\x00" + struct.pack(">H", len(entries))  # type + count
+    for e in entries:
+        if isinstance(e, str):
+            q = e.encode()
+            body += b"\x00" + struct.pack(">I", len(q)) + q
+        else:
+            body += b"\x01" + struct.pack(">H", len(e)) + e
+    body += b"\x00\x01"  # consistency
+    return frame(0x0D, body, stream=stream)
+
+
+def unauth_for(f: bytes) -> bytes:
+    msg = bytearray(UNAUTH_MSG_BASE)
+    msg[0] = 0x80 | (f[0] & 0x07)
+    msg[2] = f[2]
+    msg[3] = f[3]
+    return bytes(msg)
+
+
+# --- framing -------------------------------------------------------------
+
+def test_partial_header_asks_for_more():
+    conn = setup_conn([{}])
+    check_on_data(conn, False, False, [b"\x04\x00"], [(MORE, 7)])
+
+
+def test_partial_body_asks_for_missing():
+    conn = setup_conn([{}])
+    f = query_frame("SELECT * FROM ks.t1")
+    check_on_data(conn, False, False, [f[:12]], [(MORE, len(f) - 12)])
+
+
+def test_non_query_opcode_passes():
+    conn = setup_conn([{"query_action": "select", "query_table": "^none"}])
+    f = frame(0x05)  # OPTIONS — not query-like, always allowed
+    check_on_data(conn, False, False, [f], [(PASS, len(f)), (MORE, 9)])
+
+
+# --- allow/deny on select ------------------------------------------------
+
+def test_select_allowed():
+    conn = setup_conn([{"query_action": "select", "query_table": "^system\\."}])
+    f = query_frame("SELECT * FROM system.local WHERE key='local'")
+    check_on_data(conn, False, False, [f], [(PASS, len(f)), (MORE, 9)])
+    log = conn.instance.access_logger.entries[-1]
+    assert log.fields == {"query_action": "select", "query_table": "system.local"}
+
+
+def test_select_denied_injects_unauthorized():
+    conn = setup_conn([{"query_action": "select", "query_table": "^public\\."}])
+    f = query_frame("SELECT * FROM secret.creds", stream=7)
+    check_on_data(
+        conn, False, False, [f],
+        [(DROP, len(f)), (MORE, 9)],
+        exp_reply_buf=unauth_for(f),
+    )
+
+
+def test_insert_denied_by_action():
+    conn = setup_conn([{"query_action": "select"}])
+    f = query_frame("INSERT INTO ks.t (a) VALUES (1)")
+    check_on_data(
+        conn, False, False, [f],
+        [(DROP, len(f)), (MORE, 9)],
+        exp_reply_buf=unauth_for(f),
+    )
+
+
+def test_comment_query_is_parse_error():
+    conn = setup_conn([{}])
+    f = query_frame("SELECT * FROM t -- sneaky")
+    # The OnData loop fills the op array on repeated ERROR (reference:
+    # connection.go:141-173 has no ERROR break); the datapath treats
+    # the first ERROR as terminal (cilium_proxylib.cc:286).
+    check_on_data(
+        conn, False, False, [f],
+        [(ERROR, int(OpError.ERROR_INVALID_FRAME_TYPE))] * 16,
+    )
+
+
+def test_use_keyspace_qualifies_following_tables():
+    conn = setup_conn(
+        [
+            {"query_action": "select", "query_table": "^ks1\\."},
+            {"query_action": "use"},
+        ]
+    )
+    use = query_frame("USE ks1")
+    check_on_data(conn, False, False, [use], [(PASS, len(use)), (MORE, 9)])
+    sel = query_frame("SELECT * FROM t9")  # unqualified -> ks1.t9
+    check_on_data(conn, False, False, [sel], [(PASS, len(sel)), (MORE, 9)])
+
+
+# --- prepared statements -------------------------------------------------
+
+def test_prepare_execute_flow():
+    conn = setup_conn([{"query_action": "select", "query_table": "^ks\\."}])
+    prep = query_frame("SELECT * FROM ks.t1", opcode=0x09, stream=3)
+    check_on_data(conn, False, False, [prep], [(PASS, len(prep)), (MORE, 9)])
+    # server binds prepared-id on the reply direction
+    rep = prepared_result_frame(b"\x00\x01", stream=3)
+    check_on_data(conn, True, False, [rep], [(PASS, len(rep)), (MORE, 9)])
+    exe = execute_frame(b"\x00\x01", stream=4)
+    check_on_data(conn, False, False, [exe], [(PASS, len(exe)), (MORE, 9)])
+
+
+def test_prepare_execute_denied_after_policy_applies_to_execute():
+    conn = setup_conn([{"query_action": "select", "query_table": "^ks\\."}])
+    prep = query_frame("SELECT * FROM other.t1", opcode=0x09, stream=3)
+    # prepare itself is denied (path /prepare/select/other.t1)
+    check_on_data(
+        conn, False, False, [prep],
+        [(DROP, len(prep)), (MORE, 9)],
+        exp_reply_buf=unauth_for(prep),
+    )
+
+
+def test_execute_unknown_id_injects_unprepared():
+    conn = setup_conn([{}])
+    exe = execute_frame(b"\x00\x09", stream=5)
+    ops = []
+    res = conn.on_data(False, False, [exe], ops)
+    assert res == FilterResult.OK
+    # ERROR does not break the OnData loop (reference semantics): the
+    # parser re-sees the frame and re-injects until the op array fills.
+    assert ops == [(ERROR, int(OpError.ERROR_INVALID_FRAME_TYPE))] * 16
+    inj = conn.reply_buf.take()
+    one = len(inj) // 16
+    msg = inj[:one]
+    assert inj == msg * 16
+    assert msg.startswith(b"\x84\x00\x00\x05\x00")  # version|0x80, stream 5
+    assert msg[9:13] == b"\x00\x00\x25\x00"  # unprepared error code
+    assert msg.endswith(struct.pack(">H", 2) + b"\x00\x09")
+
+
+# --- batch ---------------------------------------------------------------
+
+def test_batch_all_allowed():
+    conn = setup_conn([{"query_table": "^ks\\."}])
+    f = batch_frame(["INSERT INTO ks.a (x) VALUES (1)",
+                     "INSERT INTO ks.b (x) VALUES (2)"])
+    check_on_data(conn, False, False, [f], [(PASS, len(f)), (MORE, 9)])
+
+
+def test_batch_one_denied_drops_all():
+    conn = setup_conn([{"query_table": "^ks\\."}])
+    f = batch_frame(["INSERT INTO ks.a (x) VALUES (1)",
+                     "INSERT INTO evil.b (x) VALUES (2)"])
+    check_on_data(
+        conn, False, False, [f],
+        [(DROP, len(f)), (MORE, 9)],
+        exp_reply_buf=unauth_for(f),
+    )
+
+
+def test_batch_with_prepared_id():
+    conn = setup_conn([{"query_table": "^ks\\."}])
+    prep = query_frame("INSERT INTO ks.a (x) VALUES (1)", opcode=0x09, stream=1)
+    check_on_data(conn, False, False, [prep], [(PASS, len(prep)), (MORE, 9)])
+    rep = prepared_result_frame(b"\x11", stream=1)
+    check_on_data(conn, True, False, [rep], [(PASS, len(rep)), (MORE, 9)])
+    f = batch_frame([b"\x11", "INSERT INTO ks.c (x) VALUES (3)"])
+    check_on_data(conn, False, False, [f], [(PASS, len(f)), (MORE, 9)])
+
+
+# --- rule validation -----------------------------------------------------
+
+def test_invalid_query_action_rejected():
+    mod = open_module([], True)
+    with pytest.raises(PolicyParseError):
+        find_instance(mod).policy_update(
+            [policy([{"query_action": "explode"}])]
+        )
+
+
+def test_no_table_action_with_table_rejected():
+    mod = open_module([], True)
+    with pytest.raises(PolicyParseError):
+        find_instance(mod).policy_update(
+            [policy([{"query_action": "list-users", "query_table": "x"}])]
+        )
+
+
+def test_unsupported_key_rejected():
+    mod = open_module([], True)
+    with pytest.raises(PolicyParseError):
+        find_instance(mod).policy_update([policy([{"nope": "x"}])])
+
+
+# --- tokenizer corner cases ---------------------------------------------
+
+class _P:
+    keyspace = ""
+
+
+@pytest.mark.parametrize(
+    "cql,action,table",
+    [
+        ("SELECT a FROM ks.t WHERE x=1", "select", "ks.t"),
+        ("DELETE FROM ks.t WHERE x=1", "delete", "ks.t"),
+        ("INSERT INTO ks.t (a) VALUES (1)", "insert", "ks.t"),
+        ("UPDATE ks.t SET a=1", "update", "ks.t"),
+        ("CREATE TABLE ks.t (a int)", "create-table", "ks.t"),
+        ("CREATE TABLE IF NOT EXISTS ks.t (a int)", "create-table", "ks.t"),
+        ("DROP TABLE IF EXISTS ks.t", "drop-table", "ks.t"),
+        # unqualified name + no active keyspace -> "." prefix
+        # (reference: cassandraparser.go:460-462)
+        ("DROP KEYSPACE IF EXISTS ks", "drop-keyspace", ".ks"),
+        # the bare-TRUNCATE special case (cassandraparser.go:447-450)
+        # is unreachable: action was already rewritten to
+        # "truncate-<field1>" at :424; preserved behavior
+        ("TRUNCATE ks.t", "truncate-ks.t", ""),
+        ("TRUNCATE TABLE ks.t", "truncate-table", "ks.t"),
+        ("CREATE MATERIALIZED VIEW mv AS SELECT", "create-materialized-view", ""),
+        ("CREATE CUSTOM INDEX ON ks.t (v)", "create-index", ""),
+        ("LIST USERS", "list-users", ""),
+        ("LIST ROLES", "list-roles", ""),
+        # grant/revoke are valid rule constants but the tokenizer's
+        # action switch has no grant/revoke arm (cassandraparser.go:398,
+        # 422) -> unparseable, matching the reference
+        ("GRANT ROLE x TO y", "", ""),
+        ("SELECT only", "", ""),  # no FROM -> unparseable
+        ("JUNK STATEMENT", "", ""),
+    ],
+)
+def test_parse_query(cql, action, table):
+    got_action, got_table = parse_query(_P(), cql)
+    assert got_action == action
+    assert got_table == table
+
+
+def test_unprepared_error_body_length_patched():
+    """The injected unprepared frame must declare the true body length
+    (divergence from the reference's hardcoded 0x1A)."""
+    conn = setup_conn([{}])
+    exe = execute_frame(b"\x00" * 16, stream=1)  # realistic MD5-size id
+    ops = []
+    conn.on_data(False, False, [exe], ops)
+    inj = conn.reply_buf.take()
+    msg = inj[: len(inj) // 16]
+    (body_len,) = struct.unpack_from(">I", msg, 5)
+    assert body_len == len(msg) - 9  # header excluded
+    assert body_len == 4 + 2 + 16  # error code + [short bytes] id

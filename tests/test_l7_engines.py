@@ -1,0 +1,265 @@
+"""Device-assisted engine parity: cassandra/memcached through the
+sidecar seam must produce the same op/inject streams as the in-process
+oracle, with the decisions actually rendered on the device path.
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+
+import pytest
+
+from cilium_tpu.proxylib import (
+    FilterResult,
+    NetworkPolicy,
+    PortNetworkPolicy,
+    PortNetworkPolicyRule,
+)
+from cilium_tpu.proxylib import instance as inst
+from cilium_tpu.proxylib.types import DROP, MORE, PASS
+from cilium_tpu.sidecar import SidecarClient, VerdictService
+from cilium_tpu.utils.option import DaemonConfig
+
+from proxylib_harness import new_connection
+
+
+@pytest.fixture
+def service(tmp_path):
+    inst.reset_module_registry()
+    svc = VerdictService(
+        str(tmp_path / "l7.sock"), DaemonConfig(batch_timeout_ms=2.0)
+    ).start()
+    yield svc
+    svc.stop()
+    inst.reset_module_registry()
+
+
+@pytest.fixture
+def client(service):
+    c = SidecarClient(service.socket_path)
+    yield c
+    c.close()
+
+
+def cass_policy():
+    return NetworkPolicy(
+        name="l7e",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=9042,
+                rules=[
+                    PortNetworkPolicyRule(
+                        l7_proto="cassandra",
+                        l7_rules=[
+                            {"query_action": "select",
+                             "query_table": "^public\\."},
+                            {"query_action": "use"},
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def mc_policy():
+    return NetworkPolicy(
+        name="l7e",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=11211,
+                rules=[
+                    PortNetworkPolicyRule(
+                        l7_proto="memcache",
+                        l7_rules=[
+                            {"command": "get", "keyPrefix": "user:"},
+                            {"command": "set"},
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def cass_query(cql: str, stream: int = 0) -> bytes:
+    q = cql.encode()
+    body = struct.pack(">I", len(q)) + q + b"\x00\x01\x00"
+    return (
+        bytes([4, 0]) + struct.pack(">H", stream) + bytes([0x07])
+        + struct.pack(">I", len(body)) + body
+    )
+
+
+def oracle_stream(policy, proto, port, msgs):
+    """[(reply, bytes)] through the in-process oracle ->
+    [(ops, inject_reply)] per message."""
+    mod = inst.open_module([], True)
+    ins = inst.find_instance(mod)
+    ins.policy_update([policy])
+    res, conn = new_connection(
+        mod, proto, True, 1, 2, "1.1.1.1:1", f"2.2.2.2:{port}", policy.name
+    )
+    assert res == FilterResult.OK
+    out = []
+    bufs = {False: b"", True: b""}
+    skip = {False: 0, True: 0}
+    for reply, m in msgs:
+        if skip[reply]:
+            take = min(skip[reply], len(m))
+            skip[reply] -= take
+            m = m[take:]
+        bufs[reply] += m
+        ops = []
+        conn.on_data(reply, False, [bufs[reply]], ops)
+        consumed = 0
+        for op, n in ops:
+            if op in (PASS, DROP):
+                take = min(n, len(bufs[reply]) - consumed)
+                consumed += take
+                skip[reply] += n - take
+        bufs[reply] = bufs[reply][consumed:]
+        out.append((
+            [(int(o), int(n)) for o, n in ops],
+            conn.reply_buf.take(),
+        ))
+    inst.close_module(mod)
+    return out
+
+
+def sidecar_stream(client, policy, proto, port, msgs, conn_id=7000):
+    mod = client.open_module([])
+    assert client.policy_update(mod, [policy]) == int(FilterResult.OK)
+    res, shim = client.new_connection(
+        mod, proto, conn_id, True, 1, 2, "1.1.1.1:1", f"2.2.2.2:{port}",
+        policy.name,
+    )
+    assert res == int(FilterResult.OK)
+    out = []
+    for reply, m in msgs:
+        _, entries = client._on_data_rpc(conn_id, reply, False, m)
+        ops, inj = [], b""
+        for _, r, eops, _io, ir in entries:
+            ops.extend(eops)
+            inj += ir
+        out.append((ops, inj))
+    shim.close()
+    return out
+
+
+def assert_stream_parity(got, exp):
+    assert len(got) == len(exp)
+    for i, ((gops, ginj), (eops, einj)) in enumerate(zip(got, exp)):
+        assert gops == eops, f"msg {i}: ops {gops} != {eops}"
+        assert ginj == einj, f"msg {i}: inject {ginj!r} != {einj!r}"
+
+
+def test_cassandra_sidecar_parity(service, client):
+    msgs = [
+        (False, cass_query("SELECT * FROM public.users")),
+        (False, cass_query("SELECT * FROM secret.creds", stream=3)),
+        (False, cass_query("USE public")),
+        (False, cass_query("SELECT * FROM t1")),  # -> public.t1, allowed
+        (False, cass_query("INSERT INTO public.x (a) VALUES (1)")),
+    ]
+    exp = oracle_stream(cass_policy(), "cassandra", 9042, msgs)
+    got = sidecar_stream(client, cass_policy(), "cassandra", 9042, msgs)
+    assert_stream_parity(got, exp)
+    # the decisions actually came from the device model
+    eng = next(
+        e for e in service._engines.values()
+        if type(e).__name__ == "CassandraBatchEngine"
+    )
+    assert eng.device_judged >= 4
+
+
+def test_cassandra_sidecar_split_frames(service, client):
+    f = cass_query("SELECT * FROM public.users")
+    msgs = [(False, f[:5]), (False, f[5:20]), (False, f[20:])]
+    exp = oracle_stream(cass_policy(), "cassandra", 9042, msgs)
+    got = sidecar_stream(client, cass_policy(), "cassandra", 9042, msgs)
+    assert_stream_parity(got, exp)
+
+
+def test_memcache_text_sidecar_parity(service, client):
+    msgs = [
+        (False, b"get user:1\r\n"),
+        (False, b"get admin:1\r\n"),  # denied, queued behind reply 1
+        (False, b"set anything 0 0 2\r\nhi\r\n"),
+        (True, b"VALUE user:1 0 1\r\nx\r\nEND\r\n"),
+        (True, b"STORED\r\n"),
+    ]
+    exp = oracle_stream(mc_policy(), "memcache", 11211, msgs)
+    got = sidecar_stream(client, mc_policy(), "memcache", 11211, msgs)
+    assert_stream_parity(got, exp)
+    eng = next(
+        e for e in service._engines.values()
+        if type(e).__name__ == "MemcacheBatchEngine"
+    )
+    assert eng.device_judged >= 3
+
+
+def test_memcache_binary_sidecar_parity(service, client):
+    def bin_req(opcode, key=b"", extras=b"", value=b""):
+        body = extras + key + value
+        return (
+            bytes([0x80, opcode]) + struct.pack(">H", len(key))
+            + bytes([len(extras), 0]) + b"\x00\x00"
+            + struct.pack(">I", len(body)) + b"\x00" * 12 + body
+        )
+
+    msgs = [
+        (False, bin_req(0x00, key=b"user:9")),
+        (False, bin_req(0x00, key=b"nope")),
+        (False, bin_req(0x01, key=b"k", extras=b"\x00" * 8, value=b"v")),
+    ]
+    exp = oracle_stream(mc_policy(), "memcache", 11211, msgs)
+    got = sidecar_stream(client, mc_policy(), "memcache", 11211, msgs)
+    assert_stream_parity(got, exp)
+
+
+def test_memcache_fuzz_chunked(service, client):
+    rng = random.Random(5)
+    raw = b"".join(
+        [
+            b"get user:1\r\n",
+            b"get admin:1\r\n",
+            b"set k 0 0 4\r\nabcd\r\n",
+            b"get user:2 user:3\r\n",  # multi-key -> host fallback
+            b"delete user:1\r\n",  # not allowed by policy
+            b"get user:4\r\n",
+        ]
+    )
+    msgs = []
+    i = 0
+    while i < len(raw):
+        n = rng.randrange(1, 16)
+        msgs.append((False, raw[i : i + n]))
+        i += n
+    exp = oracle_stream(mc_policy(), "memcache", 11211, msgs)
+    got = sidecar_stream(client, mc_policy(), "memcache", 11211, msgs)
+    assert_stream_parity(got, exp)
+
+
+def test_cassandra_fuzz_chunked(service, client):
+    rng = random.Random(11)
+    frames = [
+        cass_query("SELECT * FROM public.users"),
+        cass_query("SELECT * FROM secret.x"),
+        cass_query("USE public"),
+        cass_query("SELECT * FROM y"),
+        cass_query("UPDATE public.z SET a=1"),
+    ]
+    raw = b"".join(frames)
+    msgs = []
+    i = 0
+    while i < len(raw):
+        n = rng.randrange(1, 24)
+        msgs.append((False, raw[i : i + n]))
+        i += n
+    exp = oracle_stream(cass_policy(), "cassandra", 9042, msgs)
+    got = sidecar_stream(client, cass_policy(), "cassandra", 9042, msgs)
+    assert_stream_parity(got, exp)

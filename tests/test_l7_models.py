@@ -1,0 +1,202 @@
+"""Device-model fuzz tests: cassandra + memcached batch models must be
+bit-identical to the host oracle rule cascade (the ported proxylib
+matchers) over randomized policies and request batches.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from cilium_tpu.models.cassandra import (
+    build_cassandra_model,
+    cassandra_verdicts,
+    encode_cassandra_batch,
+)
+from cilium_tpu.models.memcached import (
+    TEXT_COMMANDS,
+    build_memcache_model,
+    encode_memcache_batch,
+    memcache_verdicts,
+)
+from cilium_tpu.models.base import ConstVerdict
+from cilium_tpu.proxylib import (
+    NetworkPolicy,
+    PortNetworkPolicy,
+    PortNetworkPolicyRule,
+)
+from cilium_tpu.proxylib.parsers.memcached import MemcacheMeta
+from cilium_tpu.proxylib.policy import compile_policy
+
+ACTIONS = ["select", "insert", "update", "delete", "use", "create-table"]
+TABLES = [
+    "system.local", "ks1.users", "ks1.orders", "secret.creds",
+    "public.data", "a.b",
+]
+TABLE_PATTERNS = [
+    "^system\\.", "^ks1\\.", "users", "^public\\.data$", ".*", "^a\\.",
+]
+
+
+def cass_policy(rng, n_rules):
+    rules = []
+    for _ in range(n_rules):
+        kv = {}
+        if rng.random() < 0.7:
+            kv["query_action"] = rng.choice(ACTIONS)
+        if rng.random() < 0.7:
+            kv["query_table"] = rng.choice(TABLE_PATTERNS)
+        rules.append(kv)
+    remotes = sorted(rng.sample(range(1, 8), rng.randrange(0, 3)))
+    return NetworkPolicy(
+        name="fz",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=9042,
+                rules=[
+                    PortNetworkPolicyRule(
+                        remote_policies=remotes,
+                        l7_proto="cassandra",
+                        l7_rules=rules,
+                    )
+                ],
+            )
+        ],
+    )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_cassandra_model_matches_oracle(seed):
+    rng = random.Random(seed)
+    policy = compile_policy(cass_policy(rng, rng.randrange(1, 4)))
+    model = build_cassandra_model(policy, ingress=True, port=9042)
+
+    reqs, paths, remotes = [], [], []
+    for _ in range(128):
+        if rng.random() < 0.15:
+            op = rng.choice(["options", "startup", "register"])
+            reqs.append((op, "", True))
+            paths.append(f"/{op}")
+        else:
+            action = rng.choice(ACTIONS)
+            table = rng.choice(TABLES)
+            reqs.append((action, table, False))
+            paths.append(f"/query/{action}/{table}")
+        remotes.append(rng.randrange(1, 8))
+
+    expected = [
+        policy.matches(True, 9042, r, p) for r, p in zip(remotes, paths)
+    ]
+    if isinstance(model, ConstVerdict):
+        assert all(e == model.allow for e in expected)
+        return
+    data, alen, tlen, nq, overflow = encode_cassandra_batch(reqs)
+    assert not overflow.any()
+    allow = np.asarray(
+        cassandra_verdicts(
+            model, data, alen, tlen, nq, np.asarray(remotes, np.int32)
+        )
+    )
+    for i in range(len(reqs)):
+        assert bool(allow[i]) == expected[i], (
+            f"req {reqs[i]} remote {remotes[i]}: device {bool(allow[i])} "
+            f"!= oracle {expected[i]}"
+        )
+
+
+MC_COMMANDS = ["get", "set", "delete", "incr", "stats", "touch", "flush_all"]
+MC_GROUPS = ["get", "set", "storage", "writeGroup", "delete", "stats", "touch"]
+MC_KEYS = [b"user:1", b"user:2", b"admin:1", b"k42", b"x", b""]
+
+
+def mc_policy(rng, n_rules):
+    rules = []
+    for _ in range(n_rules):
+        kv = {}
+        if rng.random() < 0.85:
+            kv["command"] = rng.choice(MC_GROUPS)
+            mode = rng.randrange(4)
+            if mode == 1:
+                kv["keyExact"] = rng.choice(["user:1", "k42"])
+            elif mode == 2:
+                kv["keyPrefix"] = rng.choice(["user:", "k"])
+            elif mode == 3:
+                kv["keyRegex"] = rng.choice(["^user:[0-9]+$", "k[0-9]+", "^x"])
+        rules.append(kv)
+    remotes = sorted(rng.sample(range(1, 8), rng.randrange(0, 3)))
+    return NetworkPolicy(
+        name="fz",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=11211,
+                rules=[
+                    PortNetworkPolicyRule(
+                        remote_policies=remotes,
+                        l7_proto="memcache",
+                        l7_rules=rules,
+                    )
+                ],
+            )
+        ],
+    )
+
+
+BIN_OPCODES = [0, 1, 2, 4, 5, 16, 20, 28, 10, 11]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_memcache_model_matches_oracle(seed):
+    rng = random.Random(100 + seed)
+    policy = compile_policy(mc_policy(rng, rng.randrange(1, 4)))
+    model = build_memcache_model(policy, ingress=True, port=11211)
+
+    frames, metas, remotes = [], [], []
+    for _ in range(128):
+        if rng.random() < 0.5:  # binary
+            op = rng.choice(BIN_OPCODES)
+            key = rng.choice(MC_KEYS)
+            frames.append((True, op, "", [key]))
+            metas.append(MemcacheMeta(opcode=op, keys=[key]))
+        else:  # text
+            cmd = rng.choice(MC_COMMANDS)
+            nkeys = 0 if cmd in ("stats", "flush_all") else 1
+            keys = [rng.choice(MC_KEYS[:-1]) for _ in range(nkeys)]
+            frames.append((False, 0, cmd, keys))
+            metas.append(MemcacheMeta(command=cmd, keys=keys))
+        remotes.append(rng.randrange(1, 8))
+
+    expected = [
+        policy.matches(True, 11211, r, m) for r, m in zip(remotes, metas)
+    ]
+    if isinstance(model, ConstVerdict):
+        assert all(e == model.allow for e in expected)
+        return
+    key_data, key_len, has_key, is_bin, opcode, cmd_id, overflow = (
+        encode_memcache_batch(frames)
+    )
+    assert not overflow.any()
+    allow = np.asarray(
+        memcache_verdicts(
+            model, key_data, key_len, has_key, is_bin, opcode, cmd_id,
+            np.asarray(remotes, np.int32),
+        )
+    )
+    for i in range(len(frames)):
+        assert bool(allow[i]) == expected[i], (
+            f"frame {frames[i]} remote {remotes[i]}: device "
+            f"{bool(allow[i])} != oracle {expected[i]}"
+        )
+
+
+def test_memcache_multikey_overflow_flagged():
+    frames = [(False, 0, "get", [b"a", b"b"]), (False, 0, "get", [b"a"])]
+    *_, overflow = encode_memcache_batch(frames)
+    assert overflow.tolist() == [True, False]
+
+
+def test_cassandra_oversize_table_overflow_flagged():
+    reqs = [("select", "x" * 200, False), ("select", "ks.t", False)]
+    *_, overflow = encode_cassandra_batch(reqs)
+    assert overflow.tolist() == [True, False]

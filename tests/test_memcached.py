@@ -1,0 +1,317 @@
+"""Memcached parser oracle tests (text + binary wire protocols).
+
+Scenarios mirror reference proxylib/memcached tests: command/key rule
+matching, storage-body framing, noreply handling, in-order denial
+injection, binary header framing, and the unified protocol sniff.
+"""
+
+import struct
+
+import pytest
+
+from cilium_tpu.proxylib import (
+    DROP,
+    ERROR,
+    INJECT,
+    MORE,
+    PASS,
+    FilterResult,
+    NetworkPolicy,
+    PolicyParseError,
+    PortNetworkPolicy,
+    PortNetworkPolicyRule,
+    find_instance,
+    open_module,
+    reset_module_registry,
+)
+from cilium_tpu.proxylib.parsers.memcached import (
+    BINARY_DENIED_MSG,
+    TEXT_DENIED_MSG,
+)
+
+from proxylib_harness import check_on_data, new_connection
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    reset_module_registry()
+    yield
+    reset_module_registry()
+
+
+def policy(rules, name="mp"):
+    return NetworkPolicy(
+        name=name,
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=11211,
+                rules=[
+                    PortNetworkPolicyRule(l7_proto="memcache", l7_rules=rules)
+                ],
+            )
+        ],
+    )
+
+
+def setup_conn(rules):
+    mod = open_module([], True)
+    find_instance(mod).policy_update([policy(rules)])
+    res, conn = new_connection(
+        mod, "memcache", True, 1, 2, "1.1.1.1:1", "2.2.2.2:11211", "mp"
+    )
+    assert res == FilterResult.OK
+    return conn
+
+
+def bin_request(opcode: int, key: bytes = b"", extras: bytes = b"",
+                value: bytes = b"") -> bytes:
+    body = extras + key + value
+    return (
+        bytes([0x80, opcode])          # magic, opcode
+        + struct.pack(">H", len(key))  # key length
+        + bytes([len(extras), 0])      # extras length, data type
+        + b"\x00\x00"                  # vbucket/status
+        + struct.pack(">I", len(body))  # total body length
+        + b"\x00" * 4                  # opaque
+        + b"\x00" * 8                  # cas
+        + body
+    )
+
+
+# --- text protocol: retrieval -------------------------------------------
+
+def test_text_get_allowed_by_prefix():
+    conn = setup_conn([{"command": "get", "keyPrefix": "user:"}])
+    msg = b"get user:7\r\n"
+    check_on_data(conn, False, False, [msg], [(PASS, len(msg)), (MORE, 2)])
+
+
+def test_text_get_denied_wrong_prefix_injects_inline():
+    conn = setup_conn([{"command": "get", "keyPrefix": "user:"}])
+    msg = b"get admin:1\r\n"
+    check_on_data(
+        conn, False, False, [msg],
+        [(DROP, len(msg)), (MORE, 2)],
+        exp_reply_buf=TEXT_DENIED_MSG,
+    )
+
+
+def test_text_get_multi_key_all_must_match():
+    conn = setup_conn([{"command": "get", "keyPrefix": "user:"}])
+    msg = b"get user:1 user:2\r\n"
+    check_on_data(conn, False, False, [msg], [(PASS, len(msg)), (MORE, 2)])
+    msg = b"get user:1 other:2\r\n"
+    # the allowed request's reply is still outstanding, so the denial
+    # is queued for its in-order slot, not injected inline
+    check_on_data(
+        conn, False, False, [msg], [(DROP, len(msg)), (MORE, 2)]
+    )
+
+
+def test_text_key_exact_and_regex():
+    conn = setup_conn([{"command": "get", "keyExact": "the-key"}])
+    check_on_data(conn, False, False, [b"get the-key\r\n"],
+                  [(PASS, 13), (MORE, 2)])
+    # denial queued behind the outstanding allowed reply (no inline inject)
+    check_on_data(conn, False, False, [b"get thekey\r\n"],
+                  [(DROP, 12), (MORE, 2)])
+    conn2 = setup_conn([{"command": "get", "keyRegex": "^k[0-9]+$"}])
+    check_on_data(conn2, False, False, [b"get k42\r\n"],
+                  [(PASS, 9), (MORE, 2)])
+    check_on_data(conn2, False, False, [b"get k42x\r\n"],
+                  [(DROP, 10), (MORE, 2)])
+
+
+# --- text protocol: storage + framing ------------------------------------
+
+def test_text_set_includes_data_block():
+    conn = setup_conn([{"command": "set"}])
+    head = b"set mykey 0 0 5\r\n"
+    # frame = command line + 5 data bytes + CRLF
+    check_on_data(
+        conn, False, False, [head + b"hello\r\n"],
+        [(PASS, len(head) + 7), (MORE, 2)],
+    )
+
+
+def test_text_set_noreply_not_queued():
+    conn = setup_conn([{"command": "set"}])
+    msg = b"set k 0 0 2 noreply\r\nhi\r\n"
+    check_on_data(conn, False, False, [msg], [(PASS, len(msg)), (MORE, 2)])
+    # no reply intent queued: a reply line now is a protocol error —
+    # ERROR with 0 bytes becomes PARSER_ERROR with no ops emitted
+    # (reference: connection.go:146)
+    ops = []
+    res = conn.on_data(True, False, [b"STORED\r\n"], ops)
+    assert res == FilterResult.PARSER_ERROR and ops == []
+
+
+def test_text_partial_line_more():
+    conn = setup_conn([{}])
+    check_on_data(conn, False, False, [b"get us"], [(MORE, 2)])
+    check_on_data(conn, False, False, [b"get us\r"], [(MORE, 1)])
+
+
+def test_text_unknown_command_error():
+    conn = setup_conn([{}])
+    ops = []
+    res = conn.on_data(False, False, [b"frobnicate k\r\n"], ops)
+    assert res == FilterResult.PARSER_ERROR and ops == []
+
+
+# --- text protocol: replies + in-order denial injection ------------------
+
+def test_text_reply_sequencing_with_denial():
+    conn = setup_conn([{"command": "get", "keyPrefix": "ok"}])
+    # request 1 allowed, request 2 denied (queued), request 3 allowed
+    check_on_data(conn, False, False, [b"get ok1\r\n"],
+                  [(PASS, 9), (MORE, 2)])
+    check_on_data(conn, False, False, [b"get bad\r\n"],
+                  [(DROP, 9), (MORE, 2)])
+    check_on_data(conn, False, False, [b"get ok2\r\n"],
+                  [(PASS, 9), (MORE, 2)])
+    # reply 1 passes; the loop re-invokes the parser, which finds the
+    # queued denial at the queue head and injects it immediately
+    rep1 = b"VALUE ok1 0 1\r\nx\r\nEND\r\n"
+    check_on_data(
+        conn, True, False, [rep1],
+        [(PASS, len(rep1)), (INJECT, len(TEXT_DENIED_MSG))],
+        exp_reply_buf=TEXT_DENIED_MSG,
+    )
+    # then the real reply for request 3 passes
+    rep3 = b"VALUE ok2 0 1\r\ny\r\nEND\r\n"
+    check_on_data(conn, True, False, [rep3], [(PASS, len(rep3))])
+
+
+def test_text_storage_reply_one_line():
+    conn = setup_conn([{"command": "set"}])
+    check_on_data(conn, False, False, [b"set k 0 0 2\r\nhi\r\n"],
+                  [(PASS, 17), (MORE, 2)])
+    check_on_data(conn, True, False, [b"STORED\r\n"], [(PASS, 8)])
+
+
+def test_text_stats_reply_until_end():
+    conn = setup_conn([{"command": "stats"}])
+    check_on_data(conn, False, False, [b"stats\r\n"], [(PASS, 7), (MORE, 2)])
+    # partial payload: no END yet
+    check_on_data(conn, True, False, [b"STAT pid 1\r\n"], [(MORE, 1)])
+    rep = b"STAT pid 1\r\nEND\r\n"
+    check_on_data(conn, True, False, [rep], [(PASS, len(rep))])
+
+
+# --- binary protocol -----------------------------------------------------
+
+def test_binary_partial_header_more():
+    conn = setup_conn([{}])
+    check_on_data(conn, False, False, [b"\x80\x00\x00"], [(MORE, 21)])
+
+
+def test_binary_get_allowed():
+    conn = setup_conn([{"command": "get", "keyPrefix": "user:"}])
+    f = bin_request(0x00, key=b"user:1")
+    check_on_data(conn, False, False, [f], [(PASS, len(f)), (MORE, 24)])
+
+
+def test_binary_get_denied_injects():
+    conn = setup_conn([{"command": "get", "keyPrefix": "user:"}])
+    f = bin_request(0x00, key=b"admin")
+    exp_inject = bytes([0x81]) + BINARY_DENIED_MSG[1:]
+    check_on_data(
+        conn, False, False, [f],
+        [(DROP, len(f)), (MORE, 24)],
+        exp_reply_buf=exp_inject,
+    )
+
+
+def test_binary_set_with_extras_and_value():
+    conn = setup_conn([{"command": "set"}])
+    f = bin_request(0x01, key=b"k", extras=b"\x00" * 8, value=b"hello")
+    check_on_data(conn, False, False, [f], [(PASS, len(f)), (MORE, 24)])
+
+
+def test_binary_opcode_not_in_set_denied():
+    conn = setup_conn([{"command": "get"}])
+    f = bin_request(0x04, key=b"k")  # delete opcode
+    exp_inject = bytes([0x81]) + BINARY_DENIED_MSG[1:]
+    check_on_data(
+        conn, False, False, [f],
+        [(DROP, len(f)), (MORE, 24)],
+        exp_reply_buf=exp_inject,
+    )
+
+
+def test_binary_denial_queue_in_order():
+    """A denial behind an outstanding allowed request is injected only
+    when its in-order slot comes up on the reply direction."""
+    conn = setup_conn([{"command": "get", "keyPrefix": "ok"}])
+    f1 = bin_request(0x00, key=b"ok1")
+    check_on_data(conn, False, False, [f1], [(PASS, len(f1)), (MORE, 24)])
+    f2 = bin_request(0x00, key=b"bad")
+    # denied but request 1 unanswered: queued, nothing injected yet
+    check_on_data(conn, False, False, [f2], [(DROP, len(f2)), (MORE, 24)])
+    # server answers request 1 -> passes; then the queued denial injects
+    rep1 = bin_request(0x00, value=b"x")
+    rep1 = bytes([0x81]) + rep1[1:]
+    # reply 1 passes, and the loop's re-invocation finds the queued
+    # denial now in-order and injects it in the same call
+    check_on_data(
+        conn, True, False, [rep1],
+        [(PASS, len(rep1)), (INJECT, len(BINARY_DENIED_MSG))],
+        exp_reply_buf=bytes([0x81]) + BINARY_DENIED_MSG[1:],
+    )
+
+
+# --- unified sniff -------------------------------------------------------
+
+def test_sniff_picks_binary_then_sticks():
+    conn = setup_conn([{"command": "get"}])
+    f = bin_request(0x00, key=b"k")
+    check_on_data(conn, False, False, [f], [(PASS, len(f)), (MORE, 24)])
+    assert type(conn.parser.parser).__name__ == "BinaryMemcacheParser"
+
+
+def test_sniff_picks_text():
+    conn = setup_conn([{"command": "get"}])
+    check_on_data(conn, False, False, [b"get k\r\n"], [(PASS, 7), (MORE, 2)])
+    assert type(conn.parser.parser).__name__ == "TextMemcacheParser"
+
+
+# --- rule validation -----------------------------------------------------
+
+def test_key_without_command_rejected():
+    mod = open_module([], True)
+    with pytest.raises(PolicyParseError):
+        find_instance(mod).policy_update([policy([{"keyPrefix": "x"}])])
+
+
+def test_unsupported_key_rejected():
+    mod = open_module([], True)
+    with pytest.raises(PolicyParseError):
+        find_instance(mod).policy_update([policy([{"bogus": "x"}])])
+
+
+def test_empty_rule_allows_everything():
+    conn = setup_conn([{}])
+    check_on_data(conn, False, False, [b"get anything\r\n"],
+                  [(PASS, 14), (MORE, 2)])
+    f = bin_request(0x04, key=b"k")
+    conn2 = setup_conn([{}])
+    check_on_data(conn2, False, False, [f], [(PASS, len(f)), (MORE, 24)])
+
+
+def test_text_get_miss_reply_bare_end():
+    """A get miss reply is just 'END\\r\\n' — must pass, not buffer
+    forever (divergence from the reference's terminator search)."""
+    conn = setup_conn([{"command": "get"}])
+    check_on_data(conn, False, False, [b"get nothere\r\n"],
+                  [(PASS, 13), (MORE, 2)])
+    check_on_data(conn, True, False, [b"END\r\n"], [(PASS, 5)])
+
+
+def test_unknown_command_value_rejected():
+    """A typo'd command name must not silently become allow-everything
+    (divergence from the reference's not-found map lookup)."""
+    mod = open_module([], True)
+    with pytest.raises(PolicyParseError):
+        find_instance(mod).policy_update([policy([{"command": "flushall"}])])
