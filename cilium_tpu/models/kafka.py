@@ -183,6 +183,8 @@ def encode_requests(
     tens of bytes, and shipping [F, T, 256] mostly-padding tensors makes
     the batch transfer-bound (measured ~4x throughput loss)."""
     f = len(reqs)
+    if topic_width is not None and topic_width > MAX_TOPIC_LEN:
+        raise ValueError(f"topic_width exceeds {MAX_TOPIC_LEN}")
     if topic_width is None:
         longest = max(
             (len(t.encode()) for r in reqs for t in r.get_topics()),
@@ -278,9 +280,16 @@ def kafka_verdicts(
         axis=1,
     )
 
-    # Topic coverage: [F, T, R] exact compares.
+    # Topic coverage: [F, T, R] exact compares.  The rule topic tensor is
+    # stored at MAX_TOPIC_LEN but the batch auto-sizes its width (see
+    # encode_requests); slice the rule tensor down to the batch width.
+    # Bit-identical: batch topic lengths are always <= width, so a rule
+    # with topic_len > width already fails the length-equality gate, and
+    # for rules with topic_len <= width every meaningful byte lies inside
+    # the slice (both tensors are zero-padded past their length).
+    rule_topic = model.topic[:, : topics.shape[-1]]
     t_eq = (topic_len[:, :, None] == model.topic_len[None, None, :]) & jnp.all(
-        topics[:, :, None, :] == model.topic[None, None, :, :], axis=-1
+        topics[:, :, None, :] == rule_topic[None, None, :, :], axis=-1
     )
     cover = jnp.any(
         t_eq & (~model.topic_any)[None, None, :] & base[:, None, :], axis=2
