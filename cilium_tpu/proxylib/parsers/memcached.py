@@ -265,15 +265,19 @@ class BinaryMemcacheParser:
         fields = {"opcode": str(opcode), "key": key.decode("utf-8", "surrogateescape")}
         frame_len = BINARY_HEADER_SIZE + body_len
 
+        # The 0x80 magic bit must be present in BOTH directions: the
+        # reference validates it in getOpcodeAndKey (binary/parser.go)
+        # before ever branching on reply, so a malformed reply frame is
+        # an invalid-frame error, not a PASS.
+        if not joined[0] & REQUEST_MAGIC:
+            return ERROR, int(OpError.ERROR_INVALID_FRAME_TYPE)
+
         if reply:
             self.connection.log(
                 EntryType.Response, proto="binarymemcached", fields=fields
             )
             self.reply_count += 1
             return PASS, frame_len
-
-        if not joined[0] & REQUEST_MAGIC:
-            return ERROR, int(OpError.ERROR_INVALID_FRAME_TYPE)
 
         self.request_count += 1
         meta = MemcacheMeta(opcode=opcode, keys=[key])

@@ -83,34 +83,41 @@ class ShimConnection:
         result, entries = self.client._on_data_rpc(
             self.conn_id, reply, end_stream, incoming
         )
+        # Queue every entry's ops and inject bytes BEFORE applying any op
+        # (mirrors native/shim.cc on_data_rpc): the service splits >16-op
+        # verdict lists into continuation entries with all inject bytes
+        # attached to the LAST chunk, so an INJECT op in an early chunk
+        # must be able to see inject bytes carried by a later one.
+        all_ops = []
         for _, res, ops, inj_orig, inj_reply in entries:
             if res != int(FilterResult.OK):
                 return res, bytes(output)
             self.dirs[False].inject += inj_orig
             self.dirs[True].inject += inj_reply
-            for op, n in ops:
-                if n <= 0 and op != MORE:
+            all_ops.extend(ops)
+        for op, n in all_ops:
+            if n <= 0 and op != MORE:
+                return int(FilterResult.PARSER_ERROR), bytes(output)
+            if op == MORE:
+                d.need_bytes = len(d.buffer) + n
+            elif op == PASS:
+                take = min(n, len(d.buffer))
+                output += d.buffer[:take]
+                del d.buffer[:take]
+                if n > take:
+                    d.pass_bytes = n - take
+            elif op == DROP:
+                take = min(n, len(d.buffer))
+                del d.buffer[:take]
+                if n > take:
+                    d.drop_bytes = n - take
+            elif op == INJECT:
+                if n > len(d.inject):
                     return int(FilterResult.PARSER_ERROR), bytes(output)
-                if op == MORE:
-                    d.need_bytes = len(d.buffer) + n
-                elif op == PASS:
-                    take = min(n, len(d.buffer))
-                    output += d.buffer[:take]
-                    del d.buffer[:take]
-                    if n > take:
-                        d.pass_bytes = n - take
-                elif op == DROP:
-                    take = min(n, len(d.buffer))
-                    del d.buffer[:take]
-                    if n > take:
-                        d.drop_bytes = n - take
-                elif op == INJECT:
-                    if n > len(d.inject):
-                        return int(FilterResult.PARSER_ERROR), bytes(output)
-                    output += d.inject[:n]
-                    del d.inject[:n]
-                elif op == ERROR:
-                    return int(FilterResult.PARSER_ERROR), bytes(output)
+                output += d.inject[:n]
+                del d.inject[:n]
+            elif op == ERROR:
+                return int(FilterResult.PARSER_ERROR), bytes(output)
         return int(result), bytes(output)
 
     def close(self) -> None:

@@ -72,6 +72,31 @@ class _SidecarConn:
         self.skip = {False: 0, True: 0}
 
 
+class _TabSnap:
+    """One-round consistent view of the vectorized-path conn tables,
+    taken under the registry lock at the start of each dispatch round so
+    eligibility checks and chunk issue never race policy_update /
+    new_connection table mutations (including engine slot reuse).
+
+    Holds only the rows for the round's (sorted, unique) conn ids —
+    O(round conns), not O(table size).  Out-of-range ids materialize as
+    engine=-1 / dirty=1 so they fail vec eligibility naturally."""
+
+    __slots__ = ("ids", "engine", "src", "dirty", "objs")
+
+    def __init__(self, ids, engine, src, dirty, objs):
+        self.ids = ids
+        self.engine = engine
+        self.src = src
+        self.dirty = dirty
+        self.objs = objs
+
+    def lookup(self, cids: np.ndarray) -> np.ndarray:
+        """Positions of cids in the snapshot rows (every data-item conn
+        id is in self.ids by construction)."""
+        return np.searchsorted(self.ids, cids.astype(np.int64))
+
+
 class _ColumnarLog:
     """Batched access-log sink for the fast path: one record per device
     batch instead of one Python object per request.  The per-batch ring
@@ -294,8 +319,6 @@ class VerdictService:
 
     def _tab_mark(self, conn_id: int, sc: "_SidecarConn") -> None:
         """Refresh the dirty flag from actual residual state."""
-        if conn_id >= self._tab_size:
-            return
         flow = sc.engine.flows.get(conn_id) if sc.engine is not None else None
         buffered = False
         if flow is not None:
@@ -310,7 +333,13 @@ class VerdictService:
             or sc.skip[False]
             or sc.skip[True]
         )
-        self._tab_dirty[conn_id] = 1 if dirty else 0
+        # Write under the lock: _tab_ensure (new_connection, another
+        # thread) reallocates the table arrays, and a lock-free store
+        # could land in the discarded old array, leaving a stale-clean
+        # dirty bit that re-admits a stateful conn to the vec path.
+        with self._lock:
+            if conn_id < self._tab_size:
+                self._tab_dirty[conn_id] = 1 if dirty else 0
 
     def _bind_engine(self, module_id: int, sc: _SidecarConn) -> None:
         """Attach the device batch engine for this connection's
@@ -411,15 +440,21 @@ class VerdictService:
         """
         closes = [it[1:] for it in items if it[0] == "close"]
         data_items = [it for it in items if it[0] in ("data", "mat")]
+        # Snapshot the conn tables under the lock once per round: the
+        # eligibility checks and chunk issue below run lock-free on the
+        # dispatcher thread while policy_update/new_connection mutate
+        # the tables (including _engine_objs slot reuse), so every read
+        # in this round must come from one consistent view.
+        snap = self._tab_snapshot(data_items)
         vec: list[tuple] = []  # (item, engine) — item kind "data" or "mat"
         general: list = []  # (arrival_idx, item)
         for k, it in enumerate(data_items):
             if it[0] == "mat":
-                eng = self._matrix_eligible(it[2])
+                eng = self._matrix_eligible(it[2], snap)
                 if eng is None:
                     it = ("data", it[1], _matrix_to_batch(it[2]))
             else:
-                eng = self._vec_eligible(it[2])
+                eng = self._vec_eligible(it[2], snap)
             if eng is not None:
                 vec.append((k, it, eng))
             else:
@@ -441,31 +476,57 @@ class VerdictService:
                 general.sort(key=lambda rec: rec[0])
             vec = kept
         if vec:
-            self._run_vec([(it, eng) for _, it, eng in vec])
+            self._run_vec([(it, eng) for _, it, eng in vec], snap)
         if general:
             self._process_entrywise([it for _, it in general])
         for close_args in closes:
             self.close_connection(*close_args)
 
-    def _matrix_eligible(self, mb: wire.MatrixBatch):
+    def _tab_snapshot(self, data_items: list) -> "_TabSnap | None":
+        if not data_items:
+            return None
+        ids = np.unique(
+            np.concatenate(
+                [it[2].conn_ids for it in data_items]
+            ).astype(np.int64)
+        )
+        with self._lock:
+            if self._tab_size == 0:
+                return _TabSnap(
+                    ids,
+                    np.full(len(ids), -1, np.int32),
+                    np.zeros(len(ids), np.int32),
+                    np.ones(len(ids), np.uint8),
+                    [],
+                )
+            in_range = ids < self._tab_size
+            clipped = np.where(in_range, ids, 0)
+            engine = np.where(
+                in_range, self._tab_engine[clipped], -1
+            ).astype(np.int32)
+            src = np.where(in_range, self._tab_src[clipped], 0).astype(np.int32)
+            dirty = np.where(
+                in_range, self._tab_dirty[clipped], 1
+            ).astype(np.uint8)
+            objs = list(self._engine_objs)
+        return _TabSnap(ids, engine, src, dirty, objs)
+
+    def _matrix_eligible(self, mb: wire.MatrixBatch, snap: "_TabSnap"):
         """Engine for a fixed-width matrix batch, or None to fall back."""
         n = mb.count
         if n == 0 or mb.width != self.config.batch_width:
             return None
-        cids = mb.conn_ids
-        if int(cids.max()) >= self._tab_size:
-            return None
-        idx = cids.astype(np.int64)
-        eng_idx = self._tab_engine[idx]
+        pos = snap.lookup(mb.conn_ids)
+        eng_idx = snap.engine[pos]
         e0 = int(eng_idx[0])
         if e0 < 0 or (eng_idx != e0).any():
             return None
-        if self._tab_dirty[idx].any():
+        if snap.dirty[pos].any():
             return None
         lengths = mb.lengths
         if int(lengths.min()) < 2 or int(lengths.max()) > mb.width:
             return None
-        engine = self._engine_objs[e0]
+        engine = snap.objs[e0]
         if engine is None or isinstance(engine.model, ConstVerdict):
             return None
         rows = mb.rows
@@ -479,7 +540,7 @@ class VerdictService:
             return None
         return engine
 
-    def _vec_eligible(self, batch: wire.DataBatch):
+    def _vec_eligible(self, batch: wire.DataBatch, snap: "_TabSnap"):
         """The engine serving every entry of this batch vectorized, or
         None if any entry needs the entrywise path."""
         n = batch.count
@@ -487,20 +548,17 @@ class VerdictService:
             return None
         if batch.flags.any():  # reply or end_stream entries
             return None
-        cids = batch.conn_ids
-        if int(cids.max()) >= self._tab_size:
-            return None
-        idx = cids.astype(np.int64)
-        eng_idx = self._tab_engine[idx]
+        pos = snap.lookup(batch.conn_ids)
+        eng_idx = snap.engine[pos]
         e0 = int(eng_idx[0])
         if e0 < 0 or (eng_idx != e0).any():
             return None
-        if self._tab_dirty[idx].any():
+        if snap.dirty[pos].any():
             return None
         lengths = batch.lengths
         if int(lengths.min()) < 2 or int(lengths.max()) > self.config.batch_width:
             return None
-        engine = self._engine_objs[e0]
+        engine = snap.objs[e0]
         if engine is None or isinstance(engine.model, ConstVerdict):
             return None
         blob = np.frombuffer(batch.blob, np.uint8)
@@ -552,7 +610,7 @@ class VerdictService:
             )
             np.asarray(out[-1])
 
-    def _run_vec(self, vec_items: list) -> None:
+    def _run_vec(self, vec_items: list, snap: "_TabSnap") -> None:
         """One device call per engine chunk over the concatenated
         batches, ops emitted columnar straight from the verdict arrays."""
         groups: dict[int, list] = {}
@@ -576,7 +634,7 @@ class VerdictService:
                         [it[2].lengths for it in mats]
                     ).astype(np.int32)
                     m_ids = np.concatenate([it[2].conn_ids for it in mats])
-                issued = self._issue_chunks(engine, m_rows, m_lens, m_ids)
+                issued = self._issue_chunks(engine, m_rows, m_lens, m_ids, snap)
                 sends, start = [], 0
                 for _, client, mb in mats:
                     sends.append(
@@ -604,7 +662,7 @@ class VerdictService:
             gather = offs[:, None] + col
             mask = col < lengths[:, None]
             rows = blob[np.minimum(gather, len(blob) - 1)] * mask
-            issued = self._issue_chunks(engine, rows, lengths, conn_ids)
+            issued = self._issue_chunks(engine, rows, lengths, conn_ids, snap)
             sends, start = [], 0
             for _, client, batch in datas:
                 sends.append(
@@ -615,7 +673,8 @@ class VerdictService:
                 start += batch.count
             self._completions.put(("vec", issued, n, sends))
 
-    def _issue_chunks(self, engine, rows, lengths, conn_ids) -> list:
+    def _issue_chunks(self, engine, rows, lengths, conn_ids,
+                      snap: "_TabSnap") -> list:
         """Issue device calls over [n, width] rows in fixed bucket-shaped
         chunks WITHOUT blocking; returns [(allow_future, a, b, cn)] for
         the completion worker to materialize."""
@@ -634,7 +693,7 @@ class VerdictService:
             lens = np.zeros(f_pad, np.int32)
             lens[:cn] = lengths[a:b]
             remotes = np.zeros(f_pad, np.int32)
-            remotes[:cn] = self._tab_src[conn_ids[a:b].astype(np.int64)]
+            remotes[:cn] = snap.src[snap.lookup(conn_ids[a:b])]
             _, _, chunk_allow = self._model_call(engine.model, data, lens, remotes)
             issued.append((chunk_allow, a, b, cn))
         return issued
@@ -900,16 +959,56 @@ class VerdictService:
         buf += data
         all_ops: list[tuple[int, int]] = []
         result = FilterResult.OK
-        for _ in range(64):
+        # Loop while the parser fills the op array AND makes progress:
+        # a full op array means more complete frames may still be
+        # buffered, and a quiescent peer would never trigger another
+        # pass, so draining must not be capped at a fixed iteration
+        # count (tail frames would stall indefinitely).
+        #
+        # Each pass hands the parser a bounded WINDOW of the backlog
+        # instead of the whole buffer: parsers re-join their input per
+        # invocation, so feeding the full backlog every pass is
+        # quadratic on large bursts.  A MORE emitted while bytes were
+        # withheld by the window is an artifact — the window grows (or
+        # the next pass continues after consumption) instead of
+        # surfacing it.
+        window = 1 << 16
+        while True:
+            avail = len(buf)
+            windowed = avail > window
+            chunk = bytes(memoryview(buf)[:window]) if windowed else bytes(buf)
             ops: list = []
-            result = sc.conn.on_data(reply, end_stream, [bytes(buf)], ops)
+            # end_stream only reaches the parser once the window covers
+            # the whole backlog — withheld bytes mean the stream has not
+            # actually ended from the parser's point of view.
+            result = sc.conn.on_data(
+                reply, end_stream and not windowed, [chunk], ops
+            )
+            consumed = 0
+            progress = False
+            deferred_more = False
             for op, nbytes in ops:
+                if op == MORE and windowed:
+                    deferred_more = True
+                    continue
                 all_ops.append((int(op), int(nbytes)))
                 if op in (PASS, DROP):
-                    take = min(nbytes, len(buf))
-                    del buf[:take]
+                    take = min(nbytes, avail - consumed)
+                    consumed += take
                     sc.skip[reply] += nbytes - take
-            if result != FilterResult.OK or len(ops) < wire.MAX_OPS_PER_ENTRY:
+                    if take:
+                        progress = True
+            if consumed:
+                del buf[:consumed]
+            if result != FilterResult.OK:
+                break
+            if deferred_more:
+                if not progress:
+                    window *= 2  # frame larger than the window
+                continue
+            if len(ops) < wire.MAX_OPS_PER_ENTRY:
+                break
+            if not progress:
                 break
         inj_orig = sc.conn.orig_buf.take()
         inj_reply = sc.conn.reply_buf.take()

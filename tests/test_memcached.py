@@ -224,6 +224,26 @@ def test_binary_get_denied_injects():
     )
 
 
+def test_binary_reply_without_magic_bit_errors():
+    """Reply frames must carry the 0x80 magic bit too: the reference
+    validates the magic in getOpcodeAndKey (binary/parser.go) before the
+    reply branch, so a malformed reply is an invalid-frame error."""
+    conn = setup_conn([{}])
+    # Force the sniffing parser onto the binary protocol first.
+    f = bin_request(0x00, key=b"k")
+    check_on_data(conn, False, False, [f], [(PASS, len(f)), (MORE, 24)])
+    bad_reply = bytes([0x00, 0x00]) + b"\x00" * 22  # magic bit absent
+    ops = []
+    res = conn.on_data(True, False, [bad_reply], ops)
+    # The OnData loop fills the op array on repeated ERROR (reference:
+    # connection.go has no ERROR break); the datapath treats the first
+    # ERROR as terminal (cilium_proxylib.cc:286).
+    assert res == FilterResult.OK
+    from cilium_tpu.proxylib import ERROR, OpError
+
+    assert ops == [(ERROR, int(OpError.ERROR_INVALID_FRAME_TYPE))] * 16
+
+
 def test_binary_set_with_extras_and_value():
     conn = setup_conn([{"command": "set"}])
     f = bin_request(0x01, key=b"k", extras=b"\x00" * 8, value=b"hello")

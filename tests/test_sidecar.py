@@ -283,6 +283,27 @@ def test_sidecar_shim_on_io_output_bytes(client):
     shim.close()
 
 
+def test_sidecar_oracle_drains_large_backlog(client):
+    """A single entry carrying thousands of buffered frames is fully
+    verdicted in one response: the oracle drain loop has no fixed
+    iteration cap (a quiescent peer would stall tail frames forever),
+    and the backlog exceeds the 64KB drain window so the windowed
+    re-parse path is exercised too."""
+    mod = open_with_policy(client)
+    res, shim = client.new_connection(
+        mod, "r2d2", 4242, True, 1, 2, "1.1.1.1:1", "2.2.2.2:80",
+        "sidecar-pol",
+    )
+    assert res == int(FilterResult.OK)
+    line = b"OK 0123456\r\n"
+    n = 10_000  # ~120KB of reply frames, > the 64KB window
+    burst = line * n
+    result, out = shim.on_io(True, burst)
+    assert result == int(FilterResult.OK)
+    assert out == burst  # every reply frame passed, none stalled
+    shim.close()
+
+
 def test_sidecar_policy_swap(client):
     mod = open_with_policy(client)
     res, shim = client.new_connection(
