@@ -355,6 +355,226 @@ def bench_cassandra():
     return rate, cpu_rate
 
 
+# --- config 5: 10k-rule / 1M-flow stress ---------------------------------
+
+# 250 HTTP policies x 20 rules + 50 Kafka policies x 100 rules = 10,000
+# rules; 1M flows replayed (500k HTTP + 500k Kafka), spread evenly.
+# Per-policy models are padded to ONE shared shape set so XLA compiles
+# exactly one executable per protocol (reference scale analog:
+# envoy/cilium_network_policy.h:50-76 per-identity compiled rule tables).
+STRESS_HTTP_POLICIES = 250
+STRESS_HTTP_RULES = 20
+STRESS_KAFKA_POLICIES = 50
+STRESS_KAFKA_RULES = 100
+STRESS_FLOWS = 1_000_000
+
+
+def _stress_http_models():
+    """One HttpBatchModel per policy.  Every rule is method-literal +
+    path-literal-prefix, so the tiered compiler routes the whole set to
+    the byte-compare tier — no automaton, and all policies share one
+    jit shape naturally (same rule/row counts)."""
+    from cilium_tpu.models.http import build_http_model
+    from cilium_tpu.policy.api import PortRuleHTTP
+
+    models = []
+    for p in range(STRESS_HTTP_POLICIES):
+        rules = [
+            (frozenset(), PortRuleHTTP(method="GET", path=f"/svc{p}/r{j}/.*"))
+            for j in range(STRESS_HTTP_RULES)
+        ]
+        m = build_http_model(rules)
+        assert m.line_nfa is None, "stress rules must be literal-tier"
+        models.append(m)
+    return models, ("literal-tier", 0)
+
+
+def bench_stress():
+    import jax
+
+    from cilium_tpu.kafka.policy import matches_rule
+    from cilium_tpu.kafka.request import RequestMessage
+    from cilium_tpu.models.http import http_verdicts
+    from cilium_tpu.models.kafka import (
+        build_kafka_model,
+        encode_requests,
+        kafka_verdicts,
+    )
+    from cilium_tpu.policy.api import PortRuleKafka
+
+    rng = random.Random(23)
+    n_http_flows = STRESS_FLOWS // 2
+    n_kafka_flows = STRESS_FLOWS - n_http_flows
+    per_http = n_http_flows // STRESS_HTTP_POLICIES
+    per_kafka = n_kafka_flows // STRESS_KAFKA_POLICIES
+
+    t_build0 = time.perf_counter()
+    http_models, (http_tier, _) = _stress_http_models()
+    kafka_rule_objs = []
+    kafka_models = []
+    for p in range(STRESS_KAFKA_POLICIES):
+        rules = []
+        for j in range(STRESS_KAFKA_RULES):
+            kr = PortRuleKafka(
+                role="produce" if j % 2 == 0 else "consume",
+                topic=f"p{p}t{j}",
+            )
+            kr.sanitize()
+            rules.append(kr)
+        kafka_rule_objs.append(rules)
+        kafka_models.append(build_kafka_model([(frozenset(), r) for r in rules]))
+    build_s = time.perf_counter() - t_build0
+    print(
+        f"bench stress: built {STRESS_HTTP_POLICIES}x{STRESS_HTTP_RULES} http"
+        f" ({http_tier}) + {STRESS_KAFKA_POLICIES}x"
+        f"{STRESS_KAFKA_RULES} kafka rule tables in {build_s:.1f}s",
+        file=sys.stderr,
+    )
+
+    # --- generate + pre-stage all flows, stacked on a leading POLICY
+    # axis so the whole replay is ONE jit launch per protocol (one
+    # device round trip; per-call launches through the remote-chip
+    # tunnel serialize a link RTT each — measured 150ms/call).
+    L = 64
+    http_data = np.zeros((STRESS_HTTP_POLICIES, per_http, L), np.uint8)
+    http_len = np.zeros((STRESS_HTTP_POLICIES, per_http), np.int32)
+    http_labels = np.zeros((STRESS_HTTP_POLICIES, per_http), bool)
+    http_sample = []  # (req_bytes, policy, label) for the re oracle
+    for p in range(STRESS_HTTP_POLICIES):
+        for i in range(per_http):
+            roll = rng.random()
+            j = rng.randrange(STRESS_HTTP_RULES)
+            if roll < 0.5:
+                method, path, ok = "GET", f"/svc{p}/r{j}/items/x{rng.randrange(1000)}", True
+            elif roll < 0.7:
+                method, path, ok = "POST", f"/svc{p}/r{j}/items/y", False
+            elif roll < 0.9:
+                method, path, ok = "GET", f"/svc{p}/r{j + STRESS_HTTP_RULES}/z", False
+            else:
+                method, path, ok = "GET", f"/svc{(p + 1) % STRESS_HTTP_POLICIES}/q/", False
+            req = f"{method} {path} HTTP/1.1\r\n\r\n".encode()
+            http_data[p, i, : len(req)] = np.frombuffer(req, np.uint8)
+            http_len[p, i] = len(req)
+            http_labels[p, i] = ok
+            if len(http_sample) < 500 and i < 2:
+                http_sample.append((req, p, ok))
+
+    kafka_stacked = None
+    kafka_labels = np.zeros((STRESS_KAFKA_POLICIES, per_kafka), bool)
+    kafka_samples = []  # (policy, [RequestMessage])
+    kafka_parts = []
+    for p in range(STRESS_KAFKA_POLICIES):
+        reqs = []
+        for i in range(per_kafka):
+            n_topics = rng.choice([1, 1, 2])
+            produce = rng.random() < 0.5
+            topics, ok_all = [], True
+            for _ in range(n_topics):
+                j = rng.randrange(STRESS_KAFKA_RULES)
+                if rng.random() < 0.6:
+                    # Covered iff the rule's role matches the api key.
+                    topics.append(f"p{p}t{j}")
+                    ok_all &= (j % 2 == 0) == produce
+                else:
+                    topics.append(f"p{p}x{j}")
+                    ok_all = False
+            reqs.append(
+                RequestMessage(
+                    api_key=0 if produce else 1, api_version=1,
+                    correlation_id=i, client_id="stress",
+                    topics=topics, parsed=True,
+                )
+            )
+            kafka_labels[p, i] = ok_all
+        batch = encode_requests(reqs, topic_width=32)
+        assert not batch.overflow.any()
+        kafka_parts.append(batch)
+        kafka_samples.append((p, reqs[:10]))
+    kafka_stacked = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *kafka_parts
+    )
+
+    # Stack per-policy models into [P, ...] pytrees (shared shapes).
+    import jax.numpy as jnp
+
+    http_stack = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *http_models
+    )
+    kafka_stack = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *kafka_models
+    )
+    rem_http = np.ones((STRESS_HTTP_POLICIES, per_http), np.int32)
+    rem_kafka = np.ones((STRESS_KAFKA_POLICIES, per_kafka), np.int32)
+
+    # lax.map (not vmap) over policies: per-policy intermediates (the
+    # [F, R, S*C] DFA joint, the [F, T, R, W] kafka topic compare) stay
+    # VMEM-tile-sized; vmapping would ask XLA to tile them with an extra
+    # [P] axis — measured 4x slower on the http side.
+    http_replay = jax.jit(
+        lambda ms, ds, lns, rms: jax.lax.map(
+            lambda args: http_verdicts(*args)[2], (ms, ds, lns, rms)
+        )
+    )
+    kafka_replay = jax.jit(
+        lambda ms, bs, rms: jax.lax.map(
+            lambda args: kafka_verdicts(args[0], args[1], args[2]),
+            (ms, bs, rms),
+        )
+    )
+
+    hd = jax.device_put(http_data)
+    hl = jax.device_put(http_len)
+    hr = jax.device_put(rem_http)
+    kb = jax.tree_util.tree_map(jax.device_put, kafka_stacked)
+    kr = jax.device_put(rem_kafka)
+
+    # --- warm (compile) both executables, then the timed replay
+    np.asarray(http_replay(http_stack, hd, hl, hr))
+    np.asarray(kafka_replay(kafka_stack, kb, kr))
+
+    t0 = time.perf_counter()
+    http_allow = http_replay(http_stack, hd, hl, hr)
+    kafka_allow = kafka_replay(kafka_stack, kb, kr)
+    http_allow = np.asarray(http_allow)
+    kafka_allow = np.asarray(kafka_allow)
+    dt = time.perf_counter() - t0
+    n_total = n_http_flows + n_kafka_flows
+    rate = n_total / dt
+
+    # --- bit-check every verdict against the generation labels
+    mism = int((http_allow != http_labels).sum()) + int(
+        (kafka_allow != kafka_labels).sum()
+    )
+    assert mism == 0, f"stress verdicts diverge from labels ({mism})"
+
+    # --- spot-check labels themselves against the reference oracles
+    import re as _re
+
+    for req, p, ok in http_sample[:200]:
+        head = req.split(b"\r\n\r\n")[0].decode()
+        m, path, _ = head.split(" ", 2)
+        want = m == "GET" and any(
+            _re.fullmatch(f"/svc{p}/r{j}/.*", path)
+            for j in range(STRESS_HTTP_RULES)
+        )
+        assert want == ok, f"http label oracle mismatch: {req!r}"
+    for p, sample in kafka_samples[:10]:
+        for i, r in enumerate(sample):
+            want = matches_rule(r, kafka_rule_objs[p])
+            assert want == kafka_labels[p, i], (
+                f"kafka label oracle mismatch: {r!r}"
+            )
+
+    print(
+        f"bench stress: {n_total:,} flows / 10,000 rules in {dt:.2f}s "
+        f"-> {rate:,.0f} verdicts/s (http {n_http_flows:,} @ "
+        f"{STRESS_HTTP_POLICIES} policies, kafka {n_kafka_flows:,} @ "
+        f"{STRESS_KAFKA_POLICIES}), mismatches=0",
+        file=sys.stderr,
+    )
+    return rate, dt
+
+
 # --- sidecar latency -----------------------------------------------------
 
 def bench_latency():
@@ -415,6 +635,15 @@ def run_one(which: str) -> None:
                 r1m.p99_ms / max(lat["device_rtt_ms"], 1e-9), 2
             ),
         )
+    elif which == "stress":
+        rate, dt = bench_stress()
+        _emit(
+            "stress_10k_rules_1m_flows_verdicts_per_sec", rate,
+            "verdicts/s", rate / 1_000_000,
+            rules=STRESS_HTTP_POLICIES * STRESS_HTTP_RULES
+            + STRESS_KAFKA_POLICIES * STRESS_KAFKA_RULES,
+            flows=STRESS_FLOWS, replay_seconds=round(dt, 2),
+        )
     elif which == "r2d2":
         rate, cpu = bench_r2d2()
         _emit("r2d2_l7_verdicts_per_sec_per_chip", rate, "verdicts/s",
@@ -424,7 +653,7 @@ def run_one(which: str) -> None:
 
 
 # Headline (r2d2) runs LAST so its JSON line is the final stdout line.
-CONFIGS = ("http", "kafka", "cassandra", "latency", "r2d2")
+CONFIGS = ("http", "kafka", "cassandra", "latency", "stress", "r2d2")
 
 
 def main():

@@ -8,11 +8,23 @@ pkg/policy/api/http.go:28 PortRuleHTTP) with one device pass:
 
   1. tokenize the request line ([F, L] uint8): method span = [0, sp1),
      path span = (sp1, sp2) — pure bytescan, no host round-trip
-  2. anchored NFA match of per-rule method/path regexes on those spans
+  2. TIERED method/path matching:
+       - tier 0 (free): omitted fields allow everything (http.go skips
+         the check entirely) — a per-rule flag, no byte work
+       - tier 1 (literal): patterns that are literals ("GET"),
+         alternations of literals ("GET|HEAD"), or literal prefixes
+         ("/api/v1/.*") — the overwhelming majority of real policies —
+         match with vectorized byte compares, NO automaton at all
+       - tier 2 (regex): everything else goes through the NFA (matmul,
+         small sets) or per-pattern DFA (block-diagonal, large sets)
   3. host regex + exact header lines matched as CRLF-delimited patterns
      searched over the whole request head
   4. a rule allows iff all its present components match; request allowed
      iff any rule with a matching remote allows.
+
+The tiers are bit-identical to the pure-regex path: literal analysis is
+done on the parsed AST (so escapes and alternation mirror the compiler),
+and literal-prefix rows carry the regex ``.*``-excludes-newline guard.
 
 Deny maps to a 403 response injected by the runtime engine
 (reference: cilium_l7policy.cc 403 body injection).
@@ -26,13 +38,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.bytescan import first_occurrence, first_subsequence2
+from ..ops.bytescan import first_occurrence, first_subsequence2, spans_equal_prefix, spans_start_with
+from ..ops.dfa import DeviceDfa, device_dfa, dfa_search_spans
 from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
 from ..policy.api import PortRuleHTTP
 from ..regex import compile_patterns
+from ..regex.dfa import DfaBlowupError, compile_pattern_dfas
+from ..regex.parse import DOT_BYTES, ParseError, parse
 from .base import ConstVerdict, pack_remote_sets, remote_ok
 
 _RE_META = set("\\^$.[]|()*+?{}")
+
+# Above this REGEX-TIER pattern count "auto" compiles per-pattern DFAs
+# instead of the dense union NFA (whose delta grows O(S²·C)).
+_DFA_RULE_THRESHOLD = 16
+
+LIT_W = 64  # max literal needle bytes; longer literals fall to regex
 
 
 def re_escape(s: str) -> str:
@@ -67,53 +88,100 @@ def _header_pattern(header: str) -> str:
     )
 
 
-@jax.tree_util.register_pytree_node_class
-@dataclass
-class HttpBatchModel:
-    line_nfa: DeviceNfa  # method+path patterns (anchored), 2 per rule
-    head_nfa: DeviceNfa | None  # host/header patterns over the head
-    # Mapping from flattened head patterns to rules:
-    head_rule: jax.Array  # [P] int32 — owning rule row
-    head_count: jax.Array  # [R] int32 — number of head patterns per rule
-    remote_ids: jax.Array  # [R, MAX_REMOTES] int32
-    any_remote: jax.Array  # [R] bool
-    n_rules: int = 0
+# --- literal-tier analysis ------------------------------------------------
 
-    def tree_flatten(self):
-        return (
-            (self.line_nfa, self.head_nfa, self.head_rule, self.head_count,
-             self.remote_ids, self.any_remote),
-            (self.n_rules,),
+def _ast_literal(node) -> bytes | None:
+    """Bytes of a pure single-byte-literal concatenation, else None."""
+    kind = node[0]
+    if kind == "empty":
+        return b""
+    if kind == "lit":
+        s = node[1]
+        return bytes([next(iter(s))]) if len(s) == 1 else None
+    if kind == "cat":
+        parts = [_ast_literal(x) for x in node[1]]
+        if any(p is None for p in parts):
+            return None
+        return b"".join(parts)
+    return None
+
+
+def _ast_dotstar(node) -> bool:
+    return node[0] == "star" and node[1][0] == "lit" and node[1][1] == DOT_BYTES
+
+
+def analyze_literal(pattern: str):
+    """Classify a rule field pattern for the literal tier.
+
+    Returns ("any", None) — omitted field, no constraint;
+            ("lits", [bytes, ...]) — full match any of the literals;
+            ("prefix", bytes) — literal then ``.*`` (newline-guarded);
+            None — general regex (tier 2).
+    The analysis runs on the parsed AST so escaping/alternation exactly
+    mirror the regex compiler's reading of the pattern."""
+    if pattern == "":
+        return ("any", None)
+    try:
+        ast = parse(pattern)
+    except ParseError:
+        return None  # surface the error via the regex compiler
+    lit = _ast_literal(ast)
+    if lit is not None:
+        return ("lits", [lit]) if len(lit) <= LIT_W else None
+    if _ast_dotstar(ast):
+        return ("prefix", b"")
+    if ast[0] == "cat" and len(ast[1]) >= 2 and _ast_dotstar(ast[1][-1]):
+        head = (
+            ast[1][0] if len(ast[1]) == 2 else ("cat", ast[1][:-1])
         )
+        lit = _ast_literal(head)
+        if lit is not None and len(lit) <= LIT_W:
+            return ("prefix", lit)
+    if ast[0] == "alt":
+        outs = [_ast_literal(b) for b in ast[1]]
+        if all(o is not None and len(o) <= LIT_W for o in outs):
+            return ("lits", outs)
+    return None
 
-    @classmethod
-    def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves, n_rules=aux[0])
 
-    def __call__(self, data, lengths, remotes):
-        return http_verdicts(self, data, lengths, remotes)
-
-
-def build_http_model(
-    rules_with_remotes: list[tuple[frozenset, PortRuleHTTP]],
-) -> HttpBatchModel | ConstVerdict:
-    """Compile (allowed_remote_set, PortRuleHTTP) rows into device NFAs.
-
-    Empty fields wildcard (reference: http.go — omitted fields allow all).
-    """
-    if not rules_with_remotes:
-        return ConstVerdict(False)
-
+def analyze_rules(
+    rules_with_remotes: list, tiers_on: bool = True
+) -> tuple:
+    """Classify every rule's method/path into the literal or regex tier
+    and collect host/header patterns.  Shared by build_http_model and
+    the rule-axis sharded builder (parallel/rulesharding.py)."""
+    r = len(rules_with_remotes)
+    m_rows: list[tuple[bytes, bool, int]] = []  # (needle, prefix, rule)
+    p_rows: list[tuple[bytes, bool, int]] = []
     line_patterns: list[str] = []
+    line_rule: list[int] = []
+    line_slot: list[int] = []
+    method_any = np.zeros((r,), bool)
+    path_any = np.zeros((r,), bool)
     head_patterns: list[str] = []
     head_rule: list[int] = []
     head_count: list[int] = []
 
     for i, (_, h) in enumerate(rules_with_remotes):
-        # Anchored full matches (Envoy regex_match semantics,
-        # cilium_network_policy.h:50).
-        line_patterns.append(f"^({h.method})$" if h.method else "^.*$")
-        line_patterns.append(f"^({h.path})$" if h.path else "^.*$")
+        for slot, field in ((0, h.method), (1, h.path)):
+            kind = analyze_literal(field) if tiers_on else (
+                ("any", None) if field == "" else None
+            )
+            if kind is None:
+                # Anchored full matches (Envoy regex_match semantics,
+                # cilium_network_policy.h:50).
+                line_patterns.append(f"^({field})$" if field else "^.*$")
+                line_rule.append(i)
+                line_slot.append(slot)
+            elif kind[0] == "any":
+                (method_any if slot == 0 else path_any)[i] = True
+            elif kind[0] == "lits":
+                rows = m_rows if slot == 0 else p_rows
+                for lit in kind[1]:
+                    rows.append((lit, False, i))
+            else:  # prefix
+                rows = m_rows if slot == 0 else p_rows
+                rows.append((kind[1], True, i))
         n_head = 0
         if h.host:
             # Field names are case-insensitive and OWS after ':' is
@@ -128,24 +196,146 @@ def build_http_model(
             head_rule.append(i)
             n_head += 1
         head_count.append(n_head)
+    return (m_rows, p_rows, line_patterns, line_rule, line_slot,
+            method_any, path_any, head_patterns, head_rule, head_count)
+
+
+def lit_arrays(rows: list, n_pad: int | None = None):
+    """Pack (needle, prefix, rule) literal rows into device-ready numpy
+    arrays, padded to ``n_pad`` rows (dead rows have live=False)."""
+    n = max(len(rows), 1) if n_pad is None else n_pad
+    needle = np.zeros((n, LIT_W), np.uint8)
+    nlen = np.zeros((n,), np.int32)
+    prefix = np.zeros((n,), bool)
+    rule = np.zeros((n,), np.int32)
+    live = np.zeros((n,), bool)
+    for k, (lit, pfx, ri) in enumerate(rows):
+        needle[k, : len(lit)] = np.frombuffer(lit, np.uint8)
+        nlen[k] = len(lit)
+        prefix[k] = pfx
+        rule[k] = ri
+        live[k] = True
+    return needle, nlen, prefix, rule, live
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class HttpBatchModel:
+    # tier 1: literal method (slot m) / path (slot p) rows
+    m_needle: jax.Array  # [Nm, LIT_W] uint8
+    m_len: jax.Array  # [Nm] int32
+    m_prefix: jax.Array  # [Nm] bool
+    m_rule: jax.Array  # [Nm] int32
+    m_live: jax.Array  # [Nm] bool (False = padding row)
+    p_needle: jax.Array  # [Np, LIT_W] uint8
+    p_len: jax.Array  # [Np] int32
+    p_prefix: jax.Array  # [Np] bool
+    p_rule: jax.Array  # [Np] int32
+    p_live: jax.Array  # [Np] bool
+    method_any: jax.Array  # [R] bool — field omitted
+    path_any: jax.Array  # [R] bool
+    # tier 2: general regex line patterns (anchored), slot-tagged
+    line_nfa: "DeviceNfa | DeviceDfa | None"
+    line_rule: jax.Array  # [PL] int32
+    line_slot: jax.Array  # [PL] int32 — 0 method, 1 path
+    # host/header patterns over the request head
+    head_nfa: "DeviceNfa | DeviceDfa | None"
+    head_rule: jax.Array  # [P] int32 — owning rule row
+    head_count: jax.Array  # [R] int32 — head patterns per rule
+    remote_ids: jax.Array  # [R, MAX_REMOTES] int32
+    any_remote: jax.Array  # [R] bool
+    n_rules: int = 0
+
+    def tree_flatten(self):
+        return (
+            (self.m_needle, self.m_len, self.m_prefix, self.m_rule,
+             self.m_live, self.p_needle, self.p_len, self.p_prefix,
+             self.p_rule, self.p_live, self.method_any, self.path_any,
+             self.line_nfa, self.line_rule, self.line_slot,
+             self.head_nfa, self.head_rule, self.head_count,
+             self.remote_ids, self.any_remote),
+            (self.n_rules,),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, n_rules=aux[0])
+
+    def __call__(self, data, lengths, remotes):
+        return http_verdicts(self, data, lengths, remotes)
+
+
+def build_http_model(
+    rules_with_remotes: list[tuple[frozenset, PortRuleHTTP]],
+    backend: str = "auto",
+) -> HttpBatchModel | ConstVerdict:
+    """Compile (allowed_remote_set, PortRuleHTTP) rows into device tables.
+
+    Empty fields wildcard (reference: http.go — omitted fields allow all).
+    ``backend`` governs the REGEX tier only: "nfa" (dense matmul),
+    "dfa" (per-pattern gatherless blocks), "auto" (DFA above
+    _DFA_RULE_THRESHOLD patterns, NFA fallback on blowup), or
+    "regex-only" (disable the literal tier — every pattern through the
+    automaton; used by parity tests)."""
+    if not rules_with_remotes:
+        return ConstVerdict(False)
 
     r = len(rules_with_remotes)
+    rx_backend = "auto" if backend == "regex-only" else backend
+    rows = analyze_rules(rules_with_remotes, tiers_on=backend != "regex-only")
+    (m_rows, p_rows, line_patterns, line_rule, line_slot, method_any,
+     path_any, head_patterns, head_rule, head_count) = rows
+
     packed_ids, any_remote = pack_remote_sets(
         [rs for rs, _ in rules_with_remotes]
     )
+
+    mn, ml, mp, mr, mlive = lit_arrays(m_rows)
+    pn, pl_, pp, pr, plive = lit_arrays(p_rows)
+
+    line_tab = _compile_line_tables(line_patterns, rx_backend)
+    head_tab = _compile_line_tables(head_patterns, rx_backend)
+
     return HttpBatchModel(
-        line_nfa=device_nfa(compile_patterns(line_patterns)),
-        head_nfa=(
-            device_nfa(compile_patterns(head_patterns))
-            if head_patterns
-            else None
-        ),
+        m_needle=jnp.asarray(mn),
+        m_len=jnp.asarray(ml),
+        m_prefix=jnp.asarray(mp),
+        m_rule=jnp.asarray(mr),
+        m_live=jnp.asarray(mlive),
+        p_needle=jnp.asarray(pn),
+        p_len=jnp.asarray(pl_),
+        p_prefix=jnp.asarray(pp),
+        p_rule=jnp.asarray(pr),
+        p_live=jnp.asarray(plive),
+        method_any=jnp.asarray(method_any),
+        path_any=jnp.asarray(path_any),
+        line_nfa=line_tab,
+        line_rule=jnp.asarray(np.asarray(line_rule, np.int32)),
+        line_slot=jnp.asarray(np.asarray(line_slot, np.int32)),
+        head_nfa=head_tab,
         head_rule=jnp.asarray(np.asarray(head_rule, np.int32).reshape(-1)),
         head_count=jnp.asarray(np.asarray(head_count, np.int32)),
         remote_ids=jnp.asarray(packed_ids),
         any_remote=jnp.asarray(any_remote),
         n_rules=r,
     )
+
+
+def _compile_line_tables(patterns: list[str], backend: str):
+    """Compile regex-tier patterns with the requested backend; None when
+    the tier is empty."""
+    if not patterns:
+        return None
+    use_dfa = backend == "dfa" or (
+        backend == "auto" and len(patterns) > _DFA_RULE_THRESHOLD
+    )
+    if use_dfa:
+        try:
+            return device_dfa(compile_pattern_dfas(patterns))
+        except DfaBlowupError:
+            if backend == "dfa":
+                raise
+    return device_nfa(compile_patterns(patterns))
 
 
 def _first_occurrence_after(data, start, end, byte):
@@ -155,6 +345,37 @@ def _first_occurrence_after(data, start, end, byte):
     valid = (pos > start[:, None]) & (pos < end[:, None])
     hit = (data == jnp.uint8(byte)) & valid
     return jnp.min(jnp.where(hit, pos, end[:, None]), axis=1)
+
+
+def _last_in_span(data, start, end, byte):
+    """Last ``byte`` at position >= start and < end, else -1."""
+    f, l = data.shape
+    pos = jnp.arange(l, dtype=jnp.int32)[None, :]
+    valid = (pos >= start[:, None]) & (pos < end[:, None])
+    hit = (data == jnp.uint8(byte)) & valid
+    return jnp.max(jnp.where(hit, pos, jnp.int32(-1)), axis=1)
+
+
+def _lit_hits(data, start, end, needle, nlen, prefix, live):
+    """[F, N] literal-row hits on the span: exact rows need span == lit,
+    prefix rows need span startswith lit AND no newline in the ``.*``
+    remainder (regex ``.`` excludes \\n).  "No newline in the remainder"
+    is exactly "the LAST span newline, if any, lies inside the needle
+    bytes" — needle-internal newlines were matched literally."""
+    exact = spans_equal_prefix(data, start, end, needle, nlen)
+    starts = spans_start_with(data, start, end, needle, nlen)
+    last_nl = _last_in_span(data, start, end, 0x0A)  # [F]
+    no_nl_after = last_nl[:, None] < start[:, None] + nlen[None, :]
+    hit = jnp.where(prefix[None, :], starts & no_nl_after, exact)
+    return hit & live[None, :]
+
+
+def _scatter_or(hits, rule_idx, n_rules):
+    """[F, N] bool hits keyed by rule -> [F, R] bool any-hit."""
+    f = hits.shape[0]
+    counts = jnp.zeros((f, n_rules), jnp.int32)
+    counts = counts.at[:, rule_idx].add(hits.astype(jnp.int32))
+    return counts > 0
 
 
 @jax.jit
@@ -167,6 +388,8 @@ def http_verdicts(
     """Returns (complete [F] bool, head_len [F] int32, allow [F] bool)."""
     lengths = jnp.asarray(lengths, jnp.int32)
     remotes = jnp.asarray(remotes, jnp.int32)
+    r = model.n_rules
+    f = data.shape[0]
 
     # Head completeness: first CRLFCRLF.
     crlf2 = _first_crlfcrlf(data, lengths)
@@ -177,19 +400,49 @@ def http_verdicts(
     line_end = first_subsequence2(data, lengths, 0x0D, 0x0A)  # [F]
     sp1 = first_occurrence(data, line_end, 0x20)
     sp2 = _first_occurrence_after(data, sp1, line_end, 0x20)
+    m_start, m_end = jnp.zeros_like(sp1), sp1
+    p_start, p_end = sp1 + 1, sp2
 
-    # Anchored method/path matches: [F, 2R].
-    m_hits = nfa_search_spans(model.line_nfa, data, jnp.zeros_like(sp1), sp1)
-    p_hits = nfa_search_spans(model.line_nfa, data, sp1 + 1, sp2)
-    r = model.n_rules
-    idx = jnp.arange(r)
-    method_ok = m_hits[:, idx * 2]
-    path_ok = p_hits[:, idx * 2 + 1]
+    # Tier 0/1: wildcard flags + literal rows.
+    method_ok = model.method_any[None, :] | _scatter_or(
+        _lit_hits(data, m_start, m_end, model.m_needle, model.m_len,
+                  model.m_prefix, model.m_live),
+        model.m_rule, r,
+    )
+    path_ok = model.path_any[None, :] | _scatter_or(
+        _lit_hits(data, p_start, p_end, model.p_needle, model.p_len,
+                  model.p_prefix, model.p_live),
+        model.p_rule, r,
+    )
+
+    # Tier 2: leftover regex patterns, evaluated on both spans and
+    # routed by slot.  (Resolved at trace time; absent for pure-literal
+    # rule sets — the common case.)
+    if model.line_nfa is not None:
+        search = (
+            dfa_search_spans
+            if isinstance(model.line_nfa, DeviceDfa)
+            else nfa_search_spans
+        )
+        rx_m = search(model.line_nfa, data, m_start, m_end)  # [F, PL]
+        rx_p = search(model.line_nfa, data, p_start, p_end)
+        is_m = model.line_slot == 0
+        method_ok = method_ok | _scatter_or(
+            rx_m & is_m[None, :], model.line_rule, r
+        )
+        path_ok = path_ok | _scatter_or(
+            rx_p & ~is_m[None, :], model.line_rule, r
+        )
 
     # Host/header patterns searched over the head region starting at the
     # request line's CRLF (so every header line is CRLF-framed).
     if model.head_nfa is not None:
-        h_hits = nfa_search_spans(
+        head_search = (
+            dfa_search_spans
+            if isinstance(model.head_nfa, DeviceDfa)
+            else nfa_search_spans
+        )
+        h_hits = head_search(
             model.head_nfa, data, line_end, head_len - 2
         )  # [F, P]
         # all-of per rule: count matches per rule == head_count
@@ -199,7 +452,7 @@ def http_verdicts(
         )
         head_ok = per_rule >= model.head_count[None, :]
     else:
-        head_ok = jnp.ones((data.shape[0], r), bool)
+        head_ok = jnp.ones((f, r), bool)
 
     rok = remote_ok(remotes, model.remote_ids, model.any_remote)
     allow = jnp.any(method_ok & path_ok & head_ok & rok, axis=1)

@@ -226,11 +226,13 @@ def encode_requests(
     return batch
 
 
-@jax.jit
-def kafka_verdicts(
+def kafka_rule_hits(
     model: KafkaBatchModel, batch: KafkaRequestBatch, remotes
-):
-    """Returns allowed [F] bool; bit-identical to matches_rule."""
+) -> tuple[jax.Array, jax.Array]:
+    """Per-rule-set partial reductions: (simple [F] bool, cover [F, T]
+    bool).  These OR across disjoint rule subsets, so rule-axis sharding
+    psums them before the final combine (kafka_combine) — the combine
+    itself (∀topics) does NOT distribute over rule subsets."""
     api_key = jnp.asarray(batch.api_key)
     api_version = jnp.asarray(batch.api_version)
     client = jnp.asarray(batch.client)
@@ -294,10 +296,33 @@ def kafka_verdicts(
     cover = jnp.any(
         t_eq & (~model.topic_any)[None, None, :] & base[:, None, :], axis=2
     )  # [F, T]
+    return simple, cover
+
+
+def kafka_combine(
+    simple: jax.Array,  # [F] bool — ORed across rule subsets
+    cover: jax.Array,  # [F, T] bool — ORed across rule subsets
+    topic_count: jax.Array,  # [F] int32
+    overflow: jax.Array,  # [F] bool
+) -> jax.Array:
+    """Final verdict from (possibly psum-merged) partial reductions."""
     t_idx = jnp.arange(cover.shape[1])[None, :]
     active = t_idx < topic_count[:, None]
     all_covered = jnp.all(cover | ~active, axis=1) & (topic_count > 0)
-
     # Overflowed requests are denied on device; the engine re-evaluates
     # them with the host oracle.
-    return (simple | all_covered) & ~jnp.asarray(batch.overflow)
+    return (simple | all_covered) & ~overflow
+
+
+@jax.jit
+def kafka_verdicts(
+    model: KafkaBatchModel, batch: KafkaRequestBatch, remotes
+):
+    """Returns allowed [F] bool; bit-identical to matches_rule."""
+    simple, cover = kafka_rule_hits(model, batch, remotes)
+    return kafka_combine(
+        simple,
+        cover,
+        jnp.asarray(batch.topic_count),
+        jnp.asarray(batch.overflow),
+    )

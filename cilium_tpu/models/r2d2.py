@@ -73,14 +73,15 @@ def _collect_rows(rules: CompiledPortRules):
     return rows
 
 
-def build_r2d2_model(
+def collect_policy_rows(
     policy: PolicyInstance | None, ingress: bool, port: int
-) -> ConstVerdict | R2d2BatchModel:
-    """Compile the effective rule set for (policy, direction, port) into a
-    batch model.  Applies the reference's port cascade at build time:
-    exact-port rules OR wildcard-port rules; missing policy or no matching
-    port entry -> constant deny (reference: policymap.go:208-236,
-    instance.go:157-165)."""
+) -> ConstVerdict | list[tuple[frozenset, str, str]]:
+    """Resolve the effective (remote_set, cmd, file_regex) rows for
+    (policy, direction, port), applying the reference's port cascade:
+    exact-port rules OR wildcard-port rules; missing policy or no
+    matching port entry -> constant deny (reference: policymap.go:208-236,
+    instance.go:157-165).  Exposed so rule-axis sharding can split the
+    rows before compiling per-shard tables."""
     if policy is None:
         return ConstVerdict(False)
     side = policy.ingress if ingress else policy.egress
@@ -95,7 +96,24 @@ def build_r2d2_model(
         rows.extend(_collect_rows(rules))
     if not rows:
         return ConstVerdict(False)
+    return rows
 
+
+def build_r2d2_model(
+    policy: PolicyInstance | None, ingress: bool, port: int
+) -> ConstVerdict | R2d2BatchModel:
+    """Compile the effective rule set for (policy, direction, port) into a
+    batch model."""
+    rows = collect_policy_rows(policy, ingress, port)
+    if isinstance(rows, ConstVerdict):
+        return rows
+    return build_r2d2_model_from_rows(rows)
+
+
+def build_r2d2_model_from_rows(
+    rows: list[tuple[frozenset, str, str]],
+) -> R2d2BatchModel:
+    """Compile (remote_set, cmd, file_regex) rows into device arrays."""
     remote_sets = [r[0] for r in rows]
     packed_ids, any_remote = pack_remote_sets(remote_sets)
 

@@ -62,7 +62,9 @@ def _spans_compare(
     exact (lengths must match)."""
     f, l = data.shape
     r, n = needle.shape
-    span_len = end - start  # [F]
+    # Degenerate spans (start > end, e.g. a missing token) behave as
+    # empty — matching regex span semantics (ops/nfa.py empty spans).
+    span_len = jnp.maximum(end - start, 0)  # [F]
     if prefix:
         len_ok = span_len[:, None] >= needle_len[None, :]  # [F, R]
     else:

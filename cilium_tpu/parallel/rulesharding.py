@@ -1,0 +1,372 @@
+"""Rule-axis model sharding: split a rule set across devices.
+
+The reference scales per-endpoint policy by compiling per-identity rule
+tables inside each Envoy worker (reference: envoy/cilium_network_policy.h:
+50-76 — every worker holds the whole table).  On TPU the equivalent scale
+limit is HBM: a policy's packed NFA transition tables (delta is O(S²·C))
+and per-rule compare tensors grow with the rule count, and past a point
+one chip cannot hold them.  Rule-axis sharding splits the RULES of one
+policy across the mesh's ``RULE_AXIS``:
+
+  - every shard compiles ITS OWN rule subset into its own tables (an NFA
+    over fewer patterns has fewer states, so delta shrinks
+    quadratically — sharding 2x cuts per-device table HBM ~4x);
+  - shards are padded to a common (states, classes, patterns) shape and
+    stacked along a leading shard dim, laid out with
+    ``PartitionSpec(RULE_AXIS)`` so each device holds exactly one
+    shard's tables;
+  - evaluation runs under ``shard_map``: flows shard over FLOW_AXIS,
+    every device evaluates its local rule subset, and per-rule-subset
+    partial verdicts merge with an OR-reduce (``psum > 0``) over
+    RULE_AXIS — one small [F] collective per batch, riding ICI.
+
+The OR-reduce is exact, not approximate: every model's verdict is
+``any(rule allows)`` over disjoint rule subsets (for Kafka the ORable
+partials are (simple, cover); the ∀-topics combine happens after the
+reduce — see models/kafka.py kafka_rule_hits/kafka_combine).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.base import MAX_REMOTES, ConstVerdict, pack_remote_sets
+from ..models.http import HttpBatchModel
+from ..models.kafka import (
+    KafkaBatchModel,
+    build_kafka_model,
+    kafka_combine,
+    kafka_rule_hits,
+)
+from ..models.r2d2 import MAX_CMD, R2d2BatchModel, collect_policy_rows
+from ..ops.nfa import DeviceNfa, device_nfa
+from ..regex import compile_patterns
+from ..regex.tables import NfaTables
+from .mesh import FLOW_AXIS, RULE_AXIS
+
+P = jax.sharding.PartitionSpec
+
+
+def split_balanced(seq: list, k: int) -> list[list]:
+    """Split seq into k contiguous, size-balanced chunks (first chunks
+    one longer when len % k != 0).  Chunks may be empty when k > len."""
+    n = len(seq)
+    base, extra = divmod(n, k)
+    out, i = [], 0
+    for j in range(k):
+        step = base + (1 if j < extra else 0)
+        out.append(seq[i : i + step])
+        i += step
+    return out
+
+
+# --- table padding --------------------------------------------------------
+
+def pad_tables(t: NfaTables, s: int, c: int, r: int) -> NfaTables:
+    """Pad an NfaTables to (s states, c classes, r patterns).  Padding
+    states have no transitions and are never set; padding classes are
+    never produced by classmap; padding patterns never accept."""
+    assert s >= t.n_states and c >= t.n_classes and r >= t.n_patterns
+    delta = np.zeros((c, s, s), np.uint8)
+    delta[: t.n_classes, : t.n_states, : t.n_states] = t.delta
+    start = np.zeros((s,), bool)
+    start[: t.n_states] = t.start
+    accept = np.zeros((r, s), bool)
+    accept[: t.n_patterns, : t.n_states] = t.accept
+    accept_final = np.zeros((r, s), bool)
+    accept_final[: t.n_patterns, : t.n_states] = t.accept_final
+    matches_empty = np.zeros((r,), bool)
+    matches_empty[: t.n_patterns] = t.matches_empty
+    return NfaTables(
+        n_states=s,
+        n_classes=c,
+        n_patterns=r,
+        classmap=t.classmap,
+        delta=delta,
+        start=start,
+        accept=accept,
+        accept_final=accept_final,
+        matches_empty=matches_empty,
+        patterns=list(t.patterns),
+    )
+
+
+def _never_match_tables(n_patterns: int) -> NfaTables:
+    """Tables with n_patterns patterns that accept nothing (used to give
+    head-pattern-less shards a uniformly shaped head NFA)."""
+    t = compile_patterns(["x"])
+    t.accept[:] = False
+    t.accept_final[:] = False
+    t.matches_empty[:] = False
+    return pad_tables(t, t.n_states, t.n_classes, max(n_patterns, 1))
+
+
+def stack_nfas(tables: list[NfaTables]) -> DeviceNfa:
+    """Pad a list of per-shard tables to a common shape and stack their
+    device forms along a leading shard axis."""
+    s = max(t.n_states for t in tables)
+    c = max(t.n_classes for t in tables)
+    r = max(t.n_patterns for t in tables)
+    nfas = [device_nfa(pad_tables(t, s, c, r)) for t in tables]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *nfas)
+
+
+def _stack_models(models: list):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *models)
+
+
+# --- r2d2 -----------------------------------------------------------------
+
+def build_sharded_r2d2_model(
+    policy, ingress: bool, port: int, n_shards: int
+) -> ConstVerdict | R2d2BatchModel:
+    """Compile the policy's rows into ``n_shards`` stacked shard models:
+    every leaf gains a leading [n_shards] dim to lay out with
+    PartitionSpec(RULE_AXIS).  Aux dims (states/classes/patterns) are
+    padded to the max across shards so the stacked treedef is uniform.
+    Padded rule rows are dead via never-accepting NFA pattern rows
+    (file_ok is always False for them, independent of input bytes)."""
+    rows = collect_policy_rows(policy, ingress, port)
+    if isinstance(rows, ConstVerdict):
+        return rows
+    shards = split_balanced(rows, n_shards)
+    r_max = max(len(s) for s in shards)
+    shard_tables = [
+        compile_patterns([r[2] for r in s]) if s else _never_match_tables(1)
+        for s in shards
+    ]
+    s_max = max(t.n_states for t in shard_tables)
+    c_max = max(t.n_classes for t in shard_tables)
+    models = []
+    for s, t in zip(shards, shard_tables):
+        packed = np.zeros((r_max, MAX_REMOTES), np.int32)
+        any_remote = np.zeros((r_max,), bool)
+        cmd_needle = np.zeros((r_max, MAX_CMD), np.uint8)
+        cmd_len = np.zeros((r_max,), np.int32)
+        cmd_any = np.zeros((r_max,), bool)
+        if s:
+            ids, anyr = pack_remote_sets([r[0] for r in s])
+            packed[: len(s)] = ids
+            any_remote[: len(s)] = anyr
+            for i, (_, cmd, _f) in enumerate(s):
+                b = cmd.encode()
+                cmd_needle[i, : len(b)] = np.frombuffer(b, np.uint8)
+                cmd_len[i] = len(b)
+                cmd_any[i] = len(b) == 0
+        models.append(
+            R2d2BatchModel(
+                nfa=device_nfa(pad_tables(t, s_max, c_max, r_max)),
+                cmd_needle=jnp.asarray(cmd_needle),
+                cmd_len=jnp.asarray(cmd_len),
+                cmd_any=jnp.asarray(cmd_any),
+                remote_ids=jnp.asarray(packed),
+                any_remote=jnp.asarray(any_remote),
+            )
+        )
+    return _stack_models(models)
+
+
+# --- http -----------------------------------------------------------------
+
+def build_sharded_http_model(
+    rules_with_remotes: list, n_shards: int
+) -> ConstVerdict | HttpBatchModel:
+    """Shard (remote_set, PortRuleHTTP) rows across n_shards stacked
+    models.  Every tier pads to cross-shard maxima: literal rows via the
+    live mask, regex/head patterns via never-accepting table rows, rule
+    dims via dead rules (no wildcard flag + no rows = method_ok False)."""
+    from ..models.http import analyze_rules, lit_arrays
+
+    if not rules_with_remotes:
+        return ConstVerdict(False)
+    shards = split_balanced(list(rules_with_remotes), n_shards)
+    r_max = max(len(s) for s in shards)
+    analyzed = [analyze_rules(s) for s in shards]
+
+    def line_tab(patterns):
+        return (
+            compile_patterns(patterns) if patterns else _never_match_tables(1)
+        )
+
+    line_ts = [line_tab(a[2]) for a in analyzed]
+    any_head = any(a[7] for a in analyzed)
+    head_ts = [line_tab(a[7]) if any_head else None for a in analyzed]
+
+    nm = max(max(len(a[0]) for a in analyzed), 1)
+    npath = max(max(len(a[1]) for a in analyzed), 1)
+    pl_max = max(t.n_patterns for t in line_ts)
+    ls = max(t.n_states for t in line_ts)
+    lc = max(t.n_classes for t in line_ts)
+    if any_head:
+        p_max = max(t.n_patterns for t in head_ts)
+        hs = max(t.n_states for t in head_ts)
+        hc = max(t.n_classes for t in head_ts)
+
+    models = []
+    for shard, a, lt, ht in zip(shards, analyzed, line_ts, head_ts):
+        (m_rows, p_rows, _line_pats, line_rule, line_slot, method_any,
+         path_any, _head_pats, head_rule, head_count) = a
+        n = len(shard)
+        mn, ml, mp, mr, mlive = lit_arrays(m_rows, nm)
+        pn, pl_, pp, pr, plive = lit_arrays(p_rows, npath)
+        packed_ids = np.zeros((r_max, MAX_REMOTES), np.int32)
+        any_remote = np.zeros((r_max,), bool)
+        ma = np.zeros((r_max,), bool)
+        pa = np.zeros((r_max,), bool)
+        hcnt = np.zeros((r_max,), np.int32)
+        if n:
+            ids, anyr = pack_remote_sets([rs for rs, _ in shard])
+            packed_ids[:n] = ids
+            any_remote[:n] = anyr
+            ma[:n] = method_any
+            pa[:n] = path_any
+            hcnt[:n] = np.asarray(head_count, np.int32)
+        lr = np.zeros((pl_max,), np.int32)
+        lsl = np.zeros((pl_max,), np.int32)
+        lr[: len(line_rule)] = np.asarray(line_rule, np.int32)
+        lsl[: len(line_slot)] = np.asarray(line_slot, np.int32)
+        hr = np.zeros((max(p_max, 1) if any_head else 1,), np.int32)
+        if any_head:
+            hr[: len(head_rule)] = np.asarray(head_rule, np.int32)
+        models.append(
+            HttpBatchModel(
+                m_needle=jnp.asarray(mn),
+                m_len=jnp.asarray(ml),
+                m_prefix=jnp.asarray(mp),
+                m_rule=jnp.asarray(mr),
+                m_live=jnp.asarray(mlive),
+                p_needle=jnp.asarray(pn),
+                p_len=jnp.asarray(pl_),
+                p_prefix=jnp.asarray(pp),
+                p_rule=jnp.asarray(pr),
+                p_live=jnp.asarray(plive),
+                method_any=jnp.asarray(ma),
+                path_any=jnp.asarray(pa),
+                line_nfa=device_nfa(pad_tables(lt, ls, lc, pl_max)),
+                line_rule=jnp.asarray(lr),
+                line_slot=jnp.asarray(lsl),
+                head_nfa=(
+                    device_nfa(pad_tables(ht, hs, hc, p_max))
+                    if any_head
+                    else None
+                ),
+                head_rule=jnp.asarray(hr),
+                head_count=jnp.asarray(hcnt),
+                remote_ids=jnp.asarray(packed_ids),
+                any_remote=jnp.asarray(any_remote),
+                n_rules=r_max,
+            )
+        )
+    return _stack_models(models)
+
+
+# --- kafka ----------------------------------------------------------------
+
+def _pad_kafka_model(m: KafkaBatchModel, r: int) -> KafkaBatchModel:
+    """Pad rule rows to r with dead rules (api_key_mask all-False fails
+    key_ok; any_remote False with no ids fails remote_ok)."""
+    cur = m.version.shape[0]
+    if cur == r:
+        return m
+
+    def pad(x, fill=0):
+        widths = [(0, r - cur)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths, constant_values=fill)
+
+    return KafkaBatchModel(
+        api_key_mask=pad(m.api_key_mask, False),
+        version=pad(m.version),
+        version_any=pad(m.version_any, False),
+        client=pad(m.client),
+        client_len=pad(m.client_len),
+        client_any=pad(m.client_any, False),
+        topic=pad(m.topic),
+        topic_len=pad(m.topic_len),
+        topic_any=pad(m.topic_any, False),
+        is_topic_key=m.is_topic_key,
+        remote_ids=pad(m.remote_ids),
+        any_remote=pad(m.any_remote, False),
+    )
+
+
+def build_sharded_kafka_model(
+    rules_with_remotes: list, n_shards: int
+) -> ConstVerdict | KafkaBatchModel:
+    if not rules_with_remotes:
+        return ConstVerdict(False)
+    shards = split_balanced(list(rules_with_remotes), n_shards)
+    r_max = max(len(s) for s in shards)
+    models = []
+    for s in shards:
+        if s:
+            m = build_kafka_model(s)
+        else:
+            m = build_kafka_model(rules_with_remotes[:1])
+            m = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x), m)
+        models.append(_pad_kafka_model(m, r_max))
+    return _stack_models(models)
+
+
+# --- sharded evaluation ---------------------------------------------------
+
+def _local(model):
+    """Drop the singleton shard dim a device sees under shard_map, and
+    mark every leaf varying over FLOW_AXIS for the vma checker: model
+    state mixes with flow-varying data inside lax.scan carries, whose
+    input/output varying-axis sets must agree."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.pcast(x[0], FLOW_AXIS, to="varying"), model
+    )
+
+
+def sharded_verdict_step(mesh, verdict_fn):
+    """Jitted (stacked_model, data, lengths, remotes) -> (complete,
+    msg_len, allow) over a (FLOW_AXIS, RULE_AXIS) mesh for models whose
+    verdict is any-rule-allows (r2d2, http): flows shard, rules shard,
+    allow OR-reduces over RULE_AXIS."""
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(RULE_AXIS), P(FLOW_AXIS), P(FLOW_AXIS), P(FLOW_AXIS)),
+        out_specs=(P(FLOW_AXIS), P(FLOW_AXIS), P(FLOW_AXIS)),
+    )
+    def step(model, data, lengths, remotes):
+        complete, msg_len, allow = verdict_fn(
+            _local(model), data, lengths, remotes
+        )
+        allow = (
+            jax.lax.psum(allow.astype(jnp.int32), RULE_AXIS) > 0
+        )
+        return complete, msg_len, allow
+
+    return step
+
+
+def sharded_kafka_step(mesh):
+    """Jitted (stacked_model, batch, remotes) -> allow [F] bool.  The
+    ORable partials (simple, cover) psum over RULE_AXIS; the ∀-topics
+    combine runs on the merged partials (it does not distribute over
+    rule subsets)."""
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(RULE_AXIS), P(FLOW_AXIS), P(FLOW_AXIS)),
+        out_specs=P(FLOW_AXIS),
+    )
+    def step(model, batch, remotes):
+        simple, cover = kafka_rule_hits(_local(model), batch, remotes)
+        simple = jax.lax.psum(simple.astype(jnp.int32), RULE_AXIS) > 0
+        cover = jax.lax.psum(cover.astype(jnp.int32), RULE_AXIS) > 0
+        return kafka_combine(
+            simple, cover, batch.topic_count, batch.overflow
+        )
+
+    return step

@@ -1,0 +1,243 @@
+"""Per-pattern DFA compilation for gather-based batch matching.
+
+The dense multi-pattern NFA tables (tables.py) are MXU-friendly but their
+transition matrix is O(S²·C) for S TOTAL states across all patterns — at
+policy-set scale (hundreds of rules) that is gigabytes of HBM and
+teraflops per scan.  The union NFA is block-diagonal (patterns' states
+never interact), so large rule sets compile instead to one SMALL DFA per
+pattern: the batch step becomes a per-(flow, pattern) table gather,
+O(F·R) loads per byte with per-pattern tables of a few hundred bytes.
+
+Semantics are bit-identical to the NFA path (same CompiledPattern input,
+same search/anchor/sticky-accept contract as ops/nfa.py); subset
+construction runs over the pattern's own byte classes.  Acceptance is
+encoded in the state ORDER — accepting states get the highest ids — so
+the device's sticky-accept check is one integer compare per step instead
+of a second gather.
+
+Reference counterpart: envoy/cilium_network_policy.h:50-76 compiles one
+std::regex per rule; here each rule's pattern becomes a packed DFA row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .nfa import CompiledPattern, compile_pattern
+
+MAX_DFA_STATES = 512  # per pattern; policy-rule regexes are literal-heavy
+
+
+class DfaBlowupError(ValueError):
+    """Subset construction exceeded MAX_DFA_STATES (pathological pattern);
+    callers fall back to the NFA path."""
+
+
+@dataclass
+class PatternDfa:
+    n_states: int
+    n_classes: int
+    classmap: np.ndarray  # [256] int32
+    delta: np.ndarray  # [S, C] int32
+    start: int
+    accept_thresh: int  # state >= thresh  <=>  sticky-accepting
+    accept_final: np.ndarray  # [S] bool (accept | accept-via-END)
+    matches_empty: bool
+
+
+def pattern_dfa(c: CompiledPattern, max_states: int = MAX_DFA_STATES) -> PatternDfa:
+    """Subset-construct a DFA from an epsilon-free search NFA.
+
+    CompiledPattern invariants (regex/nfa.py): transitions from state s
+    already enumerate closure(s)'s edges and targets are raw states, so
+    the subset move needs no closure step; accept membership is by raw
+    state."""
+    byte_edges: list[list[tuple[int, int]]] = [[] for _ in range(256)]
+    for s, edges in enumerate(c.transitions):
+        for byteset, d in edges:
+            for byte in byteset:
+                byte_edges[byte].append((s, d))
+
+    sig_to_class: dict[tuple, int] = {}
+    classmap = np.zeros((256,), np.int32)
+    class_moves: list[dict[int, set[int]]] = []
+    for byte in range(256):
+        sig = tuple(sorted(set(byte_edges[byte])))
+        cls = sig_to_class.get(sig)
+        if cls is None:
+            cls = len(sig_to_class)
+            sig_to_class[sig] = cls
+            mv: dict[int, set[int]] = {}
+            for s, d in sig:
+                mv.setdefault(s, set()).add(d)
+            class_moves.append(mv)
+        classmap[byte] = cls
+    n_classes = len(class_moves)
+
+    start_set = frozenset(c.start)
+    index: dict[frozenset, int] = {start_set: 0}
+    order: list[frozenset] = [start_set]
+    trans: dict[int, list[int]] = {}
+    queue = [start_set]
+    while queue:
+        st = queue.pop()
+        row = []
+        for mv in class_moves:
+            out: set[int] = set()
+            for s in st:
+                out |= mv.get(s, set())
+            nxt = frozenset(out)
+            idx = index.get(nxt)
+            if idx is None:
+                idx = len(order)
+                if idx >= max_states:
+                    raise DfaBlowupError(
+                        f"DFA exceeds {max_states} states for pattern"
+                    )
+                index[nxt] = idx
+                order.append(nxt)
+                queue.append(nxt)
+            row.append(idx)
+        trans[index[st]] = row
+    delta = np.zeros((len(order), n_classes), np.int32)
+    for src, row in trans.items():
+        delta[src] = row
+
+    accept = np.array([bool(st & c.accept) for st in order])
+    accept_final_raw = np.array(
+        [bool(st & (c.accept | c.accept_via_end)) for st in order]
+    )
+
+    # Reorder: non-accepting states first, so sticky accept is a
+    # threshold compare on the state id.
+    n = len(order)
+    perm = np.concatenate(
+        [np.flatnonzero(~accept), np.flatnonzero(accept)]
+    ).astype(np.int64)
+    remap = np.empty((n,), np.int64)
+    remap[perm] = np.arange(n)
+    delta = remap[delta[perm]].astype(np.int32)
+    accept_final = accept_final_raw[perm]
+    thresh = int((~accept).sum())
+    return PatternDfa(
+        n_states=n,
+        n_classes=n_classes,
+        classmap=classmap,
+        delta=delta,
+        start=int(remap[0]),
+        accept_thresh=thresh,
+        accept_final=accept_final,
+        matches_empty=bool(
+            start_set & (c.accept | c.accept_via_end)
+        ),
+    )
+
+
+@dataclass
+class DfaTables:
+    """Per-pattern DFAs packed to common [R, S, C] shapes over ONE
+    shared byte-class map (bytes equivalent iff they behave identically
+    in EVERY pattern of the set), so the device step needs no per-pattern
+    class lookup — the class one-hot comes from a single [256, C]
+    matmul and the transition is a block-diagonal batched matmul
+    (ops/dfa.py)."""
+
+    n_states: int
+    n_classes: int
+    n_patterns: int
+    classmap: np.ndarray  # [256] int32 — SHARED across patterns
+    delta: np.ndarray  # [R, S, C] int32
+    start: np.ndarray  # [R] int32
+    n_states_per: np.ndarray  # [R] int32 — real (unpadded) state count
+    accept: np.ndarray  # [R, S] bool — sticky accept
+    accept_final: np.ndarray  # [R, S] bool
+    matches_empty: np.ndarray  # [R] bool
+    patterns: list[str]
+
+
+def pad_dfa_tables(t: DfaTables, s: int, c: int) -> DfaTables:
+    """Pad the state/class axes (e.g. to share one jit shape across many
+    policies' tables).  Padding states are unreachable (delta never
+    points at them) and padding classes are never produced by classmap."""
+    assert s >= t.n_states and c >= t.n_classes
+    if s == t.n_states and c == t.n_classes:
+        return t
+    r = t.n_patterns
+    delta = np.zeros((r, s, c), np.int32)
+    delta[:, : t.n_states, : t.n_classes] = t.delta
+    accept = np.zeros((r, s), bool)
+    accept[:, : t.n_states] = t.accept
+    accept_final = np.zeros((r, s), bool)
+    accept_final[:, : t.n_states] = t.accept_final
+    return DfaTables(
+        n_states=s,
+        n_classes=c,
+        n_patterns=r,
+        classmap=t.classmap,
+        delta=delta,
+        start=t.start,
+        n_states_per=t.n_states_per,
+        accept=accept,
+        accept_final=accept_final,
+        matches_empty=t.matches_empty,
+        patterns=list(t.patterns),
+    )
+
+
+def compile_pattern_dfas(
+    patterns: list[str], max_states: int = MAX_DFA_STATES
+) -> DfaTables:
+    """Compile each pattern to its own DFA over a shared byte-class map
+    and pack them.  Raises DfaBlowupError if any pattern's DFA exceeds
+    ``max_states``."""
+    dfas = [pattern_dfa(compile_pattern(p), max_states) for p in patterns]
+    r = len(dfas)
+    s = max((d.n_states for d in dfas), default=1)
+
+    # Shared classes: two bytes are equivalent iff every pattern puts
+    # them in the same per-pattern class.
+    sig_to_class: dict[tuple, int] = {}
+    classmap = np.zeros((256,), np.int32)
+    reps: list[int] = []  # representative byte per shared class
+    for byte in range(256):
+        sig = tuple(int(d.classmap[byte]) for d in dfas)
+        cls = sig_to_class.get(sig)
+        if cls is None:
+            cls = len(sig_to_class)
+            sig_to_class[sig] = cls
+            reps.append(byte)
+        classmap[byte] = cls
+    c = max(len(reps), 1)
+
+    delta = np.zeros((r, s, c), np.int32)
+    start = np.zeros((r,), np.int32)
+    n_states_per = np.zeros((r,), np.int32)
+    accept = np.zeros((r, s), bool)
+    accept_final = np.zeros((r, s), bool)
+    matches_empty = np.zeros((r,), bool)
+    for i, d in enumerate(dfas):
+        # Re-index the pattern's transitions by shared class via a
+        # representative byte (all bytes of a shared class share the
+        # pattern-local class by construction).
+        local_cls = d.classmap[reps]  # [C] pattern-local class ids
+        delta[i, : d.n_states, :] = d.delta[:, local_cls]
+        start[i] = d.start
+        n_states_per[i] = d.n_states
+        accept[i, d.accept_thresh : d.n_states] = True
+        accept_final[i, : d.n_states] = d.accept_final
+        matches_empty[i] = d.matches_empty
+    return DfaTables(
+        n_states=s,
+        n_classes=c,
+        n_patterns=r,
+        classmap=classmap,
+        delta=delta,
+        start=start,
+        n_states_per=n_states_per,
+        accept=accept,
+        accept_final=accept_final,
+        matches_empty=matches_empty,
+        patterns=list(patterns),
+    )

@@ -171,6 +171,67 @@ class TestHttpModel:
     def test_re_escape(self):
         assert re_escape("X-T.k*n: a+b") == "X-T\\.k\\*n: a\\+b"
 
+    def test_dfa_backend_parity(self):
+        """The gather/DFA backend must be bit-identical to the dense NFA
+        backend on a mixed rule set (incl. host + header patterns)."""
+        rules = [
+            (frozenset(), PortRuleHTTP(method="GET|HEAD", path="/pub(lic)?/.*")),
+            (frozenset({1}), PortRuleHTTP(path="/a/[0-9]+")),
+            (frozenset(), PortRuleHTTP(host=".*\\.internal")),
+            (frozenset(), PortRuleHTTP(method="GET", headers=("X-A: 1",))),
+        ]
+        rng = random.Random(17)
+        reqs = []
+        methods = ["GET", "PUT", "HEAD", "POST"]
+        paths = ["/public/x", "/pub/y", "/a/12", "/a/xy", "/other"]
+        for _ in range(64):
+            headers = []
+            if rng.random() < 0.4:
+                headers.append(f"Host: svc.{rng.choice(['internal', 'ext'])}")
+            if rng.random() < 0.4:
+                headers.append("X-A: 1")
+            reqs.append(req(rng.choice(methods), rng.choice(paths), headers))
+        data, lengths = encode(reqs)
+        remotes = np.asarray(
+            [random.Random(3).choice([1, 2]) for _ in reqs], np.int32
+        )
+        m_nfa = build_http_model(rules, backend="nfa")
+        m_dfa = build_http_model(rules, backend="dfa")
+        from cilium_tpu.ops.dfa import DeviceDfa
+
+        assert isinstance(m_dfa.line_nfa, DeviceDfa)
+        want = np.asarray(http_verdicts(m_nfa, data, lengths, remotes)[2])
+        got = np.asarray(http_verdicts(m_dfa, data, lengths, remotes)[2])
+        np.testing.assert_array_equal(got, want)
+
+    def test_literal_tier_newline_in_needle(self):
+        """A prefix literal containing \\n must still deny when the .*
+        remainder holds a LATER newline (regex . excludes \\n); the
+        guard keys on the last span newline, not the first."""
+        rules = [(frozenset(), PortRuleHTTP(path="/a\nb.*"))]
+        reqs = [
+            b"GET /a\nbX HTTP/1.1\r\n\r\n",  # remainder clean -> allow
+            b"GET /a\nbX\nY HTTP/1.1\r\n\r\n",  # \n in remainder -> deny
+        ]
+        data, lengths = encode(reqs)
+        remotes = np.ones((len(reqs),), np.int32)
+        for backend in ("auto", "regex-only"):
+            m = build_http_model(rules, backend=backend)
+            allow = np.asarray(http_verdicts(m, data, lengths, remotes)[2])
+            assert allow.tolist() == [True, False], (backend, allow)
+
+    def test_literal_tier_dotstar_empty_span(self):
+        """path=\".*\" must allow a spaceless request line (the path span
+        is degenerate/empty, and ^(.*)$ matches empty) in both tiers."""
+        rules = [(frozenset(), PortRuleHTTP(path=".*"))]
+        reqs = [b"FOO\r\n\r\n"]
+        data, lengths = encode(reqs)
+        remotes = np.ones((1,), np.int32)
+        for backend in ("auto", "regex-only"):
+            m = build_http_model(rules, backend=backend)
+            allow = np.asarray(http_verdicts(m, data, lengths, remotes)[2])
+            assert allow.tolist() == [True], (backend, allow)
+
     def test_fuzz_against_re_oracle(self):
         rng = random.Random(5)
         rule_sets = [
