@@ -575,6 +575,117 @@ def bench_stress():
     return rate, dt
 
 
+# --- composed L3/L4 datapath ---------------------------------------------
+
+def bench_datapath():
+    """Composed CT -> LB -> ipcache -> policy pipeline, packets/sec
+    (reference: bpf/bpf_lxc.c:684-760 handle_ipv4_from_lxc).  Tables at
+    realistic per-endpoint scale: 4k CT entries, 64 services, 1k ipcache
+    prefixes, 512 policy entries."""
+    import ipaddress
+    import random as _random
+
+    from cilium_tpu.datapath.pipeline import (
+        build_tables,
+        datapath_verdicts,
+        host_oracle,
+    )
+    from cilium_tpu.maps.ctmap import CtKey4, CtMap, PROTO_TCP
+    from cilium_tpu.maps.ipcache import IpcacheMap
+    from cilium_tpu.maps.lbmap import LbMap
+    from cilium_tpu.maps.policymap import DIR_EGRESS, PolicyMap
+
+    rng = _random.Random(29)
+    ip4 = lambda s: int(ipaddress.IPv4Address(s))
+    lb = LbMap()
+    n_services = 64
+    for s in range(n_services):
+        vip = ip4(f"172.16.0.{s + 1}")
+        lb.upsert_service(
+            vip, 80,
+            [(ip4(f"10.9.{s}.{b + 1}"), 8080) for b in range(3)],
+            rev_nat_index=s + 1,
+        )
+    ipc = IpcacheMap()
+    for i in range(1024):
+        ipc.upsert(f"10.{i // 250}.{i % 250}.0/24", sec_label=256 + i)
+    pol = PolicyMap()
+    for i in range(510):
+        pol.allow(256 + i, 8080 if i % 2 else 8000, PROTO_TCP, DIR_EGRESS,
+                  proxy_port=15000 if i % 7 == 0 else 0)
+    pol.allow(0, 443, PROTO_TCP, DIR_EGRESS)
+    ct = CtMap()
+    ct_keys = []
+    for i in range(4096):
+        k = CtKey4(
+            daddr=ip4(f"10.{i % 4}.{i % 250}.{i % 200 + 1}"),
+            saddr=ip4(f"10.200.0.{i % 250 + 1}"),
+            dport=8000 + (i % 3), sport=1024 + i % 50000,
+            nexthdr=PROTO_TCP,
+        )
+        ct.create(k)
+        ct_keys.append(k)
+
+    F = 8192
+    saddr = np.zeros((F,), np.int64)
+    daddr = np.zeros((F,), np.int64)
+    sport = np.zeros((F,), np.int64)
+    dport = np.zeros((F,), np.int64)
+    proto = np.full((F,), PROTO_TCP, np.int64)
+    for i in range(F):
+        roll = rng.random()
+        if roll < 0.2:  # established flow: exercise the CT fast path
+            k = ct_keys[rng.randrange(len(ct_keys))]
+            saddr[i], daddr[i] = k.saddr, k.daddr
+            sport[i], dport[i] = k.sport, k.dport
+            continue
+        saddr[i] = ip4(f"10.200.0.{rng.randrange(250) + 1}")
+        if roll < 0.5:  # service VIP
+            daddr[i] = ip4(f"172.16.0.{rng.randrange(n_services) + 1}")
+            dport[i] = 80
+        else:
+            daddr[i] = ip4(
+                f"10.{rng.randrange(5)}.{rng.randrange(250)}."
+                f"{rng.randrange(200) + 1}"
+            )
+            dport[i] = rng.choice([8000, 8080, 443, 9999])
+        sport[i] = rng.randrange(1024, 51024)
+    as32 = lambda a: (a & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    saddr32, daddr32 = as32(saddr), as32(daddr)
+    sport32, dport32 = sport.astype(np.int32), dport.astype(np.int32)
+    proto32 = proto.astype(np.int32)
+
+    tables = build_tables(ct, lb, ipc, pol)
+
+    def fn(t, sa, da, sp, dp, pr):
+        return datapath_verdicts(t, sa, da, sp, dp, pr)["verdict"]
+
+    rate = _pipelined_rate(
+        fn, (tables, saddr32, daddr32, sport32, dport32, proto32), F
+    )
+
+    # Host oracle cross-check + CPU rate on a sample.
+    out = datapath_verdicts(
+        tables, saddr32, daddr32, sport32, dport32, proto32
+    )
+    dev_verdict = np.asarray(out["verdict"])
+    n_cpu = 1000
+    t0 = time.perf_counter()
+    mism = 0
+    for i in range(n_cpu):
+        want = host_oracle(
+            ct, lb, ipc, pol, int(saddr[i]), int(daddr[i]),
+            int(sport[i]), int(dport[i]), int(proto[i]),
+        )
+        if int(dev_verdict[i]) != want["verdict"]:
+            mism += 1
+    cpu_rate = n_cpu / (time.perf_counter() - t0)
+    assert mism == 0, f"datapath verdicts diverge ({mism}/{n_cpu})"
+    print(f"bench datapath: tpu={rate:,.0f}/s cpu={cpu_rate:,.0f}/s "
+          f"mismatches=0/{n_cpu}", file=sys.stderr)
+    return rate, cpu_rate
+
+
 # --- sidecar latency -----------------------------------------------------
 
 def bench_latency():
@@ -635,6 +746,10 @@ def run_one(which: str) -> None:
                 r1m.p99_ms / max(lat["device_rtt_ms"], 1e-9), 2
             ),
         )
+    elif which == "datapath":
+        rate, cpu = bench_datapath()
+        _emit("datapath_l34_pkts_per_sec_per_chip", rate, "pkts/s",
+              rate / 1_000_000, cpu_oracle_per_sec=round(cpu))
     elif which == "stress":
         rate, dt = bench_stress()
         _emit(
@@ -653,7 +768,9 @@ def run_one(which: str) -> None:
 
 
 # Headline (r2d2) runs LAST so its JSON line is the final stdout line.
-CONFIGS = ("http", "kafka", "cassandra", "latency", "stress", "r2d2")
+CONFIGS = (
+    "http", "kafka", "cassandra", "latency", "datapath", "stress", "r2d2"
+)
 
 
 def main():

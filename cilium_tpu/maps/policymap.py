@@ -19,6 +19,7 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -171,9 +172,17 @@ class PolicyMap:
         )
 
 
+@jax.tree_util.register_pytree_node_class
 @dataclass
 class DevicePolicyMap:
     table: DeviceTable
+
+    def tree_flatten(self):
+        return ((self.table,), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
 
 
 def policy_can_access_batch(
