@@ -94,12 +94,17 @@ def build_tables(
 ) -> DatapathTables:
     """Snapshot host maps into device tables (the analog of the pinned
     BPF maps the kernel programs read)."""
-    keys = np.zeros((len(ct.entries), 5), np.int64)
-    for i, k in enumerate(ct.entries):
+    # Expired-but-not-yet-GCed entries must NOT reach the device table:
+    # ct_lookup4 treats them as misses (conntrack.h lifetime check), so
+    # the snapshot filters on lifetime like CtMap.lookup does.
+    now = int(ct.clock())
+    live = [k for k, e in ct.entries.items() if e.lifetime >= now]
+    keys = np.zeros((len(live), 5), np.int64)
+    for i, k in enumerate(live):
         keys[i] = (k.daddr, k.saddr, k.dport, k.sport, k.nexthdr)
     # uint32 -> int32 bit pattern so >2^31 addresses compare bit-exact.
     keys = (keys & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
-    vals = np.zeros((len(ct.entries), 1), np.int64)
+    vals = np.zeros((len(live), 1), np.int64)
     return DatapathTables(
         ct=pack_table(keys, vals),
         lb=lb.to_device(),
@@ -188,15 +193,18 @@ def apply_ct_creates(ct: CtMap, out: dict, saddr, sport, proto) -> int:
     np_ = np.asarray(out["new_dport"])
     ids = np.asarray(out["dst_identity"])
     rev = np.asarray(out["rev_nat"])
+    sa = np.asarray(saddr).view(np.uint32)
+    sp = np.asarray(sport)
+    pr = np.asarray(proto)
     created = 0
     for i in np.flatnonzero(need):
         ct.create(
             CtKey4(
                 daddr=int(nd[i]),
-                saddr=int(np.asarray(saddr).view(np.uint32)[i]),
+                saddr=int(sa[i]),
                 dport=int(np_[i]),
-                sport=int(np.asarray(sport)[i]),
-                nexthdr=int(np.asarray(proto)[i]),
+                sport=int(sp[i]),
+                nexthdr=int(pr[i]),
             ),
             src_sec_id=int(ids[i]),
             rev_nat_index=int(rev[i]),
@@ -241,13 +249,14 @@ def host_oracle(
         daddr=new_daddr, saddr=saddr & 0xFFFFFFFF, dport=new_dport,
         sport=sport, nexthdr=proto,
     )
-    est = key in ct.entries
+    entry = ct.entries.get(key)
+    est = entry is not None and entry.lifetime >= int(ct.clock())
 
     info = ipcache.lookup(str(ipaddress.IPv4Address(new_daddr)))
     dst_id = info.sec_label if info is not None else WORLD_ID
 
     allowed, proxy_port = policy.lookup(
-        dst_id, new_dport, proto, direction=DIR_EGRESS
+        dst_id, new_dport, proto, direction=DIR_EGRESS, count_packets=False
     )
     pass_ok = est or allowed
     if not pass_ok:

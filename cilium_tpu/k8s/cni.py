@@ -1,0 +1,95 @@
+"""CNI command surface: ADD / DEL against the daemon.
+
+reference: plugins/cilium-cni/cilium-cni.go — the CNI plugin the
+kubelet execs per pod sandbox: ADD allocates an IP via the daemon's
+IPAM, creates the endpoint (veth plumbing is kernel-side and out of
+scope here; the endpoint carries the container/netns identifiers), and
+returns the CNI result; DEL releases the IP and deletes the endpoint.
+
+Pod labels arrive through the CNI args (the reference resolves them via
+the k8s API; tests pass them directly).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from .ipam import IpamAllocator
+from .network_policy import POD_NAMESPACE_LABEL
+
+
+class CniError(Exception):
+    pass
+
+
+@dataclass
+class CniResult:
+    """Subset of the CNI result the reference returns (types.Result)."""
+
+    endpoint_id: int
+    ip: str
+    gateway: str
+    routes: list[str] = field(default_factory=list)
+
+
+class CniPlugin:
+    """ADD/DEL dispatcher bound to one daemon + IPAM range."""
+
+    def __init__(self, daemon, ipam: IpamAllocator) -> None:
+        self.daemon = daemon
+        self.ipam = ipam
+        self._lock = threading.Lock()
+        self._next_ep_id = 1000
+        # container id -> (endpoint id, ip)
+        self._containers: dict[str, tuple[int, str]] = {}
+
+    def cni_add(
+        self,
+        container_id: str,
+        namespace: str,
+        pod_name: str,
+        labels: dict[str, str] | None = None,
+    ) -> CniResult:
+        """reference: cilium-cni.go cmdAdd: IPAM -> endpoint create."""
+        with self._lock:
+            if container_id in self._containers:
+                raise CniError(f"container {container_id} already added")
+            ep_id = self._next_ep_id
+            self._next_ep_id += 1
+            # Reserve the slot NOW so a concurrent retried ADD for the
+            # same container fails the check above instead of double-
+            # allocating (kubelet retries ADDs).
+            self._containers[container_id] = (ep_id, "")
+        ip = self.ipam.allocate_next(owner=f"{namespace}/{pod_name}")
+        lbl_strs = [
+            f"k8s:{k}={v}" for k, v in sorted((labels or {}).items())
+        ]
+        lbl_strs.append(f"k8s:{POD_NAMESPACE_LABEL}={namespace}")
+        try:
+            self.daemon.endpoint_create(
+                ep_id, ipv4=ip, labels=lbl_strs, container_name=container_id
+            )
+        except Exception:
+            self.ipam.release(ip)
+            with self._lock:
+                self._containers.pop(container_id, None)
+            raise
+        with self._lock:
+            self._containers[container_id] = (ep_id, ip)
+        return CniResult(
+            endpoint_id=ep_id, ip=ip, gateway=self.ipam.router_ip
+        )
+
+    def cni_del(self, container_id: str) -> bool:
+        """reference: cilium-cni.go cmdDel — idempotent (a DEL for an
+        unknown container succeeds; kubelet retries DELs)."""
+        with self._lock:
+            rec = self._containers.pop(container_id, None)
+        if rec is None:
+            return False
+        ep_id, ip = rec
+        self.daemon.endpoint_delete(ep_id)
+        if ip:
+            self.ipam.release(ip)
+        return True

@@ -1,0 +1,185 @@
+"""k8s watch loop: apiserver events -> daemon policy add/delete.
+
+reference: daemon/k8s_watcher.go — NetworkPolicy v1 handlers (:472
+addK8sNetworkPolicyV1/update/delete), CiliumNetworkPolicy handlers
+(:1703 addCiliumNetworkPolicyV2, :1750 delete, CNP status updates
+:1690-1946), and Endpoints handlers driving ToServices translation.
+
+Updates are delete-by-labels + re-add (the reference's update path for
+both kinds), keyed on the derived policy labels so user rules and other
+policies are untouched.  CNP status (ok/error per node) writes back to
+the fake apiserver the way the reference PATCHes the CRD status.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..labels import LabelArray
+from . import apiserver as api
+from .cnp import parse_cnp
+from .network_policy import np_policy_name, parse_network_policy, policy_labels
+from .rule_translate import translate_to_services
+
+log = logging.getLogger(__name__)
+
+
+class K8sWatcher:
+    """Consumes a FakeApiServer watch stream and drives the daemon."""
+
+    def __init__(self, daemon, apisrv: api.FakeApiServer,
+                 node_name: str = "node-0") -> None:
+        self.daemon = daemon
+        self.apiserver = apisrv
+        self.node_name = node_name
+        self._queue = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.events_seen = 0
+        # Last known endpoints per (namespace, name) service for the
+        # ToServices revert pass on endpoint updates.
+        self._svc_backends: dict[tuple, list[str]] = {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "K8sWatcher":
+        self._queue = self.apiserver.watch()
+        self._thread = threading.Thread(
+            target=self._loop, name="k8s-watcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._queue is not None:
+            self._queue.put(None)  # wake
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            ev = self._queue.get()
+            if ev is None:
+                return
+            try:
+                self.handle(ev)
+            except Exception:  # noqa: BLE001 — one bad object must not
+                log.exception("k8s event failed: %s", ev)  # kill the loop
+            self.events_seen += 1
+
+    def sync(self, timeout: float = 5.0) -> None:
+        """Wait until every queued event has been handled (test helper —
+        the informer 'cache synced' analog)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._queue.unfinished_tasks == 0:  # type: ignore[attr-defined]
+                return
+            time.sleep(0.005)
+        raise TimeoutError("k8s watcher did not drain in time")
+
+    # -- event handling ---------------------------------------------------
+
+    def handle(self, ev: api.WatchEvent) -> None:
+        try:
+            if ev.kind == api.KIND_NETWORK_POLICY:
+                self._handle_np(ev)
+            elif ev.kind == api.KIND_CNP:
+                self._handle_cnp(ev)
+            elif ev.kind == api.KIND_ENDPOINTS:
+                self._handle_endpoints(ev)
+            # Services are consumed via Endpoints; Service objects carry
+            # metadata only for ToServices label matching.
+        finally:
+            if self._queue is not None:
+                try:
+                    self._queue.task_done()
+                except ValueError:
+                    pass
+
+    def _delete_by_labels(self, lbls: LabelArray) -> int:
+        _, deleted = self.daemon.policy_delete(lbls)
+        return deleted
+
+    def _handle_np(self, ev: api.WatchEvent) -> None:
+        """reference: k8s_watcher.go addK8sNetworkPolicyV1 /
+        updateK8sNetworkPolicyV1 / deleteK8sNetworkPolicyV1."""
+        meta = ev.obj.get("metadata") or {}
+        ns = meta.get("namespace") or "default"
+        # The label-derived name honors the io.cilium.name annotation
+        # (must match parse_network_policy or deletes would miss).
+        name = np_policy_name(ev.obj)
+        lbls = policy_labels(ns, name, "NetworkPolicy")
+        if ev.type == api.DELETED:
+            self._delete_by_labels(lbls)
+            return
+        rules = parse_network_policy(ev.obj)
+        if ev.type == api.MODIFIED:
+            self._delete_by_labels(lbls)
+        self.daemon.policy_add(rules)
+
+    def _handle_cnp(self, ev: api.WatchEvent) -> None:
+        """reference: k8s_watcher.go:1703 addCiliumNetworkPolicyV2 (+
+        CNP node-status update on success/failure)."""
+        meta = ev.obj.get("metadata") or {}
+        ns = meta.get("namespace") or "default"
+        name = meta.get("name", "")
+        lbls = policy_labels(ns, name, "CiliumNetworkPolicy")
+        if ev.type == api.DELETED:
+            self._delete_by_labels(lbls)
+            return
+        try:
+            rules = parse_cnp(ev.obj)
+            if ev.type == api.MODIFIED:
+                self._delete_by_labels(lbls)
+            self.daemon.policy_add(rules)
+            self._set_cnp_status(ev.obj, ok=True, error="")
+        except Exception as exc:  # noqa: BLE001 — status carries the error
+            self._set_cnp_status(ev.obj, ok=False, error=str(exc))
+            raise
+
+    def _set_cnp_status(self, cnp: dict, ok: bool, error: str) -> None:
+        """Write the per-node status back (reference:
+        updateCiliumNetworkPolicyV2AnnotationsOnly / CNPStatus nodes)."""
+        status = cnp.setdefault("status", {}).setdefault("nodes", {})
+        status[self.node_name] = {"ok": ok, "error": error}
+
+    def _handle_endpoints(self, ev: api.WatchEvent) -> None:
+        """Endpoints changes re-translate ToServices rules
+        (reference: k8s_watcher.go addK8sEndpointV1 ->
+        d.policy.TranslateRules)."""
+        meta = ev.obj.get("metadata") or {}
+        ns = meta.get("namespace") or "default"
+        name = meta.get("name", "")
+        ips = [
+            a.get("ip")
+            for subset in ev.obj.get("subsets") or []
+            for a in subset.get("addresses") or []
+            if a.get("ip")
+        ]
+        svc = self.apiserver.get(api.KIND_SERVICE, ns, name) or {}
+        svc_labels = (svc.get("metadata") or {}).get("labels") or {}
+        repo = self.daemon.get_policy_repository()
+        key = (ns, name)
+        old = self._svc_backends.get(key, [])
+        with repo.mutex:
+            rules = list(repo.rules)
+            if old and ev.type in (api.MODIFIED, api.DELETED):
+                translate_to_services(
+                    rules, name, ns, old, svc_labels, revert=True
+                )
+            if ev.type != api.DELETED:
+                res = translate_to_services(
+                    rules, name, ns, ips, svc_labels, revert=False
+                )
+            else:
+                res = None
+        if ev.type == api.DELETED:
+            self._svc_backends.pop(key, None)
+        else:
+            self._svc_backends[key] = ips
+        if res is None or res.added_cidrs or res.removed_cidrs or old:
+            self.daemon.trigger_policy_updates()

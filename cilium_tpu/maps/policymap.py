@@ -137,9 +137,11 @@ class PolicyMap:
         )
 
     def lookup(self, identity: int, dport: int, proto: int,
-               direction: int = DIR_INGRESS) -> tuple[bool, int]:
+               direction: int = DIR_INGRESS,
+               count_packets: bool = True) -> tuple[bool, int]:
         """Host-side reference cascade; returns (allowed, proxy_port)
-        (reference: bpf/lib/policy.h:47)."""
+        (reference: bpf/lib/policy.h:47).  ``count_packets=False`` makes
+        the lookup a pure read (oracle use)."""
         for key in (
             PolicyKey(identity, dport, proto, direction),
             PolicyKey(identity, 0, 0, direction),
@@ -147,7 +149,8 @@ class PolicyMap:
         ):
             e = self.entries.get(key)
             if e is not None:
-                e.packets += 1
+                if count_packets:
+                    e.packets += 1
                 if key.dest_port == 0 and key.identity != 0:
                     return True, 0  # L3-only allow, never a redirect
                 return True, e.proxy_port

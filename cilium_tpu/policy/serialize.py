@@ -10,7 +10,7 @@ from __future__ import annotations
 import json
 from typing import Any
 
-from ..labels import LabelArray, get_cilium_key_from, parse_label
+from ..labels import SOURCE_UNSPEC, Label, LabelArray, get_cilium_key_from, parse_label
 from .api import (
     CIDRRule,
     EgressRule,
@@ -181,8 +181,21 @@ def rule_from_dict(d: dict) -> Rule:
         endpoint_selector=selector_from_dict(d.get("endpointSelector", {})),
         ingress=ingress,
         egress=egress,
-        labels=LabelArray(parse_label(s) for s in d.get("labels", [])),
+        labels=LabelArray(_label_from(s) for s in d.get("labels", [])),
         description=d.get("description", ""),
+    )
+
+
+def _label_from(v) -> Label:
+    """Labels appear either as ``source:key=value`` strings or as the
+    reference's Label object form {key, value, source} (the format the
+    examples/policies corpus uses)."""
+    if isinstance(v, str):
+        return parse_label(v)
+    return Label(
+        key=v.get("key") or "",
+        value=v.get("value") or "",
+        source=v.get("source") or SOURCE_UNSPEC,
     )
 
 

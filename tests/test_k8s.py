@@ -1,0 +1,351 @@
+"""k8s integration layer: NP/CNP translation, watch loop against the
+fake apiserver, ToServices endpoint translation, IPAM, and CNI ADD/DEL
+— ending in actual policy verdicts (reference: daemon/k8s_watcher.go,
+pkg/k8s/{network_policy,rule_translate}.go, pkg/ipam,
+plugins/cilium-cni)."""
+
+import glob
+import json
+
+import pytest
+
+from cilium_tpu.daemon.daemon import Daemon
+from cilium_tpu.k8s import (
+    CniPlugin,
+    FakeApiServer,
+    IpamAllocator,
+    K8sWatcher,
+    parse_cnp,
+    parse_network_policy,
+    translate_to_services,
+)
+from cilium_tpu.k8s.apiserver import KIND_CNP, KIND_ENDPOINTS, KIND_NETWORK_POLICY, KIND_SERVICE
+from cilium_tpu.k8s.ipam import IpamError
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.policy.api import PolicyValidationError, Rule, Service
+from cilium_tpu.policy.serialize import rule_from_dict
+from cilium_tpu.utils.option import DaemonConfig
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = Daemon(DaemonConfig(state_dir=str(tmp_path / "state"), dry_mode=True))
+    yield d
+    d.close()
+
+
+# --- golden corpus: every reference example policy parses ----------------
+
+def test_reference_examples_parse_and_sanitize():
+    files = sorted(
+        glob.glob("/root/reference/examples/policies/**/*.json", recursive=True)
+    )
+    assert len(files) >= 30
+    n = 0
+    for f in files:
+        data = json.load(open(f))
+        for d in data if isinstance(data, list) else [data]:
+            r = rule_from_dict(d)
+            r.sanitize()
+            n += 1
+    assert n >= 30
+
+
+# --- k8s NetworkPolicy v1 translation -------------------------------------
+
+def np_obj(name="np1", namespace="ns1", spec=None):
+    return {
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec or {},
+    }
+
+
+def test_np_pod_selector_gets_namespace():
+    np = np_obj(spec={
+        "podSelector": {"matchLabels": {"app": "web"}},
+        "ingress": [{"from": [{"podSelector": {"matchLabels": {"role": "fe"}}}]}],
+    })
+    [rule] = parse_network_policy(np)
+    assert ("k8s.io.kubernetes.pod.namespace", "ns1") in rule.endpoint_selector.match_labels
+    assert ("k8s.app", "web") in rule.endpoint_selector.match_labels
+    frm = rule.ingress[0].from_endpoints[0]
+    assert ("k8s.io.kubernetes.pod.namespace", "ns1") in frm.match_labels
+    assert ("k8s.role", "fe") in frm.match_labels
+
+
+def test_np_empty_from_is_wildcard():
+    np = np_obj(spec={
+        "podSelector": {},
+        "ingress": [{"ports": [{"port": 80, "protocol": "TCP"}]}],
+    })
+    [rule] = parse_network_policy(np)
+    sel = rule.ingress[0].from_endpoints[0]
+    lbls = LabelArray.parse("k8s:anything=x")
+    assert sel.matches(lbls)  # reserved:all matches everything
+    assert rule.ingress[0].to_ports[0].ports[0].port == "80"
+
+
+def test_np_default_deny_conversion():
+    np = np_obj(spec={"podSelector": {}, "policyTypes": ["Ingress"]})
+    [rule] = parse_network_policy(np)
+    assert len(rule.ingress) == 1 and not rule.ingress[0].from_endpoints
+    np2 = np_obj(spec={"podSelector": {}, "policyTypes": ["Egress"]})
+    [rule2] = parse_network_policy(np2)
+    assert not rule2.ingress and len(rule2.egress) == 1
+
+
+def test_np_ip_block():
+    np = np_obj(spec={
+        "podSelector": {},
+        "ingress": [{"from": [{"ipBlock": {
+            "cidr": "10.0.0.0/8", "except": ["10.1.0.0/16"],
+        }}]}],
+    })
+    [rule] = parse_network_policy(np)
+    cr = rule.ingress[0].from_cidr_set[0]
+    assert cr.cidr == "10.0.0.0/8" and cr.except_cidrs == ["10.1.0.0/16"]
+
+
+def test_np_empty_namespace_selector_matches_all_namespaces():
+    np = np_obj(spec={
+        "podSelector": {},
+        "ingress": [{"from": [{"namespaceSelector": {}}]}],
+    })
+    [rule] = parse_network_policy(np)
+    sel = rule.ingress[0].from_endpoints[0]
+    assert any(
+        r.key == "k8s.io.kubernetes.pod.namespace" and r.operator == "Exists"
+        for r in sel.match_expressions
+    )
+
+
+# --- CNP translation -------------------------------------------------------
+
+def cnp_obj(spec=None, specs=None, name="cnp1", namespace="team-a"):
+    obj = {"metadata": {"name": name, "namespace": namespace}}
+    if spec is not None:
+        obj["spec"] = spec
+    if specs is not None:
+        obj["specs"] = specs
+    return obj
+
+
+def test_cnp_namespace_scoping():
+    cnp = cnp_obj(spec={
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{"fromEndpoints": [{"matchLabels": {"app": "web"}}]}],
+    })
+    [rule] = parse_cnp(cnp)
+    assert ("k8s.io.kubernetes.pod.namespace", "team-a") in rule.endpoint_selector.match_labels
+    assert ("k8s.io.kubernetes.pod.namespace", "team-a") in rule.ingress[0].from_endpoints[0].match_labels
+    # policy labels derived from the CRD
+    assert any(
+        l.key == "io.cilium.k8s.policy.derived-from"
+        and l.value == "CiliumNetworkPolicy"
+        for l in rule.labels
+    )
+
+
+def test_cnp_explicit_namespace_preserved_and_validated():
+    cnp = cnp_obj(spec={
+        "endpointSelector": {"matchLabels": {
+            "k8s:io.kubernetes.pod.namespace": "team-a", "app": "db",
+        }},
+    })
+    [rule] = parse_cnp(cnp)
+    assert ("k8s.io.kubernetes.pod.namespace", "team-a") in rule.endpoint_selector.match_labels
+    bad = cnp_obj(spec={
+        "endpointSelector": {"matchLabels": {
+            "k8s:io.kubernetes.pod.namespace": "other-ns",
+        }},
+    })
+    with pytest.raises(PolicyValidationError):
+        parse_cnp(bad)
+
+
+def test_cnp_example_http_end_to_end_verdicts(daemon):
+    """The reference's l7/http example, shipped as a CNP through the
+    fake apiserver, must land in the repository and produce L7 HTTP
+    verdicts via policy resolution."""
+    spec = json.load(open("/root/reference/examples/policies/l7/http/http.json"))[0]
+    spec.pop("labels", None)  # CNP labels derive from the CRD metadata
+    srv = FakeApiServer()
+    watcher = K8sWatcher(daemon, srv).start()
+    try:
+        srv.upsert(KIND_CNP, cnp_obj(spec=spec, name="l7-rule"))
+        watcher.sync()
+        repo = daemon.get_policy_repository()
+        assert repo.num_rules() == 1
+        # Resolve ingress L4/L7 for the selected endpoint.
+        from cilium_tpu.policy.search import SearchContext
+
+        to_lbls = LabelArray.parse(
+            "k8s:app=myService", "k8s:io.kubernetes.pod.namespace=team-a"
+        )
+        l4 = repo.resolve_l4_ingress_policy(
+            SearchContext(from_labels=LabelArray(), to_labels=to_lbls)
+        )
+        f = l4["80/TCP"]
+        http_rules = [
+            h for ep_rules in f.l7_rules_per_ep.values()
+            for h in ep_rules.http
+        ]
+        assert {h.method for h in http_rules} == {"GET", "PUT"}
+        # CNP status written back for this node
+        obj = srv.get(KIND_CNP, "team-a", "l7-rule")
+        assert obj["status"]["nodes"]["node-0"]["ok"] is True
+    finally:
+        watcher.stop()
+
+
+def test_cnp_invalid_spec_writes_error_status(daemon):
+    srv = FakeApiServer()
+    watcher = K8sWatcher(daemon, srv).start()
+    try:
+        srv.upsert(KIND_CNP, cnp_obj(spec={"endpointSelector": {"matchExpressions": [{"key": "app", "operator": "Bogus"}]}}, name="bad"))
+        watcher.sync()
+        obj = srv.get(KIND_CNP, "team-a", "bad")
+        st = obj["status"]["nodes"]["node-0"]
+        assert st["ok"] is False and st["error"]
+        assert daemon.get_policy_repository().num_rules() == 0
+    finally:
+        watcher.stop()
+
+
+# --- watch loop: NP add / modify / delete ---------------------------------
+
+def test_watcher_np_lifecycle(daemon):
+    srv = FakeApiServer()
+    watcher = K8sWatcher(daemon, srv).start()
+    repo = daemon.get_policy_repository()
+    try:
+        np = np_obj(spec={
+            "podSelector": {"matchLabels": {"app": "web"}},
+            "ingress": [{"ports": [{"port": 80, "protocol": "TCP"}]}],
+        })
+        srv.upsert(KIND_NETWORK_POLICY, np)
+        watcher.sync()
+        assert repo.num_rules() == 1
+        # modify: rule set replaced, not duplicated
+        np2 = np_obj(spec={
+            "podSelector": {"matchLabels": {"app": "web"}},
+            "ingress": [{"ports": [{"port": 8080, "protocol": "TCP"}]}],
+        })
+        srv.upsert(KIND_NETWORK_POLICY, np2)
+        watcher.sync()
+        assert repo.num_rules() == 1
+        srv.delete(KIND_NETWORK_POLICY, "ns1", "np1")
+        watcher.sync()
+        assert repo.num_rules() == 0
+    finally:
+        watcher.stop()
+
+
+def test_watcher_initial_sync_replays_existing(daemon):
+    """Objects created before the watcher starts still arrive (informer
+    initial list)."""
+    srv = FakeApiServer()
+    srv.upsert(KIND_NETWORK_POLICY, np_obj(spec={"podSelector": {}}))
+    watcher = K8sWatcher(daemon, srv).start()
+    try:
+        watcher.sync()
+        assert daemon.get_policy_repository().num_rules() == 1
+    finally:
+        watcher.stop()
+
+
+# --- ToServices translation ------------------------------------------------
+
+def _to_services_rule():
+    return rule_from_dict({
+        "endpointSelector": {"matchLabels": {"app": "client"}},
+        "egress": [{"toServices": [
+            {"k8sService": {"serviceName": "db", "namespace": "prod"}},
+        ]}],
+    })
+
+
+def test_translate_to_services_populates_and_reverts():
+    rule = _to_services_rule()
+    res = translate_to_services([rule], "db", "prod", ["10.5.0.1", "10.5.0.2"])
+    cidrs = {c.cidr for c in rule.egress[0].to_cidr_set}
+    assert cidrs == {"10.5.0.1/32", "10.5.0.2/32"}
+    assert all(c.generated for c in rule.egress[0].to_cidr_set)
+    # revert removes only generated entries for those backends
+    translate_to_services([rule], "db", "prod", ["10.5.0.1", "10.5.0.2"],
+                          revert=True)
+    assert rule.egress[0].to_cidr_set == []
+    # non-matching service name leaves the rule alone
+    res = translate_to_services([rule], "other", "prod", ["10.9.9.9"])
+    assert rule.egress[0].to_cidr_set == [] and res.added_cidrs == []
+
+
+def test_watcher_endpoints_translation(daemon):
+    srv = FakeApiServer()
+    watcher = K8sWatcher(daemon, srv).start()
+    repo = daemon.get_policy_repository()
+    try:
+        daemon.policy_add([_to_services_rule()])
+        srv.upsert(KIND_SERVICE, {
+            "metadata": {"name": "db", "namespace": "prod",
+                         "labels": {"tier": "db"}},
+        })
+        srv.upsert(KIND_ENDPOINTS, {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "subsets": [{"addresses": [{"ip": "10.5.0.1"}]}],
+        })
+        watcher.sync()
+        with repo.mutex:
+            cidrs = {
+                c.cidr for r in repo.rules
+                for e in r.egress for c in e.to_cidr_set
+            }
+        assert cidrs == {"10.5.0.1/32"}
+        # backend set changes: old IP reverted, new added
+        srv.upsert(KIND_ENDPOINTS, {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "subsets": [{"addresses": [{"ip": "10.5.0.7"}]}],
+        })
+        watcher.sync()
+        with repo.mutex:
+            cidrs = {
+                c.cidr for r in repo.rules
+                for e in r.egress for c in e.to_cidr_set
+            }
+        assert cidrs == {"10.5.0.7/32"}
+    finally:
+        watcher.stop()
+
+
+# --- IPAM -------------------------------------------------------------------
+
+def test_ipam_allocate_release_exhaust():
+    ipam = IpamAllocator("10.8.0.0/29")  # .1 router, .2-.6 usable
+    ips = [ipam.allocate_next("p") for _ in range(5)]
+    assert ips == ["10.8.0.2", "10.8.0.3", "10.8.0.4", "10.8.0.5", "10.8.0.6"]
+    with pytest.raises(IpamError):
+        ipam.allocate_next("p")
+    assert ipam.release("10.8.0.4")
+    assert ipam.allocate_next("p") == "10.8.0.4"
+    with pytest.raises(IpamError):
+        ipam.allocate_ip("10.8.0.2", "p")  # already taken
+    with pytest.raises(IpamError):
+        ipam.allocate_ip("10.9.0.1", "p")  # out of range
+
+
+# --- CNI ---------------------------------------------------------------------
+
+def test_cni_add_del_roundtrip(daemon):
+    ipam = IpamAllocator("10.8.0.0/24")
+    cni = CniPlugin(daemon, ipam)
+    res = cni.cni_add("c1", "ns1", "pod-a", labels={"app": "web"})
+    assert res.ip.startswith("10.8.0.") and res.gateway == "10.8.0.1"
+    ep = daemon.endpoint_manager.lookup(res.endpoint_id)
+    assert ep is not None and ep.ipv4 == res.ip
+    assert daemon.ipcache.lookup_by_ip(res.ip) is not None
+    # DEL is idempotent
+    assert cni.cni_del("c1") is True
+    assert cni.cni_del("c1") is False
+    assert daemon.endpoint_manager.lookup(res.endpoint_id) is None
+    # the IP is reusable after release
+    assert ipam.allocate_ip(res.ip, "again") == res.ip
