@@ -32,7 +32,7 @@ from ..ipcache import (
     KvstoreIPSync,
     datapath_listener,
 )
-from ..kvstore import FileBackend, LocalBackend, setup_client
+from ..kvstore import FileBackend, LocalBackend, NetBackend, setup_client
 from ..labels import Labels, LabelArray
 from ..maps import CtMap, IpcacheMap, LbMap, MetricsMap
 from ..monitor import (
@@ -76,12 +76,15 @@ class Daemon:
         self.node_name = node_name
         self.controllers = ControllerManager()
 
-        # kvstore (reference: kvstore.Client setup)
+        # kvstore (reference: kvstore.Client setup; "tcp" is the
+        # networked backend — the etcd-module analog)
         if self.config.kvstore == "file":
             path = self.config.kvstore_opts.get(
                 "path", os.path.join(self.config.run_dir, "kvstore.json")
             )
             self.kvstore = FileBackend(path)
+        elif self.config.kvstore == "tcp":
+            self.kvstore = NetBackend(self.config.kvstore_opts["address"])
         else:
             self.kvstore = LocalBackend()
         setup_client(self.kvstore)

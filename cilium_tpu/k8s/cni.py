@@ -61,7 +61,12 @@ class CniPlugin:
             # same container fails the check above instead of double-
             # allocating (kubelet retries ADDs).
             self._containers[container_id] = (ep_id, "")
-        ip = self.ipam.allocate_next(owner=f"{namespace}/{pod_name}")
+        try:
+            ip = self.ipam.allocate_next(owner=f"{namespace}/{pod_name}")
+        except Exception:
+            with self._lock:
+                self._containers.pop(container_id, None)
+            raise
         lbl_strs = [
             f"k8s:{k}={v}" for k, v in sorted((labels or {}).items())
         ]

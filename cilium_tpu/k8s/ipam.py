@@ -38,6 +38,12 @@ class IpamAllocator:
             if self._free:
                 ip = ipaddress.ip_address(self._free.pop())
             else:
+                # Skip addresses claimed out-of-band via allocate_ip.
+                while (
+                    self._next <= self._last
+                    and str(ipaddress.ip_address(self._next)) in self._allocated
+                ):
+                    self._next += 1
                 if self._next > self._last:
                     raise IpamError(f"range {self.network} exhausted")
                 ip = ipaddress.ip_address(self._next)

@@ -6,10 +6,12 @@ reference) via BackendOperations (backend.go:86): Get/Set/CAS primitives,
 path locks, leases, and prefix watchers feeding event channels.
 
 Backends here: ``LocalBackend`` (in-process, threadsafe, full watch/lease
-semantics — the default for single-host and tests) and ``FileBackend``
-(JSON-file persisted, surviving restarts).  An etcd backend can slot in
-behind the same interface where a cluster store is available; the consumer
-layers (allocator, store, ipcache) only use BackendOperations.
+semantics — the default for single-host and tests), ``FileBackend``
+(JSON-file persisted, surviving restarts), and ``NetBackend`` (TCP client
+to a ``KvstoreServer`` — the networked store giving multiple daemons one
+shared cluster state with session leases, CAS, and live watch; see
+net.py).  The consumer layers (allocator, store, ipcache) only use
+BackendOperations.
 """
 
 from .backend import (
@@ -22,6 +24,7 @@ from .backend import (
     Watcher,
 )
 from .local import FileBackend, LocalBackend
+from .net import KvstoreServer, NetBackend
 
 _default_client: Backend | None = None
 
@@ -54,8 +57,10 @@ __all__ = [
     "FileBackend",
     "KeyValueEvent",
     "KvstoreError",
+    "KvstoreServer",
     "LocalBackend",
     "LockError",
+    "NetBackend",
     "Watcher",
     "client",
     "close_client",

@@ -1,0 +1,551 @@
+"""Networked kvstore: TCP server + client Backend.
+
+The distributed-state backbone crossing a real process/machine boundary
+— the role etcd plays for the reference (reference: pkg/kvstore/etcd.go:
+143 etcd module: leases, CAS transactions, prefix watch; keepalive.go
+session liveness).  One KvstoreServer owns the authoritative store (a
+LocalBackend); any number of NetBackend clients connect over TCP and
+speak a length-prefixed JSON protocol:
+
+  - CRUD + the CAS primitives (create_only / create_if_exists) execute
+    atomically inside the server.
+  - lease=True keys belong to the client's SESSION (one session per
+    connection); session end — clean close or TCP death — deletes them,
+    emitting DELETE events to every other client's watchers.  This is
+    the etcd lease-expiry model: a dying node's identity references and
+    ipcache entries vanish cluster-wide.
+  - Locks are server-side with session ownership and auto-release on
+    session end (reference: etcd.go LockPath via concurrency.Mutex).
+  - list_and_watch replays the snapshot + LIST_DONE, then streams live
+    events; the client assigns watch ids so no event can outrun its
+    watcher registration.
+
+Wire frame: 4-byte big-endian length + UTF-8 JSON.  Values travel hex.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import socket
+import struct
+import threading
+from typing import Optional
+
+from .backend import (
+    Backend,
+    EventType,
+    KeyValueEvent,
+    KvstoreError,
+    LockError,
+    Watcher,
+)
+from .local import LocalBackend
+
+log = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 16 << 20
+
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("kvstore peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> dict:
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    if n > MAX_FRAME:
+        raise KvstoreError(f"kvstore frame too large ({n})")
+    return json.loads(_recv_exact(sock, n))
+
+
+# ---------------------------------------------------------------------------
+# Server
+
+class _Session:
+    """Per-connection state: leased keys, held locks, active watches."""
+
+    def __init__(self, server: "KvstoreServer", sock: socket.socket,
+                 peer: str) -> None:
+        self.server = server
+        self.sock = sock
+        self.peer = peer
+        self.wlock = threading.Lock()
+        self.leased: set[str] = set()
+        self.locks: dict[str, object] = {}
+        self.watches: dict[int, tuple[Watcher, threading.Thread]] = {}
+        self._dead = False
+
+    def send(self, obj: dict) -> None:
+        with self.wlock:
+            try:
+                _send_frame(self.sock, obj)
+            except OSError:
+                pass  # reader notices the dead socket and cleans up
+
+    def serve(self) -> None:
+        try:
+            while True:
+                req = _recv_frame(self.sock)
+                op = req.get("op", "")
+                if op == "lock":
+                    # Lock acquisition blocks; its own thread keeps this
+                    # session's other requests flowing.
+                    threading.Thread(
+                        target=self._handle_safe, args=(req,), daemon=True
+                    ).start()
+                else:
+                    self._handle_safe(req)
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            self.cleanup()
+
+    def _handle_safe(self, req: dict) -> None:
+        rid = req.get("id")
+        try:
+            result = self._handle(req)
+            self.send({"id": rid, "ok": True, **(result or {})})
+        except LockError as e:
+            self.send({"id": rid, "ok": False, "error": str(e),
+                       "kind": "lock"})
+        except Exception as e:  # noqa: BLE001 — surface to the client
+            self.send({"id": rid, "ok": False, "error": str(e)})
+
+    def _handle(self, req: dict) -> dict | None:
+        b = self.server.backend
+        op = req["op"]
+        key = req.get("key", "")
+        val = bytes.fromhex(req["value"]) if "value" in req else b""
+        lease = bool(req.get("lease"))
+        if op == "ping":
+            return {}
+        if op == "status":
+            return {"status": b.status()}
+        if op == "get":
+            v = b.get(key)
+            return {"found": v is not None,
+                    "value": v.hex() if v is not None else ""}
+        if op == "get_prefix":
+            v = b.get_prefix(key)
+            return {"found": v is not None,
+                    "value": v.hex() if v is not None else ""}
+        if op == "set":
+            b.set(key, val, lease=False)
+            self._claim(key, lease)
+            return {}
+        if op == "delete":
+            b.delete(key)
+            self._disclaim(key)
+            return {}
+        if op == "delete_prefix":
+            b.delete_prefix(key)
+            with self.server._mutex:
+                for k in [
+                    k for k in self.server._lease_owner
+                    if k.startswith(key)
+                ]:
+                    self.server._lease_owner.pop(k)
+            self.leased = {k for k in self.leased if not k.startswith(key)}
+            return {}
+        if op == "create_only":
+            ok = b.create_only(key, val, lease=False)
+            if ok:
+                self._claim(key, lease)
+            return {"created": ok}
+        if op == "create_if_exists":
+            ok = b.create_if_exists(req["cond_key"], key, val, lease=False)
+            if ok:
+                self._claim(key, lease)
+            return {"created": ok}
+        if op == "list_prefix":
+            return {
+                "items": {k: v.hex() for k, v in b.list_prefix(key).items()}
+            }
+        if op == "lock":
+            path = req["path"]
+            lock = b.lock_path(path, timeout=req.get("timeout"))
+            self.locks[path] = lock
+            return {}
+        if op == "unlock":
+            lock = self.locks.pop(req["path"], None)
+            if lock is not None:
+                lock.unlock()
+            return {}
+        if op == "watch":
+            wid = int(req["wid"])
+            w = b.list_and_watch(req.get("name", self.peer), key)
+            t = threading.Thread(
+                target=self._pump_watch, args=(wid, w), daemon=True,
+                name=f"kvstore-watch-{wid}",
+            )
+            self.watches[wid] = (w, t)
+            t.start()
+            return {}
+        if op == "watch_stop":
+            rec = self.watches.pop(int(req["wid"]), None)
+            if rec is not None:
+                rec[0].stop()
+            return {}
+        raise KvstoreError(f"unknown kvstore op {op!r}")
+
+    def _claim(self, key: str, lease: bool) -> None:
+        """Record lease ownership: a later write by ANY session (leased
+        or not) re-associates the key, so an older session's death no
+        longer deletes it (etcd semantics: the latest PUT's lease —
+        or absence of one — wins)."""
+        with self.server._mutex:
+            if lease:
+                self.server._lease_owner[key] = self
+                self.leased.add(key)
+            else:
+                self.server._lease_owner.pop(key, None)
+
+    def _disclaim(self, key: str) -> None:
+        with self.server._mutex:
+            self.server._lease_owner.pop(key, None)
+        self.leased.discard(key)
+
+    def _pump_watch(self, wid: int, w: Watcher) -> None:
+        while not w.stopped and not self._dead:
+            ev = w.next_event(timeout=0.2)
+            if ev is None:
+                continue
+            self.send({
+                "event": {
+                    "wid": wid,
+                    "type": ev.typ.value,
+                    "key": ev.key,
+                    "value": ev.value.hex(),
+                }
+            })
+
+    def cleanup(self) -> None:
+        """Session death: stop watches, release locks, revoke leases —
+        the etcd lease-expiry analog; other clients see DELETE events."""
+        if self._dead:
+            return
+        self._dead = True
+        for w, _ in self.watches.values():
+            w.stop()
+        self.watches.clear()
+        for lock in self.locks.values():
+            try:
+                lock.unlock()
+            except Exception:  # noqa: BLE001
+                pass
+        self.locks.clear()
+        for k in sorted(self.leased):
+            # Only revoke keys THIS session still owns: a newer session
+            # (e.g. the restarted daemon) may have re-registered the key.
+            with self.server._mutex:
+                owned = self.server._lease_owner.get(k) is self
+                if owned:
+                    self.server._lease_owner.pop(k)
+            if not owned:
+                continue
+            try:
+                self.server.backend.delete(k)
+            except Exception:  # noqa: BLE001
+                pass
+        self.leased.clear()
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.server._drop_session(self)
+
+
+class KvstoreServer:
+    """TCP front for a LocalBackend — the cluster's shared store."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 backend: Backend | None = None) -> None:
+        self.backend = backend or LocalBackend()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.address = "%s:%d" % self._listener.getsockname()[:2]
+        self._sessions: list[_Session] = []
+        self._lease_owner: dict[str, _Session] = {}
+        self._mutex = threading.Lock()
+        self._stopped = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="kvstore-accept"
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sess = _Session(self, sock, f"{addr[0]}:{addr[1]}")
+            with self._mutex:
+                self._sessions.append(sess)
+            threading.Thread(
+                target=sess.serve, daemon=True, name="kvstore-session"
+            ).start()
+
+    def _drop_session(self, sess: _Session) -> None:
+        with self._mutex:
+            if sess in self._sessions:
+                self._sessions.remove(sess)
+
+    def close(self) -> None:
+        self._stopped = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._mutex:
+            sessions = list(self._sessions)
+        for s in sessions:
+            s.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Client
+
+class _NetLock:
+    def __init__(self, backend: "NetBackend", path: str) -> None:
+        self._backend = backend
+        self._path = path
+        self._held = True
+
+    def unlock(self) -> None:
+        if self._held:
+            self._held = False
+            self._backend._request({"op": "unlock", "path": self._path})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.unlock()
+
+
+class NetBackend(Backend):
+    """Client Backend speaking to a KvstoreServer over TCP.
+
+    One socket per backend; a reader thread routes responses to waiting
+    callers and watch events to their Watcher queues (so watches stay
+    live while requests block)."""
+
+    def __init__(self, address: str, timeout: float = 10.0) -> None:
+        host, _, port = address.rpartition(":")
+        self.address = address
+        self.timeout = timeout
+        self.sock = socket.create_connection((host, int(port)), timeout=10.0)
+        self.sock.settimeout(None)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._mutex = threading.Lock()
+        self._seq = 0
+        self._pending: dict[int, queue.Queue] = {}
+        self._watchers: dict[int, Watcher] = {}
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name="kvstore-client-read"
+        )
+        self._reader.start()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = _recv_frame(self.sock)
+                if "event" in msg:
+                    ev = msg["event"]
+                    w = self._watchers.get(int(ev["wid"]))
+                    if w is not None and not w.stopped:
+                        w.events.put(KeyValueEvent(
+                            EventType(ev["type"]), ev["key"],
+                            bytes.fromhex(ev["value"]),
+                        ))
+                    continue
+                with self._mutex:
+                    q = self._pending.pop(msg.get("id"), None)
+                if q is not None:
+                    q.put(msg)
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        with self._mutex:
+            pending = list(self._pending.values())
+            self._pending.clear()
+            watchers = list(self._watchers.values())
+            self._watchers.clear()
+        for q in pending:
+            q.put({"ok": False, "error": "kvstore connection lost"})
+        for w in watchers:
+            w.stop()
+
+    def _request(self, req: dict, timeout: float | None = None) -> dict:
+        if self._closed:
+            raise KvstoreError("kvstore client closed")
+        with self._mutex:
+            self._seq += 1
+            rid = self._seq
+            q: queue.Queue = queue.Queue(maxsize=1)
+            self._pending[rid] = q
+        req["id"] = rid
+        with self._wlock:
+            try:
+                _send_frame(self.sock, req)
+            except OSError as e:
+                with self._mutex:
+                    self._pending.pop(rid, None)
+                raise KvstoreError(f"kvstore send failed: {e}")
+        try:
+            resp = q.get(timeout=timeout if timeout is not None else self.timeout)
+        except queue.Empty:
+            with self._mutex:
+                self._pending.pop(rid, None)
+            raise KvstoreError(f"kvstore request timed out: {req['op']}")
+        if not resp.get("ok"):
+            if resp.get("kind") == "lock":
+                raise LockError(resp.get("error", "lock failed"))
+            raise KvstoreError(resp.get("error", "kvstore error"))
+        return resp
+
+    # -- Backend interface -------------------------------------------------
+
+    def status(self) -> str:
+        try:
+            inner = self._request({"op": "status"})["status"]
+            return f"tcp {self.address}: connected ({inner})"
+        except KvstoreError as e:
+            return f"tcp {self.address}: failure - {e}"
+
+    def lock_path(self, path: str, timeout: float | None = 10.0) -> _NetLock:
+        t = timeout if timeout is not None else 60.0
+        self._request(
+            {"op": "lock", "path": path, "timeout": t}, timeout=t + 5.0
+        )
+        return _NetLock(self, path)
+
+    def get(self, key: str) -> Optional[bytes]:
+        r = self._request({"op": "get", "key": key})
+        return bytes.fromhex(r["value"]) if r["found"] else None
+
+    def get_prefix(self, prefix: str) -> Optional[bytes]:
+        r = self._request({"op": "get_prefix", "key": prefix})
+        return bytes.fromhex(r["value"]) if r["found"] else None
+
+    def set(self, key: str, value: bytes, lease: bool = False) -> None:
+        self._request(
+            {"op": "set", "key": key, "value": value.hex(), "lease": lease}
+        )
+
+    def delete(self, key: str) -> None:
+        self._request({"op": "delete", "key": key})
+
+    def delete_prefix(self, prefix: str) -> None:
+        self._request({"op": "delete_prefix", "key": prefix})
+
+    def create_only(self, key: str, value: bytes, lease: bool = False) -> bool:
+        r = self._request({
+            "op": "create_only", "key": key, "value": value.hex(),
+            "lease": lease,
+        })
+        return bool(r["created"])
+
+    def create_if_exists(self, cond_key: str, key: str, value: bytes,
+                         lease: bool = False) -> bool:
+        r = self._request({
+            "op": "create_if_exists", "cond_key": cond_key, "key": key,
+            "value": value.hex(), "lease": lease,
+        })
+        return bool(r["created"])
+
+    def list_prefix(self, prefix: str) -> dict[str, bytes]:
+        r = self._request({"op": "list_prefix", "key": prefix})
+        return {k: bytes.fromhex(v) for k, v in r["items"].items()}
+
+    def list_and_watch(self, name: str, prefix: str) -> Watcher:
+        with self._mutex:
+            self._seq += 1
+            wid = self._seq
+        w = _NetWatcher(self, wid, name, prefix)
+        # Register BEFORE the request: the server's snapshot replay can
+        # arrive before the watch response.
+        self._watchers[wid] = w
+        try:
+            self._request(
+                {"op": "watch", "wid": wid, "key": prefix, "name": name}
+            )
+        except KvstoreError:
+            self._watchers.pop(wid, None)
+            raise
+        return w
+
+    def ping(self) -> bool:
+        try:
+            self._request({"op": "ping"})
+            return True
+        except KvstoreError:
+            return False
+
+    def _stop_watch(self, wid: int) -> None:
+        self._watchers.pop(wid, None)
+        if not self._closed:
+            try:
+                self._request({"op": "watch_stop", "wid": wid})
+            except KvstoreError:
+                pass
+
+    def close(self) -> None:
+        """Clean session end: the server revokes this session's leases
+        (reference: lease expiry on client shutdown)."""
+        if self._closed:
+            return
+        self._closed = True
+        # shutdown() first: close() alone does not send FIN while the
+        # reader thread is blocked in recv on the same fd, so the server
+        # would never see the session die (and leases would leak).
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._fail_pending()
+
+
+class _NetWatcher(Watcher):
+    def __init__(self, backend: NetBackend, wid: int, name: str,
+                 prefix: str) -> None:
+        super().__init__(name, prefix)
+        self._backend = backend
+        self._wid = wid
+
+    def stop(self) -> None:
+        if not self.stopped:
+            super().stop()
+            self._backend._stop_watch(self._wid)

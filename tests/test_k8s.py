@@ -349,3 +349,32 @@ def test_cni_add_del_roundtrip(daemon):
     assert daemon.endpoint_manager.lookup(res.endpoint_id) is None
     # the IP is reusable after release
     assert ipam.allocate_ip(res.ip, "again") == res.ip
+
+
+def test_ipam_allocate_next_skips_specific_allocations():
+    """allocate_next must never hand out an address already claimed via
+    allocate_ip."""
+    ipam = IpamAllocator("10.8.0.0/29")
+    ipam.allocate_ip("10.8.0.2", "a")
+    assert ipam.allocate_next("b") == "10.8.0.3"
+    assert ipam.dump()["10.8.0.2"] == "a"
+
+
+def test_cni_add_retry_after_exhaustion(daemon):
+    """A failed ADD (range exhausted) must not poison retries for the
+    same container once capacity frees up."""
+    ipam = IpamAllocator("10.8.0.0/29")
+    while True:  # exhaust the range
+        try:
+            ipam.allocate_next("filler")
+        except IpamError:
+            break
+    cni = CniPlugin(daemon, ipam)
+    import pytest as _pytest
+
+    with _pytest.raises(IpamError):
+        cni.cni_add("c-retry", "ns1", "pod-r")
+    bigger = IpamAllocator("10.9.0.0/29")
+    cni.ipam = bigger
+    res = cni.cni_add("c-retry", "ns1", "pod-r")  # retry succeeds
+    assert res.ip.startswith("10.9.0.")

@@ -1,0 +1,280 @@
+"""Networked kvstore: the TCP backend must honor the full Backend
+contract (CRUD, CAS, locks, leases, watch) across a real socket, and two
+daemons sharing one server must converge on identities and ipcache state
+— including lease revocation when a daemon dies
+(reference: pkg/kvstore/etcd.go leases/CAS/watch, two-node convergence)."""
+
+import json
+import time
+
+import pytest
+
+from cilium_tpu.kvstore import (
+    EventType,
+    KvstoreServer,
+    LockError,
+    NetBackend,
+)
+
+
+@pytest.fixture
+def server():
+    srv = KvstoreServer()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture
+def client(server):
+    c = NetBackend(server.address)
+    yield c
+    c.close()
+
+
+def _drain_until(w, typ, key, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    seen = []
+    while time.monotonic() < deadline:
+        ev = w.next_event(timeout=0.2)
+        if ev is None:
+            continue
+        seen.append(ev)
+        if ev.typ == typ and ev.key == key:
+            return ev
+    raise AssertionError(f"no {typ} for {key}; saw {seen}")
+
+
+class TestNetBackend:
+    def test_crud_roundtrip(self, client):
+        assert client.get("a/b") is None
+        client.set("a/b", b"v1")
+        assert client.get("a/b") == b"v1"
+        client.set("a/c", b"v2")
+        assert client.list_prefix("a/") == {"a/b": b"v1", "a/c": b"v2"}
+        assert client.get_prefix("a/") == b"v1"
+        client.delete("a/b")
+        assert client.get("a/b") is None
+        client.delete_prefix("a/")
+        assert client.list_prefix("a/") == {}
+
+    def test_cas_across_clients(self, server, client):
+        c2 = NetBackend(server.address)
+        try:
+            assert client.create_only("id/5", b"x")
+            assert not c2.create_only("id/5", b"y")  # atomic on the server
+            assert client.get("id/5") == b"x"
+            assert c2.create_if_exists("id/5", "val/5/n2", b"1")
+            assert not c2.create_if_exists("id/9", "val/9/n2", b"1")
+        finally:
+            c2.close()
+
+    def test_watch_snapshot_then_live(self, server, client):
+        client.set("w/a", b"1")
+        c2 = NetBackend(server.address)
+        try:
+            w = c2.list_and_watch("t", "w/")
+            ev = w.next_event(timeout=2)
+            assert ev.typ == EventType.CREATE and ev.key == "w/a"
+            assert w.next_event(timeout=2).typ == EventType.LIST_DONE
+            client.set("w/b", b"2")
+            _drain_until(w, EventType.CREATE, "w/b")
+            client.delete("w/b")
+            _drain_until(w, EventType.DELETE, "w/b")
+            w.stop()
+        finally:
+            c2.close()
+
+    def test_lock_exclusion_across_clients(self, server, client):
+        c2 = NetBackend(server.address)
+        try:
+            lock = client.lock_path("locks/x", timeout=1.0)
+            with pytest.raises(LockError):
+                c2.lock_path("locks/x", timeout=0.3)
+            lock.unlock()
+            c2.lock_path("locks/x", timeout=2.0).unlock()
+        finally:
+            c2.close()
+
+    def test_lease_revoked_on_close(self, server, client):
+        c2 = NetBackend(server.address)
+        c2.set("lease/k", b"v", lease=True)
+        c2.set("plain/k", b"v")
+        w = client.list_and_watch("t", "lease/")
+        _drain_until(w, EventType.CREATE, "lease/k")
+        c2.close()
+        # the server revokes the dead session's leases -> DELETE event
+        _drain_until(w, EventType.DELETE, "lease/k")
+        assert client.get("lease/k") is None
+        assert client.get("plain/k") == b"v"  # non-leased survives
+
+    def test_lock_released_on_session_death(self, server, client):
+        c2 = NetBackend(server.address)
+        c2.lock_path("locks/dead", timeout=1.0)
+        c2.close()  # never unlocked explicitly
+        # lock must become available once the session is gone
+        deadline = time.monotonic() + 3
+        while True:
+            try:
+                client.lock_path("locks/dead", timeout=0.3).unlock()
+                break
+            except LockError:
+                assert time.monotonic() < deadline, "lock never released"
+
+    def test_status(self, client):
+        assert "connected" in client.status()
+
+    def test_lease_reregistration_survives_old_session_death(self, server, client):
+        """etcd semantics: the latest PUT's lease wins.  A restarted
+        daemon re-registering its key must not lose it when the OLD
+        session's death is finally noticed."""
+        c_old = NetBackend(server.address)
+        c_old.set("nodes/A", b"v1", lease=True)
+        c_new = NetBackend(server.address)
+        try:
+            c_new.set("nodes/A", b"v2", lease=True)
+            c_old.close()
+            time.sleep(0.3)
+            assert client.get("nodes/A") == b"v2"  # survived old death
+            c_new.close()
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if client.get("nodes/A") is None:
+                    break
+                time.sleep(0.05)
+            assert client.get("nodes/A") is None  # dies with NEW session
+        finally:
+            c_new.close()
+
+    def test_nonlease_overwrite_clears_lease(self, server, client):
+        """A non-leased PUT over a leased key detaches the lease."""
+        c2 = NetBackend(server.address)
+        c2.set("cfg/x", b"v1", lease=True)
+        client.set("cfg/x", b"v2")  # plain set from another session
+        c2.close()
+        time.sleep(0.3)
+        assert client.get("cfg/x") == b"v2"
+
+
+class TestClusterMesh:
+    def test_remote_cluster_merge_and_purge(self, tmp_path):
+        """Cluster A meshes with cluster B: B's endpoint IPs become
+        resolvable in A's ipcache; dropping the mesh config purges them
+        (reference: pkg/clustermesh remote_cluster onRemove)."""
+        from cilium_tpu.clustermesh import ClusterMesh
+        from cilium_tpu.daemon.daemon import Daemon
+        from cilium_tpu.utils.option import DaemonConfig
+
+        srv_b = KvstoreServer()
+        db = Daemon(
+            DaemonConfig(
+                state_dir=str(tmp_path / "b"), dry_mode=True,
+                kvstore="tcp", kvstore_opts={"address": srv_b.address},
+                cluster_name="cluster-b",
+            ),
+            node_name="b0",
+        )
+        # Local side: just an ipcache + a mesh config dir (cluster A's
+        # agent state, no full daemon needed).
+        from cilium_tpu.ipcache import IPIdentityCache
+
+        cache_a = IPIdentityCache("cluster-a")
+        cfg_dir = str(tmp_path / "mesh")
+        mesh = ClusterMesh(cfg_dir, cache_a, interval=0.05)
+        try:
+            with open(f"{cfg_dir}/cluster-b", "w") as f:
+                json.dump({"address": srv_b.address}, f)
+            db.endpoint_create(31, ipv4="10.60.0.31", labels=["k8s:app=remote"])
+            id_b = db.endpoint_manager.lookup(31).security_identity.id
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if cache_a.lookup_by_ip("10.60.0.31") == id_b:
+                    break
+                time.sleep(0.05)
+            assert cache_a.lookup_by_ip("10.60.0.31") == id_b
+            assert mesh.status()[0]["connected"]
+            # drop the config: learned entries purge
+            import os
+
+            os.unlink(f"{cfg_dir}/cluster-b")
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if cache_a.lookup_by_ip("10.60.0.31") is None:
+                    break
+                time.sleep(0.05)
+            assert cache_a.lookup_by_ip("10.60.0.31") is None
+            assert mesh.num_connected() == 0
+        finally:
+            mesh.close()
+            db.close()
+            srv_b.close()
+
+
+class TestTwoDaemonConvergence:
+    def test_identity_and_ipcache_converge(self, server, tmp_path):
+        """Identity allocated on node A resolves on node B (same numeric
+        id for the same labels), ipcache syncs both ways, and A's death
+        revokes its ipcache entries on B."""
+        from cilium_tpu.daemon.daemon import Daemon
+        from cilium_tpu.utils.option import DaemonConfig
+
+        def mk(node):
+            return Daemon(
+                DaemonConfig(
+                    state_dir=str(tmp_path / node), dry_mode=True,
+                    kvstore="tcp",
+                    kvstore_opts={"address": server.address},
+                ),
+                node_name=node,
+            )
+
+        da = mk("node-a")
+        db = mk("node-b")
+        try:
+            ep = da.endpoint_create(
+                11, ipv4="10.50.0.11", labels=["k8s:app=web"]
+            )
+            id_a = ep.security_identity.id
+            # B allocating the same labels converges on the same id
+            from cilium_tpu.labels import Labels
+
+            ident_b, _ = db.identity_allocator.allocate(
+                Labels.from_model(["k8s:app=web"])
+            )
+            assert ident_b.id == id_a
+            # B's identity cache learns A's allocation via watch
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if db.identity_allocator.lookup_by_id(id_a) is not None:
+                    break
+                time.sleep(0.05)
+            assert db.identity_allocator.lookup_by_id(id_a) is not None
+            # ipcache converges A -> B
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if db.ipcache.lookup_by_ip("10.50.0.11") == id_a:
+                    break
+                time.sleep(0.05)
+            assert db.ipcache.lookup_by_ip("10.50.0.11") == id_a
+            # and B -> A
+            db.endpoint_create(22, ipv4="10.50.0.22", labels=["k8s:app=db"])
+            id_b = db.endpoint_manager.lookup(22).security_identity.id
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if da.ipcache.lookup_by_ip("10.50.0.22") == id_b:
+                    break
+                time.sleep(0.05)
+            assert da.ipcache.lookup_by_ip("10.50.0.22") == id_b
+
+            # node A dies: its leased ipcache entry disappears on B
+            da.close()
+            da = None
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if db.ipcache.lookup_by_ip("10.50.0.11") is None:
+                    break
+                time.sleep(0.05)
+            assert db.ipcache.lookup_by_ip("10.50.0.11") is None
+        finally:
+            if da is not None:
+                da.close()
+            db.close()
