@@ -52,31 +52,95 @@ class MonitorEvent:
         )
 
 
+class _ListenerQueue:
+    """Per-listener bounded queue + delivery thread: a slow or blocking
+    listener loses ITS OWN events (counted) instead of stalling the
+    publishing thread (reference: the per-CPU perf rings feeding each
+    consumer independently, pkg/bpf/perf.go:341, and listener queues in
+    monitor/listener1_2.go)."""
+
+    def __init__(self, callback, maxlen: int) -> None:
+        from collections import deque
+
+        self.callback = callback
+        self.lost = 0
+        self._q: deque = deque()
+        self.maxlen = maxlen
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="monitor-listener"
+        )
+        self._thread.start()
+
+    def put(self, event: "MonitorEvent") -> None:
+        with self._cond:
+            if len(self._q) >= self.maxlen:
+                self._q.popleft()
+                self.lost += 1
+            self._q.append(event)
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._stopped:
+                    self._cond.wait(timeout=0.5)
+                if self._stopped and not self._q:
+                    return
+                event = self._q.popleft()
+            try:
+                self.callback(event)
+            except Exception:  # noqa: BLE001 — a bad listener never
+                pass  # stalls the stream
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify()
+
+
 class Monitor:
-    """Bounded ring + listener fan-out (reference: monitor/monitor.go).
+    """Bounded ring + per-listener queued fan-out
+    (reference: monitor/monitor.go).
 
     Lost events are counted, not blocked on — the perf-ring overflow
-    behavior (monitor.go lost-event accounting).
+    behavior (monitor.go lost-event accounting); each listener has its
+    own bounded queue so backpressure is per-consumer.
     """
 
     def __init__(self, queue_size: int = defaults.MONITOR_QUEUE_SIZE) -> None:
         self.queue_size = queue_size
         self._ring: list[MonitorEvent] = []
-        self._listeners: list[Callable[[MonitorEvent], None]] = []
+        # (callback, queue-or-None) pairs; removal is by == so bound
+        # methods (a fresh object per attribute access) still match.
+        self._listeners: list = []
         self._mutex = threading.RLock()
         self.events_seen = 0
         self.events_lost = 0
 
-    def add_listener(self, listener: Callable[[MonitorEvent], None]) -> None:
+    def add_listener(self, listener: Callable[[MonitorEvent], None],
+                     queued: bool = True) -> None:
+        """``queued=False`` delivers synchronously on the publishing
+        thread — for listeners that are already non-blocking (e.g. a
+        put_nowait fan-out with its own per-subscriber queues)."""
+        lq = _ListenerQueue(listener, self.queue_size) if queued else None
         with self._mutex:
-            self._listeners.append(listener)
+            self._listeners.append((listener, lq))
 
     def remove_listener(self, listener) -> None:
         with self._mutex:
-            try:
-                self._listeners.remove(listener)
-            except ValueError:
-                pass
+            lq = None
+            for i, (cb, q) in enumerate(self._listeners):
+                if cb == listener:
+                    del self._listeners[i]
+                    lq = q
+                    break
+            if lq is not None:
+                # Keep the cumulative loss counter monotonic.
+                self.events_lost += lq.lost
+        if lq is not None:
+            lq.stop()
 
     def notify(self, event: MonitorEvent) -> None:
         with self._mutex:
@@ -87,11 +151,14 @@ class Monitor:
                 self._ring = self._ring[overflow:]
                 self.events_lost += overflow
             listeners = list(self._listeners)
-        for l in listeners:
-            try:
-                l(event)
-            except Exception:  # noqa: BLE001 — a bad listener never stalls
-                pass  # the stream
+        for cb, lq in listeners:
+            if lq is not None:
+                lq.put(event)
+            else:
+                try:
+                    cb(event)
+                except Exception:  # noqa: BLE001 — a bad listener never
+                    pass  # stalls the stream
 
     # Convenience emitters -------------------------------------------------
 
@@ -131,7 +198,8 @@ class Monitor:
         with self._mutex:
             return {
                 "seen": self.events_seen,
-                "lost": self.events_lost,
+                "lost": self.events_lost
+                + sum(lq.lost for _, lq in self._listeners if lq is not None),
                 "listeners": len(self._listeners),
                 "queued": len(self._ring),
             }

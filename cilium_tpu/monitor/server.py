@@ -43,7 +43,10 @@ class MonitorServer:
         self._subs: list[_Subscriber] = []
         self._mutex = threading.Lock()
         self._stop = threading.Event()
-        monitor.add_listener(self._fan_out)
+        # Direct (unqueued) delivery: _fan_out only put_nowaits into
+        # per-subscriber bounded queues, so the per-listener queue layer
+        # would just double-buffer and hide subscriber loss accounting.
+        monitor.add_listener(self._fan_out, queued=False)
         threading.Thread(
             target=self._accept_loop, name="monitor-server", daemon=True
         ).start()

@@ -46,6 +46,11 @@ class EngineFlow:
     ops: list[tuple[OpType, int]] = field(default_factory=list)
     reply_inject: bytearray = field(default_factory=bytearray)
     inject_capacity: int = 4096
+    # Set when the engine decides the connection must die (unparseable
+    # framing); every subsequent byte drops without re-parsing
+    # (reference: the kafka proxy closes the connection on parse errors,
+    # pkg/proxy/kafka.go handleRequest error path).
+    closed: bool = False
 
 
 class BaseBatchEngine:
@@ -106,9 +111,36 @@ class HttpBatchEngine(BaseBatchEngine):
     """HTTP request-head framing + device verdicts + 403 injection
     (reference: envoy/cilium_l7policy.cc request path)."""
 
+    # Fixed width/row buckets: padded shapes are drawn from these sets
+    # so XLA compiles each (width, rows) pair once — one oversized head
+    # must not widen (and recompile) the whole batch.
+    MIN_WIDTH = 512
+    MAX_WIDTH = 1 << 15  # heads beyond this are judged as DENY (absurd)
+    MIN_ROWS = 64
+
     def __init__(self, model, **kw):
         super().__init__(**kw)
         self.model = model
+
+    def _width_bucket(self, head_len: int) -> int:
+        w = self.MIN_WIDTH
+        while w < head_len:
+            w *= 2
+        return w
+
+    def prewarm(self, widths: tuple[int, ...] = (512, 1024)) -> None:
+        """Compile the model for the common bucket shapes up front so
+        first requests never pay a compile."""
+        if isinstance(self.model, ConstVerdict):
+            return
+        for w in widths:
+            out = http_verdicts(
+                self.model,
+                np.zeros((self.MIN_ROWS, w), np.uint8),
+                np.zeros((self.MIN_ROWS,), np.int32),
+                np.zeros((self.MIN_ROWS,), np.int32),
+            )
+            np.asarray(out[-1])
 
     def _head_and_body_len(self, buf: bytes) -> tuple[int, int] | None:
         end = buf.find(b"\r\n\r\n")
@@ -144,21 +176,34 @@ class HttpBatchEngine(BaseBatchEngine):
                 self._emit_http(st, bool(self.model.allow), head_len, body_len)
             return True
 
-        width = 1 << max(9, max(h for _, h, _ in active).bit_length())
-        f_pad = 1 << max(0, (len(active) - 1).bit_length())
-        data = np.zeros((f_pad, width), np.uint8)
-        lengths = np.zeros((f_pad,), np.int32)
-        remotes = np.zeros((f_pad,), np.int32)
-        for i, (st, head_len, _) in enumerate(active):
-            data[i, :head_len] = np.frombuffer(
-                bytes(st.buffer[:head_len]), np.uint8
-            )
-            lengths[i] = head_len
-            remotes[i] = st.remote_id
-        _, _, allow = http_verdicts(self.model, data, lengths, remotes)
-        allow = np.asarray(allow)
-        for i, (st, head_len, body_len) in enumerate(active):
-            self._emit_http(st, bool(allow[i]), head_len, body_len)
+        # Group flows into per-width buckets so one oversized head does
+        # not force a wide (and freshly compiled) scan for everyone.
+        buckets: dict[int, list[tuple[EngineFlow, int, int]]] = {}
+        for st, head_len, body_len in active:
+            if head_len > self.MAX_WIDTH:
+                # Pathological request head: deny without a device pass.
+                self._emit_http(st, False, head_len, body_len)
+                continue
+            buckets.setdefault(
+                self._width_bucket(head_len), []
+            ).append((st, head_len, body_len))
+        for width, group in sorted(buckets.items()):
+            f_pad = self.MIN_ROWS
+            while f_pad < len(group):
+                f_pad *= 2
+            data = np.zeros((f_pad, width), np.uint8)
+            lengths = np.zeros((f_pad,), np.int32)
+            remotes = np.zeros((f_pad,), np.int32)
+            for i, (st, head_len, _) in enumerate(group):
+                data[i, :head_len] = np.frombuffer(
+                    bytes(st.buffer[:head_len]), np.uint8
+                )
+                lengths[i] = head_len
+                remotes[i] = st.remote_id
+            _, _, allow = http_verdicts(self.model, data, lengths, remotes)
+            allow = np.asarray(allow)
+            for i, (st, head_len, body_len) in enumerate(group):
+                self._emit_http(st, bool(allow[i]), head_len, body_len)
         return True
 
     def _emit_http(self, st: EngineFlow, allow: bool, head_len: int,
@@ -196,12 +241,21 @@ class KafkaBatchEngine(BaseBatchEngine):
     def _step(self) -> bool:
         active = []
         for st in self.flows.values():
+            if st.closed:
+                # Connection condemned by an earlier framing error: every
+                # byte drops unparsed until the datapath tears it down.
+                if st.buffer:
+                    self._emit(st, False, len(st.buffer))
+                continue
             buf = bytes(st.buffer)
             try:
                 n = frame_length(buf)
             except KafkaParseError:
-                # Unparseable framing: drop the buffer (reference: kafka
-                # proxy closes the connection on parse errors).
+                # Unparseable framing: drop the buffer AND condemn the
+                # connection (reference: the kafka proxy closes the
+                # connection on parse errors, kafka.go handleRequest) —
+                # subsequent bytes are misframed garbage.
+                st.closed = True
                 self._emit(st, False, len(buf))
                 continue
             if n is None or len(buf) < n:

@@ -1,0 +1,222 @@
+"""In-process HTTP/Kafka batch engines: framing, verdicts, injection,
+width bucketing, framing-error connection close, >MAX_TOPICS overflow
+fallback, and >MAX_REMOTES selector chunking — plus the monitor's
+per-listener bounded queues (reference: pkg/proxy/kafka.go,
+envoy/cilium_l7policy.cc, pkg/bpf/perf.go per-CPU rings)."""
+
+import struct
+import time
+
+import numpy as np
+
+from cilium_tpu.kafka import matches_rule
+from cilium_tpu.models.base import MAX_REMOTES
+from cilium_tpu.models.builder import build_model_for_filter
+from cilium_tpu.models.http import build_http_model
+from cilium_tpu.models.kafka import MAX_TOPICS, build_kafka_model
+from cilium_tpu.monitor.monitor import Monitor, MonitorEvent, MSG_TYPE_AGENT
+from cilium_tpu.policy.api import PortRuleHTTP, PortRuleKafka
+from cilium_tpu.proxylib.types import DROP, MORE, PASS
+from cilium_tpu.runtime.engines import HTTP_403, HttpBatchEngine, KafkaBatchEngine
+
+from test_kafka import produce_request, rule as krule  # shared frame builders
+
+
+def http_model(rules=None):
+    rules = rules or [PortRuleHTTP(method="GET", path="/public/.*")]
+    for r in rules:
+        r.sanitize()
+    return build_http_model([(frozenset(), r) for r in rules])
+
+
+# --- HTTP engine ----------------------------------------------------------
+
+def test_http_engine_allow_deny_inject():
+    eng = HttpBatchEngine(http_model())
+    eng.feed(1, b"GET /public/a HTTP/1.1\r\n\r\n", remote_id=1)
+    eng.feed(2, b"POST /public/a HTTP/1.1\r\n\r\n", remote_id=1)
+    eng.pump()
+    ops1, inj1 = eng.take_ops(1)
+    assert ops1 == [(PASS, 26)] and inj1 == b""
+    ops2, inj2 = eng.take_ops(2)
+    assert ops2 == [(DROP, 27)] and inj2 == HTTP_403
+
+
+def test_http_engine_body_rides_verdict():
+    eng = HttpBatchEngine(http_model())
+    head = b"GET /public/a HTTP/1.1\r\nContent-Length: 5\r\n\r\n"
+    eng.feed(1, head + b"hel", remote_id=1)
+    eng.pump()
+    ops, _ = eng.take_ops(1)
+    assert ops == [(MORE, 1)]  # waiting for the full body
+    eng.feed(1, b"lo")
+    eng.pump()
+    ops, _ = eng.take_ops(1)
+    assert ops == [(PASS, len(head) + 5)]
+
+
+def test_http_engine_width_buckets():
+    """A huge head must not widen (or re-shape) the small heads' batch:
+    both verdict sets stay correct and the shapes used are bucketed."""
+    eng = HttpBatchEngine(http_model())
+    big_path = "/public/" + "x" * 2000
+    eng.feed(1, b"GET /public/a HTTP/1.1\r\n\r\n", remote_id=1)
+    eng.feed(2, f"GET {big_path} HTTP/1.1\r\n\r\n".encode(), remote_id=1)
+    eng.feed(3, b"GET /secret HTTP/1.1\r\n\r\n", remote_id=1)
+    eng.pump()
+    assert eng.take_ops(1)[0][0][0] == PASS
+    assert eng.take_ops(2)[0][0][0] == PASS  # matched in its own bucket
+    assert eng.take_ops(3)[0][0][0] == DROP
+
+
+def test_http_engine_absurd_head_denied():
+    eng = HttpBatchEngine(http_model())
+    monster = b"GET /public/" + b"y" * (eng.MAX_WIDTH + 100) + b" HTTP/1.1\r\n\r\n"
+    eng.feed(1, monster, remote_id=1)
+    eng.pump()
+    ops, inj = eng.take_ops(1)
+    assert ops == [(DROP, len(monster))] and inj == HTTP_403
+
+
+def test_http_engine_prewarm():
+    eng = HttpBatchEngine(http_model())
+    eng.prewarm()  # compiles; then a real request reuses the cache
+    eng.feed(1, b"GET /public/a HTTP/1.1\r\n\r\n", remote_id=1)
+    eng.pump()
+    assert eng.take_ops(1)[0][0][0] == PASS
+
+
+# --- Kafka engine ---------------------------------------------------------
+
+def kafka_engine(rules=None, host_rows=None):
+    rules = rules or [krule(topic="allowed", role="produce")]
+    rows = [(frozenset(), r) for r in rules]
+    return KafkaBatchEngine(
+        build_kafka_model(rows), host_rows=host_rows or rows
+    )
+
+
+def test_kafka_engine_allow_deny():
+    eng = kafka_engine()
+    f1 = produce_request(["allowed"])
+    f2 = produce_request(["secret"])
+    eng.feed(1, f1, remote_id=1)
+    eng.feed(2, f2, remote_id=1)
+    eng.pump()
+    ops1, inj1 = eng.take_ops(1)
+    assert ops1 == [(PASS, len(f1))] and inj1 == b""
+    ops2, inj2 = eng.take_ops(2)
+    assert ops2 == [(DROP, len(f2))] and inj2  # error response injected
+
+
+def test_kafka_engine_framing_error_closes_connection():
+    """A negative frame length condemns the flow: the buffer drops and
+    every SUBSEQUENT byte drops unparsed (reference: kafka proxy closes
+    the connection on parse errors)."""
+    eng = kafka_engine()
+    bad = struct.pack(">i", -5) + b"garbage"
+    eng.feed(1, bad, remote_id=1)
+    eng.pump()
+    ops, _ = eng.take_ops(1)
+    assert ops == [(DROP, len(bad))]
+    assert eng.flows[1].closed
+    # a perfectly valid frame after the error still drops: the stream
+    # is misframed garbage from the datapath's point of view
+    good = produce_request(["allowed"])
+    eng.feed(1, good)
+    eng.pump()
+    ops, _ = eng.take_ops(1)
+    assert ops == [(DROP, len(good))]
+
+
+def test_kafka_engine_topic_overflow_host_fallback():
+    """Requests exceeding MAX_TOPICS are refused by the device and must
+    get the exact host-oracle verdict instead of a blanket deny."""
+    rules = [krule(topic=f"t{i}", role="produce") for i in range(12)]
+    eng = kafka_engine(rules=rules)
+    many_allowed = [f"t{i}" for i in range(MAX_TOPICS + 2)]
+    f_ok = produce_request(many_allowed)
+    f_bad = produce_request(many_allowed[:-1] + ["secret"])
+    eng.feed(1, f_ok, remote_id=1)
+    eng.feed(2, f_bad, remote_id=1)
+    eng.pump()
+    assert eng.take_ops(1)[0] == [(PASS, len(f_ok))]
+    assert eng.take_ops(2)[0] == [(DROP, len(f_bad))]
+
+
+def test_kafka_engine_remote_chunking_past_32():
+    """A selector matching more than MAX_REMOTES identities chunks into
+    several model rows; identity #40 (in the second chunk) must still be
+    allowed end-to-end through the engine."""
+    from cilium_tpu.labels import Labels
+    from cilium_tpu.policy.api import EndpointSelector, L7Rules
+    from cilium_tpu.policy.l4 import L4Filter, L7DataMap, PARSER_TYPE_KAFKA
+
+    n_ids = MAX_REMOTES + 8
+    identity_cache = {
+        1000 + i: Labels.from_model([f"k8s:app=web"]) for i in range(n_ids)
+    }
+    sel = EndpointSelector.from_dict({"k8s:app": "web"})
+    l7 = L7Rules(kafka=[krule(topic="allowed", role="produce")])
+    dm = L7DataMap()
+    dm[sel] = l7
+    f = L4Filter(
+        port=9092, protocol="TCP", l7_parser=PARSER_TYPE_KAFKA,
+        l7_rules_per_ep=dm,
+    )
+    model = build_model_for_filter(f, identity_cache)
+    # rows chunked: more than one rule row for the one selector
+    assert model.version.shape[0] >= 2
+    eng = KafkaBatchEngine(model)
+    frame = produce_request(["allowed"])
+    last_id = 1000 + n_ids - 1  # lives in the second chunk
+    eng.feed(1, frame, remote_id=last_id)
+    eng.feed(2, frame, remote_id=4242)  # unknown identity -> deny
+    eng.pump()
+    assert eng.take_ops(1)[0] == [(PASS, len(frame))]
+    assert eng.take_ops(2)[0] == [(DROP, len(frame))]
+
+
+# --- monitor fan-out ------------------------------------------------------
+
+def test_monitor_slow_listener_does_not_stall_publisher():
+    mon = Monitor(queue_size=8)
+    seen = []
+
+    def slow(ev):
+        time.sleep(0.05)
+        seen.append(ev)
+
+    mon.add_listener(slow)
+    t0 = time.perf_counter()
+    for i in range(20):
+        mon.notify(MonitorEvent(MSG_TYPE_AGENT, {"i": i}))
+    publish_time = time.perf_counter() - t0
+    # publishing 20 events must not serialize behind the 50ms callback
+    assert publish_time < 0.5, publish_time
+    time.sleep(1.2)
+    status = mon.status()
+    # the slow listener lost some events to its bounded queue, counted
+    assert status["seen"] == 20
+    assert len(seen) + status["lost"] >= 20
+    assert status["lost"] > 0  # queue of 8 overflowed
+    mon.remove_listener(slow)
+
+
+def test_monitor_fast_listener_gets_everything():
+    mon = Monitor(queue_size=64)
+    got = []
+    mon.add_listener(got.append)
+    for i in range(30):
+        mon.notify(MonitorEvent(MSG_TYPE_AGENT, {"i": i}))
+    deadline = time.monotonic() + 2
+    while len(got) < 30 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(got) == 30
+    assert [e.payload["i"] for e in got] == list(range(30))  # in order
+    # bound-method removal must actually remove (== matching, not id)
+    mon.remove_listener(got.append)
+    assert mon.status()["listeners"] == 0
+    mon.notify(MonitorEvent(MSG_TYPE_AGENT, {"i": 99}))
+    time.sleep(0.1)
+    assert len(got) == 30  # nothing delivered after removal
