@@ -120,6 +120,14 @@ class ApiServer:
             return 200, d.status()
         if path == "/metrics" and method == "GET":
             return 200, d.metrics_text()
+        if path == "/v1/monitor/recent" and method == "GET":
+            return 200, [e.to_dict() for e in d.monitor.recent(200)]
+        if path == "/v1/health" and method == "GET":
+            from ..health import Prober
+
+            # a fresh Prober's status IS the empty shape — no drift
+            prober = d.health_prober if d.health_prober is not None else Prober()
+            return 200, prober.get_status()
 
         if path == "/v1/config":
             if method == "GET":

@@ -1,0 +1,65 @@
+"""bugtool: one-shot support-bundle collector.
+
+reference: bugtool/cmd/root.go:159 — archives the agent's observable
+state (CLI dumps, BPF map dumps, system state, logs) into a tar for
+support triage.  Here every dump comes over the agent's REST API so the
+tool works exactly like an operator's CLI would; unreachable sections
+are recorded as errors instead of aborting the bundle (the reference
+likewise continues past failing commands).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+import time
+
+# Route table: archive member name -> REST route.
+SECTIONS = [
+    ("status.json", "/v1/status"),
+    ("config.json", "/v1/config"),
+    ("policy.json", "/v1/policy"),
+    ("endpoints.json", "/v1/endpoint"),
+    ("identities.json", "/v1/identity"),
+    ("ipcache.json", "/v1/ipcache"),
+    ("maps.json", "/v1/map"),
+    ("prefilter.json", "/v1/prefilter"),
+    ("metrics.txt", "/metrics"),
+    ("monitor-tail.json", "/v1/monitor/recent"),
+    ("health.json", "/v1/health"),
+]
+
+
+def collect(client, out_path: str) -> dict:
+    """Collect every section through ``client`` (ApiClient) into a
+    gzipped tar at ``out_path``; returns a summary manifest."""
+    manifest = {
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "sections": {},
+    }
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name, route in SECTIONS:
+            try:
+                data = client.get(route)
+                if isinstance(data, (dict, list)):
+                    blob = json.dumps(data, indent=2, default=str).encode()
+                else:
+                    blob = str(data).encode()
+                manifest["sections"][name] = {"ok": True, "bytes": len(blob)}
+            except Exception as e:  # noqa: BLE001 — best-effort bundle
+                blob = f"ERROR collecting {route}: {e}\n".encode()
+                manifest["sections"][name] = {"ok": False, "error": str(e)}
+            _add_member(tar, name, blob)
+        _add_member(
+            tar, "MANIFEST.json",
+            json.dumps(manifest, indent=2).encode(),
+        )
+    return manifest
+
+
+def _add_member(tar: tarfile.TarFile, name: str, blob: bytes) -> None:
+    info = tarfile.TarInfo(name=f"cilium-tpu-bugtool/{name}")
+    info.size = len(blob)
+    info.mtime = int(time.time())
+    tar.addfile(info, io.BytesIO(blob))

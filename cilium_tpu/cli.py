@@ -211,6 +211,23 @@ def cmd_monitor(args):
         client.close()
 
 
+def cmd_health(args):
+    """reference: cilium-health status."""
+    _print(_client(args).get("/v1/health"), args.json)
+    return 0
+
+
+def cmd_bugtool(args):
+    """reference: bugtool/cmd/root.go:159 — support bundle."""
+    from .bugtool import collect
+
+    manifest = collect(_client(args), args.output)
+    failed = [k for k, v in manifest["sections"].items() if not v["ok"]]
+    print(f"wrote {args.output} ({len(manifest['sections'])} sections"
+          + (f", {len(failed)} failed: {failed}" if failed else "") + ")")
+    return 1 if failed else 0
+
+
 def cmd_version(args):
     print(f"cilium-tpu {VERSION}")
     return 0
@@ -313,6 +330,13 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("monitor", help="live event stream")
     x.add_argument("--monitor-socket", default=defaults.MONITOR_SOCK_PATH)
     x.set_defaults(fn=cmd_monitor)
+
+    x = sub.add_parser("health", help="node connectivity status")
+    x.set_defaults(fn=cmd_health)
+
+    x = sub.add_parser("bugtool", help="collect a support bundle")
+    x.add_argument("-o", "--output", default="cilium-tpu-bugtool.tar.gz")
+    x.set_defaults(fn=cmd_bugtool)
 
     x = sub.add_parser("version")
     x.set_defaults(fn=cmd_version)

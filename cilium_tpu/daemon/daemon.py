@@ -149,6 +149,27 @@ class Daemon:
             ),
         )
 
+        # cilium-health: per-node responder + cluster prober
+        # (reference: daemon/main.go:926-968 health endpoint launch)
+        self.health_responder = None
+        self.health_prober = None
+        if self.config.enable_health:
+            from ..health import HealthResponder, Prober
+
+            self.health_responder = HealthResponder()
+            self.health_prober = Prober(
+                node_name=node_name, controllers=self.controllers
+            )
+            self.health_prober.add_node(
+                node_name, self.health_responder.address
+            )
+            self.health_prober.start()
+
+        # DNS poller slot for toFQDNs rules (started on demand with a
+        # resolver via start_dns_poller; reference: daemon.go:1334
+        # fqdn.StartDNSPoller)
+        self.dns_poller = None
+
         # Controllers (reference: pkg/controller usage across the daemon)
         self.controllers.update_controller(
             "metrics-sync",
@@ -472,12 +493,29 @@ class Daemon:
 
     # -- shutdown ----------------------------------------------------------
 
+    def start_dns_poller(self, resolver, interval: float | None = None):
+        """Start the ToFQDNs DNS poller with the given resolver
+        (reference: fqdn.StartDNSPoller from daemon bootstrap)."""
+        from ..fqdn import DnsPoller
+
+        kwargs = {} if interval is None else {"interval": interval}
+        self.dns_poller = DnsPoller(
+            self.policy,
+            resolver,
+            on_change=self.trigger_policy_updates,
+            controllers=self.controllers,
+            **kwargs,
+        ).start()
+        return self.dns_poller
+
     def close(self) -> None:
         self.policy_trigger.shutdown()
         self.build_queue.stop()
         self.controllers.remove_all()
         self.ipcache_sync.stop()
         self.identity_allocator.close()
+        if self.health_responder is not None:
+            self.health_responder.close()
         self.kvstore.close()
 
 

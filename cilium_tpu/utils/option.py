@@ -137,9 +137,10 @@ class DaemonConfig:
     # Modes
     dry_mode: bool = False  # reference: DryMode, pkg/endpoint/bpf.go:510
     restore_state: bool = True
+    enable_health: bool = True  # reference: cilium-health launch
 
     # kvstore
-    kvstore: str = "local"  # local | etcd
+    kvstore: str = "local"  # local | file | tcp
     kvstore_opts: dict = field(default_factory=dict)
 
     # Monitor

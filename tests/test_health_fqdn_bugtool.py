@@ -1,0 +1,244 @@
+"""cilium-health prober, fqdn DNS->CIDR generation, and the bugtool
+support bundle (reference: pkg/health/server/prober.go:40, pkg/fqdn,
+bugtool/cmd/root.go:159)."""
+
+import json
+import tarfile
+import time
+
+import pytest
+
+from cilium_tpu.fqdn import DnsCache, DnsPoller
+from cilium_tpu.health import HealthResponder, Prober
+from cilium_tpu.policy.api import EgressRule, EndpointSelector, FQDNSelector, Rule
+from cilium_tpu.policy.repository import Repository
+
+
+# --- health ----------------------------------------------------------------
+
+def test_prober_healthy_and_degraded_nodes():
+    """Two live nodes + one dead address: the prober reports exactly the
+    dead one degraded, with latency recorded for the live ones."""
+    r1, r2 = HealthResponder(), HealthResponder()
+    p = Prober(node_name="n0")
+    try:
+        p.add_node("n1", r1.address)
+        p.add_node("n2", r2.address)
+        p.add_node("n3", "127.0.0.1:1")  # closed port
+        p.probe_all()
+        st = p.get_status()
+        assert st["probed_nodes"] == 3
+        assert st["degraded"] == ["n3"]
+        assert st["healthy"] == 2
+        assert st["nodes"]["n1"]["reachable"]
+        assert st["nodes"]["n1"]["latency_ms"] > 0
+        assert st["nodes"]["n3"]["failures"] == 1
+        # a node coming back after death recovers
+        p.probe_all()
+        assert p.get_status()["nodes"]["n3"]["failures"] == 2
+    finally:
+        r1.close()
+        r2.close()
+        p.close()
+
+
+def test_prober_detects_node_death():
+    r = HealthResponder()
+    p = Prober()
+    try:
+        p.add_node("n1", r.address)
+        p.probe_all()
+        assert p.get_status()["degraded"] == []
+        r.close()
+        p.probe_all()
+        st = p.get_status()
+        assert st["degraded"] == ["n1"]
+        assert st["nodes"]["n1"]["failures"] >= 1
+    finally:
+        p.close()
+
+
+def test_daemon_wires_health(tmp_path):
+    from cilium_tpu.daemon.daemon import Daemon
+    from cilium_tpu.utils.option import DaemonConfig
+
+    d = Daemon(DaemonConfig(state_dir=str(tmp_path), dry_mode=True))
+    try:
+        assert d.health_prober is not None
+        d.health_prober.probe_all()
+        st = d.health_prober.get_status()
+        assert st["probed_nodes"] == 1 and st["degraded"] == []
+    finally:
+        d.close()
+
+
+# --- fqdn ------------------------------------------------------------------
+
+def _fqdn_rule(name="svc.example.com"):
+    f = FQDNSelector(match_name=name)
+    f.sanitize()
+    r = Rule(
+        endpoint_selector=EndpointSelector.from_dict({"app": "client"}),
+        egress=[EgressRule(to_fqdns=[f])],
+    )
+    r.sanitize()
+    return r
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_dns_cache_ttl():
+    clock = FakeClock()
+    c = DnsCache(clock=clock)
+    c.update("a.com", ["1.1.1.1"], ttl=10)
+    assert c.lookup("a.com") == ("1.1.1.1",)
+    clock.t += 11
+    assert c.lookup("a.com") == ()
+    assert c.expired("a.com")
+
+
+def test_poller_generates_and_refreshes_cidrs():
+    repo = Repository()
+    repo.add(_fqdn_rule())
+    clock = FakeClock()
+    answers = {"svc.example.com": (["10.1.1.1", "10.1.1.2"], 30.0)}
+    changes = []
+    poller = DnsPoller(
+        repo, lambda name: answers[name],
+        on_change=lambda: changes.append(1), clock=clock,
+    )
+    poller.lookup_update_dns()
+    cidrs = {c.cidr for c in repo.rules[0].egress[0].to_cidr_set}
+    assert cidrs == {"10.1.1.1/32", "10.1.1.2/32"}
+    assert all(c.generated for c in repo.rules[0].egress[0].to_cidr_set)
+    assert changes == [1]
+    rev = repo.revision
+
+    # within TTL: no re-resolution, no change
+    poller.lookup_update_dns()
+    assert changes == [1] and repo.revision == rev
+
+    # TTL lapses and the answer set changes -> regenerated + notified
+    clock.t += 31
+    answers["svc.example.com"] = (["10.9.9.9"], 30.0)
+    poller.lookup_update_dns()
+    cidrs = {c.cidr for c in repo.rules[0].egress[0].to_cidr_set}
+    assert cidrs == {"10.9.9.9/32"}
+    assert changes == [1, 1] and repo.revision > rev
+
+
+def test_poller_detects_shrink_to_empty_and_skips_no_op_refresh():
+    """Change detection compares against the last known (possibly
+    expired) answer: a name whose records disappear must drop its
+    generated CIDRs, and an unchanged answer re-resolved after TTL
+    expiry must NOT trigger a spurious regeneration."""
+    repo = Repository()
+    repo.add(_fqdn_rule())
+    clock = FakeClock()
+    answers = {"svc.example.com": (["10.5.5.5"], 30.0)}
+    changes = []
+    poller = DnsPoller(
+        repo, lambda name: answers[name],
+        on_change=lambda: changes.append(1), clock=clock,
+    )
+    poller.lookup_update_dns()
+    assert changes == [1]
+    rev = repo.revision
+
+    # same answer after expiry: re-resolved, but no change event
+    clock.t += 31
+    poller.lookup_update_dns()
+    assert changes == [1] and repo.revision == rev
+
+    # records removed after expiry: generated CIDRs must go away
+    clock.t += 31
+    answers["svc.example.com"] = ([], 30.0)
+    poller.lookup_update_dns()
+    assert changes == [1, 1] and repo.revision > rev
+    assert repo.rules[0].egress[0].to_cidr_set == []
+
+
+def test_poller_survives_resolver_failure():
+    """A failing resolver keeps serving the last good answer (the
+    reference keeps cached IPs until a successful re-resolution)."""
+    repo = Repository()
+    repo.add(_fqdn_rule())
+    clock = FakeClock()
+    state = {"fail": False}
+
+    def resolver(name):
+        if state["fail"]:
+            raise OSError("dns down")
+        return ["10.2.2.2"], 5.0
+
+    poller = DnsPoller(repo, resolver, clock=clock)
+    poller.lookup_update_dns()
+    cidrs = {c.cidr for c in repo.rules[0].egress[0].to_cidr_set}
+    assert cidrs == {"10.2.2.2/32"}
+    # resolver failure after expiry: the generated entry survives
+    clock.t += 6
+    state["fail"] = True
+    poller.lookup_update_dns()
+    cidrs = {c.cidr for c in repo.rules[0].egress[0].to_cidr_set}
+    assert cidrs == {"10.2.2.2/32"}
+
+
+def test_daemon_dns_poller_triggers_regeneration(tmp_path):
+    from cilium_tpu.daemon.daemon import Daemon
+    from cilium_tpu.utils.option import DaemonConfig
+
+    d = Daemon(DaemonConfig(state_dir=str(tmp_path), dry_mode=True))
+    try:
+        d.policy_add([_fqdn_rule()])
+        answers = {"svc.example.com": (["10.3.3.3"], 1.0)}
+        poller = d.start_dns_poller(lambda n: answers[n], interval=3600)
+        poller.lookup_update_dns()
+        with d.policy.mutex:
+            cidrs = {
+                c.cidr for r in d.policy.rules
+                for e in r.egress for c in e.to_cidr_set
+            }
+        assert cidrs == {"10.3.3.3/32"}
+    finally:
+        d.close()
+
+
+# --- bugtool ---------------------------------------------------------------
+
+def test_bugtool_bundle(tmp_path):
+    """One command produces a tar with every section (reference:
+    bugtool support bundle)."""
+    from cilium_tpu.api.server import ApiClient, ApiServer
+    from cilium_tpu.bugtool import SECTIONS, collect
+    from cilium_tpu.daemon.daemon import Daemon
+    from cilium_tpu.utils.option import DaemonConfig
+
+    sock = str(tmp_path / "api.sock")
+    d = Daemon(DaemonConfig(state_dir=str(tmp_path / "s"), dry_mode=True))
+    srv = ApiServer(d, sock)
+    try:
+        d.endpoint_create(3, ipv4="10.44.0.3", labels=["k8s:app=bt"])
+        out = str(tmp_path / "bundle.tar.gz")
+        manifest = collect(ApiClient(sock), out)
+        assert all(v["ok"] for v in manifest["sections"].values()), manifest
+        with tarfile.open(out) as tar:
+            names = {m.name for m in tar.getmembers()}
+            for section, _ in SECTIONS:
+                assert f"cilium-tpu-bugtool/{section}" in names
+            status = json.load(
+                tar.extractfile("cilium-tpu-bugtool/status.json")
+            )
+            assert "cilium" in str(status).lower() or status
+            eps = json.load(
+                tar.extractfile("cilium-tpu-bugtool/endpoints.json")
+            )
+            assert any(e.get("id") == 3 for e in eps)
+    finally:
+        srv.close()
+        d.close()
