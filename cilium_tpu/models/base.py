@@ -38,6 +38,19 @@ class VerdictModel:
     n_rules: int = 0
 
 
+class SeamProbe(VerdictModel):
+    """Diagnostic model for DaemonConfig.seam_probe: a minimal real
+    device op (all frames complete, all allowed) that keeps the full
+    dispatch -> device call -> readback path alive while removing the
+    verdict-compute term — so latbench can measure what the sidecar
+    seam itself adds.  Matches the (complete, msg_len, allow) batch
+    model contract."""
+
+    def __call__(self, data, lengths, remotes):
+        ok = jnp.asarray(lengths) >= 0
+        return ok, jnp.asarray(lengths), ok
+
+
 def pack_remote_sets(remote_sets: list[frozenset[int]]) -> tuple[np.ndarray, np.ndarray]:
     """Pack per-rule allowed-remote sets into [R, MAX_REMOTES] int32 plus a
     per-rule 'empty set allows any remote' flag (reference:

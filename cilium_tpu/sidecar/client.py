@@ -270,3 +270,14 @@ class SidecarClient:
         payload = wire.pack_data_matrix(seq, width, conn_ids, lengths, rows_bytes)
         with self._wlock:
             wire.send_msg(self.sock, wire.MSG_DATA_MATRIX, payload)
+
+    def send_blob(self, seq: int, conn_ids, lengths, blob: bytes) -> None:
+        """Compact request-direction batch: exact payload bytes only
+        (the service builds the device row view with an on-device
+        gather).  Preferred over send_matrix when the device link is
+        bandwidth-limited — the wire and uplink carry no padding."""
+        payload = wire.pack_data_batch(
+            seq, conn_ids, [0] * len(conn_ids), lengths, blob
+        )
+        with self._wlock:
+            wire.send_msg(self.sock, wire.MSG_DATA_BATCH, payload)

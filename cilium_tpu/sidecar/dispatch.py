@@ -10,6 +10,15 @@ The dispatcher implements the standard fill-vs-deadline tradeoff:
 - when the oldest pending entry has waited ``batch_timeout_ms``
   (deadline), whichever comes first.
 
+``batch_timeout_ms=0`` selects GREEDY mode: the worker takes whatever
+is pending the moment it frees up.  While a round is being processed,
+arrivals coalesce naturally into the next round (self-adaptive batching
+— steady-state round size ≈ arrival rate × round service time), and an
+idle service adds zero queue wait.  This is the right mode when the
+per-round device cost is small and local (co-located chip); the
+deadline mode wins when each round pays a large fixed transport cost
+worth amortizing across more entries.
+
 The deadline timer arms when the first item lands in an empty queue, so
 an idle service adds at most ``batch_timeout_ms`` + one device pass to
 any request.  This is the consumer of ``DaemonConfig.batch_timeout_ms``
@@ -103,6 +112,11 @@ class BatchDispatcher:
                     self._pending_weight = 0
                     return batch, False
                 if self._pending:
+                    if self.timeout_s <= 0:  # greedy mode
+                        batch = self._pending
+                        self._pending = []
+                        self._pending_weight = 0
+                        return batch, False
                     wait = self.timeout_s - (time.perf_counter() - self._oldest_ts)
                     if wait <= 0:
                         batch = self._pending

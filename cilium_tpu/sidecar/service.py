@@ -52,6 +52,18 @@ from .dispatch import BatchDispatcher
 log = logging.getLogger(__name__)
 
 
+def _gather_model(model, blob, offs, lens, remotes, width: int):
+    """On-device row build: gather each entry's bytes from the flat
+    payload blob into the [n, width] layout the batch models consume,
+    masking the padding tail to zero."""
+    import jax.numpy as jnp
+
+    col = jnp.arange(width, dtype=jnp.int32)[None, :]
+    g = jnp.clip(offs[:, None] + col, 0, blob.shape[0] - 1)
+    rows = jnp.where(col < lens[:, None], blob[g], 0)
+    return model(rows, lens, remotes)
+
+
 class _SidecarConn:
     """Service-side state for one datapath connection."""
 
@@ -150,7 +162,26 @@ class VerdictService:
         self._engine_objs: list[object] = []
         self._engine_idx: dict[int, int] = {}  # id(engine) -> table idx
         self._engine_free: list[int] = []
-        self._jit_cache: dict[type, object] = {}
+        # id(model) -> (model, jitted fn); the model reference pins the
+        # id so a gc'd model can never alias a cache entry.
+        self._jit_cache: dict[int, tuple] = {}
+        self._jit_gather: dict[int, tuple] = {}
+        # Dispatch mode: 'eager'/'jit' honored as configured; 'auto' is
+        # resolved by measurement at the first engine prewarm (guarded
+        # by _dispatch_lock: concurrent first binds must not measure
+        # twice or observe a mid-measurement mode flip).
+        self._use_jit = self.config.dispatch_mode == "jit"
+        self._dispatch_resolved = self.config.dispatch_mode != "auto"
+        self._dispatch_lock = threading.Lock()
+        self.dispatch_mode_chosen = (
+            self.config.dispatch_mode
+            if self._dispatch_resolved else None
+        )
+        self._exec_device = None
+        if self.config.verdict_device == "cpu":
+            import jax
+
+            self._exec_device = jax.devices("cpu")[0]
         self.vec_batches = 0
         self.vec_entries = 0
         # Completion pipeline: the dispatcher issues device calls without
@@ -161,6 +192,14 @@ class VerdictService:
         # per-connection op order across vec and entrywise rounds.
         self._completions: "queue.Queue" = queue.Queue()
         self._completion_thread: threading.Thread | None = None
+        self._sends: "queue.Queue" = queue.Queue()
+        self._send_thread: threading.Thread | None = None
+        # Greedy dispatch (batch_timeout_ms == 0) implies a co-located
+        # device whose readback is cheap: complete rounds inline on the
+        # dispatcher thread — one fewer thread handoff per verdict.
+        # ALL sends must then go inline (vec and entrywise) so per-conn
+        # FIFO order is owned by one thread.
+        self._inline_complete = self.config.batch_timeout_ms <= 0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -175,6 +214,10 @@ class VerdictService:
             target=self._completion_loop, name="verdict-complete", daemon=True
         )
         self._completion_thread.start()
+        self._send_thread = threading.Thread(
+            target=self._send_loop, name="verdict-send", daemon=True
+        )
+        self._send_thread.start()
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -191,6 +234,8 @@ class VerdictService:
         if self._completion_thread is not None:
             self._completions.put(("stop",))
             self._completion_thread.join(timeout=5)
+        if self._send_thread is not None:
+            self._send_thread.join(timeout=5)
         try:
             os.unlink(self.socket_path)
         except OSError:
@@ -235,6 +280,10 @@ class VerdictService:
                 k: v for k, v in self._engines.items() if k[0] != module_id
             }
             self._release_engines(dropped)
+            for eng in dropped:
+                mid = id(getattr(eng, "model", None))
+                self._jit_cache.pop(mid, None)
+                self._jit_gather.pop(mid, None)
             affected = [
                 sc for sc in self._conns.values() if sc.conn.instance is ins
             ]
@@ -354,50 +403,57 @@ class VerdictService:
         if eng is None:
             # Build and prewarm OUTSIDE the registry lock: XLA compiles
             # are slow and must not stall unrelated control/data traffic.
-            ins = pl.find_instance(module_id)
-            policy = ins.policy_map().get(conn.policy_name)
-            if proto == "r2d2":
-                from ..models.r2d2 import build_r2d2_model
-
-                model = build_r2d2_model(policy, conn.ingress, conn.port)
-                eng = R2d2BatchEngine(
-                    model,
-                    capacity=self.config.batch_flows,
-                    width=self.config.batch_width,
-                    logger=ins.access_logger,
-                )
-                self.prewarm(eng)
-            else:
-                from ..runtime.l7engine import (
-                    CassandraBatchEngine,
-                    MemcacheBatchEngine,
-                )
-
-                if proto == "cassandra":
-                    from ..models.cassandra import build_cassandra_model
-
-                    model = build_cassandra_model(
-                        policy, conn.ingress, conn.port
-                    )
-                    cls = CassandraBatchEngine
-                else:
-                    from ..models.memcached import build_memcache_model
-
-                    model = build_memcache_model(
-                        policy, conn.ingress, conn.port
-                    )
-                    cls = MemcacheBatchEngine
-                eng = cls(
-                    policy, conn.ingress, conn.port, model,
-                    logger=ins.access_logger,
-                    capacity=self.config.batch_flows,
-                )
+            # Built under the configured verdict device so the model's
+            # tables are colocated with its dispatch.
+            with self._device_ctx():
+                eng = self._build_engine(module_id, conn, proto)
             with self._lock:
                 # Double-checked insert: a racing binder may have won.
                 eng = self._engines.setdefault(key, eng)
         sc.engine = eng
         # Only the r2d2 engine is vectorized-path capable.
         sc.fast_ok = proto == "r2d2"
+
+    def _build_engine(self, module_id: int, conn, proto: str):
+        ins = pl.find_instance(module_id)
+        policy = ins.policy_map().get(conn.policy_name)
+        if proto == "r2d2":
+            from ..models.r2d2 import build_r2d2_model
+
+            if self.config.seam_probe:
+                from ..models.base import SeamProbe
+
+                model = SeamProbe()
+            else:
+                model = build_r2d2_model(policy, conn.ingress, conn.port)
+            eng = R2d2BatchEngine(
+                model,
+                capacity=self.config.batch_flows,
+                width=self.config.batch_width,
+                logger=ins.access_logger,
+            )
+            self.prewarm(eng)
+            return eng
+        from ..runtime.l7engine import (
+            CassandraBatchEngine,
+            MemcacheBatchEngine,
+        )
+
+        if proto == "cassandra":
+            from ..models.cassandra import build_cassandra_model
+
+            model = build_cassandra_model(policy, conn.ingress, conn.port)
+            cls = CassandraBatchEngine
+        else:
+            from ..models.memcached import build_memcache_model
+
+            model = build_memcache_model(policy, conn.ingress, conn.port)
+            cls = MemcacheBatchEngine
+        return cls(
+            policy, conn.ingress, conn.port, model,
+            logger=ins.access_logger,
+            capacity=self.config.batch_flows,
+        )
 
     def close_connection(self, conn_id: int, expect=None) -> None:
         # Routed through the dispatcher by the caller so in-flight data
@@ -485,11 +541,20 @@ class VerdictService:
     def _tab_snapshot(self, data_items: list) -> "_TabSnap | None":
         if not data_items:
             return None
-        ids = np.unique(
-            np.concatenate(
-                [it[2].conn_ids for it in data_items]
-            ).astype(np.int64)
-        )
+        if len(data_items) == 1:
+            one = data_items[0][2].conn_ids.astype(np.int64)
+            # Single-item rounds with already strictly-increasing ids
+            # (the common matrix-batch shape) skip the unique() sort.
+            if len(one) and np.all(one[1:] > one[:-1]):
+                ids = one
+            else:
+                ids = np.unique(one)
+        else:
+            ids = np.unique(
+                np.concatenate(
+                    [it[2].conn_ids for it in data_items]
+                ).astype(np.int64)
+            )
         with self._lock:
             if self._tab_size == 0:
                 return _TabSnap(
@@ -586,20 +651,95 @@ class VerdictService:
             out.append(out[-1] * 2)
         return out
 
-    def _model_call(self, model, data, lens, remotes):
-        """One device dispatch per batch — EAGER on purpose: on this
-        chip's transport, eager op dispatch pipelines asynchronously
-        while jit executable launches serialize a link round trip per
-        call (measured 40x difference; see bench.py _pipelined_rate).
-        On co-located TPU hardware a jitted call would be equal or
-        better — flip here if the transport changes."""
-        return model(data, lens, remotes)
+    def _device_ctx(self):
+        """Context routing model build/dispatch to the configured
+        verdict device ('cpu' removes the device-link term)."""
+        if self._exec_device is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(self._exec_device)
+
+    @staticmethod
+    def _jit_for(cache: dict, model, trace_fn):
+        """id(model)-keyed jit cache; the stored model reference pins
+        the id so a gc'd model can never alias an entry."""
+        ent = cache.get(id(model))
+        if ent is None:
+            import jax
+
+            ent = (model, jax.jit(trace_fn))
+            cache[id(model)] = ent
+        return ent[1]
+
+    def _model_call(self, model, data, lens, remotes, use_jit=None):
+        """One device dispatch per batch.  The mode is a MEASURED
+        config (config.dispatch_mode): 'eager' pipelines per-op async
+        dispatch, 'jit' fuses the model into one launch; 'auto' times
+        both at first prewarm (the service's real pattern: async issue
+        + one batched readback) and keeps the faster.  ``use_jit``
+        overrides the resolved mode (used by the measurement itself so
+        it never mutates shared state mid-flight)."""
+        uj = self._use_jit if use_jit is None else use_jit
+        with self._device_ctx():
+            if uj and not isinstance(model, ConstVerdict):
+                fn = self._jit_for(self._jit_cache, model, model.__call__)
+                return fn(data, lens, remotes)
+            return model(data, lens, remotes)
+
+    def _measure_dispatch_mode(self, engine) -> None:
+        """Resolve dispatch_mode='auto': time the service's ACTUAL
+        per-round pattern — issue N batches without blocking, then ONE
+        batched ``jax.device_get`` — in each mode and keep the faster.
+        (Timing ``block_until_ready`` instead would measure N serial
+        readbacks and mask the dispatch-side difference: on a
+        high-latency link each jit launch blocks ~1 RTT while eager op
+        dispatch streams asynchronously.)"""
+        import time as _time
+
+        import jax
+
+        b = self.MIN_BUCKET
+        width = self.config.batch_width
+        data = np.zeros((b, width), np.uint8)
+        lens = np.zeros(b, np.int32)
+        rem = np.zeros(b, np.int32)
+
+        def burst(uj: bool) -> float:
+            outs = [
+                self._model_call(engine.model, data, lens, rem, use_jit=uj)[-1]
+                for _ in range(8)
+            ]
+            jax.device_get(outs)  # warm (compile / first launch)
+            t0 = _time.perf_counter()
+            outs = [
+                self._model_call(engine.model, data, lens, rem, use_jit=uj)[-1]
+                for _ in range(8)
+            ]
+            jax.device_get(outs)
+            return _time.perf_counter() - t0
+
+        t_eager = burst(False)
+        t_jit = burst(True)
+        self._use_jit = t_jit < t_eager
+        self.dispatch_mode_chosen = "jit" if self._use_jit else "eager"
+        log.info(
+            "dispatch mode auto: eager=%.1fms jit=%.1fms -> %s",
+            t_eager * 1e3, t_jit * 1e3, self.dispatch_mode_chosen,
+        )
 
     def prewarm(self, engine) -> None:
         """Compile the engine model for every bucket shape up front so
         the first real batch never pays a compile."""
         if isinstance(engine.model, ConstVerdict):
             return
+        if not self._dispatch_resolved:
+            with self._dispatch_lock:
+                if not self._dispatch_resolved:
+                    self._measure_dispatch_mode(engine)
+                    self._dispatch_resolved = True
         width = self.config.batch_width
         for b in self._buckets():
             out = self._model_call(
@@ -609,6 +749,21 @@ class VerdictService:
                 np.zeros(b, np.int32),
             )
             np.asarray(out[-1])
+            if not self._inline_complete:
+                # The gather (blob-window) path has its own executable
+                # per flow bucket — warm it so first real traffic never
+                # pays a compile on the high-latency link.  Greedy
+                # (co-located) services skip this: their compiles are
+                # local and cheap, so first-use compiles lazily instead
+                # of doubling every engine build.
+                out = self._gathered_call(
+                    engine.model,
+                    np.zeros(self.BLOB_CHUNK, np.uint8),
+                    np.zeros(b, np.int32),
+                    np.zeros(b, np.int32),
+                    np.zeros(b, np.int32),
+                )
+                np.asarray(out)
 
     def _run_vec(self, vec_items: list, snap: "_TabSnap") -> None:
         """One device call per engine chunk over the concatenated
@@ -642,7 +797,10 @@ class VerdictService:
                          start, start + mb.count)
                     )
                     start += mb.count
-                self._completions.put(("vec", issued, start, sends))
+                if self._inline_complete:
+                    self._finish_vec(issued, start, sends)
+                else:
+                    self._completions.put(("vec", issued, start, sends))
             if not datas:
                 continue
             batches = [it[2] for it in datas]
@@ -654,15 +812,12 @@ class VerdictService:
                 b"".join(b.blob for b in batches), np.uint8
             )
             n = len(conn_ids)
-            width = self.config.batch_width
             offs = np.concatenate(
-                ([0], np.cumsum(lengths.astype(np.int64)))
-            )[:-1]
-            col = np.arange(width)[None, :]
-            gather = offs[:, None] + col
-            mask = col < lengths[:, None]
-            rows = blob[np.minimum(gather, len(blob) - 1)] * mask
-            issued = self._issue_chunks(engine, rows, lengths, conn_ids, snap)
+                ([0], np.cumsum(lengths, dtype=np.int64))
+            )[:-1].astype(np.int32)
+            issued = self._issue_chunks_blob(
+                engine, blob, offs, lengths, conn_ids, snap
+            )
             sends, start = [], 0
             for _, client, batch in datas:
                 sends.append(
@@ -671,7 +826,10 @@ class VerdictService:
                      start, start + batch.count)
                 )
                 start += batch.count
-            self._completions.put(("vec", issued, n, sends))
+            if self._inline_complete:
+                self._finish_vec(issued, n, sends)
+            else:
+                self._completions.put(("vec", issued, n, sends))
 
     def _issue_chunks(self, engine, rows, lengths, conn_ids,
                       snap: "_TabSnap") -> list:
@@ -688,36 +846,165 @@ class VerdictService:
             f_pad = self.MIN_BUCKET
             while f_pad < cn:
                 f_pad *= 2
-            data = np.zeros((f_pad, width), np.uint8)
-            data[:cn] = rows[a:b]
+            if cn == f_pad:
+                # Exact bucket fit: no pad-copy of the row matrix
+                # (saves a ~0.5MB memcpy per full chunk on the hot path).
+                data = rows[a:b]
+                lens = lengths[a:b]
+            else:
+                data = np.zeros((f_pad, width), np.uint8)
+                data[:cn] = rows[a:b]
+                lens = np.zeros(f_pad, np.int32)
+                lens[:cn] = lengths[a:b]
+            remotes = np.zeros(f_pad, np.int32)
+            remotes[:cn] = snap.src[snap.lookup(conn_ids[a:b])]
+            _, _, chunk_allow = self._model_call(engine.model, data, lens, remotes)
+            if self._inline_complete and hasattr(chunk_allow, "copy_to_host_async"):
+                # Co-located/greedy mode materializes chunks
+                # sequentially right after issue; starting the
+                # device->host copies now lets them overlap.  On a
+                # high-latency link this is NOT done: per-array copies
+                # would defeat the completion worker's batched readback
+                # (one round trip for all pending arrays).
+                chunk_allow.copy_to_host_async()
+            issued.append((chunk_allow, a, b, cn))
+        return issued
+
+    # Fixed device blob window for the gather path: every chunk uploads
+    # exactly this many payload bytes, so jit sees ONE blob shape per
+    # flow bucket (prewarmable) while the uplink still carries
+    # ~payload-sized traffic instead of width-padded rows.
+    BLOB_CHUNK = 65536
+
+    def _issue_chunks_blob(self, engine, blob, offs, lengths, conn_ids,
+                           snap: "_TabSnap") -> list:
+        """Like _issue_chunks, but uploads the EXACT payload bytes and
+        builds the [n, width] row view with an on-device gather —
+        decisive when the chip is behind a bandwidth-limited link, and
+        a cheap HBM gather when co-located.  Chunks are cut by BOTH the
+        flow cap and the BLOB_CHUNK byte window."""
+        n = len(conn_ids)
+        ends = offs.astype(np.int64) + lengths
+        issued = []
+        max_chunk = self.config.batch_flows
+        a = 0
+        while a < n:
+            b = min(a + max_chunk, n)
+            base = int(offs[a])
+            if int(ends[b - 1]) - base > self.BLOB_CHUNK:
+                b = int(
+                    np.searchsorted(ends, base + self.BLOB_CHUNK, side="right")
+                )
+                b = max(b, a + 1)  # an entry never exceeds the window
+            cn = b - a
+            f_pad = self.MIN_BUCKET
+            while f_pad < cn:
+                f_pad *= 2
+            nb = int(ends[b - 1]) - base
+            bp = np.zeros(self.BLOB_CHUNK, np.uint8)
+            bp[:nb] = blob[base : base + nb]
+            o = np.zeros(f_pad, np.int32)
+            o[:cn] = offs[a:b] - base
             lens = np.zeros(f_pad, np.int32)
             lens[:cn] = lengths[a:b]
             remotes = np.zeros(f_pad, np.int32)
             remotes[:cn] = snap.src[snap.lookup(conn_ids[a:b])]
-            _, _, chunk_allow = self._model_call(engine.model, data, lens, remotes)
+            chunk_allow = self._gathered_call(
+                engine.model, bp, o, lens, remotes
+            )
+            if self._inline_complete and hasattr(chunk_allow, "copy_to_host_async"):
+                chunk_allow.copy_to_host_async()
             issued.append((chunk_allow, a, b, cn))
+            a = b
         return issued
 
+    def _gathered_call(self, model, blob_dev, offs, lens, remotes):
+        """Dispatch gather+model as ONE jit executable — always jit,
+        regardless of the measured row-path mode: the fused
+        gather+model launch is a single dispatch on any transport,
+        while an eager gather chain pays per-op dispatch (measured
+        catastrophic — seconds per round — through the tunneled
+        link)."""
+        width = self.config.batch_width
+        # ConstVerdict engines never reach here: vec eligibility
+        # excludes them (their verdict needs no payload at all).
+        with self._device_ctx():
+            fn = self._jit_for(
+                self._jit_gather,
+                model,
+                lambda bl, o, ln, r: _gather_model(model, bl, o, ln, r, width),
+            )
+            return fn(blob_dev, offs, lens, remotes)[-1]
+
+    def _finish_vec(self, issued, n, sends) -> None:
+        """Inline completion (greedy mode): materialize this round's
+        futures and send — runs on the dispatcher thread, so per-conn
+        FIFO order is trivially preserved.  The queue/worker variant in
+        _completion_loop batches readbacks instead (high-latency link).
+        Failures are isolated per chunk/per client like the queue path:
+        one dead client or device error must not abort the round."""
+        allow = np.empty(n, bool)
+        for fut, a, b, cn in issued:
+            try:
+                allow[a:b] = np.asarray(fut)[:cn]
+            except Exception:  # noqa: BLE001 — deny on device error
+                log.exception("device readback failed")
+                allow[a:b] = False
+        self.fast_log.log_batch("r2d2", n, int(n - allow.sum()))
+        self.vec_batches += 1
+        self.vec_entries += n
+        for client, seq, ids, lens, a, b in sends:
+            try:
+                self._send_columnar(client, seq, ids, lens, allow[a:b])
+            except Exception:  # noqa: BLE001 — client may be gone
+                log.exception("verdict send failed")
+
+    # Max concurrent device->host readbacks.  Measured on the tunneled
+    # chip: one batched jax.device_get costs ~1 link RTT regardless of
+    # array count, and 24 CONCURRENT gets still complete in ~1.3 RTT —
+    # so G slots cut the "arrived mid-readback" wait from a full RTT
+    # (r2's measured p99 was 2.0x RTT for exactly this reason) to
+    # ~RTT/G, while the drain-coalescing below keeps the number of
+    # outstanding gets bounded when rounds outpace the slots.  Sizing:
+    # a get takes ~1.2 RTT end-to-end, so slots must cover
+    # 1.2*RTT / round_interval concurrent groups — ~20 for 7ms rounds
+    # on a 120ms link; 32 leaves headroom (24+ concurrent gets measured
+    # to still complete in ~1.3 RTT).
+    READBACK_SLOTS = 32
+
     def _completion_loop(self) -> None:
-        """Materializes issued device futures in FIFO order and sends
-        verdict batches — the only thread that blocks on the device.
-
-        All pending records are drained and their futures materialized
-        in ONE ``jax.device_get`` so device→host readbacks overlap: a
-        readback costs a full link round trip, and N sequential
-        readbacks would serialize at N round trips while one batched
-        readback pays ~1 (measured; essential when the chip is reached
-        through a high-latency tunnel)."""
+        """Stage 1 of the completion pipeline: drains pending records,
+        coalesces them into one batched device→host readback per free
+        slot (≤READBACK_SLOTS concurrent), and forwards each group with
+        its readback future to the send loop in FIFO order."""
         import jax
+        from concurrent.futures import ThreadPoolExecutor
 
-        while True:
-            rec = self._completions.get()
-            recs = [rec]
+        pool = ThreadPoolExecutor(
+            max_workers=self.READBACK_SLOTS,
+            thread_name_prefix="verdict-readback",
+        )
+        slots = threading.Semaphore(self.READBACK_SLOTS)
+
+        def readback(futs):
+            try:
+                return jax.device_get(futs)
+            finally:
+                slots.release()
+
+        def drain(recs):
             while True:
                 try:
                     recs.append(self._completions.get_nowait())
                 except queue.Empty:
-                    break
+                    return recs
+
+        while True:
+            recs = drain([self._completions.get()])
+            # Wait for a readback slot; whatever lands meanwhile is
+            # coalesced into this group's single batched get.
+            slots.acquire()
+            recs = drain(recs)
             stop = any(r[0] == "stop" for r in recs)
             futs = [
                 fut
@@ -725,11 +1012,31 @@ class VerdictService:
                 if r[0] == "vec"
                 for fut, _, _, _ in r[1]
             ]
+            if futs:
+                vals_f = pool.submit(readback, futs)
+            else:
+                vals_f = None
+                slots.release()
+            self._sends.put((recs, vals_f, len(futs)))
+            if stop:
+                self._sends.put(None)
+                pool.shutdown(wait=False)
+                return
+
+    def _send_loop(self) -> None:
+        """Stage 2: waits on each group's readback IN ORDER and emits
+        verdict batches — per-connection FIFO is preserved because
+        sends happen on this one thread in submission order."""
+        while True:
+            item = self._sends.get()
+            if item is None:
+                return
+            recs, vals_f, n_futs = item
             try:
-                vals = jax.device_get(futs) if futs else []
+                vals = vals_f.result() if vals_f is not None else []
             except Exception:  # noqa: BLE001
                 log.exception("device readback failed")
-                vals = [None] * len(futs)
+                vals = [None] * n_futs
             vi = 0
             for r in recs:
                 try:
@@ -757,8 +1064,6 @@ class VerdictService:
                         client.send_verdicts(seq, entries)
                 except Exception:  # noqa: BLE001 — worker must survive
                     log.exception("completion failed")
-            if stop:
-                return
 
     _ERR_ROW = np.frombuffer(b"ERROR\r\n", np.uint8)
 
@@ -859,9 +1164,15 @@ class VerdictService:
         # in-flight vec rounds.
         for item in items:
             _, client, batch = item
-            self._completions.put(
-                ("ready", client, batch.seq, responses[id(item)])
-            )
+            if self._inline_complete:
+                try:
+                    client.send_verdicts(batch.seq, responses[id(item)])
+                except Exception:  # noqa: BLE001 — client may be gone
+                    log.exception("verdict send failed")
+            else:
+                self._completions.put(
+                    ("ready", client, batch.seq, responses[id(item)])
+                )
 
     def _run_fast(self, fast: list, responses: dict) -> None:
         """Vectorized single-frame path: entries grouped per engine, one

@@ -133,6 +133,19 @@ class DaemonConfig:
     batch_flows: int = defaults.BATCH_FLOWS
     batch_width: int = defaults.BATCH_WIDTH
     batch_timeout_ms: float = defaults.BATCH_TIMEOUT_MS
+    # Device dispatch: 'eager' pipelines per-op async dispatch (wins on
+    # high-latency device links), 'jit' compiles one executable launch
+    # per batch (wins co-located), 'auto' measures both at prewarm and
+    # keeps the faster.
+    dispatch_mode: str = "auto"  # auto | eager | jit
+    # 'cpu' routes verdict models to the host CPU backend (removes the
+    # device-link term; used by the co-located latency proof).
+    verdict_device: str = "default"  # default | cpu
+    # DIAGNOSTIC: replace verdict compute with a trivial all-allow
+    # device op so the sidecar seam itself (batch fill -> wire ->
+    # dispatch -> device call -> readback -> wire back) can be measured
+    # with the verdict-compute term removed.  Never a production config.
+    seam_probe: bool = False
 
     # Modes
     dry_mode: bool = False  # reference: DryMode, pkg/endpoint/bpf.go:510
@@ -161,6 +174,10 @@ class DaemonConfig:
             raise ValueError("invalid proxy port range")
         if self.batch_flows <= 0 or self.batch_width <= 0:
             raise ValueError("batch dimensions must be positive")
+        if self.dispatch_mode not in ("auto", "eager", "jit"):
+            raise ValueError(f"invalid dispatch_mode {self.dispatch_mode!r}")
+        if self.verdict_device not in ("default", "cpu"):
+            raise ValueError(f"invalid verdict_device {self.verdict_device!r}")
         if self.cluster_id < 0 or self.cluster_id > 255:
             raise ValueError("cluster-id must be in [0, 255]")
 

@@ -350,3 +350,58 @@ def test_sidecar_fast_path_used(service, client):
         _, out = shim.on_io(False, b"READ /public/a.txt\r\n")
         assert out == b"READ /public/a.txt\r\n"
     assert service.fast_log.requests >= 8
+
+
+# --- dispatch mode / verdict device (measured config) ---------------------
+
+@pytest.mark.parametrize(
+    "mode,device",
+    [("eager", "default"), ("jit", "default"), ("eager", "cpu")],
+)
+def test_sidecar_dispatch_modes_bit_identical(tmp_path, mode, device):
+    """Eager and jitted dispatch (and the cpu-backed verdict device the
+    co-located latbench mode uses) render identical verdicts vs the
+    oracle — the dispatch choice is performance config, never policy."""
+    inst.reset_module_registry()
+    cfg = DaemonConfig(
+        batch_timeout_ms=2.0, batch_flows=512,
+        dispatch_mode=mode, verdict_device=device,
+    )
+    svc = VerdictService(
+        str(tmp_path / f"verdict-{mode}-{device}.sock"), cfg
+    ).start()
+    try:
+        c = SidecarClient(svc.socket_path)
+        try:
+            exp = oracle_ops(r2d2_policy(), CORPUS)
+            got = shim_ops(c, CORPUS)
+            assert_parity(got, exp)
+            assert svc.dispatch_mode_chosen == mode
+        finally:
+            c.close()
+    finally:
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_sidecar_dispatch_auto_resolves_by_measurement(tmp_path):
+    """dispatch_mode='auto' must resolve to a concrete measured choice
+    at first engine prewarm."""
+    inst.reset_module_registry()
+    cfg = DaemonConfig(
+        batch_timeout_ms=2.0, batch_flows=512, dispatch_mode="auto"
+    )
+    svc = VerdictService(str(tmp_path / "verdict-auto.sock"), cfg).start()
+    try:
+        assert svc.dispatch_mode_chosen is None
+        c = SidecarClient(svc.socket_path)
+        try:
+            exp = oracle_ops(r2d2_policy(), CORPUS)
+            got = shim_ops(c, CORPUS)
+            assert_parity(got, exp)
+            assert svc.dispatch_mode_chosen in ("eager", "jit")
+        finally:
+            c.close()
+    finally:
+        svc.stop()
+        inst.reset_module_registry()
