@@ -170,6 +170,20 @@ class Daemon:
         # fqdn.StartDNSPoller)
         self.dns_poller = None
 
+        # Opt-in profiling + per-flow debug gates (reference: --pprof
+        # -> pkg/pprof.Enable, pkg/flowdebug.Enable from initEnv)
+        self.pprof_server = None
+        if self.config.pprof:
+            from ..utils import pprofserve
+
+            self.pprof_server = pprofserve.enable(
+                ("127.0.0.1", self.config.pprof_port)
+            )
+        if self.config.per_flow_debug:
+            from ..utils import flowdebug
+
+            flowdebug.enable()
+
         # Controllers (reference: pkg/controller usage across the daemon)
         self.controllers.update_controller(
             "metrics-sync",
@@ -516,6 +530,9 @@ class Daemon:
         self.identity_allocator.close()
         if self.health_responder is not None:
             self.health_responder.close()
+        if self.pprof_server is not None:
+            self.pprof_server.shutdown()
+            self.pprof_server.server_close()  # release the listening fd
         self.kvstore.close()
 
 

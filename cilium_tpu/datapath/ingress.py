@@ -1,0 +1,285 @@
+"""Node-ingress datapath programs: netdev and overlay.
+
+The batched analogs of the reference's physical-device and tunnel-device
+tc programs:
+
+- ``netdev_verdicts`` (reference: bpf/bpf_netdev.c:352 handle_ipv4):
+  packets arriving from the world.  Source identity is derived from the
+  ipcache LPM when the caller's identity is reserved (the HOST_ID
+  override nuance included), destinations are demuxed against the local
+  endpoint table (reference: bpf/lib/eps.h lookup_ip4_endpoint) —
+  host endpoints pass to the stack, local endpoints run the ingress
+  policy program, everything else is forwarded (via the overlay when
+  the ipcache names a tunnel endpoint, reference:
+  bpf_netdev.c encap_and_redirect_with_nodeid).
+
+- ``overlay_verdicts`` (reference: bpf/bpf_overlay.c:97 handle_ipv4):
+  packets decapped from the tunnel device.  The source identity comes
+  from the tunnel key (VNI) verbatim — the reference trusts the encap
+  peer — then the same local-delivery demux runs.
+
+The per-endpoint ingress policy step fused into both passes is the
+analog of the policy tail-call (reference: bpf/bpf_lxc.c:875,1008
+tail_ipv6/ipv4_policy → bpf/lib/policy.h:127 policy_can_access_ingress):
+CT-established packets skip policy; new flows run the {remote identity,
+dport, proto, INGRESS} cascade with proxy redirection.  As in the
+composed egress pipeline, one policy table (the destination endpoint's)
+is passed per call — the runtime batches per endpoint exactly where the
+kernel tail-calls into the per-endpoint program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..maps.ctmap import CtKey4, CtMap
+from ..maps.ipcache import IpcacheMap
+from ..maps.lxcmap import ENDPOINT_F_HOST, LxcMap
+from ..maps.policymap import DIR_INGRESS, DevicePolicyMap, PolicyMap, policy_can_access_batch
+from ..ops.lpm import DeviceLpm, lpm_lookup
+from ..ops.maplookup import DeviceTable, exact_lookup
+from .pipeline import DROP, FORWARD, TO_PROXY, WORLD_ID
+
+# Additional routing outcomes at the node boundary.
+TO_HOST = 3  # dst is the host endpoint: pass to the local stack (TC_ACT_OK)
+TO_OVERLAY = 4  # encap to tunnel_endpoint (encap_and_redirect_with_nodeid)
+
+HOST_ID = 1  # reserved host identity (pkg/identity/numericidentity.go)
+RESERVED_ID_MAX = 255  # user identities start at 256 (numericidentity.go)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class IngressTables:
+    """Device snapshot of the maps the node-ingress programs read."""
+
+    ipcache_id: DeviceLpm  # prefix -> sec_label
+    ipcache_tun: DeviceLpm  # prefix -> tunnel_endpoint
+    lxc: DeviceTable  # addr -> (lxc_id, flags)
+    ct: DeviceTable  # (daddr, saddr, dport, sport, proto)
+    policy: DevicePolicyMap  # the destination endpoint's policy
+
+    def tree_flatten(self):
+        return (
+            (self.ipcache_id, self.ipcache_tun, self.lxc, self.ct, self.policy),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+def build_ingress_tables(
+    ipcache: IpcacheMap, lxc: LxcMap, ct: CtMap, policy: PolicyMap
+) -> IngressTables:
+    from .pipeline import pack_ct
+
+    return IngressTables(
+        ipcache_id=ipcache.to_device(),
+        ipcache_tun=ipcache.to_device(value="tunnel_endpoint"),
+        lxc=lxc.to_device(),
+        ct=pack_ct(ct),
+        policy=policy.to_device(),
+    )
+
+
+def _ingress_common(tables, src_id, saddr, daddr, sport, dport, proto):
+    """Local-delivery demux + fused ingress policy program (shared by
+    netdev and overlay once the source identity is resolved)."""
+    # Local endpoint demux (eps.h lookup_ip4_endpoint).
+    is_local, lxc_vals = exact_lookup(tables.lxc, daddr)
+    lxc_id = lxc_vals[:, 0]
+    is_host_ep = is_local & ((lxc_vals[:, 1] & ENDPOINT_F_HOST) != 0)
+
+    # Ingress policy program for local (non-host) endpoints
+    # (tail_ipv4_policy): CT established skips policy.  Two CT
+    # orientations are live at node ingress (the reference's ct_lookup4
+    # tries the tuple in both directions): the FORWARD orientation
+    # matches entries this ingress pass created for earlier inbound
+    # connections, and the REPLY orientation matches entries the egress
+    # pipeline created when a local endpoint connected out — its key is
+    # (daddr=remote, saddr=local), which the inbound reply packet
+    # (saddr=remote, daddr=local) matches with saddr/daddr and
+    # sport/dport swapped.
+    est_fwd, _ = exact_lookup(tables.ct, daddr, saddr, dport, sport, proto)
+    est_reply, _ = exact_lookup(tables.ct, saddr, daddr, sport, dport, proto)
+    est = est_fwd | est_reply
+    allowed, proxy_port = policy_can_access_batch(
+        tables.policy, src_id, dport, proto, direction=DIR_INGRESS
+    )
+    pass_ok = est | allowed
+    local_verdict = jnp.where(
+        pass_ok,
+        jnp.where((proxy_port > 0) & ~est, TO_PROXY, FORWARD),
+        DROP,
+    )
+
+    # Non-local: overlay encap when the ipcache names a tunnel endpoint,
+    # otherwise direct forward (bpf_netdev.c ENCAP_IFINDEX branch).
+    tun_found, tunnel, _ = lpm_lookup(tables.ipcache_tun, daddr)
+    remote_verdict = jnp.where(
+        tun_found & (tunnel != 0), TO_OVERLAY, FORWARD
+    )
+
+    verdict = jnp.where(
+        is_host_ep,
+        TO_HOST,
+        jnp.where(is_local, local_verdict, remote_verdict),
+    )
+    delivered = is_local & ~is_host_ep
+    return {
+        "verdict": verdict,
+        "src_identity": src_id,
+        "lxc_id": jnp.where(is_local, lxc_id, 0),
+        "tunnel_endpoint": jnp.where(
+            ~is_local & tun_found, tunnel, 0
+        ),
+        "proxy_port": jnp.where(delivered & ~est, proxy_port, 0),
+        "established": est & delivered,
+        # Allowed new inbound flows the host should record (reference:
+        # ipv4_policy ct_create4 in the ingress tail call); the FORWARD
+        # orientation (daddr=local endpoint) is the key to create.
+        "needs_ct_create": delivered & pass_ok & ~est,
+    }
+
+
+@jax.jit
+def netdev_verdicts(
+    tables: IngressTables,
+    saddr: jax.Array,
+    daddr: jax.Array,
+    sport: jax.Array,
+    dport: jax.Array,
+    proto: jax.Array,
+    src_identity: jax.Array,
+):
+    """From-world node ingress (bpf_netdev.c:352 handle_ipv4)."""
+    saddr = jnp.asarray(saddr, jnp.int32)
+    daddr = jnp.asarray(daddr, jnp.int32)
+    src_identity = jnp.asarray(src_identity, jnp.int32)
+
+    # Reserved identities are refined by the ipcache source lookup —
+    # except when the cache claims HOST_ID (SNAT makes world traffic
+    # wear the host IP; trust the caller's identity then).
+    reserved = (src_identity >= 0) & (src_identity <= RESERVED_ID_MAX)
+    found, sec, _ = lpm_lookup(tables.ipcache_id, saddr)
+    override = reserved & found & (sec != 0) & (sec != HOST_ID)
+    src_id = jnp.where(
+        override,
+        sec,
+        jnp.where(reserved & (src_identity == 0), WORLD_ID, src_identity),
+    )
+    return _ingress_common(
+        tables, src_id, saddr, daddr,
+        jnp.asarray(sport, jnp.int32), jnp.asarray(dport, jnp.int32),
+        jnp.asarray(proto, jnp.int32),
+    )
+
+
+@jax.jit
+def overlay_verdicts(
+    tables: IngressTables,
+    saddr: jax.Array,
+    daddr: jax.Array,
+    sport: jax.Array,
+    dport: jax.Array,
+    proto: jax.Array,
+    tunnel_id: jax.Array,
+):
+    """Tunnel-device ingress (bpf_overlay.c:97 handle_ipv4): the VNI in
+    the tunnel key IS the source identity."""
+    return _ingress_common(
+        tables,
+        jnp.asarray(tunnel_id, jnp.int32),
+        jnp.asarray(saddr, jnp.int32),
+        jnp.asarray(daddr, jnp.int32),
+        jnp.asarray(sport, jnp.int32),
+        jnp.asarray(dport, jnp.int32),
+        jnp.asarray(proto, jnp.int32),
+    )
+
+
+def host_oracle_netdev(
+    ipcache: IpcacheMap,
+    lxc: LxcMap,
+    ct: CtMap,
+    policy: PolicyMap,
+    saddr: int,
+    daddr: int,
+    sport: int,
+    dport: int,
+    proto: int,
+    src_identity: int = 0,
+    tunnel_id: int | None = None,
+) -> dict:
+    """Reference-semantics host walk (the fuzz oracle).  With
+    ``tunnel_id`` set this is the overlay program instead."""
+    import ipaddress
+
+    if tunnel_id is not None:
+        src_id = tunnel_id
+    else:
+        src_id = src_identity
+        if 0 <= src_id <= RESERVED_ID_MAX:
+            info = ipcache.lookup(str(ipaddress.IPv4Address(saddr)))
+            if (
+                info is not None
+                and info.sec_label
+                and info.sec_label != HOST_ID
+            ):
+                src_id = info.sec_label
+            elif src_id == 0:
+                src_id = WORLD_ID
+
+    ep = lxc.lookup_ip(str(ipaddress.IPv4Address(daddr)))
+    out = {
+        "src_identity": src_id,
+        "lxc_id": ep.lxc_id if ep is not None else 0,
+        "tunnel_endpoint": 0,
+        "proxy_port": 0,
+        "established": False,
+        "needs_ct_create": False,
+    }
+    if ep is not None and ep.is_host:
+        out["verdict"] = TO_HOST
+        return out
+    if ep is not None:
+        now = int(ct.clock())
+
+        def live(key):
+            e = ct.entries.get(key)
+            return e is not None and e.lifetime >= now
+
+        est = live(
+            CtKey4(daddr=daddr & 0xFFFFFFFF, saddr=saddr & 0xFFFFFFFF,
+                   dport=dport, sport=sport, nexthdr=proto)
+        ) or live(  # reply to an egress-created entry
+            CtKey4(daddr=saddr & 0xFFFFFFFF, saddr=daddr & 0xFFFFFFFF,
+                   dport=sport, sport=dport, nexthdr=proto)
+        )
+        allowed, proxy_port = policy.lookup(
+            src_id, dport, proto, direction=DIR_INGRESS, count_packets=False
+        )
+        out["established"] = est
+        if est or allowed:
+            if proxy_port > 0 and not est:
+                out["verdict"] = TO_PROXY
+                out["proxy_port"] = proxy_port
+            else:
+                out["verdict"] = FORWARD
+            out["needs_ct_create"] = not est
+        else:
+            out["verdict"] = DROP
+        return out
+    info = ipcache.lookup(str(ipaddress.IPv4Address(daddr)))
+    if info is not None and info.tunnel_endpoint:
+        out["verdict"] = TO_OVERLAY
+        out["tunnel_endpoint"] = info.tunnel_endpoint
+    else:
+        out["verdict"] = FORWARD
+    return out

@@ -44,7 +44,7 @@ from ..maps.policymap import (
     policy_can_access_batch,
 )
 from ..ops.lpm import DeviceLpm, lpm_lookup
-from ..ops.maplookup import DeviceTable, exact_lookup, pack_table
+from ..ops.maplookup import DeviceTable, exact_lookup, pack_table, u32_to_i32
 
 # Verdicts (the reference's TC return codes collapse to these three
 # outcomes at this layer; DROP carries the policy-denied drop reason,
@@ -89,24 +89,29 @@ class DatapathTables:
         return cls(*leaves)
 
 
-def build_tables(
-    ct: CtMap, lb: LbMap, ipcache: IpcacheMap, policy: PolicyMap
-) -> DatapathTables:
-    """Snapshot host maps into device tables (the analog of the pinned
-    BPF maps the kernel programs read)."""
-    # Expired-but-not-yet-GCed entries must NOT reach the device table:
-    # ct_lookup4 treats them as misses (conntrack.h lifetime check), so
-    # the snapshot filters on lifetime like CtMap.lookup does.
+def pack_ct(ct: CtMap) -> DeviceTable:
+    """Snapshot live CT entries into a device exact-match table.
+
+    Expired-but-not-yet-GCed entries must NOT reach the device table:
+    ct_lookup4 treats them as misses (conntrack.h lifetime check), so
+    the snapshot filters on lifetime like CtMap.lookup does."""
     now = int(ct.clock())
     live = [k for k, e in ct.entries.items() if e.lifetime >= now]
     keys = np.zeros((len(live), 5), np.int64)
     for i, k in enumerate(live):
         keys[i] = (k.daddr, k.saddr, k.dport, k.sport, k.nexthdr)
-    # uint32 -> int32 bit pattern so >2^31 addresses compare bit-exact.
-    keys = (keys & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    keys = u32_to_i32(keys)
     vals = np.zeros((len(live), 1), np.int64)
+    return pack_table(keys, vals)
+
+
+def build_tables(
+    ct: CtMap, lb: LbMap, ipcache: IpcacheMap, policy: PolicyMap
+) -> DatapathTables:
+    """Snapshot host maps into device tables (the analog of the pinned
+    BPF maps the kernel programs read)."""
     return DatapathTables(
-        ct=pack_table(keys, vals),
+        ct=pack_ct(ct),
         lb=lb.to_device(),
         ipcache=ipcache.to_device(),
         policy=policy.to_device(),
@@ -153,8 +158,9 @@ def datapath_verdicts(
     # (reference: eps.h lookup falls back to WORLD_ID for misses).
     ip_found, ident, _plen = lpm_lookup(tables.ipcache, new_daddr)
     dst_id = jnp.where(ip_found, ident, jnp.int32(WORLD_ID))
-    # Tunnel endpoints ride a second ipcache value column once overlay
-    # forwarding lands; identity-only tables carry 0 here.
+    # Egress encap selection lives in the node-ingress programs
+    # (datapath/ingress.py netdev_verdicts reads the tunnel column);
+    # this endpoint-egress pass carries 0 here.
     tunnel = jnp.zeros_like(dst_id)
 
     # 4. Policy cascade on new connections (established flows were
@@ -229,7 +235,7 @@ def host_oracle(
     import ipaddress
 
     def i32(v: int) -> np.int32:
-        return np.uint32(v & 0xFFFFFFFF).view(np.int32).astype(np.int32)
+        return u32_to_i32(v).astype(np.int32)
 
     with np.errstate(over="ignore"):
         fh = int(

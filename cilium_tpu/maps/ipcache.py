@@ -59,10 +59,26 @@ class IpcacheMap:
             (k, v[1]) for k, v in self.v6.items()
         )
 
-    def to_device(self, v6: bool = False, pad_to: int | None = None) -> DeviceLpm:
+    def to_device(
+        self,
+        v6: bool = False,
+        pad_to: int | None = None,
+        value: str = "sec_label",
+    ) -> DeviceLpm:
+        """Export one value column as a DeviceLpm: 'sec_label' (identity
+        derivation) or 'tunnel_endpoint' (overlay forwarding, reference:
+        bpf_netdev.c encap_and_redirect_with_nodeid on
+        info->tunnel_endpoint)."""
+        from ..ops.maplookup import u32_to_i32
+
         table = self.v6 if v6 else self.v4
+        # Values ride int32 lanes as bit patterns (tunnel endpoints are
+        # full uint32 addresses).
         return build_lpm(
-            [(prefix, info.sec_label) for prefix, (_, info) in table.items()],
+            [
+                (prefix, int(u32_to_i32(getattr(info, value))))
+                for prefix, (_, info) in table.items()
+            ],
             v6=v6,
             pad_to=pad_to,
         )

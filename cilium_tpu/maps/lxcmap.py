@@ -70,3 +70,29 @@ class LxcMap:
 
     def dump(self):
         return sorted(self.by_ip.items())
+
+    def to_device(self, pad_to: int | None = None):
+        """Pack the v4 endpoint IPs into an exact-match DeviceTable
+        (key: addr; values: [lxc_id, flags]) — the batched analog of
+        lookup_ip4_endpoint (reference: bpf/lib/eps.h, consumed by
+        bpf_netdev.c handle_ipv4 for local delivery demux)."""
+        import ipaddress
+
+        import numpy as np
+
+        from ..ops.maplookup import pack_table, u32_to_i32
+
+        rows = []
+        vals = []
+        for ip, info in self.by_ip.items():
+            addr = ipaddress.ip_address(ip)
+            if addr.version != 4:
+                continue
+            rows.append([int(addr) & 0xFFFFFFFF])
+            vals.append([info.lxc_id, info.flags])
+        keys = u32_to_i32(np.array(rows or np.zeros((0, 1)), np.int64))
+        return pack_table(
+            keys.reshape(-1, 1),
+            np.array(vals or np.zeros((0, 2)), np.int64).astype(np.int32),
+            pad_to=pad_to,
+        )

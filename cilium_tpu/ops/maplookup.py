@@ -20,6 +20,15 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def u32_to_i32(a):
+    """uint32 bit pattern -> int32 lanes.  Device tables carry
+    addresses and other full-range uint32 values as int32 bit patterns
+    so entries >= 2^31 compare bit-exact; every pack/oracle site must
+    use this one conversion."""
+    arr = np.asarray(a, np.int64)
+    return (arr & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class DeviceTable:

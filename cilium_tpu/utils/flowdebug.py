@@ -1,0 +1,33 @@
+"""Per-flow debug logging gate.
+
+reference: pkg/flowdebug/flowdebug.go — a process-global switch; all
+per-request/per-connection debug logging must route through here so the
+(hot) per-flow paths pay a single boolean check when disabled.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_per_flow_debug = False
+
+
+def enable() -> None:
+    global _per_flow_debug
+    _per_flow_debug = True
+
+
+def disable() -> None:
+    global _per_flow_debug
+    _per_flow_debug = False
+
+
+def enabled() -> bool:
+    return _per_flow_debug
+
+
+def log(logger: logging.Logger, msg: str, *args) -> None:
+    """Log a per-flow debug message only when enabled (reference:
+    flowdebug.go Log/Logf)."""
+    if _per_flow_debug:
+        logger.debug(msg, *args)

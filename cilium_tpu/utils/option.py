@@ -151,6 +151,9 @@ class DaemonConfig:
     dry_mode: bool = False  # reference: DryMode, pkg/endpoint/bpf.go:510
     restore_state: bool = True
     enable_health: bool = True  # reference: cilium-health launch
+    pprof: bool = False  # reference: --pprof -> pkg/pprof Enable
+    pprof_port: int = 6060  # reference: pprof.go apiAddress (0 = ephemeral)
+    per_flow_debug: bool = False  # reference: pkg/flowdebug
 
     # kvstore
     kvstore: str = "local"  # local | file | tcp

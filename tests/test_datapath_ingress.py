@@ -1,0 +1,240 @@
+"""Node-ingress programs (netdev + overlay) vs host oracle: identity
+derivation, local-delivery demux, fused ingress policy, and overlay
+encap selection (reference: bpf/bpf_netdev.c:352, bpf/bpf_overlay.c:97,
+bpf/bpf_lxc.c:875 tail_ipv4_policy)."""
+
+import ipaddress
+import random
+
+import numpy as np
+
+from cilium_tpu.datapath.ingress import (
+    DROP,
+    FORWARD,
+    TO_HOST,
+    TO_OVERLAY,
+    TO_PROXY,
+    HOST_ID,
+    WORLD_ID,
+    build_ingress_tables,
+    host_oracle_netdev,
+    netdev_verdicts,
+    overlay_verdicts,
+)
+from cilium_tpu.maps.ctmap import CtKey4, CtMap, PROTO_TCP, PROTO_UDP
+from cilium_tpu.maps.ipcache import IpcacheMap
+from cilium_tpu.maps.lxcmap import ENDPOINT_F_HOST, EndpointInfo, LxcMap
+from cilium_tpu.maps.policymap import DIR_INGRESS, PolicyMap
+
+
+def ipi(s: str) -> int:
+    return int(ipaddress.IPv4Address(s))
+
+
+def build_node(rng):
+    ipc = IpcacheMap()
+    for i in range(16):
+        ipc.upsert(f"10.0.{i}.0/24", sec_label=100 + i)
+    # Remote-node pod CIDRs reachable via the overlay.
+    ipc.upsert("10.2.0.0/24", sec_label=300, tunnel_endpoint=ipi("192.168.1.2"))
+    ipc.upsert("10.2.1.0/24", sec_label=301, tunnel_endpoint=ipi("192.168.1.3"))
+    # A prefix that claims HOST_ID (SNAT case).
+    ipc.upsert("10.3.0.0/24", sec_label=HOST_ID)
+
+    lxc = LxcMap()
+    for e in range(6):
+        lxc.upsert(f"10.0.0.{e + 10}", 40 + e, EndpointInfo(ifindex=e + 2))
+    lxc.upsert("10.0.0.1", 1, EndpointInfo(flags=ENDPOINT_F_HOST))
+
+    pol = PolicyMap()
+    for ident in (100, 101, 102, 300, WORLD_ID):
+        if rng.random() < 0.7:
+            pol.allow(ident, 8080, PROTO_TCP, DIR_INGRESS,
+                      proxy_port=14000 if rng.random() < 0.4 else 0)
+    pol.allow(0, 53, PROTO_UDP, DIR_INGRESS)
+
+    ct = CtMap()
+    # A few established flows into local endpoints.
+    for k in range(4):
+        ct.create(
+            CtKey4(
+                daddr=ipi(f"10.0.0.{k + 10}"), saddr=ipi("10.0.1.5"),
+                dport=8080, sport=41000 + k, nexthdr=PROTO_TCP,
+            ),
+            src_sec_id=101,
+        )
+    return ipc, lxc, ct, pol
+
+
+def gen(rng, f):
+    cols = {k: np.zeros((f,), np.int64) for k in
+            ("saddr", "daddr", "sport", "dport", "proto", "src_id", "vni")}
+    for i in range(f):
+        roll = rng.random()
+        if roll < 0.4:  # known pod source
+            cols["saddr"][i] = ipi(f"10.0.{rng.randrange(16)}.{rng.randrange(2, 250)}")
+        elif roll < 0.55:  # SNAT/host-claiming prefix
+            cols["saddr"][i] = ipi(f"10.3.0.{rng.randrange(1, 250)}")
+        else:  # unknown world source
+            cols["saddr"][i] = ipi(f"203.0.{rng.randrange(113, 120)}.{rng.randrange(1, 250)}")
+        droll = rng.random()
+        if droll < 0.45:  # local endpoint (sometimes the established tuple)
+            cols["daddr"][i] = ipi(f"10.0.0.{rng.randrange(10, 16)}")
+            cols["dport"][i] = rng.choice([8080, 53, 9000])
+            cols["sport"][i] = rng.choice([41000, 41001, 55555])
+            if rng.random() < 0.3:
+                cols["saddr"][i] = ipi("10.0.1.5")
+        elif droll < 0.55:  # host endpoint
+            cols["daddr"][i] = ipi("10.0.0.1")
+            cols["dport"][i] = 22
+            cols["sport"][i] = rng.randrange(1024, 60000)
+        elif droll < 0.8:  # remote pod via overlay
+            cols["daddr"][i] = ipi(f"10.2.{rng.randrange(2)}.{rng.randrange(1, 250)}")
+            cols["dport"][i] = 8080
+            cols["sport"][i] = rng.randrange(1024, 60000)
+        else:  # unknown destination
+            cols["daddr"][i] = ipi("198.51.100.7")
+            cols["dport"][i] = 443
+            cols["sport"][i] = rng.randrange(1024, 60000)
+        cols["proto"][i] = PROTO_TCP if rng.random() < 0.8 else PROTO_UDP
+        cols["src_id"][i] = rng.choice([0, 0, HOST_ID, 4, 100, 5000])
+        cols["vni"][i] = rng.choice([100, 101, 300, WORLD_ID])
+    as_i32 = lambda a: (a & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    return {k: as_i32(v) for k, v in cols.items()}
+
+
+FIELDS = (
+    "verdict", "src_identity", "lxc_id", "tunnel_endpoint", "proxy_port",
+    "established", "needs_ct_create",
+)
+
+
+def test_netdev_fuzz_matches_host_oracle():
+    rng = random.Random(11)
+    ipc, lxc, ct, pol = build_node(rng)
+    tables = build_ingress_tables(ipc, lxc, ct, pol)
+    p = gen(rng, 512)
+    out = netdev_verdicts(
+        tables, p["saddr"], p["daddr"], p["sport"], p["dport"], p["proto"],
+        p["src_id"],
+    )
+    dev = {k: np.asarray(v) for k, v in out.items()}
+    for i in range(512):
+        want = host_oracle_netdev(
+            ipc, lxc, ct, pol,
+            int(np.uint32(p["saddr"][i])), int(np.uint32(p["daddr"][i])),
+            int(p["sport"][i]), int(p["dport"][i]), int(p["proto"][i]),
+            src_identity=int(p["src_id"][i]),
+        )
+        for f in FIELDS:
+            got = int(np.uint32(np.int64(dev[f][i]) & 0xFFFFFFFF))
+            exp = int(np.uint32(int(want[f]) & 0xFFFFFFFF))
+            assert got == exp, (
+                f"pkt {i} field {f}: device {got} != oracle {exp} ({want})"
+            )
+
+
+def test_overlay_fuzz_matches_host_oracle():
+    rng = random.Random(12)
+    ipc, lxc, ct, pol = build_node(rng)
+    tables = build_ingress_tables(ipc, lxc, ct, pol)
+    p = gen(rng, 512)
+    out = overlay_verdicts(
+        tables, p["saddr"], p["daddr"], p["sport"], p["dport"], p["proto"],
+        p["vni"],
+    )
+    dev = {k: np.asarray(v) for k, v in out.items()}
+    for i in range(512):
+        want = host_oracle_netdev(
+            ipc, lxc, ct, pol,
+            int(np.uint32(p["saddr"][i])), int(np.uint32(p["daddr"][i])),
+            int(p["sport"][i]), int(p["dport"][i]), int(p["proto"][i]),
+            tunnel_id=int(p["vni"][i]),
+        )
+        for f in FIELDS:
+            got = int(np.uint32(np.int64(dev[f][i]) & 0xFFFFFFFF))
+            exp = int(np.uint32(int(want[f]) & 0xFFFFFFFF))
+            assert got == exp, (
+                f"pkt {i} field {f}: device {got} != oracle {exp}"
+            )
+
+
+def test_netdev_semantics_spotchecks():
+    rng = random.Random(13)
+    ipc, lxc, ct, pol = build_node(rng)
+    pol.allow(100, 8080, PROTO_TCP, DIR_INGRESS)  # deterministic allow
+    tables = build_ingress_tables(ipc, lxc, ct, pol)
+
+    def one(saddr, daddr, sport, dport, proto, src_id):
+        as1 = lambda v: np.array([v], np.int64)
+        as_i32 = lambda a: (a & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+        out = netdev_verdicts(
+            tables, as_i32(as1(saddr)), as_i32(as1(daddr)),
+            as1(sport).astype(np.int32), as1(dport).astype(np.int32),
+            as1(proto).astype(np.int32), as1(src_id).astype(np.int32),
+        )
+        return {k: int(np.asarray(v)[0]) for k, v in out.items()}
+
+    # Host endpoint -> TO_HOST regardless of policy.
+    r = one(ipi("203.0.113.9"), ipi("10.0.0.1"), 5555, 22, PROTO_TCP, 0)
+    assert r["verdict"] == TO_HOST
+
+    # Known pod source + allowed port -> FORWARD with derived identity.
+    r = one(ipi("10.0.0.99"), ipi("10.0.0.10"), 5555, 8080, PROTO_TCP, 0)
+    assert r["verdict"] == FORWARD and r["src_identity"] == 100
+
+    # HOST_ID-claiming prefix does NOT override the caller's identity.
+    r = one(ipi("10.3.0.9"), ipi("10.0.0.10"), 5555, 8080, PROTO_TCP, 0)
+    assert r["src_identity"] == WORLD_ID  # stays world, not host
+
+    # Remote pod behind a tunnel -> TO_OVERLAY with the node address.
+    r = one(ipi("10.0.0.99"), ipi("10.2.0.7"), 5555, 8080, PROTO_TCP, 0)
+    assert r["verdict"] == TO_OVERLAY
+    assert np.uint32(r["tunnel_endpoint"] & 0xFFFFFFFF) == ipi("192.168.1.2")
+
+    # Established CT tuple skips a (missing) policy allow.
+    pol2 = PolicyMap()
+    tables2 = build_ingress_tables(ipc, lxc, ct, pol2)
+    r_est = netdev_verdicts(
+        tables2,
+        np.array([ipi("10.0.1.5")], np.int32),
+        np.array([ipi("10.0.0.10")], np.int32),
+        np.array([41000], np.int32), np.array([8080], np.int32),
+        np.array([PROTO_TCP], np.int32), np.array([0], np.int32),
+    )
+    assert int(np.asarray(r_est["verdict"])[0]) == FORWARD
+    assert bool(np.asarray(r_est["established"])[0])
+
+
+def test_reply_to_egress_connection_is_established():
+    """A local endpoint connects OUT (egress pipeline records the CT
+    entry in its orientation); the inbound REPLY must be admitted as
+    established without any ingress policy allow (reference:
+    conntrack.h ct_lookup4 reply-direction match)."""
+    rng = random.Random(14)
+    ipc, lxc, ct, _ = build_node(rng)
+    ct.create(
+        CtKey4(
+            daddr=ipi("203.0.113.50"), saddr=ipi("10.0.0.10"),
+            dport=443, sport=50000, nexthdr=PROTO_TCP,
+        ),
+        src_sec_id=0,
+    )
+    empty_pol = PolicyMap()
+    tables = build_ingress_tables(ipc, lxc, ct, empty_pol)
+    out = netdev_verdicts(
+        tables,
+        np.array([ipi("203.0.113.50")], np.int64).astype(np.uint32).view(np.int32),
+        np.array([ipi("10.0.0.10")], np.int32),
+        np.array([443], np.int32), np.array([50000], np.int32),
+        np.array([PROTO_TCP], np.int32), np.array([0], np.int32),
+    )
+    assert int(np.asarray(out["verdict"])[0]) == FORWARD
+    assert bool(np.asarray(out["established"])[0])
+    assert not bool(np.asarray(out["needs_ct_create"])[0])
+    # And the oracle agrees.
+    want = host_oracle_netdev(
+        ipc, lxc, ct, empty_pol,
+        ipi("203.0.113.50"), ipi("10.0.0.10"), 443, 50000, PROTO_TCP,
+    )
+    assert want["verdict"] == FORWARD and want["established"]
