@@ -322,6 +322,35 @@ class Daemon:
                 pass
         return True
 
+    def endpoint_update_labels(
+        self, endpoint_id: int, labels: list[str]
+    ) -> bool:
+        """Replace an endpoint's identity labels: reallocate the
+        identity, resync the ipcache, and regenerate (reference:
+        pkg/endpoint UpdateLabels/replaceIdentityLabels — the workload
+        watcher's correlation path lands here)."""
+        ep = self.endpoint_manager.lookup(endpoint_id)
+        if ep is None:
+            return False
+        new = Labels.from_model(labels)
+        if ep.labels == new:
+            return True
+        old_identity = ep.security_identity
+        identity, _ = self.identity_allocator.allocate(new)
+        ep.labels = new
+        ep.set_identity(identity)
+        if old_identity is not None:
+            self.identity_allocator.release(old_identity)
+        if ep.ipv4:
+            self.ipcache.upsert(ep.ipv4, identity.id)
+            self.ipcache_sync.upsert_to_kvstore(
+                IPIdentityPair(ep.ipv4, identity.id)
+            )
+        ep.force_policy_compute = True
+        ep.set_state(EndpointState.WAITING_TO_REGENERATE, "labels changed")
+        self.build_queue.enqueue(ep, key=ep.id)
+        return True
+
     def endpoint_regenerate(self, endpoint_id: int) -> bool:
         ep = self.endpoint_manager.lookup(endpoint_id)
         if ep is None:

@@ -76,6 +76,36 @@ class ProxyMap:
         self.entries.clear()
         return n
 
+    def save(self, path: str) -> int:
+        """Write a binary snapshot for the native proxy side (the
+        pinned-BPF-map analog; reader: native/shim.cc
+        cilium_tpu_proxymap_open / envoy/proxymap.cc counterpart).
+        Layout: b"CTPM" + uint32 count + count * 8 LE uint32s
+        (saddr, daddr, sport, dport, proto, orig_daddr, orig_dport,
+        identity).  Expired entries are skipped; the write is atomic
+        (tmp + rename) so the reader never sees a torn file.
+        Returns the number of entries written."""
+        import os
+        import struct
+
+        now = int(self.clock())
+        live = [
+            (k, v) for k, v in self.entries.items() if v.lifetime >= now
+        ]
+        blob = b"CTPM" + struct.pack("<I", len(live))
+        for k, v in live:
+            blob += struct.pack(
+                "<8I",
+                k.saddr & 0xFFFFFFFF, k.daddr & 0xFFFFFFFF,
+                k.sport, k.dport, k.nexthdr,
+                v.orig_daddr & 0xFFFFFFFF, v.orig_dport, v.identity,
+            )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return len(live)
+
     def dump(self):
         return sorted(
             self.entries.items(),

@@ -97,6 +97,63 @@ uint32_t cilium_tpu_on_io(uint64_t module, uint64_t conn_id, uint8_t reply,
 /* Deregister a connection (the Close analog). */
 void cilium_tpu_close_connection(uint64_t module, uint64_t conn_id);
 
+/* ---- access log client (reference: envoy/accesslog.cc) ---------------
+ *
+ * Per-request log records written over a unix socket to the agent's
+ * access-log server (cilium_tpu/accesslog/server.py; framing: 4-byte
+ * big-endian length + JSON LogRecord).  The client reconnects once per
+ * send on failure, mirroring the reference's TryConnect-per-Log. */
+
+/* Returns an accesslog handle, 0 on error (the path may not exist yet;
+ * connection is (re)attempted per send). */
+uint64_t cilium_tpu_accesslog_open(const char *socket_path);
+
+void cilium_tpu_accesslog_close(uint64_t handle);
+
+/* Send one pre-encoded JSON LogRecord. Returns 1 on success. */
+uint32_t cilium_tpu_accesslog_send_json(uint64_t handle, const char *json,
+                                        size_t len);
+
+/* Build + send one verdict record (entry_type: 0 request forwarded,
+ * 2 denied — matching accesslog/record.py's verdict strings). */
+uint32_t cilium_tpu_accesslog_log_verdict(
+    uint64_t handle, uint8_t denied, uint8_t ingress, uint32_t src_id,
+    uint32_t dst_id, const char *src_addr, const char *dst_addr,
+    const char *proto, const char *info);
+
+/* Attach an accesslog to a module: cilium_tpu_on_io then emits one
+ * record per applied PASS/DROP op group (the reference's per-request
+ * C++ access logging; pass 0 to detach). */
+void cilium_tpu_set_accesslog(uint64_t module, uint64_t accesslog);
+
+/* ---- proxymap reader (reference: envoy/bpf.cc + envoy/proxymap.cc +
+ * envoy/cilium_bpf_metadata.cc) -----------------------------------------
+ *
+ * Original-destination recovery for redirected connections: the
+ * datapath writes proxymap snapshots to a file (the pinned-BPF-map
+ * analog; cilium_tpu/maps/proxymap.py ProxyMap.save), and the native
+ * proxy side opens + queries it at connection accept. */
+
+/* Open (and load) a proxymap snapshot file. Returns a handle, 0 on
+ * error. */
+uint64_t cilium_tpu_proxymap_open(const char *path);
+
+/* Re-read the snapshot if the file changed. Returns entry count, or
+ * -1 on read failure (previous snapshot stays active). */
+int64_t cilium_tpu_proxymap_refresh(uint64_t handle);
+
+/* Look up the proxied 5-tuple (key fields as the datapath wrote them:
+ * source perspective, dport = local proxy port).  On hit fills
+ * orig_daddr/orig_dport/identity and returns 1. */
+uint32_t cilium_tpu_proxymap_lookup(uint64_t handle, uint32_t saddr,
+                                    uint32_t daddr, uint16_t sport,
+                                    uint16_t dport, uint8_t proto,
+                                    uint32_t *orig_daddr,
+                                    uint32_t *orig_dport,
+                                    uint32_t *identity);
+
+void cilium_tpu_proxymap_close(uint64_t handle);
+
 #ifdef __cplusplus
 }
 #endif

@@ -14,9 +14,11 @@
 
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cstdio>
 #include <deque>
 #include <map>
@@ -51,12 +53,20 @@ struct Connection {
   // Ops produced by the service but not yet handed to the caller
   // (cilium_tpu_on_data continuation when the caller's array is small).
   std::deque<CiliumTpuFilterOp> pending_ops[2];
+  // Identity/address metadata captured at OnNewConnection so the
+  // access logger can emit complete records (reference:
+  // envoy/accesslog.cc Logger fills these from the filter state).
+  bool ingress = false;
+  uint32_t src_id = 0;
+  uint32_t dst_id = 0;
+  std::string src_addr, dst_addr, proto;
 };
 
 struct Module {
   int fd = -1;
   uint64_t module_id = 0;
   uint64_t next_seq = 1;
+  std::atomic<uint64_t> accesslog{0};  // attached accesslog handle
   std::mutex io_mutex;
   // Guards the conns map itself (insert/erase/find from different
   // threads); per-connection state still follows the reference's
@@ -73,7 +83,7 @@ struct Module {
 
 std::mutex g_registry_mutex;
 std::map<uint64_t, std::unique_ptr<Module>> g_modules;
-uint64_t g_next_handle = 1;
+std::atomic<uint64_t> g_next_handle{1};
 
 Module *find_module(uint64_t handle) {
   std::lock_guard<std::mutex> lk(g_registry_mutex);
@@ -254,6 +264,167 @@ uint32_t on_data_rpc(Module *m, Connection *c, uint64_t conn_id, bool reply,
 
 }  // namespace
 
+namespace {
+
+// --- access log client (reference: envoy/accesslog.cc) --------------------
+
+struct AccessLog {
+  std::string path;
+  int fd = -1;
+  std::mutex mutex;
+
+  bool try_connect() {
+    if (fd >= 0) return true;
+    fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+    if (connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) != 0) {
+      ::close(fd);
+      fd = -1;
+      return false;
+    }
+    return true;
+  }
+
+  // 4-byte big-endian length + JSON body (accesslog/server.py framing);
+  // one reconnect attempt per send (reference: accesslog.cc Log's
+  // TryConnect-then-retry).
+  bool send_frame(const char *json, size_t len) {
+    std::lock_guard<std::mutex> lk(mutex);
+    for (int attempt = 0; attempt < 2; attempt++) {
+      if (!try_connect()) return false;
+      uint8_t hdr[4] = {
+          static_cast<uint8_t>(len >> 24), static_cast<uint8_t>(len >> 16),
+          static_cast<uint8_t>(len >> 8), static_cast<uint8_t>(len)};
+      if (send_all(fd, hdr, 4) && send_all(fd, json, len)) return true;
+      ::close(fd);
+      fd = -1;
+    }
+    return false;
+  }
+};
+
+std::mutex g_accesslog_mutex;
+// shared_ptr lifetime: an accesslog may be shared across modules and
+// threads, and close() must not free it under an in-flight send — the
+// erase drops the registry reference while senders holding the shared
+// pointer finish safely.
+std::map<uint64_t, std::shared_ptr<AccessLog>> g_accesslogs;
+
+std::shared_ptr<AccessLog> find_accesslog(uint64_t handle) {
+  std::lock_guard<std::mutex> lk(g_accesslog_mutex);
+  auto it = g_accesslogs.find(handle);
+  return it == g_accesslogs.end() ? nullptr : it->second;
+}
+
+void json_escape(std::string *out, const char *s) {
+  for (; s && *s; s++) {
+    unsigned char c = *s;
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back(c);
+    } else if (c < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", c);
+      *out += buf;
+    } else {
+      out->push_back(c);
+    }
+  }
+}
+
+// Build a LogRecord JSON (accesslog/record.py schema).
+std::string verdict_record_json(bool denied, bool ingress, uint32_t src_id,
+                                uint32_t dst_id, const char *src_addr,
+                                const char *dst_addr, const char *proto,
+                                const char *info) {
+  std::string j = "{\"type\":\"Request\",\"observation_point\":\"";
+  j += ingress ? "Ingress" : "Egress";
+  j += "\",\"verdict\":\"";
+  j += denied ? "Denied" : "Forwarded";
+  j += "\",\"source\":{\"identity\":" + std::to_string(src_id) +
+       ",\"ipv4\":\"";
+  json_escape(&j, src_addr);
+  j += "\"},\"destination\":{\"identity\":" + std::to_string(dst_id) +
+       ",\"ipv4\":\"";
+  json_escape(&j, dst_addr);
+  j += "\"},\"info\":\"";
+  json_escape(&j, info);
+  j += "\",\"l7\":{\"proto\":\"";
+  json_escape(&j, proto);
+  j += "\",\"fields\":{}}}";
+  return j;
+}
+
+// --- proxymap snapshot reader (reference: envoy/proxymap.cc) ---------------
+
+struct ProxyMapRec {
+  uint32_t saddr, daddr, sport, dport, proto;
+  uint32_t orig_daddr, orig_dport, identity;
+};
+
+struct ProxyMapFile {
+  std::string path;
+  // Snapshot version at last successful load: nanosecond mtime + size
+  // (second-granular st_mtime alone would miss rapid re-snapshots).
+  uint64_t mtime_ns = 0;
+  uint64_t size = 0;
+  std::vector<ProxyMapRec> recs;
+  std::mutex mutex;
+
+  // Snapshot layout (maps/proxymap.py ProxyMap.save): "CTPM", uint32
+  // count, then count * 8 little-endian uint32s per record.  Re-reads
+  // only when the file's mtime changed; the header count is validated
+  // against the actual file size so a corrupt snapshot returns -1
+  // (previous snapshot stays active) instead of over-allocating.
+  int64_t load() {
+    struct stat st {};
+    if (stat(path.c_str(), &st) != 0) return -1;
+    uint64_t ver = static_cast<uint64_t>(st.st_mtim.tv_sec) * 1000000000ull +
+                   static_cast<uint64_t>(st.st_mtim.tv_nsec);
+    {
+      std::lock_guard<std::mutex> lk(mutex);
+      if (mtime_ns != 0 && ver == mtime_ns &&
+          static_cast<uint64_t>(st.st_size) == size)
+        return static_cast<int64_t>(recs.size());
+    }
+    FILE *f = fopen(path.c_str(), "rb");
+    if (!f) return -1;
+    char magic[4];
+    uint32_t count = 0;
+    std::vector<ProxyMapRec> fresh;
+    bool ok = fread(magic, 1, 4, f) == 4 && memcmp(magic, "CTPM", 4) == 0 &&
+              fread(&count, 4, 1, f) == 1 &&
+              static_cast<uint64_t>(st.st_size) >=
+                  8 + static_cast<uint64_t>(count) * sizeof(ProxyMapRec);
+    if (ok) {
+      fresh.resize(count);
+      ok = count == 0 ||
+           fread(fresh.data(), sizeof(ProxyMapRec), count, f) == count;
+    }
+    fclose(f);
+    if (!ok) return -1;
+    std::lock_guard<std::mutex> lk(mutex);
+    recs = std::move(fresh);
+    mtime_ns = ver;
+    size = static_cast<uint64_t>(st.st_size);
+    return static_cast<int64_t>(recs.size());
+  }
+};
+
+std::mutex g_proxymap_mutex;
+std::map<uint64_t, std::shared_ptr<ProxyMapFile>> g_proxymaps;
+
+std::shared_ptr<ProxyMapFile> find_proxymap(uint64_t handle) {
+  std::lock_guard<std::mutex> lk(g_proxymap_mutex);
+  auto it = g_proxymaps.find(handle);
+  return it == g_proxymaps.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
 extern "C" {
 
 uint64_t cilium_tpu_open(const char *socket_path, uint8_t debug) {
@@ -344,8 +515,15 @@ uint32_t cilium_tpu_on_new_connection(uint64_t module, const char *proto,
   size_t off = 8;  // skip echoed conn_id
   uint32_t res = get<uint32_t>(reply, &off);
   if (res == CT_FILTER_OK) {
+    auto conn = std::make_unique<Connection>();
+    conn->ingress = ingress != 0;
+    conn->src_id = src_id;
+    conn->dst_id = dst_id;
+    conn->src_addr = src_addr ? src_addr : "";
+    conn->dst_addr = dst_addr ? dst_addr : "";
+    conn->proto = proto ? proto : "";
     std::lock_guard<std::mutex> ck(m->conns_mutex);
-    m->conns[conn_id] = std::make_unique<Connection>();
+    m->conns[conn_id] = std::move(conn);
   }
   return res;
 }
@@ -430,6 +608,7 @@ uint32_t cilium_tpu_on_io(uint64_t module, uint64_t conn_id, uint8_t reply,
   if (result != CT_FILTER_OK) return result;
 
   int d = reply ? 1 : 0;
+  int64_t passed_frames = 0, dropped_frames = 0;
   while (!c->pending_ops[d].empty()) {
     CiliumTpuFilterOp op = c->pending_ops[d].front();
     c->pending_ops[d].pop_front();
@@ -443,12 +622,14 @@ uint32_t cilium_tpu_on_io(uint64_t module, uint64_t conn_id, uint8_t reply,
         out.append(dir.buffer, 0, take);
         dir.buffer.erase(0, take);
         if (n > take) dir.pass_bytes = n - take;
+        passed_frames++;
         break;
       }
       case CT_FILTEROP_DROP: {
         int64_t take = std::min<int64_t>(n, dir.buffer.size());
         dir.buffer.erase(0, take);
         if (n > take) dir.drop_bytes = n - take;
+        dropped_frames++;
         break;
       }
       case CT_FILTEROP_INJECT: {
@@ -460,6 +641,29 @@ uint32_t cilium_tpu_on_io(uint64_t module, uint64_t conn_id, uint8_t reply,
       }
       default:
         return CT_FILTER_PARSER_ERROR;
+    }
+  }
+
+  // Per-request access logging (reference: envoy/accesslog.cc — the
+  // C++ side logs each verdict with the connection's identities).
+  uint64_t al_handle = m->accesslog.load();
+  if (al_handle != 0 && (passed_frames || dropped_frames)) {
+    auto al = find_accesslog(al_handle);
+    if (al) {
+      if (passed_frames) {
+        std::string j = verdict_record_json(
+            false, c->ingress, c->src_id, c->dst_id, c->src_addr.c_str(),
+            c->dst_addr.c_str(), c->proto.c_str(), "");
+        for (int64_t i = 0; i < passed_frames; i++)
+          al->send_frame(j.data(), j.size());
+      }
+      if (dropped_frames) {
+        std::string j = verdict_record_json(
+            true, c->ingress, c->src_id, c->dst_id, c->src_addr.c_str(),
+            c->dst_addr.c_str(), c->proto.c_str(), "");
+        for (int64_t i = 0; i < dropped_frames; i++)
+          al->send_frame(j.data(), j.size());
+      }
     }
   }
 
@@ -481,6 +685,107 @@ void cilium_tpu_close_connection(uint64_t module, uint64_t conn_id) {
   put<uint64_t>(&payload, conn_id);
   std::lock_guard<std::mutex> lk(m->io_mutex);
   send_msg(m->fd, kMsgClose, payload);
+}
+
+// --- access log client ABI -------------------------------------------------
+
+uint64_t cilium_tpu_accesslog_open(const char *socket_path) {
+  if (!socket_path || !*socket_path) return 0;
+  auto al = std::make_shared<AccessLog>();
+  al->path = socket_path;
+  std::lock_guard<std::mutex> lk(g_accesslog_mutex);
+  uint64_t handle = g_next_handle++;
+  g_accesslogs[handle] = std::move(al);
+  return handle;
+}
+
+void cilium_tpu_accesslog_close(uint64_t handle) {
+  std::shared_ptr<AccessLog> al;
+  {
+    std::lock_guard<std::mutex> lk(g_accesslog_mutex);
+    auto it = g_accesslogs.find(handle);
+    if (it == g_accesslogs.end()) return;
+    al = std::move(it->second);
+    g_accesslogs.erase(it);
+  }
+  // Close the fd under the send mutex so an in-flight send finishes
+  // first; stragglers then reconnect-fail harmlessly.
+  std::lock_guard<std::mutex> slk(al->mutex);
+  if (al->fd >= 0) {
+    ::close(al->fd);
+    al->fd = -1;
+  }
+}
+
+uint32_t cilium_tpu_accesslog_send_json(uint64_t handle, const char *json,
+                                        size_t len) {
+  auto al = find_accesslog(handle);
+  if (!al || !json) return 0;
+  return al->send_frame(json, len) ? 1 : 0;
+}
+
+uint32_t cilium_tpu_accesslog_log_verdict(
+    uint64_t handle, uint8_t denied, uint8_t ingress, uint32_t src_id,
+    uint32_t dst_id, const char *src_addr, const char *dst_addr,
+    const char *proto, const char *info) {
+  auto al = find_accesslog(handle);
+  if (!al) return 0;
+  std::string j = verdict_record_json(denied != 0, ingress != 0, src_id,
+                                      dst_id, src_addr ? src_addr : "",
+                                      dst_addr ? dst_addr : "",
+                                      proto ? proto : "",
+                                      info ? info : "");
+  return al->send_frame(j.data(), j.size()) ? 1 : 0;
+}
+
+void cilium_tpu_set_accesslog(uint64_t module, uint64_t accesslog) {
+  Module *m = find_module(module);
+  if (m) m->accesslog.store(accesslog);
+}
+
+// --- proxymap reader ABI ---------------------------------------------------
+
+uint64_t cilium_tpu_proxymap_open(const char *path) {
+  if (!path || !*path) return 0;
+  auto pm = std::make_shared<ProxyMapFile>();
+  pm->path = path;
+  if (pm->load() < 0) return 0;
+  std::lock_guard<std::mutex> lk(g_proxymap_mutex);
+  uint64_t handle = g_next_handle++;
+  g_proxymaps[handle] = std::move(pm);
+  return handle;
+}
+
+int64_t cilium_tpu_proxymap_refresh(uint64_t handle) {
+  auto pm = find_proxymap(handle);
+  if (!pm) return -1;
+  return pm->load();
+}
+
+uint32_t cilium_tpu_proxymap_lookup(uint64_t handle, uint32_t saddr,
+                                    uint32_t daddr, uint16_t sport,
+                                    uint16_t dport, uint8_t proto,
+                                    uint32_t *orig_daddr,
+                                    uint32_t *orig_dport,
+                                    uint32_t *identity) {
+  auto pm = find_proxymap(handle);
+  if (!pm) return 0;
+  std::lock_guard<std::mutex> lk(pm->mutex);
+  for (const auto &r : pm->recs) {
+    if (r.saddr == saddr && r.daddr == daddr && r.sport == sport &&
+        r.dport == dport && r.proto == proto) {
+      if (orig_daddr) *orig_daddr = r.orig_daddr;
+      if (orig_dport) *orig_dport = r.orig_dport;
+      if (identity) *identity = r.identity;
+      return 1;
+    }
+  }
+  return 0;
+}
+
+void cilium_tpu_proxymap_close(uint64_t handle) {
+  std::lock_guard<std::mutex> lk(g_proxymap_mutex);
+  g_proxymaps.erase(handle);
 }
 
 }  // extern "C"

@@ -191,3 +191,124 @@ def test_native_on_data_op_surface(shim, service):
     assert inj_r.raw[: inj_r_len.value] == b"ERROR\r\n"
     assert inj_o_len.value == 0
     shim.cilium_tpu_close_module(mod)
+
+
+# --- access log client (reference: envoy/accesslog.cc) ---------------------
+
+def test_native_accesslog_client(shim, tmp_path):
+    from cilium_tpu.accesslog.server import AccessLogServer
+
+    path = str(tmp_path / "al.sock")
+    srv = AccessLogServer(path)
+    try:
+        shim.cilium_tpu_accesslog_open.restype = ctypes.c_uint64
+        shim.cilium_tpu_accesslog_log_verdict.restype = ctypes.c_uint32
+        al = shim.cilium_tpu_accesslog_open(path.encode())
+        assert al != 0
+        ok = shim.cilium_tpu_accesslog_log_verdict(
+            al, 1, 1, 100, 200, b"1.2.3.4:55", b"5.6.7.8:80", b"r2d2",
+            b'say "hi"\\path',
+        )
+        assert ok == 1
+        import time
+
+        t0 = time.monotonic()
+        while not srv.records and time.monotonic() - t0 < 5:
+            time.sleep(0.02)
+        assert srv.records, "record not received"
+        rec = srv.records[0]
+        assert rec.verdict == "Denied"
+        assert rec.observation_point == "Ingress"
+        assert rec.source.identity == 100
+        assert rec.destination.identity == 200
+        assert rec.source.ipv4 == "1.2.3.4:55"
+        assert rec.info == 'say "hi"\\path'  # JSON escaping survived
+        assert rec.l7 is not None and rec.l7.proto == "r2d2"
+        shim.cilium_tpu_accesslog_close(al)
+    finally:
+        srv.close()
+
+
+def test_native_on_io_emits_access_logs(shim, service, tmp_path):
+    """With an accesslog attached, the shim logs one record per applied
+    PASS/DROP op group with the connection's identities (reference:
+    envoy/accesslog.cc per-request logging)."""
+    from cilium_tpu.accesslog.server import AccessLogServer
+
+    path = str(tmp_path / "al2.sock")
+    srv = AccessLogServer(path)
+    try:
+        shim.cilium_tpu_accesslog_open.restype = ctypes.c_uint64
+        mod = open_module(shim, service)
+        al = shim.cilium_tpu_accesslog_open(path.encode())
+        shim.cilium_tpu_set_accesslog(mod, al)
+        assert new_conn(shim, mod, 71) == OK
+        res, out = on_io(
+            shim, mod, 71, False,
+            b"READ /public/ok\r\nREAD /private/no\r\n",
+        )
+        assert res == OK
+        import time
+
+        t0 = time.monotonic()
+        while len(srv.records) < 2 and time.monotonic() - t0 < 5:
+            time.sleep(0.02)
+        verdicts = sorted(r.verdict for r in srv.records)
+        assert verdicts == ["Denied", "Forwarded"]
+        assert all(r.source.identity == 1 for r in srv.records)
+        shim.cilium_tpu_accesslog_close(al)
+        shim.cilium_tpu_close_module(mod)
+    finally:
+        srv.close()
+
+
+# --- proxymap reader (reference: envoy/proxymap.cc + bpf-metadata) ---------
+
+def test_native_proxymap_lookup_and_refresh(shim, tmp_path):
+    from cilium_tpu.maps.proxymap import ProxyKey4, ProxyMap
+
+    pm = ProxyMap()
+    key = ProxyKey4(saddr=0x0A000001, daddr=0x0A000002, sport=40000,
+                    dport=15000, nexthdr=6)
+    pm.create(key, orig_daddr=0xC0A80107, orig_dport=80, identity=7777)
+    path = str(tmp_path / "proxymap.bin")
+    assert pm.save(path) == 1
+
+    shim.cilium_tpu_proxymap_open.restype = ctypes.c_uint64
+    shim.cilium_tpu_proxymap_refresh.restype = ctypes.c_int64
+    shim.cilium_tpu_proxymap_lookup.restype = ctypes.c_uint32
+    h = shim.cilium_tpu_proxymap_open(path.encode())
+    assert h != 0
+
+    od = ctypes.c_uint32()
+    op = ctypes.c_uint32()
+    ident = ctypes.c_uint32()
+    hit = shim.cilium_tpu_proxymap_lookup(
+        h, ctypes.c_uint32(0x0A000001), ctypes.c_uint32(0x0A000002),
+        ctypes.c_uint16(40000), ctypes.c_uint16(15000), ctypes.c_uint8(6),
+        ctypes.byref(od), ctypes.byref(op), ctypes.byref(ident),
+    )
+    assert hit == 1
+    assert od.value == 0xC0A80107 and op.value == 80 and ident.value == 7777
+
+    # miss on a different tuple
+    miss = shim.cilium_tpu_proxymap_lookup(
+        h, ctypes.c_uint32(0x0A000001), ctypes.c_uint32(0x0A000002),
+        ctypes.c_uint16(40001), ctypes.c_uint16(15000), ctypes.c_uint8(6),
+        ctypes.byref(od), ctypes.byref(op), ctypes.byref(ident),
+    )
+    assert miss == 0
+
+    # datapath adds an entry + re-snapshots; refresh picks it up
+    key2 = ProxyKey4(saddr=0x0A000001, daddr=0x0A000002, sport=40001,
+                     dport=15000, nexthdr=6)
+    pm.create(key2, orig_daddr=0xC0A80108, orig_dport=443, identity=8888)
+    assert pm.save(path) == 2
+    assert shim.cilium_tpu_proxymap_refresh(h) == 2
+    hit2 = shim.cilium_tpu_proxymap_lookup(
+        h, ctypes.c_uint32(0x0A000001), ctypes.c_uint32(0x0A000002),
+        ctypes.c_uint16(40001), ctypes.c_uint16(15000), ctypes.c_uint8(6),
+        ctypes.byref(od), ctypes.byref(op), ctypes.byref(ident),
+    )
+    assert hit2 == 1 and od.value == 0xC0A80108 and ident.value == 8888
+    shim.cilium_tpu_proxymap_close(h)

@@ -1,0 +1,158 @@
+"""Workload runtime integration: event-driven endpoint label sync
+(reference: pkg/workloads — docker.go processEvent/handleCreateWorkload,
+watcher_state.go syncWithRuntime; the fake runtime mirrors
+docker.go newDockerClientMock)."""
+
+import pytest
+
+from cilium_tpu.daemon.daemon import Daemon
+from cilium_tpu.utils.option import DaemonConfig
+from cilium_tpu.workloads import (
+    EventType,
+    Workload,
+    WorkloadRuntime,
+    WorkloadWatcher,
+    get_runtime,
+    registered_runtimes,
+)
+
+
+class FakeRuntime(WorkloadRuntime):
+    name = "fake"
+
+    def __init__(self):
+        self.workloads: dict[str, Workload] = {}
+        self.inspect_calls = 0
+
+    def add(self, wid, labels, ipv4="", name=""):
+        self.workloads[wid] = Workload(
+            id=wid, name=name or wid, labels=labels, ipv4=ipv4
+        )
+
+    def inspect(self, workload_id):
+        self.inspect_calls += 1
+        return self.workloads.get(workload_id)
+
+    def list_workloads(self):
+        return sorted(self.workloads)
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = Daemon(DaemonConfig(state_dir=str(tmp_path), dry_mode=True,
+                            enable_health=False))
+    yield d
+    d.close()
+
+
+def wait_for(pred, timeout=5.0):
+    import time
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_runtime_registry_has_reference_modules():
+    assert {"docker", "crio", "containerd"} <= set(registered_runtimes())
+    rt = get_runtime("docker")
+    # No docker socket in this environment: status reports failure
+    # instead of raising (reference probes lazily too).
+    assert rt.status()["state"] == "failure"
+    with pytest.raises(ValueError):
+        get_runtime("rkt")
+
+
+def test_start_event_applies_runtime_labels(daemon):
+    rt = FakeRuntime()
+    rt.add("c1" * 32, {"app": "web", "tier": "fe"}, ipv4="10.7.0.1")
+    daemon.endpoint_create(301, ipv4="10.7.0.1", container_name="c1" * 32)
+    w = WorkloadWatcher(daemon, rt)
+    try:
+        w.enqueue("c1" * 32, EventType.START)
+        w.flush()
+        ep = daemon.endpoint_manager.lookup(301)
+        got = sorted(str(l) for l in ep.labels.values())
+        assert got == ["container:app=web", "container:tier=fe"]
+        # identity was reallocated for the new label set
+        assert ep.security_identity is not None
+        assert ep.security_identity.id >= 256
+    finally:
+        w.close()
+
+
+def test_delete_event_removes_endpoint(daemon):
+    rt = FakeRuntime()
+    rt.add("dead01", {"app": "db"})
+    daemon.endpoint_create(302, container_name="dead01")
+    w = WorkloadWatcher(daemon, rt)
+    try:
+        w.enqueue("dead01", EventType.DELETE)
+        w.flush()
+        assert wait_for(lambda: daemon.endpoint_manager.lookup(302) is None)
+    finally:
+        w.close()
+
+
+def test_correlation_retries_until_endpoint_appears(daemon):
+    """The endpoint may be created after the start event arrives
+    (reference: handleCreateWorkload's retry loop waits for it)."""
+    rt = FakeRuntime()
+    rt.add("late77", {"app": "late"}, ipv4="10.7.0.9")
+    w = WorkloadWatcher(daemon, rt, max_retries=20)
+    try:
+        w.enqueue("late77", EventType.START)
+        # create the endpoint while the watcher is retrying
+        import time
+
+        time.sleep(0.1)
+        daemon.endpoint_create(303, ipv4="10.7.0.9", container_name="late77")
+        assert wait_for(
+            lambda: any(
+                str(l) == "container:app=late"
+                for l in (daemon.endpoint_manager.lookup(303).labels or {}).values()
+            )
+        )
+    finally:
+        w.close()
+
+
+def test_periodic_sync_discovers_unseen_workloads(daemon):
+    rt = FakeRuntime()
+    rt.add("seen-by-sync", {"role": "worker"}, ipv4="10.7.0.20")
+    daemon.endpoint_create(304, ipv4="10.7.0.20",
+                           container_name="seen-by-sync")
+    w = WorkloadWatcher(daemon, rt)
+    try:
+        w.sync_with_runtime()
+        w.flush()
+        ep = daemon.endpoint_manager.lookup(304)
+        assert wait_for(
+            lambda: ["container:role=worker"]
+            == sorted(str(l) for l in ep.labels.values())
+        )
+        # a second sync enqueues nothing new (handler already exists)
+        handled = w.events_handled
+        w.sync_with_runtime()
+        w.flush()
+        assert w.events_handled == handled
+    finally:
+        w.close()
+
+
+def test_events_for_one_container_are_serialized(daemon):
+    """START then DELETE for the same container must apply in order."""
+    rt = FakeRuntime()
+    rt.add("ordered", {"app": "x"})
+    daemon.endpoint_create(305, container_name="ordered")
+    w = WorkloadWatcher(daemon, rt)
+    try:
+        w.enqueue("ordered", EventType.START)
+        w.enqueue("ordered", EventType.DELETE)
+        w.flush()
+        assert wait_for(lambda: daemon.endpoint_manager.lookup(305) is None)
+    finally:
+        w.close()
