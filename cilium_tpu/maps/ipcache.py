@@ -59,6 +59,35 @@ class IpcacheMap:
             (k, v[1]) for k, v in self.v6.items()
         )
 
+    def save(self, path: str) -> int:
+        """Write a v4 binary snapshot for the native datapath process
+        (the PolicyHostMap analog; reader: native/shim.cc
+        cilium_tpu_hostmap_open — reference: envoy/cilium_host_map.cc
+        PolicyHostMap, which subscribes the same IP->identity data via
+        NPHDS).  Layout: b"CTHM" + uint32 count + count * 4 LE uint32s
+        (network address host-order, prefix_len, sec_label,
+        tunnel_endpoint).  Atomic via tmp + rename.  Returns the entry
+        count."""
+        import os
+        import struct
+
+        recs = []
+        for net, info in self.v4.values():
+            recs.append(
+                struct.pack(
+                    "<4I",
+                    int(net.network_address), net.prefixlen,
+                    info.sec_label & 0xFFFFFFFF,
+                    info.tunnel_endpoint & 0xFFFFFFFF,
+                )
+            )
+        blob = b"CTHM" + struct.pack("<I", len(recs)) + b"".join(recs)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return len(recs)
+
     def to_device(
         self,
         v6: bool = False,

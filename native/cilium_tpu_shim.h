@@ -154,6 +154,26 @@ uint32_t cilium_tpu_proxymap_lookup(uint64_t handle, uint32_t saddr,
 
 void cilium_tpu_proxymap_close(uint64_t handle);
 
+/* ---- host map (reference: envoy/cilium_host_map.cc PolicyHostMap) ----
+ *
+ * IP -> security-identity longest-prefix lookup inside the datapath
+ * process, fed by ipcache snapshots
+ * (cilium_tpu/maps/ipcache.py IpcacheMap.save). */
+
+uint64_t cilium_tpu_hostmap_open(const char *path);
+
+/* Re-read if the snapshot changed; returns entry count or -1. */
+int64_t cilium_tpu_hostmap_refresh(uint64_t handle);
+
+/* Longest-prefix match for addr (host byte order).  On hit fills
+ * identity (and tunnel_endpoint if non-NULL) and returns the matched
+ * prefix length + 1; returns 0 on miss. */
+uint32_t cilium_tpu_hostmap_lookup(uint64_t handle, uint32_t addr,
+                                   uint32_t *identity,
+                                   uint32_t *tunnel_endpoint);
+
+void cilium_tpu_hostmap_close(uint64_t handle);
+
 #ifdef __cplusplus
 }
 #endif

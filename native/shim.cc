@@ -417,6 +417,66 @@ struct ProxyMapFile {
 std::mutex g_proxymap_mutex;
 std::map<uint64_t, std::shared_ptr<ProxyMapFile>> g_proxymaps;
 
+// --- host map snapshot (reference: envoy/cilium_host_map.cc) ---------------
+
+struct HostMapRec {
+  uint32_t addr, plen, identity, tunnel;
+};
+
+struct HostMapFile {
+  std::string path;
+  uint64_t mtime_ns = 0;
+  uint64_t size = 0;
+  std::vector<HostMapRec> recs;
+  std::mutex mutex;
+
+  // Layout (maps/ipcache.py IpcacheMap.save): "CTHM", uint32 count,
+  // count * 4 LE uint32s.  Same corruption/versioning rules as
+  // ProxyMapFile::load.
+  int64_t load() {
+    struct stat st {};
+    if (stat(path.c_str(), &st) != 0) return -1;
+    uint64_t ver = static_cast<uint64_t>(st.st_mtim.tv_sec) * 1000000000ull +
+                   static_cast<uint64_t>(st.st_mtim.tv_nsec);
+    {
+      std::lock_guard<std::mutex> lk(mutex);
+      if (mtime_ns != 0 && ver == mtime_ns &&
+          static_cast<uint64_t>(st.st_size) == size)
+        return static_cast<int64_t>(recs.size());
+    }
+    FILE *f = fopen(path.c_str(), "rb");
+    if (!f) return -1;
+    char magic[4];
+    uint32_t count = 0;
+    std::vector<HostMapRec> fresh;
+    bool ok = fread(magic, 1, 4, f) == 4 && memcmp(magic, "CTHM", 4) == 0 &&
+              fread(&count, 4, 1, f) == 1 &&
+              static_cast<uint64_t>(st.st_size) >=
+                  8 + static_cast<uint64_t>(count) * sizeof(HostMapRec);
+    if (ok) {
+      fresh.resize(count);
+      ok = count == 0 ||
+           fread(fresh.data(), sizeof(HostMapRec), count, f) == count;
+    }
+    fclose(f);
+    if (!ok) return -1;
+    std::lock_guard<std::mutex> lk(mutex);
+    recs = std::move(fresh);
+    mtime_ns = ver;
+    size = static_cast<uint64_t>(st.st_size);
+    return static_cast<int64_t>(recs.size());
+  }
+};
+
+std::mutex g_hostmap_mutex;
+std::map<uint64_t, std::shared_ptr<HostMapFile>> g_hostmaps;
+
+std::shared_ptr<HostMapFile> find_hostmap(uint64_t handle) {
+  std::lock_guard<std::mutex> lk(g_hostmap_mutex);
+  auto it = g_hostmaps.find(handle);
+  return it == g_hostmaps.end() ? nullptr : it->second;
+}
+
 std::shared_ptr<ProxyMapFile> find_proxymap(uint64_t handle) {
   std::lock_guard<std::mutex> lk(g_proxymap_mutex);
   auto it = g_proxymaps.find(handle);
@@ -786,6 +846,48 @@ uint32_t cilium_tpu_proxymap_lookup(uint64_t handle, uint32_t saddr,
 void cilium_tpu_proxymap_close(uint64_t handle) {
   std::lock_guard<std::mutex> lk(g_proxymap_mutex);
   g_proxymaps.erase(handle);
+}
+
+// --- host map ABI ----------------------------------------------------------
+
+uint64_t cilium_tpu_hostmap_open(const char *path) {
+  if (!path || !*path) return 0;
+  auto hm = std::make_shared<HostMapFile>();
+  hm->path = path;
+  if (hm->load() < 0) return 0;
+  std::lock_guard<std::mutex> lk(g_hostmap_mutex);
+  uint64_t handle = g_next_handle++;
+  g_hostmaps[handle] = std::move(hm);
+  return handle;
+}
+
+int64_t cilium_tpu_hostmap_refresh(uint64_t handle) {
+  auto hm = find_hostmap(handle);
+  if (!hm) return -1;
+  return hm->load();
+}
+
+uint32_t cilium_tpu_hostmap_lookup(uint64_t handle, uint32_t addr,
+                                   uint32_t *identity,
+                                   uint32_t *tunnel_endpoint) {
+  auto hm = find_hostmap(handle);
+  if (!hm) return 0;
+  std::lock_guard<std::mutex> lk(hm->mutex);
+  const HostMapRec *best = nullptr;
+  for (const auto &r : hm->recs) {
+    uint32_t mask =
+        r.plen == 0 ? 0u : ~((r.plen >= 32) ? 0u : ((1u << (32 - r.plen)) - 1u));
+    if ((addr & mask) == r.addr && (!best || r.plen > best->plen)) best = &r;
+  }
+  if (!best) return 0;
+  if (identity) *identity = best->identity;
+  if (tunnel_endpoint) *tunnel_endpoint = best->tunnel;
+  return best->plen + 1;
+}
+
+void cilium_tpu_hostmap_close(uint64_t handle) {
+  std::lock_guard<std::mutex> lk(g_hostmap_mutex);
+  g_hostmaps.erase(handle);
 }
 
 }  // extern "C"

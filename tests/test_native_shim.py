@@ -312,3 +312,59 @@ def test_native_proxymap_lookup_and_refresh(shim, tmp_path):
     )
     assert hit2 == 1 and od.value == 0xC0A80108 and ident.value == 8888
     shim.cilium_tpu_proxymap_close(h)
+
+
+# --- host map (reference: envoy/cilium_host_map.cc PolicyHostMap) ----------
+
+def test_native_hostmap_lpm(shim, tmp_path):
+    import ipaddress
+    import random
+
+    from cilium_tpu.maps.ipcache import IpcacheMap
+
+    ipc = IpcacheMap()
+    ipc.upsert("10.0.0.0/16", sec_label=500)
+    ipc.upsert("10.0.3.0/24", sec_label=103)
+    ipc.upsert("10.0.3.7/32", sec_label=777, tunnel_endpoint=0xC0A80102)
+    ipc.upsert("0.0.0.0/0", sec_label=2)  # world default
+    path = str(tmp_path / "hostmap.bin")
+    assert ipc.save(path) == 4
+
+    shim.cilium_tpu_hostmap_open.restype = ctypes.c_uint64
+    shim.cilium_tpu_hostmap_refresh.restype = ctypes.c_int64
+    shim.cilium_tpu_hostmap_lookup.restype = ctypes.c_uint32
+    h = shim.cilium_tpu_hostmap_open(path.encode())
+    assert h != 0
+
+    ident = ctypes.c_uint32()
+    tun = ctypes.c_uint32()
+
+    def lookup(ip):
+        r = shim.cilium_tpu_hostmap_lookup(
+            h, ctypes.c_uint32(int(ipaddress.IPv4Address(ip))),
+            ctypes.byref(ident), ctypes.byref(tun),
+        )
+        return r, ident.value, tun.value
+
+    # longest prefix wins at each level
+    assert lookup("10.0.3.7") == (33, 777, 0xC0A80102)
+    assert lookup("10.0.3.9")[:2] == (25, 103)
+    assert lookup("10.0.9.9")[:2] == (17, 500)
+    assert lookup("8.8.8.8")[:2] == (1, 2)  # default route
+
+    # fuzz parity with the host-side LPM
+    rng = random.Random(21)
+    for _ in range(200):
+        ip = str(ipaddress.IPv4Address(rng.getrandbits(32)))
+        want = ipc.lookup(ip)
+        r, got_id, _ = lookup(ip)
+        assert (r > 0) == (want is not None)
+        if want is not None:
+            assert got_id == want.sec_label, ip
+
+    # update + refresh
+    ipc.upsert("10.0.4.0/24", sec_label=104)
+    assert ipc.save(path) == 5
+    assert shim.cilium_tpu_hostmap_refresh(h) == 5
+    assert lookup("10.0.4.1")[:2] == (25, 104)
+    shim.cilium_tpu_hostmap_close(h)
