@@ -174,6 +174,26 @@ uint32_t cilium_tpu_hostmap_lookup(uint64_t handle, uint32_t addr,
 
 void cilium_tpu_hostmap_close(uint64_t handle);
 
+/* ---- accept-path composition (reference: envoy/cilium_bpf_metadata.cc
+ * onAccept + envoy/cilium_network_filter.cc onNewConnection) ----------
+ *
+ * One call for the datapath's connection-accept sequence: recover the
+ * original destination + source identity for the redirected 5-tuple
+ * from the proxymap, resolve identities via the host map (proxymap
+ * identity wins for the source; misses fall back to the host map, then
+ * to the reserved world identity), and register the connection with
+ * the verdict service.  Returns the registration's
+ * CiliumTpuFilterResult; on success fills orig_daddr/orig_dport/
+ * src_id/dst_id.  Addresses are host byte order. */
+uint32_t cilium_tpu_accept(uint64_t module, uint64_t proxymap,
+                           uint64_t hostmap, const char *l7_proto,
+                           uint64_t conn_id, uint8_t ingress,
+                           uint32_t saddr, uint32_t daddr, uint16_t sport,
+                           uint16_t dport, uint8_t proto_num,
+                           const char *policy_name, uint32_t *orig_daddr,
+                           uint32_t *orig_dport, uint32_t *src_id,
+                           uint32_t *dst_id);
+
 #ifdef __cplusplus
 }
 #endif

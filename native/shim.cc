@@ -890,4 +890,60 @@ void cilium_tpu_hostmap_close(uint64_t handle) {
   g_hostmaps.erase(handle);
 }
 
+// --- accept-path composition -----------------------------------------------
+
+uint32_t cilium_tpu_accept(uint64_t module, uint64_t proxymap,
+                           uint64_t hostmap, const char *l7_proto,
+                           uint64_t conn_id, uint8_t ingress,
+                           uint32_t saddr, uint32_t daddr, uint16_t sport,
+                           uint16_t dport, uint8_t proto_num,
+                           const char *policy_name, uint32_t *orig_daddr,
+                           uint32_t *orig_dport, uint32_t *src_id,
+                           uint32_t *dst_id) {
+  // 1. Original destination + source identity from the proxymap
+  // (cilium_bpf_metadata.cc getOriginalDst).
+  uint32_t od = daddr, op = dport, sid = 0;
+  uint32_t pm_od = 0, pm_op = 0, pm_id = 0;
+  bool redirected =
+      proxymap != 0 &&
+      cilium_tpu_proxymap_lookup(proxymap, saddr, daddr, sport, dport,
+                                 proto_num, &pm_od, &pm_op, &pm_id) == 1;
+  if (redirected) {
+    od = pm_od;
+    op = pm_op;
+    sid = pm_id;
+  }
+  // 2. Identity fallbacks via the host map (cilium_host_map.cc
+  // resolve); unknown addresses are the reserved world identity.
+  constexpr uint32_t kWorldId = 2;
+  uint32_t tmp_tun = 0;
+  if (sid == 0 && hostmap != 0)
+    if (cilium_tpu_hostmap_lookup(hostmap, saddr, &sid, &tmp_tun) == 0)
+      sid = 0;
+  if (sid == 0) sid = kWorldId;
+  uint32_t did = 0;
+  if (hostmap != 0)
+    if (cilium_tpu_hostmap_lookup(hostmap, od, &did, &tmp_tun) == 0)
+      did = 0;
+  if (did == 0) did = kWorldId;
+
+  // 3. Register with the verdict service using the ORIGINAL
+  // destination (cilium_network_filter.cc onNewConnection).
+  char src_str[32], dst_str[32];
+  snprintf(src_str, sizeof(src_str), "%u.%u.%u.%u:%u", saddr >> 24,
+           (saddr >> 16) & 255, (saddr >> 8) & 255, saddr & 255, sport);
+  snprintf(dst_str, sizeof(dst_str), "%u.%u.%u.%u:%u", od >> 24,
+           (od >> 16) & 255, (od >> 8) & 255, od & 255, op);
+  uint32_t res = cilium_tpu_on_new_connection(
+      module, l7_proto, conn_id, ingress, sid, did, src_str, dst_str,
+      policy_name);
+  if (res == CT_FILTER_OK) {
+    if (orig_daddr) *orig_daddr = od;
+    if (orig_dport) *orig_dport = op;
+    if (src_id) *src_id = sid;
+    if (dst_id) *dst_id = did;
+  }
+  return res;
+}
+
 }  // extern "C"

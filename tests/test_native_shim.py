@@ -368,3 +368,84 @@ def test_native_hostmap_lpm(shim, tmp_path):
     assert shim.cilium_tpu_hostmap_refresh(h) == 5
     assert lookup("10.0.4.1")[:2] == (25, 104)
     shim.cilium_tpu_hostmap_close(h)
+
+
+# --- accept-path composition (reference: cilium_bpf_metadata.cc +
+# cilium_network_filter.cc) -------------------------------------------------
+
+def test_native_accept_recovers_origdst_and_identities(shim, service, tmp_path):
+    """One cilium_tpu_accept call recovers orig-dst from the proxymap,
+    resolves identities from the host map, and registers the
+    connection so traffic flows end-to-end under the module's policy."""
+    import ipaddress
+
+    from cilium_tpu.maps.ipcache import IpcacheMap
+    from cilium_tpu.maps.proxymap import ProxyKey4, ProxyMap
+
+    ipi = lambda s: int(ipaddress.IPv4Address(s))
+
+    # Datapath state: client 10.1.0.5 was redirected to proxy port
+    # 15000 while connecting to 10.2.0.9:80.
+    pmap = ProxyMap()
+    pmap.create(
+        ProxyKey4(saddr=ipi("10.1.0.5"), daddr=ipi("10.0.0.1"),
+                  sport=41000, dport=15000, nexthdr=6),
+        orig_daddr=ipi("10.2.0.9"), orig_dport=80, identity=1,
+    )
+    pm_path = str(tmp_path / "pm.bin")
+    pmap.save(pm_path)
+
+    ipc = IpcacheMap()
+    ipc.upsert("10.1.0.0/16", sec_label=1)
+    ipc.upsert("10.2.0.9/32", sec_label=2)
+    hm_path = str(tmp_path / "hm.bin")
+    ipc.save(hm_path)
+
+    shim.cilium_tpu_proxymap_open.restype = ctypes.c_uint64
+    shim.cilium_tpu_hostmap_open.restype = ctypes.c_uint64
+    shim.cilium_tpu_accept.restype = ctypes.c_uint32
+    pm = shim.cilium_tpu_proxymap_open(pm_path.encode())
+    hm = shim.cilium_tpu_hostmap_open(hm_path.encode())
+    assert pm and hm
+
+    mod = open_module(shim, service)
+    od = ctypes.c_uint32()
+    op = ctypes.c_uint32()
+    sid = ctypes.c_uint32()
+    did = ctypes.c_uint32()
+    res = shim.cilium_tpu_accept(
+        mod, pm, hm, b"r2d2", 91, 1,
+        ctypes.c_uint32(ipi("10.1.0.5")), ctypes.c_uint32(ipi("10.0.0.1")),
+        ctypes.c_uint16(41000), ctypes.c_uint16(15000), ctypes.c_uint8(6),
+        b"native-pol",
+        ctypes.byref(od), ctypes.byref(op), ctypes.byref(sid),
+        ctypes.byref(did),
+    )
+    assert res == OK
+    assert od.value == ipi("10.2.0.9") and op.value == 80
+    assert sid.value == 1 and did.value == 2  # proxymap + hostmap
+
+    # The registered connection enforces the module's policy.
+    r, out = on_io(shim, mod, 91, False, b"READ /public/a\r\n")
+    assert r == OK and out == b"READ /public/a\r\n"
+
+    # A non-redirected tuple (proxymap miss): falls back to the host
+    # map for the source; an unknown source resolves to world (2),
+    # which the policy denies.
+    res2 = shim.cilium_tpu_accept(
+        mod, pm, hm, b"r2d2", 92, 1,
+        ctypes.c_uint32(ipi("203.0.113.7")), ctypes.c_uint32(ipi("10.2.0.9")),
+        ctypes.c_uint16(5555), ctypes.c_uint16(80), ctypes.c_uint8(6),
+        b"native-pol",
+        ctypes.byref(od), ctypes.byref(op), ctypes.byref(sid),
+        ctypes.byref(did),
+    )
+    assert res2 == OK
+    assert od.value == ipi("10.2.0.9") and op.value == 80  # unchanged
+    assert sid.value == 2  # world
+    r2, out2 = on_io(shim, mod, 92, False, b"READ /private/x\r\n")
+    assert r2 == OK and out2 == b""  # denied by the file rule
+
+    shim.cilium_tpu_proxymap_close(pm)
+    shim.cilium_tpu_hostmap_close(hm)
+    shim.cilium_tpu_close_module(mod)
