@@ -92,6 +92,19 @@ def device_dfa(tables: DfaTables) -> DeviceDfa:
     )
 
 
+def byte_class_onehot(dfa: DeviceDfa, byte_col: jax.Array) -> jax.Array:
+    """[F] bytes -> [F, C] one-hot byte classes (shared by the serial
+    scan and the sequence-sharded fold so the two paths cannot drift)."""
+    byte_ids = jnp.arange(256, dtype=jnp.int32)
+    byte_1h = (byte_col[:, None] == byte_ids[None, :]).astype(jnp.int8)
+    return jax.lax.dot_general(
+        byte_1h,
+        dfa.classmap_1h,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.int8)
+
+
 def _accepts(state: jax.Array, mask: jax.Array) -> jax.Array:
     """[F, R] bool: the one-hot state is in the mask."""
     return (
@@ -112,18 +125,11 @@ def _dfa_scan(dfa: DeviceDfa, data, span_start, span_end):
     accepted0 = _accepts(state0, dfa.accept_mask)
 
     data_t = data.T  # [L, F]
-    byte_ids = jnp.arange(256, dtype=jnp.int32)
 
     def step(carry, inputs):
         state, accepted = carry
         byte_col, t = inputs  # [F]
-        byte_1h = (byte_col[:, None] == byte_ids[None, :]).astype(jnp.int8)
-        cls1h = jax.lax.dot_general(
-            byte_1h,
-            dfa.classmap_1h,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        ).astype(jnp.int8)  # [F, C]
+        cls1h = byte_class_onehot(dfa, byte_col)  # [F, C]
         # joint[f, r, s*C + c] = state[f,r,s] * cls1h[f,c]
         joint = (
             state[:, :, :, None] * cls1h[:, None, None, :]
