@@ -96,7 +96,10 @@ def pack_ct(ct: CtMap) -> DeviceTable:
     ct_lookup4 treats them as misses (conntrack.h lifetime check), so
     the snapshot filters on lifetime like CtMap.lookup does."""
     now = int(ct.clock())
-    live = [k for k, e in ct.entries.items() if e.lifetime >= now]
+    live = [
+        k for k, e in ct.entries.items()
+        if e.lifetime >= now and isinstance(k, CtKey4)
+    ]
     keys = np.zeros((len(live), 5), np.int64)
     for i, k in enumerate(live):
         keys[i] = (k.daddr, k.saddr, k.dport, k.sport, k.nexthdr)

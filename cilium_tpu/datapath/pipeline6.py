@@ -1,0 +1,253 @@
+"""Composed IPv6 L3/L4 datapath step — the v6 twin of pipeline.py.
+
+The batched analog of the reference's per-packet IPv6 egress pipeline
+(reference: bpf/bpf_lxc.c:418 tail_handle_ipv6 → handle_ipv6_from_lxc):
+the same five stages as the v4 pass — lb6 service translation, v6
+conntrack, v6 ipcache LPM identity, policy cascade, verdict — with
+every address carried as FOUR int32 word lanes (the word order of
+ops/lpm.ipv6_to_words), so the whole dual-stack datapath shares one
+policy table and one verdict vocabulary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..maps.ctmap import CtKey6, CtMap
+from ..maps.ipcache import IpcacheMap
+from ..maps.lbmap import DeviceLb6Map, LbMap, lb6_select_backend_batch
+from ..maps.policymap import (
+    DIR_EGRESS,
+    DevicePolicyMap,
+    PolicyMap,
+    policy_can_access_batch,
+)
+from ..ops.lpm import DeviceLpm, lpm_lookup
+from ..ops.maplookup import DeviceTable, exact_lookup, pack_table, u32_to_i32
+from .pipeline import DROP, FORWARD, TO_PROXY, WORLD_ID, flow_hash32
+
+
+def flow_hash32_v6(saddr_w, daddr_w, sport, dport, proto):
+    """v6 flow hash: fold the word lanes into the v4 hash shape so host
+    and device agree (any fixed function works; see flow_hash32)."""
+    s = saddr_w[0]
+    d = daddr_w[0]
+    for w in range(1, 4):
+        s = s ^ (saddr_w[w] * np.int32(31))
+        d = d ^ (daddr_w[w] * np.int32(131))
+    return flow_hash32(s, d, sport, dport, proto)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DatapathTables6:
+    """Device snapshot of the v6 maps."""
+
+    ct: DeviceTable  # 11 cols: d0..d3, s0..s3, dport, sport, proto
+    lb: DeviceLb6Map
+    ipcache: DeviceLpm  # v6 (4-word)
+    policy: DevicePolicyMap
+
+    def tree_flatten(self):
+        return ((self.ct, self.lb, self.ipcache, self.policy), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+def pack_ct6(ct: CtMap) -> DeviceTable:
+    """Snapshot live v6 CT entries (CtKey6) into an 11-column device
+    exact-match table; expired entries are filtered like pack_ct."""
+    now = int(ct.clock())
+    live = [
+        k for k, e in ct.entries.items()
+        if e.lifetime >= now and isinstance(k, CtKey6)
+    ]
+    keys = np.zeros((len(live), 11), np.int64)
+    for i, k in enumerate(live):
+        keys[i, 0:4] = CtKey6.words(k.daddr)
+        keys[i, 4:8] = CtKey6.words(k.saddr)
+        keys[i, 8:11] = (k.dport, k.sport, k.nexthdr)
+    vals = np.zeros((len(live), 1), np.int64)
+    return pack_table(u32_to_i32(keys), vals)
+
+
+def build_tables6(
+    ct: CtMap, lb: LbMap, ipcache: IpcacheMap, policy: PolicyMap
+) -> DatapathTables6:
+    return DatapathTables6(
+        ct=pack_ct6(ct),
+        lb=lb.to_device6(),
+        ipcache=ipcache.to_device(v6=True),
+        policy=policy.to_device(),
+    )
+
+
+@jax.jit
+def datapath_verdicts6(
+    tables: DatapathTables6,
+    saddr_w,  # 4-tuple of [F] int32 word arrays
+    daddr_w,  # 4-tuple of [F] int32
+    sport: jax.Array,
+    dport: jax.Array,
+    proto: jax.Array,
+):
+    """One composed v6 device pass; mirrors datapath_verdicts' output
+    dict with new_daddr_words instead of new_daddr."""
+    saddr_w = tuple(jnp.asarray(w, jnp.int32) for w in saddr_w)
+    daddr_w = tuple(jnp.asarray(w, jnp.int32) for w in daddr_w)
+    sport = jnp.asarray(sport, jnp.int32)
+    dport = jnp.asarray(dport, jnp.int32)
+    proto = jnp.asarray(proto, jnp.int32)
+
+    # 1. lb6 service translation (reference: lb.h lb6_lookup_service).
+    fh = flow_hash32_v6(saddr_w, daddr_w, sport, dport, proto)
+    svc_found, be_words, be_port, rev_nat = lb6_select_backend_batch(
+        tables.lb, daddr_w, dport, fh
+    )
+    new_daddr_w = tuple(
+        jnp.where(svc_found, be_words[w], daddr_w[w]) for w in range(4)
+    )
+    new_dport = jnp.where(svc_found, be_port, dport)
+
+    # 2. v6 conntrack on the post-DNAT tuple.
+    est, _ = exact_lookup(
+        tables.ct, *new_daddr_w, *saddr_w, new_dport, sport, proto
+    )
+
+    # 3. Destination identity from the v6 ipcache LPM.
+    ip_found, ident, _plen = lpm_lookup(tables.ipcache, *new_daddr_w)
+    dst_id = jnp.where(ip_found, ident, jnp.int32(WORLD_ID))
+
+    # 4. Policy cascade (identity-based — shared with v4).
+    allowed, proxy_port = policy_can_access_batch(
+        tables.policy, dst_id, new_dport, proto, direction=DIR_EGRESS
+    )
+
+    pass_ok = est | allowed
+    verdict = jnp.where(
+        pass_ok,
+        jnp.where((proxy_port > 0) & ~est, TO_PROXY, FORWARD),
+        DROP,
+    )
+    return {
+        "verdict": verdict,
+        "new_daddr_words": new_daddr_w,
+        "new_dport": new_dport,
+        "dst_identity": dst_id,
+        "proxy_port": jnp.where(est, 0, proxy_port),
+        "rev_nat": jnp.where(svc_found, rev_nat, 0),
+        # Encap selection lives in the node-ingress programs; carried
+        # as zeros like the v4 pass so dual-stack callers share code.
+        "tunnel_endpoint": jnp.zeros_like(dst_id),
+        "established": est,
+        "needs_ct_create": pass_ok & ~est,
+    }
+
+
+def apply_ct_creates6(ct: CtMap, out: dict, saddr_w, sport, proto) -> int:
+    """Host-side follow-up for allowed new v6 flows (the v4 twin is
+    pipeline.apply_ct_creates).  saddr_w is the 4-tuple of source word
+    arrays the pipeline was called with.  Returns entries created."""
+    need = np.asarray(out["needs_ct_create"])
+    ndw = [np.asarray(w).view(np.uint32) for w in out["new_daddr_words"]]
+    saw = [np.asarray(w).view(np.uint32) for w in saddr_w]
+    np_ = np.asarray(out["new_dport"])
+    ids = np.asarray(out["dst_identity"])
+    rev = np.asarray(out["rev_nat"])
+    sp = np.asarray(sport)
+    pr = np.asarray(proto)
+
+    def join(ws, i):
+        addr = 0
+        for w in range(4):
+            addr = (addr << 32) | int(ws[w][i])
+        return addr
+
+    created = 0
+    for i in np.flatnonzero(need):
+        ct.create(
+            CtKey6(
+                daddr=join(ndw, i),
+                saddr=join(saw, i),
+                dport=int(np_[i]),
+                sport=int(sp[i]),
+                nexthdr=int(pr[i]),
+            ),
+            src_sec_id=int(ids[i]),
+            rev_nat_index=int(rev[i]),
+        )
+        created += 1
+    return created
+
+
+def host_oracle6(
+    ct: CtMap,
+    lb: LbMap,
+    ipcache: IpcacheMap,
+    policy: PolicyMap,
+    saddr: int,
+    daddr: int,
+    sport: int,
+    dport: int,
+    proto: int,
+) -> dict:
+    """Reference-semantics host walk (the v6 fuzz oracle)."""
+    import ipaddress
+
+    def i32w(addr: int):
+        return tuple(
+            np.int32(u32_to_i32(w)) for w in CtKey6.words(addr)
+        )
+
+    with np.errstate(over="ignore"):
+        fh = int(
+            flow_hash32_v6(
+                i32w(saddr), i32w(daddr), np.int32(sport), np.int32(dport),
+                np.int32(proto),
+            )
+        )
+    be = lb.select_backend6(daddr, dport, fh)
+    svc_found = be is not None
+    new_daddr = be.target if svc_found else daddr
+    new_dport = be.port if svc_found else dport
+    rev = 0
+    if svc_found:
+        master = lb.lookup_service6(daddr, dport)
+        rev = master.rev_nat_index if master else 0
+
+    key = CtKey6(
+        daddr=new_daddr, saddr=saddr, dport=new_dport, sport=sport,
+        nexthdr=proto,
+    )
+    entry = ct.entries.get(key)
+    est = entry is not None and entry.lifetime >= int(ct.clock())
+
+    info = ipcache.lookup(str(ipaddress.IPv6Address(new_daddr)))
+    dst_id = info.sec_label if info is not None else WORLD_ID
+
+    allowed, proxy_port = policy.lookup(
+        dst_id, new_dport, proto, direction=DIR_EGRESS, count_packets=False
+    )
+    pass_ok = est or allowed
+    if not pass_ok:
+        verdict = DROP
+    elif proxy_port > 0 and not est:
+        verdict = TO_PROXY
+    else:
+        verdict = FORWARD
+    return {
+        "verdict": verdict,
+        "new_daddr": new_daddr,
+        "new_dport": new_dport,
+        "dst_identity": dst_id,
+        "proxy_port": 0 if est else proxy_port,
+        "rev_nat": rev if svc_found else 0,
+        "established": est,
+        "needs_ct_create": pass_ok and not est,
+    }

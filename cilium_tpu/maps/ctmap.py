@@ -32,6 +32,10 @@ PROTO_UDP = 17
 _TUPLE4_FMT = "<IIHHBB"
 TUPLE4_SIZE = struct.calcsize(_TUPLE4_FMT)  # 14 (packed)
 
+# reference: bpf/lib/common.h ipv6_ct_tuple (two 16-byte addresses).
+_TUPLE6_FMT = "<16s16sHHBB"
+TUPLE6_SIZE = struct.calcsize(_TUPLE6_FMT)  # 38 (packed)
+
 
 @dataclass(frozen=True)
 class CtKey4:
@@ -48,6 +52,33 @@ class CtKey4:
         return struct.pack(
             _TUPLE4_FMT, self.daddr, self.saddr, self.dport, self.sport,
             self.nexthdr, self.flags,
+        )
+
+
+@dataclass(frozen=True)
+class CtKey6:
+    """IPv6 CT tuple (reference: common.h ipv6_ct_tuple).  Addresses
+    are 128-bit ints; the device table splits them into four 32-bit
+    words with the same word order as ops/lpm.ipv6_to_words."""
+
+    daddr: int
+    saddr: int
+    dport: int
+    sport: int
+    nexthdr: int
+    flags: int = TUPLE_F_OUT
+
+    def pack(self) -> bytes:
+        return struct.pack(
+            _TUPLE6_FMT,
+            self.daddr.to_bytes(16, "big"), self.saddr.to_bytes(16, "big"),
+            self.dport, self.sport, self.nexthdr, self.flags,
+        )
+
+    @staticmethod
+    def words(addr: int) -> tuple[int, int, int, int]:
+        return tuple(
+            (addr >> (128 - 32 * (w + 1))) & 0xFFFFFFFF for w in range(4)
         )
 
 

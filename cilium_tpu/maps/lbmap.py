@@ -55,67 +55,107 @@ class LbMap:
     """Host service table (reference: pkg/maps/lbmap)."""
 
     def __init__(self) -> None:
+        # v4 and v6 services live in separate tables with separate
+        # RevNAT registries, mirroring the reference's distinct
+        # cilium_lb4_/cilium_lb6_ maps (bpf/lib/maps.h) — a numeric vip
+        # alone cannot identify the family (::1 == 1).
         self.services: dict[LbKey, LbBackend] = {}
         self.revnat: dict[int, tuple[int, int]] = {}  # index -> (vip, port)
+        self.services6: dict[LbKey, LbBackend] = {}
+        self.revnat6: dict[int, tuple[int, int]] = {}
+
+    @staticmethod
+    def _upsert(services, revnat, vip, dport, backends, rev_nat_index):
+        # Remove old slaves beyond the new count, and the old RevNAT entry
+        # if the service's rev_nat_index changed.
+        old = services.get(LbKey(vip, dport, 0))
+        if old is not None:
+            for s in range(len(backends) + 1, old.count + 1):
+                services.pop(LbKey(vip, dport, s), None)
+            if old.rev_nat_index and old.rev_nat_index != rev_nat_index:
+                revnat.pop(old.rev_nat_index, None)
+        services[LbKey(vip, dport, 0)] = LbBackend(
+            count=len(backends), rev_nat_index=rev_nat_index
+        )
+        for i, (target, port) in enumerate(backends, start=1):
+            services[LbKey(vip, dport, i)] = LbBackend(
+                target=target, port=port, rev_nat_index=rev_nat_index
+            )
+        if rev_nat_index:
+            revnat[rev_nat_index] = (vip, dport)
 
     def upsert_service(
         self, vip: int, dport: int, backends: list[tuple[int, int]],
         rev_nat_index: int = 0,
     ) -> None:
-        """Install a service with its backends; master entry at slave 0,
-        backends at slaves 1..n (reference: lbmap service layout)."""
-        # Remove old slaves beyond the new count, and the old RevNAT entry
-        # if the service's rev_nat_index changed.
-        old = self.services.get(LbKey(vip, dport, 0))
-        if old is not None:
-            for s in range(len(backends) + 1, old.count + 1):
-                self.services.pop(LbKey(vip, dport, s), None)
-            if old.rev_nat_index and old.rev_nat_index != rev_nat_index:
-                self.revnat.pop(old.rev_nat_index, None)
-        self.services[LbKey(vip, dport, 0)] = LbBackend(
-            count=len(backends), rev_nat_index=rev_nat_index
-        )
-        for i, (target, port) in enumerate(backends, start=1):
-            self.services[LbKey(vip, dport, i)] = LbBackend(
-                target=target, port=port, rev_nat_index=rev_nat_index
-            )
-        if rev_nat_index:
-            self.revnat[rev_nat_index] = (vip, dport)
+        """Install a v4 service with its backends; master entry at slave
+        0, backends at slaves 1..n (reference: lbmap service layout)."""
+        self._upsert(self.services, self.revnat, vip, dport, backends,
+                     rev_nat_index)
 
-    def delete_service(self, vip: int, dport: int) -> bool:
-        master = self.services.pop(LbKey(vip, dport, 0), None)
+    def upsert_service6(
+        self, vip: int, dport: int, backends: list[tuple[int, int]],
+        rev_nat_index: int = 0,
+    ) -> None:
+        """v6 twin (reference: cilium_lb6_services)."""
+        self._upsert(self.services6, self.revnat6, vip, dport, backends,
+                     rev_nat_index)
+
+    @staticmethod
+    def _delete(services, revnat, vip, dport) -> bool:
+        master = services.pop(LbKey(vip, dport, 0), None)
         if master is None:
             return False
         for s in range(1, master.count + 1):
-            self.services.pop(LbKey(vip, dport, s), None)
+            services.pop(LbKey(vip, dport, s), None)
         if master.rev_nat_index:
-            self.revnat.pop(master.rev_nat_index, None)
+            revnat.pop(master.rev_nat_index, None)
         return True
 
-    def lookup_service(self, vip: int, dport: int) -> LbBackend | None:
-        """L4 first, then L3 wildcard-port (reference: lb.h:604-630)."""
+    def delete_service(self, vip: int, dport: int) -> bool:
+        return self._delete(self.services, self.revnat, vip, dport)
+
+    def delete_service6(self, vip: int, dport: int) -> bool:
+        return self._delete(self.services6, self.revnat6, vip, dport)
+
+    @staticmethod
+    def _lookup(services, vip, dport) -> LbBackend | None:
         if dport:
-            svc = self.services.get(LbKey(vip, dport, 0))
+            svc = services.get(LbKey(vip, dport, 0))
             if svc is not None and svc.count:
                 return svc
-        svc = self.services.get(LbKey(vip, 0, 0))
+        svc = services.get(LbKey(vip, 0, 0))
         if svc is not None and svc.count:
             return svc
         return None
+
+    def lookup_service(self, vip: int, dport: int) -> LbBackend | None:
+        """L4 first, then L3 wildcard-port (reference: lb.h:604-630)."""
+        return self._lookup(self.services, vip, dport)
+
+    def lookup_service6(self, vip: int, dport: int) -> LbBackend | None:
+        return self._lookup(self.services6, vip, dport)
+
+    @staticmethod
+    def _select(services, vip, dport, flow_hash):
+        key_port = dport
+        svc = services.get(LbKey(vip, dport, 0)) if dport else None
+        if svc is None or not svc.count:
+            key_port = 0
+            svc = services.get(LbKey(vip, 0, 0))
+        if svc is None or not svc.count:
+            return None
+        slave = ((flow_hash & 0xFFFFFFFF) % svc.count) + 1
+        return services.get(LbKey(vip, key_port, slave))
 
     def select_backend(self, vip: int, dport: int, flow_hash: int):
         """Host-side backend pick (reference: lb.h lb4_select_slave +
         lb4_lookup_slave): slave = hash % count + 1.  The hash is treated
         as a uint32 bit pattern so host and device picks agree."""
-        key_port = dport
-        svc = self.services.get(LbKey(vip, dport, 0)) if dport else None
-        if svc is None or not svc.count:
-            key_port = 0
-            svc = self.services.get(LbKey(vip, 0, 0))
-        if svc is None or not svc.count:
-            return None
-        slave = ((flow_hash & 0xFFFFFFFF) % svc.count) + 1
-        return self.services.get(LbKey(vip, key_port, slave))
+        return self._select(self.services, vip, dport, flow_hash)
+
+    def select_backend6(self, vip: int, dport: int, flow_hash: int):
+        return self._select(self.services6, vip, dport, flow_hash)
 
     def dump(self):
         return sorted(
@@ -162,6 +202,57 @@ class LbMap:
             counts=jnp.asarray(counts),
             revnat=jnp.asarray(revnat),
             b_target=jnp.asarray(b_target.astype(np.uint32).view(np.int32)),
+            b_port=jnp.asarray(b_port),
+            valid=jnp.asarray(valid),
+        )
+
+
+    def to_device6(self, max_backends: int | None = None) -> "DeviceLb6Map":
+        """v6 export: vips/backends as four 32-bit word columns (same
+        word order as ops/lpm.ipv6_to_words); reference: bpf/lib/lb.h
+        lb6_lookup_service/lb6_select_slave — the v6 twins of the v4
+        path with wider keys."""
+        from .ctmap import CtKey6
+        from ..ops.maplookup import u32_to_i32
+
+        words = CtKey6.words
+        masters = [
+            (k, v)
+            for k, v in self.services6.items() if k.slave == 0 and v.count
+        ]
+        widest = max((v.count for _, v in masters), default=1)
+        if max_backends is None:
+            max_backends = widest
+        elif max_backends < widest:
+            raise ValueError(
+                f"max_backends {max_backends} < widest service {widest}"
+            )
+        s = max(len(masters), 1)
+        vip_w = np.zeros((4, s), np.int64)
+        ports = np.zeros((s,), np.int64)
+        counts = np.zeros((s,), np.int32)
+        revnat = np.zeros((s,), np.int32)
+        bt_w = np.zeros((4, s, max_backends), np.int64)
+        b_port = np.zeros((s, max_backends), np.int32)
+        valid = np.zeros((s,), bool)
+        for i, (k, master) in enumerate(masters):
+            vip_w[:, i] = words(k.address)
+            ports[i] = k.dport
+            counts[i] = min(master.count, max_backends)
+            revnat[i] = master.rev_nat_index
+            valid[i] = True
+            for b in range(counts[i]):
+                be = self.services6.get(LbKey(k.address, k.dport, b + 1))
+                if be is not None:
+                    bt_w[:, i, b] = words(be.target)
+                    b_port[i, b] = be.port
+        as_i32 = u32_to_i32
+        return DeviceLb6Map(
+            vip_words=jnp.asarray(as_i32(vip_w)),
+            ports=jnp.asarray(ports.astype(np.int32)),
+            counts=jnp.asarray(counts),
+            revnat=jnp.asarray(revnat),
+            b_target_words=jnp.asarray(as_i32(bt_w)),
             b_port=jnp.asarray(b_port),
             valid=jnp.asarray(valid),
         )
@@ -233,3 +324,63 @@ def lb4_select_backend_batch(dlb: DeviceLbMap, vips, dports, flow_hashes):
         jnp.where(found, port, zero),
         jnp.where(found, rev, zero),
     )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DeviceLb6Map:
+    vip_words: jax.Array  # [4, S] int32
+    ports: jax.Array  # [S] int32
+    counts: jax.Array  # [S] int32
+    revnat: jax.Array  # [S] int32
+    b_target_words: jax.Array  # [4, S, B] int32
+    b_port: jax.Array  # [S, B] int32
+    valid: jax.Array  # [S] bool
+
+    def tree_flatten(self):
+        return (
+            (self.vip_words, self.ports, self.counts, self.revnat,
+             self.b_target_words, self.b_port, self.valid),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+def lb6_select_backend_batch(dlb: "DeviceLb6Map", vip_words, dports,
+                             flow_hashes):
+    """v6 batched service lookup + backend selection: vip_words is a
+    4-tuple of [F] int32 word arrays.  Returns (found, target_words
+    4-tuple, port, rev_nat_index) — the v6 twin of
+    lb4_select_backend_batch (reference: bpf/lib/lb.h lb6_*)."""
+    vw = [jnp.asarray(w, jnp.int32) for w in vip_words]
+    dports = jnp.asarray(dports, jnp.int32)
+    flow_hashes = jnp.asarray(flow_hashes, jnp.int32)
+
+    def service_match(port_query):
+        m = dlb.valid[None, :] & (dlb.ports[None, :] == port_query[:, None])
+        for w in range(4):
+            m = m & (dlb.vip_words[w][None, :] == vw[w][:, None])
+        found = jnp.any(m, axis=1)
+        idx = jnp.argmax(m, axis=1)
+        return found, idx
+
+    f_l4, i_l4 = service_match(dports)
+    f_l3, i_l3 = service_match(jnp.zeros_like(dports))
+    found = f_l4 | f_l3
+    idx = jnp.where(f_l4, i_l4, i_l3)
+
+    count = jnp.maximum(dlb.counts[idx], 1)
+    slave = (
+        flow_hashes.astype(jnp.uint32) % count.astype(jnp.uint32)
+    ).astype(jnp.int32)
+    zero = jnp.zeros_like(idx, dtype=jnp.int32)
+    target_words = tuple(
+        jnp.where(found, dlb.b_target_words[w][idx, slave], zero)
+        for w in range(4)
+    )
+    port = jnp.where(found, dlb.b_port[idx, slave], zero)
+    rev = jnp.where(found, dlb.revnat[idx], zero)
+    return found, target_words, port, rev

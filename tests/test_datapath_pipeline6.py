@@ -1,0 +1,164 @@
+"""Composed IPv6 datapath pipeline vs host oracle (reference:
+bpf/bpf_lxc.c:418 tail_handle_ipv6 / handle_ipv6_from_lxc)."""
+
+import ipaddress
+import random
+
+import numpy as np
+
+from cilium_tpu.datapath.pipeline6 import (
+    DROP,
+    FORWARD,
+    TO_PROXY,
+    build_tables6,
+    datapath_verdicts6,
+    host_oracle6,
+)
+from cilium_tpu.maps.ctmap import CtKey6, CtMap, PROTO_TCP, PROTO_UDP
+from cilium_tpu.maps.ipcache import IpcacheMap
+from cilium_tpu.maps.lbmap import LbMap
+from cilium_tpu.maps.policymap import DIR_EGRESS, PolicyMap
+from cilium_tpu.ops.lpm import ipv6_to_words
+
+
+def ip6(s: str) -> int:
+    return int(ipaddress.IPv6Address(s))
+
+
+def build_world(rng):
+    lb = LbMap()
+    for s in range(4):
+        vip = ip6(f"fd00:aa::{s + 1}")
+        backends = [
+            (ip6(f"fd00:be::{s * 8 + b + 1}"), 8000 + b)
+            for b in range(rng.randrange(1, 4))
+        ]
+        lb.upsert_service6(vip, 80, backends, rev_nat_index=s + 1)
+    ipc = IpcacheMap()
+    for i in range(8):
+        ipc.upsert(f"fd00:{i:x}::/64", sec_label=200 + i)
+    ipc.upsert("fd00:be::/64", sec_label=600)
+    ipc.upsert("fd00:3::7/128", sec_label=777)
+    pol = PolicyMap()
+    for ident in (200, 201, 600, 777):
+        if rng.random() < 0.7:
+            pol.allow(ident, 8000, PROTO_TCP, DIR_EGRESS,
+                      proxy_port=16000 if rng.random() < 0.4 else 0)
+    pol.allow(0, 53, PROTO_UDP, DIR_EGRESS)
+    ct = CtMap()
+    # some established v6 flows
+    for k in range(3):
+        ct.create(
+            CtKey6(
+                daddr=ip6(f"fd00:be::{k + 1}"), saddr=ip6("fd00:1::5"),
+                dport=8000, sport=42000 + k, nexthdr=PROTO_TCP,
+            ),
+            src_sec_id=201,
+        )
+    return ct, lb, ipc, pol
+
+
+def gen(rng, f):
+    saddr = np.zeros((f,), object)
+    daddr = np.zeros((f,), object)
+    sport = np.zeros((f,), np.int64)
+    dport = np.zeros((f,), np.int64)
+    proto = np.zeros((f,), np.int64)
+    for i in range(f):
+        saddr[i] = ip6(f"fd00:{rng.randrange(8):x}::{rng.randrange(1, 200):x}")
+        roll = rng.random()
+        if roll < 0.4:  # VIP traffic
+            daddr[i] = ip6(f"fd00:aa::{rng.randrange(1, 6)}")
+            dport[i] = 80 if rng.random() < 0.8 else 8080
+        elif roll < 0.7:  # backend / pod
+            daddr[i] = ip6(f"fd00:be::{rng.randrange(1, 30):x}")
+            dport[i] = rng.choice([8000, 53, 9999])
+        elif roll < 0.85:  # the /128 entry
+            daddr[i] = ip6("fd00:3::7")
+            dport[i] = 8000
+        else:  # unknown -> world
+            daddr[i] = ip6("2001:db8::9")
+            dport[i] = 8000
+        if rng.random() < 0.2:  # sometimes the established tuples
+            saddr[i] = ip6("fd00:1::5")
+            daddr[i] = ip6(f"fd00:be::{rng.randrange(1, 4)}")
+            sport[i] = 42000 + rng.randrange(0, 4)
+            dport[i] = 8000
+        else:
+            sport[i] = rng.randrange(1024, 60000)
+        proto[i] = PROTO_TCP if rng.random() < 0.8 else PROTO_UDP
+    sw = ipv6_to_words(list(saddr))
+    dw = ipv6_to_words(list(daddr))
+    return saddr, daddr, sw, dw, sport.astype(np.int32), \
+        dport.astype(np.int32), proto.astype(np.int32)
+
+
+def test_v6_fuzz_matches_host_oracle():
+    rng = random.Random(31)
+    ct, lb, ipc, pol = build_world(rng)
+    tables = build_tables6(ct, lb, ipc, pol)
+    f = 512
+    saddr, daddr, sw, dw, sport, dport, proto = gen(rng, f)
+    out = datapath_verdicts6(tables, sw, dw, sport, dport, proto)
+    dev = {
+        k: (tuple(np.asarray(w) for w in v) if k == "new_daddr_words"
+            else np.asarray(v))
+        for k, v in out.items()
+    }
+    for i in range(f):
+        want = host_oracle6(
+            ct, lb, ipc, pol, int(saddr[i]), int(daddr[i]),
+            int(sport[i]), int(dport[i]), int(proto[i]),
+        )
+        for fld in ("verdict", "new_dport", "dst_identity", "proxy_port",
+                    "rev_nat", "established", "needs_ct_create"):
+            assert int(dev[fld][i]) == int(want[fld]), (
+                f"pkt {i} field {fld}: {int(dev[fld][i])} != "
+                f"{int(want[fld])} ({want})"
+            )
+        got_daddr = 0
+        for w in range(4):
+            got_daddr = (got_daddr << 32) | int(
+                np.uint32(np.int64(dev["new_daddr_words"][w][i]) & 0xFFFFFFFF)
+            )
+        assert got_daddr == want["new_daddr"], f"pkt {i} daddr"
+
+
+def test_v6_established_skips_policy():
+    rng = random.Random(32)
+    ct, lb, ipc, pol = build_world(rng)
+    empty = PolicyMap()
+    tables = build_tables6(ct, lb, ipc, empty)
+    sw = ipv6_to_words([ip6("fd00:1::5")])
+    dw = ipv6_to_words([ip6("fd00:be::1")])
+    out = datapath_verdicts6(
+        tables, sw, dw,
+        np.array([42000], np.int32), np.array([8000], np.int32),
+        np.array([PROTO_TCP], np.int32),
+    )
+    assert int(np.asarray(out["verdict"])[0]) == FORWARD
+    assert bool(np.asarray(out["established"])[0])
+
+
+def test_v6_ct_create_promotes_to_established():
+    """apply_ct_creates6 records allowed new flows; the next pass sees
+    them established (reference: ct_create6 after the verdict)."""
+    from cilium_tpu.datapath.pipeline6 import apply_ct_creates6
+
+    rng = random.Random(33)
+    ct, lb, ipc, pol = build_world(rng)
+    pol.allow(600, 9100, PROTO_TCP, DIR_EGRESS)
+    tables = build_tables6(ct, lb, ipc, pol)
+    sw = ipv6_to_words([ip6("fd00:1::9")])
+    dw = ipv6_to_words([ip6("fd00:be::5")])
+    args = (np.array([5123], np.int32), np.array([9100], np.int32),
+            np.array([PROTO_TCP], np.int32))
+    out = datapath_verdicts6(tables, sw, dw, *args)
+    assert int(np.asarray(out["verdict"])[0]) == FORWARD
+    assert bool(np.asarray(out["needs_ct_create"])[0])
+    assert apply_ct_creates6(ct, out, sw, args[0], args[2]) == 1
+    # rebuild tables (pinned-map snapshot) -> established now
+    tables2 = build_tables6(ct, lb, ipc, PolicyMap())  # even with no policy
+    out2 = datapath_verdicts6(tables2, sw, dw, *args)
+    assert bool(np.asarray(out2["established"])[0])
+    assert int(np.asarray(out2["verdict"])[0]) == FORWARD
