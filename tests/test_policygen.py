@@ -1,0 +1,152 @@
+"""Policy fuzz/gen harness: random rule combinations vs an independent
+connectivity evaluator (reference: test/helpers/policygen — generates
+random policy combinations + expected connectivity and asserts both).
+
+The naive evaluator re-derives the allow semantics directly from the
+rule definition (a rule selecting the destination allows traffic iff
+one of its ingress sections' L3 and L4 constraints both hold, with
+empty meaning wildcard); the engine side answers through the full
+repository resolution (merge semantics, wildcards, L3-dependent L4).
+Any disagreement is a bug in one of them.
+"""
+
+import random
+
+import pytest
+
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    Rule,
+)
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.search import Decision, DPort, SearchContext
+
+KEYS = {"app": ["web", "db", "cache"], "tier": ["fe", "be"], "env": ["prod"]}
+PORTS = [80, 443, 8080]
+
+# Every endpoint in the universe: one value per key subset.
+def _universe():
+    out = []
+    for app in KEYS["app"]:
+        for tier in KEYS["tier"]:
+            out.append({"app": app, "tier": tier, "env": "prod"})
+    return out
+
+
+UNIVERSE = _universe()
+
+
+def _labels(d: dict) -> LabelArray:
+    return LabelArray.parse_select(
+        *[f"k8s:{k}={v}" for k, v in sorted(d.items())]
+    )
+
+
+def _rand_selector(rng) -> tuple[EndpointSelector, dict]:
+    """Random matchLabels selector over the universe (possibly empty =
+    select everything); returns the selector and its match dict."""
+    match = {}
+    for k, vals in KEYS.items():
+        if rng.random() < 0.4:
+            match[k] = rng.choice(vals)
+    return EndpointSelector.from_dict(
+        {f"{k}": v for k, v in match.items()}
+    ), match
+
+
+def _sel_matches(match: dict, ep: dict) -> bool:
+    return all(ep.get(k) == v for k, v in match.items())
+
+
+def gen_rules(rng, n_rules: int):
+    """Random rules + a parallel naive spec representation."""
+    rules, specs = [], []
+    for _ in range(n_rules):
+        to_sel, to_match = _rand_selector(rng)
+        sections = []
+        spec_sections = []
+        for _ in range(rng.randrange(1, 3)):
+            froms = []
+            from_matches = []
+            for _ in range(rng.randrange(0, 3)):
+                s, m = _rand_selector(rng)
+                froms.append(s)
+                from_matches.append(m)
+            ports = []
+            port_list = []
+            if rng.random() < 0.7:
+                for _ in range(rng.randrange(1, 3)):
+                    p = rng.choice(PORTS)
+                    ports.append(
+                        PortRule(ports=[PortProtocol(str(p), "TCP")])
+                    )
+                    port_list.append(p)
+            if not froms and not ports:
+                continue
+            sections.append(
+                IngressRule(from_endpoints=froms, to_ports=ports)
+            )
+            spec_sections.append((from_matches, port_list))
+        if not sections:
+            continue
+        r = Rule(endpoint_selector=to_sel, ingress=sections)
+        r.sanitize()
+        rules.append(r)
+        specs.append((to_match, spec_sections))
+    return rules, specs
+
+
+def naive_allows(specs, src: dict, dst: dict, port: int) -> bool:
+    """Independent connectivity evaluator, straight from the rule
+    definition (reference semantics: pkg/policy/rule.go merge +
+    l4.go coverage — re-derived, not shared code)."""
+    for to_match, sections in specs:
+        if not _sel_matches(to_match, dst):
+            continue
+        for from_matches, port_list in sections:
+            l3_ok = not from_matches or any(
+                _sel_matches(m, src) for m in from_matches
+            )
+            l4_ok = not port_list or port in port_list
+            if l3_ok and l4_ok:
+                return True
+    return False
+
+
+def engine_allows(repo: Repository, src: dict, dst: dict, port: int) -> bool:
+    ctx = SearchContext(
+        from_labels=_labels(src),
+        to_labels=_labels(dst),
+        dports=[DPort(port, "TCP")],
+    )
+    return repo.allows_ingress(ctx) == Decision.ALLOWED
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_policies_match_naive_connectivity(seed):
+    rng = random.Random(100 + seed)
+    rules, specs = gen_rules(rng, rng.randrange(1, 6))
+    repo = Repository()
+    for r in rules:
+        repo.add(r)
+    checked = 0
+    for src in UNIVERSE:
+        for dst in UNIVERSE:
+            for port in PORTS:
+                want = naive_allows(specs, src, dst, port)
+                got = engine_allows(repo, src, dst, port)
+                assert got == want, (
+                    f"seed {seed}: {src} -> {dst}:{port}: engine "
+                    f"{got} != naive {want}\nspecs={specs}"
+                )
+                checked += 1
+    assert checked == len(UNIVERSE) ** 2 * len(PORTS)
+
+
+def test_empty_repository_denies_everything():
+    repo = Repository()
+    assert not engine_allows(repo, UNIVERSE[0], UNIVERSE[1], 80)
