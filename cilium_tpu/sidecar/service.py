@@ -150,6 +150,7 @@ class VerdictService:
         self._engines: dict[tuple, object] = {}
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
+        self._clients: list["_ClientHandler"] = []
         self._stopped = False
         self.fast_log = _ColumnarLog()
         # Vectorized-path conn table: parallel arrays indexed by conn_id
@@ -230,6 +231,20 @@ class VerdictService:
                 self._listener.close()
         except OSError:
             pass
+        # Close shim connections so their reader/writer peers see EOF
+        # immediately (a restarting shim must not block in recv on a
+        # dead service).
+        with self._lock:
+            clients = list(self._clients)
+        for client in clients:
+            try:
+                client.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                client.sock.close()
+            except OSError:
+                pass
         self.dispatcher.stop()
         if self._completion_thread is not None:
             self._completions.put(("stop",))
@@ -248,6 +263,8 @@ class VerdictService:
             except OSError:
                 return
             client = _ClientHandler(self, sock)
+            with self._lock:
+                self._clients.append(client)
             t = threading.Thread(target=client.read_loop, daemon=True)
             t.start()
             self._threads.append(t)
@@ -1441,3 +1458,10 @@ class _ClientHandler:
                 self.sock.close()
             except OSError:
                 pass
+            # Prune this handler so reconnecting shims don't accumulate
+            # dead entries for the service's lifetime.
+            with self.service._lock:
+                try:
+                    self.service._clients.remove(self)
+                except ValueError:
+                    pass

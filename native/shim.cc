@@ -59,13 +59,20 @@ struct Connection {
   bool ingress = false;
   uint32_t src_id = 0;
   uint32_t dst_id = 0;
-  std::string src_addr, dst_addr, proto;
+  std::string src_addr, dst_addr, proto, policy_name;
+  // After a service reconnect the service-side buffer mirror is empty:
+  // the next data round per direction must resend the retained
+  // (unverdicted) buffer instead of only the new bytes.
+  bool resync[2] = {false, false};
 };
 
 struct Module {
   int fd = -1;
   uint64_t module_id = 0;
   uint64_t next_seq = 1;
+  std::string socket_path;  // for reconnect
+  uint8_t debug = 0;
+  std::string policy_json;  // last ACCEPTED policy, replayed on reconnect
   std::atomic<uint64_t> accesslog{0};  // attached accesslog handle
   std::mutex io_mutex;
   // Guards the conns map itself (insert/erase/find from different
@@ -215,7 +222,7 @@ bool parse_verdict_batch(const std::string &p, uint64_t *seq,
 // the module io_mutex.
 bool rpc(Module *m, uint16_t type, const std::string &payload,
          uint16_t want_type, std::string *reply) {
-  if (!send_msg(m->fd, type, payload)) return false;
+  if (m->fd < 0 || !send_msg(m->fd, type, payload)) return false;
   uint16_t got;
   for (;;) {
     if (!recv_msg(m->fd, &got, reply)) return false;
@@ -225,41 +232,182 @@ bool rpc(Module *m, uint16_t type, const std::string &payload,
   }
 }
 
+// Dial the service socket and run the OpenModule handshake.  Caller
+// holds io_mutex.  On success m->fd/m->module_id are fresh.
+bool dial_module(Module *m) {
+  if (m->fd >= 0) {
+    ::close(m->fd);
+    m->fd = -1;
+  }
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  sockaddr_un addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, m->socket_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (::connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return false;
+  }
+  m->fd = fd;
+  std::string payload;
+  put<uint8_t>(&payload, m->debug);
+  put<uint16_t>(&payload, 0);  // no params
+  std::string reply;
+  if (!rpc(m, kMsgOpenModule, payload, kMsgModuleId, &reply) ||
+      reply.size() < 8) {
+    ::close(m->fd);
+    m->fd = -1;
+    return false;
+  }
+  size_t off = 0;
+  m->module_id = get<uint64_t>(reply, &off);
+  if (m->module_id == 0) {
+    ::close(m->fd);
+    m->fd = -1;
+    return false;
+  }
+  return true;
+}
+
+bool register_conn_rpc(Module *m, uint64_t conn_id, const Connection *c) {
+  std::string payload;
+  put<uint64_t>(&payload, m->module_id);
+  put<uint64_t>(&payload, conn_id);
+  put<uint8_t>(&payload, c->ingress ? 1 : 0);
+  put<uint32_t>(&payload, c->src_id);
+  put<uint32_t>(&payload, c->dst_id);
+  put_str(&payload, c->proto.c_str());
+  put_str(&payload, c->src_addr.c_str());
+  put_str(&payload, c->dst_addr.c_str());
+  put_str(&payload, c->policy_name.c_str());
+  std::string reply;
+  if (!rpc(m, kMsgNewConnection, payload, kMsgConnResult, &reply) ||
+      reply.size() < 12)
+    return false;
+  size_t off = 8;
+  return get<uint32_t>(reply, &off) == CT_FILTER_OK;
+}
+
+// Service-restart recovery (the NPDS-reconnect analog, reference:
+// proxylib/npds/client.go:133 reconnect loop): dial a fresh module,
+// replay the last accepted policy, re-register every live connection,
+// and mark all directions for buffer resync — the shim's retained
+// buffers are exactly the unverdicted bytes the new service needs.
+// Caller holds io_mutex.
+bool reconnect_module(Module *m) {
+  // Any replay failure closes the fresh fd again: a half-replayed
+  // module must look DEAD so the next call re-enters recovery, not
+  // half-recovered with unregistered connections.
+  auto fail = [m]() {
+    if (m->fd >= 0) {
+      ::close(m->fd);
+      m->fd = -1;
+    }
+    return false;
+  };
+  if (!dial_module(m)) return false;
+  if (!m->policy_json.empty()) {
+    std::string payload;
+    put<uint64_t>(&payload, m->module_id);
+    put<uint32_t>(&payload, static_cast<uint32_t>(m->policy_json.size()));
+    payload += m->policy_json;
+    std::string reply;
+    if (!rpc(m, kMsgPolicyUpdate, payload, kMsgAck, &reply)) return fail();
+    size_t off = 0;
+    if (reply.size() < 4 || get<uint32_t>(reply, &off) != CT_FILTER_OK)
+      return fail();
+  }
+  std::lock_guard<std::mutex> ck(m->conns_mutex);
+  for (auto &kv : m->conns) {
+    if (!register_conn_rpc(m, kv.first, kv.second.get())) return fail();
+    kv.second->resync[0] = true;
+    kv.second->resync[1] = true;
+  }
+  return true;
+}
+
 // Ship new bytes for a connection/direction; parse verdict entries and
 // append their ops/injects to the connection's pending queues.
 uint32_t on_data_rpc(Module *m, Connection *c, uint64_t conn_id, bool reply,
                      bool end_stream, const uint8_t *data, int64_t len) {
   std::lock_guard<std::mutex> lk(m->io_mutex);
-  uint64_t seq = m->next_seq++;
-  std::string payload;
-  put<uint64_t>(&payload, seq);
-  put<uint32_t>(&payload, 1);
-  put<uint64_t>(&payload, conn_id);
-  uint8_t flags = (reply ? 1 : 0) | (end_stream ? 2 : 0);
-  put<uint8_t>(&payload, flags);
-  put<uint32_t>(&payload, static_cast<uint32_t>(len));
-  if (len > 0) payload.append(reinterpret_cast<const char *>(data), len);
+  int d = reply ? 1 : 0;
 
-  std::string rp;
-  if (!send_msg(m->fd, kMsgDataBatch, payload)) return CT_FILTER_UNKNOWN_ERROR;
-  for (;;) {
-    uint16_t got;
-    if (!recv_msg(m->fd, &got, &rp)) return CT_FILTER_UNKNOWN_ERROR;
-    if (got != kMsgVerdictBatch) continue;
-    uint64_t got_seq;
-    std::vector<VerdictEntry> entries;
-    if (!parse_verdict_batch(rp, &got_seq, &entries))
-      return CT_FILTER_UNKNOWN_ERROR;
-    if (got_seq != seq) continue;  // stale reply for another call
-    uint32_t result = CT_FILTER_OK;
-    for (auto &e : entries) {
-      if (e.result != CT_FILTER_OK) result = e.result;
-      c->dirs[0].inject += e.inject_orig;
-      c->dirs[1].inject += e.inject_reply;
-      for (auto &op : e.ops) c->pending_ops[reply ? 1 : 0].push_back(op);
+  auto build = [&](const char *bytes, int64_t n) {
+    uint64_t seq = m->next_seq++;
+    std::string payload;
+    put<uint64_t>(&payload, seq);
+    put<uint32_t>(&payload, 1);
+    put<uint64_t>(&payload, conn_id);
+    uint8_t flags = (reply ? 1 : 0) | (end_stream ? 2 : 0);
+    put<uint8_t>(&payload, flags);
+    put<uint32_t>(&payload, static_cast<uint32_t>(n));
+    if (n > 0) payload.append(bytes, n);
+    return std::make_pair(seq, payload);
+  };
+
+  auto attempt = [&](uint64_t seq, const std::string &payload,
+                     uint32_t *result) -> bool {
+    // false = transport failure (caller may reconnect + retry)
+    std::string rp;
+    if (m->fd < 0 || !send_msg(m->fd, kMsgDataBatch, payload)) return false;
+    for (;;) {
+      uint16_t got;
+      if (!recv_msg(m->fd, &got, &rp)) return false;
+      if (got != kMsgVerdictBatch) continue;
+      uint64_t got_seq;
+      std::vector<VerdictEntry> entries;
+      if (!parse_verdict_batch(rp, &got_seq, &entries)) {
+        *result = CT_FILTER_UNKNOWN_ERROR;
+        return true;
+      }
+      if (got_seq != seq) continue;  // stale reply for another call
+      *result = CT_FILTER_OK;
+      for (auto &e : entries) {
+        if (e.result != CT_FILTER_OK) *result = e.result;
+        c->dirs[0].inject += e.inject_orig;
+        c->dirs[1].inject += e.inject_reply;
+        for (auto &op : e.ops) c->pending_ops[d].push_back(op);
+      }
+      return true;
     }
+  };
+
+  // After a reconnect, the service's buffer mirror is empty: ship the
+  // whole retained (unverdicted) buffer — which already contains the
+  // incoming bytes on the on_io path — instead of only the new bytes.
+  uint32_t result = CT_FILTER_UNKNOWN_ERROR;
+  bool ok;
+  if (c->resync[d] && !c->dirs[d].buffer.empty()) {
+    auto [seq, payload] =
+        build(c->dirs[d].buffer.data(),
+              static_cast<int64_t>(c->dirs[d].buffer.size()));
+    ok = attempt(seq, payload, &result);
+  } else {
+    auto [seq, payload] = build(reinterpret_cast<const char *>(data), len);
+    ok = attempt(seq, payload, &result);
+  }
+  if (ok) {
+    c->resync[d] = false;
     return result;
   }
+
+  // Transport failure: reconnect (fresh module + policy + connection
+  // replay, all directions marked resync) and retry ONCE.  The on_io
+  // path retains the unverdicted bytes in dir.buffer (including this
+  // call's); the raw on_data path keeps dir.buffer empty — there the
+  // caller owns buffering and passes the full unverdicted data each
+  // call (reference OnData contract), so the caller's bytes are the
+  // resync payload.
+  if (!reconnect_module(m)) return CT_FILTER_UNKNOWN_ERROR;
+  const std::string &buf = c->dirs[d].buffer;
+  auto [seq, payload] =
+      buf.empty() ? build(reinterpret_cast<const char *>(data), len)
+                  : build(buf.data(), static_cast<int64_t>(buf.size()));
+  if (!attempt(seq, payload, &result)) return CT_FILTER_UNKNOWN_ERROR;
+  c->resync[d] = false;
+  return result;
 }
 
 }  // namespace
@@ -488,36 +636,12 @@ std::shared_ptr<ProxyMapFile> find_proxymap(uint64_t handle) {
 extern "C" {
 
 uint64_t cilium_tpu_open(const char *socket_path, uint8_t debug) {
-  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
-  if (fd < 0) return 0;
-  sockaddr_un addr;
-  memset(&addr, 0, sizeof(addr));
-  addr.sun_family = AF_UNIX;
-  strncpy(addr.sun_path, socket_path, sizeof(addr.sun_path) - 1);
-  if (::connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) != 0) {
-    ::close(fd);
-    return 0;
-  }
   auto m = std::make_unique<Module>();
-  m->fd = fd;
-
-  std::string payload;
-  put<uint8_t>(&payload, debug);
-  put<uint16_t>(&payload, 0);  // no params
-  std::string reply;
+  m->socket_path = socket_path ? socket_path : "";
+  m->debug = debug;
   {
     std::lock_guard<std::mutex> lk(m->io_mutex);
-    if (!rpc(m.get(), kMsgOpenModule, payload, kMsgModuleId, &reply) ||
-        reply.size() < 8) {
-      ::close(fd);
-      return 0;
-    }
-  }
-  size_t off = 0;
-  m->module_id = get<uint64_t>(reply, &off);
-  if (m->module_id == 0) {
-    ::close(fd);
-    return 0;
+    if (!dial_module(m.get())) return 0;
   }
   std::lock_guard<std::mutex> lk(g_registry_mutex);
   uint64_t handle = g_next_handle++;
@@ -546,7 +670,10 @@ uint32_t cilium_tpu_policy_update_json(uint64_t module, const char *json,
   if (!rpc(m, kMsgPolicyUpdate, payload, kMsgAck, &reply) || reply.size() < 4)
     return CT_FILTER_UNKNOWN_ERROR;
   size_t off = 0;
-  return get<uint32_t>(reply, &off);
+  uint32_t res = get<uint32_t>(reply, &off);
+  if (res == CT_FILTER_OK)
+    m->policy_json.assign(json, len);  // replayed on reconnect
+  return res;
 }
 
 uint32_t cilium_tpu_on_new_connection(uint64_t module, const char *proto,
@@ -582,6 +709,7 @@ uint32_t cilium_tpu_on_new_connection(uint64_t module, const char *proto,
     conn->src_addr = src_addr ? src_addr : "";
     conn->dst_addr = dst_addr ? dst_addr : "";
     conn->proto = proto ? proto : "";
+    conn->policy_name = policy_name ? policy_name : "";
     std::lock_guard<std::mutex> ck(m->conns_mutex);
     m->conns[conn_id] = std::move(conn);
   }
