@@ -476,3 +476,42 @@ def _first_crlfcrlf(data: jax.Array, lengths: jax.Array) -> jax.Array:
         & ((pos + 3) < lengths[:, None])
     )
     return jnp.min(jnp.where(hit, pos, lengths[:, None]), axis=1)
+
+
+def build_http_model_for_port(policy, ingress: bool, port: int,
+                              backend: str = "auto"):
+    """Compile the effective HTTP rule rows for (policy, direction,
+    port) from a proxylib PolicyInstance, applying the reference's port
+    cascade (exact port OR wildcard 0) — the HTTP twin of
+    models/r2d2.collect_policy_rows, used by the sidecar's engine bind."""
+    from ..proxylib.parsers.http import HttpRule
+
+    if policy is None:
+        return ConstVerdict(False)
+    side = policy.ingress if ingress else policy.egress
+    rows: list[tuple[frozenset, PortRuleHTTP]] = []
+    for key in (port, 0):
+        rules = side.by_port.get(key)
+        if rules is None:
+            continue
+        if not rules.have_l7_rules or not rules.rules:
+            return ConstVerdict(True)
+        for rule in rules.rules:
+            matchers = rule.l7_matchers or [None]
+            for m in matchers:
+                if m is None:
+                    rows.append((rule.allowed_remotes, PortRuleHTTP()))
+                else:
+                    assert isinstance(m, HttpRule), f"not an http rule: {m!r}"
+                    rows.append(
+                        (
+                            rule.allowed_remotes,
+                            PortRuleHTTP(
+                                method=m.method_src, path=m.path_src,
+                                host=m.host_src, headers=list(m.headers),
+                            ),
+                        )
+                    )
+    if not rows:
+        return ConstVerdict(False)
+    return build_http_model(rows, backend=backend)

@@ -30,10 +30,9 @@ from ..models.kafka import encode_requests, kafka_verdicts
 from ..policy.l4 import PARSER_TYPE_HTTP, PARSER_TYPE_KAFKA
 from ..proxylib.types import DROP, MORE, PASS, OpType
 
-HTTP_403 = (
-    b"HTTP/1.1 403 Forbidden\r\ncontent-type: text/plain\r\n"
-    b"content-length: 14\r\n\r\nAccess denied\n"
-)
+# Shared with the streaming parser so both HTTP paths inject the
+# reference's exact denial (envoy/cilium_l7policy.cc:91).
+from ..proxylib.parsers.http import HTTP_403  # noqa: E402
 
 
 @dataclass
@@ -143,23 +142,11 @@ class HttpBatchEngine(BaseBatchEngine):
             np.asarray(out[-1])
 
     def _head_and_body_len(self, buf: bytes) -> tuple[int, int] | None:
-        end = buf.find(b"\r\n\r\n")
-        if end < 0:
-            return None
-        head_len = end + 4
-        body_len = 0
-        # Content-Length framing so body bytes ride the same PASS/DROP.
-        lower = buf[:head_len].lower()
-        idx = lower.find(b"\r\ncontent-length:")
-        if idx >= 0:
-            line_end = lower.find(b"\r\n", idx + 2)
-            try:
-                body_len = int(lower[idx + 17:line_end].strip())
-            except ValueError:
-                body_len = 0
-        if len(buf) < head_len + body_len:
-            return None  # wait for the full body
-        return head_len, body_len
+        # One framing implementation for both HTTP paths (streaming
+        # parser + this engine) so fixes cannot diverge.
+        from ..proxylib.parsers.http import head_and_body_len
+
+        return head_and_body_len(buf)
 
     def _step(self) -> bool:
         active: list[tuple[EngineFlow, int, int]] = []

@@ -397,3 +397,75 @@ class MemcacheBatchEngine(DeviceAssistedEngine):
             )
         )
         return allow, overflow
+
+
+class HttpSidecarEngine(DeviceAssistedEngine):
+    """HTTP through the sidecar seam — the cilium.l7policy filter
+    served by the verdict service (reference: envoy/cilium_l7policy.cc
+    request path): complete request frames are judged on device via the
+    HTTP batch model; partial frames, replies, and oversized heads ride
+    the streaming HttpParser oracle."""
+
+    proto = "http"
+    MIN_WIDTH = 512
+    MAX_WIDTH = 1 << 15  # beyond this: host fallback (parser denies)
+    MIN_ROWS = 64
+
+    def _make_parser(self, conn):
+        from ..proxylib.parsers.http import HttpParser
+
+        return HttpParser(conn)
+
+    def _peek(self, st, buf):
+        from ..proxylib.parsers.http import head_and_body_len, parse_head
+
+        descs = []
+        off = 0
+        while True:
+            framed = head_and_body_len(buf[off:])
+            if framed is None:
+                break
+            head_len, body_len = framed
+            head = buf[off : off + head_len]
+            if parse_head(head) is None:
+                # The oracle denies malformed request lines WITHOUT
+                # consuming a device verdict — stop peeking here so the
+                # per-flow verdict queue stays aligned (the cassandra
+                # peek breaks on parse errors for the same reason).
+                break
+            descs.append(head)
+            off += head_len + body_len
+        return descs
+
+    def _judge(self, descs, remotes):
+        from ..models.http import http_verdicts
+
+        n = len(descs)
+        allow = np.zeros(n, bool)
+        overflow = np.zeros(n, bool)
+        buckets: dict[int, list[int]] = {}
+        for i, head in enumerate(descs):
+            if len(head) > self.MAX_WIDTH:
+                overflow[i] = True
+                continue
+            w = self.MIN_WIDTH
+            while w < len(head):
+                w *= 2
+            buckets.setdefault(w, []).append(i)
+        for w, idxs in sorted(buckets.items()):
+            f_pad = self.MIN_ROWS
+            while f_pad < len(idxs):
+                f_pad *= 2
+            data = np.zeros((f_pad, w), np.uint8)
+            lengths = np.zeros((f_pad,), np.int32)
+            rem = np.zeros((f_pad,), np.int32)
+            for j, i in enumerate(idxs):
+                h = descs[i]
+                data[j, : len(h)] = np.frombuffer(h, np.uint8)
+                lengths[j] = len(h)
+                rem[j] = remotes[i]
+            _, _, a = http_verdicts(self.model, data, lengths, rem)
+            a = np.asarray(a)
+            for j, i in enumerate(idxs):
+                allow[i] = bool(a[j])
+        return allow, overflow

@@ -412,7 +412,7 @@ class VerdictService:
         (policy, direction, port, proto), building the model on first use."""
         conn = sc.conn
         proto = conn.parser_name
-        if proto not in ("r2d2", "cassandra", "memcache"):
+        if proto not in ("r2d2", "cassandra", "memcache", "http"):
             return  # other protocols: oracle path
         key = (module_id, conn.policy_name, conn.ingress, conn.port, proto)
         with self._lock:
@@ -453,6 +453,7 @@ class VerdictService:
             return eng
         from ..runtime.l7engine import (
             CassandraBatchEngine,
+            HttpSidecarEngine,
             MemcacheBatchEngine,
         )
 
@@ -461,6 +462,11 @@ class VerdictService:
 
             model = build_cassandra_model(policy, conn.ingress, conn.port)
             cls = CassandraBatchEngine
+        elif proto == "http":
+            from ..models.http import build_http_model_for_port
+
+            model = build_http_model_for_port(policy, conn.ingress, conn.port)
+            cls = HttpSidecarEngine
         else:
             from ..models.memcached import build_memcache_model
 
