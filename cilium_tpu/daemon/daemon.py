@@ -170,6 +170,10 @@ class Daemon:
         # fqdn.StartDNSPoller)
         self.dns_poller = None
 
+        # NPDS push target (attach_verdict_service connects it;
+        # reference: the agent-embedded xDS server's policy stream)
+        self.npds_pusher = None
+
         # Opt-in profiling + per-flow debug gates (reference: --pprof
         # -> pkg/pprof.Enable, pkg/flowdebug.Enable from initEnv)
         self.pprof_server = None
@@ -252,6 +256,22 @@ class Daemon:
             if not self.config.dry_mode:
                 ep.write_state(self._state_dir())
 
+    def attach_verdict_service(self, socket_path: str):
+        """Connect the NPDS push to a live verdict service and sync the
+        current endpoint policies (reference: daemon.go:1327
+        StartProxySupport → envoy.StartXDSServer; here the daemon dials
+        the service's socket instead of serving gRPC)."""
+        from ..proxy.npds_push import NpdsPusher
+
+        if self.npds_pusher is not None:
+            self.npds_pusher.close()
+        self.npds_pusher = NpdsPusher(socket_path)
+        cache = self.identity_allocator.get_identity_cache()
+        for ep in self.endpoint_manager.get_endpoints():
+            if ep.desired_l4_policy is not None:
+                self.npds_pusher.upsert(ep, cache)
+        return self.npds_pusher
+
     def _push_endpoint_policy(self, ep: Endpoint) -> None:
         """Publish the endpoint's resolved policy to subscribed sidecars
         (reference: pkg/envoy/server.go:628 UpdateNetworkPolicy)."""
@@ -267,6 +287,13 @@ class Daemon:
         self.dist_cache.upsert(
             TYPE_NETWORK_POLICY, str(ep.id), resource, force=False
         )
+        if self.npds_pusher is not None:
+            try:
+                self.npds_pusher.upsert(
+                    ep, self.identity_allocator.get_identity_cache()
+                )
+            except OSError:
+                log.warning("NPDS push failed; verdict service unreachable")
 
     def endpoint_create(
         self, endpoint_id: int, ipv4: str = "",
@@ -309,6 +336,11 @@ class Daemon:
             self.identity_allocator.release(ep.security_identity)
         self.endpoint_manager.remove(ep)
         self.dist_cache.delete(TYPE_NETWORK_POLICY, str(endpoint_id))
+        if self.npds_pusher is not None:
+            try:
+                self.npds_pusher.remove(ep)
+            except OSError:
+                log.warning("NPDS prune failed; verdict service unreachable")
         ep.set_state(EndpointState.DISCONNECTED, "deleted")
         EndpointCount.set(len(self.endpoint_manager))
         # remove persisted state
@@ -559,6 +591,8 @@ class Daemon:
         self.identity_allocator.close()
         if self.health_responder is not None:
             self.health_responder.close()
+        if self.npds_pusher is not None:
+            self.npds_pusher.close()
         if self.pprof_server is not None:
             self.pprof_server.shutdown()
             self.pprof_server.server_close()  # release the listening fd

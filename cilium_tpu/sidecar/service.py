@@ -1200,11 +1200,14 @@ class VerdictService:
     def _run_fast(self, fast: list, responses: dict) -> None:
         """Vectorized single-frame path: entries grouped per engine, one
         device call per group, ops emitted from the verdict arrays."""
-        groups: dict[int, list] = {}
+        # Capture each record's engine ONCE at grouping: policy_update
+        # rebinds sc.engine concurrently, and a re-read after grouping
+        # could judge the group with a different engine's model.
+        groups: dict[int, tuple] = {}
         for rec in fast:
-            groups.setdefault(id(rec[2].engine), []).append(rec)
-        for recs in groups.values():
-            engine = recs[0][2].engine
+            eng = rec[2].engine
+            groups.setdefault(id(eng), (eng, []))[1].append(rec)
+        for engine, recs in groups.values():
             n = len(recs)
             width = self.config.batch_width
             f_pad = self.MIN_BUCKET  # bucketed shapes, no jit churn
@@ -1243,10 +1246,15 @@ class VerdictService:
                   end_stream: bool, data: bytes):
         """Stateful path: request direction through the batch engine when
         available, otherwise the in-process oracle parser."""
-        if sc.engine is not None and getattr(sc.engine, "handles_reply", False):
-            # Device-assisted engine (cassandra/memcache): both directions.
+        # One engine snapshot for the whole entry: policy_update rebinds
+        # sc.engine from a reader thread, and a mid-entry swap would
+        # feed one engine but take_ops from another (empty) one.
+        engine = sc.engine
+        if engine is not None and getattr(engine, "handles_reply", False):
+            # Device-assisted engine (cassandra/memcache/http): both
+            # directions.
             conn = sc.conn
-            sc.engine.feed(
+            engine.feed(
                 conn_id,
                 data,
                 reply=reply,
@@ -1256,8 +1264,8 @@ class VerdictService:
                 src_addr=conn.src_addr,
                 dst_addr=conn.dst_addr,
             )
-            sc.engine.pump()
-            ops, inj_orig, inj_reply = sc.engine.take_ops(conn_id, reply)
+            engine.pump()
+            ops, inj_orig, inj_reply = engine.take_ops(conn_id, reply)
             return (
                 conn_id,
                 int(FilterResult.OK),
@@ -1265,9 +1273,9 @@ class VerdictService:
                 inj_orig,
                 inj_reply,
             )
-        if sc.engine is not None and not reply:
+        if engine is not None and not reply:
             conn = sc.conn
-            sc.engine.feed(
+            engine.feed(
                 conn_id,
                 data,
                 remote_id=conn.src_id,
@@ -1277,8 +1285,8 @@ class VerdictService:
                 src_addr=conn.src_addr,
                 dst_addr=conn.dst_addr,
             )
-            sc.engine.pump()
-            ops, inject = sc.engine.take_ops(conn_id)
+            engine.pump()
+            ops, inject = engine.take_ops(conn_id)
             return (
                 conn_id,
                 int(FilterResult.OK),
