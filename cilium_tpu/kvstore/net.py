@@ -31,6 +31,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
 from .backend import (
@@ -313,6 +314,13 @@ class KvstoreServer:
 
     def close(self) -> None:
         self._stopped = True
+        # shutdown() first: it wakes the accept loop so the listening
+        # fd actually releases (close() alone leaves the thread parked
+        # in accept() holding the socket, and the port stays bound).
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
@@ -331,11 +339,25 @@ class _NetLock:
         self._backend = backend
         self._path = path
         self._held = True
+        self.lost = False  # session died: the server released this lock
 
     def unlock(self) -> None:
+        if self.lost:
+            # Surface the mutual-exclusion violation instead of
+            # pretending the critical section was protected
+            # (reference: etcd session loss fails the lock holder).
+            self._held = False
+            raise LockError(f"lock {self._path} lost on session reconnect")
         if self._held:
             self._held = False
-            self._backend._request({"op": "unlock", "path": self._path})
+            with self._backend._mutex:
+                try:
+                    self._backend._locks.remove(self)
+                except ValueError:
+                    pass
+            self._backend._request(
+                {"op": "unlock", "path": self._path}, retryable=False
+            )
 
     def __enter__(self):
         return self
@@ -364,6 +386,16 @@ class NetBackend(Backend):
         self._pending: dict[int, queue.Queue] = {}
         self._watchers: dict[int, Watcher] = {}
         self._closed = False
+        # Reconnect state (reference: pkg/kvstore reconnect with
+        # pkg/backoff + lease keepalive): session-owned leased keys are
+        # replayed on a fresh session, active watches re-subscribed.
+        self._leased: dict[str, bytes] = {}
+        self._watch_specs: dict[int, tuple[str, str]] = {}
+        self._reconnect_lock = threading.Lock()
+        self._generation = 0
+        self._conn_dead = False  # reader saw EOF; requests must redial
+        self._locks: list[_NetLock] = []  # held locks (loss marking)
+        self.reconnects = 0
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True, name="kvstore-client-read"
         )
@@ -372,9 +404,15 @@ class NetBackend(Backend):
     # -- plumbing ----------------------------------------------------------
 
     def _read_loop(self) -> None:
+        # Capture this thread's session: a stale reader (superseded by a
+        # reconnect) must neither recv from the NEW socket nor mark the
+        # new session dead.
+        with self._mutex:
+            gen = self._generation
+            sock = self.sock
         try:
             while True:
-                msg = _recv_frame(self.sock)
+                msg = _recv_frame(sock)
                 if "event" in msg:
                     ev = msg["event"]
                     w = self._watchers.get(int(ev["wid"]))
@@ -391,22 +429,161 @@ class NetBackend(Backend):
         except (ConnectionError, OSError, ValueError):
             pass
         finally:
-            self._fail_pending()
+            with self._mutex:
+                stale = self._generation != gen
+            if not stale:
+                self._conn_dead = True
+                self._fail_pending()
+                # Watch-only clients make no requests, so nothing would
+                # ever trigger the reconnect path for them: recover (or
+                # signal loss) in the background.
+                if not self._closed:
+                    threading.Thread(
+                        target=self._background_reconnect, args=(gen,),
+                        name="kvstore-reconnect", daemon=True,
+                    ).start()
+
+    def _background_reconnect(self, gen: int) -> None:
+        if not self._reconnect(gen) and not self._closed:
+            # Could not rebuild the session within the backoff budget:
+            # stop the watchers so consumers SEE the loss instead of
+            # waiting forever on a silent stream.
+            with self._mutex:
+                watchers = list(self._watchers.values())
+                self._watchers.clear()
+                self._watch_specs.clear()
+            for w in watchers:
+                w.stop()
 
     def _fail_pending(self) -> None:
         with self._mutex:
             pending = list(self._pending.values())
             self._pending.clear()
-            watchers = list(self._watchers.values())
-            self._watchers.clear()
+            # Watchers are only torn down on a clean close; an abnormal
+            # connection loss keeps them registered so _reconnect can
+            # re-subscribe them (they see a fresh snapshot replay).
+            watchers = list(self._watchers.values()) if self._closed else []
+            if self._closed:
+                self._watchers.clear()
         for q in pending:
             q.put({"ok": False, "error": "kvstore connection lost"})
         for w in watchers:
             w.stop()
 
-    def _request(self, req: dict, timeout: float | None = None) -> dict:
+    def _reconnect(self, observed_gen: int) -> bool:
+        """Dial a fresh session and rebuild session state: replay
+        leased keys (the keepalive re-registration analog) and
+        re-subscribe active watches.  Backoff-bounded; only one caller
+        reconnects per generation."""
+        with self._reconnect_lock:
+            if self._closed:
+                return False
+            if self._generation != observed_gen:
+                return True  # someone else already reconnected
+            host, _, port = self.address.rpartition(":")
+            delay = 0.05
+            deadline = time.monotonic() + self.timeout
+            while True:
+                try:
+                    sock = socket.create_connection(
+                        (host, int(port)), timeout=2.0
+                    )
+                    break
+                except OSError:
+                    if time.monotonic() + delay > deadline:
+                        return False
+                    time.sleep(delay)
+                    delay = min(delay * 2, 1.0)
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            with self._mutex:
+                self.sock = sock
+                self._generation += 1
+                self._conn_dead = False
+                self.reconnects += 1
+                # Server-side session death released every lock this
+                # client held: mark them lost so holders find out.
+                locks = list(self._locks)
+                self._locks.clear()
+            for lk in locks:
+                lk.lost = True
+            reader = threading.Thread(
+                target=self._read_loop, name="kvstore-net-reader", daemon=True
+            )
+            self._reader = reader
+            reader.start()
+            # Replay session-owned state on the fresh session.
+            try:
+                with self._mutex:
+                    leased = dict(self._leased)
+                    specs = dict(self._watch_specs)
+                for key, value in leased.items():
+                    # create_only: the old session's lease revocation may
+                    # have let another client legitimately claim the key —
+                    # never clobber it, drop our stale claim instead.
+                    r = self._request_once(
+                        {"op": "create_only", "key": key,
+                         "value": value.hex(), "lease": True}
+                    )
+                    if not r["created"]:
+                        log.warning(
+                            "leased key %s re-claimed elsewhere; "
+                            "dropping local claim", key,
+                        )
+                        with self._mutex:
+                            self._leased.pop(key, None)
+                for wid, (name, prefix) in specs.items():
+                    self._request_once(
+                        {"op": "watch", "wid": wid, "key": prefix,
+                         "name": name}
+                    )
+            except KvstoreError:
+                # Half-rebuilt sessions are poison: tear the connection
+                # down again so the next attempt replays from scratch.
+                self._conn_dead = True
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return False
+            return True
+
+    def _request(self, req: dict, timeout: float | None = None,
+                 retryable: bool = True) -> dict:
+        """One round trip, with a single reconnect + retry on
+        transport loss.  Non-idempotent ops (CAS creates, locks) are
+        NEVER blindly retried: the first attempt may have been applied
+        with its response lost, and a retry would mis-report the
+        outcome — callers re-run their own logic instead (reference:
+        etcd client retry semantics for non-idempotent mutations)."""
+        gen = self._generation
+        try:
+            return self._request_once(req, timeout)
+        except KvstoreError as e:
+            transport = (
+                "connection lost" in str(e) or "send failed" in str(e)
+            )
+            if self._closed or not transport:
+                raise
+            if not retryable:
+                # Still rebuild the session for later calls.
+                self._reconnect(gen)
+                raise
+            if not self._reconnect(gen):
+                raise
+            return self._request_once(req, timeout)
+
+    def _request_once(self, req: dict, timeout: float | None = None) -> dict:
         if self._closed:
             raise KvstoreError("kvstore client closed")
+        if self._conn_dead:
+            # Fail fast into the reconnect path instead of sending into
+            # a dead socket and waiting out the timeout.
+            raise KvstoreError("kvstore connection lost")
         with self._mutex:
             self._seq += 1
             rid = self._seq
@@ -443,10 +620,17 @@ class NetBackend(Backend):
 
     def lock_path(self, path: str, timeout: float | None = 10.0) -> _NetLock:
         t = timeout if timeout is not None else 60.0
+        # Not retryable: a lost response may mean the lock WAS granted;
+        # a blind retry could double-acquire or wait out a lock this
+        # session already holds.
         self._request(
-            {"op": "lock", "path": path, "timeout": t}, timeout=t + 5.0
+            {"op": "lock", "path": path, "timeout": t}, timeout=t + 5.0,
+            retryable=False,
         )
-        return _NetLock(self, path)
+        lock = _NetLock(self, path)
+        with self._mutex:
+            self._locks.append(lock)
+        return lock
 
     def get(self, key: str) -> Optional[bytes]:
         r = self._request({"op": "get", "key": key})
@@ -460,18 +644,31 @@ class NetBackend(Backend):
         self._request(
             {"op": "set", "key": key, "value": value.hex(), "lease": lease}
         )
+        with self._mutex:
+            if lease:
+                self._leased[key] = value
+            else:
+                self._leased.pop(key, None)
 
     def delete(self, key: str) -> None:
         self._request({"op": "delete", "key": key})
+        with self._mutex:
+            self._leased.pop(key, None)
 
     def delete_prefix(self, prefix: str) -> None:
         self._request({"op": "delete_prefix", "key": prefix})
+        with self._mutex:
+            for k in [k for k in self._leased if k.startswith(prefix)]:
+                del self._leased[k]
 
     def create_only(self, key: str, value: bytes, lease: bool = False) -> bool:
         r = self._request({
             "op": "create_only", "key": key, "value": value.hex(),
             "lease": lease,
-        })
+        }, retryable=False)
+        if r["created"] and lease:
+            with self._mutex:
+                self._leased[key] = value
         return bool(r["created"])
 
     def create_if_exists(self, cond_key: str, key: str, value: bytes,
@@ -479,7 +676,10 @@ class NetBackend(Backend):
         r = self._request({
             "op": "create_if_exists", "cond_key": cond_key, "key": key,
             "value": value.hex(), "lease": lease,
-        })
+        }, retryable=False)
+        if r["created"] and lease:
+            with self._mutex:
+                self._leased[key] = value
         return bool(r["created"])
 
     def list_prefix(self, prefix: str) -> dict[str, bytes]:
@@ -494,12 +694,16 @@ class NetBackend(Backend):
         # Register BEFORE the request: the server's snapshot replay can
         # arrive before the watch response.
         self._watchers[wid] = w
+        with self._mutex:
+            self._watch_specs[wid] = (name, prefix)
         try:
             self._request(
                 {"op": "watch", "wid": wid, "key": prefix, "name": name}
             )
         except KvstoreError:
             self._watchers.pop(wid, None)
+            with self._mutex:
+                self._watch_specs.pop(wid, None)
             raise
         return w
 
@@ -512,6 +716,8 @@ class NetBackend(Backend):
 
     def _stop_watch(self, wid: int) -> None:
         self._watchers.pop(wid, None)
+        with self._mutex:
+            self._watch_specs.pop(wid, None)
         if not self._closed:
             try:
                 self._request({"op": "watch_stop", "wid": wid})

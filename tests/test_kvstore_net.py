@@ -278,3 +278,134 @@ class TestTwoDaemonConvergence:
             if da is not None:
                 da.close()
             db.close()
+
+
+class TestReconnect:
+    """Server-restart resilience (reference: pkg/kvstore reconnect with
+    pkg/backoff + lease keepalive re-registration)."""
+
+    def test_client_survives_server_restart(self, tmp_path):
+        import time
+
+        from cilium_tpu.kvstore.net import KvstoreServer, NetBackend
+
+        srv = KvstoreServer("127.0.0.1", 0)
+        addr = srv.address
+        port = int(addr.rpartition(":")[2])
+        c = NetBackend(addr, timeout=8.0)
+        try:
+            c.set("persist/a", b"1")
+            c.set("lease/mine", b"owned", lease=True)
+            w = c.list_and_watch("t", "persist/")
+            # drain the initial snapshot
+            ev = w.events.get(timeout=2)
+            assert ev.key == "persist/a"
+
+            srv.close()
+            srv2 = None
+            for _ in range(80):  # the old listener may linger briefly
+                try:
+                    srv2 = KvstoreServer("127.0.0.1", port)
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            assert srv2 is not None, "could not rebind kvstore port"
+            try:
+                # Requests transparently reconnect + retry.
+                assert c.get("persist/a") is None  # fresh empty server
+                c.set("persist/b", b"2")
+                assert c.get("persist/b") == b"2"
+                assert c.reconnects == 1
+
+                # The leased key was replayed on the new session.
+                assert c.get("lease/mine") == b"owned"
+
+                # The watcher survived and re-subscribed: it sees the
+                # new-session events for its prefix.
+                seen = {}
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 4:
+                    try:
+                        ev = w.events.get(timeout=0.2)
+                        seen[ev.key] = ev
+                    except Exception:
+                        pass
+                    if "persist/b" in seen:
+                        break
+                assert "persist/b" in seen and not w.stopped
+            finally:
+                srv2.close()
+        finally:
+            c.close()
+
+    def test_lock_loss_is_surfaced_after_reconnect(self, tmp_path):
+        import time
+
+        from cilium_tpu.kvstore.backend import LockError
+        from cilium_tpu.kvstore.net import KvstoreServer, NetBackend
+
+        srv = KvstoreServer("127.0.0.1", 0)
+        port = int(srv.address.rpartition(":")[2])
+        c = NetBackend(srv.address, timeout=8.0)
+        try:
+            lock = c.lock_path("locks/critical")
+            srv.close()
+            srv2 = None
+            for _ in range(80):
+                try:
+                    srv2 = KvstoreServer("127.0.0.1", port)
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            assert srv2 is not None
+            try:
+                c.set("x", b"1")  # triggers reconnect
+                # The server-side session death released the lock; the
+                # holder must be TOLD, not silently "succeed".
+                import pytest as _pytest
+
+                with _pytest.raises(LockError, match="lost"):
+                    lock.unlock()
+            finally:
+                srv2.close()
+        finally:
+            c.close()
+
+    def test_lease_replay_never_clobbers_new_claimant(self, tmp_path):
+        import time
+
+        from cilium_tpu.kvstore.net import KvstoreServer, NetBackend
+
+        srv = KvstoreServer("127.0.0.1", 0)
+        port = int(srv.address.rpartition(":")[2])
+        a = NetBackend(srv.address, timeout=8.0)
+        try:
+            a.set("claim/id", b"owner-a", lease=True)
+            srv.close()
+            srv2 = None
+            for _ in range(80):
+                try:
+                    srv2 = KvstoreServer("127.0.0.1", port)
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            assert srv2 is not None
+            try:
+                # B races A's background replay for the key on the
+                # fresh server.  Either may win — the invariant is that
+                # the FIRST claimant keeps it (replay never clobbers).
+                b = NetBackend(srv2.address, timeout=8.0)
+                try:
+                    created_b = b.create_only(
+                        "claim/id", b"owner-b", lease=True
+                    )
+                    a.set("other", b"1")  # ensure A reconnected+replayed
+                    winner = b"owner-b" if created_b else b"owner-a"
+                    assert a.get("claim/id") == winner
+                    assert b.get("claim/id") == winner
+                finally:
+                    b.close()
+            finally:
+                srv2.close()
+        finally:
+            a.close()
