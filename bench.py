@@ -738,6 +738,13 @@ def run_one(which: str) -> None:
         # remote-chip tunnel it dominates every figure; on co-located
         # TPU it collapses to O(0.1ms).
         r1m = next(r for r in lat["rates"] if r.offered_rate == 1_000_000)
+        r100k = next(r for r in lat["rates"] if r.offered_rate == 100_000)
+        rtt = max(lat["device_rtt_ms"], 1e-9)
+        # The 1M/s point saturates a slow shared uplink (measured as low
+        # as ~12MB/s on the tunneled bench chip) and then measures queue
+        # depth, not the architecture; the 100k point and the uplink
+        # figure are emitted alongside so the number can be read against
+        # the transport it was taken on.
         _emit(
             "sidecar_added_latency_p99_ms_at_1M",
             r1m.added_p99_ms,
@@ -746,9 +753,10 @@ def run_one(which: str) -> None:
             p50_ms=round(r1m.p50_ms, 3),
             achieved_rate=round(r1m.achieved_rate),
             device_rtt_ms=round(lat["device_rtt_ms"], 2),
-            rtt_multiples_p99=round(
-                r1m.p99_ms / max(lat["device_rtt_ms"], 1e-9), 2
-            ),
+            uplink_mbps=round(lat["uplink_mbps"], 1),
+            rtt_multiples_p99=round(r1m.p99_ms / rtt, 2),
+            p99_ms_at_100k=round(r100k.p99_ms, 2),
+            rtt_multiples_p99_at_100k=round(r100k.p99_ms / rtt, 2),
             dispatch_mode=lat["dispatch_mode"],
         )
     elif which == "latency_colocated":
