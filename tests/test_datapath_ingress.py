@@ -238,3 +238,45 @@ def test_reply_to_egress_connection_is_established():
         ipi("203.0.113.50"), ipi("10.0.0.10"), 443, 50000, PROTO_TCP,
     )
     assert want["verdict"] == FORWARD and want["established"]
+
+
+def test_verdict_accounting_handles_ingress_output():
+    """account_verdicts on netdev_verdicts output: TO_HOST/TO_OVERLAY
+    count as forwarded, drop notifications carry the remote (source)
+    identity (reference: update_metrics counts every delivery verdict)."""
+    from cilium_tpu.datapath.notify import account_verdicts
+    from cilium_tpu.maps.metricsmap import (
+        METRIC_DIR_INGRESS,
+        MetricsMap,
+        REASON_FORWARDED,
+    )
+    from cilium_tpu.monitor import MSG_TYPE_DROP, Monitor
+
+    rng = random.Random(42)
+    ipc, lxc, ct, pol = build_node(rng)
+    tables = build_ingress_tables(ipc, lxc, ct, pol)
+    p = gen(rng, 256)
+    out = netdev_verdicts(
+        tables, p["saddr"], p["daddr"], p["sport"], p["dport"], p["proto"],
+        p["src_id"],
+    )
+    metrics = MetricsMap()
+    monitor = Monitor(1024)
+    counts = account_verdicts(
+        out, metrics, monitor=monitor, direction=METRIC_DIR_INGRESS,
+        dports=p["dport"], proto=p["proto"],
+    )
+    verdict = np.asarray(out["verdict"])
+    # FORWARD + TO_HOST + TO_OVERLAY are all delivery outcomes.
+    assert counts["forwarded"] == int(np.isin(verdict, (0, 3, 4)).sum())
+    assert counts["dropped"] == int((verdict == 1).sum())
+    assert (
+        counts["forwarded"] + counts["dropped"] + counts["proxied"]
+        == len(verdict)
+    )
+    assert metrics.get(REASON_FORWARDED, METRIC_DIR_INGRESS).count == \
+        counts["forwarded"] + counts["proxied"]
+    drops = [e for e in monitor.recent(1024) if e.type == MSG_TYPE_DROP]
+    if drops:
+        # Ingress drops carry the derived remote identity.
+        assert drops[0].payload["src_identity"] != 0

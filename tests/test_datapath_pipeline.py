@@ -187,3 +187,50 @@ def test_service_dnat_and_revnat():
     # device backend pick agrees with the host pick (same hash fn)
     want = host_oracle(ct, lb, ipc, pol, ip(3), vip, 1234, 80, PROTO_TCP)
     assert nd == want["new_daddr"]
+
+def test_verdict_accounting_metrics_and_drop_notifications():
+    """Batched metrics + bounded drop notifications from one pipeline
+    output (reference: bpf/lib/metrics.h update_metrics +
+    drop.h send_drop_notify -> perf ring -> monitor)."""
+    from cilium_tpu.datapath.notify import (
+        DROP_POLICY_REASON,
+        MAX_DROP_NOTIFICATIONS,
+        account_verdicts,
+    )
+    from cilium_tpu.maps.metricsmap import (
+        METRIC_DIR_EGRESS,
+        MetricsMap,
+        REASON_FORWARDED,
+    )
+    from cilium_tpu.monitor import MSG_TYPE_DROP, Monitor
+
+    rng = random.Random(41)
+    ct, lb, ipc, pol = build_world(rng)
+    tables = build_tables(ct, lb, ipc, pol)
+    pkts = gen_packets(rng, 512)
+    out = datapath_verdicts(tables, *pkts)
+
+    metrics = MetricsMap()
+    monitor = Monitor(4096)
+    lengths = np.full((512,), 100, np.int64)
+    counts = account_verdicts(
+        out, metrics, monitor=monitor, lengths=lengths,
+        dports=pkts[3], proto=pkts[4],
+    )
+    verdict = np.asarray(out["verdict"])
+    assert counts["dropped"] == int((verdict == 1).sum())
+    assert counts["forwarded"] == int((verdict == 0).sum())
+    assert counts["proxied"] == int((verdict == 2).sum())
+
+    fwd = metrics.get(REASON_FORWARDED, METRIC_DIR_EGRESS)
+    assert fwd.count == counts["forwarded"] + counts["proxied"]
+    assert fwd.bytes == 100 * fwd.count
+    drp = metrics.get(DROP_POLICY_REASON, METRIC_DIR_EGRESS)
+    assert drp.count == counts["dropped"]
+    assert drp.bytes == 100 * counts["dropped"]
+
+    # Drop notifications are emitted (bounded) with packet context.
+    drops = [e for e in monitor.recent(4096) if e.type == MSG_TYPE_DROP]
+    assert len(drops) == min(counts["dropped"], MAX_DROP_NOTIFICATIONS)
+    if drops:
+        assert drops[0].payload["dport"] in (80, 8080, 8000, 53, 9999)
