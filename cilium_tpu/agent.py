@@ -64,7 +64,10 @@ def main(argv=None) -> int:
     p.add_argument("--cluster-name", default=defaults.CLUSTER_NAME)
     p.add_argument("--enable-policy", default="default",
                    choices=["default", "always", "never"])
-    p.add_argument("--kvstore", default="local", choices=["local", "file"])
+    p.add_argument("--kvstore", default="local",
+                   choices=["local", "file", "tcp"])
+    p.add_argument("--kvstore-address", default="",
+                   help="host:port of the kvstore server (kvstore=tcp)")
     p.add_argument("--dry-mode", action="store_true",
                    help="skip device exports (reference: DryMode)")
     p.add_argument("--restore", action=argparse.BooleanOptionalAction,
@@ -82,6 +85,9 @@ def main(argv=None) -> int:
         cluster_name=args.cluster_name,
         enable_policy=args.enable_policy,
         kvstore=args.kvstore,
+        kvstore_opts=(
+            {"address": args.kvstore_address} if args.kvstore_address else {}
+        ),
         dry_mode=args.dry_mode,
         restore_state=args.restore,
     )
