@@ -16,8 +16,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any
 
+import logging
+
+from ..utils import flowdebug
 from .accesslog import EntryType, LogEntry
 from .types import DROP, ERROR, INJECT, MORE, NOP, PASS, FilterResult, OpType
+
+_flow_log = logging.getLogger("cilium_tpu.proxylib.flow")
 
 # Default op-list capacity, matching the Envoy-side caller's array
 # (reference: envoy/cilium_proxylib.cc:201 — max 16 ops per OnIO call).
@@ -91,6 +96,14 @@ class Connection:
                     break
                 if nbytes == 0:
                     return FilterResult.PARSER_ERROR
+                # Per-flow op tracing rides the flowdebug gate so the
+                # hot loop pays one boolean when disabled (reference:
+                # pkg/flowdebug consumers in pkg/proxy).
+                flowdebug.log(
+                    _flow_log, "conn %d %s %s op=%s n=%d",
+                    self.conn_id, self.parser_name,
+                    "reply" if reply else "orig", op.name, nbytes,
+                )
                 ops.append((op, nbytes))
                 if op == MORE:
                     break

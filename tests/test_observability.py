@@ -115,3 +115,45 @@ def test_flowdebug_gate(caplog):
         finally:
             flowdebug.disable()
     assert [r.getMessage() for r in caplog.records] == ["shown 2"]
+
+
+def test_flowdebug_traces_proxylib_ops(caplog):
+    """With the gate enabled, every parser op is traced per flow; with
+    it disabled the hot loop logs nothing (reference: pkg/flowdebug
+    consumers in pkg/proxy)."""
+    from cilium_tpu.proxylib import (
+        NetworkPolicy,
+        PortNetworkPolicy,
+        PortNetworkPolicyRule,
+    )
+    from cilium_tpu.proxylib import instance as inst
+    from proxylib_harness import new_connection
+
+    inst.reset_module_registry()
+    mod = inst.open_module([], True)
+    ins = inst.find_instance(mod)
+    ins.policy_update([NetworkPolicy(
+        name="fd", policy=2,
+        ingress_per_port_policies=[PortNetworkPolicy(port=80, rules=[
+            PortNetworkPolicyRule(l7_proto="r2d2",
+                                  l7_rules=[{"cmd": "HALT"}])])],
+    )])
+    res, conn = new_connection(
+        mod, "r2d2", True, 1, 2, "1.1.1.1:1", "2.2.2.2:80", "fd"
+    )
+    try:
+        with caplog.at_level(logging.DEBUG, logger="cilium_tpu.proxylib.flow"):
+            ops = []
+            conn.on_data(False, False, [b"HALT\r\n"], ops)
+            assert not caplog.records  # gate off: silent
+            flowdebug.enable()
+            try:
+                ops = []
+                conn.on_data(False, False, [b"HALT\r\n"], ops)
+            finally:
+                flowdebug.disable()
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("r2d2" in m and "PASS" in m for m in msgs)
+    finally:
+        inst.close_module(mod)
+        inst.reset_module_registry()
