@@ -546,6 +546,7 @@ class Daemon:
                 ),
             },
             "monitor": self.monitor.status(),
+            "verdict_service": self._verdict_service_status(),
             "controllers": [
                 {
                     "name": s.name,
@@ -556,6 +557,20 @@ class Daemon:
                 for s in self.controllers.statuses()
             ],
         }
+
+    def _verdict_service_status(self):
+        """Counters from the attached verdict service (reference: the
+        agent's Envoy admin scrape feeding `cilium status`)."""
+        if self.npds_pusher is None:
+            return None
+        try:
+            st = self.npds_pusher.client.status()
+        except Exception:  # noqa: BLE001 — service may be down
+            return {"state": "unreachable"}
+        st["state"] = "Ok"
+        st["npds_pushes"] = self.npds_pusher.pushes
+        st["npds_nacks"] = self.npds_pusher.nacks
+        return st
 
     def _endpoints_by_state(self) -> dict:
         out: dict[str, int] = {}

@@ -188,6 +188,11 @@ class SidecarClient:
         )
         return int(np.frombuffer(got, "<u8", 1)[0])
 
+    def status(self) -> dict:
+        """Service counters (MSG_STATUS round trip)."""
+        got = self._control_rpc(wire.MSG_STATUS, b"", wire.MSG_STATUS_REPLY)
+        return json.loads(got.decode())
+
     def policy_update(self, module_id: int, policies) -> int:
         payload = json.dumps([asdict(p) for p in policies]).encode()
         got = self._control_rpc(

@@ -274,6 +274,29 @@ class VerdictService:
     def open_module(self, params, debug: bool) -> int:
         return pl.open_module(params, debug)
 
+    def status(self) -> dict:
+        """Service counters for operators/status/bugtool (the
+        reference's nearest analog is the Envoy admin surface the agent
+        scrapes for `cilium status`)."""
+        with self._lock:
+            n_conns = len(self._conns)
+            n_engines = len(self._engines)
+        return {
+            "connections": n_conns,
+            "engines": n_engines,
+            "dispatch_mode": self.dispatch_mode_chosen,
+            "requests": self.fast_log.requests,
+            "denied": self.fast_log.denied,
+            "vec_batches": self.vec_batches,
+            "vec_entries": self.vec_entries,
+            "dispatcher": {
+                "batches": self.dispatcher.batches,
+                "entries": self.dispatcher.entries,
+                "fill": self.dispatcher.fill_dispatches,
+                "deadline": self.dispatcher.deadline_dispatches,
+            },
+        }
+
     def close_module(self, module_id: int) -> None:
         pl.close_module(module_id)
 
@@ -1461,6 +1484,11 @@ class _ClientHandler:
                     module_id, pj = wire.unpack_policy_update(payload)
                     status = self.service.policy_update(module_id, pj)
                     self.send(wire.MSG_ACK, wire.pack_ack(status))
+                elif msg_type == wire.MSG_STATUS:
+                    self.send(
+                        wire.MSG_STATUS_REPLY,
+                        json.dumps(self.service.status()).encode(),
+                    )
                 else:
                     log.warning("unknown message type %d", msg_type)
         except wire.ConnectionClosed:

@@ -51,6 +51,8 @@ MSG_ACK = 9
 # batch layout (request direction only, no end_stream).  The TPU-first
 # ingestion format: padding happens at the edge, once.
 MSG_DATA_MATRIX = 10
+MSG_STATUS = 11  # -> MSG_STATUS_REPLY (JSON service counters)
+MSG_STATUS_REPLY = 12
 
 # OnIO op capacity per verdict entry (reference: cilium_proxylib.cc:199).
 MAX_OPS_PER_ENTRY = 16

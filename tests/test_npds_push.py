@@ -127,3 +127,15 @@ def test_daemon_policy_drives_verdict_service(world):
         )
     finally:
         shim_client.close()
+
+
+def test_verdict_service_status_surfaces_in_daemon(world):
+    """`cilium status` shows the attached verdict service's counters
+    (the agent's proxy-admin scrape analog)."""
+    d, svc = world
+    assert d.status()["verdict_service"] is None  # not attached yet
+    d.attach_verdict_service(svc.socket_path)
+    st = d.status()["verdict_service"]
+    assert st["state"] == "Ok"
+    assert st["npds_pushes"] >= 0 and "dispatcher" in st
+    assert "connections" in st and "requests" in st
