@@ -762,6 +762,12 @@ def run_one(which: str) -> None:
     elif which == "latency_colocated":
         # Device term removed (CPU-backed verdict models): measures the
         # seam architecture itself — the co-located sub-ms proof.
+        # os_noise is the host's own scheduler-stall floor (measured in
+        # a tight loop with nothing running): on the shared 1-core
+        # bench VMs, external 1-17ms stalls occupy ~1-2% of wall time,
+        # which bounds any honest p99 from below — p90/p95 and the
+        # release-lateness split are emitted so the seam's own
+        # contribution is auditable.
         lat = bench_latency(colocated=True)
         r100k = next(r for r in lat["rates"] if r.offered_rate == 100_000)
         _emit(
@@ -770,9 +776,15 @@ def run_one(which: str) -> None:
             "ms",
             1.0 / max(r100k.added_p99_ms, 1e-9),
             p50_ms=round(r100k.p50_ms, 3),
+            p90_ms=round(r100k.p90_ms, 3),
             p99_ms=round(r100k.p99_ms, 3),
             achieved_rate=round(r100k.achieved_rate),
             dispatch_mode=lat["dispatch_mode"],
+            release_late_p50_ms=round(r100k.release_late_p50_ms, 3),
+            release_late_p99_ms=round(r100k.release_late_p99_ms, 3),
+            p99_runs_100k=lat["p99_runs"].get(100_000, []),
+            os_noise=lat["os_noise"],
+            seam_stages_us=lat.get("seam_stages_us", {}),
         )
     elif which == "datapath":
         rate, cpu = bench_datapath()

@@ -147,9 +147,10 @@ class SidecarClient:
     # -- plumbing ---------------------------------------------------------
 
     def _read_loop(self) -> None:
+        reader = wire.BufferedReader(self.sock)
         try:
             while True:
-                msg_type, payload = wire.recv_msg(self.sock)
+                msg_type, payload = reader.recv_msg()
                 if msg_type == wire.MSG_VERDICT_BATCH:
                     vb = wire.unpack_verdict_batch(payload)
                     cb = self.verdict_callback
@@ -159,6 +160,15 @@ class SidecarClient:
                         evt.set()
                     elif cb is not None:
                         cb(vb)
+                elif msg_type == wire.MSG_VERDICT_MULTI:
+                    cb = self.verdict_callback
+                    for vb in wire.unpack_verdict_multi(payload):
+                        evt = self._pending.pop(vb.seq, None)
+                        if evt is not None:
+                            self._verdicts[vb.seq] = vb
+                            evt.set()
+                        elif cb is not None:
+                            cb(vb)
                 else:
                     self._control.append((msg_type, payload))
                     self._control_evt.set()
@@ -269,10 +279,15 @@ class SidecarClient:
             wire.send_msg(self.sock, wire.MSG_DATA_BATCH, payload)
 
     def send_matrix(self, seq: int, width: int, conn_ids, lengths,
-                    rows_bytes: bytes) -> None:
+                    rows_bytes: bytes, complete: bool = False) -> None:
         """Fixed-width pre-padded batch (request direction): the service
-        reshapes straight into the device layout."""
-        payload = wire.pack_data_matrix(seq, width, conn_ids, lengths, rows_bytes)
+        reshapes straight into the device layout.  ``complete=True``
+        declares every row is exactly one whole frame (the edge owns
+        framing), letting the service skip its per-row content scan."""
+        payload = wire.pack_data_matrix(
+            seq, width, conn_ids, lengths, rows_bytes,
+            wire.MAT_FLAG_COMPLETE if complete else 0,
+        )
         with self._wlock:
             wire.send_msg(self.sock, wire.MSG_DATA_MATRIX, payload)
 

@@ -61,6 +61,13 @@ class BatchDispatcher:
         self._oldest_ts = 0.0
         self._stopped = False
         self._in_process_lock = threading.Lock()
+        # True from the moment the worker pops a batch in _take until it
+        # finishes processing it.  Set BEFORE _pending is cleared (both
+        # under _cond) so a lock-free reader that observes an empty
+        # _pending is guaranteed to observe _busy=True for any popped
+        # batch still in flight — the ordering the service's cut-through
+        # path relies on to never overtake queued work.
+        self._busy = False
         self._worker = threading.Thread(target=self._run, name=name, daemon=True)
         # Dispatch telemetry (read by benches/status).
         self.batches = 0
@@ -97,32 +104,27 @@ class BatchDispatcher:
         with self._in_process_lock:
             pass
 
+    def _pop_locked(self) -> list[Any]:
+        self._busy = True  # before the clear — see __init__ note
+        batch = self._pending
+        self._pending = []
+        self._pending_weight = 0
+        return batch
+
     def _take(self) -> tuple[list[Any], bool]:
         """Wait for fill or deadline; returns (batch, was_deadline)."""
         with self._cond:
             while True:
                 if self._stopped:
-                    batch = self._pending
-                    self._pending = []
-                    self._pending_weight = 0
-                    return batch, False
+                    return self._pop_locked(), False
                 if self._pending_weight >= self.max_batch:
-                    batch = self._pending
-                    self._pending = []
-                    self._pending_weight = 0
-                    return batch, False
+                    return self._pop_locked(), False
                 if self._pending:
                     if self.timeout_s <= 0:  # greedy mode
-                        batch = self._pending
-                        self._pending = []
-                        self._pending_weight = 0
-                        return batch, False
+                        return self._pop_locked(), False
                     wait = self.timeout_s - (time.perf_counter() - self._oldest_ts)
                     if wait <= 0:
-                        batch = self._pending
-                        self._pending = []
-                        self._pending_weight = 0
-                        return batch, True
+                        return self._pop_locked(), True
                     self._cond.wait(wait)
                 else:
                     self._cond.wait()
@@ -146,6 +148,7 @@ class BatchDispatcher:
                         logging.getLogger(__name__).exception(
                             "batch process failed"
                         )
+            self._busy = False
             if self._stopped and not batch:
                 return
             if self._stopped:

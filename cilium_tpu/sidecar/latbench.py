@@ -69,6 +69,11 @@ class RateResult:
     gen_saturated: bool
     added_p50_ms: float
     added_p99_ms: float
+    # Release lateness: how far behind schedule the open-loop generator
+    # was when it actually shipped each request (diagnoses how much of
+    # the measured latency is generator-side scheduling vs the seam).
+    release_late_p50_ms: float = 0.0
+    release_late_p99_ms: float = 0.0
 
 
 class LatencyBench:
@@ -186,7 +191,9 @@ class LatencyBench:
             rows = (
                 self.pool_rows[ai:].tobytes() + self.pool_rows[:bi].tobytes()
             )
-        self.client.send_matrix(seq, self.width, ids, lens, rows)
+        # complete=True: the pool rows are built as single whole frames,
+        # so the edge declares framing and the service skips its scan.
+        self.client.send_matrix(seq, self.width, ids, lens, rows, complete=True)
 
     def run_rate(self, rate: float, n_requests: int, seed: int = 3) -> RateResult:
         import gc
@@ -201,7 +208,21 @@ class LatencyBench:
         finally:
             gc.enable()
 
+    @staticmethod
+    def _tighten_timer_slack() -> None:
+        """Best-effort per-thread timer slack reduction (default 50µs —
+        measured to stretch a 100µs pacing sleep to ~175µs; 1µs slack
+        brings it to ~120µs, which lands directly in release lateness)."""
+        try:
+            import ctypes
+
+            libc = ctypes.CDLL("libc.so.6", use_errno=True)
+            libc.prctl(29, 1000, 0, 0, 0)  # PR_SET_TIMERSLACK = 29, 1µs
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
+
     def _run_rate(self, rate: float, n_requests: int, seed: int) -> RateResult:
+        self._tighten_timer_slack()
         rng = np.random.default_rng(seed)
         inter = rng.exponential(1.0 / rate, n_requests)
         sched = np.cumsum(inter)  # scheduled arrival times (s from start)
@@ -226,6 +247,7 @@ class LatencyBench:
         t0 = time.perf_counter()
         i = 0
         gen_behind = False
+        release_late = np.empty(n_requests)
         while i < n_requests:
             now = time.perf_counter() - t0
             j = int(np.searchsorted(sched, now))
@@ -245,6 +267,9 @@ class LatencyBench:
                     seq = self._next_seq
                     self._next_seq += 1
                     sent[seq] = (i, b, time.perf_counter())
+                    release_late[i:b] = (
+                        time.perf_counter() - t0
+                    ) - sched[i:b]
                     self._send_range(seq, i, b)
                     i = b
             else:
@@ -279,6 +304,12 @@ class LatencyBench:
             gen_saturated=gen_behind or achieved / rate < 0.98,
             added_p50_ms=0.0,  # filled by caller after oracle measure
             added_p99_ms=0.0,
+            release_late_p50_ms=float(
+                np.percentile(release_late * 1000.0, 50)
+            ),
+            release_late_p99_ms=float(
+                np.percentile(release_late * 1000.0, 99)
+            ),
         )
 
     def oracle_latency_ms(self, n: int = 20000) -> tuple[float, float]:
@@ -325,6 +356,33 @@ def measure_uplink_mbps(n: int = 6, size: int = 512 * 1024) -> float:
         jax.block_until_ready(jax.device_put(x))
     dt = time.perf_counter() - t0
     return n * size / dt / 1e6
+
+
+def measure_os_noise(window_s: float = 2.0) -> dict:
+    """Scheduler-noise floor of the host: gaps observed by a tight
+    single-thread loop with nothing else runnable in-process.  On the
+    shared 1-core bench VMs, hypervisor/cotenant stalls of 1-17ms are
+    routinely observed (~1-2% of wall time above 1ms) — an external
+    additive term every latency percentile here inherits.  Reported
+    alongside the percentiles so they can be read against the host."""
+    gaps = []
+    t_prev = time.perf_counter()
+    t_end = t_prev + window_s
+    while True:
+        t = time.perf_counter()
+        if t - t_prev > 0.0003:
+            gaps.append(t - t_prev)
+        t_prev = t
+        if t > t_end:
+            break
+    g = np.array(gaps) if gaps else np.zeros(1)
+    return {
+        "window_s": window_s,
+        "gaps_over_0p3ms": len(gaps),
+        "gap_max_ms": round(float(g.max()) * 1e3, 2),
+        "gap_sum_ms": round(float(g.sum()) * 1e3, 1),
+        "stall_fraction": round(float(g.sum()) / window_s, 4),
+    }
 
 
 def measure_device_rtt_ms(n: int = 12) -> float:
@@ -377,7 +435,12 @@ def run(
         # pending the moment it frees up (arrivals self-coalesce while
         # a round is in flight).
         kw.setdefault("batch_timeout_ms", 0.0)
-        kw.setdefault("client_timeout_ms", 0.1)
+        # Ship whatever is pending on every generator wakeup: with the
+        # service in cut-through mode there is no per-round transport
+        # cost worth amortizing, so any client-side hold is pure added
+        # latency.  Batch formation still happens naturally from the
+        # generator's wakeup granularity (~0.17ms sleep quantum).
+        kw.setdefault("client_timeout_ms", 0.0)
         rtt_ms = 0.0
         uplink_mbps = 0.0
     else:
@@ -393,15 +456,30 @@ def run(
         # the tunneled bench chip), so ship exact payload bytes and let
         # the device build the padded row view.
         kw.setdefault("wire_mode", "blob")
+    # Deep rounds: the cap only binds under backlog, where amortizing
+    # the ~200µs per-round fixed cost over more entries is what keeps
+    # the 1M/s point stable (a 1024 cap measured p99 14ms there).
     kw.setdefault("batch_flows", 8192)
     kw.setdefault("client_batch", 2048)
     bench = LatencyBench(socket_path, **kw)
     try:
+        os_noise = measure_os_noise()
         oracle_p50, oracle_p99 = bench.oracle_latency_ms()
         results = []
+        p99_runs: dict[float, list] = {}
         for rate in rates:
             n = min(n_requests, max(20_000, int(rate * 0.5)))
-            r = bench.run_rate(rate, n)
+            # The shared bench VMs suffer external multi-ms scheduler
+            # stalls (see measure_os_noise) at ~1-2% of wall time —
+            # enough to set p99 single-handedly in an unlucky window.
+            # The colocated seam metric takes the median-of-3 run so
+            # the architecture, not one hypervisor stall, is measured;
+            # every run's p99 is reported alongside.
+            reps = 3 if (colocated and rate <= 100_000) else 1
+            runs = [bench.run_rate(rate, n, seed=3 + k) for k in range(reps)]
+            runs.sort(key=lambda rr: rr.p99_ms)
+            p99_runs[rate] = [round(rr.p99_ms, 3) for rr in runs]
+            r = runs[len(runs) // 2]
             # Raw added latency vs the in-process oracle, and the
             # co-located-hardware projection (one link RTT plus the
             # RTT-scaled batching windows removed; on local TPU those
@@ -416,13 +494,22 @@ def run(
             "uplink_mbps": uplink_mbps,
             "colocated": colocated,
             "dispatch_mode": bench.service.dispatch_mode_chosen,
+            "os_noise": os_noise,
+            "p99_runs": p99_runs,
             "rates": results,
             "dispatcher": {
                 "batches": bench.service.dispatcher.batches,
                 "fill": bench.service.dispatcher.fill_dispatches,
                 "deadline": bench.service.dispatcher.deadline_dispatches,
+                "inline": bench.service.inline_batches,
                 "vec_batches": bench.service.vec_batches,
                 "vec_entries": bench.service.vec_entries,
+            },
+            # Published seam breakdown (seam_probe runs): per-stage
+            # thread-CPU of the group fast path, µs per round.
+            "seam_stages_us": {
+                k: round(v[1] / max(v[0], 1) * 1e6, 1)
+                for k, v in bench.service.seam_stages.items()
             },
         }
     finally:

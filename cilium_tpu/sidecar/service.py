@@ -35,7 +35,9 @@ import logging
 import os
 import queue
 import socket
+import struct
 import threading
+import time
 
 import numpy as np
 
@@ -94,19 +96,29 @@ class _TabSnap:
     O(round conns), not O(table size).  Out-of-range ids materialize as
     engine=-1 / dirty=1 so they fail vec eligibility naturally."""
 
-    __slots__ = ("ids", "engine", "src", "dirty", "objs")
+    __slots__ = ("ids", "engine", "src", "dirty", "objs", "single")
 
-    def __init__(self, ids, engine, src, dirty, objs):
+    def __init__(self, ids, engine, src, dirty, objs, single=False):
         self.ids = ids
         self.engine = engine
         self.src = src
         self.dirty = dirty
         self.objs = objs
+        # True when the snapshot rows are exactly one item's conn_ids in
+        # arrival order — lookups are then the identity (no search).
+        self.single = single
 
     def lookup(self, cids: np.ndarray) -> np.ndarray:
         """Positions of cids in the snapshot rows (every data-item conn
         id is in self.ids by construction)."""
+        n = len(cids)
+        if self.single and n == len(self.ids) and n <= len(_IDENTITY):
+            return _IDENTITY[:n]
         return np.searchsorted(self.ids, cids.astype(np.int64))
+
+
+# Shared identity-permutation prefix for single-item snapshot lookups.
+_IDENTITY = np.arange(1 << 14)
 
 
 class _ColumnarLog:
@@ -153,6 +165,14 @@ class VerdictService:
         self._clients: list["_ClientHandler"] = []
         self._stopped = False
         self.fast_log = _ColumnarLog()
+        # Per-batch-size scratch for verdict frame assembly (op pattern
+        # template + constant columns) — bounds per-frame numpy work to
+        # one template copy and two strided stores.
+        self._frame_tpl: dict[int, tuple] = {}
+        # Per-stage CPU accounting of the group fast path (seam_probe
+        # runs only): stage -> [calls, thread-CPU seconds].  This is the
+        # published seam breakdown the latency bench reports.
+        self.seam_stages: dict[str, list] = {}
         # Vectorized-path conn table: parallel arrays indexed by conn_id
         # (grown on demand) so batch eligibility and remote-identity
         # lookups are O(1) numpy gathers instead of per-entry dict walks.
@@ -163,6 +183,7 @@ class VerdictService:
         self._engine_objs: list[object] = []
         self._engine_idx: dict[int, int] = {}  # id(engine) -> table idx
         self._engine_free: list[int] = []
+        self._objs_cache: tuple | None = None  # invalidated on mutation
         # id(model) -> (model, jitted fn); the model reference pins the
         # id so a gc'd model can never alias a cache entry.
         self._jit_cache: dict[int, tuple] = {}
@@ -201,10 +222,30 @@ class VerdictService:
         # ALL sends must then go inline (vec and entrywise) so per-conn
         # FIFO order is owned by one thread.
         self._inline_complete = self.config.batch_timeout_ms <= 0
+        # Cut-through telemetry (greedy mode): rounds processed directly
+        # on the shim reader thread, skipping the dispatcher handoff.
+        self.inline_batches = 0
+        self._prev_switch_interval: float | None = None
 
     # -- lifecycle --------------------------------------------------------
 
+    # GIL switch interval while a greedy (co-located) service is up.
+    # The interpreter default is 5ms — on a small host one Python thread
+    # mid-bytecode can stall every other seam thread for 5ms, which IS
+    # the latency tail.  0.5ms was chosen by sweep: lower values (50µs)
+    # make jax's internal mutexes spin under contention (measured
+    # ~400µs of burned thread-CPU per device call), higher ones grow
+    # the convoy tail.
+    GIL_SWITCH_INTERVAL_S = float(
+        os.environ.get("CILIUM_TPU_GIL_SWITCH_S", 5e-4)
+    )
+
     def start(self) -> "VerdictService":
+        if self._inline_complete:
+            import sys
+
+            self._prev_switch_interval = sys.getswitchinterval()
+            sys.setswitchinterval(self.GIL_SWITCH_INTERVAL_S)
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -255,6 +296,11 @@ class VerdictService:
             os.unlink(self.socket_path)
         except OSError:
             pass
+        if self._prev_switch_interval is not None:
+            import sys
+
+            sys.setswitchinterval(self._prev_switch_interval)
+            self._prev_switch_interval = None
 
     def _accept_loop(self) -> None:
         while not self._stopped:
@@ -289,6 +335,7 @@ class VerdictService:
             "denied": self.fast_log.denied,
             "vec_batches": self.vec_batches,
             "vec_entries": self.vec_entries,
+            "inline_batches": self.inline_batches,
             "dispatcher": {
                 "batches": self.dispatcher.batches,
                 "entries": self.dispatcher.entries,
@@ -395,6 +442,7 @@ class VerdictService:
                 idx = len(self._engine_objs)
                 self._engine_objs.append(engine)
             self._engine_idx[id(engine)] = idx
+            self._objs_cache = None
         self._tab_engine[conn_id] = idx
 
     def _release_engines(self, engines: list) -> None:
@@ -405,6 +453,7 @@ class VerdictService:
             if idx is not None:
                 self._engine_objs[idx] = None
                 self._engine_free.append(idx)
+                self._objs_cache = None
 
     def _tab_mark(self, conn_id: int, sc: "_SidecarConn") -> None:
         """Refresh the dirty flag from actual residual state."""
@@ -521,8 +570,188 @@ class VerdictService:
 
     # -- data plane (dispatcher worker thread only) -----------------------
 
-    def submit_data(self, client, batch: wire.DataBatch) -> None:
-        self.dispatcher.submit(("data", client, batch), weight=batch.count)
+    def submit_data(self, client, batch: wire.DataBatch,
+                    backlogged: bool = False) -> None:
+        item = ("data", client, batch)
+        if not backlogged and self._try_cut_through(item):
+            return
+        self.dispatcher.submit(item, weight=batch.count)
+
+    def submit_matrix(self, client, mb: wire.MatrixBatch,
+                      backlogged: bool = False) -> None:
+        item = ("mat", client, mb)
+        if not backlogged and self._try_cut_through(item):
+            return
+        self.dispatcher.submit(item, weight=mb.count)
+
+    def _try_cut_through(self, item) -> bool:
+        """Greedy-mode cut-through: process the round directly on the
+        shim reader thread when the service is idle — removes the
+        reader→dispatcher thread handoff (a GIL-scheduling wait, not a
+        fixed cost).  Under load the reader routes to the dispatcher
+        instead, whose busy-worker queueing is what aggregates the
+        backlog into large rounds.  (Full reader-side drain-and-process
+        was tried and reverted: it keeps rounds at 1-2 messages, so
+        per-round fixed costs multiply and the tail worsens ~2×.)
+
+        Per-connection FIFO is preserved: a connection's data arrives on
+        exactly one reader thread, so an earlier item from this client is
+        either already processed or sitting in the dispatcher queue — in
+        which case the queue is non-empty and we line up behind it.
+        """
+        if not self._inline_complete:
+            return False
+        disp = self.dispatcher
+        # Lock-free peek: queued or popped-but-unprocessed work anywhere
+        # means this item must line up behind it (the _busy set-before-
+        # clear ordering in dispatch._pop_locked makes this peek safe).
+        if disp._pending or disp._busy:
+            return False
+        # Non-blocking: if a round is mid-process, queue to the
+        # dispatcher so the worker coalesces everything that arrived
+        # during the in-flight round into ONE device call.
+        if not disp._in_process_lock.acquire(blocking=False):
+            return False
+        try:
+            if disp._pending or disp._busy:
+                return False
+            self.inline_batches += 1
+            try:
+                self._process([item])
+            except Exception:  # noqa: BLE001 — reader must survive
+                log.exception("cut-through process failed")
+        finally:
+            disp._in_process_lock.release()
+        return True
+
+    def _run_mat_group(self, items: list) -> bool:
+        """Whole-round fast path: every item is a complete-flag matrix
+        batch, judged with ONE eligibility gather, ONE (chunked) device
+        dispatch, ONE batched readback, and ONE verdict frame per
+        client.  This collapses the per-item costs that dominate
+        aggregated rounds (measured: eligibility 17µs + frame 14µs +
+        client unpack 8µs per item).  Returns False — with no side
+        effects — when the group needs the general path."""
+        stages = self.seam_stages if self.config.seam_probe else None
+        t0 = time.thread_time() if stages is not None else 0.0
+
+        def mark(stage: str) -> None:
+            nonlocal t0
+            if stages is None:
+                return
+            t1 = time.thread_time()
+            rec = stages.setdefault(stage, [0, 0.0])
+            rec[0] += 1
+            rec[1] += t1 - t0
+            t0 = t1
+
+        if len(items) == 1:
+            mb0 = items[0][2]
+            ids = mb0.conn_ids
+            lengths = mb0.lengths
+            rows = mb0.rows
+        else:
+            ids = np.concatenate([it[2].conn_ids for it in items])
+            lengths = np.concatenate([it[2].lengths for it in items])
+            rows = np.vstack([it[2].rows for it in items])
+        n = len(ids)
+        if n == 0:
+            return False
+        idx = ids.astype(np.int64)
+        mark("concat")
+        with self._lock:
+            if self._tab_size == 0 or int(idx.max()) >= self._tab_size:
+                return False
+            eng_idx = self._tab_engine[idx]
+            e0 = int(eng_idx[0])
+            if e0 < 0 or (eng_idx != e0).any():
+                return False
+            if self._tab_dirty[idx].any():
+                return False
+            remotes = self._tab_src[idx]
+            engine = self._engine_objs[e0]
+        if engine is None or isinstance(engine.model, ConstVerdict):
+            return False
+        if int(lengths.min()) < 2 or int(lengths.max()) > self.config.batch_width:
+            return False
+        mark("eligibility")
+        # Issue device chunks with the precomputed remotes, then one
+        # batched readback for the whole round.
+        lens32 = lengths.astype(np.int32)
+        issued = []
+        max_chunk = self.config.batch_flows
+        for a in range(0, n, max_chunk):
+            b = min(a + max_chunk, n)
+            cn = b - a
+            f_pad = self._min_bucket
+            while f_pad < cn:
+                f_pad *= 2
+            if cn == f_pad:
+                data, lens, rem = rows[a:b], lens32[a:b], remotes[a:b]
+            else:
+                data = np.zeros((f_pad, self.config.batch_width), np.uint8)
+                data[:cn] = rows[a:b]
+                lens = np.zeros(f_pad, np.int32)
+                lens[:cn] = lens32[a:b]
+                rem = np.zeros(f_pad, np.int32)
+                rem[:cn] = remotes[a:b]
+            _, _, chunk_allow = self._model_call(engine.model, data, lens, rem)
+            issued.append((chunk_allow, a, b, cn))
+        mark("device_issue")
+        allow = np.empty(n, bool)
+        for fut, a, b, cn in issued:
+            # np.asarray per array beats one batched device_get for the
+            # typical 1-2 co-located chunks (measured 3µs vs 20µs).
+            try:
+                allow[a:b] = np.asarray(fut)[:cn]
+            except Exception:  # noqa: BLE001 — deny on device error
+                log.exception("device readback failed")
+                allow[a:b] = False
+        mark("readback")
+        self.fast_log.log_batch("r2d2", n, int(n - allow.sum()))
+        self.vec_batches += 1
+        self.vec_entries += n
+        # Responses: one frame per client — a plain VERDICT_BATCH for a
+        # single seq, a VERDICT_MULTI covering all its seqs otherwise.
+        per_client: dict[int, list] = {}
+        start = 0
+        for _, client, mb in items:
+            per_client.setdefault(id(client), [client, [], [], []])
+            rec = per_client[id(client)]
+            rec[1].append(mb.seq)
+            rec[2].append(mb.count)
+            rec[3].append((start, start + mb.count))
+            start += mb.count
+        for client, seqs, counts, spans in per_client.values():
+            try:
+                if len(seqs) == 1:
+                    a, b = spans[0]
+                    client.send(
+                        wire.MSG_VERDICT_BATCH,
+                        self._verdict_frame(
+                            seqs[0], ids[a:b], lengths[a:b], allow[a:b]
+                        ),
+                    )
+                    continue
+                if spans[-1][1] - spans[0][0] == sum(counts):
+                    # Contiguous spans (the single-client round and any
+                    # unbroken run): zero-copy views.
+                    a, b = spans[0][0], spans[-1][1]
+                    c_ids, c_lens, c_allow = ids[a:b], lengths[a:b], allow[a:b]
+                else:
+                    sel = np.concatenate(
+                        [np.arange(a, b) for a, b in spans]
+                    )
+                    c_ids, c_lens, c_allow = ids[sel], lengths[sel], allow[sel]
+                body = self._verdict_body(c_ids, c_lens, c_allow)
+                client.send(
+                    wire.MSG_VERDICT_MULTI,
+                    wire.pack_verdict_multi(seqs, counts, len(c_ids), body),
+                )
+            except Exception:  # noqa: BLE001 — client may be gone
+                log.exception("verdict send failed")
+        mark("respond")
+        return True
 
     def submit_close(self, conn_id: int) -> None:
         with self._lock:
@@ -542,6 +771,23 @@ class VerdictService:
         """
         closes = [it[1:] for it in items if it[0] == "close"]
         data_items = [it for it in items if it[0] in ("data", "mat")]
+        # Whole-round fast path (greedy mode): every data item a
+        # complete-flag matrix batch of the configured width — one
+        # grouped eligibility/dispatch/readback/response pass.
+        if (
+            self._inline_complete
+            and data_items
+            and all(
+                it[0] == "mat"
+                and (it[2].flags & wire.MAT_FLAG_COMPLETE)
+                and it[2].width == self.config.batch_width
+                for it in data_items
+            )
+            and self._run_mat_group(data_items)
+        ):
+            for close_args in closes:
+                self.close_connection(*close_args)
+            return
         # Snapshot the conn tables under the lock once per round: the
         # eligibility checks and chunk issue below run lock-free on the
         # dispatcher thread while policy_update/new_connection mutate
@@ -587,12 +833,15 @@ class VerdictService:
     def _tab_snapshot(self, data_items: list) -> "_TabSnap | None":
         if not data_items:
             return None
+        single = False
         if len(data_items) == 1:
             one = data_items[0][2].conn_ids.astype(np.int64)
             # Single-item rounds with already strictly-increasing ids
-            # (the common matrix-batch shape) skip the unique() sort.
+            # (the common matrix-batch shape) skip the unique() sort and
+            # mark the snapshot identity-ordered for O(1) lookups.
             if len(one) and np.all(one[1:] > one[:-1]):
                 ids = one
+                single = True
             else:
                 ids = np.unique(one)
         else:
@@ -608,7 +857,22 @@ class VerdictService:
                     np.full(len(ids), -1, np.int32),
                     np.zeros(len(ids), np.int32),
                     np.ones(len(ids), np.uint8),
-                    [],
+                    (),
+                    single,
+                )
+            objs = self._objs_cache
+            if objs is None:
+                objs = self._objs_cache = tuple(self._engine_objs)
+            if len(ids) and int(ids[-1]) < self._tab_size:
+                # All in range (ids sorted): three plain gathers — the
+                # fancy index copies, which IS the snapshot.
+                return _TabSnap(
+                    ids,
+                    self._tab_engine[ids],
+                    self._tab_src[ids],
+                    self._tab_dirty[ids],
+                    objs,
+                    single,
                 )
             in_range = ids < self._tab_size
             clipped = np.where(in_range, ids, 0)
@@ -619,8 +883,7 @@ class VerdictService:
             dirty = np.where(
                 in_range, self._tab_dirty[clipped], 1
             ).astype(np.uint8)
-            objs = list(self._engine_objs)
-        return _TabSnap(ids, engine, src, dirty, objs)
+        return _TabSnap(ids, engine, src, dirty, objs, single)
 
     def _matrix_eligible(self, mb: wire.MatrixBatch, snap: "_TabSnap"):
         """Engine for a fixed-width matrix batch, or None to fall back."""
@@ -640,6 +903,10 @@ class VerdictService:
         engine = snap.objs[e0]
         if engine is None or isinstance(engine.model, ConstVerdict):
             return None
+        if mb.flags & wire.MAT_FLAG_COMPLETE:
+            # The edge declared whole-frame rows (it owns framing);
+            # skip the per-row content scan.
+            return engine
         rows = mb.rows
         li = lengths.astype(np.int64)
         ar = np.arange(n)
@@ -688,11 +955,20 @@ class VerdictService:
 
     # Fixed device batch buckets: padded shapes are drawn from this small
     # set so XLA compiles each (bucket, width) once and never again — the
-    # anti-churn guard for mixed batch sizes.
+    # anti-churn guard for mixed batch sizes.  Greedy (co-located) mode
+    # uses a smaller floor: its common round is one ~10-30-entry message
+    # processed inline, and local compiles are cheap; the remote path
+    # keeps the 256 floor so prewarm pays 3 fewer multi-second compiles
+    # through the tunneled link.
     MIN_BUCKET = 256
+    MIN_BUCKET_GREEDY = 32
+
+    @property
+    def _min_bucket(self) -> int:
+        return self.MIN_BUCKET_GREEDY if self._inline_complete else self.MIN_BUCKET
 
     def _buckets(self) -> list[int]:
-        out = [self.MIN_BUCKET]
+        out = [self._min_bucket]
         while out[-1] < self.config.batch_flows:
             out.append(out[-1] * 2)
         return out
@@ -708,10 +984,12 @@ class VerdictService:
 
         return jax.default_device(self._exec_device)
 
-    @staticmethod
-    def _jit_for(cache: dict, model, trace_fn):
+    def _jit_for(self, cache: dict, model, trace_fn):
         """id(model)-keyed jit cache; the stored model reference pins
-        the id so a gc'd model can never alias an entry."""
+        the id so a gc'd model can never alias an entry.  (Binding the
+        device via in_shardings instead of the default-device ctx was
+        tried and reverted: 15µs/call isolated but ~400µs of spinning
+        thread-CPU under multi-thread contention on a small host.)"""
         ent = cache.get(id(model))
         if ent is None:
             import jax
@@ -747,7 +1025,7 @@ class VerdictService:
 
         import jax
 
-        b = self.MIN_BUCKET
+        b = self._min_bucket
         width = self.config.batch_width
         data = np.zeros((b, width), np.uint8)
         lens = np.zeros(b, np.int32)
@@ -889,7 +1167,7 @@ class VerdictService:
         for a in range(0, n, max_chunk):
             b = min(a + max_chunk, n)
             cn = b - a
-            f_pad = self.MIN_BUCKET
+            f_pad = self._min_bucket
             while f_pad < cn:
                 f_pad *= 2
             if cn == f_pad:
@@ -943,7 +1221,7 @@ class VerdictService:
                 )
                 b = max(b, a + 1)  # an entry never exceeds the window
             cn = b - a
-            f_pad = self.MIN_BUCKET
+            f_pad = self._min_bucket
             while f_pad < cn:
                 f_pad *= 2
             nb = int(ends[b - 1]) - base
@@ -999,9 +1277,21 @@ class VerdictService:
         self.fast_log.log_batch("r2d2", n, int(n - allow.sum()))
         self.vec_batches += 1
         self.vec_entries += n
+        # Coalesce this round's verdict frames per client: one sendall
+        # per client instead of one syscall (+ writer-lock trip) per
+        # original message — the dominant per-item cost in aggregated
+        # rounds.
+        per_client: dict[int, tuple] = {}
         for client, seq, ids, lens, a, b in sends:
             try:
-                self._send_columnar(client, seq, ids, lens, allow[a:b])
+                frame = self._verdict_frame(seq, ids, lens, allow[a:b])
+            except Exception:  # noqa: BLE001
+                log.exception("verdict frame build failed")
+                continue
+            per_client.setdefault(id(client), (client, []))[1].append(frame)
+        for client, frames in per_client.values():
+            try:
+                client.send_frames(wire.MSG_VERDICT_BATCH, frames)
             except Exception:  # noqa: BLE001 — client may be gone
                 log.exception("verdict send failed")
 
@@ -1101,9 +1391,18 @@ class VerdictService:
                         )
                         self.vec_batches += 1
                         self.vec_entries += n
+                        per_client: dict[int, tuple] = {}
                         for client, seq, ids, lens, a, b in sends:
-                            self._send_columnar(
-                                client, seq, ids, lens, allow[a:b]
+                            per_client.setdefault(
+                                id(client), (client, [])
+                            )[1].append(
+                                self._verdict_frame(
+                                    seq, ids, lens, allow[a:b]
+                                )
+                            )
+                        for client, frames in per_client.values():
+                            client.send_frames(
+                                wire.MSG_VERDICT_BATCH, frames
                             )
                     elif r[0] == "ready":
                         _, client, seq, entries = r
@@ -1113,33 +1412,43 @@ class VerdictService:
 
     _ERR_ROW = np.frombuffer(b"ERROR\r\n", np.uint8)
 
-    def _send_columnar(self, client, seq, conn_ids, lengths, allow) -> None:
+    def _verdict_body(self, conn_ids, lengths, allow) -> bytes:
         """Columnar op assembly: every entry is (PASS|DROP frame, MORE 1)
         — identical to the streaming oracle's op sequence for one
         complete frame (reference: r2d2parser.go:158-213)."""
         n = len(conn_ids)
-        denied = ~allow
-        ops = np.zeros(2 * n, wire.FILTER_OP)
+        tpl = self._frame_tpl.get(n)
+        if tpl is None:
+            ops0 = np.zeros(2 * n, wire.FILTER_OP)
+            ops0["op"][1::2] = int(MORE)
+            ops0["n_bytes"][1::2] = 1
+            tpl = (ops0, np.zeros(n, np.uint32), np.full(n, 2, np.uint32))
+            if len(self._frame_tpl) < 4096:
+                self._frame_tpl[n] = tpl
+        ops0, zeros_u32, twos_u32 = tpl
+        ops = ops0.copy()
         ops["op"][0::2] = np.where(allow, int(PASS), int(DROP))
         ops["n_bytes"][0::2] = lengths
-        ops["op"][1::2] = int(MORE)
-        ops["n_bytes"][1::2] = 1
-        nd = int(denied.sum())
-        inj_blob = (
-            np.broadcast_to(self._ERR_ROW, (nd, 7)).tobytes() if nd else b""
+        nd = n - int(allow.sum())
+        if nd:
+            inj_blob = np.broadcast_to(self._ERR_ROW, (nd, 7)).tobytes()
+            inj_reply = np.where(allow, 0, 7).astype(np.uint32)
+        else:
+            inj_blob = b""
+            inj_reply = zeros_u32
+        return wire.pack_verdict_body(
+            conn_ids, zeros_u32, twos_u32, zeros_u32, inj_reply, ops, inj_blob
         )
+
+    def _verdict_frame(self, seq, conn_ids, lengths, allow) -> bytes:
+        return struct.pack("<QI", seq, len(conn_ids)) + self._verdict_body(
+            conn_ids, lengths, allow
+        )
+
+    def _send_columnar(self, client, seq, conn_ids, lengths, allow) -> None:
         client.send(
             wire.MSG_VERDICT_BATCH,
-            wire.pack_verdict_batch(
-                seq,
-                conn_ids,
-                np.zeros(n, np.uint32),
-                np.full(n, 2, np.uint32),
-                np.zeros(n, np.uint32),
-                np.where(denied, 7, 0).astype(np.uint32),
-                ops,
-                inj_blob,
-            ),
+            self._verdict_frame(seq, conn_ids, lengths, allow),
         )
 
     def _process_entrywise(self, items: list) -> None:
@@ -1233,7 +1542,7 @@ class VerdictService:
         for engine, recs in groups.values():
             n = len(recs)
             width = self.config.batch_width
-            f_pad = self.MIN_BUCKET  # bucketed shapes, no jit churn
+            f_pad = self._min_bucket  # bucketed shapes, no jit churn
             while f_pad < n:
                 f_pad *= 2
             data = np.zeros((f_pad, width), np.uint8)
@@ -1411,6 +1720,18 @@ class _ClientHandler:
             except OSError:
                 pass
 
+    def send_frames(self, msg_type: int, payloads: list[bytes]) -> None:
+        """One sendall for a round's worth of frames to this client."""
+        buf = b"".join(
+            wire.HEADER.pack(wire.MAGIC, msg_type, len(p)) + p
+            for p in payloads
+        )
+        with self._wlock:
+            try:
+                self.sock.sendall(buf)
+            except OSError:
+                pass
+
     def send_verdicts(self, seq: int, entries: list) -> None:
         """entries: (conn_id, result, ops, inject_orig, inject_reply) —
         op lists longer than the ABI capacity split into continuation
@@ -1450,19 +1771,30 @@ class _ClientHandler:
             ),
         )
 
+    @staticmethod
+    def _parse_data(msg_type: int, payload: bytes):
+        if msg_type == wire.MSG_DATA_BATCH:
+            return ("data", wire.unpack_data_batch(payload))
+        return ("mat", wire.unpack_data_matrix(payload))
+
     def read_loop(self) -> None:
+        reader = wire.BufferedReader(self.sock)
+        svc = self.service
         try:
             while True:
-                msg_type, payload = wire.recv_msg(self.sock)
-                if msg_type == wire.MSG_DATA_BATCH:
-                    self.service.submit_data(
-                        self, wire.unpack_data_batch(payload)
-                    )
-                elif msg_type == wire.MSG_DATA_MATRIX:
-                    mb = wire.unpack_data_matrix(payload)
-                    self.service.dispatcher.submit(
-                        ("mat", self, mb), weight=mb.count
-                    )
+                msg_type, payload = reader.recv_msg()
+                if msg_type in (wire.MSG_DATA_BATCH, wire.MSG_DATA_MATRIX):
+                    kind, batch = self._parse_data(msg_type, payload)
+                    # Backlog probe: bytes already buffered behind this
+                    # frame mean the reader is behind — route to the
+                    # dispatcher so the worker aggregates the backlog
+                    # into one device round.  An idle stream cuts
+                    # through (processed right here, no handoff).
+                    backlogged = reader.pending
+                    if kind == "data":
+                        svc.submit_data(self, batch, backlogged=backlogged)
+                    else:
+                        svc.submit_matrix(self, batch, backlogged=backlogged)
                 elif msg_type == wire.MSG_CLOSE:
                     self.service.submit_close(wire.unpack_close(payload))
                 elif msg_type == wire.MSG_NEW_CONNECTION:
