@@ -130,6 +130,14 @@ class EndpointOwner(Protocol):
 
     def get_proxy_manager(self): ...
 
+    def update_network_policy(self, ep: "Endpoint") -> bool:
+        """Push the endpoint's resolved policy to the proxy layer and
+        block until it is acknowledged; False fails the regeneration
+        (reference: pkg/endpoint/policy.go:402 updateNetworkPolicy →
+        envoy server push, ACK-gated via completion.WaitGroup at
+        pkg/endpoint/bpf.go:555)."""
+        ...
+
 
 class Endpoint:
     """reference: pkg/endpoint/endpoint.go Endpoint."""
@@ -164,6 +172,7 @@ class Endpoint:
         self.force_policy_compute = False
         self.ingress_policy_enabled = False
         self.egress_policy_enabled = False
+        self._stale_redirects: list[str] = []
         self._prev_identity_cache: Optional[dict[int, object]] = None
 
         # Per-endpoint option overlay (reference: pkg/option/endpoint.go).
@@ -385,11 +394,23 @@ class Endpoint:
                     self.desired_map_state[key] = PolicyMapStateEntry(
                         proxy_port=redirect.proxy_port
                     )
-        # Remove stale redirects (reference: removeOldRedirects).
-        for pid in list(self.realized_redirects):
-            if pid not in active:
+        # Stale-redirect removal is DEFERRED to after the proxy-ACK
+        # gate (reference: removeOldRedirects runs in the finalize
+        # stage, bpf.go:446): tearing a redirect down before the ACK
+        # would leave a reverted map pointing at a dead proxy port.
+        self._stale_redirects = [
+            pid for pid in self.realized_redirects if pid not in active
+        ]
+
+    def _remove_old_redirects(self, owner: EndpointOwner) -> None:
+        """Finalize stage: drop redirects the new (ACKed) policy no
+        longer references (reference: bpf.go removeOldRedirects)."""
+        proxy = owner.get_proxy_manager()
+        for pid in getattr(self, "_stale_redirects", ()):  # set by add
+            if proxy is not None:
                 proxy.remove_redirect(pid)
-                del self.realized_redirects[pid]
+            self.realized_redirects.pop(pid, None)
+        self._stale_redirects = []
 
     def sync_policy_map(self) -> tuple[int, int]:
         """Diff desired vs realized into the policy map; returns
@@ -440,6 +461,11 @@ class Endpoint:
         self.stats = SpanStats()
         stats = self.stats
         ok = False
+        # Revert checkpoint (reference: pkg/revert stack built through
+        # regenerateBPF, bpf.go:561-584): enough state to roll the
+        # datapath back if the proxy layer never ACKs the new policy.
+        prev_desired = dict(self.desired_map_state)
+        prev_revision = self.policy_revision
         try:
             stats.span("policy").start()
             self.regenerate_policy(owner)
@@ -453,6 +479,37 @@ class Endpoint:
             stats.span("mapSync").start()
             self.sync_policy_map()
             stats.span("mapSync").end()
+
+            # Proxy ACK gate: regeneration blocks until the verdict
+            # service acknowledges the pushed policy; no ACK -> the
+            # endpoint must NOT report ready with a datapath enforcing a
+            # policy the L7 layer never received (reference:
+            # pkg/endpoint/bpf.go:555 completion wait on the xDS ACK,
+            # pkg/envoy/xds/ack.go:138).
+            stats.span("proxyAck").start()
+            acked = owner.update_network_policy(self)
+            stats.span("proxyAck").end()
+            if not acked:
+                # Revert the map to its pre-regeneration state
+                # (reference: revert stack unwind, bpf.go:561-584).
+                # Old redirects were NOT torn down yet (deferred to the
+                # finalize stage below), so the restored entries still
+                # point at live proxy ports.
+                self.desired_map_state = prev_desired
+                self.sync_policy_map()
+                if not option.config.dry_mode:
+                    self.device_policy_map = self.policy_map.to_device()
+                self.policy_revision = prev_revision
+                # The retry must recompute policy from scratch — the
+                # skip check in regenerate_policy would otherwise see
+                # next_policy_revision already current and promote the
+                # reverted OLD map as the NEW revision.
+                self.force_policy_compute = True
+                return False
+
+            # Finalize: now that the proxy ACKed, tear down redirects
+            # the new policy no longer references.
+            self._remove_old_redirects(owner)
 
             # "Compile": pack the policy map into device arrays (the BPF
             # compile+attach analog, skipped in DryMode like the

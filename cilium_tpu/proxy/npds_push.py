@@ -84,10 +84,13 @@ class NpdsPusher:
     """Keeps a verdict service's policy map in sync with the daemon's
     endpoint policies (reference: XDSServer.UpdateNetworkPolicy)."""
 
-    def __init__(self, socket_path: str):
+    def __init__(self, socket_path: str, ack_timeout: float = 5.0):
         from ..sidecar.client import SidecarClient
 
-        self.client = SidecarClient(socket_path)
+        # The client timeout IS the ACK deadline: policy_update blocks
+        # until the service replies MSG_ACK or the deadline passes
+        # (reference: completion deadline, pkg/endpoint/bpf.go:555).
+        self.client = SidecarClient(socket_path, timeout=ack_timeout)
         self.module = self.client.open_module([])
         if self.module == 0:
             raise ConnectionError(f"verdict service at {socket_path}")

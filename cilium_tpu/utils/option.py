@@ -128,6 +128,10 @@ class DaemonConfig:
     # Proxy
     proxy_port_min: int = defaults.PROXY_PORT_MIN
     proxy_port_max: int = defaults.PROXY_PORT_MAX
+    # How long a regeneration blocks waiting for the verdict service to
+    # ACK an NPDS policy push before failing and reverting (reference:
+    # the completion.WaitGroup context deadline at pkg/endpoint/bpf.go:555).
+    proxy_ack_timeout_s: float = 5.0
 
     # Device batching (TPU runtime)
     batch_flows: int = defaults.BATCH_FLOWS

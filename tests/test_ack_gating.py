@@ -1,0 +1,138 @@
+"""ACK-gated endpoint regeneration.
+
+The datapath must never enforce a policy the verdict service has not
+acknowledged: a regeneration whose NPDS push fails (dead service, NACK,
+timeout) reverts the policy map to its pre-regeneration state and
+leaves the endpoint not-ready; once the service returns, the endpoint
+recovers (reference: pkg/endpoint/bpf.go:555 completion wait +
+pkg/envoy/xds/ack.go:138 ACK tracking + pkg/revert unwind).
+"""
+
+import json
+import time
+
+import pytest
+
+from cilium_tpu.daemon.daemon import Daemon
+from cilium_tpu.endpoint import EndpointState
+from cilium_tpu.policy import rules_from_json
+from cilium_tpu.proxylib import instance as inst
+from cilium_tpu.sidecar.service import VerdictService
+from cilium_tpu.utils.option import DaemonConfig
+
+
+def wait_for(pred, timeout=8.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def http_rule(path="/public/.*"):
+    return {
+        "endpointSelector": {"matchLabels": {"app": "server"}},
+        "labels": ["k8s:policy=ack-test"],
+        "ingress": [
+            {
+                "fromEndpoints": [{"matchLabels": {"app": "client"}}],
+                "toPorts": [
+                    {
+                        "ports": [{"port": "80", "protocol": "TCP"}],
+                        "rules": {
+                            "http": [{"method": "GET", "path": path}]
+                        },
+                    }
+                ],
+            }
+        ],
+    }
+
+
+@pytest.fixture
+def world(tmp_path):
+    inst.reset_module_registry()
+    svc = VerdictService(
+        str(tmp_path / "vs.sock"), DaemonConfig(batch_timeout_ms=2.0)
+    ).start()
+    d = Daemon(
+        DaemonConfig(
+            state_dir=str(tmp_path / "state"), dry_mode=True,
+            enable_health=False, proxy_ack_timeout_s=1.0,
+        )
+    )
+    yield d, svc, str(tmp_path / "vs.sock")
+    d.close()
+    svc.stop()
+    inst.reset_module_registry()
+
+
+def _build_world(d, svc):
+    d.policy_add(rules_from_json(json.dumps([http_rule()])))
+    d.endpoint_create(21, ipv4="10.8.0.21", labels=["k8s:app=client"])
+    server_ep = d.endpoint_create(22, ipv4="10.8.0.22",
+                                  labels=["k8s:app=server"])
+    assert wait_for(lambda: server_ep.state == EndpointState.READY)
+    d.attach_verdict_service(svc.socket_path)
+    return server_ep
+
+
+def test_dead_service_fails_regeneration_and_reverts(world):
+    d, svc, sock = world
+    server_ep = _build_world(d, svc)
+    assert wait_for(lambda: server_ep.state == EndpointState.READY)
+    pre_map = dict(server_ep.realized_map_state)
+    pre_rev = server_ep.policy_revision
+    assert pre_map, "expected a realized policy map before the kill"
+
+    # Kill the verdict service, then change policy -> regeneration must
+    # fail at the ACK gate, revert the map, and NOT reach ready.
+    svc.stop()
+    d.policy_add(rules_from_json(json.dumps([http_rule("/other/.*")])))
+    assert wait_for(
+        lambda: server_ep.state == EndpointState.NOT_READY, timeout=10.0
+    ), f"state={server_ep.state}"
+    # Revert: the datapath still enforces the ACKed (old) policy.
+    assert dict(server_ep.realized_map_state) == pre_map
+    assert server_ep.policy_revision == pre_rev
+
+    # Service returns: reattach recovers the endpoint and delivers the
+    # new policy, revision advances past the reverted one.
+    svc2 = VerdictService(sock, DaemonConfig(batch_timeout_ms=2.0)).start()
+    try:
+        d.attach_verdict_service(sock)
+        assert wait_for(
+            lambda: server_ep.state == EndpointState.READY, timeout=10.0
+        ), f"state={server_ep.state}"
+        assert server_ep.policy_revision > pre_rev
+        # The recovery regeneration must have RECOMPUTED policy (not
+        # promoted the reverted old map as the new revision): the NEW
+        # rule's path is what the service now holds.
+        pol = d.npds_pusher._policies["10.8.0.22"]
+        paths = [
+            h["path"]
+            for pp in pol.ingress_per_port_policies
+            for r in pp.rules
+            for h in (r.http_rules or [])
+        ]
+        # Both rules coexist in the repo (policy_add appends); the NEW
+        # rule's path arriving proves the recovery recomputed policy
+        # rather than promoting the reverted old map.
+        assert "/other/.*" in paths, paths
+        st = d.npds_pusher.client.status()
+        assert d.npds_pusher.nacks == 0
+        assert st["connections"] >= 0  # service is live and answering
+    finally:
+        svc2.stop()
+
+
+def test_ready_implies_acked(world):
+    """While the service is healthy, every ready endpoint's policy has
+    been pushed AND acknowledged (pushes>0, nacks==0)."""
+    d, svc, _ = world
+    server_ep = _build_world(d, svc)
+    d.policy_add(rules_from_json(json.dumps([http_rule("/v2/.*")])))
+    assert wait_for(lambda: server_ep.state == EndpointState.READY)
+    assert wait_for(lambda: d.npds_pusher.pushes >= 2)
+    assert d.npds_pusher.nacks == 0

@@ -57,6 +57,9 @@ class FakeOwner:
     def get_proxy_manager(self):
         return self.proxy
 
+    def update_network_policy(self, ep):
+        return True  # no proxy layer attached — vacuous ACK
+
 
 @pytest.fixture(autouse=True)
 def _default_enforcement():
