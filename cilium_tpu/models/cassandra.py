@@ -29,10 +29,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.bytescan import spans_equal_prefix
-from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
+from ..ops.rxsearch import (
+    DeviceDfa,
+    DeviceNfa,
+    automaton_search_spans,
+    compile_automaton,
+)
 from ..proxylib.parsers.cassandra import CassandraRule
 from ..proxylib.policy import CompiledPortRules, PolicyInstance
-from ..regex import compile_patterns
 from .base import ConstVerdict, VerdictModel, pack_remote_sets, remote_ok
 
 MAX_ACTION = 32  # longest action is "create-materialized-view" (24)
@@ -42,7 +46,7 @@ MAX_TABLE = 96
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class CassandraBatchModel(VerdictModel):
-    nfa: DeviceNfa  # query_table regex rows
+    nfa: "DeviceDfa | DeviceNfa"  # query_table regex rows
     action_needle: jax.Array  # [R, MAX_ACTION] uint8
     action_len: jax.Array  # [R] int32
     action_any: jax.Array  # [R] bool
@@ -114,9 +118,8 @@ def build_cassandra_model(
         action_any[i] = len(b) == 0
         table_none[i] = table == ""
 
-    tables = compile_patterns([r[2] for r in rows])
     return CassandraBatchModel(
-        nfa=device_nfa(tables),
+        nfa=compile_automaton([r[2] for r in rows]),
         action_needle=jnp.asarray(action_needle),
         action_len=jnp.asarray(action_len),
         action_any=jnp.asarray(action_any),
@@ -171,7 +174,7 @@ def cassandra_verdicts(
         | model.action_any[None, :]
     )  # [F, R]
     table_start = jnp.full_like(table_len, MAX_ACTION)
-    table_hit = nfa_search_spans(
+    table_hit = automaton_search_spans(
         model.nfa, data, table_start, table_start + table_len
     )  # [F, R]
     table_ok = (

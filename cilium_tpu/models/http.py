@@ -49,10 +49,6 @@ from .base import ConstVerdict, pack_remote_sets, remote_ok
 
 _RE_META = set("\\^$.[]|()*+?{}")
 
-# Above this REGEX-TIER pattern count "auto" compiles per-pattern DFAs
-# instead of the dense union NFA (whose delta grows O(S²·C)).
-_DFA_RULE_THRESHOLD = 16
-
 LIT_W = 64  # max literal needle bytes; longer literals fall to regex
 
 
@@ -200,11 +196,19 @@ def analyze_rules(
             method_any, path_any, head_patterns, head_rule, head_count)
 
 
-def lit_arrays(rows: list, n_pad: int | None = None):
+def lit_arrays(rows: list, n_pad: int | None = None,
+               width: int | None = None):
     """Pack (needle, prefix, rule) literal rows into device-ready numpy
-    arrays, padded to ``n_pad`` rows (dead rows have live=False)."""
+    arrays, padded to ``n_pad`` rows (dead rows have live=False).  The
+    needle width is trimmed to the longest actual needle (rounded up to
+    8, min 8) — the span-compare window build scales with it; pass
+    ``width`` to unify shapes across shards."""
     n = max(len(rows), 1) if n_pad is None else n_pad
-    needle = np.zeros((n, LIT_W), np.uint8)
+    if width is None:
+        max_len = max((len(lit) for lit, _, _ in rows), default=0)
+        width = min(LIT_W, max(8, (max_len + 7) // 8 * 8))
+    w = width
+    needle = np.zeros((n, w), np.uint8)
     nlen = np.zeros((n,), np.int32)
     prefix = np.zeros((n,), bool)
     rule = np.zeros((n,), np.int32)
@@ -245,6 +249,11 @@ class HttpBatchModel:
     remote_ids: jax.Array  # [R, MAX_REMOTES] int32
     any_remote: jax.Array  # [R] bool
     n_rules: int = 0
+    # Static slot usage (trace-time): which spans the regex tier must
+    # actually search — an all-path pattern set skips the method-span
+    # automaton pass entirely (half the regex-tier cost).
+    has_method_rx: bool = False
+    has_path_rx: bool = False
 
     def tree_flatten(self):
         return (
@@ -254,12 +263,15 @@ class HttpBatchModel:
              self.line_nfa, self.line_rule, self.line_slot,
              self.head_nfa, self.head_rule, self.head_count,
              self.remote_ids, self.any_remote),
-            (self.n_rules,),
+            (self.n_rules, self.has_method_rx, self.has_path_rx),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves, n_rules=aux[0])
+        return cls(
+            *leaves, n_rules=aux[0],
+            has_method_rx=aux[1], has_path_rx=aux[2],
+        )
 
     def __call__(self, data, lengths, remotes):
         return http_verdicts(self, data, lengths, remotes)
@@ -273,8 +285,8 @@ def build_http_model(
 
     Empty fields wildcard (reference: http.go — omitted fields allow all).
     ``backend`` governs the REGEX tier only: "nfa" (dense matmul),
-    "dfa" (per-pattern gatherless blocks), "auto" (DFA above
-    _DFA_RULE_THRESHOLD patterns, NFA fallback on blowup), or
+    "dfa" (per-pattern gatherless blocks), "auto" (DFA-first at any
+    size, NFA fallback on determinization blowup), or
     "regex-only" (disable the literal tier — every pattern through the
     automaton; used by parity tests)."""
     if not rules_with_remotes:
@@ -318,24 +330,20 @@ def build_http_model(
         remote_ids=jnp.asarray(packed_ids),
         any_remote=jnp.asarray(any_remote),
         n_rules=r,
+        has_method_rx=any(s == 0 for s in line_slot),
+        has_path_rx=any(s == 1 for s in line_slot),
     )
 
 
 def _compile_line_tables(patterns: list[str], backend: str):
     """Compile regex-tier patterns with the requested backend; None when
-    the tier is empty."""
-    if not patterns:
-        return None
-    use_dfa = backend == "dfa" or (
-        backend == "auto" and len(patterns) > _DFA_RULE_THRESHOLD
-    )
-    if use_dfa:
-        try:
-            return device_dfa(compile_pattern_dfas(patterns))
-        except DfaBlowupError:
-            if backend == "dfa":
-                raise
-    return device_nfa(compile_patterns(patterns))
+    the tier is empty.  DFA-first at every size since the integer-id
+    step rewrite (ops/dfa.py) made the DFA ~12× the dense NFA."""
+    from ..ops.rxsearch import compile_automaton
+
+    if backend == "nfa":
+        return device_nfa(compile_patterns(patterns)) if patterns else None
+    return compile_automaton(patterns, backend)
 
 
 def _first_occurrence_after(data, start, end, byte):
@@ -424,15 +432,17 @@ def http_verdicts(
             if isinstance(model.line_nfa, DeviceDfa)
             else nfa_search_spans
         )
-        rx_m = search(model.line_nfa, data, m_start, m_end)  # [F, PL]
-        rx_p = search(model.line_nfa, data, p_start, p_end)
         is_m = model.line_slot == 0
-        method_ok = method_ok | _scatter_or(
-            rx_m & is_m[None, :], model.line_rule, r
-        )
-        path_ok = path_ok | _scatter_or(
-            rx_p & ~is_m[None, :], model.line_rule, r
-        )
+        if model.has_method_rx:
+            rx_m = search(model.line_nfa, data, m_start, m_end)  # [F, PL]
+            method_ok = method_ok | _scatter_or(
+                rx_m & is_m[None, :], model.line_rule, r
+            )
+        if model.has_path_rx:
+            rx_p = search(model.line_nfa, data, p_start, p_end)
+            path_ok = path_ok | _scatter_or(
+                rx_p & ~is_m[None, :], model.line_rule, r
+            )
 
     # Host/header patterns searched over the head region starting at the
     # request line's CRLF (so every header line is CRLF-framed).

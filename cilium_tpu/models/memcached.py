@@ -28,10 +28,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.bytescan import spans_equal_prefix, spans_start_with
-from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
+from ..ops.rxsearch import (
+    DeviceDfa,
+    DeviceNfa,
+    automaton_search_spans,
+    compile_automaton,
+)
 from ..proxylib.parsers.memcached import MEMCACHE_OPCODE_MAP, MemcacheRule
 from ..proxylib.policy import CompiledPortRules, PolicyInstance
-from ..regex import compile_patterns
 from .base import ConstVerdict, VerdictModel, pack_remote_sets, remote_ok
 
 MAX_KEY = 96
@@ -52,7 +56,7 @@ KEY_MODE_REGEX = 3
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class MemcacheBatchModel(VerdictModel):
-    nfa: DeviceNfa  # keyRegex rows ('' for non-regex rules)
+    nfa: "DeviceDfa | DeviceNfa"  # keyRegex rows ('' for non-regex rules)
     op_tab: jax.Array  # [R, 256] bool — allowed binary opcodes
     cmd_tab: jax.Array  # [R, NCMDS] bool — allowed text commands
     empty_rule: jax.Array  # [R] bool — matches anything
@@ -149,9 +153,8 @@ def build_memcache_model(
         key_needle_len[i] = len(needle)
         patterns.append(m.key_regex if key_mode[i] == KEY_MODE_REGEX else "")
 
-    tables = compile_patterns(patterns)
     return MemcacheBatchModel(
-        nfa=device_nfa(tables),
+        nfa=compile_automaton(patterns),
         op_tab=jnp.asarray(op_tab),
         cmd_tab=jnp.asarray(cmd_tab),
         empty_rule=jnp.asarray(empty_rule),
@@ -225,7 +228,7 @@ def memcache_verdicts(
     prefix = spans_start_with(
         key_data, zeros, key_len, model.key_needle, model.key_needle_len
     )
-    regex = nfa_search_spans(model.nfa, key_data, zeros, key_len)
+    regex = automaton_search_spans(model.nfa, key_data, zeros, key_len)
     mode = model.key_mode[None, :]
     key_ok = jnp.where(
         mode == KEY_MODE_EXACT,

@@ -24,10 +24,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.bytescan import count_byte, first_occurrence, first_subsequence2, spans_equal_prefix
-from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
+from ..ops.rxsearch import (
+    DeviceDfa,
+    DeviceNfa,
+    automaton_search_spans,
+    compile_automaton,
+)
 from ..proxylib.parsers.r2d2 import R2d2Rule
 from ..proxylib.policy import CompiledPortRules, PolicyInstance
-from ..regex import compile_patterns
 from .base import ConstVerdict, VerdictModel, pack_remote_sets, remote_ok
 
 MAX_CMD = 8  # longest r2d2 command is "RESET" (5)
@@ -36,7 +40,7 @@ MAX_CMD = 8  # longest r2d2 command is "RESET" (5)
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class R2d2BatchModel(VerdictModel):
-    nfa: DeviceNfa  # file-regex NFA, one pattern per row
+    nfa: "DeviceDfa | DeviceNfa"  # file-regex automaton, one pattern per row
     cmd_needle: jax.Array  # [R, MAX_CMD] uint8
     cmd_len: jax.Array  # [R] int32
     cmd_any: jax.Array  # [R] bool
@@ -127,9 +131,8 @@ def build_r2d2_model_from_rows(
         cmd_len[i] = len(b)
         cmd_any[i] = len(b) == 0
 
-    tables = compile_patterns([r[2] for r in rows])
     return R2d2BatchModel(
-        nfa=device_nfa(tables),
+        nfa=compile_automaton([r[2] for r in rows]),
         cmd_needle=jnp.asarray(cmd_needle),
         cmd_len=jnp.asarray(cmd_len),
         cmd_any=jnp.asarray(cmd_any),
@@ -167,7 +170,7 @@ def r2d2_verdicts(
         )
         | model.cmd_any[None, :]
     )  # [F, R]
-    file_ok = nfa_search_spans(model.nfa, data, file_start, file_end)  # [F, R]
+    file_ok = automaton_search_spans(model.nfa, data, file_start, file_end)  # [F, R]
     rem_ok = remote_ok(remotes, model.remote_ids, model.any_remote)  # [F, R]
 
     allow = jnp.any(cmd_ok & file_ok & rem_ok, axis=1)

@@ -49,6 +49,38 @@ def count_byte(data: jax.Array, lengths: jax.Array, byte: int) -> jax.Array:
     return jnp.sum(((data == jnp.uint8(byte)) & valid).astype(jnp.int32), axis=1)
 
 
+def window_at(data: jax.Array, start: jax.Array, n: int) -> jax.Array:
+    """Per-flow window ``data[f, start[f]:start[f]+n]`` (zeros past the
+    row end).
+
+    Two formulations, selected by the tracing backend:
+    - TPU: a barrel shifter — log2(L) conditional whole-row shifts by
+      powers of two, selected by the bits of ``start``.  O(L·logL)
+      bytes of pure VPU traffic per flow; TPU gathers serialize (a
+      take_along_axis here measured ~0.4s per 500k-flow replay pass,
+      3× the whole remaining pipeline).
+    - CPU (tests, cpu-pinned verdict engines): plain take_along_axis —
+      CPU gathers are fast and the shift chain is slower there.
+    """
+    f, l = data.shape
+    if jax.default_backend() == "cpu":
+        idx = start[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
+        idx = jnp.minimum(idx, l - 1)
+        return jnp.take_along_axis(data, idx.astype(jnp.int32), axis=1)
+    out = jnp.concatenate([data, jnp.zeros((f, n), data.dtype)], axis=1)
+    width = out.shape[1]
+    start = jnp.asarray(start, jnp.int32)
+    k = 1
+    while k < l:
+        shifted = jnp.concatenate(
+            [out[:, k:], jnp.zeros((f, min(k, width)), data.dtype)], axis=1
+        )
+        take = (start & k) != 0  # this bit of the shift amount
+        out = jnp.where(take[:, None], shifted, out)
+        k <<= 1
+    return out[:, :n]
+
+
 def _spans_compare(
     data: jax.Array,
     start: jax.Array,
@@ -69,9 +101,7 @@ def _spans_compare(
         len_ok = span_len[:, None] >= needle_len[None, :]  # [F, R]
     else:
         len_ok = span_len[:, None] == needle_len[None, :]  # [F, R]
-    idx = start[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]  # [F, N]
-    idx = jnp.minimum(idx, l - 1)
-    window = jnp.take_along_axis(data, idx.astype(jnp.int32), axis=1)  # [F, N]
+    window = window_at(data, start, n)  # [F, N]
     eq = window[:, None, :] == needle[None, :, :]  # [F, R, N]
     bytes_needed = (
         jnp.arange(n, dtype=jnp.int32)[None, None, :] < needle_len[None, :, None]

@@ -1,0 +1,62 @@
+"""Automaton compile + search dispatch shared by the protocol models.
+
+Every protocol model (r2d2/http/cassandra/memcached) needs "compile
+these regex patterns to a device automaton, then search spans with it".
+Two device automata exist:
+
+- ``DeviceDfa`` (ops/dfa.py): per-pattern determinized blocks advanced
+  with an integer-id row-select — O(S·C) MACs per (flow, pattern, byte).
+  The default: ~12× the dense NFA's throughput (r2d2 measured 1.9M/s →
+  23M/s verdicts on the same rule set).
+- ``DeviceNfa`` (ops/nfa.py): the dense union-NFA matmul — O(S²·C) per
+  byte, but immune to determinization blowup.  The fallback when a
+  pattern's DFA explodes (``DfaBlowupError``).
+
+(reference: the per-rule compiled std::regex walk this replaces,
+envoy/cilium_network_policy.h:50-76.)
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..regex import compile_patterns
+from ..regex.dfa import DfaBlowupError, compile_pattern_dfas
+from .dfa import DeviceDfa, device_dfa, dfa_search_batch, dfa_search_spans
+from .nfa import DeviceNfa, device_nfa, nfa_search_batch, nfa_search_spans
+
+__all__ = [
+    "compile_automaton",
+    "automaton_search_spans",
+    "automaton_search_batch",
+    "DeviceDfa",
+    "DeviceNfa",
+]
+
+
+def compile_automaton(
+    patterns: list[str], backend: str = "auto"
+) -> DeviceDfa | DeviceNfa | None:
+    """Compile patterns to the requested device automaton; None when
+    the list is empty.  ``auto`` = DFA with NFA fallback on blowup."""
+    if not patterns:
+        return None
+    if backend in ("auto", "dfa"):
+        try:
+            return device_dfa(compile_pattern_dfas(patterns))
+        except DfaBlowupError:
+            if backend == "dfa":
+                raise
+    return device_nfa(compile_patterns(patterns))
+
+
+def automaton_search_spans(tab, data, span_start, span_end) -> jax.Array:
+    """[F, R] bool: pattern r matches data[f, span_start:span_end]."""
+    fn = dfa_search_spans if isinstance(tab, DeviceDfa) else nfa_search_spans
+    return fn(tab, data, span_start, span_end)
+
+
+def automaton_search_batch(tab, data, lengths) -> jax.Array:
+    """[F, R] bool: pattern r matches data[f, :lengths[f]]."""
+    fn = dfa_search_batch if isinstance(tab, DeviceDfa) else nfa_search_batch
+    return fn(tab, data, lengths)

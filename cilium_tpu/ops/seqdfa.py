@@ -81,8 +81,14 @@ def _fold_chunk(dfa: DeviceDfa, data, t0, span_start, span_end,
             p0 = jax.lax.pcast(p0, (vary_axis,), to="varying")
         elif hasattr(jax.lax, "pvary"):  # older jax
             p0 = jax.lax.pvary(p0, (vary_axis,))
-    # delta as [R, C, S, S]: for class c, D[r, c, s, t] = 1 iff δ(s,c)=t.
-    delta_sc = dfa.delta_1h.reshape(r, s, c, s).transpose(0, 2, 1, 3)
+    # delta as [R, C, S, S]: for class c, D[r, c, s, t] = 1 iff δ(s,c)=t,
+    # derived from the integer-id table (padded states map to 0 but are
+    # never selected: composition starts from the identity and final
+    # application selects real start states only).
+    delta_sc = (
+        dfa.delta_id.transpose(0, 2, 1)[:, :, :, None]
+        == jnp.arange(s, dtype=jnp.int32)[None, None, None, :]
+    ).astype(jnp.int8)
 
     def step(p, inputs):
         byte_col, t = inputs  # [F], scalar-per-flow position

@@ -198,6 +198,21 @@ def build_sharded_http_model(
 
     nm = max(max(len(a[0]) for a in analyzed), 1)
     npath = max(max(len(a[1]) for a in analyzed), 1)
+    # Needle widths unified across shards so stacked models share shapes.
+    lit_w = max(
+        (
+            len(lit)
+            for a in analyzed
+            for rows in (a[0], a[1])
+            for lit, _, _ in rows
+        ),
+        default=0,
+    )
+    lit_w = max(8, (lit_w + 7) // 8 * 8)
+    # Slot-usage flags are aux (static) — must agree across shards
+    # (a[4] is each shard's line_slot list).
+    has_m_rx = any(s == 0 for a in analyzed for s in a[4])
+    has_p_rx = any(s == 1 for a in analyzed for s in a[4])
     pl_max = max(t.n_patterns for t in line_ts)
     ls = max(t.n_states for t in line_ts)
     lc = max(t.n_classes for t in line_ts)
@@ -211,8 +226,8 @@ def build_sharded_http_model(
         (m_rows, p_rows, _line_pats, line_rule, line_slot, method_any,
          path_any, _head_pats, head_rule, head_count) = a
         n = len(shard)
-        mn, ml, mp, mr, mlive = lit_arrays(m_rows, nm)
-        pn, pl_, pp, pr, plive = lit_arrays(p_rows, npath)
+        mn, ml, mp, mr, mlive = lit_arrays(m_rows, nm, width=lit_w)
+        pn, pl_, pp, pr, plive = lit_arrays(p_rows, npath, width=lit_w)
         packed_ids = np.zeros((r_max, MAX_REMOTES), np.int32)
         any_remote = np.zeros((r_max,), bool)
         ma = np.zeros((r_max,), bool)
@@ -259,6 +274,8 @@ def build_sharded_http_model(
                 remote_ids=jnp.asarray(packed_ids),
                 any_remote=jnp.asarray(any_remote),
                 n_rules=r_max,
+                has_method_rx=has_m_rx,
+                has_path_rx=has_p_rx,
             )
         )
     return _stack_models(models)
