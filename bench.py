@@ -991,12 +991,15 @@ def _check_regressions(lines: list[str]) -> int:
     smaller_better = {"sidecar_added_latency_p99_ms_at_1M",
                       "sidecar_seam_added_p99_ms_colocated"}
     rc = 0
+    seen: set = set()
     for line in lines:
         try:
             d = json.loads(line)
         except ValueError:
             continue
         name, val = d.get("metric"), d.get("value")
+        if name:
+            seen.add(name)
         if name not in prev or not isinstance(val, (int, float)):
             continue
         old = prev[name]
@@ -1014,6 +1017,13 @@ def _check_regressions(lines: list[str]) -> int:
                       f"({drop:+.0%} vs {prev_file}); explain in "
                       f"BENCH_NOTES.md or fix", file=sys.stderr)
                 rc = 1
+    # A metric that VANISHED (config crashed, stopped emitting) is the
+    # worst regression of all — never let it pass silently.
+    for name in prev:
+        if name not in seen and name not in allowed:
+            print(f"bench --check: MISSING metric {name} (present in "
+                  f"{prev_file}, absent this run)", file=sys.stderr)
+            rc = 1
     return rc
 
 

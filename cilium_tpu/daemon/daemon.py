@@ -285,6 +285,21 @@ class Daemon:
             if not self.config.dry_mode:
                 ep.write_state(self._state_dir())
 
+    def _local_pair(self, ipv4: str, identity_id: int) -> IPIdentityPair:
+        """The kvstore pair for a local endpoint IP: carries this node's
+        underlay address so remote nodes learn where to encap
+        (reference: pkg/ipcache/kvstore.go hostIP marshalling;
+        consumed by the overlay path, bpf/lib/encap.h)."""
+        import ipaddress
+
+        tunnel = 0
+        if self.config.node_ipv4:
+            tunnel = int(ipaddress.IPv4Address(self.config.node_ipv4))
+        return IPIdentityPair(
+            ipv4, identity_id,
+            tunnel_endpoint=tunnel, host_ip=self.config.node_ipv4,
+        )
+
     def _retry_not_ready_endpoints(self) -> None:
         """Re-enqueue endpoints that failed their last regeneration
         (e.g. proxy-ACK timeout) so policy converges without waiting
@@ -365,9 +380,7 @@ class Daemon:
         EndpointCount.set(len(self.endpoint_manager))
         if ipv4:
             self.ipcache.upsert(ipv4, identity.id)
-            self.ipcache_sync.upsert_to_kvstore(
-                IPIdentityPair(ipv4, identity.id)
-            )
+            self.ipcache_sync.upsert_to_kvstore(self._local_pair(ipv4, identity.id))
         ep.set_state(EndpointState.WAITING_TO_REGENERATE, "identity ready")
         self.build_queue.enqueue(ep, key=ep.id)
         return ep
@@ -426,7 +439,7 @@ class Daemon:
         if ep.ipv4:
             self.ipcache.upsert(ep.ipv4, identity.id)
             self.ipcache_sync.upsert_to_kvstore(
-                IPIdentityPair(ep.ipv4, identity.id)
+                self._local_pair(ep.ipv4, identity.id)
             )
         ep.force_policy_compute = True
         ep.set_state(EndpointState.WAITING_TO_REGENERATE, "labels changed")

@@ -98,6 +98,11 @@ class LocalBackend(Backend):
             self._data[key] = value
             if lease:
                 self._leased.add(key)
+            else:
+                # A non-leased overwrite downgrades the key BEFORE the
+                # emit persists (etcd: the latest PUT's lease — or
+                # absence of one — wins).
+                self._leased.discard(key)
             self._emit(
                 KeyValueEvent(
                     EventType.MODIFY if existed else EventType.CREATE, key, value

@@ -50,6 +50,29 @@ _LEN = struct.Struct(">I")
 MAX_FRAME = 16 << 20
 
 
+class KvstoreCounters:
+    """Failure/event counters for the swallowed-error paths (reference:
+    kvstore errors surface through controller failure counts,
+    pkg/kvstore/events.go).  Surfaced through server/client status and
+    the daemon status section — a malformed frame or revoke failure
+    increments here instead of vanishing."""
+
+    def __init__(self) -> None:
+        self._mutex = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def inc(self, name: str) -> None:
+        with self._mutex:
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def snapshot(self) -> dict[str, int]:
+        with self._mutex:
+            return dict(self._counts)
+
+
+counters = KvstoreCounters()
+
+
 def _send_frame(sock: socket.socket, obj: dict) -> None:
     data = json.dumps(obj).encode()
     sock.sendall(_LEN.pack(len(data)) + data)
@@ -93,8 +116,10 @@ class _Session:
         with self.wlock:
             try:
                 _send_frame(self.sock, obj)
-            except OSError:
-                pass  # reader notices the dead socket and cleans up
+            except OSError as e:
+                # Reader notices the dead socket and cleans up.
+                counters.inc("server_send_failed")
+                log.debug("kvstore session %s send failed: %s", self.peer, e)
 
     def serve(self) -> None:
         try:
@@ -109,8 +134,14 @@ class _Session:
                     ).start()
                 else:
                     self._handle_safe(req)
-        except (ConnectionError, OSError, ValueError):
-            pass
+        except (ConnectionError, OSError) as e:
+            log.debug("kvstore session %s ended: %s", self.peer, e)
+        except ValueError as e:
+            # Malformed frame: a protocol bug, not a disconnect — count
+            # and log it loudly before dropping the session.
+            counters.inc("server_malformed_frame")
+            log.warning("kvstore session %s malformed frame: %s",
+                        self.peer, e)
         finally:
             self.cleanup()
 
@@ -134,7 +165,10 @@ class _Session:
         if op == "ping":
             return {}
         if op == "status":
-            return {"status": b.status()}
+            return {
+                "status": b.status(),
+                "counters": counters.snapshot(),
+            }
         if op == "get":
             v = b.get(key)
             return {"found": v is not None,
@@ -144,7 +178,10 @@ class _Session:
             return {"found": v is not None,
                     "value": v.hex() if v is not None else ""}
         if op == "set":
-            b.set(key, val, lease=False)
+            # lease-ness travels into the backend so a durable backend
+            # excludes the key from its snapshot ATOMICALLY with the
+            # write (persistence happens on the mutation's emit).
+            b.set(key, val, lease=lease)
             self._claim(key, lease)
             return {}
         if op == "delete":
@@ -162,12 +199,12 @@ class _Session:
             self.leased = {k for k in self.leased if not k.startswith(key)}
             return {}
         if op == "create_only":
-            ok = b.create_only(key, val, lease=False)
+            ok = b.create_only(key, val, lease=lease)
             if ok:
                 self._claim(key, lease)
             return {"created": ok}
         if op == "create_if_exists":
-            ok = b.create_if_exists(req["cond_key"], key, val, lease=False)
+            ok = b.create_if_exists(req["cond_key"], key, val, lease=lease)
             if ok:
                 self._claim(key, lease)
             return {"created": ok}
@@ -206,7 +243,9 @@ class _Session:
         """Record lease ownership: a later write by ANY session (leased
         or not) re-associates the key, so an older session's death no
         longer deletes it (etcd semantics: the latest PUT's lease —
-        or absence of one — wins)."""
+        or absence of one — wins).  Lease-ness is mirrored into the
+        backend's leased set so a durable backend excludes leased keys
+        from its snapshot (they die with their sessions)."""
         with self.server._mutex:
             if lease:
                 self.server._lease_owner[key] = self
@@ -245,8 +284,10 @@ class _Session:
         for lock in self.locks.values():
             try:
                 lock.unlock()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                counters.inc("server_unlock_failed")
+                log.warning("session %s lock release failed: %s",
+                            self.peer, e)
         self.locks.clear()
         for k in sorted(self.leased):
             # Only revoke keys THIS session still owns: a newer session
@@ -259,8 +300,9 @@ class _Session:
                 continue
             try:
                 self.server.backend.delete(k)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                counters.inc("server_lease_revoke_failed")
+                log.warning("lease revoke of %s failed: %s", k, e)
         self.leased.clear()
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
@@ -274,11 +316,26 @@ class _Session:
 
 
 class KvstoreServer:
-    """TCP front for a LocalBackend — the cluster's shared store."""
+    """TCP front for a LocalBackend — the cluster's shared store.
+
+    ``snapshot_path`` makes the store durable: every mutation persists
+    to disk (lease-owned keys excluded — they die with their sessions,
+    exactly like etcd leases) and a restarted server restores from the
+    snapshot, so identities and other non-leased cluster state survive
+    a store restart (reference: etcd's WAL/snapshot durability that
+    pkg/kvstore assumes)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 backend: Backend | None = None) -> None:
-        self.backend = backend or LocalBackend()
+                 backend: Backend | None = None,
+                 snapshot_path: str | None = None) -> None:
+        from .local import FileBackend
+
+        if backend is None:
+            backend = (
+                FileBackend(snapshot_path) if snapshot_path
+                else LocalBackend()
+            )
+        self.backend = backend
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -426,8 +483,12 @@ class NetBackend(Backend):
                     q = self._pending.pop(msg.get("id"), None)
                 if q is not None:
                     q.put(msg)
-        except (ConnectionError, OSError, ValueError):
-            pass
+        except (ConnectionError, OSError) as e:
+            counters.inc("client_conn_lost")
+            log.debug("kvstore client connection lost: %s", e)
+        except ValueError as e:
+            counters.inc("client_malformed_frame")
+            log.warning("kvstore client malformed frame: %s", e)
         finally:
             with self._mutex:
                 stale = self._generation != gen

@@ -39,11 +39,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.bytescan import first_occurrence, first_subsequence2, spans_equal_prefix, spans_start_with
-from ..ops.dfa import DeviceDfa, device_dfa, dfa_search_spans
+from ..ops.dfa import DeviceDfa, dfa_search_spans
 from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
 from ..policy.api import PortRuleHTTP
 from ..regex import compile_patterns
-from ..regex.dfa import DfaBlowupError, compile_pattern_dfas
 from ..regex.parse import DOT_BYTES, ParseError, parse
 from .base import ConstVerdict, pack_remote_sets, remote_ok
 

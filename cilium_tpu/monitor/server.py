@@ -1,8 +1,16 @@
-"""Monitor unix-socket pub/sub.
+"""Monitor unix-socket pub/sub, both listener protocol versions.
 
-reference: monitor/listener1_2.go — subscribers connect to the monitor
-socket and receive every event; slow subscribers drop events rather than
-stalling the stream.  Framing: 4-byte big-endian length + JSON event.
+reference: monitor/listener1_2.go + listener1_0.go — the node monitor
+serves BOTH protocol generations simultaneously on sibling sockets so
+old and new consumers coexist across upgrades:
+
+- **1.2** (``<path>``): 4-byte big-endian length + JSON event — the
+  payload framing (reference: listener1_2.go gob payload.Payload).
+- **1.0** (``<path>.1_0``): newline-delimited JSON, one event per line
+  — the legacy framing analog (reference: listener1_0.go raw encoding).
+
+Slow subscribers drop events rather than stalling the stream on either
+version.
 """
 
 from __future__ import annotations
@@ -22,8 +30,9 @@ log = get_logger("monitor-server")
 
 
 class _Subscriber:
-    def __init__(self, conn: socket.socket) -> None:
+    def __init__(self, conn: socket.socket, version: str = "1.2") -> None:
         self.conn = conn
+        self.version = version
         self.queue: "queue.Queue[MonitorEvent]" = queue.Queue(maxsize=4096)
         self.lost = 0
 
@@ -34,12 +43,16 @@ class MonitorServer:
     def __init__(self, monitor: Monitor, path: str) -> None:
         self.monitor = monitor
         self.path = path
-        if os.path.exists(path):
-            os.unlink(path)
+        self.path_1_0 = path + ".1_0"
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(path)
-        self._sock.listen(16)
+        self._socks: dict[str, socket.socket] = {}
+        for p, version in ((path, "1.2"), (self.path_1_0, "1.0")):
+            if os.path.exists(p):
+                os.unlink(p)
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.bind(p)
+            s.listen(16)
+            self._socks[version] = s
         self._subs: list[_Subscriber] = []
         self._mutex = threading.Lock()
         self._stop = threading.Event()
@@ -47,9 +60,11 @@ class MonitorServer:
         # per-subscriber bounded queues, so the per-listener queue layer
         # would just double-buffer and hide subscriber loss accounting.
         monitor.add_listener(self._fan_out, queued=False)
-        threading.Thread(
-            target=self._accept_loop, name="monitor-server", daemon=True
-        ).start()
+        for version, s in self._socks.items():
+            threading.Thread(
+                target=self._accept_loop, args=(s, version),
+                name=f"monitor-server-{version}", daemon=True,
+            ).start()
 
     def _fan_out(self, ev: MonitorEvent) -> None:
         with self._mutex:
@@ -60,16 +75,16 @@ class MonitorServer:
             except queue.Full:
                 s.lost += 1  # slow subscriber: drop, don't stall
 
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, sock: socket.socket, version: str) -> None:
         while not self._stop.is_set():
             try:
-                self._sock.settimeout(0.2)
-                conn, _ = self._sock.accept()
+                sock.settimeout(0.2)
+                conn, _ = sock.accept()
             except socket.timeout:
                 continue
             except OSError:
                 return
-            sub = _Subscriber(conn)
+            sub = _Subscriber(conn, version=version)
             with self._mutex:
                 self._subs.append(sub)
             threading.Thread(
@@ -84,7 +99,10 @@ class MonitorServer:
                 except queue.Empty:
                     continue
                 data = json.dumps(ev.to_dict()).encode()
-                sub.conn.sendall(struct.pack(">I", len(data)) + data)
+                if sub.version == "1.0":
+                    sub.conn.sendall(data + b"\n")
+                else:
+                    sub.conn.sendall(struct.pack(">I", len(data)) + data)
         except OSError:
             pass
         finally:
@@ -104,23 +122,42 @@ class MonitorServer:
 
     def close(self) -> None:
         self._stop.set()
-        try:
-            self._sock.close()
-        finally:
-            if os.path.exists(self.path):
-                os.unlink(self.path)
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        for p in (self.path, self.path_1_0):
+            if os.path.exists(p):
+                os.unlink(p)
 
 
 class MonitorClient:
-    """Subscriber side (the `monitor` CLI command's transport)."""
+    """Subscriber side (the `monitor` CLI command's transport).
 
-    def __init__(self, path: str) -> None:
+    ``version="1.0"`` dials the legacy line-framed socket (the path the
+    server exposes as ``<path>.1_0``); default is the 1.2 payload
+    framing."""
+
+    def __init__(self, path: str, version: str = "1.2") -> None:
+        self.version = version
+        if version == "1.0" and not path.endswith(".1_0"):
+            path = path + ".1_0"
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.connect(path)
+        self._linebuf = b""
 
     def next_event(self, timeout: float | None = None) -> Optional[MonitorEvent]:
         self._sock.settimeout(timeout)
         try:
+            if self.version == "1.0":
+                while b"\n" not in self._linebuf:
+                    chunk = self._sock.recv(65536)
+                    if not chunk:
+                        return None
+                    self._linebuf += chunk
+                line, self._linebuf = self._linebuf.split(b"\n", 1)
+                return MonitorEvent.from_dict(json.loads(line.decode()))
             hdr = self._recv_exact(4)
             if hdr is None:
                 return None

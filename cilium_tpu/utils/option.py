@@ -132,6 +132,11 @@ class DaemonConfig:
     # ACK an NPDS policy push before failing and reverting (reference:
     # the completion.WaitGroup context deadline at pkg/endpoint/bpf.go:555).
     proxy_ack_timeout_s: float = 5.0
+    # This node's underlay IPv4 (the VXLAN tunnel endpoint peers encap
+    # to).  Published as HostIP/TunnelEndpoint with every local
+    # endpoint's ipcache pair (reference: pkg/ipcache/kvstore.go
+    # marshals hostIP; bpf/lib/encap.h uses the learned tunnel endpoint).
+    node_ipv4: str = ""
 
     # Device batching (TPU runtime)
     batch_flows: int = defaults.BATCH_FLOWS

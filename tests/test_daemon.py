@@ -273,3 +273,32 @@ class TestMonitorStream:
             client.close()
         finally:
             server.close()
+
+    def test_both_listener_versions_simultaneously(self, daemon, tmp_path):
+        """The monitor serves 1.0 (line framing) and 1.2 (payload
+        framing) subscribers at once (reference: monitor/listener1_0.go
+        + listener1_2.go coexisting across upgrades)."""
+        path = str(tmp_path / "mon.sock")
+        server = MonitorServer(daemon.monitor, path)
+        try:
+            c12 = MonitorClient(path)
+            c10 = MonitorClient(path, version="1.0")
+            assert wait_for(lambda: server.subscriber_count() == 2)
+            daemon.policy_add(rules_from_json(POLICY))
+
+            def drain(client):
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    ev = client.next_event(timeout=0.5)
+                    if ev is not None and ev.payload.get("revision"):
+                        return ev
+                return None
+
+            ev12, ev10 = drain(c12), drain(c10)
+            assert ev12 is not None and ev10 is not None
+            # Same event content through both framings.
+            assert ev12.payload.get("revision") == ev10.payload.get("revision")
+            c12.close()
+            c10.close()
+        finally:
+            server.close()

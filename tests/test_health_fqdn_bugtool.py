@@ -242,3 +242,67 @@ def test_bugtool_bundle(tmp_path):
     finally:
         srv.close()
         d.close()
+
+
+def test_bugtool_native_sections(tmp_path):
+    """The beyond-the-agent captures (reference: bugtool/cmd/root.go:159
+    tc/ip/bpffs dumps): device platform, verdict-service counters over
+    its own wire, kvstore failure counters, CNI interface records, and
+    the latest BENCH/MULTICHIP artifacts."""
+    from cilium_tpu.api.server import ApiClient, ApiServer
+    from cilium_tpu.bugtool import collect
+    from cilium_tpu.daemon.daemon import Daemon
+    from cilium_tpu.k8s.cni import CniPlugin
+    from cilium_tpu.k8s.ipam import IpamAllocator
+    from cilium_tpu.proxylib import instance as inst
+    from cilium_tpu.sidecar.service import VerdictService
+    from cilium_tpu.utils.option import DaemonConfig
+
+    inst.reset_module_registry()
+    sock = str(tmp_path / "api.sock")
+    vsock = str(tmp_path / "vs.sock")
+    d = Daemon(DaemonConfig(state_dir=str(tmp_path / "s"), dry_mode=True))
+    srv = ApiServer(d, sock)
+    vs = VerdictService(vsock, DaemonConfig(batch_timeout_ms=2.0)).start()
+    cni = CniPlugin(d, IpamAllocator("10.45.0.0/24"))
+    cni.cni_add("bt-cont", "ns1", "pod-bt")
+    # A fake BENCH artifact in the "repo root".
+    root = str(tmp_path / "root")
+    import os
+
+    os.makedirs(root)
+    with open(f"{root}/BENCH_r99.json", "w") as f:
+        json.dump({"parsed": {"metric": "x", "value": 1}}, f)
+    try:
+        out = str(tmp_path / "bundle2.tar.gz")
+        manifest = collect(
+            ApiClient(sock), out, verdict_socket=vsock, cni=cni,
+            repo_root=root,
+        )
+        with tarfile.open(out) as tar:
+            names = {m.name for m in tar.getmembers()}
+            for extra in (
+                "cilium-tpu-bugtool/device.json",
+                "cilium-tpu-bugtool/kvstore-counters.json",
+                "cilium-tpu-bugtool/verdict-service.json",
+                "cilium-tpu-bugtool/cni-interfaces.json",
+                "cilium-tpu-bugtool/artifacts/BENCH_r99.json",
+            ):
+                assert extra in names, names
+            dev = json.load(tar.extractfile("cilium-tpu-bugtool/device.json"))
+            assert dev["device_count"] >= 1
+            vsj = json.load(
+                tar.extractfile("cilium-tpu-bugtool/verdict-service.json")
+            )
+            assert "dispatcher" in vsj
+            cnij = json.load(
+                tar.extractfile("cilium-tpu-bugtool/cni-interfaces.json")
+            )
+            assert any(v["container_ifname"] == "eth0" for v in cnij.values())
+        assert manifest["sections"]["device.json"]["ok"]
+        assert manifest["sections"]["verdict-service.json"]["ok"]
+    finally:
+        vs.stop()
+        srv.close()
+        d.close()
+        inst.reset_module_registry()

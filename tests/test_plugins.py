@@ -1,0 +1,148 @@
+"""Orchestrator plugin surfaces: the full CNI ADD/DEL/CHECK lifecycle
+(reference: plugins/cilium-cni/cilium-cni.go:293 cmdAdd / :455 cmdDel)
+and the docker libnetwork remote driver over its unix-socket HTTP
+protocol (reference: plugins/cilium-docker/driver/driver.go)."""
+
+import http.client
+import json
+import socket
+
+import pytest
+
+from cilium_tpu.daemon.daemon import Daemon
+from cilium_tpu.k8s.cni import CniError, CniPlugin
+from cilium_tpu.k8s.ipam import IpamAllocator
+from cilium_tpu.plugins.docker import LibnetworkDriver
+from cilium_tpu.utils.option import DaemonConfig
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = Daemon(DaemonConfig(state_dir=str(tmp_path / "state"),
+                            dry_mode=True, enable_health=False))
+    yield d
+    d.close()
+
+
+# --- CNI lifecycle ---------------------------------------------------------
+
+def test_cni_add_provisions_interfaces(daemon):
+    cni = CniPlugin(daemon, IpamAllocator("10.8.0.0/24"), mtu=1450)
+    res = cni.cni_add("cont-1", "ns1", "pod-a", netns="/proc/123/ns/net")
+    # Interface records mirror connector.SetupVeth: lxc+sha name, peer
+    # renamed eth0 inside the netns, MTU applied, default route via the
+    # IPAM router.
+    veth = cni.interfaces("cont-1")
+    assert veth.host_ifname.startswith("lxc") and len(veth.host_ifname) == 13
+    assert veth.container_ifname == "eth0"
+    assert veth.moved_to_netns and veth.netns == "/proc/123/ns/net"
+    assert veth.mtu == 1450
+    assert res.host_ifname == veth.host_ifname
+    assert res.container_mac == veth.container_mac
+    assert res.routes == [f"0.0.0.0/0 via {res.gateway}"]
+    # Deterministic names: same container id -> same interface names
+    # (kubelet retries must converge on one identity).
+    from cilium_tpu.endpoint.connector import setup_veth
+
+    assert setup_veth("cont-1", "x").host_ifname == veth.host_ifname
+
+
+def test_cni_check_semantics(daemon):
+    cni = CniPlugin(daemon, IpamAllocator("10.8.0.0/24"))
+    with pytest.raises(CniError):
+        cni.cni_check("nope")  # never added
+    res = cni.cni_add("cont-2", "ns1", "pod-b")
+    cni.cni_check("cont-2")  # consistent state passes
+    # Endpoint vanishing behind the plugin's back fails CHECK.
+    daemon.endpoint_delete(res.endpoint_id)
+    with pytest.raises(CniError):
+        cni.cni_check("cont-2")
+
+
+def test_cni_del_idempotent_and_releases(daemon):
+    ipam = IpamAllocator("10.8.0.0/29")
+    cni = CniPlugin(daemon, ipam)
+    res = cni.cni_add("cont-3", "ns1", "pod-c")
+    assert cni.cni_del("cont-3") is True
+    assert cni.cni_del("cont-3") is False  # repeated DEL: silent no-op
+    assert cni.cni_del("never-added") is False
+    assert cni.interfaces("cont-3") is None
+    assert ipam.allocate_ip(res.ip, "reuse") == res.ip  # IP released
+
+
+# --- libnetwork driver -----------------------------------------------------
+
+class _UnixConn(http.client.HTTPConnection):
+    def __init__(self, path):
+        super().__init__("localhost")
+        self._path = path
+
+    def connect(self):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(self._path)
+
+
+def _post(path, route, body):
+    conn = _UnixConn(path)
+    payload = json.dumps(body).encode()
+    conn.request("POST", route, payload,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json.loads(resp.read().decode())
+    conn.close()
+    return resp.status, out
+
+
+def test_libnetwork_driver_protocol(daemon, tmp_path):
+    drv = LibnetworkDriver(
+        daemon, IpamAllocator("10.11.0.0/24")
+    ).serve(str(tmp_path / "docker.sock"))
+    sock = str(tmp_path / "docker.sock")
+    try:
+        # Handshake + capabilities (driver.go handshake/capabilities).
+        st, out = _post(sock, "/Plugin.Activate", {})
+        assert st == 200 and out == {"Implements": ["NetworkDriver"]}
+        st, out = _post(sock, "/NetworkDriver.GetCapabilities", {})
+        assert st == 200 and out["Scope"] == "local"
+
+        _post(sock, "/NetworkDriver.CreateNetwork", {"NetworkID": "n1"})
+
+        # CreateEndpoint: missing IPv4 rejected (driver.go:287), valid
+        # request creates the agent endpoint.
+        st, out = _post(sock, "/NetworkDriver.CreateEndpoint",
+                        {"EndpointID": "e1", "Interface": {}})
+        assert st == 400 and "No IPv4" in out["Err"]
+        st, out = _post(
+            sock, "/NetworkDriver.CreateEndpoint",
+            {"EndpointID": "e1", "Interface": {"Address": "10.11.0.7/24"}},
+        )
+        assert st == 200 and out == {"Interface": {}}
+        assert daemon.ipcache.lookup_by_ip("10.11.0.7") is not None
+        # Duplicate rejected (driver.go:305).
+        st, out = _post(
+            sock, "/NetworkDriver.CreateEndpoint",
+            {"EndpointID": "e1", "Interface": {"Address": "10.11.0.8/24"}},
+        )
+        assert st == 400 and "already exists" in out["Err"]
+
+        # Join hands libnetwork the veth + gateway (driver.go join).
+        st, out = _post(sock, "/NetworkDriver.Join",
+                        {"EndpointID": "e1", "SandboxKey": "/sb/1"})
+        assert st == 200
+        assert out["InterfaceName"]["DstPrefix"] == "eth"
+        assert out["InterfaceName"]["SrcName"].startswith("tmp")
+        assert out["Gateway"] == "10.11.0.1"
+        st, out = _post(sock, "/NetworkDriver.EndpointOperInfo",
+                        {"EndpointID": "e1"})
+        assert st == 200
+
+        _post(sock, "/NetworkDriver.Leave", {"EndpointID": "e1"})
+        st, _ = _post(sock, "/NetworkDriver.DeleteEndpoint",
+                      {"EndpointID": "e1"})
+        assert st == 200
+        assert daemon.ipcache.lookup_by_ip("10.11.0.7") is None
+        # Unknown endpoint surfaces a driver error.
+        st, out = _post(sock, "/NetworkDriver.Join", {"EndpointID": "e1"})
+        assert st == 400 and "unknown endpoint" in out["Err"]
+    finally:
+        drv.close()
