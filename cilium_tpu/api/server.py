@@ -12,13 +12,12 @@ import http.client
 import json
 import re
 import socket
-import socketserver
-import threading
 import os
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Callable
 
 from ..labels import LabelArray
+from ..utils.unixhttp import serve_unix, shutdown_unix
 from ..policy import DPort, rules_from_json
 from ..utils.logging import get_logger
 
@@ -31,21 +30,12 @@ class ApiError(RuntimeError):
         self.status = status
 
 
-class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
-    daemon_threads = True
-    allow_reuse_address = True
-
-
 class ApiServer:
     """Routes -> daemon methods (reference: daemon REST handler wiring)."""
 
     def __init__(self, daemon, path: str) -> None:
         self.daemon = daemon
         self.path = path
-        if os.path.exists(path):
-            os.unlink(path)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -99,11 +89,7 @@ class ApiServer:
             def do_PATCH(self):
                 self._dispatch("PATCH")
 
-        self._httpd = _UnixHTTPServer(path, Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="api-server", daemon=True
-        )
-        self._thread.start()
+        self._httpd = serve_unix(path, Handler)
 
     # -- routing -----------------------------------------------------------
 
@@ -292,10 +278,7 @@ class ApiServer:
         return 200, maps[name]()
 
     def close(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if os.path.exists(self.path):
-            os.unlink(self.path)
+        shutdown_unix(self._httpd, self.path)
 
 
 class _UnixConnection(http.client.HTTPConnection):

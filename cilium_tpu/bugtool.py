@@ -87,6 +87,7 @@ def collect(
     verdict_socket: str | None = None,
     cni=None,
     repo_root: str | None = None,
+    kvstore=None,
 ) -> dict:
     """Collect every section through ``client`` (ApiClient) plus the
     native/device sections into a gzipped tar at ``out_path``; returns
@@ -119,7 +120,15 @@ def collect(
         _add_member(tar, "device.json", record("device.json", _device_section))
         _add_member(
             tar, "kvstore-counters.json",
-            record("kvstore-counters.json", _kvstore_counters),
+            record(
+                "kvstore-counters.json",
+                lambda: (
+                    kvstore.counters.snapshot()
+                    if kvstore is not None
+                    and hasattr(kvstore, "counters")
+                    else {}
+                ),
+            ),
         )
         if verdict_socket:
             _add_member(
@@ -154,10 +163,6 @@ def collect(
     return manifest
 
 
-def _kvstore_counters() -> dict:
-    from .kvstore.net import counters
-
-    return counters.snapshot()
 
 
 def _add_member(tar: tarfile.TarFile, name: str, blob: bytes) -> None:

@@ -586,7 +586,17 @@ class Daemon:
         return {
             "cilium": {"state": "Ok", "uptime_s": round(
                 time.time() - self._started, 1)},
-            "kvstore": {"state": "Ok", "status": self.kvstore.status()},
+            "kvstore": {
+                "state": "Ok",
+                "status": self.kvstore.status(),
+                # Client-side failure counters (reference: kvstore
+                # errors surfacing via controller failure counts).
+                "counters": (
+                    self.kvstore.counters.snapshot()
+                    if hasattr(self.kvstore, "counters")
+                    else {}
+                ),
+            },
             "node": self.node_name,
             "cluster": self.config.cluster_name,
             "policy": {

@@ -84,16 +84,17 @@ class CniPlugin:
             self._next_ep_id += 1
             # Reserve the slot NOW so a concurrent retried ADD for the
             # same container fails the check above instead of double-
-            # allocating (kubelet retries ADDs).
-            rec = _Container(ep_id)
-            self._containers[container_id] = rec
+            # allocating (kubelet retries ADDs).  The placeholder stays
+            # EMPTY (no ip/veth) until the endpoint exists: a DEL racing
+            # this in-flight ADD must find nothing to tear down — it
+            # must not free the IP the ADD is about to bind.
+            self._containers[container_id] = _Container(ep_id)
         try:
             ip = self.ipam.allocate_next(owner=f"{namespace}/{pod_name}")
         except Exception:
             with self._lock:
                 self._containers.pop(container_id, None)
             raise
-        rec.ip = ip
         # Interface provisioning (connector.SetupVeth) + the netns move
         # (cilium-cni.go:342-355).
         veth = setup_veth(
@@ -102,7 +103,6 @@ class CniPlugin:
         )
         move_to_netns(veth)
         veth.routes = [f"0.0.0.0/0 via {self.ipam.router_ip}"]
-        rec.veth = veth
         lbl_strs = [
             f"k8s:{k}={v}" for k, v in sorted((labels or {}).items())
         ]
@@ -116,6 +116,8 @@ class CniPlugin:
             with self._lock:
                 self._containers.pop(container_id, None)
             raise
+        with self._lock:
+            self._containers[container_id] = _Container(ep_id, ip, veth)
         return CniResult(
             endpoint_id=ep_id,
             ip=ip,

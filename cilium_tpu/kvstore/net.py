@@ -70,9 +70,6 @@ class KvstoreCounters:
             return dict(self._counts)
 
 
-counters = KvstoreCounters()
-
-
 def _send_frame(sock: socket.socket, obj: dict) -> None:
     data = json.dumps(obj).encode()
     sock.sendall(_LEN.pack(len(data)) + data)
@@ -118,7 +115,7 @@ class _Session:
                 _send_frame(self.sock, obj)
             except OSError as e:
                 # Reader notices the dead socket and cleans up.
-                counters.inc("server_send_failed")
+                self.server.counters.inc("server_send_failed")
                 log.debug("kvstore session %s send failed: %s", self.peer, e)
 
     def serve(self) -> None:
@@ -139,7 +136,7 @@ class _Session:
         except ValueError as e:
             # Malformed frame: a protocol bug, not a disconnect — count
             # and log it loudly before dropping the session.
-            counters.inc("server_malformed_frame")
+            self.server.counters.inc("server_malformed_frame")
             log.warning("kvstore session %s malformed frame: %s",
                         self.peer, e)
         finally:
@@ -167,7 +164,7 @@ class _Session:
         if op == "status":
             return {
                 "status": b.status(),
-                "counters": counters.snapshot(),
+                "counters": self.server.counters.snapshot(),
             }
         if op == "get":
             v = b.get(key)
@@ -285,7 +282,7 @@ class _Session:
             try:
                 lock.unlock()
             except Exception as e:  # noqa: BLE001
-                counters.inc("server_unlock_failed")
+                self.server.counters.inc("server_unlock_failed")
                 log.warning("session %s lock release failed: %s",
                             self.peer, e)
         self.locks.clear()
@@ -301,7 +298,7 @@ class _Session:
             try:
                 self.server.backend.delete(k)
             except Exception as e:  # noqa: BLE001
-                counters.inc("server_lease_revoke_failed")
+                self.server.counters.inc("server_lease_revoke_failed")
                 log.warning("lease revoke of %s failed: %s", k, e)
         self.leased.clear()
         try:
@@ -336,6 +333,7 @@ class KvstoreServer:
                 else LocalBackend()
             )
         self.backend = backend
+        self.counters = KvstoreCounters()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -434,6 +432,7 @@ class NetBackend(Backend):
         host, _, port = address.rpartition(":")
         self.address = address
         self.timeout = timeout
+        self.counters = KvstoreCounters()
         self.sock = socket.create_connection((host, int(port)), timeout=10.0)
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -484,10 +483,10 @@ class NetBackend(Backend):
                 if q is not None:
                     q.put(msg)
         except (ConnectionError, OSError) as e:
-            counters.inc("client_conn_lost")
+            self.counters.inc("client_conn_lost")
             log.debug("kvstore client connection lost: %s", e)
         except ValueError as e:
-            counters.inc("client_malformed_frame")
+            self.counters.inc("client_malformed_frame")
             log.warning("kvstore client malformed frame: %s", e)
         finally:
             with self._mutex:

@@ -1,22 +1,24 @@
-"""Batched per-pattern DFA evaluation on TPU — block-diagonal matmuls.
+"""Batched per-pattern DFA evaluation on TPU.
 
 The scale-out sibling of ops/nfa.py.  The dense union NFA advances a
 [F, S_total] state set with an O(S_total²·C) matmul per byte; at
 hundred-rule scale S_total is thousands and the delta is HBM-hostile.
 But the union automaton is block-diagonal — patterns never share states
-— and each pattern determinizes to a TINY DFA (regex/dfa.py), so the
-step factors into per-pattern blocks evaluated as ONE batched matmul:
+— and each pattern determinizes to a TINY DFA (regex/dfa.py), whose
+next state is a SCALAR.  The per-byte step therefore needs no S×S
+transition algebra at all:
 
   state:   [F, R, S] one-hot int8 (deterministic => exactly one bit)
-  cls1h:   [F, C]    = byte_onehot[F, 256] @ classmap_onehot[256, C]
-  joint:   [F, R, S*C] = state ⊗ cls1h      (outer product, VPU)
-  state':  [F, R, S]  = joint @ delta1h[R, S*C, S]   (batch dim R, MXU)
+  cls1h:   [F, C]    range compares (classes are unions of byte runs)
+  row:     [F, R, C] = state @ delta_id[R, S, C]   (row select, MXU)
+  nxt_id:  [F, R]    = Σ_c row·cls1h               (class select, VPU)
+  state':  [F, R, S] = (nxt_id == iota_S)          (one-hot rebuild)
 
-Work per byte is O(F·R·S²·C) with S ≈ 16 instead of O(F·S_total²·C)
-with S_total ≈ R·S — an R× saving that turns thousand-rule sets from
-teraflops into gigaflops, with tables a few hundred KB.  No gathers
-anywhere: TPU gathers do not vectorize (a gather-based scan measured
-~10k flows/s; this formulation measures ~40M/s at R=40).
+Work per byte is O(F·R·S·C) — S× less than the one-hot-delta matmul
+this replaced and S_total/S·S× less than the dense NFA — with tables a
+few KB.  No gathers anywhere: TPU gathers do not vectorize (a
+gather-based scan measured ~10k flows/s; take_along_axis variants cost
+~0.4s per 500k-flow pass).
 
 Acceptance is a mask reduction (state ⋅ accept_mask), sticky across
 steps like the NFA op.  API mirrors ops/nfa.py; bit-identical by
@@ -46,7 +48,12 @@ class DeviceDfa:
     delta's O(S²·C), a 48× compute and ~50× HBM-traffic saving at
     S=48/C=19 (measured 3× wall on the 500k-flow stress replay)."""
 
-    classmap_1h: jax.Array  # [256, C] int8 — shared byte-class one-hot
+    # Byte classes as unions of ranges: cls c contains byte b iff
+    # lo[c,k] <= b <= hi[c,k] for some k.  The range compare form costs
+    # ~C*K byte-ops per flow-byte instead of materializing a [F, 256]
+    # one-hot (16MB per scan step at F=64k) for the classmap matmul.
+    cls_lo: jax.Array  # [C, K] int32 (padded rows have lo > hi)
+    cls_hi: jax.Array  # [C, K] int32
     delta_id: jax.Array  # [R, S, C] int8 — next-state id per (state, class)
     start_1h: jax.Array  # [R, S] int8
     accept_mask: jax.Array  # [R, S] int8 — sticky accept states
@@ -57,7 +64,8 @@ class DeviceDfa:
 
     def tree_flatten(self):
         leaves = (
-            self.classmap_1h,
+            self.cls_lo,
+            self.cls_hi,
             self.delta_id,
             self.start_1h,
             self.accept_mask,
@@ -81,15 +89,28 @@ def device_dfa(tables: DfaTables) -> DeviceDfa:
         raise DfaBlowupError(
             f"DFA state id must fit int8 (got {s} states)"
         )
-    classmap_1h = np.zeros((256, c), np.int8)
-    classmap_1h[np.arange(256), tables.classmap] = 1
+    # Byte classes as maximal runs of the 256-entry classmap.
+    runs: list[list[tuple[int, int]]] = [[] for _ in range(c)]
+    start_b = 0
+    for b in range(1, 257):
+        if b == 256 or tables.classmap[b] != tables.classmap[start_b]:
+            runs[int(tables.classmap[start_b])].append((start_b, b - 1))
+            start_b = b
+    k = max(1, max(len(rr) for rr in runs))
+    cls_lo = np.full((c, k), 1, np.int32)  # lo>hi: empty padding
+    cls_hi = np.zeros((c, k), np.int32)
+    for ci, rr in enumerate(runs):
+        for ki, (lo, hi) in enumerate(rr):
+            cls_lo[ci, ki] = lo
+            cls_hi[ci, ki] = hi
     # Padded states/patterns keep delta_id=0: the one-hot state vector
     # never activates them, so their targets are never selected.
     delta_id = tables.delta.astype(np.int8)  # [R, S, C]
     start_1h = np.zeros((r, s), np.int8)
     start_1h[np.arange(r), tables.start] = 1
     return DeviceDfa(
-        classmap_1h=jnp.asarray(classmap_1h),
+        cls_lo=jnp.asarray(cls_lo),
+        cls_hi=jnp.asarray(cls_hi),
         delta_id=jnp.asarray(delta_id),
         start_1h=jnp.asarray(start_1h),
         accept_mask=jnp.asarray(tables.accept.astype(np.int8)),
@@ -102,15 +123,14 @@ def device_dfa(tables: DfaTables) -> DeviceDfa:
 
 def byte_class_onehot(dfa: DeviceDfa, byte_col: jax.Array) -> jax.Array:
     """[F] bytes -> [F, C] one-hot byte classes (shared by the serial
-    scan and the sequence-sharded fold so the two paths cannot drift)."""
-    byte_ids = jnp.arange(256, dtype=jnp.int32)
-    byte_1h = (byte_col[:, None] == byte_ids[None, :]).astype(jnp.int8)
-    return jax.lax.dot_general(
-        byte_1h,
-        dfa.classmap_1h,
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32,
-    ).astype(jnp.int8)
+    scan and the sequence-sharded fold so the two paths cannot drift).
+    Range-compare form: classes are unions of byte runs, so membership
+    is a handful of [F] compares instead of a [F, 256] one-hot matmul
+    (which cost 16MB of traffic per scan step at F=64k — measured 3.5x
+    slower end to end on the r2d2 search)."""
+    b = jnp.asarray(byte_col, jnp.int32)[:, None, None]  # [F, 1, 1]
+    in_run = (b >= dfa.cls_lo[None, :, :]) & (b <= dfa.cls_hi[None, :, :])
+    return jnp.any(in_run, axis=2).astype(jnp.int8)  # [F, C]
 
 
 def _accepts(state: jax.Array, mask: jax.Array) -> jax.Array:

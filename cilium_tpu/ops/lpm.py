@@ -119,11 +119,21 @@ def lpm_lookup(
         masked = jnp.bitwise_and(aw[:, None], lpm.masks[w][None, :])
         matched = matched & (masked == lpm.words[w][None, :])
     # Longest prefix wins: score = plen+1 for matches, 0 otherwise.
+    # Gather-free selection (TPU gathers serialize): the best score is
+    # a max-reduce; the winning row's value is a masked max over the
+    # rows attaining it (tables MAY contain duplicate equal-length
+    # prefixes — any of their values is a valid answer, matching the
+    # argmax tie-break contract).
     score = jnp.where(matched, lpm.plen[None, :] + 1, 0)
-    best = jnp.argmax(score, axis=1)
-    found = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0] > 0
-    value = jnp.where(found, lpm.values[best], 0)
-    plen_out = jnp.where(found, lpm.plen[best], -1)
+    best_score = jnp.max(score, axis=1)  # [F]
+    found = best_score > 0
+    at_best = matched & (score == best_score[:, None])  # [F, N]
+    value = jnp.max(
+        jnp.where(at_best, lpm.values[None, :], jnp.iinfo(jnp.int32).min),
+        axis=1,
+    )
+    value = jnp.where(found, value, 0)
+    plen_out = jnp.where(found, best_score - 1, -1)
     return found, value, plen_out
 
 

@@ -92,6 +92,13 @@ def exact_lookup(table: DeviceTable, *query_cols) -> tuple[jax.Array, jax.Array]
     for col, q in zip(table.cols, query_cols):
         matched = matched & (col[None, :] == q[:, None])
     found = jnp.any(matched, axis=1)
-    idx = jnp.argmax(matched, axis=1)
-    vals = jnp.where(found[:, None], table.values[idx], 0)
+    # Row extraction as ONE matmul (match rows are unique after build
+    # dedup, so the sum IS the matched row; zero when unmatched) — TPU
+    # gathers serialize, the [F,N]x[N,V] dot rides the MXU.
+    vals = jax.lax.dot_general(
+        matched.astype(jnp.int8),
+        table.values,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
     return found, vals

@@ -15,24 +15,18 @@ Errors use libnetwork's {"Err": "..."} shape.
 from __future__ import annotations
 
 import json
-import os
-import socketserver
 import threading
 from http.server import BaseHTTPRequestHandler
 
 from ..endpoint.connector import move_to_netns, setup_veth
 from ..utils.logging import get_logger
+from ..utils.unixhttp import serve_unix, shutdown_unix
 
 log = get_logger("docker-driver")
 
 
 class DriverError(RuntimeError):
     pass
-
-
-class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
-    daemon_threads = True
-    allow_reuse_address = True
 
 
 class LibnetworkDriver:
@@ -48,7 +42,6 @@ class LibnetworkDriver:
         self._endpoints: dict[str, dict] = {}
         self._next_ep_id = 5000
         self._server = None
-        self._thread = None
 
     # -- protocol methods (driver.go handler names) -----------------------
 
@@ -155,9 +148,6 @@ class LibnetworkDriver:
     # -- unix-socket HTTP plumbing ----------------------------------------
 
     def serve(self, path: str) -> "LibnetworkDriver":
-        if os.path.exists(path):
-            os.unlink(path)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         driver = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -192,19 +182,10 @@ class LibnetworkDriver:
                 self.end_headers()
                 self.wfile.write(payload)
 
-        self._server = _UnixHTTPServer(path, Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
-        )
-        self._thread.start()
+        self._server = serve_unix(path, Handler)
         self.path = path
         return self
 
     def close(self) -> None:
         if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            try:
-                os.unlink(self.path)
-            except OSError:
-                pass
+            shutdown_unix(self._server, self.path)
