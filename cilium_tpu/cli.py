@@ -233,6 +233,53 @@ def cmd_version(args):
     return 0
 
 
+def _kvstore_backend(args):
+    """Direct store connection (reference: cilium/cmd/kvstore.go — the
+    kvstore commands bypass the agent and dial the store)."""
+    from .kvstore.net import NetBackend
+
+    return NetBackend(args.address)
+
+
+def cmd_kvstore_get(args):
+    b = _kvstore_backend(args)
+    try:
+        if args.recursive:
+            items = b.list_prefix(args.key)
+            for k in sorted(items):
+                print(f"{k} => {items[k].decode(errors='replace')}")
+            return 0
+        v = b.get(args.key)
+        if v is None:
+            print(f"key {args.key} not found", file=sys.stderr)
+            return 1
+        print(v.decode(errors="replace"))
+        return 0
+    finally:
+        b.close()
+
+
+def cmd_kvstore_set(args):
+    b = _kvstore_backend(args)
+    try:
+        b.set(args.key, args.value.encode())
+        return 0
+    finally:
+        b.close()
+
+
+def cmd_kvstore_delete(args):
+    b = _kvstore_backend(args)
+    try:
+        if args.recursive:
+            b.delete_prefix(args.key)
+        else:
+            b.delete(args.key)
+        return 0
+    finally:
+        b.close()
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cilium-tpu",
@@ -337,6 +384,24 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("bugtool", help="collect a support bundle")
     x.add_argument("-o", "--output", default="cilium-tpu-bugtool.tar.gz")
     x.set_defaults(fn=cmd_bugtool)
+
+    kv = sub.add_parser(
+        "kvstore", help="direct kvstore access (reference: cilium kvstore)"
+    ).add_subparsers(dest="kv_cmd", required=True)
+    for name, fn, val in (
+        ("get", cmd_kvstore_get, False),
+        ("set", cmd_kvstore_set, True),
+        ("delete", cmd_kvstore_delete, False),
+    ):
+        x = kv.add_parser(name)
+        x.add_argument("key")
+        if val:
+            x.add_argument("value")
+        else:
+            x.add_argument("--recursive", action="store_true")
+        x.add_argument("--address", required=True,
+                       help="kvstore server host:port")
+        x.set_defaults(fn=fn)
 
     x = sub.add_parser("version")
     x.set_defaults(fn=cmd_version)

@@ -143,3 +143,26 @@ def test_failure_counters_surface(tmp_path):
     finally:
         c.close()
         srv.close()
+
+
+def test_kvstore_cli_roundtrip(capsys):
+    """reference: cilium/cmd/kvstore_{get,set,delete}.go — the CLI
+    dials the store directly."""
+    from cilium_tpu.cli import main as cli_main
+
+    srv = KvstoreServer()
+    a = srv.address
+    try:
+        assert cli_main(["kvstore", "set", "cli/x", "v1", "--address", a]) == 0
+        assert cli_main(["kvstore", "get", "cli/x", "--address", a]) == 0
+        assert "v1" in capsys.readouterr().out
+        assert cli_main(
+            ["kvstore", "get", "cli/", "--recursive", "--address", a]
+        ) == 0
+        assert "cli/x => v1" in capsys.readouterr().out
+        assert cli_main(
+            ["kvstore", "delete", "cli/x", "--address", a]
+        ) == 0
+        assert cli_main(["kvstore", "get", "cli/x", "--address", a]) == 1
+    finally:
+        srv.close()
