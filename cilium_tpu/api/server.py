@@ -108,6 +108,15 @@ class ApiServer:
             return 200, d.metrics_text()
         if path == "/v1/monitor/recent" and method == "GET":
             return 200, [e.to_dict() for e in d.monitor.recent(200)]
+        if path == "/v1/node" and method == "GET":
+            # Local node + discovered peers (reference: pkg/node store).
+            return 200, {
+                "local": d.node_discovery.local.to_dict(),
+                "nodes": {
+                    name: n.to_dict()
+                    for name, n in d.node_discovery.get_nodes().items()
+                },
+            }
         if path == "/v1/health" and method == "GET":
             from ..health import Prober
 

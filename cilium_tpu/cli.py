@@ -233,17 +233,45 @@ def cmd_version(args):
     return 0
 
 
-def _kvstore_backend(args):
-    """Direct store connection (reference: cilium/cmd/kvstore.go — the
-    kvstore commands bypass the agent and dial the store)."""
+def cmd_node_list(args):
+    """reference: cilium node list — local node + kvstore-discovered
+    peers."""
+    data = _client(args).get("/v1/node")
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    local = data["local"]
+    print(f"local: {local['Cluster']}/{local['Name']} "
+          f"{local['IPv4Address'] or '-'}")
+    for name, n in sorted(data["nodes"].items()):
+        print(f"{name} {n['IPv4Address'] or '-'}")
+    return 0
+
+
+def _run_kvstore(args, fn) -> int:
+    """Direct store connection + error handling (reference:
+    cilium/cmd/kvstore.go — these commands bypass the agent and dial
+    the store, so failures name the STORE, not the agent socket)."""
+    from .kvstore.backend import KvstoreError
     from .kvstore.net import NetBackend
 
-    return NetBackend(args.address)
+    try:
+        b = NetBackend(args.address)
+    except (OSError, ValueError) as e:
+        print(f"Error: cannot reach kvstore at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        return fn(b)
+    except KvstoreError as e:
+        print(f"Error: kvstore at {args.address}: {e}", file=sys.stderr)
+        return 1
+    finally:
+        b.close()
 
 
 def cmd_kvstore_get(args):
-    b = _kvstore_backend(args)
-    try:
+    def go(b):
         if args.recursive:
             items = b.list_prefix(args.key)
             for k in sorted(items):
@@ -255,29 +283,27 @@ def cmd_kvstore_get(args):
             return 1
         print(v.decode(errors="replace"))
         return 0
-    finally:
-        b.close()
+
+    return _run_kvstore(args, go)
 
 
 def cmd_kvstore_set(args):
-    b = _kvstore_backend(args)
-    try:
+    def go(b):
         b.set(args.key, args.value.encode())
         return 0
-    finally:
-        b.close()
+
+    return _run_kvstore(args, go)
 
 
 def cmd_kvstore_delete(args):
-    b = _kvstore_backend(args)
-    try:
+    def go(b):
         if args.recursive:
             b.delete_prefix(args.key)
         else:
             b.delete(args.key)
         return 0
-    finally:
-        b.close()
+
+    return _run_kvstore(args, go)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -384,6 +410,12 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("bugtool", help="collect a support bundle")
     x.add_argument("-o", "--output", default="cilium-tpu-bugtool.tar.gz")
     x.set_defaults(fn=cmd_bugtool)
+
+    nd = sub.add_parser("node", help="cluster nodes").add_subparsers(
+        dest="node_cmd", required=True
+    )
+    x = nd.add_parser("list")
+    x.set_defaults(fn=cmd_node_list)
 
     kv = sub.add_parser(
         "kvstore", help="direct kvstore access (reference: cilium kvstore)"

@@ -122,6 +122,20 @@ class Daemon:
         self.ipcache_sync = KvstoreIPSync(self.ipcache, backend=self.kvstore)
         self.ipcache_sync.start_watcher()
 
+        # Node registry: publish the local node, track peers (reference:
+        # node.AutoComplete + the pkg/node kvstore store; remote nodes
+        # are what the overlay encaps toward).
+        from ..node import Node, NodeDiscovery
+
+        self.node_discovery = NodeDiscovery(
+            Node(
+                name=node_name,
+                cluster=self.config.cluster_name,
+                ipv4_address=self.config.node_ipv4,
+            ),
+            backend=self.kvstore,
+        )
+
         # Other datapath maps
         self.ct_map = CtMap()
         self.lb_map = LbMap()
@@ -676,6 +690,7 @@ class Daemon:
         self.build_queue.stop()
         self.controllers.remove_all()
         self.ipcache_sync.stop()
+        self.node_discovery.close()
         self.identity_allocator.close()
         if self.health_responder is not None:
             self.health_responder.close()

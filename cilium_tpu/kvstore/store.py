@@ -10,11 +10,14 @@ propagation ride on this.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from .backend import Backend, EventType
+from .backend import Backend, EventType, KvstoreError
+
+log = logging.getLogger(__name__)
 
 
 class SharedStore:
@@ -83,6 +86,14 @@ class SharedStore:
                 if ev.typ == EventType.LIST_DONE:
                     continue
                 name = ev.key[len(self.prefix) + 1:]
+                # Own keys loop back through the prefix watch; the
+                # shared view holds REMOTE state only (reference:
+                # store.go onUpdate isLocal filter) — a node must not
+                # discover itself as a peer.
+                with self._mutex:
+                    own = name in self._local or name == self.node_name
+                if own:
+                    continue
                 if ev.typ == EventType.DELETE:
                     with self._mutex:
                         self._shared.pop(name, None)
@@ -106,4 +117,10 @@ class SharedStore:
         if self._watcher is not None:
             self._watcher.stop()
         for name in list(self._local):
-            self.delete_local_key(name)
+            # Best-effort: on a dead/closing kvstore connection the
+            # server-side lease revocation removes the key anyway
+            # (session death = lease expiry) — teardown must not raise.
+            try:
+                self.delete_local_key(name)
+            except KvstoreError as e:
+                log.debug("store close: delete %s skipped: %s", name, e)

@@ -177,3 +177,43 @@ def test_crossnode_flow_through_overlay(world):
     # knowledge of it, and new flows from that IP lose the identity.
     da.endpoint_delete(11)
     assert wait_for(lambda: db.ipcache.lookup_by_ip(CLIENT_IP) is None)
+
+
+def test_node_discovery_between_daemons(world, tmp_path):
+    """Each daemon publishes its Node and discovers the peer through
+    the kvstore store (reference: pkg/node manager + `cilium node
+    list`); the API and CLI surface both."""
+    da, db = world
+    assert wait_for(
+        lambda: any(
+            n.ipv4_address == NODE_B_IP
+            for n in da.node_discovery.get_nodes().values()
+        )
+    ), da.node_discovery.get_nodes()
+    assert wait_for(
+        lambda: any(
+            n.ipv4_address == NODE_A_IP
+            for n in db.node_discovery.get_nodes().values()
+        )
+    )
+    # A node must not discover ITSELF as a peer (reference: store.go
+    # isLocal filter).
+    assert all(
+        n.ipv4_address != NODE_A_IP
+        for n in da.node_discovery.get_nodes().values()
+    )
+
+    from cilium_tpu.api.server import ApiClient, ApiServer
+    from cilium_tpu.cli import main as cli_main
+
+    sock = str(tmp_path / "api-a.sock")
+    srv = ApiServer(da, sock)
+    try:
+        data = ApiClient(sock).get("/v1/node")
+        assert data["local"]["IPv4Address"] == NODE_A_IP
+        assert any(
+            n["IPv4Address"] == NODE_B_IP for n in data["nodes"].values()
+        )
+        assert cli_main(["--socket", sock, "node", "list"]) == 0
+    finally:
+        srv.close()
