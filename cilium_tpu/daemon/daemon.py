@@ -124,7 +124,11 @@ class Daemon:
 
         # Node registry: publish the local node, track peers (reference:
         # node.AutoComplete + the pkg/node kvstore store; remote nodes
-        # are what the overlay encaps toward).
+        # are what the overlay encaps toward and what the health prober
+        # probes).  Health attrs exist BEFORE the watch starts: node
+        # events fire from the watcher thread immediately.
+        self.health_responder = None
+        self.health_prober = None
         from ..node import Node, NodeDiscovery
 
         self.node_discovery = NodeDiscovery(
@@ -134,6 +138,8 @@ class Daemon:
                 ipv4_address=self.config.node_ipv4,
             ),
             backend=self.kvstore,
+            on_node_update=self._on_remote_node,
+            on_node_delete=self._on_remote_node_gone,
         )
 
         # Other datapath maps
@@ -165,8 +171,6 @@ class Daemon:
 
         # cilium-health: per-node responder + cluster prober
         # (reference: daemon/main.go:926-968 health endpoint launch)
-        self.health_responder = None
-        self.health_prober = None
         if self.config.enable_health:
             from ..health import HealthResponder, Prober
 
@@ -178,6 +182,14 @@ class Daemon:
                 node_name, self.health_responder.address
             )
             self.health_prober.start()
+            # Advertise the responder address cluster-wide and probe
+            # every peer already discovered (reference: the health IP
+            # travels in the Node object, prober.go probes all nodes).
+            self.node_discovery.update_local(
+                ipv4_health_ip=self.health_responder.address
+            )
+            for n in self.node_discovery.get_nodes().values():
+                self._on_remote_node(n)
 
         # DNS poller slot for toFQDNs rules (started on demand with a
         # resolver via start_dns_poller; reference: daemon.go:1334
@@ -313,6 +325,16 @@ class Daemon:
             ipv4, identity_id,
             tunnel_endpoint=tunnel, host_ip=self.config.node_ipv4,
         )
+
+    def _on_remote_node(self, node) -> None:
+        """Node discovery -> health prober feed (reference: the prober
+        walks the discovered node set, pkg/health/server/prober.go:40)."""
+        if self.health_prober is not None and node.ipv4_health_ip:
+            self.health_prober.add_node(node.fullname(), node.ipv4_health_ip)
+
+    def _on_remote_node_gone(self, name: str) -> None:
+        if self.health_prober is not None:
+            self.health_prober.remove_node(name)
 
     def _retry_not_ready_endpoints(self) -> None:
         """Re-enqueue endpoints that failed their last regeneration

@@ -217,3 +217,40 @@ def test_node_discovery_between_daemons(world, tmp_path):
         assert cli_main(["--socket", sock, "node", "list"]) == 0
     finally:
         srv.close()
+
+
+def test_health_prober_follows_node_discovery(tmp_path):
+    """Two health-enabled daemons discover each other and their probers
+    probe the PEER's responder (reference: the health IP travels in the
+    Node object; pkg/health/server/prober.go walks discovered nodes)."""
+    srv = KvstoreServer()
+
+    def mk(node, node_ip):
+        return Daemon(
+            DaemonConfig(
+                state_dir=str(tmp_path / node), dry_mode=True,
+                kvstore="tcp", kvstore_opts={"address": srv.address},
+                node_ipv4=node_ip, enable_health=True,
+            ),
+            node_name=node,
+        )
+
+    da = mk("ha", NODE_A_IP)
+    db = mk("hb", NODE_B_IP)
+    try:
+        def peer_probed():
+            da.health_prober.probe_all()
+            nodes = da.health_prober.get_status()["nodes"]
+            rec = nodes.get("default/hb")
+            return bool(rec and rec["reachable"])
+
+        assert wait_for(peer_probed, timeout=10.0), (
+            da.health_prober.get_status()
+        )
+        # And the peer's latency was actually measured.
+        rec = da.health_prober.get_status()["nodes"]["default/hb"]
+        assert rec["address"] == db.health_responder.address
+    finally:
+        da.close()
+        db.close()
+        srv.close()
