@@ -1510,9 +1510,7 @@ class VerdictService:
 
         if fast:
             self._run_fast(fast, responses)
-        for key, i, sc, conn_id, reply, end_stream, data in slow:
-            responses[key][i] = self._run_slow(sc, conn_id, reply, end_stream, data)
-            self._tab_mark(conn_id, sc)
+        self._run_slow_batched(slow, responses)
 
         # Emit one verdict batch per data item, in arrival order —
         # through the completion queue so responses stay FIFO with any
@@ -1574,6 +1572,99 @@ class VerdictService:
                     inj,
                 )
 
+    def _run_slow_batched(self, slow: list, responses: dict) -> None:
+        """Engine-backed slow entries are processed in WAVES: the nth
+        entry of every connection is fed together and each engine is
+        pumped ONCE per wave — a round's worth of frames (http/
+        cassandra/memcached heads across every flow) is judged in one
+        device batch per wave instead of one device call per entry,
+        while per-connection order and per-entry op attribution are
+        preserved (each conn contributes at most one entry per wave, so
+        take_ops drains exactly that entry's ops).
+
+        Oracle-path conns and end_stream entries keep the strict
+        per-entry pipeline; once a connection has taken that path in
+        this round, its later entries follow it (order)."""
+        waves: list[list] = []
+        wave_of: dict[int, int] = {}
+        tainted: set[int] = set()
+        leftovers: list = []
+        for rec in slow:
+            key, i, sc, conn_id, reply, end_stream, data = rec
+            engine = sc.engine
+            batchable = (
+                engine is not None
+                and not end_stream
+                and conn_id not in tainted
+                and (getattr(engine, "handles_reply", False) or not reply)
+            )
+            if not batchable:
+                tainted.add(conn_id)
+                leftovers.append(rec)
+                continue
+            w = wave_of.get(conn_id, 0)
+            wave_of[conn_id] = w + 1
+            while len(waves) <= w:
+                waves.append([])
+            # Engine snapshotted ONCE per record: policy_update rebinds
+            # sc.engine concurrently, and feed/take must hit the same one.
+            waves[w].append((rec, engine))
+
+        for wave in waves:
+            engines: dict[int, object] = {}
+            for (key, i, sc, conn_id, reply, end_stream, data), engine in wave:
+                self._feed_engine(engine, sc, conn_id, reply, data)
+                engines[id(engine)] = engine
+            for engine in engines.values():
+                engine.pump()
+            for (key, i, sc, conn_id, reply, end_stream, data), engine in wave:
+                responses[key][i] = self._take_engine(engine, conn_id, reply)
+                self._tab_mark(conn_id, sc)
+        for rec in leftovers:
+            key, i, sc, conn_id, reply, end_stream, data = rec
+            responses[key][i] = self._run_slow(
+                sc, conn_id, reply, end_stream, data
+            )
+            self._tab_mark(conn_id, sc)
+
+    @staticmethod
+    def _feed_engine(engine, sc: "_SidecarConn", conn_id: int, reply: bool,
+                     data: bytes) -> None:
+        """One entry into an engine — the single definition of the feed
+        kwargs contract, shared by the wave-batched and per-entry paths
+        (they must never drift: both serve entries of the same conns)."""
+        conn = sc.conn
+        if getattr(engine, "handles_reply", False):
+            engine.feed(
+                conn_id, data, reply=reply, remote_id=conn.src_id,
+                policy_name=conn.policy_name, dst_id=conn.dst_id,
+                src_addr=conn.src_addr, dst_addr=conn.dst_addr,
+            )
+        else:
+            engine.feed(
+                conn_id, data, remote_id=conn.src_id,
+                policy_name=conn.policy_name, ingress=conn.ingress,
+                dst_id=conn.dst_id, src_addr=conn.src_addr,
+                dst_addr=conn.dst_addr,
+            )
+
+    @staticmethod
+    def _take_engine(engine, conn_id: int, reply: bool):
+        """Drain one entry's ops into the response-tuple shape (shared
+        by the wave-batched and per-entry paths)."""
+        if getattr(engine, "handles_reply", False):
+            ops, inj_o, inj_r = engine.take_ops(conn_id, reply)
+        else:
+            ops, inject = engine.take_ops(conn_id)
+            inj_o, inj_r = b"", inject
+        return (
+            conn_id,
+            int(FilterResult.OK),
+            [(int(op), int(nn)) for op, nn in ops],
+            inj_o,
+            inj_r,
+        )
+
     def _run_slow(self, sc: _SidecarConn, conn_id: int, reply: bool,
                   end_stream: bool, data: bytes):
         """Stateful path: request direction through the batch engine when
@@ -1582,50 +1673,12 @@ class VerdictService:
         # sc.engine from a reader thread, and a mid-entry swap would
         # feed one engine but take_ops from another (empty) one.
         engine = sc.engine
-        if engine is not None and getattr(engine, "handles_reply", False):
-            # Device-assisted engine (cassandra/memcache/http): both
-            # directions.
-            conn = sc.conn
-            engine.feed(
-                conn_id,
-                data,
-                reply=reply,
-                remote_id=conn.src_id,
-                policy_name=conn.policy_name,
-                dst_id=conn.dst_id,
-                src_addr=conn.src_addr,
-                dst_addr=conn.dst_addr,
-            )
+        if engine is not None and (
+            getattr(engine, "handles_reply", False) or not reply
+        ):
+            self._feed_engine(engine, sc, conn_id, reply, data)
             engine.pump()
-            ops, inj_orig, inj_reply = engine.take_ops(conn_id, reply)
-            return (
-                conn_id,
-                int(FilterResult.OK),
-                [(int(op), int(nn)) for op, nn in ops],
-                inj_orig,
-                inj_reply,
-            )
-        if engine is not None and not reply:
-            conn = sc.conn
-            engine.feed(
-                conn_id,
-                data,
-                remote_id=conn.src_id,
-                policy_name=conn.policy_name,
-                ingress=conn.ingress,
-                dst_id=conn.dst_id,
-                src_addr=conn.src_addr,
-                dst_addr=conn.dst_addr,
-            )
-            engine.pump()
-            ops, inject = engine.take_ops(conn_id)
-            return (
-                conn_id,
-                int(FilterResult.OK),
-                [(int(op), int(nn)) for op, nn in ops],
-                b"",
-                inject,
-            )
+            return self._take_engine(engine, conn_id, reply)
 
         # Oracle path: mirror the datapath buffer, loop while the parser
         # fills the op array (reference: cilium_proxylib.cc:301 do-while).

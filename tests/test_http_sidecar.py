@@ -210,3 +210,81 @@ def test_malformed_request_line_keeps_verdict_queue_aligned(service):
         assert out == good
     finally:
         client.close()
+
+
+def test_http_wave_batching_parity(tmp_path):
+    """Aggregated rounds with MULTIPLE pipelined requests per conn run
+    through the wave-batched slow path (nth entry of every conn judged
+    in one device batch per wave) — verdict sequences must match the
+    per-request oracle exactly."""
+    import threading
+
+    import numpy as np
+
+    from cilium_tpu.proxylib import instance as inst
+    from cilium_tpu.sidecar.client import SidecarClient
+    from cilium_tpu.sidecar.service import VerdictService
+    from cilium_tpu.utils.option import DaemonConfig
+
+    inst.reset_module_registry()
+    svc = VerdictService(
+        str(tmp_path / "wv.sock"), DaemonConfig(batch_timeout_ms=0.0)
+    ).start()
+    cl = SidecarClient(svc.socket_path, timeout=300.0)
+    try:
+        mod = cl.open_module([])
+        assert cl.policy_update(mod, [http_policy()]) == int(FilterResult.OK)
+        N = 4
+        for cid in range(1, N + 1):
+            res, _ = cl.new_connection(
+                mod, "http", cid, True, 1, 2, "1.1.1.1:1", "2.2.2.2:80",
+                "http-pol",
+            )
+            assert res == int(FilterResult.OK)
+        reqs = [
+            b"GET /public/a HTTP/1.1\r\nHost: h\r\n\r\n",   # allow
+            b"GET /private/b HTTP/1.1\r\nHost: h\r\n\r\n",  # deny
+            b"GET /public/c HTTP/1.1\r\nHost: h\r\n\r\n",   # allow
+        ]
+        got: dict[int, object] = {}
+        evt = threading.Event()
+
+        def cb(vb):
+            got[vb.seq] = vb
+            evt.set()
+
+        cl.verdict_callback = cb
+        # ONE DataBatch carrying all three requests PER CONN (repeated
+        # conn ids) — a single round whose slow set has three entries
+        # per conn, deterministically exercising waves 0..2 and their
+        # per-conn op attribution.
+        ids = np.concatenate(
+            [np.arange(1, N + 1, dtype=np.uint64)] * len(reqs)
+        )
+        lens = np.concatenate(
+            [np.full(N, len(r), np.uint32) for r in reqs]
+        )
+        blob = b"".join(r * N for r in reqs)
+        cl.send_batch(77, ids, np.zeros(len(ids), np.uint8), lens, blob)
+        assert evt.wait(240), sorted(got)
+
+        vb = got[77]
+        assert vb.count == N * len(reqs)
+        for j in range(vb.count):
+            cid, res, ops, _io, ir = vb.entry(j)
+            k = j // N  # request index (entries in send order)
+            assert res == int(FilterResult.OK)
+            kinds = [int(o) for o, _ in ops]
+            allow = k != 1
+            if allow:
+                assert int(PASS) in kinds and int(DROP) not in kinds, (
+                    k, cid, ops,
+                )
+                assert ir == b""
+            else:
+                assert int(DROP) in kinds, (k, cid, ops)
+                assert b"403" in ir  # injected denial response
+    finally:
+        cl.close()
+        svc.stop()
+        inst.reset_module_registry()
