@@ -193,7 +193,7 @@ def cmd_metrics(args):
 def cmd_monitor(args):
     from .monitor import MonitorClient, format_event
 
-    client = MonitorClient(args.monitor_socket)
+    client = MonitorClient(args.monitor_socket, version=args.protocol)
     print("Listening for events...", file=sys.stderr)
     try:
         while True:
@@ -402,6 +402,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     x = sub.add_parser("monitor", help="live event stream")
     x.add_argument("--monitor-socket", default=defaults.MONITOR_SOCK_PATH)
+    # Listener protocol generation (reference: monitor/listener1_0.go
+    # vs listener1_2.go — both served simultaneously).
+    x.add_argument("--protocol", choices=["1.0", "1.2"], default="1.2")
     x.set_defaults(fn=cmd_monitor)
 
     x = sub.add_parser("health", help="node connectivity status")
