@@ -236,11 +236,55 @@ class ApiServer:
                 )
                 return 200, {"revision": rev}
 
+        m = re.fullmatch(r"/v1/service(?:/(\d+))?", path)
+        if m:
+            return self._service(method, m.group(1), body)
+
         m = re.fullmatch(r"/v1/map(?:/([\w-]+))?", path)
         if m and method == "GET":
             return self._map_dump(m.group(1))
 
         raise ApiError(404, f"no route for {method} {path}")
+
+    def _service(self, method: str, id_str: str | None,
+                 body: bytes) -> tuple[int, Any]:
+        """Service REST handlers (reference: daemon/loadbalancer.go
+        PutServiceID :135 / GetServiceID :289 / DeleteServiceID :183
+        + GET /service list)."""
+        from ..service import L3n4Addr, ServiceError
+
+        mgr = self.daemon.service_manager
+        if method == "GET" and id_str is None:
+            return 200, [s.to_model() for s in mgr.list()]
+        if id_str is None:
+            raise ApiError(400, "service ID required")
+        svc_id = int(id_str)
+        if svc_id == 0:
+            raise ApiError(400, "invalid service ID 0")  # SVCAdd contract
+        if method == "GET":
+            svc = mgr.get(svc_id)
+            if svc is None:
+                raise ApiError(404, f"service {svc_id} not found")
+            return 200, svc.to_model()
+        if method == "DELETE":
+            if not mgr.delete_by_id(svc_id):
+                raise ApiError(404, f"service {svc_id} not found")
+            return 200, {}
+        if method == "PUT":
+            spec = json.loads(body.decode() or "{}")
+            try:
+                frontend = L3n4Addr.from_dict(
+                    spec.get("frontend-address") or {}
+                )
+                backends = [
+                    L3n4Addr.from_dict(b)
+                    for b in spec.get("backend-addresses") or []
+                ]
+                _, created = mgr.upsert(frontend, backends, id=svc_id)
+            except ServiceError as e:
+                raise ApiError(460, str(e)) from e
+            return (201 if created else 200), mgr.get(svc_id).to_model()
+        raise ApiError(405, f"{method} not supported on /v1/service")
 
     def _map_dump(self, name: str | None) -> tuple[int, Any]:
         """reference: cilium bpf * list / cilium map get."""

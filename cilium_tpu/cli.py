@@ -233,6 +233,59 @@ def cmd_version(args):
     return 0
 
 
+def _parse_l3n4(spec: str) -> dict:
+    """'ip:port' or '[v6]:port' -> address dict (reference: cilium
+    service update --frontend)."""
+    host, _, port = spec.rpartition(":")
+    host = host.strip("[]")
+    try:
+        port_n = int(port)
+    except ValueError:
+        port_n = 0
+    if not host or not port_n:
+        raise SystemExit(f"invalid address {spec!r}; want IP:PORT")
+    return {"ip": host, "port": port_n, "protocol": "TCP"}
+
+
+def cmd_service_list(args):
+    """reference: cilium service list (cilium/cmd/service_list.go)."""
+    data = _client(args).get("/v1/service")
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    for svc in data:
+        fe = svc["frontend-address"]
+        bes = ", ".join(
+            f"{b['ip']}:{b['port']}" for b in svc["backend-addresses"]
+        ) or "-"
+        print(f"{svc['id']} {fe['ip']}:{fe['port']}/{fe['protocol']} -> {bes}")
+    return 0
+
+
+def cmd_service_get(args):
+    _print(_client(args).get(f"/v1/service/{args.id}"), args.json)
+    return 0
+
+
+def cmd_service_update(args):
+    """reference: cilium service update --id --frontend --backends."""
+    body = {
+        "frontend-address": _parse_l3n4(args.frontend),
+        "backend-addresses": [
+            _parse_l3n4(b) for b in (args.backends or "").split(",") if b
+        ],
+    }
+    out = _client(args).put(f"/v1/service/{args.id}", body)
+    _print(out, args.json)
+    return 0
+
+
+def cmd_service_delete(args):
+    _client(args).delete(f"/v1/service/{args.id}")
+    print(f"service {args.id} deleted")
+    return 0
+
+
 def cmd_node_list(args):
     """reference: cilium node list — local node + kvstore-discovered
     peers."""
@@ -419,6 +472,26 @@ def build_parser() -> argparse.ArgumentParser:
     )
     x = nd.add_parser("list")
     x.set_defaults(fn=cmd_node_list)
+
+    # reference: cilium service list/get/update/delete
+    # (cilium/cmd/service*.go)
+    svc = sub.add_parser(
+        "service", help="load-balancer services"
+    ).add_subparsers(dest="svc_cmd", required=True)
+    x = svc.add_parser("list")
+    x.set_defaults(fn=cmd_service_list)
+    x = svc.add_parser("get")
+    x.add_argument("id", type=int)
+    x.set_defaults(fn=cmd_service_get)
+    x = svc.add_parser("update")
+    x.add_argument("--id", type=int, required=True)
+    x.add_argument("--frontend", required=True, help="VIP as IP:PORT")
+    x.add_argument("--backends", default="",
+                   help="comma-separated backend IP:PORT list")
+    x.set_defaults(fn=cmd_service_update)
+    x = svc.add_parser("delete")
+    x.add_argument("id", type=int)
+    x.set_defaults(fn=cmd_service_delete)
 
     kv = sub.add_parser(
         "kvstore", help="direct kvstore access (reference: cilium kvstore)"

@@ -148,6 +148,14 @@ class Daemon:
         self.metrics_map = MetricsMap()
         self.prefilter = PreFilter()
 
+        # Services / load-balancer control plane: programs the LbMap
+        # from the REST API and the k8s watcher, with RevNAT ids
+        # allocated cluster-wide through the kvstore (reference:
+        # daemon/loadbalancer.go + pkg/service/id_kvstore.go).
+        from ..service import ServiceManager
+
+        self.service_manager = ServiceManager(self.lb_map, self.kvstore)
+
         # Proxy + runtime engines (reference: proxy.StartProxySupport)
         self.proxy_manager = ProxyManager(
             self.config.proxy_port_min,

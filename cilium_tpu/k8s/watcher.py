@@ -17,6 +17,7 @@ import logging
 import threading
 
 from ..labels import LabelArray
+from ..service import L3n4Addr, ServiceError
 from . import apiserver as api
 from .cnp import parse_cnp
 from .network_policy import np_policy_name, parse_network_policy, policy_labels
@@ -40,6 +41,14 @@ class K8sWatcher:
         # Last known endpoints per (namespace, name) service for the
         # ToServices revert pass on endpoint updates.
         self._svc_backends: dict[tuple, list[str]] = {}
+        # Service/Endpoints stores driving the load balancer
+        # (reference: d.loadBalancer.K8sServices / K8sEndpoints,
+        # daemon/k8s_watcher.go:822,945).
+        self._k8s_services: dict[tuple, dict] = {}
+        self._k8s_eps: dict[tuple, dict] = {}
+        # Frontends currently programmed per service, for teardown of
+        # ports that disappear (reference: delK8sSVCs).
+        self._lb_frontends: dict[tuple, list[L3n4Addr]] = {}
 
     # -- lifecycle --------------------------------------------------------
 
@@ -91,8 +100,8 @@ class K8sWatcher:
                 self._handle_cnp(ev)
             elif ev.kind == api.KIND_ENDPOINTS:
                 self._handle_endpoints(ev)
-            # Services are consumed via Endpoints; Service objects carry
-            # metadata only for ToServices label matching.
+            elif ev.kind == api.KIND_SERVICE:
+                self._handle_service(ev)
         finally:
             if self._queue is not None:
                 try:
@@ -154,12 +163,8 @@ class K8sWatcher:
         meta = ev.obj.get("metadata") or {}
         ns = meta.get("namespace") or "default"
         name = meta.get("name", "")
-        ips = [
-            a.get("ip")
-            for subset in ev.obj.get("subsets") or []
-            for a in subset.get("addresses") or []
-            if a.get("ip")
-        ]
+        parsed_eps = _parse_endpoints(ev.obj)
+        ips = parsed_eps["ips"]
         svc = self.apiserver.get(api.KIND_SERVICE, ns, name) or {}
         svc_labels = (svc.get("metadata") or {}).get("labels") or {}
         repo = self.daemon.get_policy_repository()
@@ -183,3 +188,113 @@ class K8sWatcher:
             self._svc_backends[key] = ips
         if res is None or res.added_cidrs or res.removed_cidrs or old:
             self.daemon.trigger_policy_updates()
+
+        # Feed the load-balancer sync (reference: addK8sEndpointV1 ->
+        # addK8sSVCs with the stored service, k8s_watcher.go:945-1032).
+        if ev.type == api.DELETED:
+            self._k8s_eps.pop(key, None)
+        else:
+            self._k8s_eps[key] = parsed_eps
+        self._sync_lb(key)
+
+    # -- services -> load balancer ----------------------------------------
+
+    def _handle_service(self, ev: api.WatchEvent) -> None:
+        """reference: daemon/k8s_watcher.go:822 addK8sServiceV1 /
+        :858 update / :862 delete — stores the parsed service and
+        reconciles the LB maps against it."""
+        meta = ev.obj.get("metadata") or {}
+        key = (meta.get("namespace") or "default", meta.get("name", ""))
+        if ev.type == api.DELETED:
+            self._k8s_services.pop(key, None)
+        else:
+            self._k8s_services[key] = _parse_service(ev.obj)
+        self._sync_lb(key)
+
+    def _sync_lb(self, key: tuple) -> None:
+        """Reconcile the programmed frontends for one (ns, name)
+        against the current Service + Endpoints pair (reference:
+        addK8sSVCs/delK8sSVCs, k8s_watcher.go:1137,1196).  Headless
+        services (no clusterIP) program nothing."""
+        mgr = self.daemon.service_manager
+        svc = self._k8s_services.get(key)
+        eps = self._k8s_eps.get(key) or {"ips": [], "ports": {}}
+
+        desired: list[tuple[L3n4Addr, list[L3n4Addr]]] = []
+        if svc is not None and svc["frontend_ip"]:
+            seen_ports = set()
+            for p in svc["ports"]:
+                if p["port"] in seen_ports:  # reference: getUniqPorts
+                    continue
+                seen_ports.add(p["port"])
+                fe = L3n4Addr(
+                    svc["frontend_ip"], p["port"], p.get("protocol", "TCP")
+                )
+                be_port = eps["ports"].get(p["name"])
+                backends = []
+                if be_port is not None:
+                    backends = [
+                        L3n4Addr(ip, be_port[0], be_port[1])
+                        for ip in eps["ips"]
+                    ]
+                desired.append((fe, backends))
+
+        previous = {fe.key(): fe for fe in self._lb_frontends.get(key, [])}
+        desired_keys = {fe.key() for fe, _ in desired}
+        for fe_key, fe in previous.items():
+            if fe_key not in desired_keys:
+                mgr.delete_by_frontend(fe)
+        programmed = []
+        for fe, backends in desired:
+            try:
+                mgr.upsert(fe, backends)
+                programmed.append(fe)
+            except ServiceError:
+                log.exception("k8s service %s: LB programming failed", key)
+                # A frontend programmed by an earlier sync stays tracked
+                # even if this update failed — otherwise its map entries
+                # would leak past the Service's deletion.
+                if fe.key() in previous:
+                    programmed.append(previous[fe.key()])
+        if programmed:
+            self._lb_frontends[key] = programmed
+        else:
+            self._lb_frontends.pop(key, None)
+
+
+def _parse_service(obj: dict) -> dict:
+    """Parse a k8s Service into the LB-relevant fields (reference:
+    loadbalancer.K8sServiceInfo; 'None'/'' clusterIP = headless,
+    k8s_watcher.go:826 NewK8sServiceInfo IsHeadless)."""
+    spec = obj.get("spec") or {}
+    cluster_ip = spec.get("clusterIP") or ""
+    if cluster_ip in ("None", "none"):
+        cluster_ip = ""
+    ports = [
+        {
+            "name": p.get("name", ""),
+            "port": int(p["port"]),
+            "protocol": (p.get("protocol") or "TCP").upper(),
+        }
+        for p in spec.get("ports") or []
+        if p.get("port")
+    ]
+    return {"frontend_ip": cluster_ip, "ports": ports}
+
+
+def _parse_endpoints(obj: dict) -> dict:
+    """Parse k8s Endpoints into backend IPs + per-name ports
+    (reference: loadbalancer.K8sServiceEndpoint: BEIPs set + Ports
+    map keyed by port name)."""
+    ips: list[str] = []
+    ports: dict[str, tuple[int, str]] = {}
+    for subset in obj.get("subsets") or []:
+        for a in subset.get("addresses") or []:
+            if a.get("ip") and a["ip"] not in ips:
+                ips.append(a["ip"])
+        for p in subset.get("ports") or []:
+            if p.get("port"):
+                ports[p.get("name", "")] = (
+                    int(p["port"]), (p.get("protocol") or "TCP").upper()
+                )
+    return {"ips": ips, "ports": ports}
