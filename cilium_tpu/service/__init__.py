@@ -234,6 +234,21 @@ class ServiceManager:
                     f"frontend {frontend.key()}"
                 )
         with self._mutex:
+            # The datapath service key is (vip, port) without protocol —
+            # same as the reference's lb4_key (bpf/lib/common.h:427),
+            # where two services differing only in protocol would
+            # silently share one map slot.  Reject that instead of
+            # desyncing the manager from the map.
+            for other_key, other_id in self._by_frontend.items():
+                other = self._services[other_id].frontend
+                if (other.ip_int, other.port, other.family) == (
+                    frontend.ip_int, frontend.port, frontend.family
+                ) and other.protocol != frontend.protocol:
+                    raise ServiceError(
+                        f"frontend {frontend.key()} collides with "
+                        f"{other_key} (service {other_id}): the LB map "
+                        f"key has no protocol"
+                    )
             # Local cache first (reference: SVCMap in front of the
             # kvstore): the k8s endpoint-churn hot path must not pay a
             # kvstore lock + scan for a frontend whose ID is known.

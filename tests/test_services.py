@@ -130,6 +130,19 @@ def test_service_manager_programs_lbmap():
     assert not mgr.delete_by_id(svc_id)
 
 
+def test_service_manager_rejects_protocol_only_collision():
+    """The LB map key is (vip, port) without protocol (reference:
+    bpf lb4_key) — a second service differing only in protocol would
+    silently share the slot, so it is rejected."""
+    lb = LbMap()
+    mgr = ServiceManager(lb, LocalBackend())
+    mgr.upsert(L3n4Addr("10.0.0.1", 53, "TCP"), [L3n4Addr("10.1.0.1", 53)])
+    with pytest.raises(ServiceError):
+        mgr.upsert(L3n4Addr("10.0.0.1", 53, "UDP"), [L3n4Addr("10.1.0.2", 53)])
+    # Same protocol re-upsert still fine.
+    mgr.upsert(L3n4Addr("10.0.0.1", 53, "TCP"), [L3n4Addr("10.1.0.3", 53)])
+
+
 def test_service_manager_v6_and_family_mismatch():
     lb = LbMap()
     mgr = ServiceManager(lb, LocalBackend())
