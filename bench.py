@@ -291,8 +291,33 @@ def bench_kafka():
     remotes = np.ones((F,), np.int32)
     assert not batch.overflow.any()
 
-    fn = type(model).__call__  # dispatch style probed by _pipelined_rate
-    rate = _pipelined_rate(fn, (model, batch, remotes), F)
+    fn = type(model).__call__
+
+    # The kafka model is a tiny ACL-mask lookup — per-batch device time
+    # is far below both the per-call dispatch cost AND the ~120ms fence
+    # readback RTT of the tunneled chip, so plain call-marginal timing
+    # measures the HOST (r4's 36M-vs-144M mystery: 30-90% run-to-run
+    # swings; scaling data in BENCH_NOTES.md).  Fix both constants at
+    # once: K serially dependent model applications inside ONE jit call
+    # (each iteration's remotes depend on the previous verdicts, so
+    # XLA can neither hoist nor parallelize) make every call
+    # device-bound, and the adaptive marginal harness then cancels the
+    # fence RTT.  Cross-invocation variance <10% (BENCH_NOTES.md r5).
+    import jax.numpy as jnp
+
+    K = 256
+
+    def k_loop(model_, batch_, remotes_):
+        def body(_, carry):
+            acc, rem = carry
+            out = model_(batch_, rem)
+            return acc + out.astype(jnp.int32), jnp.where(out, rem, rem + 1)
+
+        return jax.lax.fori_loop(
+            0, K, body, (jnp.zeros(F, jnp.int32), remotes_)
+        )[0]
+
+    rate = _pipelined_rate(k_loop, (model, batch, remotes), F * K)
 
     n_cpu = 2000
     t0 = time.perf_counter()
@@ -839,7 +864,10 @@ def run_one(which: str) -> None:
     elif which == "kafka":
         rate, cpu = bench_kafka()
         _emit("kafka_l7_verdicts_per_sec_per_chip", rate, "verdicts/s",
-              rate / 1_000_000, cpu_oracle_per_sec=round(cpu))
+              rate / 1_000_000, cpu_oracle_per_sec=round(cpu),
+              method="compute-bound: 256 serially-dependent model "
+                     "applications per jit call + marginal-rate fence "
+                     "cancellation (BENCH_NOTES.md round 5)")
     elif which == "cassandra":
         rate, cpu = bench_cassandra()
         _emit("cassandra_l7_verdicts_per_sec_per_chip", rate, "verdicts/s",
@@ -1009,7 +1037,14 @@ def _load_prev_metrics() -> tuple[str, dict]:
         out[d["metric"]] = d["value"]
     parsed = rec.get("parsed")
     if isinstance(parsed, dict) and "metric" in parsed:
-        out[parsed["metric"]] = parsed["value"]
+        if parsed["metric"] == "bench_summary":
+            # Never store the aggregate under its own name — it would
+            # then be demanded as a "metric" by the vanished check.
+            for name, obj in (parsed.get("metrics") or {}).items():
+                out[name] = obj.get("value")
+        else:
+            out[parsed["metric"]] = parsed["value"]
+    out.pop("bench_summary", None)
     return files[-1], out
 
 

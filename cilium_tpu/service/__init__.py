@@ -213,6 +213,9 @@ class ServiceManager:
         # id -> LBSVC and frontend-key -> id (reference: SVCMapID + SVCMap)
         self._services: dict[int, LBService] = {}
         self._by_frontend: dict[str, int] = {}
+        # (ip_int, port, family) -> protocol, for the O(1) map-slot
+        # collision check (the LB map key carries no protocol).
+        self._slot_proto: dict[tuple, str] = {}
         self._mutex = threading.RLock()  # reference: BPFMapMU
 
     # -- core add/delete (reference: SVCAdd / svcAdd / svcDelete) ---------
@@ -239,16 +242,14 @@ class ServiceManager:
             # where two services differing only in protocol would
             # silently share one map slot.  Reject that instead of
             # desyncing the manager from the map.
-            for other_key, other_id in self._by_frontend.items():
-                other = self._services[other_id].frontend
-                if (other.ip_int, other.port, other.family) == (
-                    frontend.ip_int, frontend.port, frontend.family
-                ) and other.protocol != frontend.protocol:
-                    raise ServiceError(
-                        f"frontend {frontend.key()} collides with "
-                        f"{other_key} (service {other_id}): the LB map "
-                        f"key has no protocol"
-                    )
+            slot = (frontend.ip_int, frontend.port, frontend.family)
+            other_proto = self._slot_proto.get(slot)
+            if other_proto is not None and other_proto != frontend.protocol:
+                raise ServiceError(
+                    f"frontend {frontend.key()} collides with an "
+                    f"existing {other_proto} service on the same "
+                    f"VIP:port: the LB map key has no protocol"
+                )
             # Local cache first (reference: SVCMap in front of the
             # kvstore): the k8s endpoint-churn hot path must not pay a
             # kvstore lock + scan for a frontend whose ID is known.
@@ -273,6 +274,7 @@ class ServiceManager:
                 id=svc_id, frontend=frontend, backends=list(backends)
             )
             self._by_frontend[frontend.key()] = svc_id
+            self._slot_proto[slot] = frontend.protocol
             return svc_id, created
 
     def delete_by_id(self, id_: int) -> bool:
@@ -284,6 +286,10 @@ class ServiceManager:
             if svc is None:
                 return False
             self._by_frontend.pop(svc.frontend.key(), None)
+            self._slot_proto.pop(
+                (svc.frontend.ip_int, svc.frontend.port,
+                 svc.frontend.family), None,
+            )
             self.id_allocator.delete_id(id_)
             self._delete_from_map(svc.frontend)
             return True

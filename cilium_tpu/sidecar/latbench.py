@@ -558,7 +558,6 @@ def run(
         kw = dict(kw)
         kw["null_seam"] = True
         kw.setdefault("client_timeout_ms", 0.3)
-        kw.setdefault("client_batch", 2048)
         colocated = True  # median-of-5 + no device RTT measurement
         rtt_ms = 0.0
         uplink_mbps = 0.0
