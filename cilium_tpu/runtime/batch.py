@@ -83,6 +83,50 @@ class R2d2BatchEngine:
     def feed(self, flow_id: int, data: bytes, remote_id: int = 0, policy_name: str = "", **flow_kwargs) -> None:
         self.flow(flow_id, remote_id, policy_name, **flow_kwargs).buffer += data
 
+    # -- async round API (one readback per round) --------------------------
+    #
+    # CRLF framing is host-knowable, so frame extraction never needs the
+    # device — only the per-frame allow verdict does.  The service feeds
+    # every slow entry of a round through feed_extract, judges ALL
+    # extracted frames in one model call, and emits ops at completion
+    # time; the wave path's one-readback-per-pump (a ~100ms link RTT on
+    # the tunneled bench chip) collapses to one readback per round.
+
+    def feed_extract(
+        self, flow_id: int, data: bytes, remote_id: int = 0,
+        policy_name: str = "", **flow_kwargs,
+    ) -> list[tuple[bytes, int]]:
+        """Append data and drain every now-complete frame host-side.
+        Returns [(msg_bytes, msg_len)] completed by THIS feed, in
+        stream order.  Ops are NOT emitted here — the caller judges the
+        frames (batched across flows) and calls emit_frame per frame,
+        then finish_entry for MORE parity with pump()."""
+        st = self.flow(flow_id, remote_id, policy_name, **flow_kwargs)
+        st.buffer += data
+        frames: list[tuple[bytes, int]] = []
+        while True:
+            idx = st.buffer.find(b"\r\n")
+            if idx < 0:
+                break
+            msg_len = idx + 2
+            frames.append((bytes(st.buffer[:idx]), msg_len))
+            del st.buffer[:msg_len]
+        return frames
+
+    def emit_frame(self, flow_id: int, msg: bytes, msg_len: int,
+                   allow: bool) -> None:
+        """Ops for one frame already drained by feed_extract."""
+        self._emit(self.flows[flow_id], msg, allow, msg_len, drain=False)
+
+    def finish_entry(self, flow_id: int, more: bool) -> None:
+        """Trailing MORE — the same rule pump() applies per round.
+        ``more`` is the caller's decision CAPTURED AT FEED TIME
+        (frames completed or residue left), so a later round draining
+        the buffer cannot retroactively change this entry's ops."""
+        st = self.flows[flow_id]
+        if more and (not st.ops or st.ops[-1][0] != MORE):
+            st.ops.append((MORE, 1))
+
     def pump(self) -> None:
         """Run device steps until no flow has a complete frame; appends ops
         to each flow's op list."""
@@ -159,7 +203,8 @@ class R2d2BatchEngine:
             self._emit(st, bytes(st.buffer[: n - 2]), bool(allow[i]), n)
         return True
 
-    def _emit(self, st: FlowState, msg: bytes, allow: bool, msg_len: int) -> None:
+    def _emit(self, st: FlowState, msg: bytes, allow: bool, msg_len: int,
+              drain: bool = True) -> None:
         if self.logger is not None:
             fields = msg.decode("utf-8", "surrogateescape").split(" ")
             file_ = fields[1] if len(fields) == 2 else ""
@@ -182,7 +227,8 @@ class R2d2BatchEngine:
             room = st.inject_capacity - len(st.reply_inject)
             st.reply_inject += b"ERROR\r\n"[: max(room, 0)]
             st.ops.append((DROP, msg_len))
-        del st.buffer[:msg_len]
+        if drain:
+            del st.buffer[:msg_len]
 
     def take_ops(self, flow_id: int) -> tuple[list[tuple[OpType, int]], bytes]:
         st = self.flows[flow_id]

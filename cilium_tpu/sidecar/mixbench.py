@@ -1,0 +1,324 @@
+"""Mixed-path throughput benchmark: the slow/oracle paths under a
+realistic traffic mix.
+
+The headline throughput configs exercise only the vectorized fast path
+(single complete request-direction frames).  Real proxy traffic also
+carries partial frames (a frame split across reads — carried state),
+pipelined frames (several frames in one read), and reply-direction
+bytes — all of which the reference's in-process parser handles in the
+same code path (proxylib/proxylib/connection.go:118) but which this
+architecture routes through the batch engines' wave path and the
+in-process oracle.  This bench measures steady-state verdicts/s for a
+configurable mix and reports the per-path split, so a regression in
+the non-fast paths cannot hide behind the fast-path headline.
+
+Closed loop: W rounds in flight; each round is one DataBatch over the
+connection pool with the mix applied per-connection:
+  - fast conns:      one complete frame per round (entrywise fast path,
+                     one bucketed device call per round)
+  - partial conns:   frames split across two rounds (engine buffering,
+                     wave path; a verdict every second round)
+  - pipelined conns: two complete frames in one entry (wave path, two
+                     verdicts per round)
+  - reply conns:     request frame + reply-direction bytes (oracle /
+                     engine reply handling)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..proxylib.types import FilterResult
+from ..utils.option import DaemonConfig
+from . import wire
+from .client import SidecarClient
+from .service import VerdictService
+
+
+class MixBench:
+    def __init__(
+        self,
+        socket_path: str,
+        pool: int = 8192,
+        frac_partial: float = 0.10,
+        frac_pipelined: float = 0.05,
+        frac_reply: float = 0.05,
+        batch_flows: int = 8192,
+        verdict_device: str = "default",
+    ) -> None:
+        from cilium_tpu.proxylib import (
+            NetworkPolicy,
+            PortNetworkPolicy,
+            PortNetworkPolicyRule,
+        )
+
+        self.pool = pool
+        n_partial = int(pool * frac_partial)
+        n_pipe = int(pool * frac_pipelined)
+        n_reply = int(pool * frac_reply)
+        n_fast = pool - n_partial - n_pipe - n_reply
+        # Conn-id layout: [fast | partial | pipelined | reply]
+        self.n_fast, self.n_partial, self.n_pipe, self.n_reply = (
+            n_fast, n_partial, n_pipe, n_reply,
+        )
+
+        policy = NetworkPolicy(
+            name="mixbench",
+            policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(
+                    port=80,
+                    rules=[
+                        PortNetworkPolicyRule(
+                            l7_proto="r2d2",
+                            l7_rules=[
+                                {"cmd": "READ", "file": "/public/.*"},
+                                {"cmd": "HALT"},
+                            ],
+                        )
+                    ],
+                )
+            ],
+        )
+        # batch_timeout_ms > 0 selects the completion-pipeline mode
+        # (overlapped readbacks) — the right mode for a high-RTT device
+        # link; greedy/inline mode would serialize one readback per
+        # round.
+        cfg = DaemonConfig(
+            batch_flows=batch_flows,
+            batch_timeout_ms=0.25,
+            batch_width=64,
+            verdict_device=verdict_device,
+        )
+        self._policy = policy
+        self.service = VerdictService(socket_path, cfg).start()
+        self.client = SidecarClient(socket_path, timeout=600.0)
+        self.module = self.client.open_module([])
+        assert self.client.policy_update(self.module, [policy]) == int(
+            FilterResult.OK
+        )
+        for cid in range(1, pool + 1):
+            res, _ = self.client.new_connection(
+                self.module, "r2d2", cid, True, 1, 2,
+                "1.1.1.1:1", "2.2.2.2:80", "mixbench",
+            )
+            assert res == int(FilterResult.OK), res
+
+        # Frame corpus (mixed allow/deny), pre-padded to device rows so
+        # the per-round matrix build is numpy indexing, not Python.
+        rng = np.random.default_rng(11)
+        self.frames = []
+        for i in range(pool):
+            roll = rng.random()
+            if roll < 0.4:
+                self.frames.append(f"READ /public/f{i % 997}.txt\r\n".encode())
+            elif roll < 0.55:
+                self.frames.append(b"HALT\r\n")
+            else:
+                self.frames.append(f"READ /private/f{i % 997}\r\n".encode())
+        self.pool_rows = np.zeros((pool, 64), np.uint8)
+        self.pool_lens = np.zeros((pool,), np.uint32)
+        for i, f in enumerate(self.frames):
+            self.pool_rows[i, : len(f)] = np.frombuffer(f, np.uint8)
+            self.pool_lens[i] = len(f)
+
+    def _build_round(self, round_idx: int):
+        """One round = one complete-flag MATRIX batch (the fast conns —
+        the C++ edge owns framing and ships frames it completed as
+        kMsgDataMatrix complete=1, so they ride the vec path) plus one
+        DataBatch carrying everything the edge could NOT frame: partial
+        reads, pipelined reads, reply-direction bytes.  Returns
+        (matrix, data_batch, n_verdict_frames, split)."""
+        split = {"fast": 0, "partial": 0, "pipelined": 0, "reply": 0}
+        frames_done = 0
+        # fast conns -> matrix rows (pure numpy: pool indexing)
+        m_ids = np.arange(1, self.n_fast + 1, dtype=np.uint64)
+        sel = (np.arange(1, self.n_fast + 1) + round_idx) % self.pool
+        m_rows = self.pool_rows[sel]
+        m_lens = self.pool_lens[sel]
+        frames_done += self.n_fast
+        split["fast"] += self.n_fast
+
+        conn_ids: list[int] = []
+        flags: list[int] = []
+        chunks: list[bytes] = []
+        pos = self.n_fast
+        # partial: half a frame per round (verdict lands on odd rounds)
+        for k in range(self.n_partial):
+            cid = pos + k + 1
+            f = self.frames[(cid + (round_idx // 2)) % self.pool]
+            half = len(f) // 2
+            conn_ids.append(cid)
+            flags.append(0)
+            if round_idx % 2 == 0:
+                chunks.append(f[:half])
+            else:
+                chunks.append(f[half:])
+                frames_done += 1
+                split["partial"] += 1
+        pos += self.n_partial
+        # pipelined: two complete frames in one entry
+        for k in range(self.n_pipe):
+            cid = pos + k + 1
+            f1 = self.frames[(cid + round_idx) % self.pool]
+            f2 = self.frames[(cid + round_idx + 1) % self.pool]
+            conn_ids.append(cid)
+            flags.append(0)
+            chunks.append(f1 + f2)
+            frames_done += 2
+            split["pipelined"] += 2
+        pos += self.n_pipe
+        # reply-direction bytes (r2d2 reply: passed through the oracle/
+        # engine reply handling, one op stream per entry)
+        for k in range(self.n_reply):
+            cid = pos + k + 1
+            conn_ids.append(cid)
+            flags.append(wire.FLAG_REPLY)
+            chunks.append(b"OK\r\n")
+            frames_done += 1
+            split["reply"] += 1
+        lengths = np.array([len(c) for c in chunks], np.uint32)
+        matrix = (m_ids, m_lens, m_rows.tobytes())
+        data = (
+            np.array(conn_ids, np.uint64), np.array(flags, np.uint8),
+            lengths, b"".join(chunks),
+        )
+        return matrix, data, frames_done, split
+
+    def _send_round(self, seq: int, round_idx: int):
+        """Ship one round as (matrix seq, data seq+1); returns
+        (frames, split)."""
+        matrix, data, nf, split = self._build_round(round_idx)
+        m_ids, m_lens, m_rows = matrix
+        self.client.send_matrix(seq, 64, m_ids, m_lens, m_rows,
+                                complete=True)
+        ids, fl, lens, blob = data
+        self.client.send_batch(seq + 1, ids, fl, lens, blob)
+        return nf, split
+
+    def run(self, duration_s: float = 12.0, warmup_rounds: int = 4) -> dict:
+        recv_seqs: dict[int, float] = {}
+        evt = threading.Event()
+
+        def on_verdict(vb):
+            recv_seqs[vb.seq] = time.perf_counter()
+            evt.set()
+
+        self.client.verdict_callback = on_verdict
+
+        # Warmup (compiles every bucket the mix touches).
+        seq = 1
+        for r in range(warmup_rounds):
+            self._send_round(seq, r)
+            deadline = time.monotonic() + 600
+            while seq + 1 not in recv_seqs and time.monotonic() < deadline:
+                evt.wait(1.0)
+                evt.clear()
+            assert seq + 1 in recv_seqs, "warmup round lost"
+            seq += 2
+
+        # Timed closed loop, two rounds in flight (a round completes
+        # when BOTH its seqs answered).
+        t0 = time.perf_counter()
+        last_progress = time.monotonic()
+        frames_total = 0
+        split_total = {"fast": 0, "partial": 0, "pipelined": 0, "reply": 0}
+        inflight: dict[int, int] = {}  # matrix seq -> frame count
+        round_idx = warmup_rounds
+        rounds = 0
+        while time.perf_counter() - t0 < duration_s or inflight:
+            while (
+                len(inflight) < 2
+                and time.perf_counter() - t0 < duration_s
+            ):
+                nf, split = self._send_round(seq, round_idx)
+                inflight[seq] = nf
+                for k, v in split.items():
+                    split_total[k] += v
+                seq += 2
+                round_idx += 1
+                rounds += 1
+            done = [
+                s for s in inflight
+                if s in recv_seqs and s + 1 in recv_seqs
+            ]
+            for s in done:
+                frames_total += inflight.pop(s)
+                last_progress = time.monotonic()
+            if not done:
+                evt.wait(0.05)
+                evt.clear()
+                if time.monotonic() - last_progress > 120:
+                    raise TimeoutError(
+                        f"mixbench stalled: rounds {sorted(inflight)} "
+                        f"never answered"
+                    )
+        elapsed = time.perf_counter() - t0
+        self.client.verdict_callback = None
+        slow_frames = (
+            split_total["partial"] + split_total["pipelined"]
+            + split_total["reply"]
+        )
+        return {
+            "verdicts_per_sec": frames_total / elapsed,
+            "frames": frames_total,
+            "rounds": rounds,
+            "elapsed_s": elapsed,
+            "split": split_total,
+            "slow_fraction": slow_frames / max(
+                slow_frames + split_total["fast"], 1
+            ),
+        }
+
+    def oracle_rate(self, rounds: int = 6) -> float:
+        """The reference-architecture comparison point: the SAME mixed
+        entry stream fed through the ported in-process streaming parser
+        (reference: proxylib/proxylib/connection.go:118 handles
+        complete, partial, pipelined, and reply data in one code
+        path).  Frames/s on this host, single-threaded."""
+        from ..proxylib import instance as pl
+
+        mod = pl.open_module([], True)
+        ins = pl.find_instance(mod)
+        ins.policy_update([self._policy])
+        conns = {}
+        for cid in range(1, self.pool + 1):
+            res, conn = pl.on_new_connection(
+                mod, "r2d2", 1_000_000 + cid, True, 1, 2,
+                "1.1.1.1:1", "2.2.2.2:80", "mixbench",
+            )
+            conns[cid] = conn
+        frames_total = 0
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            matrix, data, nf, _split = self._build_round(r)
+            m_ids, m_lens, m_rows = matrix
+            rows = np.frombuffer(m_rows, np.uint8).reshape(-1, 64)
+            for k in range(len(m_ids)):
+                ops: list = []
+                c = conns[int(m_ids[k])]
+                c.on_data(
+                    False, False, [rows[k, : m_lens[k]].tobytes()], ops
+                )
+                c.reply_buf.take()
+            ids, fl, lens, blob = data
+            offs = np.concatenate(([0], np.cumsum(lens.astype(np.int64))))
+            for k in range(len(ids)):
+                ops = []
+                c = conns[int(ids[k])]
+                c.on_data(
+                    bool(fl[k] & wire.FLAG_REPLY), False,
+                    [blob[offs[k]:offs[k + 1]]], ops,
+                )
+                c.reply_buf.take()
+            frames_total += nf
+        elapsed = time.perf_counter() - t0
+        pl.close_module(mod)
+        return frames_total / elapsed
+
+    def close(self) -> None:
+        self.client.close()
+        self.service.stop()

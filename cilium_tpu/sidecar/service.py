@@ -222,6 +222,10 @@ class VerdictService:
         # ALL sends must then go inline (vec and entrywise) so per-conn
         # FIFO order is owned by one thread.
         self._inline_complete = self.config.batch_timeout_ms <= 0
+        # Conns with an issued-but-unfinished async entrywise round
+        # (refcounts; guarded by _lock).  Sync rounds touching them are
+        # deferred to the send thread — see _process_entrywise.
+        self._async_pending: dict[int, int] = {}
         # Cut-through telemetry (greedy mode): rounds processed directly
         # on the shim reader thread, skipping the dispatcher handoff.
         self.inline_batches = 0
@@ -455,8 +459,10 @@ class VerdictService:
                 self._engine_free.append(idx)
                 self._objs_cache = None
 
-    def _tab_mark(self, conn_id: int, sc: "_SidecarConn") -> None:
-        """Refresh the dirty flag from actual residual state."""
+    @staticmethod
+    def _conn_residual_dirty(conn_id: int, sc: "_SidecarConn") -> bool:
+        """The single definition of 'this conn holds residual state':
+        engine flow buffer(s), oracle buffers, or skip counts."""
         flow = sc.engine.flows.get(conn_id) if sc.engine is not None else None
         buffered = False
         if flow is not None:
@@ -464,13 +470,31 @@ class VerdictService:
                 buffered = bool(flow.buffer)
             else:  # device-assisted engines: per-direction buffers
                 buffered = bool(flow.bufs[False] or flow.bufs[True])
-        dirty = bool(
+        return bool(
             buffered
             or sc.bufs[False]
             or sc.bufs[True]
             or sc.skip[False]
             or sc.skip[True]
         )
+
+    def _tab_mark_many(self, pairs: list) -> None:
+        """Batch dirty-flag refresh: one lock acquisition for a whole
+        round's worth of conns instead of one per entry (the per-entry
+        variant measured ~1.6k lock trips per mixed round)."""
+        updates = [
+            (conn_id, 1 if self._conn_residual_dirty(conn_id, sc) else 0)
+            for conn_id, sc in pairs
+        ]
+        with self._lock:
+            size = self._tab_size
+            for conn_id, dirty in updates:
+                if conn_id < size:
+                    self._tab_dirty[conn_id] = dirty
+
+    def _tab_mark(self, conn_id: int, sc: "_SidecarConn") -> None:
+        """Refresh the dirty flag from actual residual state."""
+        dirty = self._conn_residual_dirty(conn_id, sc)
         # Write under the lock: _tab_ensure (new_connection, another
         # thread) reallocates the table arrays, and a lock-free store
         # could land in the discarded old array, leaving a stale-clean
@@ -1342,12 +1366,12 @@ class VerdictService:
             slots.acquire()
             recs = drain(recs)
             stop = any(r[0] == "stop" for r in recs)
-            futs = [
-                fut
-                for r in recs
-                if r[0] == "vec"
-                for fut, _, _, _ in r[1]
-            ]
+            futs = []
+            for r in recs:
+                if r[0] == "vec":
+                    futs.extend(fut for fut, _, _, _ in r[1])
+                elif r[0] == "entry2":
+                    futs.extend(r[1])
             if futs:
                 vals_f = pool.submit(readback, futs)
             else:
@@ -1404,6 +1428,12 @@ class VerdictService:
                             client.send_frames(
                                 wire.MSG_VERDICT_BATCH, frames
                             )
+                    elif r[0] == "entry2":
+                        _, rfuts, finish = r
+                        nf = len(rfuts)
+                        chunk = vals[vi : vi + nf]
+                        vi += nf  # before finish: a throw must not
+                        finish(chunk)  # misalign later records' slices
                     elif r[0] == "ready":
                         _, client, seq, entries = r
                         client.send_verdicts(seq, entries)
@@ -1508,28 +1538,237 @@ class VerdictService:
                     slow_conns.add(conn_id)
                     slow.append((key, i, sc, conn_id, reply, end_stream, data))
 
-        if fast:
-            self._run_fast(fast, responses)
-        self._run_slow_batched(slow, responses)
+        # Async round (completion-pipeline mode): when every slow entry
+        # is either CRLF-extractable (engine exposes feed_extract) or
+        # host-only work, the whole round issues its device calls
+        # without reading back — the completion loop batches the
+        # readbacks, overlapping the ~1-RTT device_get with the next
+        # round's dispatch exactly like the vec path.  The wave path's
+        # one-readback-per-pump (≈1 link RTT each) made mixed rounds
+        # RTT-serial: 10k verdicts/s through the tunnel vs the vec
+        # path's millions (see BENCH_NOTES round 5).
+        if not self._inline_complete and self._slow_async_eligible(slow):
+            fast_issued = self._issue_fast(fast) if fast else []
+            buckets, plan = self._issue_slow_async(slow, responses)
+            futs = [g[0] for g in fast_issued] + [b[0] for b in buckets]
+            pend = {conn_id for _k, _i, _sc, conn_id, *_ in plan}
+            if pend:
+                with self._lock:
+                    for cid in pend:
+                        self._async_pending[cid] = (
+                            self._async_pending.get(cid, 0) + 1
+                        )
 
-        # Emit one verdict batch per data item, in arrival order —
-        # through the completion queue so responses stay FIFO with any
-        # in-flight vec rounds.
-        for item in items:
-            _, client, batch = item
-            if self._inline_complete:
+            def finish(vals: list | None) -> None:
                 try:
-                    client.send_verdicts(batch.seq, responses[id(item)])
-                except Exception:  # noqa: BLE001 — client may be gone
-                    log.exception("verdict send failed")
-            else:
-                self._completions.put(
-                    ("ready", client, batch.seq, responses[id(item)])
-                )
+                    nf = len(fast_issued)
+                    self._finish_fast(
+                        fast_issued, responses,
+                        vals=(
+                            vals[:nf] if vals is not None else [None] * nf
+                        ),
+                    )
+                    self._finish_slow_async(
+                        buckets, plan, responses,
+                        vals=(
+                            vals[nf:] if vals is not None
+                            else [None] * len(buckets)
+                        ),
+                    )
+                    for item in items:
+                        _, client, batch = item
+                        try:
+                            client.send_verdicts(
+                                batch.seq, responses[id(item)]
+                            )
+                        except Exception:  # noqa: BLE001 — client gone
+                            log.exception("verdict send failed")
+                finally:
+                    if pend:
+                        with self._lock:
+                            for cid in pend:
+                                n = self._async_pending.get(cid, 1) - 1
+                                if n <= 0:
+                                    self._async_pending.pop(cid, None)
+                                else:
+                                    self._async_pending[cid] = n
 
-    def _run_fast(self, fast: list, responses: dict) -> None:
-        """Vectorized single-frame path: entries grouped per engine, one
-        device call per group, ops emitted from the verdict arrays."""
+            self._completions.put(("entry2", futs, finish))
+            return
+
+        # Sync fallback.  If any conn in this round has an UNFINISHED
+        # async round, its engine state (ops/inject) is still owed to
+        # the send thread's finish — running pump/take here would race
+        # it and interleave op attribution.  Defer the whole round to
+        # the completion queue (futs=[]): it executes on the send
+        # thread strictly AFTER the pending finish, preserving both
+        # state exclusivity and per-conn response order.
+        deferred = False
+        if not self._inline_complete and self._async_pending:
+            with self._lock:
+                pending_now = set(self._async_pending)
+            if pending_now:
+                round_conns = {rec[3] for rec in slow}
+                round_conns.update(rec[3] for rec in fast)
+                deferred = bool(round_conns & pending_now)
+
+        def run_sync_and_respond(_vals: list | None = None) -> None:
+            if fast:
+                self._run_fast(fast, responses)
+            self._run_slow_batched(slow, responses)
+            for item in items:
+                _, client, batch = item
+                if self._inline_complete or deferred:
+                    try:
+                        client.send_verdicts(batch.seq, responses[id(item)])
+                    except Exception:  # noqa: BLE001 — client may be gone
+                        log.exception("verdict send failed")
+                else:
+                    self._completions.put(
+                        ("ready", client, batch.seq, responses[id(item)])
+                    )
+
+        if deferred:
+            self._completions.put(("entry2", [], run_sync_and_respond))
+        else:
+            run_sync_and_respond()
+
+    @staticmethod
+    def _slow_async_eligible(slow: list) -> bool:
+        """True when no slow entry would need a synchronous device
+        readback: every entry either goes through feed_extract (CRLF
+        engines, request direction), a ConstVerdict engine (host-only
+        pump), or the host-only oracle parser."""
+        for _key, _i, sc, _conn_id, reply, end_stream, _data in slow:
+            engine = sc.engine
+            if engine is None:
+                continue  # oracle, host-only
+            if isinstance(engine.model, ConstVerdict):
+                continue  # pump() special-cases ConstVerdict host-side
+            if hasattr(engine, "feed_extract") and not reply and not end_stream:
+                continue  # extractable
+            if reply and not getattr(engine, "handles_reply", False):
+                continue  # oracle, host-only
+            return False  # engine pump path would read back synchronously
+        return True
+
+    def _issue_slow_async(self, slow: list, responses: dict):
+        """Issue half of the async slow path: feed every extractable
+        entry, collect its completed frames, batch ALL frames into one
+        model call per (engine, width) bucket — futures only.  Oracle
+        entries (host parsers) are computed right here.  Returns
+        (buckets, plan): buckets = [(allow_dev, metas, engine)] where
+        metas = [(plan_idx, msg, msg_len)], plan = per-entry records
+        for the finish half."""
+        plan = []  # (kind, key, i, sc, conn_id, frames | None)
+        by_group: dict[tuple, list] = {}  # (id(engine), width) -> metas
+        engines: dict[int, object] = {}
+        oracle_marks = []
+        for key, i, sc, conn_id, reply, end_stream, data in slow:
+            engine = sc.engine
+            extractable = (
+                engine is not None
+                and hasattr(engine, "feed_extract")
+                and not isinstance(engine.model, ConstVerdict)
+                and not reply
+                and not end_stream
+            )
+            if not extractable:
+                # ConstVerdict engines, oracle conns, reply, end_stream:
+                # all host-only here (see _slow_async_eligible).
+                responses[key][i] = self._run_slow(
+                    sc, conn_id, reply, end_stream, data
+                )
+                oracle_marks.append((conn_id, sc))
+                continue
+            conn = sc.conn
+            frames = engine.feed_extract(
+                conn_id, data, remote_id=conn.src_id,
+                policy_name=conn.policy_name, ingress=conn.ingress,
+                dst_id=conn.dst_id, src_addr=conn.src_addr,
+                dst_addr=conn.dst_addr,
+            )
+            # The MORE decision belongs to THIS entry's residue — decide
+            # it now, not at finish time, when a later round may already
+            # have drained or refilled the buffer.
+            flow = engine.flows.get(conn_id)
+            more = bool(frames) or bool(flow is not None and flow.buffer)
+            rec = (key, i, sc, conn_id, engine, more, [])
+            plan.append(rec)
+            engines[id(engine)] = engine
+            for msg, msg_len in frames:
+                w = self.config.batch_width
+                while msg_len > w:
+                    w *= 2
+                by_group.setdefault((id(engine), w), []).append(
+                    (rec, msg, msg_len)
+                )
+        buckets = []
+        for (eng_id, width), metas in sorted(by_group.items(),
+                                             key=lambda kv: kv[0][1]):
+            engine = engines[eng_id]
+            n = len(metas)
+            f_pad = self._min_bucket
+            while f_pad < n:
+                f_pad *= 2
+            data_m = np.zeros((f_pad, width), np.uint8)
+            lengths = np.zeros((f_pad,), np.int32)
+            remotes = np.zeros((f_pad,), np.int32)
+            for j, (rec, msg, msg_len) in enumerate(metas):
+                row = np.frombuffer(msg + b"\r\n", np.uint8)
+                data_m[j, : len(row)] = row
+                lengths[j] = msg_len
+                remotes[j] = rec[2].conn.src_id
+            _c, _m, allow = self._model_call(
+                engine.model, data_m, lengths, remotes
+            )
+            # Record each frame's (bucket, slot) so the finish half can
+            # emit ops in per-entry stream order.
+            bi = len(buckets)
+            for j, (rec, msg, msg_len) in enumerate(metas):
+                rec[6].append((bi, j, msg, msg_len))
+            buckets.append((allow, metas, engine))
+        if oracle_marks:
+            self._tab_mark_many(oracle_marks)
+        # Dirty flags for extract conns are written NOW, on the
+        # dispatcher thread, before the next round can be classified:
+        # a deferred mark would leave a stale-clean window in which a
+        # vec/matrix batch re-admits a conn holding half a frame.
+        # (Buffer state is final for this round — finish only drains
+        # ops/inject, never buffers.)
+        if plan:
+            self._tab_mark_many([(rec[3], rec[2]) for rec in plan])
+        return buckets, plan
+
+    def _finish_slow_async(self, buckets: list, plan: list,
+                           responses: dict, vals: list) -> None:
+        """Finish half: one readback per bucket (batched by the
+        completion loop via ``vals``), then per-entry op emission in
+        arrival order — MORE parity and inject draining identical to
+        the wave path's pump()/take_ops."""
+        allows = []
+        for bi, (allow_dev, metas, _engine) in enumerate(buckets):
+            v = vals[bi] if bi < len(vals) else None
+            if v is None:
+                try:
+                    allows.append(np.asarray(allow_dev))
+                except Exception:  # noqa: BLE001 — deny on device error
+                    log.exception("device readback failed")
+                    allows.append(np.zeros(len(metas), bool))
+            else:
+                allows.append(np.asarray(v))
+        for key, i, sc, conn_id, engine, more, slots in plan:
+            for bi, j, msg, msg_len in slots:
+                engine.emit_frame(
+                    conn_id, msg, msg_len, bool(allows[bi][j])
+                )
+            engine.finish_entry(conn_id, more)
+            responses[key][i] = self._take_engine(engine, conn_id, False)
+
+    def _issue_fast(self, fast: list) -> list:
+        """Vectorized single-frame path, issue half: entries grouped
+        per engine, one device call per group, futures kept — no
+        readback here.  Returns [(allow_dev, recs)]."""
         # Capture each record's engine ONCE at grouping: policy_update
         # rebinds sc.engine concurrently, and a re-read after grouping
         # could judge the group with a different engine's model.
@@ -1537,6 +1776,7 @@ class VerdictService:
         for rec in fast:
             eng = rec[2].engine
             groups.setdefault(id(eng), (eng, []))[1].append(rec)
+        issued = []
         for engine, recs in groups.values():
             n = len(recs)
             width = self.config.batch_width
@@ -1554,8 +1794,28 @@ class VerdictService:
             complete, msg_len, allow = self._model_call(
                 engine.model, data, lengths, remotes
             )
-            allow = np.asarray(allow)
-            denied = int(n - allow[:n].sum())
+            issued.append((allow, recs))
+        return issued
+
+    def _finish_fast(self, issued: list, responses: dict,
+                     vals: list | None = None) -> None:
+        """Readback + per-entry response build for _issue_fast groups.
+        ``vals`` carries pre-fetched values (completion-loop batched
+        device_get); None entries mean the readback failed → deny."""
+        for gi, (allow_dev, recs) in enumerate(issued):
+            n = len(recs)
+            if vals is not None:
+                v = vals[gi]
+                allow = (
+                    np.zeros(n, bool) if v is None else np.asarray(v)[:n]
+                )
+            else:
+                try:
+                    allow = np.asarray(allow_dev)[:n]
+                except Exception:  # noqa: BLE001 — deny on device error
+                    log.exception("device readback failed")
+                    allow = np.zeros(n, bool)
+            denied = int(n - allow.sum())
             self.fast_log.log_batch("r2d2", n, denied)
             for i, (key, idx, sc, conn_id, payload) in enumerate(recs):
                 if allow[i]:
@@ -1571,6 +1831,10 @@ class VerdictService:
                     b"",
                     inj,
                 )
+
+    def _run_fast(self, fast: list, responses: dict) -> None:
+        """Synchronous fast path (inline mode): issue + finish."""
+        self._finish_fast(self._issue_fast(fast), responses)
 
     def _run_slow_batched(self, slow: list, responses: dict) -> None:
         """Engine-backed slow entries are processed in WAVES: the nth
