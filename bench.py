@@ -426,14 +426,19 @@ STRESS_HTTP_POLICIES = 250
 STRESS_HTTP_RULES = 20
 STRESS_KAFKA_POLICIES = 50
 STRESS_KAFKA_RULES = 100
+STRESS_CASS_POLICIES = 50
+STRESS_CASS_RULES = 40
 STRESS_FLOWS = 1_000_000
 
 
-# Of the 20 rules per policy, this many are genuine regexes (character
+# Of the 20 rules per policy: this many are genuine regexes (character
 # classes mid-pattern) that the tiered compiler MUST route through the
 # automaton — the reference's normal case is a compiled regex per rule
-# (reference: envoy/cilium_network_policy.h:50-76 std::regex).
+# (reference: envoy/cilium_network_policy.h:50-76 std::regex) — and
+# STRESS_HTTP_NFA_RULES of them are patterns whose DFA exceeds the
+# 128-state int8 budget, forcing the dense-NFA tier to carry real load.
 STRESS_HTTP_REGEX_RULES = 6
+STRESS_HTTP_NFA_RULES = 2
 
 
 def _stress_regex_path(j: int) -> str:
@@ -447,20 +452,33 @@ def _stress_regex_path(j: int) -> str:
     return f"/g{j:02d}/[a-z0-9]+/item/.*"
 
 
+def _stress_nfa_path(j: int) -> str:
+    # The classic exponential-determinization shape (a|b)*a(a|b){7}:
+    # its minimal DFA must remember the last 8 symbols (2^8 = 256
+    # states > the 128-state int8 budget), so compile_automaton's
+    # 'auto' path MUST fall back to the dense NFA — these rules carry
+    # genuine NFA-tier load, not DFA load under another name.
+    tail = "(a|b)" * 7
+    return f"/n{j:02d}/(a|b)*a{tail}/x"
+
+
 def _stress_http_models():
-    """Per policy: 14 literal-prefix rules (tier 1) + 6 regex rules
-    (tier 2, automaton).  The regex rules share one path convention
-    across policies (a common production shape: many services, one API
-    path scheme), so the compiler deduplicates them into ONE shared
-    automaton evaluated over the flattened flow batch — per-policy
-    evaluation of an identical automaton would re-pay its cost 250×
-    in tiny kernels (measured 350k/s vs >1M/s deduplicated).  Verdict
-    semantics are exact rule-set union: any-literal-rule-allows OR
-    any-regex-rule-allows."""
+    """Per policy: 12 literal-prefix rules (tier 1) + 6 DFA-tier regex
+    rules + 2 NFA-tier regex rules (DFA state blowup).  The regex rules
+    share one path convention across policies (a common production
+    shape: many services, one API path scheme), so the compiler
+    deduplicates them into ONE shared automaton per tier evaluated over
+    the flattened flow batch — per-policy evaluation of an identical
+    automaton would re-pay its cost 250× in tiny kernels (measured
+    350k/s vs >1M/s deduplicated).  Verdict semantics are exact
+    rule-set union: any-literal OR any-DFA-regex OR any-NFA-regex."""
     from cilium_tpu.models.http import build_http_model
+    from cilium_tpu.ops.nfa import DeviceNfa
     from cilium_tpu.policy.api import PortRuleHTTP
 
-    n_lit = STRESS_HTTP_RULES - STRESS_HTTP_REGEX_RULES
+    n_lit = (
+        STRESS_HTTP_RULES - STRESS_HTTP_REGEX_RULES - STRESS_HTTP_NFA_RULES
+    )
     models = []
     for p in range(STRESS_HTTP_POLICIES):
         rules = [
@@ -481,8 +499,16 @@ def _stress_http_models():
     assert rx_model.line_nfa is not None, (
         "stress mix must exercise the automaton tier"
     )
+    nfa_rules = [
+        (frozenset(), PortRuleHTTP(method="GET", path=_stress_nfa_path(j)))
+        for j in range(STRESS_HTTP_NFA_RULES)
+    ]
+    nfa_model = build_http_model(nfa_rules, backend="auto")
+    assert isinstance(nfa_model.line_nfa, DeviceNfa), (
+        "DFA-blowup patterns must land on the dense NFA tier"
+    )
     tier = type(rx_model.line_nfa).__name__
-    return models, rx_model, (tier, STRESS_HTTP_REGEX_RULES)
+    return models, rx_model, nfa_model, (tier, STRESS_HTTP_REGEX_RULES)
 
 
 def bench_stress():
@@ -498,14 +524,30 @@ def bench_stress():
     )
     from cilium_tpu.policy.api import PortRuleKafka
 
+    from cilium_tpu.models.cassandra import (
+        build_cassandra_model,
+        cassandra_verdicts,
+        encode_cassandra_batch,
+    )
+    from cilium_tpu.proxylib import (
+        NetworkPolicy,
+        PortNetworkPolicy,
+        PortNetworkPolicyRule,
+    )
+    from cilium_tpu.proxylib.policy import compile_policy
+
     rng = random.Random(23)
     n_http_flows = STRESS_FLOWS // 2
-    n_kafka_flows = STRESS_FLOWS - n_http_flows
+    n_cass_flows = STRESS_FLOWS // 5
+    n_kafka_flows = STRESS_FLOWS - n_http_flows - n_cass_flows
     per_http = n_http_flows // STRESS_HTTP_POLICIES
     per_kafka = n_kafka_flows // STRESS_KAFKA_POLICIES
+    per_cass = n_cass_flows // STRESS_CASS_POLICIES
 
     t_build0 = time.perf_counter()
-    http_models, http_rx_model, (http_tier, _) = _stress_http_models()
+    http_models, http_rx_model, http_nfa_model, (http_tier, _) = (
+        _stress_http_models()
+    )
     kafka_rule_objs = []
     kafka_models = []
     for p in range(STRESS_KAFKA_POLICIES):
@@ -519,11 +561,45 @@ def bench_stress():
             rules.append(kr)
         kafka_rule_objs.append(rules)
         kafka_models.append(build_kafka_model([(frozenset(), r) for r in rules]))
+
+    # Cassandra policies: regex table rules (the reference's cassandra
+    # parser matches query_table with a compiled regex per rule,
+    # proxylib/cassandra/cassandraparser.go:605).  Rule TEXT is shared
+    # across all 50 policies (one schema convention), so ONE model
+    # serves the whole flattened flow batch — the same dedup the http
+    # regex tier uses (per-policy evaluation of an identical automaton
+    # would re-pay its cost 50× in small kernels).
+    def _cass_rule(j: int) -> dict:
+        return {
+            "query_action": "select" if j % 2 == 0 else "insert",
+            "query_table": f"^ks\\.(t{j:02d}|tmp{j:02d})[0-9]*$",
+        }
+
+    cass_rules = [_cass_rule(j) for j in range(STRESS_CASS_RULES)]
+    cass_pol = compile_policy(
+        NetworkPolicy(
+            name="cass",
+            policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(
+                    port=9042,
+                    rules=[
+                        PortNetworkPolicyRule(
+                            l7_proto="cassandra", l7_rules=cass_rules
+                        )
+                    ],
+                )
+            ],
+        )
+    )
+    cass_model = build_cassandra_model(cass_pol, ingress=True, port=9042)
     build_s = time.perf_counter() - t_build0
     print(
         f"bench stress: built {STRESS_HTTP_POLICIES}x{STRESS_HTTP_RULES} http"
-        f" ({http_tier}) + {STRESS_KAFKA_POLICIES}x"
-        f"{STRESS_KAFKA_RULES} kafka rule tables in {build_s:.1f}s",
+        f" ({http_tier} + {STRESS_HTTP_NFA_RULES} DeviceNfa) + "
+        f"{STRESS_KAFKA_POLICIES}x{STRESS_KAFKA_RULES} kafka + "
+        f"{STRESS_CASS_POLICIES}x{STRESS_CASS_RULES} cassandra-regex "
+        f"rule tables in {build_s:.1f}s",
         file=sys.stderr,
     )
 
@@ -536,29 +612,42 @@ def bench_stress():
     http_len = np.zeros((STRESS_HTTP_POLICIES, per_http), np.int32)
     http_labels = np.zeros((STRESS_HTTP_POLICIES, per_http), bool)
     http_sample = []  # (req_bytes, policy, label) for the re oracle
-    n_lit = STRESS_HTTP_RULES - STRESS_HTTP_REGEX_RULES
+    n_lit = (
+        STRESS_HTTP_RULES - STRESS_HTTP_REGEX_RULES - STRESS_HTTP_NFA_RULES
+    )
     for p in range(STRESS_HTTP_POLICIES):
         for i in range(per_http):
             roll = rng.random()
-            if roll < 0.35:  # literal-tier hit
+            if roll < 0.30:  # literal-tier hit
                 j = rng.randrange(n_lit)
                 method, path, ok = (
                     "GET", f"/svc{p:03d}/r{j:02d}/items/x{rng.randrange(1000)}",
                     True,
                 )
-            elif roll < 0.55:  # regex-tier hit: [a-z0-9]+ segment + /item/
+            elif roll < 0.47:  # regex-tier hit: [a-z0-9]+ segment + /item/
                 j = rng.randrange(STRESS_HTTP_REGEX_RULES)
                 seg = f"ab{rng.randrange(1000)}z"
                 method, path, ok = (
                     "GET", f"/g{j:02d}/{seg}/item/{rng.randrange(10)}",
                     True,
                 )
-            elif roll < 0.65:  # regex-tier miss: uppercase segment
+            elif roll < 0.55:  # regex-tier miss: uppercase segment
                 j = rng.randrange(STRESS_HTTP_REGEX_RULES)
                 method, path, ok = (
                     "GET", f"/g{j:02d}/ABC/item/1", False,
                 )
-            elif roll < 0.75:  # method miss
+            elif roll < 0.63:  # NFA-tier hit: 8th-from-last symbol 'a'
+                j = rng.randrange(STRESS_HTTP_NFA_RULES)
+                seg = (
+                    "ab" * rng.randrange(3) + "a"
+                    + "".join(rng.choice("ab") for _ in range(7))
+                )
+                method, path, ok = "GET", f"/n{j:02d}/{seg}/x", True
+            elif roll < 0.70:  # NFA-tier miss: 8th-from-last symbol 'b'
+                j = rng.randrange(STRESS_HTTP_NFA_RULES)
+                seg = "b" + "".join(rng.choice("ab") for _ in range(7))
+                method, path, ok = "GET", f"/n{j:02d}/{seg}/x", False
+            elif roll < 0.78:  # method miss
                 j = rng.randrange(n_lit)
                 method, path, ok = "POST", f"/svc{p:03d}/r{j:02d}/items/y", False
             elif roll < 0.9:  # unknown rule id
@@ -611,6 +700,39 @@ def bench_stress():
         lambda *xs: np.stack(xs), *kafka_parts
     )
 
+    # Cassandra flows: (action, table) tuples against the regex rules.
+    cass_labels = np.zeros((STRESS_CASS_POLICIES, per_cass), bool)
+    cass_parts = []
+    cass_samples = []  # (action, table, ok) for the re oracle
+    for p in range(STRESS_CASS_POLICIES):
+        tuples = []
+        for i in range(per_cass):
+            roll = rng.random()
+            j = rng.randrange(STRESS_CASS_RULES)
+            rule_action = "select" if j % 2 == 0 else "insert"
+            if roll < 0.45:  # rule hit (t or tmp variant, digit tail)
+                base = "t" if rng.random() < 0.7 else "tmp"
+                tail = str(rng.randrange(100)) if rng.random() < 0.6 else ""
+                action, table, ok = (
+                    rule_action, f"ks.{base}{j:02d}{tail}", True,
+                )
+            elif roll < 0.65:  # action miss on a covered table
+                action, table, ok = "update", f"ks.t{j:02d}", False
+            elif roll < 0.85:  # table miss: unknown table name
+                action, table, ok = rule_action, f"ks.x{j:02d}", False
+            else:  # keyspace miss
+                action, table, ok = rule_action, f"other.t{j:02d}", False
+            tuples.append((action, table, False))
+            cass_labels[p, i] = ok
+            if len(cass_samples) < 300 and i < 6:
+                cass_samples.append((action, table, ok))
+        data, alen, tlen, nq, overflow = encode_cassandra_batch(tuples)
+        assert not overflow.any()
+        cass_parts.append((data, alen, tlen, nq))
+    cass_stacked = tuple(
+        np.stack([part[k] for part in cass_parts]) for k in range(4)
+    )
+
     # Stack per-policy models into [P, ...] pytrees (shared shapes).
     import jax.numpy as jnp
 
@@ -622,6 +744,7 @@ def bench_stress():
     )
     rem_http = np.ones((STRESS_HTTP_POLICIES, per_http), np.int32)
     rem_kafka = np.ones((STRESS_KAFKA_POLICIES, per_kafka), np.int32)
+    rem_cass = np.ones((STRESS_CASS_POLICIES, per_cass), np.int32)
 
     # lax.map (not vmap) over policies: per-policy intermediates (the
     # [F, R, S*C] DFA joint, the [F, T, R, W] kafka topic compare) stay
@@ -640,10 +763,23 @@ def bench_stress():
             lambda args: http_verdicts(m, *args)[2], (ds, lns, rms)
         )
     )
+    # The NFA tier reuses http_rx_replay (same wrapper, jit retraces on
+    # the different model pytree).
     kafka_replay = jax.jit(
         lambda ms, bs, rms: jax.lax.map(
             lambda args: kafka_verdicts(args[0], args[1], args[2]),
             (ms, bs, rms),
+        )
+    )
+    # One SHARED cassandra model over the flattened flow batch (the
+    # rule text is policy-independent, so per-policy evaluation would
+    # re-pay the identical automaton 50× in small kernels — the same
+    # dedup the http regex tier uses), chunked like the http tiers.
+    CASS_CHUNKS = 50
+    cass_replay = jax.jit(
+        lambda m, ds, als, tls, nqs, rms: jax.lax.map(
+            lambda args: cassandra_verdicts(m, *args),
+            (ds, als, tls, nqs, rms),
         )
     )
 
@@ -657,29 +793,53 @@ def bench_stress():
     hr_flat = jax.device_put(rem_http.reshape(RX_CHUNKS, -1))
     kb = jax.tree_util.tree_map(jax.device_put, kafka_stacked)
     kr = jax.device_put(rem_kafka)
+    cb = tuple(
+        jax.device_put(
+            x.reshape((CASS_CHUNKS, -1) + x.shape[2:])
+        )
+        for x in cass_stacked
+    )
+    cr = jax.device_put(rem_cass.reshape(CASS_CHUNKS, -1))
 
     # --- warm (compile) the executables, then the timed replay
     np.asarray(http_replay(http_stack, hd, hl, hr))
     np.asarray(http_rx_replay(http_rx_model, hd_flat, hl_flat, hr_flat))
+    np.asarray(http_rx_replay(http_nfa_model, hd_flat, hl_flat, hr_flat))
     np.asarray(kafka_replay(kafka_stack, kb, kr))
+    np.asarray(cass_replay(cass_model, *cb, cr))
 
     t0 = time.perf_counter()
     http_allow = http_replay(http_stack, hd, hl, hr)
     http_rx_allow = http_rx_replay(
         http_rx_model, hd_flat, hl_flat, hr_flat
     )
+    http_nfa_allow = http_rx_replay(
+        http_nfa_model, hd_flat, hl_flat, hr_flat
+    )
     kafka_allow = kafka_replay(kafka_stack, kb, kr)
-    http_allow = np.asarray(http_allow) | np.asarray(http_rx_allow).reshape(
-        STRESS_HTTP_POLICIES, per_http
+    cass_allow = cass_replay(cass_model, *cb, cr)
+    http_allow = (
+        np.asarray(http_allow)
+        | np.asarray(http_rx_allow).reshape(
+            STRESS_HTTP_POLICIES, per_http
+        )
+        | np.asarray(http_nfa_allow).reshape(
+            STRESS_HTTP_POLICIES, per_http
+        )
     )
     kafka_allow = np.asarray(kafka_allow)
+    cass_allow = np.asarray(cass_allow).reshape(
+        STRESS_CASS_POLICIES, per_cass
+    )
     dt = time.perf_counter() - t0
-    n_total = n_http_flows + n_kafka_flows
+    n_total = n_http_flows + n_kafka_flows + n_cass_flows
     rate = n_total / dt
 
     # --- bit-check every verdict against the generation labels
-    mism = int((http_allow != http_labels).sum()) + int(
-        (kafka_allow != kafka_labels).sum()
+    mism = (
+        int((http_allow != http_labels).sum())
+        + int((kafka_allow != kafka_labels).sum())
+        + int((cass_allow != cass_labels).sum())
     )
     assert mism == 0, f"stress verdicts diverge from labels ({mism})"
 
@@ -689,9 +849,11 @@ def bench_stress():
     for req, p, ok in http_sample[:200]:
         head = req.split(b"\r\n\r\n")[0].decode()
         m, path, _ = head.split(" ", 2)
-        pats = [f"/svc{p:03d}/r{j:02d}/.*" for j in range(n_lit)] + [
-            _stress_regex_path(j) for j in range(STRESS_HTTP_REGEX_RULES)
-        ]
+        pats = (
+            [f"/svc{p:03d}/r{j:02d}/.*" for j in range(n_lit)]
+            + [_stress_regex_path(j) for j in range(STRESS_HTTP_REGEX_RULES)]
+            + [_stress_nfa_path(j) for j in range(STRESS_HTTP_NFA_RULES)]
+        )
         want = m == "GET" and any(_re.fullmatch(pt, path) for pt in pats)
         assert want == ok, f"http label oracle mismatch: {req!r}"
     for p, sample in kafka_samples[:10]:
@@ -700,13 +862,26 @@ def bench_stress():
             assert want == kafka_labels[p, i], (
                 f"kafka label oracle mismatch: {r!r}"
             )
+    for action, table, ok in cass_samples[:200]:
+        want = any(
+            (_cass_rule(j)["query_action"] == action)
+            and _re.search(_cass_rule(j)["query_table"], table)
+            for j in range(STRESS_CASS_RULES)
+        )
+        assert want == ok, f"cassandra label oracle mismatch: {action} {table}"
 
+    n_rules = (
+        STRESS_HTTP_POLICIES * STRESS_HTTP_RULES
+        + STRESS_KAFKA_POLICIES * STRESS_KAFKA_RULES
+        + STRESS_CASS_POLICIES * STRESS_CASS_RULES
+    )
     print(
-        f"bench stress: {n_total:,} flows / 10,000 rules in {dt:.2f}s "
+        f"bench stress: {n_total:,} flows / {n_rules:,} rules in {dt:.2f}s "
         f"-> {rate:,.0f} verdicts/s (http {n_http_flows:,} @ "
-        f"{STRESS_HTTP_POLICIES} policies incl {STRESS_HTTP_REGEX_RULES}"
-        f"/{STRESS_HTTP_RULES} {http_tier} regex rules, kafka "
-        f"{n_kafka_flows:,} @ {STRESS_KAFKA_POLICIES}), mismatches=0",
+        f"{STRESS_HTTP_POLICIES} policies incl {STRESS_HTTP_REGEX_RULES} "
+        f"{http_tier} + {STRESS_HTTP_NFA_RULES} DeviceNfa regex rules, "
+        f"kafka {n_kafka_flows:,} @ {STRESS_KAFKA_POLICIES}, cassandra-"
+        f"regex {n_cass_flows:,} @ {STRESS_CASS_POLICIES}), mismatches=0",
         file=sys.stderr,
     )
     return rate, dt, http_tier
@@ -1018,14 +1193,18 @@ def run_one(which: str) -> None:
             "stress_10k_rules_1m_flows_verdicts_per_sec", rate,
             "verdicts/s", rate / 1_000_000,
             rules=STRESS_HTTP_POLICIES * STRESS_HTTP_RULES
-            + STRESS_KAFKA_POLICIES * STRESS_KAFKA_RULES,
+            + STRESS_KAFKA_POLICIES * STRESS_KAFKA_RULES
+            + STRESS_CASS_POLICIES * STRESS_CASS_RULES,
             flows=STRESS_FLOWS, replay_seconds=round(dt, 2),
             http_tier_mix={
-                "literal_rules_per_policy":
-                    STRESS_HTTP_RULES - STRESS_HTTP_REGEX_RULES,
+                "literal_rules_per_policy": STRESS_HTTP_RULES
+                - STRESS_HTTP_REGEX_RULES - STRESS_HTTP_NFA_RULES,
                 "regex_rules_per_policy": STRESS_HTTP_REGEX_RULES,
+                "nfa_rules_per_policy": STRESS_HTTP_NFA_RULES,
                 "automaton": http_tier,
+                "nfa_automaton": "DeviceNfa",
             },
+            cassandra_regex_policies=STRESS_CASS_POLICIES,
         )
     elif which == "r2d2":
         rate, cpu = bench_r2d2()
