@@ -67,7 +67,9 @@ def main(argv=None) -> int:
     p.add_argument("--kvstore", default="local",
                    choices=["local", "file", "tcp"])
     p.add_argument("--kvstore-address", default="",
-                   help="host:port of the kvstore server (kvstore=tcp)")
+                   help="host:port of the kvstore server (kvstore=tcp); "
+                        "comma-separated failover list supported "
+                        "(primary,follower)")
     p.add_argument("--dry-mode", action="store_true",
                    help="skip device exports (reference: DryMode)")
     p.add_argument("--restore", action=argparse.BooleanOptionalAction,

@@ -24,7 +24,7 @@ from .backend import (
     Watcher,
 )
 from .local import FileBackend, LocalBackend
-from .net import KvstoreServer, NetBackend
+from .net import KvstoreFollower, KvstoreServer, NetBackend
 
 _default_client: Backend | None = None
 
@@ -57,6 +57,7 @@ __all__ = [
     "FileBackend",
     "KeyValueEvent",
     "KvstoreError",
+    "KvstoreFollower",
     "KvstoreServer",
     "LocalBackend",
     "LockError",

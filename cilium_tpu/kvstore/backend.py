@@ -32,6 +32,10 @@ class KeyValueEvent:
     typ: EventType
     key: str = ""
     value: bytes = b""
+    # True when the key is lease-owned by a live session at emit time
+    # (annotated by the networked server's watch pump; replicas use it
+    # to keep leased keys out of their durable snapshots).
+    lease: bool = False
 
 
 class Watcher:

@@ -205,6 +205,22 @@ class _Session:
             if ok:
                 self._claim(key, lease)
             return {"created": ok}
+        if op == "reclaim":
+            # Post-failover lease re-adoption: succeed only if the key
+            # still holds OUR bit-identical value AND no live session
+            # owns it (the replicated-ghost case).  The owner check and
+            # re-claim happen under the server mutex, so another
+            # session's create_only/_claim cannot be stolen from.
+            with self.server._mutex:
+                if self.server._lease_owner.get(key) is not None:
+                    return {"taken": False}
+                cur = b.get(key)
+                if cur != val:
+                    return {"taken": False}
+                b.set(key, val, lease=True)
+                self.server._lease_owner[key] = self
+                self.leased.add(key)
+            return {"taken": True}
         if op == "list_prefix":
             return {
                 "items": {k: v.hex() for k, v in b.list_prefix(key).items()}
@@ -260,12 +276,15 @@ class _Session:
             ev = w.next_event(timeout=0.2)
             if ev is None:
                 continue
+            with self.server._mutex:
+                leased = ev.key in self.server._lease_owner
             self.send({
                 "event": {
                     "wid": wid,
                     "type": ev.typ.value,
                     "key": ev.key,
                     "value": ev.value.hex(),
+                    "lease": leased,
                 }
             })
 
@@ -386,6 +405,104 @@ class KvstoreServer:
             s.cleanup()
 
 
+class KvstoreFollower(KvstoreServer):
+    """Snapshot-shipping replica: a full KvstoreServer whose store is
+    kept in sync from a primary over the primary's own watch protocol
+    (list_and_watch("") replays the complete snapshot, then streams
+    every mutation).  Clients list the follower after the primary in
+    their failover list; when the primary dies they redial here and
+    find the replicated state, re-claiming their leased keys on fresh
+    sessions (reference role: the second interchangeable networked
+    backend behind BackendOperations, pkg/kvstore/backend.go:86 —
+    etcd's replica durability without raft: last-write-wins, ordered
+    failover, no split-brain arbitration).
+
+    The follower serves reads AND writes from the start (its store is
+    a LocalBackend like the primary's); replication stops when the
+    primary dies and the follower simply continues as the store."""
+
+    def __init__(self, primary_address: str, host: str = "127.0.0.1",
+                 port: int = 0, backend: Backend | None = None,
+                 snapshot_path: str | None = None) -> None:
+        # Dial the primary BEFORE binding our own listener: a follower
+        # pointed at a dead/wrong primary must fail its constructor
+        # without leaking a live listening socket + accept thread.
+        self.primary_address = primary_address
+        self.synced = threading.Event()
+        self.replicating = True
+        self._repl_client = NetBackend(primary_address, timeout=5.0)
+        try:
+            self._repl_watch = self._repl_client.list_and_watch(
+                "replica", ""
+            )
+            super().__init__(host, port, backend=backend,
+                             snapshot_path=snapshot_path)
+        except Exception:
+            self._repl_client.close()
+            raise
+        self._repl_thread = threading.Thread(
+            target=self._replicate, daemon=True, name="kvstore-replica"
+        )
+        self._repl_thread.start()
+
+    def _replicate(self) -> None:
+        # Every snapshot replay (initial sync AND post-reconnect
+        # resubscription) ends in LIST_DONE; at that barrier the local
+        # store is pruned to the replayed key set, so deletions that
+        # happened while the stream was down — or stale keys restored
+        # from this follower's own snapshot file — cannot survive as
+        # resurrected state.  A key written directly to this follower
+        # inside a primary-blip window is pruned too: while the primary
+        # lives, it is authoritative (last-write-wins toward primary;
+        # no arbitration — see class docstring).
+        seen: set[str] = set()
+        last_gen = self._repl_client.reconnects
+        try:
+            for ev in self._repl_watch:
+                gen = self._repl_client.reconnects
+                if gen != last_gen:
+                    # Stream re-established: events from here are a
+                    # fresh snapshot replay — restart the seen set.
+                    last_gen = gen
+                    seen = set()
+                try:
+                    if ev.typ == EventType.LIST_DONE:
+                        for k in list(self.backend.list_prefix("")):
+                            if k not in seen:
+                                self.backend.delete(k)
+                        self.synced.set()
+                    elif ev.typ == EventType.DELETE:
+                        self.backend.delete(ev.key)
+                        seen.discard(ev.key)
+                    else:  # CREATE / MODIFY
+                        # lease-ness travels with the event: leased keys
+                        # stay out of a durable follower's snapshot file
+                        # (they die with their sessions; the owner
+                        # re-claims them after failover via 'reclaim').
+                        self.backend.set(ev.key, ev.value, lease=ev.lease)
+                        seen.add(ev.key)
+                except Exception:  # noqa: BLE001 — one bad apply must
+                    self.counters.inc("replica_apply_failed")  # not kill
+                    log.exception("replica apply failed: %s", ev.key)
+        except Exception:  # noqa: BLE001 — replica must not die noisily
+            self.counters.inc("replica_stream_failed")
+        finally:
+            # Stream ended: primary gone (or follower closing).  Keep
+            # serving — this store IS the surviving copy.
+            self.replicating = False
+
+    def close(self) -> None:
+        try:
+            self._repl_watch.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._repl_client.close()
+        except Exception:  # noqa: BLE001
+            pass
+        super().close()
+
+
 # ---------------------------------------------------------------------------
 # Client
 
@@ -426,14 +543,23 @@ class NetBackend(Backend):
 
     One socket per backend; a reader thread routes responses to waiting
     callers and watch events to their Watcher queues (so watches stay
-    live while requests block)."""
+    live while requests block).
+
+    ``address`` may be a comma-separated failover list
+    ("host1:port1,host2:port2"): the client connects to the first
+    reachable server and, on connection loss, walks the list in order
+    during reconnect — a primary + KvstoreFollower pair gives the
+    cluster store a survivable failure mode (reference: the etcd
+    client's endpoint list, pkg/kvstore/etcd.go config)."""
 
     def __init__(self, address: str, timeout: float = 10.0) -> None:
-        host, _, port = address.rpartition(":")
-        self.address = address
+        self.addresses = [a.strip() for a in address.split(",") if a.strip()]
+        if not self.addresses:
+            raise KvstoreError("no kvstore address given")
+        self.address = self.addresses[0]
         self.timeout = timeout
         self.counters = KvstoreCounters()
-        self.sock = socket.create_connection((host, int(port)), timeout=10.0)
+        self.sock = self._dial_any(first=True)
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = threading.Lock()
@@ -459,6 +585,34 @@ class NetBackend(Backend):
 
     # -- plumbing ----------------------------------------------------------
 
+    def _dial_any(self, first: bool = False) -> socket.socket:
+        """Connect to the first reachable address, starting from the
+        CURRENT one: after a failover, a blip must not silently fail
+        back to a restarted (possibly empty) primary while other
+        clients remain on the follower — sticking to the current
+        server keeps the fleet convergent (fail-back is an operator
+        action: restart clients with the primary first).  Records the
+        connected address in self.address."""
+        ordered = [self.address] + [
+            a for a in self.addresses if a != self.address
+        ]
+        last_err: Exception | None = None
+        for addr in ordered:
+            host, _, port = addr.rpartition(":")
+            try:
+                sock = socket.create_connection(
+                    (host, int(port)), timeout=10.0 if first else 2.0
+                )
+            except OSError as e:
+                last_err = e
+                continue
+            if addr != self.address:
+                self.counters.inc("client_failover")
+                log.warning("kvstore failover: %s -> %s", self.address, addr)
+            self.address = addr
+            return sock
+        raise KvstoreError(f"no kvstore server reachable: {last_err}")
+
     def _read_loop(self) -> None:
         # Capture this thread's session: a stale reader (superseded by a
         # reconnect) must neither recv from the NEW socket nor mark the
@@ -476,6 +630,7 @@ class NetBackend(Backend):
                         w.events.put(KeyValueEvent(
                             EventType(ev["type"]), ev["key"],
                             bytes.fromhex(ev["value"]),
+                            lease=bool(ev.get("lease")),
                         ))
                     continue
                 with self._mutex:
@@ -540,16 +695,15 @@ class NetBackend(Backend):
                 return False
             if self._generation != observed_gen:
                 return True  # someone else already reconnected
-            host, _, port = self.address.rpartition(":")
             delay = 0.05
             deadline = time.monotonic() + self.timeout
             while True:
                 try:
-                    sock = socket.create_connection(
-                        (host, int(port)), timeout=2.0
-                    )
+                    # Walks the failover list: a dead primary falls
+                    # through to the follower.
+                    sock = self._dial_any()
                     break
-                except OSError:
+                except KvstoreError:
                     if time.monotonic() + delay > deadline:
                         return False
                     time.sleep(delay)
@@ -590,6 +744,19 @@ class NetBackend(Backend):
                          "value": value.hex(), "lease": True}
                     )
                     if not r["created"]:
+                        # On a FOLLOWER after failover the key exists as
+                        # our own replicated ghost (no owning session).
+                        # The server-side reclaim atomically re-takes
+                        # lease ownership iff the value is bit-identical
+                        # AND no live session owns the key; anything
+                        # else means another client genuinely claimed
+                        # it — drop our stale claim.
+                        rr = self._request_once(
+                            {"op": "reclaim", "key": key,
+                             "value": value.hex()}
+                        )
+                        if rr.get("taken"):
+                            continue
                         log.warning(
                             "leased key %s re-claimed elsewhere; "
                             "dropping local claim", key,
