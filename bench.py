@@ -1112,17 +1112,27 @@ def run_one(which: str) -> None:
         # which bounds any honest p99 from below — p90/p95 and the
         # release-lateness split are emitted so the seam's own
         # contribution is auditable.
-        # The control first (VERDICT r4 weak #1): a null-seam echo —
-        # same socket, same framing, same generator, verdict replaced
-        # by an immediate constant.  Its percentiles ARE this host's
-        # environmental floor; (seam − null) is the
-        # architecture-attributable added latency judged vs 1ms.
-        null = bench_latency(null_seam=True)
-        n100k = next(r for r in null["rates"] if r.offered_rate == 100_000)
-        n1m = next(r for r in null["rates"] if r.offered_rate == 1_000_000)
-        lat = bench_latency(colocated=True)
-        r100k = next(r for r in lat["rates"] if r.offered_rate == 100_000)
-        r1m = next(r for r in lat["rates"] if r.offered_rate == 1_000_000)
+        # The control experiment (VERDICT r4 weak #1), PAIRED: each
+        # seam run executes adjacent in time to a null-seam run (same
+        # socket framing, same generator, verdict replaced by an
+        # immediate constant), and the architecture-attributable added
+        # p99 is the MEDIAN OF PER-PAIR (seam − null) DELTAS — pairing
+        # cancels the host's drifting stall rate the way the null
+        # server cancels the constant floor (unpaired blocks measured
+        # 0.77ms and 1.02ms an hour apart on identical code).
+        from cilium_tpu.sidecar import latbench
+
+        out = latbench.run_paired_colocated(
+            "/tmp/cilium_tpu_bench_lat_colo.sock"
+        )
+        r100k, n100k = out["seam_100k"], out["null_100k"]
+        r1m, n1m = out["seam_1m"], out["null_1m"]
+        print(
+            f"bench latency (colocated, paired): seam p99 "
+            f"{r100k.p99_ms:.2f}ms null p99 {n100k.p99_ms:.2f}ms "
+            f"delta(median of pairs) {out['delta_p99_ms']:.3f}ms",
+            file=sys.stderr,
+        )
         _emit(
             "sidecar_seam_added_p99_ms_colocated",
             r100k.added_p99_ms,
@@ -1132,31 +1142,34 @@ def run_one(which: str) -> None:
             p90_ms=round(r100k.p90_ms, 3),
             p99_ms=round(r100k.p99_ms, 3),
             achieved_rate=round(r100k.achieved_rate),
-            dispatch_mode=lat["dispatch_mode"],
+            dispatch_mode=out["dispatch_mode"],
             release_late_p50_ms=round(r100k.release_late_p50_ms, 3),
             release_late_p99_ms=round(r100k.release_late_p99_ms, 3),
-            p99_runs_100k=lat["p99_runs"].get(100_000, []),
-            os_noise=lat["os_noise"],
-            seam_stages_us=lat.get("seam_stages_us", {}),
+            p99_runs_100k=out["seam_p99_runs"],
+            os_noise=out["os_noise"],
+            seam_stages_us=out.get("seam_stages_us", {}),
             null_seam_p50_ms=round(n100k.p50_ms, 3),
             null_seam_p99_ms=round(n100k.p99_ms, 3),
-            null_p99_runs=null["p99_runs"].get(100_000, []),
+            null_p99_runs=out["null_p99_runs"],
         )
-        # Architecture-attributable latency: measured seam minus the
-        # measured environmental floor, at the same offered rate on the
-        # same host — the number the <1ms north star is judged against.
+        # The number the <1ms north star is judged against.  The score
+        # denominator floors at 0.25ms — a stall-struck window where
+        # the pair-median lands at/below zero must not score as
+        # infinitely good.
         _emit(
             "sidecar_seam_p99_minus_null_ms_colocated",
-            max(r100k.p99_ms - n100k.p99_ms, 0.0),
+            max(out["delta_p99_ms"], 0.0),
             "ms",
-            1.0 / max(r100k.p99_ms - n100k.p99_ms, 1e-9),
+            1.0 / max(out["delta_p99_ms"], 0.25),
+            pair_deltas_ms=out["pair_deltas_ms"],
             seam_p99_ms=round(r100k.p99_ms, 3),
             null_p99_ms=round(n100k.p99_ms, 3),
             seam_p50_ms=round(r100k.p50_ms, 3),
             null_p50_ms=round(n100k.p50_ms, 3),
         )
         # The 1M/s colocated point (VERDICT r4 missing #2: measured but
-        # never recorded before this round).
+        # never recorded before this round), paired with its own
+        # adjacent null run.
         _emit(
             "sidecar_seam_added_p99_ms_colocated_at_1M",
             r1m.added_p99_ms,

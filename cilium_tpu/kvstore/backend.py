@@ -25,6 +25,11 @@ class EventType(enum.Enum):
     MODIFY = "modify"
     DELETE = "delete"
     LIST_DONE = "listDone"
+    # Client-local marker (never sent on the wire): the connection was
+    # re-established and a fresh snapshot replay follows.  Delivered
+    # only to watchers that opted in via ``mark_resync`` — ordinary
+    # consumers never see it.
+    RESYNC = "resync"
 
 
 @dataclass
@@ -49,6 +54,11 @@ class Watcher:
         self.prefix = prefix
         self.events: "queue.Queue[KeyValueEvent]" = queue.Queue(maxsize=0)
         self._stopped = False
+        # Opt-in: receive a RESYNC marker event when the transport
+        # reconnects, BEFORE the fresh snapshot replay — consumers that
+        # reconcile against replays (the kvstore follower) need the
+        # boundary; everyone else stays oblivious.
+        self.mark_resync = False
 
     def stop(self) -> None:
         self._stopped = True

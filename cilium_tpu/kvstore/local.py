@@ -105,7 +105,8 @@ class LocalBackend(Backend):
                 self._leased.discard(key)
             self._emit(
                 KeyValueEvent(
-                    EventType.MODIFY if existed else EventType.CREATE, key, value
+                    EventType.MODIFY if existed else EventType.CREATE,
+                    key, value, lease=lease,
                 )
             )
 
@@ -133,7 +134,9 @@ class LocalBackend(Backend):
             self._data[key] = value
             if lease:
                 self._leased.add(key)
-            self._emit(KeyValueEvent(EventType.CREATE, key, value))
+            self._emit(
+                KeyValueEvent(EventType.CREATE, key, value, lease=lease)
+            )
         return True
 
     def create_if_exists(self, cond_key: str, key: str, value: bytes,
@@ -144,7 +147,9 @@ class LocalBackend(Backend):
             self._data[key] = value
             if lease:
                 self._leased.add(key)
-            self._emit(KeyValueEvent(EventType.CREATE, key, value))
+            self._emit(
+                KeyValueEvent(EventType.CREATE, key, value, lease=lease)
+            )
         return True
 
     def list_prefix(self, prefix: str) -> dict[str, bytes]:
@@ -164,7 +169,10 @@ class LocalBackend(Backend):
             # no live event can precede (and be overwritten by) the snapshot.
             for k, v in sorted(self._data.items()):
                 if k.startswith(prefix):
-                    w.events.put(KeyValueEvent(EventType.CREATE, k, v))
+                    w.events.put(KeyValueEvent(
+                        EventType.CREATE, k, v,
+                        lease=k in self._leased,
+                    ))
             w.events.put(KeyValueEvent(EventType.LIST_DONE))
             self._watchers.append(w)
         return w

@@ -177,13 +177,18 @@ class _Session:
         if op == "set":
             # lease-ness travels into the backend so a durable backend
             # excludes the key from its snapshot ATOMICALLY with the
-            # write (persistence happens on the mutation's emit).
-            b.set(key, val, lease=lease)
-            self._claim(key, lease)
+            # write (persistence happens on the mutation's emit).  The
+            # server mutex spans write + ownership record so 'reclaim'
+            # cannot interleave between them and double-assign a lease.
+            with self.server._mutex:
+                b.set(key, val, lease=lease)
+                self._claim_locked(key, lease)
             return {}
         if op == "delete":
-            b.delete(key)
-            self._disclaim(key)
+            with self.server._mutex:
+                b.delete(key)
+                self.server._lease_owner.pop(key, None)
+            self.leased.discard(key)
             return {}
         if op == "delete_prefix":
             b.delete_prefix(key)
@@ -196,14 +201,18 @@ class _Session:
             self.leased = {k for k in self.leased if not k.startswith(key)}
             return {}
         if op == "create_only":
-            ok = b.create_only(key, val, lease=lease)
-            if ok:
-                self._claim(key, lease)
+            with self.server._mutex:
+                ok = b.create_only(key, val, lease=lease)
+                if ok:
+                    self._claim_locked(key, lease)
             return {"created": ok}
         if op == "create_if_exists":
-            ok = b.create_if_exists(req["cond_key"], key, val, lease=lease)
-            if ok:
-                self._claim(key, lease)
+            with self.server._mutex:
+                ok = b.create_if_exists(
+                    req["cond_key"], key, val, lease=lease
+                )
+                if ok:
+                    self._claim_locked(key, lease)
             return {"created": ok}
         if op == "reclaim":
             # Post-failover lease re-adoption: succeed only if the key
@@ -252,39 +261,35 @@ class _Session:
             return {}
         raise KvstoreError(f"unknown kvstore op {op!r}")
 
-    def _claim(self, key: str, lease: bool) -> None:
-        """Record lease ownership: a later write by ANY session (leased
-        or not) re-associates the key, so an older session's death no
-        longer deletes it (etcd semantics: the latest PUT's lease —
-        or absence of one — wins).  Lease-ness is mirrored into the
-        backend's leased set so a durable backend excludes leased keys
-        from its snapshot (they die with their sessions)."""
-        with self.server._mutex:
-            if lease:
-                self.server._lease_owner[key] = self
-                self.leased.add(key)
-            else:
-                self.server._lease_owner.pop(key, None)
-
-    def _disclaim(self, key: str) -> None:
-        with self.server._mutex:
+    def _claim_locked(self, key: str, lease: bool) -> None:
+        """Record lease ownership — CALLER HOLDS server._mutex (the
+        claim must be atomic with the backend write or 'reclaim' can
+        interleave and double-assign).  A later write by ANY session
+        (leased or not) re-associates the key, so an older session's
+        death no longer deletes it (etcd semantics: the latest PUT's
+        lease — or absence of one — wins).  Lease-ness is mirrored into
+        the backend's leased set so a durable backend excludes leased
+        keys from its snapshot (they die with their sessions)."""
+        if lease:
+            self.server._lease_owner[key] = self
+            self.leased.add(key)
+        else:
             self.server._lease_owner.pop(key, None)
-        self.leased.discard(key)
 
     def _pump_watch(self, wid: int, w: Watcher) -> None:
         while not w.stopped and not self._dead:
             ev = w.next_event(timeout=0.2)
             if ev is None:
                 continue
-            with self.server._mutex:
-                leased = ev.key in self.server._lease_owner
+            # ev.lease was stamped ATOMICALLY with the mutation by the
+            # backend (a pump-time ownership lookup would race _claim).
             self.send({
                 "event": {
                     "wid": wid,
                     "type": ev.typ.value,
                     "key": ev.key,
                     "value": ev.value.hex(),
-                    "lease": leased,
+                    "lease": ev.lease,
                 }
             })
 
@@ -435,6 +440,10 @@ class KvstoreFollower(KvstoreServer):
             self._repl_watch = self._repl_client.list_and_watch(
                 "replica", ""
             )
+            # Reconnect boundaries must be visible: the prune-at-
+            # LIST_DONE reconciliation needs to know where a fresh
+            # snapshot replay starts.
+            self._repl_watch.mark_resync = True
             super().__init__(host, port, backend=backend,
                              snapshot_path=snapshot_path)
         except Exception:
@@ -456,15 +465,14 @@ class KvstoreFollower(KvstoreServer):
         # lives, it is authoritative (last-write-wins toward primary;
         # no arbitration — see class docstring).
         seen: set[str] = set()
-        last_gen = self._repl_client.reconnects
         try:
             for ev in self._repl_watch:
-                gen = self._repl_client.reconnects
-                if gen != last_gen:
-                    # Stream re-established: events from here are a
-                    # fresh snapshot replay — restart the seen set.
-                    last_gen = gen
+                if ev.typ == EventType.RESYNC:
+                    # Stream re-established: the marker was enqueued
+                    # BEFORE the fresh replay, so stale pre-blip events
+                    # are already behind us — restart the seen set.
                     seen = set()
+                    continue
                 try:
                     if ev.typ == EventType.LIST_DONE:
                         for k in list(self.backend.list_prefix("")):
@@ -735,6 +743,15 @@ class NetBackend(Backend):
                 with self._mutex:
                     leased = dict(self._leased)
                     specs = dict(self._watch_specs)
+                # RESYNC markers land BEFORE the re-subscriptions, so
+                # everything behind the marker in an opted-in watcher's
+                # queue is pre-blip and everything after it is the
+                # fresh snapshot replay — the follower's prune depends
+                # on this ordering.
+                for wid in specs:
+                    w = self._watchers.get(wid)
+                    if w is not None and w.mark_resync and not w.stopped:
+                        w.events.put(KeyValueEvent(EventType.RESYNC))
                 for key, value in leased.items():
                     # create_only: the old session's lease revocation may
                     # have let another client legitimately claim the key —
