@@ -1758,12 +1758,17 @@ class VerdictService:
             else:
                 allows.append(np.asarray(v))
         for key, i, sc, conn_id, engine, more, slots in plan:
-            for bi, j, msg, msg_len in slots:
-                engine.emit_frame(
-                    conn_id, msg, msg_len, bool(allows[bi][j])
-                )
-            engine.finish_entry(conn_id, more)
-            responses[key][i] = self._take_engine(engine, conn_id, False)
+            ops, inject = engine.settle_entry(
+                conn_id,
+                [
+                    (msg, msg_len, bool(allows[bi][j]))
+                    for bi, j, msg, msg_len in slots
+                ],
+                more,
+            )
+            responses[key][i] = self._entry_response(
+                conn_id, ops, b"", inject
+            )
 
     def _issue_fast(self, fast: list) -> list:
         """Vectorized single-frame path, issue half: entries grouped
@@ -1921,6 +1926,13 @@ class VerdictService:
         else:
             ops, inject = engine.take_ops(conn_id)
             inj_o, inj_r = b"", inject
+        return VerdictService._entry_response(conn_id, ops, inj_o, inj_r)
+
+    @staticmethod
+    def _entry_response(conn_id: int, ops, inj_o: bytes, inj_r: bytes):
+        """THE per-entry response tuple — the one definition shared by
+        the wave path (_take_engine) and the async path
+        (_finish_slow_async); they must never drift."""
         return (
             conn_id,
             int(FilterResult.OK),
