@@ -74,20 +74,30 @@ def _pipelined_rate(fn, args, batch_size):
         _fence(f(*args))
         probed.append((_timed_calls(f, args, 4), name, f))
     probed.sort(key=lambda t: t[0])
-    t, _name, f = probed[0]
-    # Grow the call count until the timed window dominates the constant
-    # fence/RTT terms, then report the marginal between the last two
-    # sizes (constant terms cancel).
-    n = 4
-    while t < 1.0 and n < 4096:
-        n2 = n * 4
-        t2 = _timed_calls(f, args, n2)
-        if t2 > max(1.0, 3 * t) or n2 >= 4096:
-            if t2 > t:
-                return batch_size * (n2 - n) / (t2 - t)
-            return batch_size * n2 / t2
-        n, t = n2, t2
-    return batch_size * n / t
+    _t0, _name, f = probed[0]
+
+    def marginal() -> float:
+        # Grow the call count until the timed window dominates the
+        # constant fence/RTT terms, then report the marginal between
+        # the last two sizes (constant terms cancel).
+        t = _timed_calls(f, args, 4)
+        n = 4
+        while t < 1.0 and n < 4096:
+            n2 = n * 4
+            t2 = _timed_calls(f, args, n2)
+            if t2 > max(1.0, 3 * t) or n2 >= 4096:
+                if t2 > t:
+                    return batch_size * (n2 - n) / (t2 - t)
+                return batch_size * n2 / t2
+            n, t = n2, t2
+        return batch_size * n / t
+
+    # Best of 2: a host/tunnel stall landing inside one marginal window
+    # only DEFLATES the rate (a 40x dip was observed once on the http
+    # config), so the larger of two independent windows is the honest
+    # de-noised reading — inflation artifacts are prevented separately
+    # (device-bound calls; see the kafka K-loop).
+    return max(marginal(), marginal())
 
 
 def _emit(metric, value, unit, vs_baseline, **extra):
