@@ -425,6 +425,207 @@ def bench_cassandra():
     return rate, cpu_rate
 
 
+def bench_memcached():
+    """Memcached (command/opcode, key) ACL on-chip — the only protocol
+    whose device rate had never been recorded (VERDICT r5 ask #5a).
+    Text+binary mix over key-prefix, key-exact and key-regex rules;
+    device verdicts cross-checked bit-identical against the in-process
+    MemcacheRule walk (reference: proxylib/memcached/parser.go:186)."""
+    from cilium_tpu.models.memcached import (
+        build_memcache_model,
+        encode_memcache_batch,
+        memcache_verdicts,
+    )
+    from cilium_tpu.proxylib import (
+        NetworkPolicy,
+        PortNetworkPolicy,
+        PortNetworkPolicyRule,
+    )
+    from cilium_tpu.proxylib.parsers.memcached import MemcacheMeta
+    from cilium_tpu.proxylib.policy import compile_policy
+
+    policy = compile_policy(
+        NetworkPolicy(
+            name="bench",
+            policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(
+                    port=11211,
+                    rules=[
+                        PortNetworkPolicyRule(
+                            l7_proto="memcache",
+                            l7_rules=[
+                                {"command": "get", "keyPrefix": "user:"},
+                                {"command": "set",
+                                 "keyRegex": "^sess:[0-9]+$"},
+                                {"command": "delete",
+                                 "keyExact": "the-key"},
+                            ],
+                        )
+                    ],
+                )
+            ],
+        )
+    )
+    model = build_memcache_model(policy, ingress=True, port=11211)
+
+    # (is_binary, opcode, command, keys): the steady-state single-key
+    # shapes, half allowed / half denied, text and binary both.
+    rng = random.Random(23)
+    tuples = []
+    for _ in range(1024):
+        kind = rng.randrange(6)
+        if kind == 0:
+            tuples.append((False, 0, "get", [b"user:%d" % rng.randrange(99)]))
+        elif kind == 1:
+            tuples.append((False, 0, "get", [b"admin:%d" % rng.randrange(99)]))
+        elif kind == 2:
+            tuples.append((False, 0, "set", [b"sess:%d" % rng.randrange(99)]))
+        elif kind == 3:
+            tuples.append((False, 0, "set", [b"sess:x%d" % rng.randrange(99)]))
+        elif kind == 4:
+            # binary get (opcode 0) / getq (9)
+            tuples.append((True, rng.choice([0, 9]),
+                           "", [b"user:%d" % rng.randrange(99)]))
+        else:
+            # binary set (opcode 1) — denied (rule is text+bin 'set'
+            # but key must match the sess regex)
+            tuples.append((True, 1, "", [b"sess:%d" % rng.randrange(99)]))
+
+    F = 65536
+    frames = [tuples[i % len(tuples)] for i in range(F)]
+    (key_data, key_len, has_key, is_binary, opcode, cmd_id,
+     overflow) = encode_memcache_batch(frames)
+    assert not overflow.any()
+    remotes = np.ones((F,), np.int32)
+
+    rate = _pipelined_rate(
+        memcache_verdicts,
+        (model, key_data, key_len, has_key, is_binary, opcode, cmd_id,
+         remotes),
+        F,
+    )
+
+    # CPU oracle: the per-request rule walk the device replaces.
+    n_cpu = 2000
+    metas = [
+        MemcacheMeta(command=("" if b else cmd), opcode=(op if b else -1),
+                     keys=list(keys))
+        for b, op, cmd, keys in tuples
+    ]
+    t0 = time.perf_counter()
+    oracle_allows = [
+        policy.matches(True, 11211, 1, metas[i % len(metas)])
+        for i in range(n_cpu)
+    ]
+    cpu_rate = n_cpu / (time.perf_counter() - t0)
+
+    dev = np.asarray(memcache_verdicts(
+        model, key_data, key_len, has_key, is_binary, opcode, cmd_id,
+        remotes,
+    ))
+    mism = sum(
+        1 for i in range(n_cpu) if bool(dev[i % F]) != oracle_allows[i]
+    )
+    assert mism == 0, f"memcached device verdicts diverge ({mism})"
+    print(f"bench memcached: tpu={rate:,.0f}/s cpu={cpu_rate:,.0f}/s "
+          f"mismatches=0/{n_cpu}", file=sys.stderr)
+    return rate, cpu_rate
+
+
+def bench_kvstore_failover(cycles: int = 5):
+    """Failover cost of the fenced cluster-state plane, measured
+    through the chaos proxy: steady client write rate, then a full
+    partition with the primary left alive; the outage is the wall time
+    from partition to the first write acknowledged by the promoted
+    follower.  Zero acknowledged writes may be lost each cycle (the
+    fencing contract, tests/test_kvstore_partition.py).
+
+    The outage sums heartbeat detection, reconnect budget, grace, and
+    JITTERED retry backoff — single runs swing well past the --check
+    guard's 10%; the reported figure is the MEDIAN of ``cycles``
+    independent failovers (spread recorded alongside)."""
+    from cilium_tpu.kvstore import (
+        ChaosProxy,
+        KvstoreFollower,
+        KvstoreServer,
+        NetBackend,
+    )
+
+    outages, steadies, total_acked = [], [], 0
+    for cycle in range(cycles):
+        primary = KvstoreServer()
+        chaos = ChaosProxy(primary.address)
+        follower = KvstoreFollower(
+            chaos.address, repl_timeout=1.0, failover_grace=0.1
+        )
+        assert follower.synced.wait(5.0)
+        client = NetBackend(
+            f"{chaos.address},{follower.address}", timeout=30.0
+        )
+        acked = {}
+        try:
+            n0 = 200
+            t0 = time.perf_counter()
+            for i in range(n0):
+                k, v = f"bench/pre/{i}", b"%d" % i
+                client.set(k, v)
+                acked[k] = v
+            steadies.append(n0 / (time.perf_counter() - t0))
+
+            # Quiesce: replication is ASYNC — a write acked by the
+            # primary in the instant before the cut lives only on the
+            # (fenced) old primary.  That lag window is the documented
+            # cost of quorum-free snapshot shipping (net.py
+            # docstring); the outage measurement cuts on a converged
+            # pair so the loss check below exercises the fencing
+            # contract, not the lag.
+            last = f"bench/pre/{n0 - 1}"
+            deadline = time.monotonic() + 10.0
+            while (follower.backend.get(last) != acked[last]
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert follower.backend.get(last) == acked[last], "repl stalled"
+
+            chaos.partition(reset_existing=True)
+            t_part = time.perf_counter()
+            # Blocks through redial + not_primary backoff + promotion.
+            client.set("bench/first-after", b"x")
+            outages.append(time.perf_counter() - t_part)
+            acked["bench/first-after"] = b"x"
+            assert follower.promoted.is_set()
+
+            for i in range(n0):
+                k, v = f"bench/post/{i}", b"%d" % i
+                client.set(k, v)
+                acked[k] = v
+
+            lost = [
+                k for k, v in acked.items()
+                if follower.backend.get(k) != v
+            ]
+            assert not lost, (
+                f"cycle {cycle}: acked writes lost: {lost[:5]}"
+            )
+            total_acked += len(acked)
+        finally:
+            client.close()
+            follower.close()
+            chaos.close()
+            primary.close()
+
+    outages.sort()
+    median = outages[len(outages) // 2]
+    steady = sorted(steadies)[len(steadies) // 2]
+    print(
+        f"bench kvstore failover: outage median={median:.3f}s "
+        f"(min={outages[0]:.3f} max={outages[-1]:.3f}, n={cycles}) "
+        f"steady={steady:,.0f} writes/s acked={total_acked} lost=0",
+        file=sys.stderr,
+    )
+    return median, outages, steady, total_acked
+
+
 # --- config 5: 10k-rule / 1M-flow stress ---------------------------------
 
 # 250 HTTP policies x 20 rules + 50 Kafka policies x 100 rules = 10,000
@@ -1059,9 +1260,11 @@ def bench_mixed():
         f"in-process oracle={out['oracle_per_sec']:,.0f}/s)",
         file=sys.stderr,
     )
-    # Floor: an order-of-magnitude collapse of the slow paths must fail
-    # the bench outright (the 10% --check guard handles drift).
-    assert out["verdicts_per_sec"] >= 50_000, out["verdicts_per_sec"]
+    # Floor at the measured r05 level (122k): a regression of the slow
+    # paths must fail the bench outright, not hide under a floor set
+    # 2.4x below what the path actually does (the 10% --check guard
+    # handles drift on top).
+    assert out["verdicts_per_sec"] >= 110_000, out["verdicts_per_sec"]
     return out
 
 
@@ -1084,6 +1287,21 @@ def run_one(which: str) -> None:
         rate, cpu = bench_cassandra()
         _emit("cassandra_l7_verdicts_per_sec_per_chip", rate, "verdicts/s",
               rate / 1_000_000, cpu_oracle_per_sec=round(cpu))
+    elif which == "memcached":
+        rate, cpu = bench_memcached()
+        _emit("memcached_l7_verdicts_per_sec_per_chip", rate, "verdicts/s",
+              rate / 1_000_000, cpu_oracle_per_sec=round(cpu))
+    elif which == "kvstore_failover":
+        median, outages, steady, n_acked = bench_kvstore_failover()
+        # Smaller is better; vs_baseline floors at 0.1s so a lucky
+        # sub-100ms failover cannot score as infinitely good.
+        _emit(
+            "kvstore_failover_write_outage_s", median, "s",
+            1.0 / max(median, 0.1),
+            outages_s=[round(o, 3) for o in outages],
+            steady_writes_per_sec=round(steady),
+            acked_writes=n_acked, lost_writes=0,
+        )
     elif which == "latency":
         lat = bench_latency()
         # The 1M/s point is the north-star latency config; vs_baseline
@@ -1239,54 +1457,95 @@ def run_one(which: str) -> None:
 
 # Headline (r2d2) runs LAST so its JSON line is the final stdout line.
 CONFIGS = (
-    "http", "kafka", "cassandra", "latency", "latency_colocated",
-    "mixed", "datapath", "stress", "r2d2",
+    "http", "kafka", "cassandra", "memcached", "latency",
+    "latency_colocated", "mixed", "datapath", "stress",
+    "kvstore_failover", "r2d2",
 )
 
 
+def _round_of(path: str) -> int:
+    import re
+
+    m = re.search(r"_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def _summary_value(obj):
+    """bench_summary 'metrics' values: plain numbers since r06, full
+    metric objects before — accept both."""
+    if isinstance(obj, dict):
+        return obj.get("value")
+    return obj
+
+
 def _load_prev_metrics() -> tuple[str, dict]:
-    """Metric values from the newest BENCH_r*.json (the driver records
-    the run's stdout tail there); ('', {}) when none exists."""
+    """Metric values of the previous round for the drift guard.
+
+    Two sources, merged: the committed BENCH_FULL_rNN.json (written by
+    this script — complete by construction) and the driver's
+    BENCH_rNN.json stdout tail (which historically truncated away all
+    but the last lines, starving the guard).  The committed file wins
+    whenever its round is at least as new; the tail still contributes
+    anything the full record predates.  ('', {}) when neither exists.
+    """
     import glob
 
-    files = sorted(glob.glob("BENCH_r*.json"))
-    if not files:
-        return "", {}
-    try:
-        rec = json.load(open(files[-1]))
-    except (OSError, ValueError):
-        return files[-1], {}
-    out = {}
-    # Full-line parse (not a lazy regex): metric lines carry nested
-    # objects (e.g. the stress http_tier_mix), which a non-greedy
-    # \{.*?\} would truncate at the first inner brace.
-    for line in rec.get("tail", "").splitlines():
-        line = line.strip()
-        if not line.startswith('{"metric"'):
-            continue
+    out: dict = {}
+    tail_files = sorted(glob.glob("BENCH_r*.json"), key=_round_of)
+    full_files = sorted(glob.glob("BENCH_FULL_r*.json"), key=_round_of)
+    prev_file = ""
+
+    if tail_files:
+        prev_file = tail_files[-1]
         try:
-            d = json.loads(line)
-        except ValueError:
-            continue
-        if d["metric"] == "bench_summary":
-            # The truncation-proof aggregate: every metric of that run
-            # in one line (emitted last so the driver's tail always
-            # keeps it).
-            for name, obj in (d.get("metrics") or {}).items():
-                out[name] = obj.get("value")
-            continue
-        out[d["metric"]] = d["value"]
-    parsed = rec.get("parsed")
-    if isinstance(parsed, dict) and "metric" in parsed:
-        if parsed["metric"] == "bench_summary":
-            # Never store the aggregate under its own name — it would
-            # then be demanded as a "metric" by the vanished check.
-            for name, obj in (parsed.get("metrics") or {}).items():
-                out[name] = obj.get("value")
-        else:
-            out[parsed["metric"]] = parsed["value"]
+            rec = json.load(open(tail_files[-1]))
+        except (OSError, ValueError):
+            rec = {}
+        # Full-line parse (not a lazy regex): metric lines carry nested
+        # objects (e.g. the stress http_tier_mix), which a non-greedy
+        # \{.*?\} would truncate at the first inner brace.
+        for line in rec.get("tail", "").splitlines():
+            line = line.strip()
+            if not line.startswith('{"metric"'):
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if d["metric"] == "bench_summary":
+                # The truncation-proof aggregate: every metric of that
+                # run in one line.
+                for name, obj in (d.get("metrics") or {}).items():
+                    out[name] = _summary_value(obj)
+                continue
+            out[d["metric"]] = d["value"]
+        parsed = rec.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            if parsed["metric"] == "bench_summary":
+                # Never store the aggregate under its own name — it
+                # would then be demanded as a "metric" by the vanished
+                # check.
+                for name, obj in (parsed.get("metrics") or {}).items():
+                    out[name] = _summary_value(obj)
+            else:
+                out[parsed["metric"]] = parsed["value"]
+
+    if full_files and (
+        not tail_files
+        or _round_of(full_files[-1]) >= _round_of(tail_files[-1])
+    ):
+        try:
+            full = json.load(open(full_files[-1]))
+        except (OSError, ValueError):
+            full = {}
+        for name, obj in (full.get("metrics") or {}).items():
+            v = obj.get("value") if isinstance(obj, dict) else obj
+            if v is not None:
+                out[name] = v
+        prev_file = full_files[-1]
+
     out.pop("bench_summary", None)
-    return files[-1], out
+    return prev_file, out
 
 
 def _rebaselined() -> set:
@@ -1303,11 +1562,16 @@ def _rebaselined() -> set:
     }
 
 
-def _check_regressions(lines: list[str]) -> int:
+def _check_regressions(lines: list[str],
+                       prev_file: str | None = None,
+                       prev: dict | None = None) -> int:
     """Regression guard: fail (rc 1) when any metric this run dropped
-    >10% below the previous BENCH_r*.json without a documented
-    rebaseline in BENCH_NOTES.md."""
-    prev_file, prev = _load_prev_metrics()
+    >10% below the previous round without a documented rebaseline in
+    BENCH_NOTES.md.  main() preloads (prev_file, prev) BEFORE writing
+    this run's own BENCH_FULL record — loading here afterwards would
+    compare the run against itself and pass everything."""
+    if prev is None:
+        prev_file, prev = _load_prev_metrics()
     if not prev:
         print("bench --check: no previous BENCH_r*.json; nothing to compare",
               file=sys.stderr)
@@ -1317,7 +1581,8 @@ def _check_regressions(lines: list[str]) -> int:
     smaller_better = {"sidecar_added_latency_p99_ms_at_1M",
                       "sidecar_seam_added_p99_ms_colocated",
                       "sidecar_seam_added_p99_ms_colocated_at_1M",
-                      "sidecar_seam_p99_minus_null_ms_colocated"}
+                      "sidecar_seam_p99_minus_null_ms_colocated",
+                      "kvstore_failover_write_outage_s"}
     rc = 0
     seen: set = set()
     for line in lines:
@@ -1393,11 +1658,17 @@ def main():
         sys.stdout.flush()
         emitted.extend(proc.stdout.splitlines())
 
-    # Truncation-proof record: the driver keeps only the TAIL of this
-    # run's stdout, which in round 4 silently dropped the earlier
-    # metric lines from BENCH_r04.json.  One aggregate line near the
-    # end carries every metric; the headline r2d2 line is re-emitted
-    # last so the driver's single-line parse still lands on it.
+    # Truncation-proof record, two layers (VERDICT r5 ask #3 — the r5
+    # run again lost 10 of 11 metrics to the driver's 2,000-char tail
+    # because the aggregate carried FULL objects and blew past it):
+    #   1. bench_summary is metric→value pairs ONLY (~400 chars for 11
+    #      metrics), emitted SECOND-TO-LAST so the tail always keeps
+    #      it; the headline r2d2 line stays last for the driver's
+    #      single-line parse.
+    #   2. The full objects (runs arrays, pair deltas, splits) go to a
+    #      committed BENCH_FULL_rNN.json, which _load_prev_metrics
+    #      prefers — the >10% drift guard covers every metric even if
+    #      the tail is truncated to nothing.
     metrics: dict[str, dict] = {}
     headline = None
     for line in emitted:
@@ -1409,12 +1680,27 @@ def main():
             metrics[d["metric"]] = d
             if d["metric"] == "r2d2_l7_verdicts_per_sec_per_chip":
                 headline = line
+    import glob
+
+    # Snapshot the PREVIOUS round's metrics before this run's full
+    # record lands on disk and becomes the newest candidate.
+    prev_file, prev = _load_prev_metrics()
+    round_no = 1 + max(
+        [_round_of(f) for f in glob.glob("BENCH_r*.json")] or [0]
+    )
+    full_path = f"BENCH_FULL_r{round_no:02d}.json"
+    with open(full_path, "w") as f:
+        json.dump({"round": round_no, "metrics": metrics}, f, indent=1)
+    print(f"bench: full record -> {full_path}", file=sys.stderr)
     summary = {
         "metric": "bench_summary",
         "value": len(metrics),
         "unit": "metrics",
         "vs_baseline": 1.0,
-        "metrics": metrics,
+        "full_record": full_path,
+        "metrics": {
+            name: d.get("value") for name, d in metrics.items()
+        },
     }
     print(json.dumps(summary))
     emitted.append(json.dumps(summary))
@@ -1422,7 +1708,7 @@ def main():
         print(headline)
     sys.stdout.flush()
     if args.check:
-        sys.exit(_check_regressions(emitted))
+        sys.exit(_check_regressions(emitted, prev_file, prev))
 
 
 if __name__ == "__main__":

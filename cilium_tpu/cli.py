@@ -359,6 +359,39 @@ def cmd_kvstore_delete(args):
     return _run_kvstore(args, go)
 
 
+def cmd_kvstore_status(args):
+    """Fencing/arbitration view of one store server: role, epoch,
+    whether it has been fenced by a newer primary, and the failure
+    counters on both ends (reference: cilium kvstore + the etcd
+    cluster-health probes in `cilium status --all-health`)."""
+
+    def go(b):
+        info = b.server_info()
+        if args.json:
+            print(json.dumps(info, indent=2))
+            return 0
+        fenced = (
+            f"FENCED by epoch {info['fenced_by']}" if info["fenced"]
+            else "writable" if info["role"] == "primary"
+            else "read-only (replicating)"
+        )
+        print(f"{info['address']}: role={info['role']} "
+              f"epoch={info['epoch']} {fenced}")
+        print(f"backend: {info['backend']}")
+        if info["replicating"]:
+            print("replication: streaming from primary")
+        for side in ("server", "client"):
+            counters = info[f"{side}_counters"]
+            if counters:
+                joined = " ".join(
+                    f"{k}={v}" for k, v in sorted(counters.items())
+                )
+                print(f"{side} counters: {joined}")
+        return 0
+
+    return _run_kvstore(args, go)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cilium-tpu",
@@ -510,6 +543,13 @@ def build_parser() -> argparse.ArgumentParser:
         x.add_argument("--address", required=True,
                        help="kvstore server host:port")
         x.set_defaults(fn=fn)
+    x = kv.add_parser(
+        "status", help="store role/epoch/fencing state + counters"
+    )
+    x.add_argument("--address", required=True,
+                   help="kvstore server host:port")
+    x.add_argument("--json", action="store_true")
+    x.set_defaults(fn=cmd_kvstore_status)
 
     x = sub.add_parser("version")
     x.set_defaults(fn=cmd_version)

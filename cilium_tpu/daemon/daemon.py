@@ -32,10 +32,20 @@ from ..ipcache import (
     KvstoreIPSync,
     datapath_listener,
 )
-from ..kvstore import FileBackend, LocalBackend, NetBackend, setup_client
+from ..kvstore import (
+    FileBackend,
+    KvstoreError,
+    LocalBackend,
+    LockError,
+    NetBackend,
+    setup_client,
+)
+from ..kvstore.allocator import AllocatorError
 from ..labels import Labels, LabelArray
 from ..maps import CtMap, IpcacheMap, LbMap, MetricsMap
 from ..monitor import (
+    AGENT_NOTIFY_KVSTORE_DEGRADED,
+    AGENT_NOTIFY_KVSTORE_RESTORED,
     AGENT_NOTIFY_POLICY_UPDATED,
     AGENT_NOTIFY_START,
     Monitor,
@@ -47,6 +57,8 @@ from ..utils.controller import ControllerManager, ControllerParams
 from ..utils.logging import get_logger
 from ..utils.metrics import (
     EndpointCount,
+    KvstoreDegraded,
+    KvstoreDegradedEvents,
     PolicyCount,
     PolicyImportErrors,
     PolicyRevision,
@@ -88,6 +100,13 @@ class Daemon:
         else:
             self.kvstore = LocalBackend()
         setup_client(self.kvstore)
+        # Degraded-mode latch (reference: the agent keeps the datapath
+        # up on cached state when etcd flaps — kvstore connectivity is
+        # a status condition, not a crash): endpoint regeneration and
+        # verdict serving continue on cached identities while the
+        # store is fenced or unreachable.
+        self._kvstore_degraded = False
+        self._kv_degraded_lock = threading.Lock()
 
         # Policy repository (reference: policy.NewPolicyRepository)
         self.policy = Repository()
@@ -245,6 +264,14 @@ class Daemon:
             ControllerParams(do_func=self._retry_not_ready_endpoints,
                              run_interval=15.0),
         )
+        # Store liveness probe driving the degraded-mode latch both
+        # ways (a flapless exit path: no endpoint churn is needed to
+        # notice the store came back).
+        self.controllers.update_controller(
+            "kvstore-health",
+            ControllerParams(do_func=self._check_kvstore_health,
+                             run_interval=5.0),
+        )
 
         # Initialize the accelerator backend once, on this thread, before
         # builder threads race to first-touch it (concurrent first jax use
@@ -299,6 +326,100 @@ class Daemon:
                 "regeneration will revert"
             )
             return False
+
+    # -- kvstore degraded mode ---------------------------------------------
+
+    def _enter_kvstore_degraded(self, reason: str) -> None:
+        with self._kv_degraded_lock:
+            if self._kvstore_degraded:
+                return
+            self._kvstore_degraded = True
+        KvstoreDegraded.set(1)
+        KvstoreDegradedEvents.inc()
+        log.with_field("reason", reason).warning(
+            "kvstore degraded: continuing on cached identities"
+        )
+        self.monitor.send_agent_notification(
+            AGENT_NOTIFY_KVSTORE_DEGRADED,
+            f"kvstore degraded ({reason}); serving cached identities",
+        )
+
+    def _exit_kvstore_degraded(self) -> None:
+        with self._kv_degraded_lock:
+            if not self._kvstore_degraded:
+                return
+            self._kvstore_degraded = False
+        KvstoreDegraded.set(0)
+        log.info("kvstore connectivity restored")
+        self.monitor.send_agent_notification(
+            AGENT_NOTIFY_KVSTORE_RESTORED, "kvstore connectivity restored"
+        )
+
+    def _check_kvstore_health(self) -> None:
+        """The only path OUT of degraded mode.  Reachability is not
+        enough: a fenced or still-replicating server answers pings and
+        reads while rejecting every write — the probe must check
+        WRITABILITY (role + fencing state), or the latch would flap
+        'restored' while allocations still fail."""
+        b = self.kvstore
+        ping = getattr(b, "ping", None)
+        if not callable(ping):
+            return  # local/file backends cannot flap
+        if not ping():
+            self._enter_kvstore_degraded("store unreachable")
+            return
+        info_fn = getattr(b, "server_info", None)
+        if callable(info_fn):
+            try:
+                info = info_fn()
+            except KvstoreError as e:
+                self._enter_kvstore_degraded(f"status probe: {e}")
+                return
+            if info.get("fenced") or info.get("role") != "primary":
+                self._enter_kvstore_degraded(
+                    f"store {info.get('address')} not writable "
+                    f"(role={info.get('role')}, "
+                    f"fenced={info.get('fenced')})"
+                )
+                return
+        self._exit_kvstore_degraded()
+
+    def _allocate_identity(self, lbls: Labels):
+        """Identity allocation with graceful degradation: a fenced or
+        unreachable store must not stop endpoint regeneration — labels
+        already resolved keep their cached identity (cluster-unique by
+        construction when it was allocated), with a LOCAL refcounted
+        reference so the eventual release balances; only a truly NEW
+        label set fails while degraded.  Exiting degraded mode is the
+        health probe's job — a cache-served allocation proves nothing
+        about connectivity."""
+        try:
+            return self.identity_allocator.allocate(lbls)
+        except (LockError, AllocatorError):
+            # KvstoreError subclasses that do NOT mean the store is
+            # down (lock contention, ID-space exhaustion): latching
+            # degraded mode for them would flap the gauge and spam
+            # monitor notifications while the store is healthy.
+            raise
+        except KvstoreError as e:
+            cached = self.identity_allocator.retain_cached(lbls)
+            self._enter_kvstore_degraded(f"identity allocation: {e}")
+            if cached is None:
+                raise
+            return cached, False
+
+    def _kvstore_publish(self, desc: str, fn) -> None:
+        """Best-effort kvstore propagation (ipcache pairs etc.): local
+        datapath state is already updated by the caller; a degraded
+        store defers only the CROSS-NODE announcement.  Lock
+        contention and allocator-domain errors are not connectivity
+        loss — they propagate instead of latching degraded mode."""
+        try:
+            fn()
+        except (LockError, AllocatorError):
+            raise
+        except KvstoreError as e:
+            self._enter_kvstore_degraded(f"{desc}: {e}")
 
     # -- proxy backends ----------------------------------------------------
 
@@ -416,7 +537,7 @@ class Daemon:
             labels=Labels.from_model(labels or []),
         )
         ep.set_state(EndpointState.WAITING_FOR_IDENTITY, "created")
-        identity, _ = self.identity_allocator.allocate(
+        identity, _ = self._allocate_identity(
             ep.labels if ep.labels else Labels.from_model(["reserved:init"])
         )
         ep.set_identity(identity)
@@ -424,7 +545,12 @@ class Daemon:
         EndpointCount.set(len(self.endpoint_manager))
         if ipv4:
             self.ipcache.upsert(ipv4, identity.id)
-            self.ipcache_sync.upsert_to_kvstore(self._local_pair(ipv4, identity.id))
+            self._kvstore_publish(
+                "ipcache upsert",
+                lambda: self.ipcache_sync.upsert_to_kvstore(
+                    self._local_pair(ipv4, identity.id)
+                ),
+            )
         ep.set_state(EndpointState.WAITING_TO_REGENERATE, "identity ready")
         self.build_queue.enqueue(ep, key=ep.id)
         return ep
@@ -438,9 +564,17 @@ class Daemon:
         self.proxy_manager.remove_endpoint_redirects(endpoint_id)
         if ep.ipv4:
             self.ipcache.delete(ep.ipv4)
-            self.ipcache_sync.delete_from_kvstore(ep.ipv4)
+            self._kvstore_publish(
+                "ipcache delete",
+                lambda: self.ipcache_sync.delete_from_kvstore(ep.ipv4),
+            )
         if ep.security_identity is not None:
-            self.identity_allocator.release(ep.security_identity)
+            self._kvstore_publish(
+                "identity release",
+                lambda: self.identity_allocator.release(
+                    ep.security_identity
+                ),
+            )
         self.endpoint_manager.remove(ep)
         self.dist_cache.delete(TYPE_NETWORK_POLICY, str(endpoint_id))
         if self.npds_pusher is not None:
@@ -475,15 +609,21 @@ class Daemon:
         if ep.labels == new:
             return True
         old_identity = ep.security_identity
-        identity, _ = self.identity_allocator.allocate(new)
+        identity, _ = self._allocate_identity(new)
         ep.labels = new
         ep.set_identity(identity)
         if old_identity is not None:
-            self.identity_allocator.release(old_identity)
+            self._kvstore_publish(
+                "identity release",
+                lambda: self.identity_allocator.release(old_identity),
+            )
         if ep.ipv4:
             self.ipcache.upsert(ep.ipv4, identity.id)
-            self.ipcache_sync.upsert_to_kvstore(
-                self._local_pair(ep.ipv4, identity.id)
+            self._kvstore_publish(
+                "ipcache upsert",
+                lambda: self.ipcache_sync.upsert_to_kvstore(
+                    self._local_pair(ep.ipv4, identity.id)
+                ),
             )
         ep.force_policy_compute = True
         ep.set_state(EndpointState.WAITING_TO_REGENERATE, "labels changed")
@@ -508,7 +648,7 @@ class Daemon:
             self.endpoint_manager.insert(ep)
             if ep.security_identity is not None and ep.labels:
                 # Re-allocate to re-register this node's reference.
-                identity, _ = self.identity_allocator.allocate(
+                identity, _ = self._allocate_identity(
                     ep.security_identity.labels
                 )
                 ep.set_identity(identity)
@@ -564,7 +704,7 @@ class Daemon:
                 continue
             lbls = Labels()
             lbls.upsert(lbl)
-            ident, _ = self.identity_allocator.allocate(lbls)
+            ident, _ = self._allocate_identity(lbls)
             self._cidr_identities[prefix] = ident
             self.ipcache.upsert(prefix, ident.id)
 
@@ -578,7 +718,14 @@ class Daemon:
             if prefix not in live:
                 ident = self._cidr_identities.pop(prefix)
                 self.ipcache.delete(prefix)
-                self.identity_allocator.release(ident)
+                # Same degraded contract as endpoint releases: the
+                # policy deletion already happened; a fenced store must
+                # not abort it half-applied (the allocator's pending-
+                # unref ledger retries the remote side via run_gc).
+                self._kvstore_publish(
+                    "cidr identity release",
+                    lambda: self.identity_allocator.release(ident),
+                )
 
     def policy_delete(self, labels: LabelArray) -> tuple[int, int]:
         """reference: daemon/policy.go PolicyDelete."""
@@ -631,8 +778,12 @@ class Daemon:
             "cilium": {"state": "Ok", "uptime_s": round(
                 time.time() - self._started, 1)},
             "kvstore": {
-                "state": "Ok",
+                "state": "Degraded" if self._kvstore_degraded else "Ok",
                 "status": self.kvstore.status(),
+                "degraded": self._kvstore_degraded,
+                # Fencing epoch the client has observed (None for
+                # local/file backends, which cannot fail over).
+                "epoch": getattr(self.kvstore, "epoch", None),
                 # Client-side failure counters (reference: kvstore
                 # errors surfacing via controller failure counts).
                 "counters": (

@@ -173,6 +173,21 @@ class IdentityAllocator:
             return False
         return self.allocator.release(_labels_key(identity.labels))
 
+    def retain_cached(self, lbls: Labels) -> Optional[Identity]:
+        """Degraded-mode allocation: take a refcounted LOCAL reference
+        on an identity already resolved for these labels, without any
+        kvstore I/O (see Allocator.retain_cached).  None if the labels
+        were never resolved — a truly new identity needs the store."""
+        reserved = lbls.get_from_source(SOURCE_RESERVED)
+        if len(reserved) == len(lbls) and len(reserved) == 1:
+            name = next(iter(reserved))
+            if name in RESERVED_IDENTITIES:
+                return ReservedIdentities[name]
+        id_ = self.allocator.retain_cached(_labels_key(lbls))
+        if id_ is None:
+            return None
+        return Identity(id=id_, labels=lbls)
+
     def lookup_by_id(self, numeric: int) -> Optional[Identity]:
         """reference: cache.go LookupIdentityByID."""
         reserved = look_up_reserved_identity(numeric)

@@ -17,14 +17,17 @@ BackendOperations.
 from .backend import (
     Backend,
     CAP_CREATE_IF_EXISTS,
+    EpochFencedError,
     EventType,
     KeyValueEvent,
     KvstoreError,
     LockError,
+    NotPrimaryError,
     Watcher,
 )
+from .chaos import ChaosProxy
 from .local import FileBackend, LocalBackend
-from .net import KvstoreFollower, KvstoreServer, NetBackend
+from .net import EPOCH_KEY, KvstoreFollower, KvstoreServer, NetBackend
 
 _default_client: Backend | None = None
 
@@ -53,6 +56,9 @@ def close_client() -> None:
 __all__ = [
     "Backend",
     "CAP_CREATE_IF_EXISTS",
+    "ChaosProxy",
+    "EPOCH_KEY",
+    "EpochFencedError",
     "EventType",
     "FileBackend",
     "KeyValueEvent",
@@ -62,6 +68,7 @@ __all__ = [
     "LocalBackend",
     "LockError",
     "NetBackend",
+    "NotPrimaryError",
     "Watcher",
     "client",
     "close_client",

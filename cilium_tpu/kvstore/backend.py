@@ -18,6 +18,30 @@ class LockError(KvstoreError):
     pass
 
 
+class EpochFencedError(KvstoreError):
+    """A write reached a server whose fencing epoch is below the
+    cluster's: a newer primary exists (EPOCH_FENCED).  The rejection
+    happens BEFORE any mutation, so retrying against the current
+    primary is always safe; callers that cache state derived from the
+    stale server must re-resolve against the new primary instead of
+    trusting their caches (see kvstore/net.py state machine)."""
+
+    def __init__(self, msg: str, epoch: int = 0) -> None:
+        super().__init__(msg)
+        self.epoch = epoch  # the fencing (higher) epoch, if known
+
+
+class NotPrimaryError(KvstoreError):
+    """A write reached a still-replicating follower.  Transient by
+    design: the follower either promotes (claiming the next epoch) or
+    the primary returns — the write was rejected before any mutation,
+    so backing off and retrying is always safe."""
+
+    def __init__(self, msg: str, epoch: int = 0) -> None:
+        super().__init__(msg)
+        self.epoch = epoch
+
+
 class EventType(enum.Enum):
     """reference: pkg/kvstore/events.go."""
 

@@ -139,6 +139,21 @@ class LocalBackend(Backend):
             )
         return True
 
+    def compare_and_swap(self, key: str, expected: bytes | None,
+                         value: bytes, lease: bool = False) -> bool:
+        """Atomic CAS: write value iff the key currently holds exactly
+        ``expected`` (None = key absent).  The epoch-claim primitive of
+        the fenced failover (net.py): a promoting follower claims epoch
+        N+1 against the last epoch it replicated, so a concurrent
+        mutation of the epoch key can never be silently overwritten.
+        Emits like set(), so a durable backend persists the claim
+        atomically with the mutation."""
+        with self._mutex:
+            if self._data.get(key) != expected:
+                return False
+            self.set(key, value, lease=lease)
+        return True
+
     def create_if_exists(self, cond_key: str, key: str, value: bytes,
                          lease: bool = False) -> bool:
         with self._mutex:

@@ -21,6 +21,65 @@ speak a length-prefixed JSON protocol:
     watcher registration.
 
 Wire frame: 4-byte big-endian length + UTF-8 JSON.  Values travel hex.
+
+Fencing epochs — the split-brain arbitration the snapshot-shipping
+follower lacked (reference analog: etcd raft terms / consul sessions,
+pkg/kvstore/etcd.go:143, consul.go:119).  State machine:
+
+  - The PRIMARY owns a monotonically increasing epoch N, stored in the
+    key space under ``EPOCH_KEY`` (so it replicates to followers and
+    persists in durable snapshots like any other non-leased key).
+  - A FOLLOWER serves reads and watches from the start but REJECTS
+    writes with ``not_primary`` while its replication stream lives:
+    a write it accepted could be silently pruned at the next
+    LIST_DONE resync, so it refuses to accept what it cannot keep.
+  - When the follower's replication stream dies and its reconnect
+    budget is exhausted, it waits ``failover_grace`` and then PROMOTES:
+    it CAS-claims epoch N+1 against the last epoch it replicated
+    (durably — the claim lands in its snapshot before any write is
+    accepted) and becomes writable.  A promoted follower never
+    resubscribes to the old primary, so its accepted writes can never
+    be pruned.
+  - Every client request carries the highest epoch the client has
+    observed; every response carries the server's epoch.  A server
+    that sees a request epoch above its own has proof a newer primary
+    exists and FENCES itself: all subsequent writes are rejected with
+    ``epoch_fenced`` (EPOCH_FENCED) — a partitioned-but-alive old
+    primary can never accept writes from any client that has touched
+    the new primary.  The promoted follower also dials the old
+    primary's address in the background and fences it explicitly the
+    moment the partition heals.
+  - Clients treat both rejection kinds as rejected-before-apply (safe
+    to retry even for CAS creates): ``not_primary`` backs off and
+    retries in place (the follower is about to promote or the primary
+    is back); ``epoch_fenced`` redials FORWARD along the failover
+    list toward the higher epoch, then retries.
+
+Failover ordering contract: promotion strictly follows replication
+death (the repl watcher is only stopped after its reconnect budget is
+spent — or after the replication HEARTBEAT declares a silent
+partition dead), and writability strictly follows the durable epoch
+claim.  Exactly two loss windows remain, both documented and asserted
+(tests/test_kvstore_partition.py), neither silent:
+
+  1. Replication lag at the cut: replication is asynchronous, so a
+     write acked by the primary in the instant before the partition
+     may not have reached the follower — it survives on the fenced
+     old primary, visible to degraded reads, never merged.
+  2. The LWW window: writes acknowledged by the old primary between
+     the follower's promotion and the first fencing contact (fencer
+     thread on heal, or epoch gossip from any client) — same fate.
+
+Two followers of one primary promoting concurrently would claim the
+same epoch (ordered failover lists, one follower per chain, is the
+supported topology).
+
+Degraded mode (daemon/daemon.py): when the store is fenced or
+unreachable, endpoint regeneration and verdict serving continue on
+cached identities (kvstore_degraded metric + monitor notification);
+degraded mode guarantees datapath continuity for already-resolved
+state, and guarantees nothing for NEW identities or cross-node
+propagation until the store returns.
 """
 
 from __future__ import annotations
@@ -36,18 +95,40 @@ from typing import Optional
 
 from .backend import (
     Backend,
+    EpochFencedError,
     EventType,
     KeyValueEvent,
     KvstoreError,
     LockError,
+    NotPrimaryError,
     Watcher,
 )
 from .local import LocalBackend
+from ..utils.backoff import Exponential
 
 log = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 16 << 20
+
+# The fencing epoch lives in the ordinary key space: it replicates to
+# followers through the same watch stream as everything else and lands
+# in durable snapshots with no special-casing.
+EPOCH_KEY = "cilium/.cluster/epoch"
+# The highest epoch this server was fenced BY, persisted the same way:
+# a snapshot-backed old primary that restarts comes back still fenced
+# instead of silently writable at its stale epoch.  (A memory-only
+# server restarts empty — data and fencing alike — which is the
+# documented fail-back hazard of running without a snapshot_path.)
+FENCED_KEY = "cilium/.cluster/fenced"
+
+# Ops that mutate the store (or grant exclusion tokens derived from
+# it): these are what fencing rejects.  Reads and watches stay served
+# by fenced/replicating servers — degraded reads keep the datapath up.
+WRITE_OPS = frozenset({
+    "set", "delete", "delete_prefix", "create_only", "create_if_exists",
+    "reclaim", "lock",
+})
 
 
 class KvstoreCounters:
@@ -145,13 +226,26 @@ class _Session:
     def _handle_safe(self, req: dict) -> None:
         rid = req.get("id")
         try:
+            epoch = self.server._epoch_gate(req)
             result = self._handle(req)
-            self.send({"id": rid, "ok": True, **(result or {})})
+            # The promotion-CAS is the only epoch mutation a request
+            # can cause, and requests never trigger it — the gate-time
+            # read is current for the response.
+            self.send({"id": rid, "ok": True,
+                       "epoch": epoch, **(result or {})})
+        except EpochFencedError as e:
+            self.send({"id": rid, "ok": False, "error": str(e),
+                       "kind": "epoch_fenced",
+                       "epoch": self.server.fenced_by or self.server.epoch})
+        except NotPrimaryError as e:
+            self.send({"id": rid, "ok": False, "error": str(e),
+                       "kind": "not_primary", "epoch": self.server.epoch})
         except LockError as e:
             self.send({"id": rid, "ok": False, "error": str(e),
-                       "kind": "lock"})
+                       "kind": "lock", "epoch": self.server.epoch})
         except Exception as e:  # noqa: BLE001 — surface to the client
-            self.send({"id": rid, "ok": False, "error": str(e)})
+            self.send({"id": rid, "ok": False, "error": str(e),
+                       "epoch": self.server.epoch})
 
     def _handle(self, req: dict) -> dict | None:
         b = self.server.backend
@@ -161,10 +255,25 @@ class _Session:
         lease = bool(req.get("lease"))
         if op == "ping":
             return {}
+        if op == "fence":
+            # Explicit fencing (the promoted follower's heal-time
+            # notification; also the CLI's arbitration probe).  The
+            # epoch gate above already fences on the carried request
+            # epoch; this op additionally accepts an explicit value so
+            # a fencer need not fake client state.
+            fenced = self.server.fence(int(req.get("fence_epoch", 0) or 0))
+            return {"fenced": bool(self.server.fenced_by),
+                    "fenced_now": fenced}
         if op == "status":
             return {
                 "status": b.status(),
                 "counters": self.server.counters.snapshot(),
+                "role": self.server.role,
+                "fenced": self.server.fenced,
+                "fenced_by": self.server.fenced_by,
+                "replicating": bool(
+                    getattr(self.server, "replicating", False)
+                ),
             }
         if op == "get":
             v = b.get(key)
@@ -220,8 +329,13 @@ class _Session:
             # owns it (the replicated-ghost case).  The owner check and
             # re-claim happen under the server mutex, so another
             # session's create_only/_claim cannot be stolen from.
+            # Self-owned keys re-take trivially: the client's replay
+            # retries after a not_primary rejection, and a second pass
+            # over an already-adopted key must stay a success, not get
+            # misread as "claimed elsewhere".
             with self.server._mutex:
-                if self.server._lease_owner.get(key) is not None:
+                owner = self.server._lease_owner.get(key)
+                if owner is not None and owner is not self:
                     return {"taken": False}
                 cur = b.get(key)
                 if cur != val:
@@ -348,7 +462,8 @@ class KvstoreServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  backend: Backend | None = None,
-                 snapshot_path: str | None = None) -> None:
+                 snapshot_path: str | None = None,
+                 role: str = "primary") -> None:
         from .local import FileBackend
 
         if backend is None:
@@ -358,6 +473,26 @@ class KvstoreServer:
             )
         self.backend = backend
         self.counters = KvstoreCounters()
+        # Fencing state.  The role is fixed BEFORE the listener starts:
+        # a session racing construction must never see a follower as
+        # writable (the write it sneaked in would be pruned at the
+        # first LIST_DONE — the exact loss fencing exists to prevent).
+        self.role = role
+        self.fenced_by = 0  # higher epoch this server was fenced by
+        raw_fenced = self.backend.get(FENCED_KEY)
+        if raw_fenced:
+            # Restored from a snapshot taken after this server was
+            # fenced: stay fenced — a restart must not reopen the
+            # split-brain the fence closed.
+            try:
+                self.fenced_by = int(raw_fenced.decode())
+            except ValueError:
+                pass
+        if role == "primary":
+            # Durable restores keep their snapshot epoch; fresh stores
+            # start at 1.  Followers do NOT seed: replication delivers
+            # the primary's epoch with the first snapshot replay.
+            self.backend.create_only(EPOCH_KEY, b"1")
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -391,6 +526,89 @@ class KvstoreServer:
             if sess in self._sessions:
                 self._sessions.remove(sess)
 
+    # -- fencing -----------------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        """This server's fencing epoch (read from the key space so a
+        follower's replicated epoch and a promoted epoch need no
+        separate bookkeeping)."""
+        raw = self.backend.get(EPOCH_KEY)
+        if not raw:
+            return 0
+        try:
+            return int(raw.decode())
+        except ValueError:
+            return 0
+
+    @property
+    def fenced(self) -> bool:
+        """Fencing is RELATIVE to the current epoch: a replicating
+        follower that briefly trailed a client's observed epoch stops
+        being fenced once replication (or promotion) catches its epoch
+        up; a stale primary can never raise its epoch and stays fenced
+        forever."""
+        return self.fenced_by > self.epoch
+
+    @property
+    def writable(self) -> bool:
+        return self.role == "primary" and not self.fenced
+
+    def fence(self, epoch: int) -> bool:
+        """Record proof that a primacy at ``epoch`` exists.  Above our
+        own epoch, this server is fenced: every subsequent write is
+        rejected with EPOCH_FENCED until (if ever) our own epoch
+        catches up.  Idempotent; returns True on the transition."""
+        if epoch <= self.epoch:
+            return False
+        with self._mutex:
+            if self.fenced_by >= epoch:
+                return False
+            first = self.fenced_by <= self.epoch
+            self.fenced_by = epoch
+        # Durability before visibility: a snapshot-backed server must
+        # restart still-fenced (the fencer thread stops after one ack,
+        # trusting this persists).
+        try:
+            self.backend.set(FENCED_KEY, str(epoch).encode())
+        except Exception as e:  # noqa: BLE001 — fencing still holds
+            self.counters.inc("server_fence_persist_failed")  # in memory
+            log.warning("could not persist fence marker: %s", e)
+        if first:
+            self.counters.inc("server_fenced")
+            log.warning(
+                "kvstore %s (epoch %d) fenced by epoch %d: rejecting "
+                "writes", getattr(self, "address", "?"), self.epoch, epoch,
+            )
+        return first
+
+    def _epoch_gate(self, req: dict) -> int:
+        """Per-request fencing check (runs before dispatch); returns
+        the server epoch (read ONCE — the property walks the backend)
+        for the response.  The client-carried epoch doubles as a
+        gossip channel: any client that has touched a newer primary
+        fences this server on contact, even while the promoted
+        follower cannot reach it."""
+        epoch = self.epoch
+        observed = int(req.get("epoch", 0) or 0)
+        if observed > epoch:
+            self.fence(observed)
+        if req.get("op", "") not in WRITE_OPS:
+            return epoch
+        if self.fenced_by > epoch:
+            self.counters.inc("server_write_rejected_fenced")
+            raise EpochFencedError(
+                f"EPOCH_FENCED: server epoch {epoch} fenced by "
+                f"epoch {self.fenced_by}", epoch=self.fenced_by,
+            )
+        if self.role != "primary":
+            self.counters.inc("server_write_rejected_not_primary")
+            raise NotPrimaryError(
+                f"replicating follower (epoch {epoch}) does not "
+                f"accept writes", epoch=epoch,
+            )
+        return epoch
+
     def close(self) -> None:
         self._stopped = True
         # shutdown() first: it wakes the accept loop so the listening
@@ -411,31 +629,46 @@ class KvstoreServer:
 
 
 class KvstoreFollower(KvstoreServer):
-    """Snapshot-shipping replica: a full KvstoreServer whose store is
-    kept in sync from a primary over the primary's own watch protocol
-    (list_and_watch("") replays the complete snapshot, then streams
-    every mutation).  Clients list the follower after the primary in
-    their failover list; when the primary dies they redial here and
-    find the replicated state, re-claiming their leased keys on fresh
-    sessions (reference role: the second interchangeable networked
-    backend behind BackendOperations, pkg/kvstore/backend.go:86 —
-    etcd's replica durability without raft: last-write-wins, ordered
-    failover, no split-brain arbitration).
+    """Snapshot-shipping replica with fenced failover: a full
+    KvstoreServer whose store is kept in sync from a primary over the
+    primary's own watch protocol (list_and_watch("") replays the
+    complete snapshot, then streams every mutation).  Clients list the
+    follower after the primary in their failover list; when the
+    primary dies they redial here and find the replicated state,
+    re-claiming their leased keys on fresh sessions (reference role:
+    the second interchangeable networked backend behind
+    BackendOperations, pkg/kvstore/backend.go:86).
 
-    The follower serves reads AND writes from the start (its store is
-    a LocalBackend like the primary's); replication stops when the
-    primary dies and the follower simply continues as the store."""
+    While replicating, the follower serves reads and watches but
+    REJECTS writes (not_primary): anything it accepted could be pruned
+    at the next LIST_DONE resync — the silent-loss path fencing
+    removes.  When the replication stream dies for good (reconnect
+    budget ``repl_timeout`` exhausted) and ``failover_grace`` passes,
+    the follower PROMOTES: it durably CAS-claims epoch N+1 in its own
+    store, becomes the writable primary, never resubscribes to the old
+    primary (so no accepted write can ever be pruned), and keeps
+    dialing the old primary's address in the background to fence it
+    the moment a partition heals.  See the module docstring for the
+    full epoch state machine and the documented LWW window."""
 
     def __init__(self, primary_address: str, host: str = "127.0.0.1",
                  port: int = 0, backend: Backend | None = None,
-                 snapshot_path: str | None = None) -> None:
+                 snapshot_path: str | None = None,
+                 repl_timeout: float = 5.0,
+                 failover_grace: float = 0.25,
+                 auto_promote: bool = True) -> None:
         # Dial the primary BEFORE binding our own listener: a follower
         # pointed at a dead/wrong primary must fail its constructor
         # without leaking a live listening socket + accept thread.
         self.primary_address = primary_address
         self.synced = threading.Event()
+        self.promoted = threading.Event()
         self.replicating = True
-        self._repl_client = NetBackend(primary_address, timeout=5.0)
+        self.failover_grace = failover_grace
+        self.auto_promote = auto_promote
+        self._closing = False
+        self._promote_lock = threading.Lock()
+        self._repl_client = NetBackend(primary_address, timeout=repl_timeout)
         try:
             self._repl_watch = self._repl_client.list_and_watch(
                 "replica", ""
@@ -445,7 +678,7 @@ class KvstoreFollower(KvstoreServer):
             # snapshot replay starts.
             self._repl_watch.mark_resync = True
             super().__init__(host, port, backend=backend,
-                             snapshot_path=snapshot_path)
+                             snapshot_path=snapshot_path, role="follower")
         except Exception:
             self._repl_client.close()
             raise
@@ -453,6 +686,45 @@ class KvstoreFollower(KvstoreServer):
             target=self._replicate, daemon=True, name="kvstore-replica"
         )
         self._repl_thread.start()
+        # Heartbeat against the primary: a SILENT partition (TCP
+        # session up, bytes blackholed) produces no stream error at
+        # all — without an end-to-end probe the follower would wait
+        # forever and never fail over (reference: etcd keepalives /
+        # consul session TTLs detect exactly this).
+        self._hb_interval = max(repl_timeout / 2.0, 0.25)
+        threading.Thread(
+            target=self._heartbeat, daemon=True, name="kvstore-replica-hb"
+        ).start()
+
+    def _heartbeat(self) -> None:
+        misses = 0
+        while not self._closing and self.replicating:
+            time.sleep(self._hb_interval)
+            if self._closing or not self.replicating:
+                return
+            if self._repl_client.ping():
+                misses = 0
+                continue
+            misses += 1
+            if misses < 2:  # one miss can be a blip mid-reconnect
+                continue
+            self.counters.inc("replica_heartbeat_dead")
+            log.warning(
+                "kvstore follower %s: replication heartbeat to %s lost; "
+                "declaring the primary dead", self.address,
+                self.primary_address,
+            )
+            # Stopping the watch ends the _replicate loop, whose exit
+            # path runs the grace + promotion sequence.
+            try:
+                self._repl_watch.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                self._repl_client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            return
 
     def _replicate(self) -> None:
         # Every snapshot replay (initial sync AND post-reconnect
@@ -495,11 +767,116 @@ class KvstoreFollower(KvstoreServer):
         except Exception:  # noqa: BLE001 — replica must not die noisily
             self.counters.inc("replica_stream_failed")
         finally:
-            # Stream ended: primary gone (or follower closing).  Keep
-            # serving — this store IS the surviving copy.
+            # Stream ended for good: primary gone (or follower
+            # closing).  This store IS the surviving copy — claim the
+            # next epoch and take over, or (auto_promote=False) keep
+            # serving reads and wait for an operator.
             self.replicating = False
+            if self.auto_promote and not self._closing:
+                if self.failover_grace:
+                    time.sleep(self.failover_grace)
+                if not self._closing:
+                    self.promote()
+
+    def promote(self) -> bool:
+        """Durable epoch claim + role flip, in that order: the epoch
+        N+1 claim lands in this store's snapshot BEFORE any write is
+        accepted, so a restart of the new primary can never come back
+        believing it is still at the old epoch.  CAS against the last
+        replicated epoch: a concurrent external epoch mutation fails
+        the claim instead of being silently overwritten.
+
+        Callable by an operator (auto_promote=False planned failover)
+        as well as the auto path — so it severs a still-live
+        replication stream FIRST: a promoted server must never apply
+        another snapshot replay, or the LIST_DONE prune would eat the
+        writes it acknowledged."""
+        with self._promote_lock:
+            return self._promote_locked()
+
+    def _promote_locked(self) -> bool:
+        if self.promoted.is_set():
+            return True
+        if self.replicating:
+            # Operator-initiated promotion with the stream still up:
+            # cut it.  The closed repl client can never resubscribe,
+            # so no replay (and no prune) can follow the claim; the
+            # _replicate thread's own exit path re-enters promote()
+            # and no-ops on the promoted event.
+            try:
+                self._repl_watch.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                self._repl_client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self.replicating = False
+        if self.epoch <= 0 and self.fenced_by <= 0:
+            # Initial sync never delivered the primary's epoch: we do
+            # not know what epoch the primary owns, so any claim we
+            # made could COLLIDE with it (claiming 1 against a seed-1
+            # primary makes fencing permanently inert — both sides
+            # writable at the same epoch, the exact split-brain this
+            # machinery prevents).  An unsynced follower has nothing
+            # worth serving as primary anyway: stay read-only.
+            self.counters.inc("follower_promote_refused_unsynced")
+            log.warning(
+                "kvstore follower %s refusing promotion: initial sync "
+                "never completed (unknown primary epoch)", self.address,
+            )
+            return False
+        cur_raw = self.backend.get(EPOCH_KEY)
+        # Claim above everything we have seen: the replicated epoch
+        # AND any higher epoch we were fenced by.
+        new = max(self.epoch, self.fenced_by) + 1
+        if not self.backend.compare_and_swap(
+            EPOCH_KEY, cur_raw, str(new).encode()
+        ):
+            self.counters.inc("follower_promote_cas_failed")
+            log.warning("kvstore follower promotion CAS failed")
+            return False
+        self.role = "primary"
+        self.promoted.set()
+        self.counters.inc("follower_promoted")
+        log.warning(
+            "kvstore follower %s promoted to primary at epoch %d "
+            "(old primary %s will be fenced)",
+            self.address, new, self.primary_address,
+        )
+        threading.Thread(
+            target=self._fence_old_primary, args=(new,), daemon=True,
+            name="kvstore-fencer",
+        ).start()
+        return True
+
+    def _fence_old_primary(self, epoch: int) -> None:
+        """Keep dialing the old primary until it acknowledges the
+        fence: during a partition the dial fails and backs off; the
+        moment the partition heals, the old primary learns a newer
+        epoch exists and rejects writes from then on.  (Clients that
+        touched the new primary fence it on contact too — this thread
+        just closes the no-client-crosses-over gap.)"""
+        boff = Exponential(min_duration=0.2, max_duration=2.0,
+                           name="kvstore-fence")
+        while not self._closing:
+            try:
+                c = NetBackend(self.primary_address, timeout=2.0)
+                try:
+                    r = c._request({"op": "fence", "fence_epoch": epoch})
+                    if r.get("fenced"):
+                        self.counters.inc("old_primary_fenced")
+                        log.info("old primary %s fenced at epoch %d",
+                                 self.primary_address, epoch)
+                        return
+                finally:
+                    c.close()
+            except (KvstoreError, OSError):
+                pass
+            boff.wait()
 
     def close(self) -> None:
+        self._closing = True
         try:
             self._repl_watch.stop()
         except Exception:  # noqa: BLE001
@@ -567,6 +944,10 @@ class NetBackend(Backend):
         self.address = self.addresses[0]
         self.timeout = timeout
         self.counters = KvstoreCounters()
+        # Highest fencing epoch observed on any response: carried on
+        # every request (the gossip that fences stale primaries) and
+        # surfaced through daemon status / `cilium kvstore status`.
+        self.epoch = 0
         self.sock = self._dial_any(first=True)
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -703,7 +1084,8 @@ class NetBackend(Backend):
                 return False
             if self._generation != observed_gen:
                 return True  # someone else already reconnected
-            delay = 0.05
+            boff = Exponential(min_duration=0.05, max_duration=1.0,
+                               name="kvstore-reconnect")
             deadline = time.monotonic() + self.timeout
             while True:
                 try:
@@ -712,10 +1094,10 @@ class NetBackend(Backend):
                     sock = self._dial_any()
                     break
                 except KvstoreError:
+                    delay = boff.duration()
                     if time.monotonic() + delay > deadline:
                         return False
                     time.sleep(delay)
-                    delay = min(delay * 2, 1.0)
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             try:
@@ -740,52 +1122,15 @@ class NetBackend(Backend):
             reader.start()
             # Replay session-owned state on the fresh session.
             try:
-                with self._mutex:
-                    leased = dict(self._leased)
-                    specs = dict(self._watch_specs)
-                # RESYNC markers land BEFORE the re-subscriptions, so
-                # everything behind the marker in an opted-in watcher's
-                # queue is pre-blip and everything after it is the
-                # fresh snapshot replay — the follower's prune depends
-                # on this ordering.
-                for wid in specs:
-                    w = self._watchers.get(wid)
-                    if w is not None and w.mark_resync and not w.stopped:
-                        w.events.put(KeyValueEvent(EventType.RESYNC))
-                for key, value in leased.items():
-                    # create_only: the old session's lease revocation may
-                    # have let another client legitimately claim the key —
-                    # never clobber it, drop our stale claim instead.
-                    r = self._request_once(
-                        {"op": "create_only", "key": key,
-                         "value": value.hex(), "lease": True}
-                    )
-                    if not r["created"]:
-                        # On a FOLLOWER after failover the key exists as
-                        # our own replicated ghost (no owning session).
-                        # The server-side reclaim atomically re-takes
-                        # lease ownership iff the value is bit-identical
-                        # AND no live session owns the key; anything
-                        # else means another client genuinely claimed
-                        # it — drop our stale claim.
-                        rr = self._request_once(
-                            {"op": "reclaim", "key": key,
-                             "value": value.hex()}
-                        )
-                        if rr.get("taken"):
-                            continue
-                        log.warning(
-                            "leased key %s re-claimed elsewhere; "
-                            "dropping local claim", key,
-                        )
-                        with self._mutex:
-                            self._leased.pop(key, None)
-                for wid, (name, prefix) in specs.items():
-                    self._request_once(
-                        {"op": "watch", "wid": wid, "key": prefix,
-                         "name": name}
-                    )
-            except KvstoreError:
+                self._replay_session()
+            except KvstoreError as e:
+                if isinstance(e, (EpochFencedError, NotPrimaryError)):
+                    # Rebuilt onto a stale server (fenced) or a
+                    # follower that is not promoting (replicating from
+                    # a live primary we blipped off): rotate the
+                    # address forward so the NEXT attempt dials toward
+                    # the writable server instead of re-poisoning here.
+                    self._rotate_address()
                 # Half-rebuilt sessions are poison: tear the connection
                 # down again so the next attempt replays from scratch.
                 self._conn_dead = True
@@ -796,30 +1141,186 @@ class NetBackend(Backend):
                 return False
             return True
 
+    def _replay_session(self) -> None:
+        """Rebuild session state on a fresh connection: replay leased
+        keys (the keepalive re-registration analog), then re-subscribe
+        watches.  Each step is IDEMPOTENT (create_only falls through
+        to the self-tolerant server-side reclaim; watch registration
+        happens once per wid), so a not_primary rejection from a
+        follower that has not promoted yet backs off and resumes where
+        it left — the normal post-failover path while the follower
+        claims its epoch."""
+        with self._mutex:
+            leased = dict(self._leased)
+            specs = dict(self._watch_specs)
+        # RESYNC markers land BEFORE the re-subscriptions, so
+        # everything behind the marker in an opted-in watcher's
+        # queue is pre-blip and everything after it is the
+        # fresh snapshot replay — the follower's prune depends
+        # on this ordering.
+        for wid in specs:
+            w = self._watchers.get(wid)
+            if w is not None and w.mark_resync and not w.stopped:
+                w.events.put(KeyValueEvent(EventType.RESYNC))
+        boff = Exponential(min_duration=0.05, max_duration=0.5,
+                           name="kvstore-replay")
+        deadline = time.monotonic() + self.timeout
+        pending_leases = dict(leased)
+        pending_watches = dict(specs)
+        while pending_leases or pending_watches:
+            try:
+                while pending_leases:
+                    key, value = next(iter(pending_leases.items()))
+                    # create_only: the old session's lease revocation
+                    # may have let another client legitimately claim
+                    # the key — never clobber it, drop our stale claim
+                    # instead.
+                    r = self._request_once(
+                        {"op": "create_only", "key": key,
+                         "value": value.hex(), "lease": True}
+                    )
+                    if not r["created"]:
+                        # On a FOLLOWER after failover the key exists
+                        # as our own replicated ghost (no owning
+                        # session).  The server-side reclaim atomically
+                        # re-takes lease ownership iff the value is
+                        # bit-identical AND no other live session owns
+                        # the key; anything else means another client
+                        # genuinely claimed it — drop our stale claim.
+                        rr = self._request_once(
+                            {"op": "reclaim", "key": key,
+                             "value": value.hex()}
+                        )
+                        if not rr.get("taken"):
+                            log.warning(
+                                "leased key %s re-claimed elsewhere; "
+                                "dropping local claim", key,
+                            )
+                            with self._mutex:
+                                self._leased.pop(key, None)
+                    pending_leases.pop(key)
+                while pending_watches:
+                    wid, (name, prefix) = next(
+                        iter(pending_watches.items())
+                    )
+                    self._request_once(
+                        {"op": "watch", "wid": wid, "key": prefix,
+                         "name": name}
+                    )
+                    pending_watches.pop(wid)
+            except NotPrimaryError:
+                self.counters.inc("client_not_primary_retry")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise
+                time.sleep(min(boff.duration(), max(remaining, 0.0)))
+
     def _request(self, req: dict, timeout: float | None = None,
                  retryable: bool = True) -> dict:
-        """One round trip, with a single reconnect + retry on
-        transport loss.  Non-idempotent ops (CAS creates, locks) are
-        NEVER blindly retried: the first attempt may have been applied
-        with its response lost, and a retry would mis-report the
-        outcome — callers re-run their own logic instead (reference:
-        etcd client retry semantics for non-idempotent mutations)."""
-        gen = self._generation
+        """One round trip with typed retry classification:
+
+        - TRANSPORT loss: reconnect (walking the failover list) and
+          retry, backing off until self.timeout — idempotent ops only.
+          Non-idempotent ops (CAS creates, locks) are NEVER blindly
+          retried: the first attempt may have been applied with its
+          response lost, and a retry would mis-report the outcome —
+          callers re-run their own logic instead (reference: etcd
+          client retry semantics for non-idempotent mutations).
+        - NOT_PRIMARY: the follower rejected BEFORE applying, so every
+          op — CAS creates included — retries safely; back off
+          (jittered exponential, utils.backoff) until the follower
+          promotes or the primary returns, bounded by self.timeout.
+        - EPOCH_FENCED: the server is stale; redial FORWARD along the
+          failover list toward the newer primary and retry (again
+          rejected-before-apply, so always safe).  With nowhere
+          forward to go, the typed error surfaces to the caller.
+        """
+        boff = Exponential(min_duration=0.05, max_duration=0.5,
+                           name="kvstore-request")
+        deadline = time.monotonic() + self.timeout
+        np_retries = 0
+        while True:
+            gen = self._generation
+            try:
+                return self._request_once(req, timeout)
+            except NotPrimaryError:
+                self.counters.inc("client_not_primary_retry")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise
+                np_retries += 1
+                if np_retries % 4 == 0 and len(self.addresses) > 1:
+                    # We may have landed on a follower during a mere
+                    # primary BLIP: the follower keeps replicating from
+                    # the live primary and will never promote, so
+                    # waiting here would wedge until the deadline.
+                    # Probe around the ring — if the primary is back,
+                    # the write lands there; if not, the dial falls
+                    # through the failover list right back here.
+                    self._redial_forward(gen)
+                    continue
+                time.sleep(min(boff.duration(), max(remaining, 0.0)))
+            except EpochFencedError:
+                self.counters.inc("client_fenced")
+                if time.monotonic() >= deadline:
+                    raise
+                if not self._redial_forward(gen):
+                    raise
+            except KvstoreError as e:
+                transport = (
+                    "connection lost" in str(e) or "send failed" in str(e)
+                )
+                if self._closed or not transport:
+                    raise
+                if not retryable:
+                    # Still rebuild the session for later calls.
+                    self._reconnect(gen)
+                    raise
+                if time.monotonic() >= deadline:
+                    raise
+                if not self._reconnect(gen):
+                    # _reconnect spent its own dial budget; one more
+                    # pass through the loop only if time remains.
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise
+                    time.sleep(min(boff.duration(), max(remaining, 0.0)))
+
+    def _rotate_address(self) -> bool:
+        """Advance self.address to the next entry of the failover
+        list; False with nowhere to go."""
+        with self._mutex:
+            if len(self.addresses) <= 1:
+                return False
+            cur = self.address
+            try:
+                i = self.addresses.index(cur)
+            except ValueError:
+                i = -1
+            nxt = self.addresses[(i + 1) % len(self.addresses)]
+            if nxt == cur:
+                return False
+            self.address = nxt
+        log.warning("kvstore %s not writable; redialing forward to %s",
+                    cur, nxt)
+        return True
+
+    def _redial_forward(self, observed_gen: int) -> bool:
+        """Rotate to the next address in the failover list and rebuild
+        the session there — the reaction to EPOCH_FENCED: the newer
+        primary is FORWARD in the list, and sticking to the fenced
+        server would strand every write."""
+        if not self._rotate_address():
+            return False
+        self.counters.inc("client_fence_redial")
+        # Sever the old session; _reconnect (generation-guarded against
+        # the reader's own background redial) rebuilds it against the
+        # rotated address.
         try:
-            return self._request_once(req, timeout)
-        except KvstoreError as e:
-            transport = (
-                "connection lost" in str(e) or "send failed" in str(e)
-            )
-            if self._closed or not transport:
-                raise
-            if not retryable:
-                # Still rebuild the session for later calls.
-                self._reconnect(gen)
-                raise
-            if not self._reconnect(gen):
-                raise
-            return self._request_once(req, timeout)
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        return self._reconnect(observed_gen)
 
     def _request_once(self, req: dict, timeout: float | None = None) -> dict:
         if self._closed:
@@ -828,6 +1329,7 @@ class NetBackend(Backend):
             # Fail fast into the reconnect path instead of sending into
             # a dead socket and waiting out the timeout.
             raise KvstoreError("kvstore connection lost")
+        req["epoch"] = self.epoch
         with self._mutex:
             self._seq += 1
             rid = self._seq
@@ -847,29 +1349,76 @@ class NetBackend(Backend):
             with self._mutex:
                 self._pending.pop(rid, None)
             raise KvstoreError(f"kvstore request timed out: {req['op']}")
+        self._observe_epoch(resp)
         if not resp.get("ok"):
-            if resp.get("kind") == "lock":
+            kind = resp.get("kind")
+            if kind == "lock":
                 raise LockError(resp.get("error", "lock failed"))
+            if kind == "epoch_fenced":
+                raise EpochFencedError(
+                    resp.get("error", "EPOCH_FENCED"),
+                    epoch=int(resp.get("epoch", 0) or 0),
+                )
+            if kind == "not_primary":
+                raise NotPrimaryError(
+                    resp.get("error", "not primary"),
+                    epoch=int(resp.get("epoch", 0) or 0),
+                )
             raise KvstoreError(resp.get("error", "kvstore error"))
         return resp
+
+    def _observe_epoch(self, resp: dict) -> None:
+        try:
+            e = int(resp.get("epoch", 0) or 0)
+        except (TypeError, ValueError):
+            return
+        if e > self.epoch:
+            self.epoch = e
 
     # -- Backend interface -------------------------------------------------
 
     def status(self) -> str:
         try:
-            inner = self._request({"op": "status"})["status"]
-            return f"tcp {self.address}: connected ({inner})"
+            r = self._request({"op": "status"})
+            role = r.get("role", "?")
+            fenced = " FENCED" if r.get("fenced") else ""
+            return (
+                f"tcp {self.address}: connected ({r['status']}; "
+                f"role={role} epoch={self.epoch}{fenced})"
+            )
         except KvstoreError as e:
             return f"tcp {self.address}: failure - {e}"
 
+    def server_info(self) -> dict:
+        """Structured store status for `cilium kvstore status` and the
+        daemon status section: role, fencing epoch, replication state,
+        server+client counters."""
+        r = self._request({"op": "status"})
+        return {
+            "address": self.address,
+            "addresses": list(self.addresses),
+            "role": r.get("role", "?"),
+            "epoch": self.epoch,
+            "fenced": bool(r.get("fenced")),
+            "fenced_by": int(r.get("fenced_by", 0) or 0),
+            "replicating": bool(r.get("replicating")),
+            "backend": r.get("status", ""),
+            "server_counters": r.get("counters", {}),
+            "client_counters": self.counters.snapshot(),
+            "reconnects": self.reconnects,
+        }
+
     def lock_path(self, path: str, timeout: float | None = 10.0) -> _NetLock:
         t = timeout if timeout is not None else 60.0
-        # Not retryable: a lost response may mean the lock WAS granted;
-        # a blind retry could double-acquire or wait out a lock this
-        # session already holds.
+        # Transport-retry IS safe for locks, uniquely among the
+        # non-idempotent ops: a grant is bound to the SESSION, and a
+        # transport loss kills the session — whatever the lost first
+        # attempt acquired is released by server-side session cleanup,
+        # so the retry (on a fresh session) can block briefly but
+        # never double-acquire.  This is what lets the allocator ride
+        # through a failover instead of surfacing every blip.
         self._request(
             {"op": "lock", "path": path, "timeout": t}, timeout=t + 5.0,
-            retryable=False,
         )
         lock = _NetLock(self, path)
         with self._mutex:

@@ -40,6 +40,17 @@ class SharedStore:
         self._shared: dict[str, dict] = {}
         self._mutex = threading.RLock()
         self._watcher = None
+        # True while the last publish/keepalive could not reach the
+        # store (fenced or unreachable) — local keys keep serving and
+        # are republished by the self-healing resync loop below.
+        self.degraded = False
+        self._closed = False
+        self._resync_active = False
+        # Set by any failed publish; the resync loop clears it before
+        # a pass and re-checks after — a failure that lands WHILE a
+        # pass is in flight (and thus missed it) forces another pass
+        # instead of being stranded by the pass's success.
+        self._dirty = False
         self._start_watch()
 
     def _key_path(self, name: str) -> str:
@@ -47,12 +58,31 @@ class SharedStore:
 
     def update_local_key_sync(self, name: str, value: dict) -> None:
         """Publish/refresh one of our keys (reference:
-        store.go UpdateLocalKeySync)."""
+        store.go UpdateLocalKeySync).  The local copy is recorded
+        FIRST: if the store is fenced or unreachable the publish is
+        deferred — the value is not lost, the periodic
+        sync_local_keys keepalive republishes it once the store
+        returns (degraded mode: local state keeps serving, cross-node
+        propagation pauses)."""
         with self._mutex:
             self._local[name] = value
-        self.backend.set(
-            self._key_path(name), json.dumps(value).encode(), lease=True
-        )
+        try:
+            self.backend.set(
+                self._key_path(name), json.dumps(value).encode(), lease=True
+            )
+            self.degraded = False
+        except KvstoreError as e:
+            with self._mutex:
+                self.degraded = True
+                self._dirty = True
+            log.warning(
+                "store %s: publish of %s deferred (kvstore degraded): %s",
+                self.prefix, name, e,
+            )
+            # Nothing else republishes on its own (no consumer runs a
+            # periodic keepalive today) — the deferral claim is only
+            # true if WE retry until the store takes the keys again.
+            self._kick_resync()
 
     def delete_local_key(self, name: str) -> None:
         with self._mutex:
@@ -69,13 +99,83 @@ class SharedStore:
 
     def sync_local_keys(self) -> None:
         """Re-publish all local keys (periodic keepalive refresh,
-        reference: store.go syncLocalKeys)."""
+        reference: store.go syncLocalKeys).  Best-effort per key: one
+        fenced/unreachable write must not strand the keys behind it —
+        the next keepalive tick retries them all; ``degraded`` tracks
+        whether the last full pass published everything."""
         with self._mutex:
             local = dict(self._local)
+        failed = 0
         for name, value in local.items():
-            self.backend.set(
-                self._key_path(name), json.dumps(value).encode(), lease=True
-            )
+            try:
+                self.backend.set(
+                    self._key_path(name), json.dumps(value).encode(),
+                    lease=True,
+                )
+            except KvstoreError as e:
+                failed += 1
+                log.warning("store %s: keepalive of %s failed: %s",
+                            self.prefix, name, e)
+        with self._mutex:
+            self.degraded = failed > 0
+            if failed:
+                self._dirty = True
+        if failed:
+            self._kick_resync()
+
+    def _kick_resync(self) -> None:
+        """Start (at most one) background republisher that retries
+        sync_local_keys with backoff until every local key landed —
+        the recovery half of degraded mode."""
+        with self._mutex:
+            if self._resync_active or self._closed:
+                return
+            self._resync_active = True
+        threading.Thread(
+            target=self._resync_loop, daemon=True,
+            name=f"store-resync-{self.prefix}",
+        ).start()
+
+    def _resync_loop(self) -> None:
+        from ..utils.backoff import Exponential
+
+        boff = Exponential(min_duration=1.0, max_duration=15.0,
+                           name=f"store-resync-{self.prefix}")
+        try:
+            while True:
+                boff.wait()
+                with self._mutex:
+                    if self._closed:
+                        return
+                    self._dirty = False
+                    local = dict(self._local)
+                ok = True
+                for name, value in local.items():
+                    try:
+                        self.backend.set(
+                            self._key_path(name),
+                            json.dumps(value).encode(), lease=True,
+                        )
+                    except KvstoreError:
+                        ok = False
+                        break
+                if ok:
+                    with self._mutex:
+                        if self._dirty:
+                            continue  # a publish failed mid-pass
+                        self.degraded = False
+                    log.info("store %s: deferred keys republished",
+                             self.prefix)
+                    return
+        finally:
+            with self._mutex:
+                self._resync_active = False
+                # A publish that failed while we were exiting saw
+                # _resync_active=True and declined to start a thread:
+                # re-kick for it or its key would strand unpublished.
+                redo = self._dirty and not self._closed
+            if redo:
+                self._kick_resync()
 
     def _start_watch(self) -> None:
         w = self.backend.list_and_watch(f"store-{self.prefix}", self.prefix + "/")
@@ -114,6 +214,8 @@ class SharedStore:
         ).start()
 
     def close(self) -> None:
+        with self._mutex:
+            self._closed = True
         if self._watcher is not None:
             self._watcher.stop()
         for name in list(self._local):

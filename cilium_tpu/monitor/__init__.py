@@ -13,6 +13,8 @@ monitor/listener1_2.go).
 
 from .monitor import (
     AGENT_NOTIFY_ENDPOINT_REGENERATE_SUCCESS,
+    AGENT_NOTIFY_KVSTORE_DEGRADED,
+    AGENT_NOTIFY_KVSTORE_RESTORED,
     AGENT_NOTIFY_POLICY_UPDATED,
     AGENT_NOTIFY_START,
     MSG_TYPE_ACCESS_LOG,
@@ -29,6 +31,8 @@ from .format import format_event
 
 __all__ = [
     "AGENT_NOTIFY_ENDPOINT_REGENERATE_SUCCESS",
+    "AGENT_NOTIFY_KVSTORE_DEGRADED",
+    "AGENT_NOTIFY_KVSTORE_RESTORED",
     "AGENT_NOTIFY_POLICY_UPDATED",
     "AGENT_NOTIFY_START",
     "MSG_TYPE_ACCESS_LOG",

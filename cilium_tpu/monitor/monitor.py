@@ -28,6 +28,10 @@ AGENT_NOTIFY_ENDPOINT_REGENERATE_SUCCESS = 3
 AGENT_NOTIFY_ENDPOINT_REGENERATE_FAIL = 4
 AGENT_NOTIFY_POLICY_UPDATED = 5
 AGENT_NOTIFY_POLICY_DELETED = 6
+# Cluster-store degradation (fenced/unreachable kvstore): the agent
+# keeps serving on cached identities and announces both edges.
+AGENT_NOTIFY_KVSTORE_DEGRADED = 7
+AGENT_NOTIFY_KVSTORE_RESTORED = 8
 
 
 @dataclass

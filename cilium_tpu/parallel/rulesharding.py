@@ -34,6 +34,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# jax.shard_map graduated from jax.experimental in 0.5; accept both so
+# the mesh code runs on the container's pinned jax too.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover — depends on the installed jax
+    from jax.experimental.shard_map import shard_map
+
 from ..models.base import MAX_REMOTES, ConstVerdict, pack_remote_sets
 from ..models.http import HttpBatchModel
 from ..models.kafka import (
@@ -334,10 +341,13 @@ def _local(model):
     """Drop the singleton shard dim a device sees under shard_map, and
     mark every leaf varying over FLOW_AXIS for the vma checker: model
     state mixes with flow-varying data inside lax.scan carries, whose
-    input/output varying-axis sets must agree."""
-    return jax.tree_util.tree_map(
-        lambda x: jax.lax.pcast(x[0], FLOW_AXIS, to="varying"), model
-    )
+    input/output varying-axis sets must agree.  (On jax < 0.6 there is
+    no vma checker and no lax.pcast — dropping the dim suffices.)"""
+    if hasattr(jax.lax, "pcast"):
+        mark = lambda x: jax.lax.pcast(x, FLOW_AXIS, to="varying")  # noqa: E731
+    else:
+        mark = lambda x: x  # noqa: E731
+    return jax.tree_util.tree_map(lambda x: mark(x[0]), model)
 
 
 def sharded_verdict_step(mesh, verdict_fn):
@@ -348,7 +358,7 @@ def sharded_verdict_step(mesh, verdict_fn):
 
     @jax.jit
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(RULE_AXIS), P(FLOW_AXIS), P(FLOW_AXIS), P(FLOW_AXIS)),
         out_specs=(P(FLOW_AXIS), P(FLOW_AXIS), P(FLOW_AXIS)),
@@ -373,7 +383,7 @@ def sharded_kafka_step(mesh):
 
     @jax.jit
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(RULE_AXIS), P(FLOW_AXIS), P(FLOW_AXIS)),
         out_specs=P(FLOW_AXIS),

@@ -21,7 +21,7 @@ import json
 import threading
 from dataclasses import dataclass, field
 
-from ..kvstore.backend import Backend, KvstoreError
+from ..kvstore.backend import Backend, EpochFencedError, KvstoreError
 
 # reference: common/const.go FirstFreeServiceID = 1
 FIRST_FREE_SERVICE_ID = 1
@@ -104,7 +104,19 @@ class ServiceIDAllocator:
         nonzero, bind exactly that ID or fail — the SVCAdd contract
         (daemon/loadbalancer.go:56): a frontend already registered
         under a different ID, or an ID bound to a different frontend,
-        is an error surfaced to the caller."""
+        is an error surfaced to the caller.
+
+        Epoch-aware: an EPOCH_FENCED rejection mid-sequence means the
+        store failed over.  Every fenced op was rejected before being
+        applied, so the whole lock + find + CAS sequence re-runs
+        cleanly against the new primary (which re-resolves the
+        frontend->ID binding from ITS key space)."""
+        try:
+            return self._acquire_id(frontend, desired)
+        except EpochFencedError:
+            return self._acquire_id(frontend, desired)
+
+    def _acquire_id(self, frontend: L3n4Addr, desired: int = 0) -> int:
         if desired and not 0 < desired <= MAX_SERVICE_ID:
             raise ServiceError(
                 f"service ID {desired} outside [1, {MAX_SERVICE_ID}] "
@@ -173,7 +185,15 @@ class ServiceIDAllocator:
             return None
 
     def delete_id(self, id_: int) -> bool:
-        """reference: id_kvstore.go DeleteID."""
+        """reference: id_kvstore.go DeleteID.  Same fenced-retry
+        contract as acquire_id: rejected-before-apply, so the lock +
+        delete re-runs whole against the post-failover primary."""
+        try:
+            return self._delete_id(id_)
+        except EpochFencedError:
+            return self._delete_id(id_)
+
+    def _delete_id(self, id_: int) -> bool:
         lock = self.backend.lock_path(f"{self.base}/lock")
         try:
             if self.backend.get(self._id_key(id_)) is None:

@@ -197,3 +197,13 @@ ProxyVerdicts = registry.counter(
 ProxyBatches = registry.counter(
     "proxy_batches_total", "Device verdict batches dispatched"
 )
+KvstoreDegraded = registry.gauge(
+    "kvstore_degraded",
+    "1 while the cluster store is fenced/unreachable and the agent "
+    "serves from cached identities (reference: kvstore connectivity "
+    "in `cilium status`)",
+)
+KvstoreDegradedEvents = registry.counter(
+    "kvstore_degraded_events_total",
+    "Transitions into kvstore degraded mode",
+)

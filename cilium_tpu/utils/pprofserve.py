@@ -44,8 +44,18 @@ def profile_text(seconds: float = 1.0, top: int = 50) -> str:
             if ident == me:
                 continue
             code = frame.f_code
+            # co_qualname needs py3.11.  The fallback must keep the
+            # enclosing function's name for <genexpr>/<lambda>/<listcomp>
+            # frames (their co_name alone is anonymous, and a hot
+            # comprehension would otherwise hide its owner from the
+            # profile).
+            qual = getattr(code, "co_qualname", None)
+            if qual is None:
+                qual = code.co_name
+                if qual.startswith("<") and frame.f_back is not None:
+                    qual = f"{frame.f_back.f_code.co_name}.{qual}"
             counts[
-                f"{code.co_filename}:{frame.f_lineno} ({code.co_qualname})"
+                f"{code.co_filename}:{frame.f_lineno} ({qual})"
             ] += 1
         if n_samples * SAMPLE_INTERVAL >= seconds:
             stop.set()

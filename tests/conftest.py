@@ -6,7 +6,25 @@ before the first backend use.  Benchmarks (bench.py) do NOT use this and run
 on the real TPU chip.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5) spells it via XLA_FLAGS; the flag is read at
+    # backend initialization, which no test has triggered yet.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak/chaos tests excluded from the tier-1 run "
+        "(-m 'not slow')",
+    )

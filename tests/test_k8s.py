@@ -6,8 +6,18 @@ plugins/cilium-cni)."""
 
 import glob
 import json
+import os
 
 import pytest
+
+# The golden-corpus tests read the reference checkout, which not every
+# container ships; its absence is an environment property, not a
+# regression.
+_HAVE_REFERENCE = os.path.isdir("/root/reference/examples/policies")
+needs_reference = pytest.mark.skipif(
+    not _HAVE_REFERENCE,
+    reason="/root/reference example policies not present",
+)
 
 from cilium_tpu.daemon.daemon import Daemon
 from cilium_tpu.k8s import (
@@ -36,6 +46,7 @@ def daemon(tmp_path):
 
 # --- golden corpus: every reference example policy parses ----------------
 
+@needs_reference
 def test_reference_examples_parse_and_sanitize():
     files = sorted(
         glob.glob("/root/reference/examples/policies/**/*.json", recursive=True)
@@ -163,6 +174,7 @@ def test_cnp_explicit_namespace_preserved_and_validated():
         parse_cnp(bad)
 
 
+@needs_reference
 def test_cnp_example_http_end_to_end_verdicts(daemon):
     """The reference's l7/http example, shipped as a CNP through the
     fake apiserver, must land in the repository and produce L7 HTTP
