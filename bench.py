@@ -1268,6 +1268,190 @@ def bench_mixed():
     return out
 
 
+def bench_verdict_overload():
+    """Fail-closed overload behavior at 2x capacity (the robustness
+    contract): capacity is measured closed-loop, then an open-loop
+    generator offers 2x that rate against a bounded admission queue.
+    Every entry must be answered — served OK or shed with a typed SHED
+    verdict (zero silent loss) — and the p99 of SERVED verdicts stays
+    bounded by the queue-age watermark instead of growing with the
+    backlog."""
+    import threading
+
+    from cilium_tpu.proxylib import (
+        NetworkPolicy, PortNetworkPolicy, PortNetworkPolicyRule,
+        FilterResult,
+    )
+    from cilium_tpu.proxylib import instance as inst_mod
+    from cilium_tpu.sidecar import SidecarClient, VerdictService
+    from cilium_tpu.utils.option import DaemonConfig
+
+    policy = NetworkPolicy(
+        name="bench-ovl",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=80,
+                rules=[
+                    PortNetworkPolicyRule(
+                        remote_policies=[1],
+                        l7_proto="r2d2",
+                        l7_rules=[{"cmd": "READ", "file": "/public/.*"}],
+                    )
+                ],
+            )
+        ],
+    )
+    QUEUE_AGE_MS = 25.0
+    inst_mod.reset_module_registry()
+    # Greedy (co-located) mode: rounds complete inline, so end-to-end
+    # latency = admission-queue wait + one round — both bounded (age
+    # cap / round size), which is the degradation contract this bench
+    # guards.  (Deadline mode pipelines completion asynchronously and
+    # its in-flight depth is not admission-capped.)
+    cfg = DaemonConfig(
+        batch_timeout_ms=0.0, batch_flows=512,
+        shed_queue_entries=2048, shed_queue_age_ms=QUEUE_AGE_MS,
+    )
+    svc = VerdictService("/tmp/cilium_tpu_bench_overload.sock", cfg).start()
+    client = SidecarClient(svc.socket_path, timeout=60.0)
+    msg = b"READ /public/bench.txt\r\n"
+    n_conns = 64
+    try:
+        mod = client.open_module([])
+        assert client.policy_update(mod, [policy]) == int(FilterResult.OK)
+        for cid in range(1, n_conns + 1):
+            res, _ = client.new_connection(
+                mod, "r2d2", cid, True, 1, 2, "1.1.1.1:1", "2.2.2.2:80",
+                "bench-ovl",
+            )
+            assert res == int(FilterResult.OK)
+
+        answered: dict[int, tuple[float, bool]] = {}
+        lock = threading.Lock()
+        sent_ts: dict[int, float] = {}
+
+        def cb(vb):
+            now = time.perf_counter()
+            ok = bool(vb.count) and int(vb.results[0]) == int(FilterResult.OK)
+            with lock:
+                answered[vb.seq] = (now, ok)
+
+        client.verdict_callback = cb
+        ids = np.arange(1, n_conns + 1, dtype=np.uint64)
+        lens = np.full(n_conns, len(msg), np.uint32)
+        blob = msg * n_conns
+
+        def fire(seq):
+            sent_ts[seq] = time.perf_counter()
+            client.send_batch(seq, ids, [0] * n_conns, lens, blob)
+
+        def drain(upto, timeout_s):
+            deadline = time.perf_counter() + timeout_s
+            while time.perf_counter() < deadline:
+                with lock:
+                    if len(answered) >= upto:
+                        return True
+                time.sleep(0.002)
+            return False
+
+        # Closed-loop capacity: back-to-back batches, one in flight.
+        warm = 20
+        for s in range(1, warm + 1):
+            fire(s)
+            assert drain(s, 30.0), "warmup stalled"
+        t0 = time.perf_counter()
+        n_cap = 200
+        for s in range(warm + 1, warm + n_cap + 1):
+            fire(s)
+            assert drain(s, 30.0), "capacity phase stalled"
+        capacity = n_cap * n_conns / (time.perf_counter() - t0)
+
+        # Open loop at 2x capacity, with a bounded in-flight window (a
+        # real edge applies socket backpressure): without it, batches
+        # pile up in the unix socket buffer BEFORE the service's
+        # admission clock starts and the measured tail is wire-queue
+        # time, not service behavior.  The first pass PRIMES and is
+        # discarded — aggregated overload rounds hit jit bucket shapes
+        # the closed loop never built, and those one-time compiles are
+        # cold-start cost, not steady-state overload behavior.
+        offered = 2.0 * capacity
+        interval = n_conns / offered
+        window = 1024  # max un-answered batches in flight
+
+        def open_loop(seq0: int, duration: float) -> int:
+            seq = seq0
+            t_start = time.perf_counter()
+            next_fire = t_start
+            while time.perf_counter() - t_start < duration:
+                now = time.perf_counter()
+                if now < next_fire:
+                    time.sleep(min(next_fire - now, 0.001))
+                    continue
+                with lock:
+                    outstanding = (seq - seq0) - len(answered)
+                if outstanding >= window:
+                    time.sleep(0.001)
+                    continue
+                seq += 1
+                fire(seq)
+                next_fire += interval
+            return seq - seq0
+
+        with lock:
+            answered.clear()
+        sent_ts.clear()
+        open_loop(50_000, 2.5)  # prime (compiles land here)
+        time.sleep(1.0)
+        with lock:
+            answered.clear()
+        sent_ts.clear()
+        duration = 4.0
+        n_sent = open_loop(100_000, duration)
+        achieved_offer = n_sent * n_conns / duration
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            with lock:
+                if all(s in answered for s in sent_ts):
+                    break
+            time.sleep(0.005)
+        with lock:
+            done = dict(answered)
+        silent_loss = sum(1 for s in sent_ts if s not in done)
+        served = [
+            (done[s][0] - sent_ts[s]) * 1e3
+            for s in sent_ts if s in done and done[s][1]
+        ]
+        shed = sum(1 for s in done.values() if not s[1])
+        assert silent_loss == 0, f"{silent_loss} batches never answered"
+        assert served, "overload run served nothing"
+        served.sort()
+        p50 = served[len(served) // 2]
+        p99 = served[min(int(len(served) * 0.99), len(served) - 1)]
+        shed_rate = shed / max(len(done), 1)
+        st = svc.status()
+        print(
+            f"bench verdict_overload: capacity={capacity:,.0f}/s "
+            f"offered={offered:,.0f}/s (achieved {achieved_offer:,.0f}/s) "
+            f"served_p50={p50:.2f}ms served_p99={p99:.2f}ms "
+            f"shed_rate={shed_rate:.2f} silent_loss=0 "
+            f"(queue_age_cap={QUEUE_AGE_MS}ms)",
+            file=sys.stderr,
+        )
+        return {
+            "p99_ms": p99, "p50_ms": p50, "capacity": capacity,
+            "offered": offered, "achieved_offer": achieved_offer,
+            "shed_rate": shed_rate,
+            "queue_age_cap_ms": QUEUE_AGE_MS,
+            "shed_entries": st["containment"]["shed_entries"],
+        }
+    finally:
+        client.verdict_callback = None
+        client.close()
+        svc.stop()
+        inst_mod.reset_module_registry()
+
+
 def run_one(which: str) -> None:
     import jax
 
@@ -1412,6 +1596,23 @@ def run_one(which: str) -> None:
             seam_minus_null_p99_ms=round(
                 max(r1m.p99_ms - n1m.p99_ms, 0.0), 3),
         )
+    elif which == "verdict_overload":
+        out = bench_verdict_overload()
+        # Smaller is better (a served-verdict p99 under 2x-capacity
+        # overload); the score denominator floors at the queue-age cap
+        # — p99 below the cap is the contract being met, not a win to
+        # chase.
+        _emit(
+            "verdict_overload_p99_ms_at_2x", out["p99_ms"], "ms",
+            1.0 / max(out["p99_ms"], out["queue_age_cap_ms"]) * 10.0,
+            p50_ms=round(out["p50_ms"], 3),
+            capacity_verdicts_per_sec=round(out["capacity"]),
+            offered_verdicts_per_sec=round(out["offered"]),
+            shed_rate=round(out["shed_rate"], 3),
+            shed_entries=out["shed_entries"],
+            silent_loss=0,
+            queue_age_cap_ms=out["queue_age_cap_ms"],
+        )
     elif which == "mixed":
         out = bench_mixed()
         _emit(
@@ -1459,7 +1660,7 @@ def run_one(which: str) -> None:
 CONFIGS = (
     "http", "kafka", "cassandra", "memcached", "latency",
     "latency_colocated", "mixed", "datapath", "stress",
-    "kvstore_failover", "r2d2",
+    "kvstore_failover", "verdict_overload", "r2d2",
 )
 
 
@@ -1582,7 +1783,8 @@ def _check_regressions(lines: list[str],
                       "sidecar_seam_added_p99_ms_colocated",
                       "sidecar_seam_added_p99_ms_colocated_at_1M",
                       "sidecar_seam_p99_minus_null_ms_colocated",
-                      "kvstore_failover_write_outage_s"}
+                      "kvstore_failover_write_outage_s",
+                      "verdict_overload_p99_ms_at_2x"}
     rc = 0
     seen: set = set()
     for line in lines:

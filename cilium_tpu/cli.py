@@ -392,6 +392,57 @@ def cmd_kvstore_status(args):
     return _run_kvstore(args, go)
 
 
+def cmd_sidecar_status(args):
+    """Verdict-service health: throughput counters plus the overload/
+    fault-containment ladder (queue depth, shed counts, quarantine,
+    host-fallback) — the L7 analog of `cilium kvstore status`."""
+    from .sidecar import SidecarClient, SidecarUnavailable
+
+    try:
+        cl = SidecarClient(args.address, timeout=3.0)
+    except OSError as e:
+        print(f"Error: cannot reach verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        st = cl.status()
+    except (SidecarUnavailable, TimeoutError) as e:
+        print(f"Error: verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        cl.close()
+    if args.json:
+        print(json.dumps(st, indent=2))
+        return 0
+    cont = st.get("containment", {})
+    disp = st.get("dispatcher", {})
+    health = (
+        "QUARANTINED (host fallback active)" if cont.get("quarantined")
+        else "Ok"
+    )
+    print(f"{args.address}: {health}")
+    print(f"connections: {st['connections']}  engines: {st['engines']}  "
+          f"dispatch={st['dispatch_mode']}")
+    print(f"verdicts: {st['requests']} requests, {st['denied']} denied, "
+          f"{st['vec_entries']} vectorized")
+    print(f"queue: depth={disp.get('queue_depth', 0)} "
+          f"oldest={disp.get('queue_oldest_ms', 0)}ms "
+          f"shed_submits={disp.get('shed_submits', 0)} "
+          f"stall_deposals={disp.get('stall_deposals', 0)}")
+    print(f"containment: shed={cont.get('shed_entries', 0)} "
+          f"errors={cont.get('error_entries', 0)} "
+          f"crashes={cont.get('batch_crashes', 0)} "
+          f"fallback={cont.get('fallback_entries', 0)} "
+          f"stalls={cont.get('stalls', 0)} "
+          f"quarantine_events={cont.get('quarantine_events', 0)}")
+    if cont.get("quarantined"):
+        print(f"quarantine: {cont.get('reason', '')} "
+              f"for {cont.get('quarantined_for_s', 0)}s "
+              f"(probes: {cont.get('probes', 0)})")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cilium-tpu",
@@ -550,6 +601,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="kvstore server host:port")
     x.add_argument("--json", action="store_true")
     x.set_defaults(fn=cmd_kvstore_status)
+
+    sc = sub.add_parser(
+        "sidecar", help="verdict-service status (overload/containment)"
+    ).add_subparsers(dest="sc_cmd", required=True)
+    x = sc.add_parser(
+        "status",
+        help="verdict counters + shed/quarantine/fallback ladder",
+    )
+    x.add_argument("--address", required=True,
+                   help="verdict service unix socket path")
+    x.add_argument("--json", action="store_true")
+    x.set_defaults(fn=cmd_sidecar_status)
 
     x = sub.add_parser("version")
     x.set_defaults(fn=cmd_version)

@@ -44,3 +44,10 @@ class FilterResult(enum.IntEnum):
     INVALID_ADDRESS = 5
     INVALID_INSTANCE = 6
     UNKNOWN_ERROR = 7
+    # Extensions beyond the reference ABI range — fail-closed overload /
+    # fault containment verdicts for the sidecar seam.  Any non-OK
+    # result is treated as a connection error by the datapath consumer
+    # (including the native shim, which needs no knowledge of the new
+    # codes), so these stay fail-closed on old clients by construction.
+    SHED = 8  # admission queue over capacity / entry deadline passed
+    SERVICE_UNAVAILABLE = 9  # verdict service unreachable (client-side)

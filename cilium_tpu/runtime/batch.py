@@ -25,7 +25,7 @@ import numpy as np
 
 from ..models.base import ConstVerdict
 from ..proxylib.accesslog import EntryType, LogEntry
-from ..proxylib.types import DROP, MORE, PASS, OpType
+from ..proxylib.types import DROP, ERROR, MORE, PASS, OpError, OpType
 
 
 @dataclass
@@ -44,16 +44,27 @@ class FlowState:
     # (reference: connection.go:190-209): injected bytes beyond this are
     # truncated, never buffered unboundedly.
     inject_capacity: int = 1024
+    # Set when the flow exceeded the retained-bytes cap: the buffer was
+    # dropped with a typed protocol-error op sequence and the flow is
+    # dead (the caller closes the connection on the ERROR result).
+    overflowed: bool = False
 
 
 class R2d2BatchEngine:
     """Batch engine for the r2d2 model (the flagship end-to-end slice)."""
 
-    def __init__(self, model, capacity: int = 2048, width: int = 256, logger=None):
+    def __init__(self, model, capacity: int = 2048, width: int = 256,
+                 logger=None, max_buffer: int = 1 << 20):
         self.model = model
         self.capacity = capacity
         self.width = width
         self.logger = logger
+        # Per-flow retained-bytes cap: a flow that buffers more than
+        # this without a frame delimiter is dropped with a typed
+        # protocol-error (bounded retained-data contract; the streaming
+        # reference bounds its buffer the same way).  0 = unbounded.
+        self.max_buffer = max_buffer
+        self.buffer_overflows = 0
         self.flows: dict[int, FlowState] = {}
 
     def flow(
@@ -80,8 +91,30 @@ class R2d2BatchEngine:
             self.flows[flow_id] = st
         return st
 
+    def _overflow(self, st: FlowState, incoming: int) -> None:
+        """Enforce the retained-bytes cap: drop everything buffered plus
+        the incoming bytes with a typed protocol-error op pair — the
+        shim consumes the DROP then surfaces PARSER_ERROR on the ERROR
+        op and closes the connection.  Nothing is silently retained."""
+        dropped = len(st.buffer) + incoming
+        st.buffer.clear()
+        st.overflowed = True
+        self.buffer_overflows += 1
+        st.ops.append((DROP, dropped))
+        st.ops.append((ERROR, int(OpError.ERROR_INVALID_FRAME_LENGTH)))
+
     def feed(self, flow_id: int, data: bytes, remote_id: int = 0, policy_name: str = "", **flow_kwargs) -> None:
-        self.flow(flow_id, remote_id, policy_name, **flow_kwargs).buffer += data
+        st = self.flow(flow_id, remote_id, policy_name, **flow_kwargs)
+        if st.overflowed:
+            if not st.ops:  # dead flow: every further feed errors out
+                st.ops.append(
+                    (ERROR, int(OpError.ERROR_INVALID_FRAME_LENGTH))
+                )
+            return
+        if self.max_buffer and len(st.buffer) + len(data) > self.max_buffer:
+            self._overflow(st, len(data))
+            return
+        st.buffer += data
 
     # -- async round API (one readback per round) --------------------------
     #
@@ -104,6 +137,15 @@ class R2d2BatchEngine:
         st = self.flows.get(flow_id)  # fast path: metadata kwargs only
         if st is None:  # matter at creation
             st = self.flow(flow_id, remote_id, policy_name, **flow_kwargs)
+        if st.overflowed:
+            if not st.ops:
+                st.ops.append(
+                    (ERROR, int(OpError.ERROR_INVALID_FRAME_LENGTH))
+                )
+            return []
+        if self.max_buffer and len(st.buffer) + len(data) > self.max_buffer:
+            self._overflow(st, len(data))
+            return []
         st.buffer += data
         frames: list[tuple[bytes, int]] = []
         while True:
@@ -146,6 +188,8 @@ class R2d2BatchEngine:
         # r2d2parser.go:158-161) — flows that saw activity or still hold
         # bytes end the round with MORE 1 for op-sequence parity.
         for fid, st in self.flows.items():
+            if st.overflowed:
+                continue  # ops already end in the typed error pair
             grew = len(st.ops) > ops_before.get(fid, 0)
             if (st.buffer or grew) and (not st.ops or st.ops[-1][0] != MORE):
                 st.ops.append((MORE, 1))

@@ -42,7 +42,11 @@ from ..proxylib.parsers.memcached import (
     MemcacheParser,
     TextMemcacheParser,
 )
-from ..proxylib.types import MORE, DROP, PASS, FilterResult
+import logging
+
+from ..proxylib.types import MORE, DROP, ERROR, PASS, FilterResult, OpError
+
+log = logging.getLogger(__name__)
 
 
 class _EngineInstance:
@@ -56,8 +60,9 @@ class _EngineInstance:
         q = self.engine._pending_verdicts.get(self.engine._driving_flow)
         if q:
             return bool(q.popleft())
-        # Host fallback: overflow frames or frames beyond the peek
-        # horizon — exact oracle decision.
+        # Host fallback: overflow frames, frames beyond the peek
+        # horizon, or a quarantined device — exact oracle decision.
+        self.engine.host_judged += 1
         policy = self.engine.policy
         return policy is not None and policy.matches(
             ingress, port, remote_id, l7
@@ -69,7 +74,8 @@ class _EngineInstance:
 
 
 class _EngineFlow:
-    __slots__ = ("conn", "parser", "bufs", "ops", "stalled", "skip")
+    __slots__ = ("conn", "parser", "bufs", "ops", "stalled", "skip",
+                 "overflowed")
 
     def __init__(self, conn, parser):
         self.conn = conn
@@ -82,6 +88,9 @@ class _EngineFlow:
         # input (a parser may decide on a frame prefix — e.g. memcached
         # binary bodies); consumed on arrival without re-parsing.
         self.skip = {False: 0, True: 0}
+        # Retained-bytes cap exceeded: buffers dropped with a typed
+        # protocol-error, flow is dead.
+        self.overflowed = False
 
 
 class DeviceAssistedEngine:
@@ -96,18 +105,33 @@ class DeviceAssistedEngine:
     handles_reply = True
 
     def __init__(self, policy, ingress: bool, port: int, model,
-                 logger=None, capacity: int = 2048):
+                 logger=None, capacity: int = 2048,
+                 max_buffer: int = 1 << 20):
         self.policy = policy  # PolicyInstance for host fallback
         self.ingress = ingress
         self.port = port
         self.model = model
         self.logger = logger
         self.capacity = capacity
+        # Per-flow retained-bytes cap across both direction buffers
+        # (0 = unbounded) — see runtime/batch.py FlowState.
+        self.max_buffer = max_buffer
+        self.buffer_overflows = 0
         self.flows: dict[int, _EngineFlow] = {}
         self.instance = _EngineInstance(self)
         self._pending_verdicts: dict[int, deque] = {}
         self._driving_flow: int | None = None
         self.device_judged = 0  # frames decided on device (telemetry)
+        self.host_judged = 0  # frames decided by host fallback (telemetry)
+        # Containment hooks set by the service: device_gate() -> bool
+        # answers "may this round use the device?" (False while the
+        # device is quarantined — the judge step is skipped and every
+        # frame falls through to the host ``policy.matches`` fallback,
+        # which is bit-identical by construction).  device_fail_hook(exc)
+        # reports a crashed judge so the service can count it toward the
+        # poisoned-engine threshold.
+        self.device_gate = None
+        self.device_fail_hook = None
 
     # -- flow management --------------------------------------------------
 
@@ -138,12 +162,35 @@ class DeviceAssistedEngine:
     def feed(self, flow_id: int, data: bytes, reply: bool = False,
              remote_id: int = 0, **kw) -> None:
         st = self.flow(flow_id, remote_id, **kw)
+        if st.overflowed:
+            if not st.ops[reply]:  # dead flow: every further feed errors
+                st.ops[reply].append(
+                    (ERROR, int(OpError.ERROR_INVALID_FRAME_LENGTH))
+                )
+            return
         if st.skip[reply]:
             take = min(st.skip[reply], len(data))
             st.skip[reply] -= take
             data = data[take:]
             if not data:
                 return
+        retained = len(st.bufs[False]) + len(st.bufs[True])
+        if self.max_buffer and retained + len(data) > self.max_buffer:
+            # Retained-bytes cap: drop everything buffered in this
+            # direction plus the incoming bytes with a typed
+            # protocol-error pair; the flow is dead (caller closes on
+            # the ERROR result).
+            dropped = len(st.bufs[reply]) + len(data)
+            st.bufs[False].clear()
+            st.bufs[True].clear()
+            st.overflowed = True
+            st.stalled[False] = st.stalled[True] = True
+            self.buffer_overflows += 1
+            st.ops[reply].append((DROP, dropped))
+            st.ops[reply].append(
+                (ERROR, int(OpError.ERROR_INVALID_FRAME_LENGTH))
+            )
+            return
         st.bufs[reply] += data
         st.stalled[reply] = False
 
@@ -173,28 +220,50 @@ class DeviceAssistedEngine:
                 continue
             for desc in self._peek(st, bytes(st.bufs[False])):
                 batch_entries.append((fid, desc))
-        # 2. judge on device
+        # 2. judge on device — skipped entirely while the device is
+        # quarantined (device_gate False): every frame then falls
+        # through to the host ``policy.matches`` fallback inside the
+        # drive phase, which is bit-identical by construction.  A judge
+        # that CRASHES takes the same fallback (and reports the failure
+        # so the service can quarantine a poisoned engine).
         self._pending_verdicts = {}
-        if batch_entries and not isinstance(self.model, ConstVerdict):
-            verdicts, overflow = self._judge(
-                [d for _, d in batch_entries],
-                np.asarray(
-                    [self.flows[fid].conn.src_id for fid, _ in batch_entries],
-                    np.int32,
-                ),
-            )
-            stopped: set[int] = set()
-            for i, (fid, _) in enumerate(batch_entries):
-                if fid in stopped:
-                    continue
-                if overflow[i]:
-                    # host fallback from this frame on, for THIS flow only
-                    stopped.add(fid)
-                    continue
-                self._pending_verdicts.setdefault(fid, deque()).append(
-                    bool(verdicts[i])
+        device_ok = self.device_gate is None or self.device_gate()
+        if (
+            batch_entries
+            and device_ok
+            and not isinstance(self.model, ConstVerdict)
+        ):
+            try:
+                verdicts, overflow = self._judge(
+                    [d for _, d in batch_entries],
+                    np.asarray(
+                        [self.flows[fid].conn.src_id
+                         for fid, _ in batch_entries],
+                        np.int32,
+                    ),
                 )
-                self.device_judged += 1
+            except Exception as exc:  # noqa: BLE001 — host fallback
+                log.exception("device judge failed; host fallback")
+                if self.device_fail_hook is not None:
+                    try:
+                        self.device_fail_hook(exc)
+                    except Exception:  # noqa: BLE001
+                        pass
+                verdicts, overflow = None, None
+            if verdicts is not None:
+                stopped: set[int] = set()
+                for i, (fid, _) in enumerate(batch_entries):
+                    if fid in stopped:
+                        continue
+                    if overflow[i]:
+                        # host fallback from this frame on, for THIS
+                        # flow only
+                        stopped.add(fid)
+                        continue
+                    self._pending_verdicts.setdefault(fid, deque()).append(
+                        bool(verdicts[i])
+                    )
+                    self.device_judged += 1
         elif batch_entries and isinstance(self.model, ConstVerdict):
             for fid, _ in batch_entries:
                 self._pending_verdicts.setdefault(fid, deque()).append(

@@ -23,13 +23,17 @@ The native C++ shim implementing the same client contract lives in
 ``native/`` (built to ``libcilium_tpu_shim.so``).
 """
 
-from .client import ShimConnection, SidecarClient
+from .client import ShimConnection, SidecarClient, SidecarUnavailable
 from .dispatch import BatchDispatcher
+from .guard import DeviceGuard, DeviceStall
 from .service import VerdictService
 
 __all__ = [
     "BatchDispatcher",
+    "DeviceGuard",
+    "DeviceStall",
     "ShimConnection",
     "SidecarClient",
+    "SidecarUnavailable",
     "VerdictService",
 ]

@@ -11,12 +11,32 @@ round with continuation).
 
 Used by tests (op/byte parity against the in-process oracle) and by the
 latency bench (batched async mode).
+
+Fault containment (the fail-closed contract):
+
+- A dead socket surfaces a typed ``SidecarUnavailable`` immediately —
+  never a raw OSError, never a hang until the RPC timeout.
+- ``ShimConnection.on_io`` NEVER raises and NEVER hangs on service
+  loss: it drops the direction's retained bytes (fail-closed — nothing
+  passes unverdicted) and returns ``SERVICE_UNAVAILABLE``.
+- With ``auto_reconnect=True`` the client redials with jittered
+  exponential backoff and REPLAYS its session (modules, policies,
+  registered connections) so verdicts resume without caller
+  involvement.  Retry classification follows the kvstore client
+  (utils/backoff, PR 1): control RPCs (open_module, policy_update,
+  new_connection, status) are idempotent at the service and retried
+  once after a reconnect; data RPCs are NEVER retried — their bytes
+  were dropped fail-closed, and a replay could double-apply ops.
+- ``deadline_ms`` stamps every data RPC with a wire deadline budget
+  (MSG_DATA_BATCH_DL) so the service sheds — typed — rather than serve
+  a verdict the datapath has already given up on.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import logging
 import socket
 import threading
 from dataclasses import asdict, dataclass, field
@@ -24,7 +44,17 @@ from dataclasses import asdict, dataclass, field
 import numpy as np
 
 from ..proxylib.types import DROP, ERROR, INJECT, MORE, PASS, FilterResult
+from ..utils import metrics
+from ..utils.backoff import Exponential
 from . import wire
+
+log = logging.getLogger(__name__)
+
+
+class SidecarUnavailable(wire.WireError):
+    """The verdict service is unreachable (typed, raised immediately —
+    callers decide between fail-closed verdicts and retry-after-
+    reconnect; see the module docstring's classification)."""
 
 
 @dataclass
@@ -47,7 +77,8 @@ class ShimConnection:
         self.dirs = {False: _Direction(), True: _Direction()}
         self.closed = False
 
-    def on_io(self, reply: bool, data: bytes, end_stream: bool = False) -> tuple[int, bytes]:
+    def on_io(self, reply: bool, data: bytes, end_stream: bool = False,
+              deadline_ms: float | None = None) -> tuple[int, bytes]:
         """Feed new input bytes for one direction; returns
         (FilterResult, output bytes to forward downstream).
 
@@ -55,7 +86,13 @@ class ShimConnection:
         once (the service mirrors the retained buffer and consumes
         already-verdicted overshoot itself); ops returned by the service
         refer to the retained buffer AFTER overshoot consumption, which
-        this side reproduces with the pass/drop counters below."""
+        this side reproduces with the pass/drop counters below.
+
+        ``deadline_ms`` (default: the client's configured deadline)
+        rides the wire so queue time past it sheds typed instead of
+        hanging.  Service loss is fail-closed: retained bytes are
+        dropped and SERVICE_UNAVAILABLE returned — never an exception,
+        never a hang."""
         d = self.dirs[reply]
         output = bytearray()
         incoming = bytes(data)
@@ -80,9 +117,18 @@ class ShimConnection:
             output += d.inject
             d.inject.clear()
 
-        result, entries = self.client._on_data_rpc(
-            self.conn_id, reply, end_stream, incoming
-        )
+        try:
+            result, entries = self.client._on_data_rpc(
+                self.conn_id, reply, end_stream, incoming,
+                deadline_ms=deadline_ms,
+            )
+        except (SidecarUnavailable, TimeoutError):
+            # Fail-closed: nothing buffered may pass unverdicted while
+            # the service is down OR unresponsive past the RPC timeout.
+            # (Output assembled so far was authorized by earlier
+            # verdicts and still goes out.)
+            d.buffer.clear()
+            return int(FilterResult.SERVICE_UNAVAILABLE), bytes(output)
         # Queue every entry's ops and inject bytes BEFORE applying any op
         # (mirrors native/shim.cc on_data_rpc): the service splits >16-op
         # verdict lists into continuation entries with all inject bytes
@@ -120,6 +166,15 @@ class ShimConnection:
                 return int(FilterResult.PARSER_ERROR), bytes(output)
         return int(result), bytes(output)
 
+    def _reset_fail_closed(self) -> None:
+        """After a reconnect the service has no memory of this conn's
+        retained bytes; drop them (fail-closed — never forward
+        unverdicted residue) and clear the overshoot counters."""
+        for d in self.dirs.values():
+            d.buffer.clear()
+            d.inject.clear()
+            d.pass_bytes = d.drop_bytes = d.need_bytes = 0
+
     def close(self) -> None:
         if not self.closed:
             self.closed = True
@@ -127,12 +182,20 @@ class ShimConnection:
 
 
 class SidecarClient:
-    """Wire client: one socket, a reader thread routing replies."""
+    """Wire client: one socket, a reader thread routing replies.
 
-    def __init__(self, socket_path: str, timeout: float = 10.0):
+    ``deadline_ms`` > 0 stamps data RPCs with a wire deadline budget;
+    ``auto_reconnect`` turns on redial-with-backoff + session replay
+    (see module docstring)."""
+
+    def __init__(self, socket_path: str, timeout: float = 10.0,
+                 deadline_ms: float = 0.0, auto_reconnect: bool = False):
+        self.socket_path = socket_path
+        self.timeout = timeout
+        self.deadline_ms = deadline_ms
+        self.auto_reconnect = auto_reconnect
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(socket_path)
-        self.timeout = timeout
         self._seq = itertools.count(1)
         self._wlock = threading.Lock()
         self._pending: dict[int, threading.Event] = {}
@@ -140,15 +203,34 @@ class SidecarClient:
         self._control: list[tuple[int, bytes]] = []
         self._control_evt = threading.Event()
         self._clock = threading.Lock()  # serialize control round trips
+        self._alive = True
+        self._closed = False
+        self._down_once = threading.Lock()  # one disconnect hook per drop
+        self._down_handled = False
+        self._reconnected = threading.Event()
+        self._reconnected.set()
+        self.reconnects = 0
+        # Session record for replay: caller-visible module id ->
+        # {params, debug, policies payload}; the wire-side id may differ
+        # after a service restart, so calls translate through _mod_map.
+        self._session_lock = threading.Lock()
+        self._modules: dict[int, dict] = {}
+        self._mod_map: dict[int, int] = {}
+        self._conn_args: dict[int, tuple] = {}
+        self._shims: dict[int, ShimConnection] = {}
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
         self.verdict_callback = None  # async mode: called with VerdictBatch
 
     # -- plumbing ---------------------------------------------------------
 
+    @property
+    def connected(self) -> bool:
+        return self._alive
+
     def _read_loop(self) -> None:
-        reader = wire.BufferedReader(self.sock)
         try:
+            reader = wire.BufferedReader(self.sock)
             while True:
                 msg_type, payload = reader.recv_msg()
                 if msg_type == wire.MSG_VERDICT_BATCH:
@@ -174,43 +256,247 @@ class SidecarClient:
                     self._control_evt.set()
         except (wire.ConnectionClosed, OSError):
             pass
+        finally:
+            self._on_disconnect()
 
-    def _control_rpc(self, msg_type: int, payload: bytes, want: int) -> bytes:
-        with self._clock:
-            self._control_evt.clear()
-            with self._wlock:
-                wire.send_msg(self.sock, msg_type, payload)
-            if not self._control_evt.wait(self.timeout):
-                raise TimeoutError("no control reply")
-            got_type, got = self._control.pop(0)
-            if got_type != want:
-                raise wire.WireError(f"expected {want}, got {got_type}")
-            return got
+    def _on_disconnect(self) -> None:
+        """Socket died: fail every waiter typed-and-immediately, then
+        (optionally) start the reconnect loop."""
+        with self._down_once:
+            if self._down_handled:
+                return
+            self._down_handled = True
+        self._alive = False
+        self._reconnected.clear()
+        # Wake data waiters WITHOUT a verdict: they observe the missing
+        # entry and raise SidecarUnavailable instead of sleeping out
+        # their full RPC timeout.
+        for seq, evt in list(self._pending.items()):
+            self._pending.pop(seq, None)
+            evt.set()
+        self._control_evt.set()
+        if self.auto_reconnect and not self._closed:
+            threading.Thread(
+                target=self._reconnect_loop,
+                daemon=True,
+                name="sidecar-reconnect",
+            ).start()
+
+    def _send(self, msg_type: int, payload: bytes) -> None:
+        if not self._alive:
+            raise SidecarUnavailable(
+                f"verdict service at {self.socket_path} is down"
+            )
+        with self._wlock:
+            sock = self.sock
+            try:
+                wire.send_msg(sock, msg_type, payload)
+            except OSError as e:
+                # Close only the socket we actually wrote to: _resume
+                # may have swapped in a fresh one concurrently, and
+                # killing it would throw away the just-replayed session.
+                if sock is self.sock:
+                    try:
+                        sock.close()  # force the reader out of recv
+                    except OSError:
+                        pass
+                raise SidecarUnavailable(str(e)) from e
+
+    # -- reconnect --------------------------------------------------------
+
+    def _reconnect_loop(self) -> None:
+        backoff = Exponential(
+            min_duration=0.05, max_duration=2.0, name="sidecar-reconnect"
+        )
+        while not self._closed:
+            try:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.connect(self.socket_path)
+            except OSError:
+                backoff.wait()
+                continue
+            try:
+                self._resume(sock)
+            except Exception:  # noqa: BLE001 — service mid-restart
+                log.exception("sidecar session replay failed; retrying")
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._alive = False
+                backoff.wait()
+                continue
+            return
+
+    def _resume(self, sock: socket.socket) -> None:
+        """Swap in the fresh socket and replay the session: modules,
+        their last-acked policies, then registered connections.  Shim
+        buffers reset fail-closed (the service has no memory of them)."""
+        with self._wlock:
+            if self._closed:
+                # close() raced the reconnect: never leave a "closed"
+                # client holding a live session.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise wire.WireError("client closed during reconnect")
+            self.sock = sock
+        self._alive = True
+        with self._down_once:
+            self._down_handled = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        with self._session_lock:
+            modules = dict(self._modules)
+            conn_args = dict(self._conn_args)
+            shims = list(self._shims.values())
+        for caller_id, rec in modules.items():
+            wire_id = self._raw_open_module(rec["params"], rec["debug"])
+            self._mod_map[caller_id] = wire_id
+            if rec["policies"] is not None:
+                status = self._raw_policy_update(wire_id, rec["policies"])
+                if status != int(FilterResult.OK):
+                    raise wire.WireError(
+                        f"policy replay rejected: {status}"
+                    )
+        for conn_id, args in conn_args.items():
+            res = self._raw_new_connection(conn_id, args)
+            if res != int(FilterResult.OK):
+                log.warning(
+                    "conn %d replay rejected: %d", conn_id, res
+                )
+        for shim in shims:
+            shim._reset_fail_closed()
+        self.reconnects += 1
+        metrics.SidecarClientReconnects.inc()
+        self._reconnected.set()
+        log.info(
+            "sidecar client reconnected to %s (%d modules, %d conns)",
+            self.socket_path, len(modules), len(conn_args),
+        )
+
+    def _wire_mod(self, module_id: int) -> int:
+        return self._mod_map.get(module_id, module_id)
+
+    def _control_rpc(self, build, want: int, retry: bool = True) -> bytes:
+        """One control round trip.  ``build()`` produces (msg_type,
+        payload) — re-invoked on retry so module ids re-translate after
+        a replay.  Control RPCs are idempotent at the service, so ONE
+        retry after an auto-reconnect is safe (transport errors on
+        non-idempotent ops would never be blindly retried — the data
+        plane fails closed instead)."""
+        for attempt in (0, 1):
+            try:
+                with self._clock:
+                    self._control_evt.clear()
+                    msg_type, payload = build()
+                    self._send(msg_type, payload)
+                    if not self._control_evt.wait(self.timeout):
+                        if not self._alive:
+                            raise SidecarUnavailable("connection lost")
+                        raise TimeoutError("no control reply")
+                    if not self._control:
+                        # Woken by _on_disconnect, not by a reply.
+                        raise SidecarUnavailable("connection lost")
+                    got_type, got = self._control.pop(0)
+                    if got_type != want:
+                        raise wire.WireError(
+                            f"expected {want}, got {got_type}"
+                        )
+                    return got
+            except SidecarUnavailable:
+                if not (
+                    retry
+                    and self.auto_reconnect
+                    and attempt == 0
+                    and not self._closed
+                ):
+                    raise
+                if not self._reconnected.wait(self.timeout):
+                    raise
+        raise SidecarUnavailable("unreachable")  # not reached
 
     # -- module / policy surface (the libcilium.h analog) -----------------
+
+    def _raw_open_module(self, params, debug: bool) -> int:
+        got = self._control_rpc(
+            lambda: (
+                wire.MSG_OPEN_MODULE,
+                wire.pack_open_module(params or [], debug),
+            ),
+            wire.MSG_MODULE_ID,
+            retry=False,
+        )
+        return int(np.frombuffer(got, "<u8", 1)[0])
 
     def open_module(self, params: list[tuple[str, str]] | None = None,
                     debug: bool = False) -> int:
         got = self._control_rpc(
-            wire.MSG_OPEN_MODULE,
-            wire.pack_open_module(params or [], debug),
+            lambda: (
+                wire.MSG_OPEN_MODULE,
+                wire.pack_open_module(params or [], debug),
+            ),
             wire.MSG_MODULE_ID,
         )
-        return int(np.frombuffer(got, "<u8", 1)[0])
+        mod = int(np.frombuffer(got, "<u8", 1)[0])
+        with self._session_lock:
+            self._modules[mod] = {
+                "params": list(params or []), "debug": debug,
+                "policies": None,
+            }
+            self._mod_map[mod] = mod
+        return mod
 
     def status(self) -> dict:
         """Service counters (MSG_STATUS round trip)."""
-        got = self._control_rpc(wire.MSG_STATUS, b"", wire.MSG_STATUS_REPLY)
+        got = self._control_rpc(
+            lambda: (wire.MSG_STATUS, b""), wire.MSG_STATUS_REPLY
+        )
         return json.loads(got.decode())
+
+    def _raw_policy_update(self, wire_mod: int, payload: bytes) -> int:
+        got = self._control_rpc(
+            lambda: (
+                wire.MSG_POLICY_UPDATE,
+                wire.pack_policy_update(wire_mod, payload),
+            ),
+            wire.MSG_ACK,
+            retry=False,
+        )
+        return wire.unpack_ack(got)
 
     def policy_update(self, module_id: int, policies) -> int:
         payload = json.dumps([asdict(p) for p in policies]).encode()
         got = self._control_rpc(
-            wire.MSG_POLICY_UPDATE,
-            wire.pack_policy_update(module_id, payload),
+            lambda: (
+                wire.MSG_POLICY_UPDATE,
+                wire.pack_policy_update(self._wire_mod(module_id), payload),
+            ),
             wire.MSG_ACK,
         )
-        return wire.unpack_ack(got)
+        status = wire.unpack_ack(got)
+        if status == int(FilterResult.OK):
+            with self._session_lock:
+                if module_id in self._modules:
+                    self._modules[module_id]["policies"] = payload
+        return status
+
+    def _raw_new_connection(self, conn_id: int, args: tuple) -> int:
+        (module_id, proto, ingress, src_id, dst_id,
+         src_addr, dst_addr, policy_name) = args
+        got = self._control_rpc(
+            lambda: (
+                wire.MSG_NEW_CONNECTION,
+                wire.pack_new_connection(
+                    self._wire_mod(module_id), conn_id, ingress, src_id,
+                    dst_id, proto, src_addr, dst_addr, policy_name,
+                ),
+            ),
+            wire.MSG_CONN_RESULT,
+            retry=False,
+        )
+        return int(np.frombuffer(got[8:], "<u4", 1)[0])
 
     def new_connection(
         self,
@@ -224,24 +510,38 @@ class SidecarClient:
         dst_addr: str,
         policy_name: str,
     ) -> tuple[int, ShimConnection | None]:
+        args = (module_id, proto, ingress, src_id, dst_id,
+                src_addr, dst_addr, policy_name)
         got = self._control_rpc(
-            wire.MSG_NEW_CONNECTION,
-            wire.pack_new_connection(
-                module_id, conn_id, ingress, src_id, dst_id,
-                proto, src_addr, dst_addr, policy_name,
+            lambda: (
+                wire.MSG_NEW_CONNECTION,
+                wire.pack_new_connection(
+                    self._wire_mod(module_id), conn_id, ingress, src_id,
+                    dst_id, proto, src_addr, dst_addr, policy_name,
+                ),
             ),
             wire.MSG_CONN_RESULT,
         )
         res = int(np.frombuffer(got[8:], "<u4", 1)[0])
         if res != int(FilterResult.OK):
             return res, None
-        return res, ShimConnection(self, conn_id)
+        shim = ShimConnection(self, conn_id)
+        with self._session_lock:
+            self._conn_args[conn_id] = args
+            self._shims[conn_id] = shim
+        return res, shim
 
     def close_connection(self, conn_id: int) -> None:
-        with self._wlock:
-            wire.send_msg(self.sock, wire.MSG_CLOSE, wire.pack_close(conn_id))
+        with self._session_lock:
+            self._conn_args.pop(conn_id, None)
+            self._shims.pop(conn_id, None)
+        try:
+            self._send(wire.MSG_CLOSE, wire.pack_close(conn_id))
+        except SidecarUnavailable:
+            pass  # the restart already forgot the conn
 
     def close(self) -> None:
+        self._closed = True
         try:
             self.sock.close()
         except OSError:
@@ -250,23 +550,40 @@ class SidecarClient:
     # -- data plane -------------------------------------------------------
 
     def _on_data_rpc(self, conn_id: int, reply: bool, end_stream: bool,
-                     data: bytes):
-        """Synchronous single-entry round trip (the OnData ABI call)."""
+                     data: bytes, deadline_ms: float | None = None):
+        """Synchronous single-entry round trip (the OnData ABI call).
+        NEVER retried across a reconnect (see retry classification);
+        raises SidecarUnavailable immediately on a dead service."""
         seq = next(self._seq)
         flags = (wire.FLAG_REPLY if reply else 0) | (
             wire.FLAG_END_STREAM if end_stream else 0
         )
         evt = threading.Event()
         self._pending[seq] = evt
-        payload = wire.pack_data_batch(
-            seq, [conn_id], [flags], [len(data)], data
-        )
-        with self._wlock:
-            wire.send_msg(self.sock, wire.MSG_DATA_BATCH, payload)
+        budget_ms = self.deadline_ms if deadline_ms is None else deadline_ms
+        if budget_ms and budget_ms > 0:
+            payload = wire.pack_data_batch_dl(
+                int(budget_ms * 1000.0), seq, [conn_id], [flags],
+                [len(data)], data,
+            )
+            msg = wire.MSG_DATA_BATCH_DL
+        else:
+            payload = wire.pack_data_batch(
+                seq, [conn_id], [flags], [len(data)], data
+            )
+            msg = wire.MSG_DATA_BATCH
+        try:
+            self._send(msg, payload)
+        except SidecarUnavailable:
+            self._pending.pop(seq, None)
+            raise
         if not evt.wait(self.timeout):
             self._pending.pop(seq, None)
             raise TimeoutError("no verdict reply")
-        vb = self._verdicts.pop(seq)
+        vb = self._verdicts.pop(seq, None)
+        if vb is None:
+            # Woken by _on_disconnect: the service died mid-flight.
+            raise SidecarUnavailable("connection lost awaiting verdict")
         entries = [vb.entry(i) for i in range(vb.count)]
         result = entries[-1][1] if entries else int(FilterResult.OK)
         return result, entries
@@ -275,8 +592,7 @@ class SidecarClient:
         """Async batched mode (latency bench): fire a DATA batch; replies
         arrive on verdict_callback."""
         payload = wire.pack_data_batch(seq, conn_ids, flags, lengths, blob)
-        with self._wlock:
-            wire.send_msg(self.sock, wire.MSG_DATA_BATCH, payload)
+        self._send(wire.MSG_DATA_BATCH, payload)
 
     def send_matrix(self, seq: int, width: int, conn_ids, lengths,
                     rows_bytes: bytes, complete: bool = False) -> None:
@@ -288,8 +604,7 @@ class SidecarClient:
             seq, width, conn_ids, lengths, rows_bytes,
             wire.MAT_FLAG_COMPLETE if complete else 0,
         )
-        with self._wlock:
-            wire.send_msg(self.sock, wire.MSG_DATA_MATRIX, payload)
+        self._send(wire.MSG_DATA_MATRIX, payload)
 
     def send_blob(self, seq: int, conn_ids, lengths, blob: bytes) -> None:
         """Compact request-direction batch: exact payload bytes only
@@ -299,5 +614,4 @@ class SidecarClient:
         payload = wire.pack_data_batch(
             seq, conn_ids, [0] * len(conn_ids), lengths, blob
         )
-        with self._wlock:
-            wire.send_msg(self.sock, wire.MSG_DATA_BATCH, payload)
+        self._send(wire.MSG_DATA_BATCH, payload)

@@ -1,4 +1,5 @@
-"""Adaptive fill-vs-deadline batch dispatcher.
+"""Adaptive fill-vs-deadline batch dispatcher with fail-closed overload
+and stall containment.
 
 Device dispatch is most efficient at full batches, but a request that
 arrives into an idle service must not wait a full batch's worth of fill
@@ -26,13 +27,33 @@ any request.  This is the consumer of ``DaemonConfig.batch_timeout_ms``
 analog is the per-request proxy dispatch in GoFilter::Instance::OnIO
 (reference: envoy/cilium_proxylib.cc:125), which this component amortizes
 across flows.
+
+Containment contract (the robustness layer):
+
+- **Bounded admission**: ``max_pending`` caps queued weight; ``submit``
+  refuses excess work (returns False) so the caller can answer with a
+  typed SHED verdict instead of queueing unboundedly.  ``force=True``
+  bypasses the cap for control items (closes) that must never be lost.
+- **Crash containment**: a ``process(batch)`` that raises reaches
+  ``on_batch_error(batch, exc)`` so every in-flight entry can receive a
+  typed error verdict — never logged-and-dropped.
+- **Stall containment**: an optional watchdog bounds one round at
+  ``stall_timeout_s``.  A worker stuck past the deadline (device hang)
+  is DEPOSED: the stuck batch goes to ``on_stall(batch)`` for typed
+  shed verdicts, a replacement worker takes over the queue, and the
+  deposed thread's late sends are suppressed by generation (consumers
+  check ``thread_is_deposed()``).  Python cannot cancel the stuck
+  thread; it is abandoned (daemon) and exits when the stall clears.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable
+
+log = logging.getLogger(__name__)
 
 
 class BatchDispatcher:
@@ -41,7 +62,7 @@ class BatchDispatcher:
 
     ``process(items)`` receives the pending list (oldest first).  Each
     item carries a ``weight`` (entry count for wire requests) counted
-    toward the fill threshold.
+    toward the fill threshold and the admission cap.
     """
 
     def __init__(
@@ -50,16 +71,29 @@ class BatchDispatcher:
         max_batch: int = 2048,
         timeout_ms: float = 0.5,
         name: str = "verdict-dispatch",
+        max_pending: int = 0,
+        stall_timeout_s: float = 0.0,
+        on_batch_error: Callable[[list[Any], BaseException], None] | None = None,
+        on_stall: Callable[[list[Any]], None] | None = None,
     ):
         self.process = process
         self.max_batch = max_batch
         self.timeout_s = timeout_ms / 1000.0
+        self.max_pending = max_pending
+        self.stall_timeout_s = stall_timeout_s
+        self.on_batch_error = on_batch_error
+        self.on_stall = on_stall
+        self._name = name
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        # Signalled every time a round finishes (flush waits here —
+        # never a sleep/poll loop).
+        self._done = threading.Condition(self._lock)
         self._pending: list[Any] = []
         self._pending_weight = 0
         self._oldest_ts = 0.0
         self._stopped = False
+        self._started = False
         self._in_process_lock = threading.Lock()
         # True from the moment the worker pops a batch in _take until it
         # finishes processing it.  Set BEFORE _pending is cleared (both
@@ -68,53 +102,130 @@ class BatchDispatcher:
         # batch still in flight — the ordering the service's cut-through
         # path relies on to never overtake queued work.
         self._busy = False
-        self._worker = threading.Thread(target=self._run, name=name, daemon=True)
+        # Worker generation: bumped at each stall deposal.  The current
+        # worker, the current in-process lock, and send suppression are
+        # all keyed to it.
+        self._gen = 0
+        self._round_start = 0.0
+        self._current_batch: list[Any] | None = None
+        self._worker = threading.Thread(
+            target=self._run, args=(0,), name=name, daemon=True
+        )
+        self._watchdog_stop = threading.Event()
         # Dispatch telemetry (read by benches/status).
         self.batches = 0
         self.entries = 0
         self.fill_dispatches = 0
         self.deadline_dispatches = 0
+        self.shed_submits = 0
+        self.shed_weight = 0
+        self.stall_deposals = 0
+
+    # -- lifecycle --------------------------------------------------------
 
     def start(self) -> "BatchDispatcher":
+        self._started = True
         self._worker.start()
+        if self.stall_timeout_s > 0:
+            threading.Thread(
+                target=self._watch,
+                name=f"{self._name}-watchdog",
+                daemon=True,
+            ).start()
         return self
 
     def stop(self) -> None:
+        """Idempotent; safe before start() and when called twice."""
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
-        self._worker.join(timeout=5)
+            self._done.notify_all()
+            worker = self._worker
+        self._watchdog_stop.set()
+        if self._started and worker.is_alive():
+            worker.join(timeout=5)
 
-    def submit(self, item: Any, weight: int = 1) -> None:
+    # -- admission --------------------------------------------------------
+
+    def submit(self, item: Any, weight: int = 1, force: bool = False) -> bool:
+        """Queue one item; False means the admission cap refused it (the
+        caller owes the peer a typed SHED response — weight-0/control
+        items pass ``force=True`` and are never refused)."""
         with self._cond:
+            if (
+                not force
+                and self.max_pending
+                and self._pending_weight + weight > self.max_pending
+            ):
+                self.shed_submits += 1
+                self.shed_weight += weight
+                return False
             if not self._pending:
                 self._oldest_ts = time.perf_counter()
             self._pending.append(item)
             self._pending_weight += weight
             self._cond.notify()
+        return True
 
-    def flush(self) -> None:
-        """Block until everything submitted so far has been processed."""
-        while True:
-            with self._cond:
-                if not self._pending:
+    @property
+    def pending_weight(self) -> int:
+        return self._pending_weight
+
+    def oldest_age_s(self) -> float:
+        """Age of the oldest queued item (0 when idle)."""
+        with self._cond:
+            if not self._pending:
+                return 0.0
+            return time.perf_counter() - self._oldest_ts
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Block until everything submitted so far has been processed.
+        Condition-based (signalled at batch completion) — never a poll
+        loop, and a deposed (stuck) round does not wedge it: deposal
+        clears busy and signals.  Returns False on timeout."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        with self._done:
+            while self._pending or self._busy:
+                if self._stopped:
                     break
-            time.sleep(0.0005)
-        # One more beat for the batch currently in process().
-        with self._in_process_lock:
+                wait = None
+                if deadline is not None:
+                    wait = deadline - time.perf_counter()
+                    if wait <= 0:
+                        return False
+                self._done.wait(wait)
+        # One more beat for a cut-through round currently inline on a
+        # reader thread (it holds the current in-process lock).
+        lock = self._in_process_lock
+        with lock:
             pass
+        return True
+
+    def thread_is_deposed(self) -> bool:
+        """True when the CALLING thread is a dispatcher worker that has
+        been deposed by the stall watchdog — its late sends must be
+        suppressed (the stuck batch already received typed verdicts)."""
+        gen = getattr(threading.current_thread(), "_disp_gen", None)
+        return gen is not None and gen != self._gen
+
+    # -- worker -----------------------------------------------------------
 
     def _pop_locked(self) -> list[Any]:
         self._busy = True  # before the clear — see __init__ note
+        self._round_start = time.perf_counter()
         batch = self._pending
+        self._current_batch = batch
         self._pending = []
         self._pending_weight = 0
         return batch
 
-    def _take(self) -> tuple[list[Any], bool]:
-        """Wait for fill or deadline; returns (batch, was_deadline)."""
+    def _take(self, my_gen: int) -> tuple[list[Any] | None, bool]:
+        """Wait for fill or deadline; returns (batch, was_deadline).
+        Returns (None, False) when this worker has been deposed."""
         with self._cond:
             while True:
+                if self._gen != my_gen:
+                    return None, False
                 if self._stopped:
                     return self._pop_locked(), False
                 if self._pending_weight >= self.max_batch:
@@ -129,11 +240,18 @@ class BatchDispatcher:
                 else:
                     self._cond.wait()
 
-    def _run(self) -> None:
+    def _run(self, my_gen: int) -> None:
+        threading.current_thread()._disp_gen = my_gen
         while True:
-            batch, deadline = self._take()
+            batch, deadline = self._take(my_gen)
+            if batch is None:
+                return  # deposed while waiting
             if batch:
-                with self._in_process_lock:
+                # Capture the lock object: deposal swaps in a fresh one
+                # for the replacement generation, so a stuck holder of
+                # the old lock can never wedge the new worker.
+                lock = self._in_process_lock
+                with lock:
                     self.batches += 1
                     self.entries += len(batch)
                     if deadline:
@@ -142,16 +260,72 @@ class BatchDispatcher:
                         self.fill_dispatches += 1
                     try:
                         self.process(batch)
-                    except Exception:  # noqa: BLE001 — worker must survive
-                        import logging
+                    except Exception as exc:  # noqa: BLE001 — must survive
+                        log.exception("batch process failed")
+                        if (
+                            self.on_batch_error is not None
+                            and self._gen == my_gen
+                        ):
+                            try:
+                                self.on_batch_error(batch, exc)
+                            except Exception:  # noqa: BLE001
+                                log.exception("on_batch_error failed")
+            with self._cond:
+                if self._gen != my_gen:
+                    return  # deposed mid-round: a replacement owns the queue
+                self._busy = False
+                self._current_batch = None
+                self._done.notify_all()
+                if self._stopped and not self._pending:
+                    return
 
-                        logging.getLogger(__name__).exception(
-                            "batch process failed"
-                        )
-            self._busy = False
-            if self._stopped and not batch:
-                return
-            if self._stopped:
-                with self._cond:
-                    if not self._pending:
-                        return
+    # -- stall watchdog ---------------------------------------------------
+
+    def _watch(self) -> None:
+        interval = max(min(self.stall_timeout_s / 4.0, 0.5), 0.01)
+        while not self._watchdog_stop.wait(interval):
+            with self._cond:
+                if self._stopped:
+                    return
+                if not self._busy:
+                    continue
+                if (
+                    time.perf_counter() - self._round_start
+                    < self.stall_timeout_s
+                ):
+                    continue
+                # A free in-process lock means process() already
+                # RETURNED (its verdicts are sent) and the worker is
+                # merely about to clear _busy — deposing now would send
+                # duplicate SHED replies for served seqs.  Only a held
+                # lock is a genuinely stuck round.
+                lk = self._in_process_lock
+                if lk.acquire(blocking=False):
+                    lk.release()
+                    continue
+                # Depose: abandon the stuck worker+lock, hand the queue
+                # to a fresh generation, and surface the stuck batch for
+                # typed shed verdicts.
+                batch = self._current_batch
+                self._current_batch = None
+                self._gen += 1
+                self._busy = False
+                self._in_process_lock = threading.Lock()
+                self.stall_deposals += 1
+                self._worker = threading.Thread(
+                    target=self._run,
+                    args=(self._gen,),
+                    name=f"{self._name}-g{self._gen}",
+                    daemon=True,
+                )
+                self._worker.start()
+                self._done.notify_all()
+            log.error(
+                "dispatch round stalled > %.1fs; worker deposed "
+                "(generation %d)", self.stall_timeout_s, self._gen,
+            )
+            if self.on_stall is not None and batch:
+                try:
+                    self.on_stall(batch)
+                except Exception:  # noqa: BLE001
+                    log.exception("on_stall failed")

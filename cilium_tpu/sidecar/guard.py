@@ -1,0 +1,201 @@
+"""Device quarantine state machine for the verdict hot path.
+
+Per-packet-ML dataplanes treat bounded-latency degradation — not
+availability loss — as the contract when the accelerator path stalls
+(Taurus, arXiv:2002.08987; the kernel L7-offload line makes the same
+call).  This module owns the state machine that enforces it for the
+sidecar:
+
+- a device call that exceeds the watchdog deadline (TPU stall, compile
+  storm) **quarantines** the device: subsequent rounds bypass the
+  device entirely and render verdicts through the bit-identical host
+  fallback (the proxylib oracle / the device-assisted engines' host
+  ``policy.matches`` path);
+- repeated crashed rounds (a poisoned engine) quarantine the same way
+  via ``record_failure``;
+- while quarantined, traffic-driven **re-probes** run a real device
+  call on a disposable executor under the same deadline; the first
+  probe that completes heals the quarantine, so recovery is automatic
+  and requires no operator action.
+
+A stuck probe/worker thread cannot be cancelled in Python — it is
+abandoned (daemon, bounded by one per probe interval) and its executor
+discarded; the number of leaked threads is bounded by the number of
+distinct stalls, not by traffic.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+log = logging.getLogger(__name__)
+
+
+class DeviceStall(Exception):
+    """A device call exceeded the watchdog deadline."""
+
+
+class DeviceGuard:
+    """Quarantine latch + automatic re-probe.
+
+    ``timeout_s`` bounds one device round (and one probe);
+    ``reprobe_interval_s`` paces traffic-driven probes while
+    quarantined; ``fail_threshold`` consecutive crashed rounds trip the
+    quarantine without a stall (0 disables that trigger).
+    ``on_change(quarantined: bool)`` fires on every transition (metrics
+    / monitor hookup).
+    """
+
+    def __init__(
+        self,
+        timeout_s: float = 10.0,
+        reprobe_interval_s: float = 1.0,
+        fail_threshold: int = 3,
+        on_change=None,
+    ):
+        self.timeout_s = timeout_s
+        self.reprobe_interval_s = reprobe_interval_s
+        self.fail_threshold = fail_threshold
+        self.on_change = on_change
+        self._lock = threading.Lock()
+        self.quarantined = False
+        self.reason = ""
+        self.stalls = 0
+        self.quarantine_events = 0
+        self.probes = 0
+        self._crash_streak = 0
+        # Set by record_failure, consumed by record_ok: a round that
+        # CONTAINED a failure (typed errors, host fallback) still
+        # completes, and its record_ok must not reset the streak — only
+        # a genuinely clean round does.
+        self._tainted = False
+        self._probe_inflight = False
+        self._last_probe = 0.0
+        self._quarantined_at = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_s > 0
+
+    # -- transitions ------------------------------------------------------
+
+    def quarantine(self, reason: str) -> None:
+        with self._lock:
+            if self.quarantined:
+                return
+            self.quarantined = True
+            self.reason = reason
+            self.quarantine_events += 1
+            self._quarantined_at = time.monotonic()
+            # The next probe may fire immediately.
+            self._last_probe = 0.0
+        log.warning("device quarantined: %s", reason)
+        if self.on_change is not None:
+            try:
+                self.on_change(True)
+            except Exception:  # noqa: BLE001 — hook must not poison state
+                log.exception("quarantine on_change hook failed")
+
+    def record_stall(self, reason: str = "device-stall") -> None:
+        with self._lock:
+            self.stalls += 1
+        self.quarantine(reason)
+
+    def record_failure(self, reason: str = "model-error") -> None:
+        """One crashed/contained-failed dispatch round; quarantine on a
+        streak of them."""
+        with self._lock:
+            self._crash_streak += 1
+            self._tainted = True
+            trip = (
+                self.fail_threshold
+                and self._crash_streak >= self.fail_threshold
+            )
+        if trip:
+            self.quarantine(f"{reason} x{self._crash_streak}")
+
+    def record_ok(self) -> None:
+        """End of a completed round: resets the streak ONLY if the
+        round recorded no contained failure (a pump/judge crash that
+        was answered with typed errors still counts toward the
+        poisoned-engine streak)."""
+        with self._lock:
+            if self._tainted:
+                self._tainted = False
+                return
+            self._crash_streak = 0
+
+    def _heal(self) -> None:
+        with self._lock:
+            if not self.quarantined:
+                return
+            self.quarantined = False
+            self.reason = ""
+            self._crash_streak = 0
+            self._tainted = False
+        log.warning("device un-quarantined (probe succeeded)")
+        if self.on_change is not None:
+            try:
+                self.on_change(False)
+            except Exception:  # noqa: BLE001
+                log.exception("quarantine on_change hook failed")
+
+    # -- re-probe ---------------------------------------------------------
+
+    def maybe_reprobe(self, probe_fn) -> None:
+        """Traffic-driven: called once per dispatch round.  At most one
+        probe in flight; paced by ``reprobe_interval_s``.  The probe
+        runs ``probe_fn`` on a fresh single-thread executor bounded by
+        ``timeout_s`` — a probe that hangs is abandoned with its
+        executor and quarantine holds."""
+        if not self.quarantined:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if self._probe_inflight:
+                return
+            if now - self._last_probe < self.reprobe_interval_s:
+                return
+            self._probe_inflight = True
+            self._last_probe = now
+            self.probes += 1
+
+        def run() -> None:
+            ex = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="device-probe"
+            )
+            try:
+                fut = ex.submit(probe_fn)
+                fut.result(self.timeout_s or 5.0)
+            except Exception:  # noqa: BLE001 — timeout or device error
+                log.debug("device re-probe failed; quarantine holds")
+            else:
+                self._heal()
+            finally:
+                ex.shutdown(wait=False)
+                with self._lock:
+                    self._probe_inflight = False
+
+        threading.Thread(
+            target=run, daemon=True, name="device-reprobe"
+        ).start()
+
+    # -- observability ----------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            out = {
+                "quarantined": self.quarantined,
+                "reason": self.reason,
+                "stalls": self.stalls,
+                "quarantine_events": self.quarantine_events,
+                "probes": self.probes,
+            }
+            if self.quarantined:
+                out["quarantined_for_s"] = round(
+                    time.monotonic() - self._quarantined_at, 3
+                )
+            return out

@@ -38,6 +38,7 @@ import socket
 import struct
 import threading
 import time
+from concurrent.futures import TimeoutError as _FuturesTimeout
 
 import numpy as np
 
@@ -45,11 +46,13 @@ from ..models.base import ConstVerdict
 from ..proxylib import instance as pl
 from ..proxylib.accesslog import EntryType, LogEntry
 from ..proxylib.npds import policy_from_dict
-from ..proxylib.types import DROP, MORE, PASS, FilterResult
+from ..proxylib.types import DROP, ERROR, MORE, PASS, FilterResult, OpError
 from ..runtime.batch import R2d2BatchEngine
+from ..utils import metrics
 from ..utils.option import DaemonConfig
 from . import wire
 from .dispatch import BatchDispatcher
+from .guard import DeviceGuard
 
 log = logging.getLogger(__name__)
 
@@ -69,9 +72,10 @@ def _gather_model(model, blob, offs, lens, remotes, width: int):
 class _SidecarConn:
     """Service-side state for one datapath connection."""
 
-    __slots__ = ("conn", "client", "bufs", "engine", "fast_ok", "skip")
+    __slots__ = ("conn", "client", "bufs", "engine", "fast_ok", "skip",
+                 "module_id", "demoted_mod")
 
-    def __init__(self, conn, client, engine):
+    def __init__(self, conn, client, engine, module_id: int = 0):
         self.conn = conn  # in-process oracle Connection
         self.client = client
         # Mirror of the datapath's unconsumed buffer, per direction
@@ -84,6 +88,12 @@ class _SidecarConn:
         # frame prefix, reference: libcilium.h OnData comment); they are
         # consumed on arrival without re-parsing.
         self.skip = {False: 0, True: 0}
+        self.module_id = module_id
+        # Set while this conn has been demoted off a quarantined device
+        # engine onto the oracle path; remembers the module so the
+        # engine can be rebound once the device heals and the oracle
+        # residue drains.
+        self.demoted_mod = None
 
 
 class _TabSnap:
@@ -152,11 +162,31 @@ class VerdictService:
     def __init__(self, socket_path: str, config: DaemonConfig | None = None):
         self.socket_path = socket_path
         self.config = config or DaemonConfig()
+        # Overload & fault containment: the guard owns the quarantine
+        # state machine (device -> quarantine -> host fallback), the
+        # dispatcher enforces the admission cap and the round watchdog
+        # (-> shed).  All rungs of the ladder are typed and observable.
+        self.guard = DeviceGuard(
+            timeout_s=self.config.device_call_timeout_s,
+            reprobe_interval_s=self.config.device_reprobe_interval_s,
+            fail_threshold=self.config.device_fail_threshold,
+            on_change=self._on_quarantine_change,
+        )
+        self._queue_age_s = self.config.shed_queue_age_ms / 1000.0
         self.dispatcher = BatchDispatcher(
             self._process,
             max_batch=self.config.batch_flows,
             timeout_ms=self.config.batch_timeout_ms,
+            max_pending=self.config.shed_queue_entries,
+            stall_timeout_s=self.config.device_call_timeout_s,
+            on_batch_error=self._on_batch_error,
+            on_stall=self._on_dispatch_stall,
         )
+        # Containment telemetry (status/metrics).
+        self.shed_entries = 0
+        self.batch_crashes = 0
+        self.fallback_entries = 0
+        self.error_entries = 0
         self._lock = threading.Lock()  # conn/engine registry
         self._conns: dict[int, _SidecarConn] = {}
         self._engines: dict[tuple, object] = {}
@@ -271,9 +301,23 @@ class VerdictService:
 
     def stop(self) -> None:
         self._stopped = True
-        try:
-            if self._listener is not None:
+        # shutdown BEFORE close: the acceptor thread parked in accept()
+        # holds the fd, and a bare close() defers the kernel teardown —
+        # the listener would keep accepting into its backlog and a
+        # reconnecting shim would attach to this ZOMBIE service (whose
+        # dispatcher is dead) instead of failing over to the restarted
+        # one.  Unlink the path immediately for the same reason.
+        if self._listener is not None:
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._listener.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.socket_path)
         except OSError:
             pass
         # Close shim connections so their reader/writer peers see EOF
@@ -296,10 +340,8 @@ class VerdictService:
             self._completion_thread.join(timeout=5)
         if self._send_thread is not None:
             self._send_thread.join(timeout=5)
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
+        # (The socket path was unlinked up front — a second unlink here
+        # could delete a RESTARTED service's fresh socket.)
         if self._prev_switch_interval is not None:
             import sys
 
@@ -311,6 +353,14 @@ class VerdictService:
             try:
                 sock, _ = self._listener.accept()
             except OSError:
+                return
+            if self._stopped:
+                # Raced stop(): never hand a connection to a dead
+                # service — the peer must see EOF and fail over.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 return
             client = _ClientHandler(self, sock)
             with self._lock:
@@ -345,6 +395,21 @@ class VerdictService:
                 "entries": self.dispatcher.entries,
                 "fill": self.dispatcher.fill_dispatches,
                 "deadline": self.dispatcher.deadline_dispatches,
+                "queue_depth": self.dispatcher.pending_weight,
+                "queue_oldest_ms": round(
+                    self.dispatcher.oldest_age_s() * 1e3, 3
+                ),
+                "stall_deposals": self.dispatcher.stall_deposals,
+                "shed_submits": self.dispatcher.shed_submits,
+            },
+            # Degradation ladder: device -> quarantine -> host fallback
+            # -> shed.  Every rung typed and counted.
+            "containment": {
+                "shed_entries": self.shed_entries,
+                "error_entries": self.error_entries,
+                "batch_crashes": self.batch_crashes,
+                "fallback_entries": self.fallback_entries,
+                **self.guard.status(),
             },
         }
 
@@ -400,7 +465,7 @@ class VerdictService:
         )
         if res != FilterResult.OK:
             return int(res)
-        sc = _SidecarConn(conn, client, None)
+        sc = _SidecarConn(conn, client, None, module_id=module_id)
         self._bind_engine(module_id, sc)
         with self._lock:
             self._conns[conn_id] = sc
@@ -470,6 +535,10 @@ class VerdictService:
                 buffered = bool(flow.buffer)
             else:  # device-assisted engines: per-direction buffers
                 buffered = bool(flow.bufs[False] or flow.bufs[True])
+            # A flow that tripped the retained-bytes cap is dead: keep
+            # it off the vec path so every further entry re-surfaces
+            # the typed error through the engine feed.
+            buffered = buffered or getattr(flow, "overflowed", False)
         return bool(
             buffered
             or sc.bufs[False]
@@ -510,6 +579,12 @@ class VerdictService:
         proto = conn.parser_name
         if proto not in ("r2d2", "cassandra", "memcache", "http"):
             return  # other protocols: oracle path
+        if self.guard.quarantined:
+            # Never build/prewarm against a quarantined device (the
+            # compile would hang this reader thread).  The conn starts
+            # on the oracle path and is bound once the device heals.
+            sc.demoted_mod = module_id
+            return
         key = (module_id, conn.policy_name, conn.ingress, conn.port, proto)
         with self._lock:
             eng = self._engines.get(key)
@@ -544,6 +619,7 @@ class VerdictService:
                 capacity=self.config.batch_flows,
                 width=self.config.batch_width,
                 logger=ins.access_logger,
+                max_buffer=self.config.max_flow_buffer,
             )
             self.prewarm(eng)
             return eng
@@ -568,11 +644,20 @@ class VerdictService:
 
             model = build_memcache_model(policy, conn.ingress, conn.port)
             cls = MemcacheBatchEngine
-        return cls(
+        eng = cls(
             policy, conn.ingress, conn.port, model,
             logger=ins.access_logger,
             capacity=self.config.batch_flows,
+            max_buffer=self.config.max_flow_buffer,
         )
+        # Containment hooks: the judge step is skipped while the device
+        # is quarantined (host policy.matches fallback, bit-identical),
+        # and judge crashes count toward the poisoned-engine threshold.
+        eng.device_gate = lambda: not self.guard.quarantined
+        eng.device_fail_hook = lambda exc: self.guard.record_failure(
+            f"judge-crash: {type(exc).__name__}"
+        )
+        return eng
 
     def close_connection(self, conn_id: int, expect=None) -> None:
         # Routed through the dispatcher by the caller so in-flight data
@@ -596,17 +681,21 @@ class VerdictService:
 
     def submit_data(self, client, batch: wire.DataBatch,
                     backlogged: bool = False) -> None:
+        batch.arrival = time.monotonic()
         item = ("data", client, batch)
         if not backlogged and self._try_cut_through(item):
             return
-        self.dispatcher.submit(item, weight=batch.count)
+        if not self.dispatcher.submit(item, weight=batch.count):
+            self._shed_item(item, "queue_full")
 
     def submit_matrix(self, client, mb: wire.MatrixBatch,
                       backlogged: bool = False) -> None:
+        mb.arrival = time.monotonic()
         item = ("mat", client, mb)
         if not backlogged and self._try_cut_through(item):
             return
-        self.dispatcher.submit(item, weight=mb.count)
+        if not self.dispatcher.submit(item, weight=mb.count):
+            self._shed_item(item, "queue_full")
 
     def _try_cut_through(self, item) -> bool:
         """Greedy-mode cut-through: process the round directly on the
@@ -642,8 +731,14 @@ class VerdictService:
             self.inline_batches += 1
             try:
                 self._process([item])
-            except Exception:  # noqa: BLE001 — reader must survive
+            except Exception as exc:  # noqa: BLE001 — reader must survive
                 log.exception("cut-through process failed")
+                # Same crash containment as the dispatcher path: every
+                # entry gets a typed error verdict, never a silent drop.
+                try:
+                    self._on_batch_error([item], exc)
+                except Exception:  # noqa: BLE001
+                    log.exception("cut-through error containment failed")
         finally:
             disp._in_process_lock.release()
         return True
@@ -780,7 +875,181 @@ class VerdictService:
     def submit_close(self, conn_id: int) -> None:
         with self._lock:
             sc = self._conns.get(conn_id)
-        self.dispatcher.submit(("close", conn_id, sc), weight=0)
+        # force: a close must never be shed, or the conn leaks.
+        self.dispatcher.submit(("close", conn_id, sc), weight=0, force=True)
+
+    # -- fault containment -------------------------------------------------
+
+    def _on_quarantine_change(self, quarantined: bool) -> None:
+        metrics.DeviceQuarantined.set(1.0 if quarantined else 0.0)
+        if quarantined:
+            metrics.DeviceQuarantineEvents.inc()
+
+    def _typed_entries(self, batch, result: int) -> list:
+        """One typed (conn_id, result, no-ops) response per entry — the
+        fail-closed shape for shed/crash verdicts (any non-OK result is
+        a connection error to the datapath consumer)."""
+        return [
+            (int(cid), int(result), [], b"", b"")
+            for cid in batch.conn_ids
+        ]
+
+    def _shed_item(self, item, reason: str) -> None:
+        """Fail-closed DROP with a typed SHED response — the admission
+        queue never hangs or silently drops an entry."""
+        _, client, batch = item
+        n = batch.count
+        self.shed_entries += n
+        metrics.SidecarShedTotal.inc(reason, amount=n)
+        try:
+            client.send_verdicts(
+                batch.seq, self._typed_entries(batch, FilterResult.SHED)
+            )
+        except Exception:  # noqa: BLE001 — client may be gone
+            log.exception("shed response send failed")
+
+    def _on_batch_error(self, items: list, exc: BaseException) -> None:
+        """Crash containment: a failed process(batch) produces typed
+        per-entry error verdicts for EVERY entry in the batch instead of
+        being swallowed — no client blocks on a crashed round."""
+        self.batch_crashes += 1
+        metrics.SidecarBatchCrashes.inc()
+        self.guard.record_failure(f"batch-crash: {type(exc).__name__}")
+        for it in items:
+            if it[0] == "close":
+                try:
+                    self.close_connection(*it[1:])
+                except Exception:  # noqa: BLE001
+                    log.exception("close during crash containment failed")
+                continue
+            _, client, batch = it
+            n = batch.count
+            self.error_entries += n
+            try:
+                client.send_verdicts(
+                    batch.seq,
+                    self._typed_entries(batch, FilterResult.UNKNOWN_ERROR),
+                )
+            except Exception:  # noqa: BLE001
+                log.exception("error response send failed")
+
+    def _on_dispatch_stall(self, items: list) -> None:
+        """Watchdog deposed a stuck round (device hang): quarantine the
+        device and shed the stuck batch with typed verdicts — the
+        deposed worker's own late sends are generation-suppressed."""
+        self.guard.record_stall("dispatch-stall")
+        metrics.DeviceStalls.inc()
+        for it in items:
+            if it[0] == "close":
+                # Re-queue for the replacement worker; never lost.
+                self.dispatcher.submit(it, weight=0, force=True)
+                continue
+            self._shed_item(it, "stall")
+
+    def _device_probe(self) -> None:
+        """One real device round (used by quarantine re-probes): prefer
+        an r2d2 engine's own model; fall back to a bare device op when
+        no row-shaped model exists.  Raises/hangs exactly when the
+        device path is still unhealthy."""
+        with self._lock:
+            eng = next(
+                (
+                    e for e in self._engines.values()
+                    if isinstance(e, R2d2BatchEngine)
+                    and not isinstance(e.model, ConstVerdict)
+                ),
+                None,
+            )
+        if eng is not None:
+            b = self._min_bucket
+            w = self.config.batch_width
+            with self._device_ctx():
+                out = eng.model(
+                    np.zeros((b, w), np.uint8),
+                    np.zeros(b, np.int32),
+                    np.zeros(b, np.int32),
+                )
+            np.asarray(out[-1])
+            return
+        import jax
+        import jax.numpy as jnp
+
+        with self._device_ctx():
+            jax.device_get(jnp.ones(8))
+
+    def _admit(self, items: list) -> list:
+        """Admission pass at dispatch time: shed entries whose wire
+        deadline or queue age passed while queued, pace quarantine
+        re-probes, and sample queue-depth telemetry."""
+        self.guard.maybe_reprobe(self._device_probe)
+        metrics.SidecarQueueDepth.set(float(self.dispatcher.pending_weight))
+        now = time.monotonic()
+        kept = []
+        for it in items:
+            if it[0] == "close":
+                kept.append(it)
+                continue
+            b = it[2]
+            expired = (
+                b.deadline is not None and now > b.deadline
+            ) or (
+                self._queue_age_s
+                and b.arrival
+                and now - b.arrival > self._queue_age_s
+            )
+            if expired:
+                self._shed_item(it, "deadline")
+            else:
+                kept.append(it)
+        return kept
+
+    def _demote_to_oracle(self, conn_id: int, sc: "_SidecarConn") -> None:
+        """Move a conn off a quarantined pure-device engine onto the
+        in-process oracle path, migrating the engine's retained request
+        bytes into the oracle buffer mirror so no byte is lost or
+        replayed.  The oracle IS the definition of bit-exactness, so
+        verdicts keep flowing unchanged while the device is out."""
+        engine = sc.engine
+        if engine is None:
+            return
+        flow = engine.flows.pop(conn_id, None)
+        if flow is not None and getattr(flow, "buffer", None):
+            # Engine-retained request bytes precede anything the oracle
+            # mirror may hold for this direction.
+            sc.bufs[False] = bytearray(flow.buffer) + sc.bufs[False]
+        sc.engine = None
+        sc.fast_ok = False
+        sc.demoted_mod = sc.module_id
+        with self._lock:
+            if conn_id < self._tab_size:
+                self._tab_engine[conn_id] = -1
+                self._tab_dirty[conn_id] = 1
+
+    def _maybe_rebind(self, conn_id: int, sc: "_SidecarConn") -> None:
+        """Un-demote after the device heals: once the oracle residue has
+        drained, bind the engine back so the conn resumes the device
+        path."""
+        if (
+            sc.demoted_mod is None
+            or sc.bufs[False]
+            or sc.bufs[True]
+            or sc.skip[False]
+            or sc.skip[True]
+        ):
+            return
+        mod = sc.demoted_mod
+        sc.demoted_mod = None
+        try:
+            self._bind_engine(mod, sc)
+        except Exception:  # noqa: BLE001 — stay on the oracle path
+            log.exception("engine rebind after heal failed")
+            sc.engine = None
+            sc.fast_ok = False
+            return
+        with self._lock:
+            self._tab_set_engine(
+                conn_id, sc.engine if sc.fast_ok else None
+            )
 
     def _process(self, items: list) -> None:
         """Dispatcher entry: triage aggregated items.
@@ -793,13 +1062,19 @@ class VerdictService:
         shares a connection with an entrywise batch in the same round,
         preserving per-connection op order.
         """
+        items = self._admit(items)
         closes = [it[1:] for it in items if it[0] == "close"]
         data_items = [it for it in items if it[0] in ("data", "mat")]
+        # Quarantined device: the whole round bypasses the vectorized
+        # paths and renders through the host fallback (entrywise) —
+        # bounded-latency degradation, never a hang.
+        quarantined = self.guard.quarantined
         # Whole-round fast path (greedy mode): every data item a
         # complete-flag matrix batch of the configured width — one
         # grouped eligibility/dispatch/readback/response pass.
         if (
-            self._inline_complete
+            not quarantined
+            and self._inline_complete
             and data_items
             and all(
                 it[0] == "mat"
@@ -811,6 +1086,7 @@ class VerdictService:
         ):
             for close_args in closes:
                 self.close_connection(*close_args)
+            self.guard.record_ok()
             return
         # Snapshot the conn tables under the lock once per round: the
         # eligibility checks and chunk issue below run lock-free on the
@@ -821,7 +1097,11 @@ class VerdictService:
         vec: list[tuple] = []  # (item, engine) — item kind "data" or "mat"
         general: list = []  # (arrival_idx, item)
         for k, it in enumerate(data_items):
-            if it[0] == "mat":
+            if quarantined:
+                eng = None
+                if it[0] == "mat":
+                    it = ("data", it[1], _matrix_to_batch(it[2]))
+            elif it[0] == "mat":
                 eng = self._matrix_eligible(it[2], snap)
                 if eng is None:
                     it = ("data", it[1], _matrix_to_batch(it[2]))
@@ -853,6 +1133,9 @@ class VerdictService:
             self._process_entrywise([it for _, it in general])
         for close_args in closes:
             self.close_connection(*close_args)
+        # The round completed without raising — reset the poisoned-
+        # engine crash streak.
+        self.guard.record_ok()
 
     def _tab_snapshot(self, data_items: list) -> "_TabSnap | None":
         if not data_items:
@@ -1393,7 +1676,21 @@ class VerdictService:
                 return
             recs, vals_f, n_futs = item
             try:
-                vals = vals_f.result() if vals_f is not None else []
+                # Bounded wait: a readback stalled past the device
+                # deadline quarantines the device and fails THIS group
+                # closed (typed deny) instead of wedging the strictly-
+                # FIFO send pipeline behind it forever.
+                timeout = (
+                    self.guard.timeout_s if self.guard.enabled else None
+                )
+                vals = vals_f.result(timeout) if vals_f is not None else []
+            except _FuturesTimeout:
+                # (concurrent.futures.TimeoutError is a distinct class
+                # from the builtin TimeoutError before py3.11)
+                log.error("device readback stalled; quarantining")
+                self.guard.record_stall("readback-stall")
+                metrics.DeviceStalls.inc()
+                vals = [None] * n_futs
             except Exception:  # noqa: BLE001
                 log.exception("device readback failed")
                 vals = [None] * n_futs
@@ -1490,6 +1787,7 @@ class VerdictService:
         slow: list[tuple] = []
         slow_conns: set[int] = set()
 
+        quarantined = self.guard.quarantined
         for item in items:
             _, client, batch = item
             key = id(item)
@@ -1508,6 +1806,19 @@ class VerdictService:
                         b"",
                     )
                     continue
+                if quarantined:
+                    # Pure-device engines (no oracle inside) fall back
+                    # to the in-process oracle; device-assisted engines
+                    # keep their engine (the device_gate makes their
+                    # judge step a host policy.matches, bit-identical).
+                    if sc.engine is not None and not getattr(
+                        sc.engine, "handles_reply", False
+                    ):
+                        self._demote_to_oracle(conn_id, sc)
+                    self.fallback_entries += 1
+                    metrics.SidecarFallbackVerdicts.inc()
+                elif sc.demoted_mod is not None:
+                    self._maybe_rebind(conn_id, sc)
                 if sc.skip[reply]:
                     take = min(sc.skip[reply], len(data))
                     sc.skip[reply] -= take
@@ -1526,7 +1837,10 @@ class VerdictService:
                     and not reply
                     and conn_id not in slow_conns
                     and not sc.bufs[False]
-                    and (eng_flow is None or not eng_flow.buffer)
+                    and (
+                        eng_flow is None
+                        or not (eng_flow.buffer or eng_flow.overflowed)
+                    )
                     and not isinstance(sc.engine.model, ConstVerdict)
                     and len(data) >= 2
                     and data.endswith(b"\r\n")
@@ -1676,7 +1990,7 @@ class VerdictService:
             if not extractable:
                 # ConstVerdict engines, oracle conns, reply, end_stream:
                 # all host-only here (see _slow_async_eligible).
-                responses[key][i] = self._run_slow(
+                responses[key][i] = self._run_slow_safe(
                     sc, conn_id, reply, end_stream, data
                 )
                 oracle_marks.append((conn_id, sc))
@@ -1881,17 +2195,33 @@ class VerdictService:
 
         for wave in waves:
             engines: dict[int, object] = {}
+            failed: set[int] = set()
             for (key, i, sc, conn_id, reply, end_stream, data), engine in wave:
                 self._feed_engine(engine, sc, conn_id, reply, data)
                 engines[id(engine)] = engine
-            for engine in engines.values():
-                engine.pump()
+            for eid, engine in engines.items():
+                try:
+                    engine.pump()
+                except Exception as exc:  # noqa: BLE001 — contain per engine
+                    log.exception("engine pump failed")
+                    self.guard.record_failure(
+                        f"pump-crash: {type(exc).__name__}"
+                    )
+                    failed.add(eid)
             for (key, i, sc, conn_id, reply, end_stream, data), engine in wave:
-                responses[key][i] = self._take_engine(engine, conn_id, reply)
+                if id(engine) in failed:
+                    self.error_entries += 1
+                    responses[key][i] = (
+                        conn_id, int(FilterResult.UNKNOWN_ERROR), [], b"", b"",
+                    )
+                else:
+                    responses[key][i] = self._take_engine(
+                        engine, conn_id, reply
+                    )
                 self._tab_mark(conn_id, sc)
         for rec in leftovers:
             key, i, sc, conn_id, reply, end_stream, data = rec
-            responses[key][i] = self._run_slow(
+            responses[key][i] = self._run_slow_safe(
                 sc, conn_id, reply, end_stream, data
             )
             self._tab_mark(conn_id, sc)
@@ -1941,6 +2271,18 @@ class VerdictService:
             inj_r,
         )
 
+    def _run_slow_safe(self, sc: _SidecarConn, conn_id: int, reply: bool,
+                       end_stream: bool, data: bytes):
+        """Per-entry crash containment: one entry's failure yields a
+        typed error verdict for THAT entry instead of crashing the whole
+        round (the dispatcher's on_batch_error remains the backstop)."""
+        try:
+            return self._run_slow(sc, conn_id, reply, end_stream, data)
+        except Exception:  # noqa: BLE001
+            log.exception("entry processing failed (conn %d)", conn_id)
+            self.error_entries += 1
+            return (conn_id, int(FilterResult.UNKNOWN_ERROR), [], b"", b"")
+
     def _run_slow(self, sc: _SidecarConn, conn_id: int, reply: bool,
                   end_stream: bool, data: bytes):
         """Stateful path: request direction through the batch engine when
@@ -1959,6 +2301,26 @@ class VerdictService:
         # Oracle path: mirror the datapath buffer, loop while the parser
         # fills the op array (reference: cilium_proxylib.cc:301 do-while).
         buf = sc.bufs[reply]
+        cap = self.config.max_flow_buffer
+        if cap and len(buf) + len(data) > cap:
+            # Bounded retained-data contract: a flow buffering past the
+            # cap without a frame boundary gets a typed protocol-error
+            # DROP of everything retained + incoming, and dies.  Result
+            # stays OK so the shim APPLIES the DROP (consuming its
+            # retained bytes) before the ERROR op surfaces PARSER_ERROR.
+            dropped = len(buf) + len(data)
+            buf.clear()
+            metrics.FlowBufferOverflows.inc(sc.conn.parser_name)
+            return (
+                conn_id,
+                int(FilterResult.OK),
+                [
+                    (int(DROP), dropped),
+                    (int(ERROR), int(OpError.ERROR_INVALID_FRAME_LENGTH)),
+                ],
+                b"",
+                b"",
+            )
         buf += data
         all_ops: list[tuple[int, int]] = []
         result = FilterResult.OK
@@ -2042,7 +2404,15 @@ class _ClientHandler:
         self._wlock = threading.Lock()
         self.module_id = 0
 
+    def _suppressed(self) -> bool:
+        """True on a dispatcher worker deposed by the stall watchdog —
+        its batch already received typed shed verdicts, so a late send
+        (after the stall clears) would duplicate/interleave replies."""
+        return self.service.dispatcher.thread_is_deposed()
+
     def send(self, msg_type: int, payload: bytes) -> None:
+        if self._suppressed():
+            return
         with self._wlock:
             try:
                 wire.send_msg(self.sock, msg_type, payload)
@@ -2051,6 +2421,8 @@ class _ClientHandler:
 
     def send_frames(self, msg_type: int, payloads: list[bytes]) -> None:
         """One sendall for a round's worth of frames to this client."""
+        if self._suppressed():
+            return
         buf = b"".join(
             wire.HEADER.pack(wire.MAGIC, msg_type, len(p)) + p
             for p in payloads
@@ -2104,6 +2476,12 @@ class _ClientHandler:
     def _parse_data(msg_type: int, payload: bytes):
         if msg_type == wire.MSG_DATA_BATCH:
             return ("data", wire.unpack_data_batch(payload))
+        if msg_type == wire.MSG_DATA_BATCH_DL:
+            budget_s, batch = wire.unpack_data_batch_dl(payload)
+            # Anchor the relative budget to this host's monotonic clock
+            # at receive: entries still queued past it are shed typed.
+            batch.deadline = time.monotonic() + budget_s
+            return ("data", batch)
         return ("mat", wire.unpack_data_matrix(payload))
 
     def read_loop(self) -> None:
@@ -2112,7 +2490,11 @@ class _ClientHandler:
         try:
             while True:
                 msg_type, payload = reader.recv_msg()
-                if msg_type in (wire.MSG_DATA_BATCH, wire.MSG_DATA_MATRIX):
+                if msg_type in (
+                    wire.MSG_DATA_BATCH,
+                    wire.MSG_DATA_BATCH_DL,
+                    wire.MSG_DATA_MATRIX,
+                ):
                     kind, batch = self._parse_data(msg_type, payload)
                     # Backlog probe: bytes already buffered behind this
                     # frame mean the reader is behind — route to the

@@ -58,6 +58,14 @@ MSG_STATUS_REPLY = 12
 # over all entries.  Sent only to clients that speak the matrix format
 # (the C++ shim uses DATA_BATCH/VERDICT_BATCH and never sees this).
 MSG_VERDICT_MULTI = 13
+# DATA_BATCH with a deadline budget prepended: {deadline_us u32} + the
+# standard DATA_BATCH payload.  The budget is RELATIVE (microseconds of
+# remaining patience at send time) so no clock sync is needed; the
+# service anchors it to its own monotonic clock at receive.  Entries
+# whose deadline passes while queued are shed with a typed SHED verdict
+# — the fail-closed alternative to a silent queue hang.  Old clients
+# (incl. the native shim) keep sending plain DATA_BATCH.
+MSG_DATA_BATCH_DL = 14
 
 # OnIO op capacity per verdict entry (reference: cilium_proxylib.cc:199).
 MAX_OPS_PER_ENTRY = 16
@@ -248,6 +256,11 @@ class DataBatch:
     lengths: np.ndarray  # u32[n]
     blob: bytes  # concatenated entry payloads
     _offsets: np.ndarray | None = None
+    # Containment bookkeeping (service-side, never serialized): absolute
+    # monotonic deadline from a DATA_BATCH_DL budget, and arrival time
+    # for the queue-age watermark.
+    deadline: float | None = None
+    arrival: float = 0.0
 
     @property
     def count(self) -> int:
@@ -307,6 +320,21 @@ def unpack_data_batch(payload: bytes) -> DataBatch:
     return DataBatch(seq, conn_ids, flags, lengths, payload[off:])
 
 
+def pack_data_batch_dl(
+    deadline_us: int, seq: int, conn_ids, flags, lengths, blob: bytes
+) -> bytes:
+    """DATA_BATCH with a relative deadline budget (µs, capped at u32)."""
+    return struct.pack("<I", min(int(deadline_us), 0xFFFFFFFF)) + (
+        pack_data_batch(seq, conn_ids, flags, lengths, blob)
+    )
+
+
+def unpack_data_batch_dl(payload: bytes) -> tuple[float, DataBatch]:
+    """Returns (deadline budget in seconds, batch)."""
+    (deadline_us,) = struct.unpack_from("<I", payload, 0)
+    return deadline_us / 1e6, unpack_data_batch(payload[4:])
+
+
 # --- DATA_MATRIX ---------------------------------------------------------
 
 @dataclass
@@ -317,6 +345,9 @@ class MatrixBatch:
     lengths: np.ndarray  # u32[n]
     rows: np.ndarray  # u8[n, width], zero-padded past lengths
     flags: int = 0  # MAT_FLAG_* bits
+    # Containment bookkeeping (service-side, never serialized).
+    deadline: float | None = None
+    arrival: float = 0.0
 
     @property
     def count(self) -> int:

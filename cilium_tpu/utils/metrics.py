@@ -207,3 +207,50 @@ KvstoreDegradedEvents = registry.counter(
     "kvstore_degraded_events_total",
     "Transitions into kvstore degraded mode",
 )
+
+# Sidecar verdict-path overload & fault containment.  The degradation
+# ladder is device -> quarantine -> host fallback -> shed; every rung
+# is observable here and in `cilium sidecar status`.
+SidecarShedTotal = registry.counter(
+    "sidecar_shed_total",
+    "Verdict entries shed with a typed SHED response "
+    "(queue_full | deadline | stall)",
+    ("reason",),
+)
+SidecarBatchCrashes = registry.counter(
+    "sidecar_batch_crashes_total",
+    "Dispatch rounds that crashed; every in-flight entry received a "
+    "typed error verdict",
+)
+SidecarFallbackVerdicts = registry.counter(
+    "sidecar_fallback_verdicts_total",
+    "Verdict entries served by the bit-identical host/oracle fallback "
+    "while the device was quarantined",
+)
+DeviceStalls = registry.counter(
+    "device_stalls_total",
+    "Device calls that exceeded the watchdog deadline",
+)
+DeviceQuarantined = registry.gauge(
+    "device_quarantined",
+    "1 while the verdict device/engine is quarantined and verdicts flow "
+    "through the host fallback",
+)
+DeviceQuarantineEvents = registry.counter(
+    "device_quarantine_events_total",
+    "Transitions into device quarantine",
+)
+SidecarQueueDepth = registry.gauge(
+    "sidecar_queue_depth",
+    "Verdict admission-queue depth (entries) sampled per dispatch round",
+)
+SidecarClientReconnects = registry.counter(
+    "sidecar_client_reconnects_total",
+    "Successful shim-client reconnects to the verdict service",
+)
+FlowBufferOverflows = registry.counter(
+    "flow_buffer_overflow_total",
+    "Flows dropped for exceeding the retained-bytes cap without a "
+    "frame boundary (typed protocol-error DROP + close)",
+    ("proto",),
+)

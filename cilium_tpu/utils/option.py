@@ -156,6 +156,37 @@ class DaemonConfig:
     # with the verdict-compute term removed.  Never a production config.
     seam_probe: bool = False
 
+    # Overload & fault containment (sidecar verdict path).  The
+    # contract is bounded-latency degradation, never availability loss:
+    # a stuck device call quarantines the device (verdicts continue
+    # through the bit-identical host/oracle fallback), and a burst past
+    # capacity sheds with a typed SHED verdict instead of queueing
+    # unboundedly or hanging the caller.
+    #
+    # Upper bound on one device round (model call / readback) before
+    # the watchdog deposes the dispatch worker and quarantines the
+    # device.  Must comfortably exceed worst-case XLA compile times on
+    # the deployment's device link; 0 disables the watchdog.
+    device_call_timeout_s: float = 10.0
+    # While quarantined, how often traffic re-probes the device for
+    # automatic un-quarantine.
+    device_reprobe_interval_s: float = 1.0
+    # Consecutive crashed dispatch rounds before the device/engine is
+    # treated as poisoned and quarantined (0 disables).
+    device_fail_threshold: int = 3
+    # Admission-queue watermarks: pending entries beyond this are shed
+    # at submit (0 = unbounded), and queued entries older than this are
+    # shed at dispatch (0 = no age bound).  Entries may also carry an
+    # explicit per-entry deadline from the shim (wire DATA_BATCH_DL),
+    # which takes precedence over the age watermark.
+    shed_queue_entries: int = 1 << 17
+    shed_queue_age_ms: float = 5000.0
+    # Per-flow retained-bytes cap (engine flow buffers and the service's
+    # oracle buffer mirror): a flow that buffers more than this without
+    # a frame boundary gets a typed protocol-error DROP and is closed,
+    # matching the reference's bounded retained-data contract.
+    max_flow_buffer: int = 1 << 20
+
     # Modes
     dry_mode: bool = False  # reference: DryMode, pkg/endpoint/bpf.go:510
     restore_state: bool = True
@@ -192,6 +223,15 @@ class DaemonConfig:
             raise ValueError(f"invalid verdict_device {self.verdict_device!r}")
         if self.cluster_id < 0 or self.cluster_id > 255:
             raise ValueError("cluster-id must be in [0, 255]")
+        if (
+            self.device_call_timeout_s < 0
+            or self.device_reprobe_interval_s < 0
+            or self.device_fail_threshold < 0
+            or self.shed_queue_entries < 0
+            or self.shed_queue_age_ms < 0
+            or self.max_flow_buffer < 0
+        ):
+            raise ValueError("containment thresholds must be non-negative")
 
 
 # Global config (reference: option.Config singleton).

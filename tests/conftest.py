@@ -28,3 +28,56 @@ def pytest_configure(config):
         "slow: long soak/chaos tests excluded from the tier-1 run "
         "(-m 'not slow')",
     )
+
+
+# --- thread-leak guard -----------------------------------------------------
+#
+# A hung BatchDispatcher worker or a leaked non-daemon thread used to eat
+# the whole tier-1 timeout before anything failed.  This fixture makes the
+# hang fail FAST and NAMED: after each test module, any surviving
+# dispatcher worker or module-spawned non-daemon thread fails that module
+# with the thread list in the message.
+
+import threading
+
+import pytest
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_leaked_threads():
+    baseline = set(threading.enumerate())
+    yield
+    GRACE_S = 5.0
+    deadline = None
+
+    def _offenders():
+        dispatchers = [
+            t for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("verdict-dispatch")
+            and not t.name.endswith("-watchdog")
+        ]
+        nondaemon = [
+            t for t in threading.enumerate()
+            if t.is_alive() and not t.daemon
+            and t is not threading.main_thread()
+            and t not in baseline
+        ]
+        return dispatchers, nondaemon
+
+    import time as _time
+
+    deadline = _time.monotonic() + GRACE_S
+    dispatchers, nondaemon = _offenders()
+    while (dispatchers or nondaemon) and _time.monotonic() < deadline:
+        for t in dispatchers + nondaemon:
+            t.join(timeout=0.25)
+        dispatchers, nondaemon = _offenders()
+    assert not dispatchers, (
+        "stuck BatchDispatcher worker(s) survived the module: "
+        f"{[t.name for t in dispatchers]} — a service was not stopped or "
+        "a dispatch round is hung"
+    )
+    assert not nondaemon, (
+        "leaked non-daemon thread(s) survived the module: "
+        f"{[t.name for t in nondaemon]}"
+    )

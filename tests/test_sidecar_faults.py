@@ -1,0 +1,585 @@
+"""Fault-containment tests for the sidecar verdict hot path.
+
+The contract under test (ISSUE 2): bounded-latency degradation, never
+availability loss.  A hung device call must quarantine the device while
+verdicts continue through the bit-identical host/oracle fallback; a
+crashed batch must produce typed per-entry errors; a burst past
+capacity must shed with typed SHED verdicts; a dead service must fail
+closed and reconnect — and across ALL of it, zero silently dropped or
+hung ``on_io`` calls.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.proxylib import FilterResult
+from cilium_tpu.proxylib import instance as inst
+from cilium_tpu.sidecar import (
+    BatchDispatcher,
+    SidecarClient,
+    SidecarUnavailable,
+    VerdictService,
+)
+from cilium_tpu.utils.option import DaemonConfig
+
+from test_sidecar import CORPUS, assert_parity, oracle_ops, r2d2_policy
+
+
+# A pipelined (two-frame) entry routes through the entrywise engine
+# path, whose model calls dispatch eagerly on the dispatcher thread —
+# the spot where a host-visible stall/crash manifests.  (Single-frame
+# entries ride the vectorized path, whose gather+model executable was
+# jit-compiled at prewarm and never re-enters the Python wrapper.)
+PIPELINED = b"READ /public/a.txt\r\nHALT\r\n"
+
+
+class FaultModel:
+    """Wraps a real verdict model with injectable faults: ``stall``
+    blocks every call until cleared (a hung TPU / compile storm);
+    ``crash`` raises (a poisoned engine)."""
+
+    MAX_STALL_S = 30.0  # leak guard: a stuck thread frees itself in CI
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.stall = threading.Event()
+        self.crash = threading.Event()
+        self.calls = 0
+
+    def __call__(self, data, lengths, remotes):
+        self.calls += 1
+        waited = 0.0
+        while self.stall.is_set() and waited < self.MAX_STALL_S:
+            time.sleep(0.01)
+            waited += 0.01
+        if self.crash.is_set():
+            raise RuntimeError("injected model crash")
+        return self.inner(data, lengths, remotes)
+
+
+@pytest.fixture
+def fault_model(monkeypatch):
+    """Every r2d2 model built by the service is wrapped in a FaultModel;
+    the fixture hands the test the live wrapper(s)."""
+    import cilium_tpu.models.r2d2 as r2d2mod
+
+    built: list[FaultModel] = []
+    orig = r2d2mod.build_r2d2_model
+
+    def wrapped(*a, **kw):
+        m = FaultModel(orig(*a, **kw))
+        built.append(m)
+        return m
+
+    monkeypatch.setattr(r2d2mod, "build_r2d2_model", wrapped)
+    yield built
+    # Never leave a thread parked on the gate (conftest leak guard).
+    for m in built:
+        m.stall.clear()
+        m.crash.clear()
+
+
+def _service(tmp_path, name, **cfg_kw):
+    inst.reset_module_registry()
+    defaults = dict(
+        batch_timeout_ms=2.0,
+        batch_flows=256,
+        dispatch_mode="eager",
+    )
+    defaults.update(cfg_kw)
+    cfg = DaemonConfig(**defaults)
+    return VerdictService(str(tmp_path / f"{name}.sock"), cfg).start()
+
+
+def _open_conn(client, conn_id, policies=None):
+    mod = client.open_module([])
+    assert client.policy_update(mod, policies or [r2d2_policy()]) == int(
+        FilterResult.OK
+    )
+    res, shim = client.new_connection(
+        mod, "r2d2", conn_id, True, 1, 2, "1.1.1.1:1", "2.2.2.2:80",
+        "sidecar-pol",
+    )
+    assert res == int(FilterResult.OK)
+    return mod, shim
+
+
+def _shim_run(client, shim, msgs):
+    out = []
+    for m in msgs:
+        result, entries = client._on_data_rpc(shim.conn_id, False, False, m)
+        ops, inj = [], b""
+        for _, r, eops, _io, ir in entries:
+            assert r == int(FilterResult.OK)
+            ops.extend(eops)
+            inj += ir
+        out.append((ops, inj))
+    return out
+
+
+def _wait(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# --- hung device: quarantine + bit-identical fallback + heal ---------------
+
+def test_hung_model_quarantine_fallback_and_heal(tmp_path, fault_model):
+    """The acceptance scenario: with the model stalled, the service
+    keeps rendering verdicts through the host fallback (bit-identical
+    to the oracle on the same inputs), the stuck round is shed TYPED
+    (no silent hang), and the engine un-quarantines after the stall
+    clears."""
+    svc = _service(
+        tmp_path, "hung",
+        device_call_timeout_s=0.4,
+        device_reprobe_interval_s=0.05,
+        shed_queue_age_ms=0.0,  # keep queued entries alive across the stall
+    )
+    client = SidecarClient(svc.socket_path, timeout=20.0)
+    try:
+        _, shim_a = _open_conn(client, 7001)
+        res, shim_b = client.new_connection(
+            1, "r2d2", 7002, True, 1, 2, "1.1.1.1:1", "2.2.2.2:80",
+            "sidecar-pol",
+        )
+        assert res == int(FilterResult.OK)
+        assert fault_model, "service built no r2d2 model"
+        model = fault_model[0]
+
+        # Baseline: device path, parity with the oracle.
+        assert_parity(
+            _shim_run(client, shim_a, CORPUS), oracle_ops(r2d2_policy(), CORPUS)
+        )
+
+        # Stall the device.  The in-flight round is deposed by the
+        # watchdog and answered with a typed SHED — never a hang.
+        model.stall.set()
+        stalled_result = {}
+
+        def stalled_request():
+            t0 = time.monotonic()
+            result, _ = client._on_data_rpc(
+                shim_a.conn_id, False, False, PIPELINED
+            )
+            stalled_result["result"] = result
+            stalled_result["elapsed"] = time.monotonic() - t0
+
+        t = threading.Thread(target=stalled_request)
+        t.start()
+        _wait(lambda: svc.guard.quarantined, 5.0, "quarantine")
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "stalled on_io call hung"
+        assert stalled_result["result"] == int(FilterResult.SHED)
+        assert stalled_result["elapsed"] < 5.0
+        assert svc.dispatcher.stall_deposals >= 1
+
+        # While quarantined: verdicts continue via the host fallback,
+        # bit-identical to the oracle on the same inputs, and p99 stays
+        # bounded (each call is a host parse, no device wait).
+        t0 = time.monotonic()
+        got = _shim_run(client, shim_b, CORPUS)
+        per_call = (time.monotonic() - t0) / len(CORPUS)
+        assert_parity(got, oracle_ops(r2d2_policy(), CORPUS))
+        assert per_call < 1.0, f"fallback verdicts too slow: {per_call}s"
+        st = svc.status()
+        assert st["containment"]["quarantined"] is True
+        assert st["containment"]["fallback_entries"] > 0
+        assert st["containment"]["stalls"] >= 1
+
+        # Stall clears -> traffic-driven re-probe heals automatically.
+        model.stall.clear()
+        def poke_and_check():
+            _shim_run(client, shim_b, [b"HALT\r\n"])
+            return not svc.guard.quarantined
+        _wait(poke_and_check, 15.0, "un-quarantine after stall cleared")
+
+        # Healed: parity still holds and the device path resumes (the
+        # demoted conn rebinds its engine; new traffic hits the model).
+        calls_before = model.calls
+        assert_parity(
+            _shim_run(client, shim_b, CORPUS), oracle_ops(r2d2_policy(), CORPUS)
+        )
+        _shim_run(client, shim_b, [PIPELINED])  # eager-path round
+        _wait(
+            lambda: model.calls > calls_before, 5.0,
+            "device path resumed after heal",
+        )
+        assert svc.status()["containment"]["quarantined"] is False
+    finally:
+        for m in fault_model:
+            m.stall.clear()
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+# --- crashed batch: typed per-entry errors, poisoned-engine quarantine -----
+
+def test_batch_crash_typed_errors_then_quarantine(tmp_path, fault_model):
+    svc = _service(
+        tmp_path, "crash",
+        device_call_timeout_s=5.0,
+        device_reprobe_interval_s=0.05,
+        device_fail_threshold=3,
+    )
+    client = SidecarClient(svc.socket_path, timeout=10.0)
+    try:
+        _, shim = _open_conn(client, 7101)
+        model = fault_model[0]
+        assert_parity(
+            _shim_run(client, shim, CORPUS[:2]),
+            oracle_ops(r2d2_policy(), CORPUS[:2]),
+        )
+
+        model.crash.set()
+        # Every crashed round answers EVERY entry with a typed error —
+        # promptly, with no client hang.
+        for _ in range(3):
+            t0 = time.monotonic()
+            result, entries = client._on_data_rpc(
+                shim.conn_id, False, False, PIPELINED
+            )
+            assert result == int(FilterResult.UNKNOWN_ERROR)
+            assert len(entries) == 1
+            assert time.monotonic() - t0 < 5.0
+        assert svc.batch_crashes >= 3
+
+        # Three consecutive crashes = poisoned engine -> quarantined ->
+        # verdicts come back OK through the host fallback, bit-identical.
+        _wait(lambda: svc.guard.quarantined, 5.0, "poisoned-engine quarantine")
+        got = _shim_run(client, shim, CORPUS)
+        assert_parity(got, oracle_ops(r2d2_policy(), CORPUS))
+
+        # Fix the model -> automatic re-probe heals.
+        model.crash.clear()
+        def poke():
+            _shim_run(client, shim, [b"HALT\r\n"])
+            return not svc.guard.quarantined
+        _wait(poke, 15.0, "heal after crash cleared")
+        assert_parity(
+            _shim_run(client, shim, CORPUS[:3]),
+            oracle_ops(r2d2_policy(), CORPUS[:3]),
+        )
+    finally:
+        for m in fault_model:
+            m.crash.clear()
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+# --- overload: bounded queue, typed sheds, zero silent loss ----------------
+
+def test_overload_shed_bounded_zero_silent_loss(tmp_path, fault_model):
+    svc = _service(
+        tmp_path, "overload",
+        device_call_timeout_s=10.0,  # no deposal: pure queue pressure
+        shed_queue_entries=8,
+        shed_queue_age_ms=0.0,
+    )
+    client = SidecarClient(svc.socket_path, timeout=20.0)
+    try:
+        _, shim = _open_conn(client, 7201)
+        model = fault_model[0]
+        _shim_run(client, shim, [b"HALT\r\n"])  # engine warm
+
+        answered: dict[int, int] = {}
+        done = threading.Event()
+        N = 60
+
+        def cb(vb):
+            answered[vb.seq] = int(vb.results[0]) if vb.count else -1
+            if len(answered) == N:
+                done.set()
+
+        client.verdict_callback = cb
+        # Stall the worker (a pipelined round pins it inside the model
+        # call) so the queue builds past the 8-entry cap, then release.
+        # Every entry must be answered: OK or typed SHED.
+        model.stall.set()
+        occupier = threading.Thread(
+            target=lambda: client._on_data_rpc(
+                shim.conn_id, False, False, PIPELINED
+            )
+        )
+        occupier.start()
+        time.sleep(0.1)  # the round is now in-process and stuck
+        msg = b"READ /public/a.txt\r\n"
+        for k in range(N):
+            client.send_batch(
+                1000 + k, [shim.conn_id], [0], [len(msg)], msg
+            )
+        time.sleep(0.3)
+        model.stall.clear()
+        occupier.join(10.0)
+        assert not occupier.is_alive()
+        assert done.wait(15.0), (
+            f"silent loss: {N - len(answered)} of {N} entries never "
+            f"answered (got {len(answered)})"
+        )
+        results = set(answered.values())
+        assert results <= {int(FilterResult.OK), int(FilterResult.SHED)}, results
+        st = svc.status()
+        assert st["containment"]["shed_entries"] > 0, "queue cap never shed"
+        assert st["dispatcher"]["shed_submits"] > 0
+    finally:
+        for m in fault_model:
+            m.stall.clear()
+        client.verdict_callback = None
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_wire_deadline_sheds_typed(tmp_path, fault_model):
+    """A per-entry deadline propagated from on_io over the wire: queue
+    time past the budget sheds with a typed SHED verdict."""
+    svc = _service(
+        tmp_path, "deadline",
+        device_call_timeout_s=10.0,
+        shed_queue_age_ms=0.0,
+    )
+    client = SidecarClient(svc.socket_path, timeout=20.0)
+    try:
+        _, shim_a = _open_conn(client, 7301)
+        res, shim_b = client.new_connection(
+            1, "r2d2", 7302, True, 1, 2, "1.1.1.1:1", "2.2.2.2:80",
+            "sidecar-pol",
+        )
+        assert res == int(FilterResult.OK)
+        model = fault_model[0]
+        _shim_run(client, shim_a, [b"HALT\r\n"])  # engine warm
+
+        model.stall.set()
+        results = {}
+
+        def slow_req():  # occupies the worker for the stall duration
+            r, _ = client._on_data_rpc(
+                shim_a.conn_id, False, False, PIPELINED
+            )
+            results["a"] = r
+
+        ta = threading.Thread(target=slow_req)
+        ta.start()
+        time.sleep(0.1)  # the round is now in-process and stuck
+        # 30ms budget, queued behind a ~0.5s stall -> shed typed.
+        res_b, _ = None, None
+        def dl_req():
+            r, _ = shim_b.client._on_data_rpc(
+                shim_b.conn_id, False, False, b"HALT\r\n", deadline_ms=30.0
+            )
+            results["b"] = r
+
+        tb = threading.Thread(target=dl_req)
+        tb.start()
+        time.sleep(0.4)
+        model.stall.clear()
+        ta.join(10.0)
+        tb.join(10.0)
+        assert not ta.is_alive() and not tb.is_alive()
+        assert results["a"] == int(FilterResult.OK)  # stall < watchdog
+        assert results["b"] == int(FilterResult.SHED)
+    finally:
+        for m in fault_model:
+            m.stall.clear()
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+# --- client: typed unavailability + auto-reconnect -------------------------
+
+def test_control_rpc_unavailable_is_typed_and_prompt(tmp_path):
+    svc = _service(tmp_path, "unavail")
+    client = SidecarClient(svc.socket_path, timeout=10.0)
+    try:
+        client.open_module([])
+        svc.stop()
+        t0 = time.monotonic()
+        with pytest.raises(SidecarUnavailable):
+            client.status()
+        # typed and immediate — not a 10s RPC-timeout hang
+        assert time.monotonic() - t0 < 3.0
+        t0 = time.monotonic()
+        with pytest.raises(SidecarUnavailable):
+            client._on_data_rpc(1, False, False, b"HALT\r\n")
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_client_reconnect_after_service_restart(tmp_path):
+    svc = _service(tmp_path, "restart")
+    path = svc.socket_path
+    client = SidecarClient(path, timeout=8.0, auto_reconnect=True)
+    try:
+        _, shim = _open_conn(client, 7401)
+        exp = oracle_ops(r2d2_policy(), [b"READ /public/a.txt\r\n"])
+        res, out = shim.on_io(False, b"READ /public/a.txt\r\n")
+        assert res == int(FilterResult.OK)
+        assert out == b"READ /public/a.txt\r\n"
+
+        svc.stop()
+        # Down: fail-closed typed verdicts, returned promptly, no raise.
+        t0 = time.monotonic()
+        res, out = shim.on_io(False, b"READ /public/a.txt\r\n")
+        assert res == int(FilterResult.SERVICE_UNAVAILABLE)
+        assert out == b""  # nothing passes unverdicted
+        assert time.monotonic() - t0 < 3.0
+
+        # Service returns (fresh process: fresh module registry) -> the
+        # client reconnects and REPLAYS modules, policies, conns.
+        inst.reset_module_registry()
+        svc2 = VerdictService(path, DaemonConfig(
+            batch_timeout_ms=2.0, batch_flows=256, dispatch_mode="eager",
+        )).start()
+        try:
+            _wait(
+                lambda: client.connected and client.reconnects >= 1,
+                10.0, "client reconnect",
+            )
+            # Verdicts flow again on the SAME shim object, same parity.
+            def verdict_ok():
+                res, out = shim.on_io(False, b"READ /public/a.txt\r\n")
+                return res == int(FilterResult.OK) and out
+            _wait(verdict_ok, 10.0, "verdicts after reconnect")
+            got = _shim_run(client, shim, CORPUS)
+            assert_parity(got, oracle_ops(r2d2_policy(), CORPUS))
+        finally:
+            svc2.stop()
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+# --- flow buffer caps: typed protocol-error DROP + close -------------------
+
+def test_flow_buffer_cap_request_direction(tmp_path):
+    svc = _service(tmp_path, "bufcap", max_flow_buffer=4096)
+    client = SidecarClient(svc.socket_path, timeout=10.0)
+    try:
+        _, shim = _open_conn(client, 7501)
+        # A stream with no frame delimiter grows the engine flow buffer
+        # until the cap trips: typed protocol-error, buffer dropped.
+        res = int(FilterResult.OK)
+        chunk = b"A" * 1000
+        for _ in range(6):
+            res, _out = shim.on_io(False, chunk)
+            if res != int(FilterResult.OK):
+                break
+        assert res == int(FilterResult.PARSER_ERROR)
+        assert len(shim.dirs[False].buffer) == 0, "retained bytes leaked"
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_flow_buffer_cap_reply_direction_oracle(tmp_path):
+    svc = _service(tmp_path, "bufcap2", max_flow_buffer=4096)
+    client = SidecarClient(svc.socket_path, timeout=10.0)
+    try:
+        _, shim = _open_conn(client, 7502)
+        res = int(FilterResult.OK)
+        chunk = b"B" * 1000
+        for _ in range(6):
+            res, _out = shim.on_io(True, chunk)
+            if res != int(FilterResult.OK):
+                break
+        assert res == int(FilterResult.PARSER_ERROR)
+        assert len(shim.dirs[True].buffer) == 0
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+# --- dispatcher: flush without busy-wait, idempotent stop ------------------
+
+def test_dispatcher_flush_condition_based():
+    seen = []
+    release = threading.Event()
+
+    def proc(items):
+        release.wait(5.0)
+        seen.extend(items)
+
+    d = BatchDispatcher(proc, max_batch=1000, timeout_ms=0.0).start()
+    try:
+        for i in range(10):
+            d.submit(i)
+        # flush must block while a round is in process()...
+        assert d.flush(timeout=0.2) is False
+        release.set()
+        # ...and return promptly once the work drains (no poll loop).
+        assert d.flush(timeout=5.0) is True
+        assert len(seen) == 10
+    finally:
+        d.stop()
+
+
+def test_dispatcher_stop_idempotent():
+    d = BatchDispatcher(lambda items: None)
+    d.stop()  # before start: no RuntimeError
+    d.stop()
+    d2 = BatchDispatcher(lambda items: None).start()
+    d2.stop()
+    d2.stop()  # double stop after start
+
+
+def test_dispatcher_admission_cap_refuses():
+    gate = threading.Event()
+
+    def proc(items):
+        gate.wait(5.0)
+
+    d = BatchDispatcher(proc, max_batch=1, timeout_ms=0.0, max_pending=4).start()
+    try:
+        d.submit("head")  # popped by the worker, blocks in proc
+        time.sleep(0.1)
+        accepted = [d.submit(i) for i in range(8)]
+        assert not all(accepted), "cap never refused"
+        assert d.submit("ctl", weight=0, force=True) is True  # never shed
+        assert d.shed_submits > 0
+    finally:
+        gate.set()
+        d.stop()
+
+
+# --- CLI surface -----------------------------------------------------------
+
+def test_cli_sidecar_status(tmp_path, capsys):
+    from cilium_tpu.cli import main as cli_main
+
+    svc = _service(tmp_path, "cli")
+    client = SidecarClient(svc.socket_path, timeout=10.0)
+    try:
+        _, shim = _open_conn(client, 7601)
+        _shim_run(client, shim, [b"HALT\r\n"])
+        rc = cli_main(["sidecar", "status", "--address", svc.socket_path])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "containment:" in out and "queue:" in out
+        rc = cli_main(
+            ["sidecar", "status", "--address", svc.socket_path, "--json"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert '"containment"' in out
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
