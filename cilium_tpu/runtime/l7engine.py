@@ -176,13 +176,16 @@ class DeviceAssistedEngine:
                 return
         retained = len(st.bufs[False]) + len(st.bufs[True])
         if self.max_buffer and retained + len(data) > self.max_buffer:
-            # Retained-bytes cap: drop everything buffered in this
+            # Retained-bytes cap: drop everything buffered in THIS
             # direction plus the incoming bytes with a typed
             # protocol-error pair; the flow is dead (caller closes on
-            # the ERROR result).
+            # the ERROR result).  The opposite direction's buffer is
+            # left intact — the shim still mirrors those retained
+            # bytes, and clearing them here with no covering op would
+            # desync that mirror; they die with the flow (the next
+            # entry in that direction gets the overflowed ERROR above).
             dropped = len(st.bufs[reply]) + len(data)
-            st.bufs[False].clear()
-            st.bufs[True].clear()
+            st.bufs[reply].clear()
             st.overflowed = True
             st.stalled[False] = st.stalled[True] = True
             self.buffer_overflows += 1

@@ -51,6 +51,22 @@ from . import wire
 log = logging.getLogger(__name__)
 
 
+def _teardown(sock: socket.socket) -> None:
+    """shutdown-then-close, the one definition: a bare close() neither
+    wakes a reader blocked in recv on the same socket object nor sends
+    the peer a prompt FIN, so every teardown in this module must
+    shutdown first or it leaks a reader thread and a half-open
+    service connection."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 class SidecarUnavailable(wire.WireError):
     """The verdict service is unreachable (typed, raised immediately —
     callers decide between fail-closed verdicts and retry-after-
@@ -207,6 +223,14 @@ class SidecarClient:
         self._closed = False
         self._down_once = threading.Lock()  # one disconnect hook per drop
         self._down_handled = False
+        # Reconnect-loop ownership (guarded by _down_once): exactly one
+        # loop may drive recovery at a time.  A disconnect observed
+        # while a loop is active (its own replay socket dying, or a
+        # just-resumed socket dying before the loop hands off) sets
+        # ``pending`` to request another cycle instead of spawning a
+        # second loop that would race the first over self.sock.
+        self._reconnect_active = False
+        self._reconnect_pending = False
         self._reconnected = threading.Event()
         self._reconnected.set()
         self.reconnects = 0
@@ -218,7 +242,9 @@ class SidecarClient:
         self._mod_map: dict[int, int] = {}
         self._conn_args: dict[int, tuple] = {}
         self._shims: dict[int, ShimConnection] = {}
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(self.sock,), daemon=True
+        )
         self._reader.start()
         self.verdict_callback = None  # async mode: called with VerdictBatch
 
@@ -228,9 +254,13 @@ class SidecarClient:
     def connected(self) -> bool:
         return self._alive
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, sock: socket.socket) -> None:
+        # The socket is passed in, never re-read from self.sock: the
+        # reader must bind to the socket its spawner owned — by first
+        # bytecode a later reconnect cycle may already have swapped
+        # self.sock, and two readers on one socket would race frames.
         try:
-            reader = wire.BufferedReader(self.sock)
+            reader = wire.BufferedReader(sock)
             while True:
                 msg_type, payload = reader.recv_msg()
                 if msg_type == wire.MSG_VERDICT_BATCH:
@@ -257,16 +287,29 @@ class SidecarClient:
         except (wire.ConnectionClosed, OSError):
             pass
         finally:
-            self._on_disconnect()
+            self._on_disconnect(sock)
 
-    def _on_disconnect(self) -> None:
+    def _on_disconnect(self, sock: socket.socket | None = None) -> None:
         """Socket died: fail every waiter typed-and-immediately, then
-        (optionally) start the reconnect loop."""
+        (optionally) start the reconnect loop.  ``sock`` identifies the
+        DYING socket: a reader whose socket is no longer self.sock is
+        reporting a replay attempt that was already torn down and
+        superseded — a delayed callback from it must be a no-op, or it
+        would mark a healthy reconnected client down, fail its waiters,
+        and spawn a rival reconnect loop that replays the session again
+        and orphans the healthy socket with a live reader."""
         with self._down_once:
+            # Identity checked UNDER the latch lock: _resume performs
+            # its swap + down-state reset atomically under this same
+            # lock, so a stale callback preempted between an outside
+            # check and the latch could otherwise interleave with a
+            # successful replay and mark the fresh session down.
+            if sock is not None and sock is not self.sock:
+                return
             if self._down_handled:
                 return
             self._down_handled = True
-        self._alive = False
+            self._alive = False
         self._reconnected.clear()
         # Wake data waiters WITHOUT a verdict: they observe the missing
         # entry and raise SidecarUnavailable instead of sleeping out
@@ -276,11 +319,32 @@ class SidecarClient:
             evt.set()
         self._control_evt.set()
         if self.auto_reconnect and not self._closed:
-            threading.Thread(
-                target=self._reconnect_loop,
-                daemon=True,
-                name="sidecar-reconnect",
-            ).start()
+            with self._down_once:
+                if self._reconnect_active:
+                    # A loop is already driving recovery: this is its
+                    # own replay socket dying (service restarted again
+                    # mid-replay) or a just-resumed socket dying before
+                    # the loop exited.  Request another cycle — a
+                    # second loop would race the first over self.sock,
+                    # replaying the session twice and orphaning the
+                    # loser's socket with a live reader.
+                    self._reconnect_pending = True
+                    return
+                self._reconnect_active = True
+            try:
+                threading.Thread(
+                    target=self._reconnect_loop,
+                    daemon=True,
+                    name="sidecar-reconnect",
+                ).start()
+            except RuntimeError:  # can't start new thread
+                # Un-register, or auto-reconnect is latched off for the
+                # life of the process: every later disconnect would see
+                # an "active" loop that never existed and just set
+                # pending.
+                log.exception("failed to spawn sidecar reconnect loop")
+                with self._down_once:
+                    self._reconnect_active = False
 
     def _send(self, msg_type: int, payload: bytes) -> None:
         if not self._alive:
@@ -292,23 +356,41 @@ class SidecarClient:
             try:
                 wire.send_msg(sock, msg_type, payload)
             except OSError as e:
-                # Close only the socket we actually wrote to: _resume
-                # may have swapped in a fresh one concurrently, and
-                # killing it would throw away the just-replayed session.
+                # Tear down only the socket we actually wrote to:
+                # _resume may have swapped in a fresh one concurrently,
+                # and killing it would throw away the just-replayed
+                # session.  A write error need not coincide with a FIN
+                # reaching the reader (ETIMEDOUT against a wedged-but-
+                # open peer), so a bare close would leave the reader
+                # parked in recv — no _on_disconnect, no reconnect loop,
+                # client wedged forever.
                 if sock is self.sock:
-                    try:
-                        sock.close()  # force the reader out of recv
-                    except OSError:
-                        pass
+                    _teardown(sock)  # force the reader out of recv
                 raise SidecarUnavailable(str(e)) from e
 
     # -- reconnect --------------------------------------------------------
 
     def _reconnect_loop(self) -> None:
+        try:
+            self._reconnect_cycles()
+        except Exception:  # noqa: BLE001 — never die still registered
+            # The loop owns _reconnect_active; dying with it set would
+            # latch auto-reconnect off for the life of the process
+            # (every later disconnect would just set pending).  Clear
+            # it so the next disconnect can spawn a fresh loop.
+            log.exception("sidecar reconnect loop died")
+            with self._down_once:
+                self._reconnect_active = False
+
+    def _reconnect_cycles(self) -> None:
         backoff = Exponential(
             min_duration=0.05, max_duration=2.0, name="sidecar-reconnect"
         )
         while not self._closed:
+            with self._down_once:
+                # A disconnect latched during the previous cycle is
+                # consumed by this fresh attempt.
+                self._reconnect_pending = False
             try:
                 sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                 sock.connect(self.socket_path)
@@ -319,14 +401,35 @@ class SidecarClient:
                 self._resume(sock)
             except Exception:  # noqa: BLE001 — service mid-restart
                 log.exception("sidecar session replay failed; retrying")
-                try:
-                    sock.close()
-                except OSError:
-                    pass
                 self._alive = False
+                # Tear the attempt down; the reader _resume started (if
+                # it got that far) dies on the shut socket and its
+                # _on_disconnect fails waiters typed-and-immediately —
+                # it cannot spawn a rival loop (this one is still
+                # registered active; the disconnect just sets
+                # _reconnect_pending, cleared at the top of the retry).
+                # A replay socket that died MID-replay already ran the
+                # same _on_disconnect before the RPC failure landed us
+                # here.
+                _teardown(sock)
+                backoff.wait()
+                continue
+            with self._down_once:
+                pending = self._reconnect_pending
+                if not pending:
+                    self._reconnect_active = False
+            if pending:
+                # The just-resumed socket already died (its reader
+                # latched a disconnect between replay completion and
+                # this handoff): run another cycle rather than exiting
+                # with nobody driving recovery — but back off first
+                # like the other failure paths, or a flapping service
+                # gets hammered with back-to-back full session replays.
                 backoff.wait()
                 continue
             return
+        with self._down_once:
+            self._reconnect_active = False
 
     def _resume(self, sock: socket.socket) -> None:
         """Swap in the fresh socket and replay the session: modules,
@@ -336,16 +439,23 @@ class SidecarClient:
             if self._closed:
                 # close() raced the reconnect: never leave a "closed"
                 # client holding a live session.
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+                _teardown(sock)
                 raise wire.WireError("client closed during reconnect")
-            self.sock = sock
-        self._alive = True
-        with self._down_once:
-            self._down_handled = False
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+            # Swap + down-state reset as ONE atomic unit under the
+            # disconnect latch lock: _on_disconnect validates its dying
+            # socket's identity under the same lock, so a stale
+            # callback observes either the old socket (and latches the
+            # old session down, correctly) or the new one (and no-ops)
+            # — never a half-applied swap that lets it mark the fresh
+            # session dead.  (_wlock -> _down_once nesting occurs only
+            # here; _down_once holders never take _wlock.)
+            with self._down_once:
+                self.sock = sock
+                self._alive = True
+                self._down_handled = False
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(sock,), daemon=True
+        )
         self._reader.start()
         with self._session_lock:
             modules = dict(self._modules)
@@ -368,6 +478,17 @@ class SidecarClient:
                 )
         for shim in shims:
             shim._reset_fail_closed()
+        if self._closed:
+            # close() raced the replay AFTER the initial check passed:
+            # it may have shut the OLD socket just before the swap, so
+            # the session we just replayed would outlive the "closed"
+            # client (live reader thread until process exit).  Tear the
+            # fresh socket down here; the reader exits on the closed
+            # socket.  (close() runs lock-free by design — taking
+            # _wlock there could deadlock behind a sendall wedged on a
+            # stuck peer, the very thing close() must break.)
+            _teardown(self.sock)
+            raise wire.WireError("client closed during reconnect")
         self.reconnects += 1
         metrics.SidecarClientReconnects.inc()
         self._reconnected.set()
@@ -542,10 +663,13 @@ class SidecarClient:
 
     def close(self) -> None:
         self._closed = True
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        # Capture the socket OBJECT once: _resume swaps self.sock on
+        # reconnect, and a re-read between shutdown and close could
+        # shutdown the old socket but bare-close the new one —
+        # recreating the lingering-reader leak for the fresh reader.
+        # (_resume checks _closed after the swap and tears the fresh
+        # socket down the same way.)
+        _teardown(self.sock)
 
     # -- data plane -------------------------------------------------------
 

@@ -41,9 +41,13 @@ Containment contract (the robustness layer):
   ``stall_timeout_s``.  A worker stuck past the deadline (device hang)
   is DEPOSED: the stuck batch goes to ``on_stall(batch)`` for typed
   shed verdicts, a replacement worker takes over the queue, and the
-  deposed thread's late sends are suppressed by generation (consumers
-  check ``thread_is_deposed()``).  Python cannot cancel the stuck
-  thread; it is abandoned (daemon) and exits when the stall clears.
+  stuck ROUND's late sends — from the abandoned thread or from
+  completion-pipeline records it already queued — are suppressed per
+  round (consumers check ``thread_is_deposed()`` /
+  ``thread_round_is_shed()``; per-generation suppression alone would
+  also swallow earlier completed rounds still in the pipeline).
+  Python cannot cancel the stuck thread; it is abandoned (daemon) and
+  exits when the stall clears.
 """
 
 from __future__ import annotations
@@ -103,11 +107,24 @@ class BatchDispatcher:
         # path relies on to never overtake queued work.
         self._busy = False
         # Worker generation: bumped at each stall deposal.  The current
-        # worker, the current in-process lock, and send suppression are
-        # all keyed to it.
+        # worker and the current in-process lock are keyed to it.
         self._gen = 0
         self._round_start = 0.0
         self._current_batch: list[Any] | None = None
+        # Round ids: every dispatch round (worker pop OR cut-through
+        # inline round) gets a unique id, recorded on the processing
+        # thread as ``_disp_round``.  round_seq only advances while
+        # _busy is false (pop and inline begin both require it), so
+        # while a round is in flight round_seq IS that round's id —
+        # there is no separate "current round" field to keep in sync.
+        # Deposal adds the STUCK round's id to _shed_rounds —
+        # suppression is then per-round, not per-generation: an earlier
+        # round of the same generation whose results are still in the
+        # completion pipeline was never shed, and suppressing it would
+        # silently lose its verdicts.  The set grows by one per deposal
+        # (bounded by distinct stalls, like the abandoned threads).
+        self.round_seq = 0
+        self._shed_rounds: set[int] = set()
         self._worker = threading.Thread(
             target=self._run, args=(0,), name=name, daemon=True
         )
@@ -208,11 +225,59 @@ class BatchDispatcher:
         gen = getattr(threading.current_thread(), "_disp_gen", None)
         return gen is not None and gen != self._gen
 
+    def thread_round_is_shed(self) -> bool:
+        """True when the CALLING thread carries a round id the watchdog
+        shed (typed SHED verdicts already sent for the whole batch) —
+        its sends for that round must be suppressed.  Covers both the
+        stuck thread itself (worker or cut-through reader) and the send
+        loop, which adopts each pipeline record's round id while
+        emitting it."""
+        rid = getattr(threading.current_thread(), "_disp_round", None)
+        return rid is not None and rid in self._shed_rounds
+
+    def begin_inline_round(self, batch: list[Any]) -> int | None:
+        """Arm the stall watchdog for a cut-through round (caller holds
+        the in-process lock).  Without this a device call hung inside
+        an inline round on an otherwise IDLE service is invisible —
+        _busy stays false, the watchdog skips every cycle, and the shim
+        reader wedges with no typed reply and no quarantine.  Returns
+        the round id, or None when a worker round is queued/in flight
+        (the caller must line up behind it — claiming the round state
+        here would clobber a concurrent _pop_locked's)."""
+        with self._cond:
+            if self._pending or self._busy:
+                return None
+            self._busy = True
+            self._round_start = time.perf_counter()
+            self._current_batch = batch
+            self.round_seq += 1
+            threading.current_thread()._disp_round = self.round_seq
+            return self.round_seq
+
+    def end_inline_round(self, rid: int) -> None:
+        """Close a cut-through round — but only if it still owns the
+        round state: a worker pop (behind the held lock) or a deposal
+        may have superseded it, and clearing _busy then would break the
+        set-before-clear ordering the cut-through peek relies on."""
+        with self._cond:
+            if self.round_seq == rid:
+                self._busy = False
+                self._current_batch = None
+                self._done.notify_all()
+                # The worker parks in _take while an inline round is
+                # busy (it must not clobber the round state) — wake it
+                # so work queued behind this round dispatches now.
+                self._cond.notify_all()
+
     # -- worker -----------------------------------------------------------
 
     def _pop_locked(self) -> list[Any]:
         self._busy = True  # before the clear — see __init__ note
         self._round_start = time.perf_counter()
+        self.round_seq += 1
+        # _pop_locked runs on the worker thread itself (via _take), so
+        # the round id can be recorded directly on it.
+        threading.current_thread()._disp_round = self.round_seq
         batch = self._pending
         self._current_batch = batch
         self._pending = []
@@ -226,6 +291,20 @@ class BatchDispatcher:
             while True:
                 if self._gen != my_gen:
                     return None, False
+                if self._busy:
+                    # A cut-through inline round owns the round state
+                    # (_round_start/round_seq/_current_batch) —
+                    # the worker never sees its OWN round here (it
+                    # clears _busy before re-entering _take).  Popping
+                    # now would clobber the watchdog's view of the
+                    # genuinely in-flight round: the watchdog would
+                    # time the pop's (merely lock-blocked) batch,
+                    # depose THAT, and the actually-stuck inline item
+                    # would never be shed — its client wedged
+                    # unboundedly.  Wait it out: end_inline_round and
+                    # deposal both notify this condition.
+                    self._cond.wait()
+                    continue
                 if self._stopped:
                     return self._pop_locked(), False
                 if self._pending_weight >= self.max_batch:
@@ -310,6 +389,12 @@ class BatchDispatcher:
                 self._current_batch = None
                 self._gen += 1
                 self._busy = False
+                # The stuck round's sends — from the abandoned thread
+                # OR from pipeline records it already queued — are
+                # suppressed per-round (see thread_round_is_shed).
+                # round_seq is the stuck round's id: it only advances
+                # while _busy is false, and this round is still busy.
+                self._shed_rounds.add(self.round_seq)
                 self._in_process_lock = threading.Lock()
                 self.stall_deposals += 1
                 self._worker = threading.Thread(
@@ -320,6 +405,11 @@ class BatchDispatcher:
                 )
                 self._worker.start()
                 self._done.notify_all()
+                # Wake any idle PREVIOUS-generation worker parked in
+                # _take's cond wait (deposal during a cut-through round
+                # never submits): it observes the gen bump and exits
+                # instead of lingering until the next submit.
+                self._cond.notify_all()
             log.error(
                 "dispatch round stalled > %.1fs; worker deposed "
                 "(generation %d)", self.stall_timeout_s, self._gen,

@@ -67,11 +67,24 @@ class DeviceGuard:
         self.quarantine_events = 0
         self.probes = 0
         self._crash_streak = 0
-        # Set by record_failure, consumed by record_ok: a round that
-        # CONTAINED a failure (typed errors, host fallback) still
-        # completes, and its record_ok must not reset the streak — only
-        # a genuinely clean round does.
+        # Set by record_failure, consumed by record_ok, cleared at
+        # round_start (round-local): a round that CONTAINED a failure
+        # (typed errors, host fallback) still completes, and its
+        # record_ok must not reset the streak — only a genuinely clean
+        # round does.
         self._tainted = False
+        # Sticky variant for DEFERRED failures (a round's async
+        # completion crashing on the send-loop thread, possibly in the
+        # gap between dispatcher rounds): round_start must NOT erase it
+        # — otherwise the next round's clean record_ok resets the
+        # streak and an engine whose every deferred completion crashes
+        # never reaches fail_threshold.  Consumed (without a reset) by
+        # the next record_ok, like the original taint.
+        self._sticky_taint = False
+        # deferred_scope marks the calling thread so record_failure
+        # picks sticky semantics without plumbing flags through every
+        # engine hook / pump call site.
+        self._tls = threading.local()
         self._probe_inflight = False
         self._last_probe = 0.0
         self._quarantined_at = 0.0
@@ -104,12 +117,18 @@ class DeviceGuard:
             self.stalls += 1
         self.quarantine(reason)
 
-    def record_failure(self, reason: str = "model-error") -> None:
+    def record_failure(self, reason: str = "model-error",
+                       sticky: bool = False) -> None:
         """One crashed/contained-failed dispatch round; quarantine on a
-        streak of them."""
+        streak of them.  ``sticky`` (or a surrounding deferred_scope)
+        marks a deferred-completion failure whose taint must survive
+        the next round_start."""
         with self._lock:
             self._crash_streak += 1
-            self._tainted = True
+            if sticky or getattr(self._tls, "sticky", False):
+                self._sticky_taint = True
+            else:
+                self._tainted = True
             trip = (
                 self.fail_threshold
                 and self._crash_streak >= self.fail_threshold
@@ -117,14 +136,39 @@ class DeviceGuard:
         if trip:
             self.quarantine(f"{reason} x{self._crash_streak}")
 
+    def deferred_scope(self, fn, *args, **kwargs):
+        """Run ``fn`` with record_failure in STICKY mode: the send loop
+        uses this around deferred round completions (entry2 finishes),
+        whose pump/judge crashes land outside any dispatcher round and
+        would otherwise be erased by the next round_start."""
+        self._tls.sticky = True
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._tls.sticky = False
+
+    def round_start(self) -> None:
+        """A new dispatch round begins: the ROUND-LOCAL taint is
+        cleared.  A round that CRASHES never reaches record_ok, so its
+        taint would otherwise survive and swallow the NEXT clean
+        round's record_ok without resetting the streak — alternating
+        crash/clean rounds would still accumulate to fail_threshold,
+        contradicting the 'consecutive crashed rounds' semantics.  The
+        sticky (deferred-failure) taint is deliberately NOT cleared
+        here — it belongs to no dispatcher round and is consumed by
+        the next record_ok instead."""
+        with self._lock:
+            self._tainted = False
+
     def record_ok(self) -> None:
         """End of a completed round: resets the streak ONLY if the
         round recorded no contained failure (a pump/judge crash that
         was answered with typed errors still counts toward the
         poisoned-engine streak)."""
         with self._lock:
-            if self._tainted:
+            if self._tainted or self._sticky_taint:
                 self._tainted = False
+                self._sticky_taint = False
                 return
             self._crash_streak = 0
 
@@ -136,6 +180,7 @@ class DeviceGuard:
             self.reason = ""
             self._crash_streak = 0
             self._tainted = False
+            self._sticky_taint = False
         log.warning("device un-quarantined (probe succeeded)")
         if self.on_change is not None:
             try:

@@ -336,7 +336,7 @@ class VerdictService:
                 pass
         self.dispatcher.stop()
         if self._completion_thread is not None:
-            self._completions.put(("stop",))
+            self._completion_put(("stop",))
             self._completion_thread.join(timeout=5)
         if self._send_thread is not None:
             self._send_thread.join(timeout=5)
@@ -654,7 +654,7 @@ class VerdictService:
         # is quarantined (host policy.matches fallback, bit-identical),
         # and judge crashes count toward the poisoned-engine threshold.
         eng.device_gate = lambda: not self.guard.quarantined
-        eng.device_fail_hook = lambda exc: self.guard.record_failure(
+        eng.device_fail_hook = lambda exc: self._record_contained_failure(
             f"judge-crash: {type(exc).__name__}"
         )
         return eng
@@ -722,11 +722,30 @@ class VerdictService:
             return False
         # Non-blocking: if a round is mid-process, queue to the
         # dispatcher so the worker coalesces everything that arrived
-        # during the in-flight round into ONE device call.
-        if not disp._in_process_lock.acquire(blocking=False):
+        # during the in-flight round into ONE device call.  Capture the
+        # lock OBJECT (mirroring BatchDispatcher._run): the stall
+        # watchdog swaps _in_process_lock for a fresh one at deposal —
+        # reachable while cut-through holds it (a popped batch blocking
+        # on this lock trips the watchdog) — and a re-read release would
+        # raise RuntimeError on the unheld replacement out of
+        # submit_data while leaking this lock held forever.
+        lock = disp._in_process_lock
+        if not lock.acquire(blocking=False):
             return False
+        released = False
         try:
-            if disp._pending or disp._busy:
+            if lock is not disp._in_process_lock:
+                # Deposed between read and acquire: a replacement
+                # generation owns the queue (and a new lock) — line up
+                # behind it rather than racing its rounds.
+                return False
+            # Arm the stall watchdog for this inline round (rechecks
+            # pending/busy under the dispatcher condition): a device
+            # call hung HERE on an idle service would otherwise never
+            # be detected — no deposal, no quarantine, no typed reply,
+            # one wedged shim reader.
+            rid = disp.begin_inline_round([item])
+            if rid is None:
                 return False
             self.inline_batches += 1
             try:
@@ -734,13 +753,28 @@ class VerdictService:
             except Exception as exc:  # noqa: BLE001 — reader must survive
                 log.exception("cut-through process failed")
                 # Same crash containment as the dispatcher path: every
-                # entry gets a typed error verdict, never a silent drop.
+                # entry gets a typed error verdict, never a silent drop
+                # (suppressed if the watchdog already shed this round).
                 try:
                     self._on_batch_error([item], exc)
                 except Exception:  # noqa: BLE001
                     log.exception("cut-through error containment failed")
+            finally:
+                # Release BEFORE closing the round, mirroring _run's
+                # release-then-clear-_busy ordering: the watchdog treats
+                # a free in-process lock as "process() returned, its
+                # verdicts are sent" and skips deposal.  Closing the
+                # round first would leave a window (busy=True, lock
+                # held, verdicts already sent) where a round completing
+                # just past the deadline gets deposed and its served
+                # seq double-replied with a SHED batch.
+                released = True
+                lock.release()
+                disp.end_inline_round(rid)
+                threading.current_thread()._disp_round = None
         finally:
-            disp._in_process_lock.release()
+            if not released:
+                lock.release()
         return True
 
     def _run_mat_group(self, items: list) -> bool:
@@ -835,13 +869,18 @@ class VerdictService:
         per_client: dict[int, list] = {}
         start = 0
         for _, client, mb in items:
-            per_client.setdefault(id(client), [client, [], [], []])
+            per_client.setdefault(id(client), [client, [], [], [], []])
             rec = per_client[id(client)]
             rec[1].append(mb.seq)
             rec[2].append(mb.count)
             rec[3].append((start, start + mb.count))
+            rec[4].append(mb)
             start += mb.count
-        for client, seqs, counts, spans in per_client.values():
+        for client, seqs, counts, spans, mbs in per_client.values():
+            # ``batches=mbs``: send() marks every covered wire batch
+            # answered under the write lock before writing, so a stall
+            # deposal tripped by a LATER client's wedged send in this
+            # same round can never SHED-double-reply a seq served here.
             try:
                 if len(seqs) == 1:
                     a, b = spans[0]
@@ -850,6 +889,7 @@ class VerdictService:
                         self._verdict_frame(
                             seqs[0], ids[a:b], lengths[a:b], allow[a:b]
                         ),
+                        batches=mbs,
                     )
                     continue
                 if spans[-1][1] - spans[0][0] == sum(counts):
@@ -866,6 +906,7 @@ class VerdictService:
                 client.send(
                     wire.MSG_VERDICT_MULTI,
                     wire.pack_verdict_multi(seqs, counts, len(c_ids), body),
+                    batches=mbs,
                 )
             except Exception:  # noqa: BLE001 — client may be gone
                 log.exception("verdict send failed")
@@ -896,17 +937,38 @@ class VerdictService:
 
     def _shed_item(self, item, reason: str) -> None:
         """Fail-closed DROP with a typed SHED response — the admission
-        queue never hangs or silently drops an entry."""
+        queue never hangs or silently drops an entry.  An item whose
+        real verdicts already went out (a multi-group round can serve
+        its vec group, then hang in a later group before deposal) is
+        skipped: round-id suppression only stops sends issued AFTER the
+        shed, it cannot retract one already on the wire, and a second
+        reply for a consumed seq desyncs the shim.  The early
+        ``answered`` read only saves building the reply; the
+        AUTHORITATIVE check-and-mark happens under the client write
+        lock inside send_verdicts, which also covers a real-verdict
+        sendall still in flight (the wedged send that tripped the
+        watchdog marks its batches before writing)."""
         _, client, batch = item
+        if batch.answered:
+            return
         n = batch.count
-        self.shed_entries += n
-        metrics.SidecarShedTotal.inc(reason, amount=n)
         try:
-            client.send_verdicts(
-                batch.seq, self._typed_entries(batch, FilterResult.SHED)
+            sent = client.send_verdicts(
+                batch.seq,
+                self._typed_entries(batch, FilterResult.SHED),
+                batch=batch,
             )
         except Exception:  # noqa: BLE001 — client may be gone
             log.exception("shed response send failed")
+            return
+        if sent:
+            # Counted only when THIS reply answered the seq: a real-
+            # verdict send that won the race under the write lock means
+            # the entry was served, and booking it as shed too would
+            # double-count it (status and the overload bench's shed
+            # rate would over-report).
+            self.shed_entries += n
+            metrics.SidecarShedTotal.inc(reason, amount=n)
 
     def _on_batch_error(self, items: list, exc: BaseException) -> None:
         """Crash containment: a failed process(batch) produces typed
@@ -914,7 +976,9 @@ class VerdictService:
         being swallowed — no client blocks on a crashed round."""
         self.batch_crashes += 1
         metrics.SidecarBatchCrashes.inc()
-        self.guard.record_failure(f"batch-crash: {type(exc).__name__}")
+        self._record_contained_failure(
+            f"batch-crash: {type(exc).__name__}"
+        )
         for it in items:
             if it[0] == "close":
                 try:
@@ -923,20 +987,31 @@ class VerdictService:
                     log.exception("close during crash containment failed")
                 continue
             _, client, batch = it
-            n = batch.count
-            self.error_entries += n
+            if batch.answered:
+                # This item's real verdicts (or its SHED reply) already
+                # went out — e.g. a greedy multi-group round that served
+                # its vec group inline before a later group crashed.  A
+                # second reply would desync the shim; an in-flight send
+                # is caught by the same check under the client write
+                # lock inside send_verdicts.
+                continue
             try:
-                client.send_verdicts(
+                sent = client.send_verdicts(
                     batch.seq,
                     self._typed_entries(batch, FilterResult.UNKNOWN_ERROR),
+                    batch=batch,
                 )
             except Exception:  # noqa: BLE001
                 log.exception("error response send failed")
+                continue
+            if sent:  # see _shed_item: never double-book served entries
+                self.error_entries += batch.count
 
     def _on_dispatch_stall(self, items: list) -> None:
         """Watchdog deposed a stuck round (device hang): quarantine the
-        device and shed the stuck batch with typed verdicts — the
-        deposed worker's own late sends are generation-suppressed."""
+        device and shed the stuck batch with typed verdicts — the stuck
+        round's own late sends (from its thread or from pipeline
+        records it queued) are round-suppressed."""
         self.guard.record_stall("dispatch-stall")
         metrics.DeviceStalls.inc()
         for it in items:
@@ -1062,6 +1137,7 @@ class VerdictService:
         shares a connection with an entrywise batch in the same round,
         preserving per-connection op order.
         """
+        self.guard.round_start()
         items = self._admit(items)
         closes = [it[1:] for it in items if it[0] == "close"]
         data_items = [it for it in items if it[0] in ("data", "mat")]
@@ -1086,7 +1162,7 @@ class VerdictService:
         ):
             for close_args in closes:
                 self.close_connection(*close_args)
-            self.guard.record_ok()
+            self._round_record_ok()
             return
         # Snapshot the conn tables under the lock once per round: the
         # eligibility checks and chunk issue below run lock-free on the
@@ -1135,7 +1211,33 @@ class VerdictService:
             self.close_connection(*close_args)
         # The round completed without raising — reset the poisoned-
         # engine crash streak.
-        self.guard.record_ok()
+        self._round_record_ok()
+
+    def _round_thread_suppressed(self) -> bool:
+        """True on a thread whose guard bookkeeping must be dropped —
+        the same deposed-worker/shed-round predicate that suppresses
+        sends.  A zombie round unsticking minutes after deposal must
+        touch NEITHER direction of the streak: its record_ok would
+        reset a genuine streak the replacement worker is accumulating
+        (or consume a live round's taint), and its record_failure
+        would taint the live rounds for a crash the deposal already
+        booked via record_stall."""
+        disp = self.dispatcher
+        return disp.thread_is_deposed() or disp.thread_round_is_shed()
+
+    def _round_record_ok(self) -> None:
+        """guard.record_ok for a completed round — see
+        _round_thread_suppressed."""
+        if not self._round_thread_suppressed():
+            self.guard.record_ok()
+
+    def _record_contained_failure(self, reason: str) -> None:
+        """guard.record_failure for a contained in-round failure —
+        gated like record_ok; covers every crash-streak input reachable
+        from an abandoned thread (batch crash, engine pump crash, the
+        device-assisted engines' judge-crash hook)."""
+        if not self._round_thread_suppressed():
+            self.guard.record_failure(reason)
 
     def _tab_snapshot(self, data_items: list) -> "_TabSnap | None":
         if not data_items:
@@ -1425,13 +1527,13 @@ class VerdictService:
                 for _, client, mb in mats:
                     sends.append(
                         (client, mb.seq, mb.conn_ids, mb.lengths,
-                         start, start + mb.count)
+                         start, start + mb.count, mb)
                     )
                     start += mb.count
                 if self._inline_complete:
                     self._finish_vec(issued, start, sends)
                 else:
-                    self._completions.put(("vec", issued, start, sends))
+                    self._completion_put(("vec", issued, start, sends))
             if not datas:
                 continue
             batches = [it[2] for it in datas]
@@ -1454,13 +1556,13 @@ class VerdictService:
                 sends.append(
                     (client, batch.seq, conn_ids[start : start + batch.count],
                      lengths[start : start + batch.count],
-                     start, start + batch.count)
+                     start, start + batch.count, batch)
                 )
                 start += batch.count
             if self._inline_complete:
                 self._finish_vec(issued, n, sends)
             else:
-                self._completions.put(("vec", issued, n, sends))
+                self._completion_put(("vec", issued, n, sends))
 
     def _issue_chunks(self, engine, rows, lengths, conn_ids,
                       snap: "_TabSnap") -> list:
@@ -1567,6 +1669,20 @@ class VerdictService:
             )
             return fn(blob_dev, offs, lens, remotes)[-1]
 
+    def _completion_put(self, rec) -> None:
+        """Queue a record into the completion pipeline tagged with the
+        issuing thread's dispatcher ROUND id.  The stall watchdog sheds
+        a stuck round's whole batch with typed SHED verdicts —
+        including groups that round already handed to this pipeline —
+        so the send loop must drop those groups' real verdicts or a
+        client receives two replies for one seq (and misapplies ops on
+        a shim that already consumed it).  The tag is per-round, not
+        per-generation: a deposed worker's EARLIER rounds completed
+        normally and were never shed, and suppressing their queued
+        records would silently lose verdicts."""
+        rid = getattr(threading.current_thread(), "_disp_round", None)
+        self._completions.put((rid, rec))
+
     def _finish_vec(self, issued, n, sends) -> None:
         """Inline completion (greedy mode): materialize this round's
         futures and send — runs on the dispatcher thread, so per-conn
@@ -1584,21 +1700,49 @@ class VerdictService:
         self.fast_log.log_batch("r2d2", n, int(n - allow.sum()))
         self.vec_batches += 1
         self.vec_entries += n
-        # Coalesce this round's verdict frames per client: one sendall
-        # per client instead of one syscall (+ writer-lock trip) per
-        # original message — the dominant per-item cost in aggregated
-        # rounds.
+        self._send_vec_frames(sends, allow)
+
+    def _send_vec_frames(self, sends, allow) -> None:
+        """Emit a vec round's verdicts: one VERDICT_BATCH frame per
+        original message, coalesced into one sendall (+ one writer-lock
+        trip) per client — the dominant per-item cost in aggregated
+        rounds.  Each message's wire batch rides along so send_frames
+        marks it answered under the write lock before writing.  Frame
+        build and client failures are isolated: one bad entry or dead
+        client must not abort the rest of the round."""
         per_client: dict[int, tuple] = {}
-        for client, seq, ids, lens, a, b in sends:
+        for client, seq, ids, lens, a, b, batch in sends:
             try:
                 frame = self._verdict_frame(seq, ids, lens, allow[a:b])
             except Exception:  # noqa: BLE001
                 log.exception("verdict frame build failed")
+                # Fail closed, never silent: the shim is owed exactly
+                # one reply for this seq, and nothing downstream will
+                # answer it (the round completes normally).
+                try:
+                    sent = client.send_verdicts(
+                        seq,
+                        self._typed_entries(
+                            batch, FilterResult.UNKNOWN_ERROR
+                        ),
+                        batch=batch,
+                    )
+                except Exception:  # noqa: BLE001
+                    log.exception("error response send failed")
+                    continue
+                if sent:  # see _shed_item: no double-booking
+                    self.error_entries += batch.count
                 continue
-            per_client.setdefault(id(client), (client, []))[1].append(frame)
-        for client, frames in per_client.values():
+            _, frames, bs = per_client.setdefault(
+                id(client), (client, [], [])
+            )
+            frames.append(frame)
+            bs.append(batch)
+        for client, frames, bs in per_client.values():
             try:
-                client.send_frames(wire.MSG_VERDICT_BATCH, frames)
+                client.send_frames(
+                    wire.MSG_VERDICT_BATCH, frames, batches=bs
+                )
             except Exception:  # noqa: BLE001 — client may be gone
                 log.exception("verdict send failed")
 
@@ -1648,9 +1792,9 @@ class VerdictService:
             # coalesced into this group's single batched get.
             slots.acquire()
             recs = drain(recs)
-            stop = any(r[0] == "stop" for r in recs)
+            stop = any(r[0] == "stop" for _rid, r in recs)
             futs = []
-            for r in recs:
+            for _rid, r in recs:
                 if r[0] == "vec":
                     futs.extend(fut for fut, _, _, _ in r[1])
                 elif r[0] == "entry2":
@@ -1695,10 +1839,26 @@ class VerdictService:
                 log.exception("device readback failed")
                 vals = [None] * n_futs
             vi = 0
-            for r in recs:
+            cur = threading.current_thread()
+            for rid, r in recs:
+                # Adopt the record's round id for the duration of its
+                # sends: a record issued by a round the stall watchdog
+                # shed already had its whole batch answered with typed
+                # SHED verdicts, so the thread_round_is_shed()
+                # suppression in _ClientHandler.send* must cover THIS
+                # thread's sends of that record too — or a client
+                # receives both a real VERDICT_BATCH and a SHED batch
+                # for one seq.  Rounds that completed before their
+                # worker was deposed keep their own (un-shed) ids and
+                # are emitted normally — never silently lost.
+                cur._disp_round = rid
                 try:
+                    deposed = self.dispatcher.thread_round_is_shed()
                     if r[0] == "vec":
                         _, issued, n, sends = r
+                        if deposed:
+                            vi += len(issued)  # keep later slices aligned
+                            continue
                         allow = np.empty(n, bool)
                         for _, a, b, cn in issued:
                             v = vals[vi]
@@ -1712,30 +1872,32 @@ class VerdictService:
                         )
                         self.vec_batches += 1
                         self.vec_entries += n
-                        per_client: dict[int, tuple] = {}
-                        for client, seq, ids, lens, a, b in sends:
-                            per_client.setdefault(
-                                id(client), (client, [])
-                            )[1].append(
-                                self._verdict_frame(
-                                    seq, ids, lens, allow[a:b]
-                                )
-                            )
-                        for client, frames in per_client.values():
-                            client.send_frames(
-                                wire.MSG_VERDICT_BATCH, frames
-                            )
+                        self._send_vec_frames(sends, allow)
                     elif r[0] == "entry2":
+                        # Runs even when deposed: finish() drains engine
+                        # ops/inject and the async-pending refcounts
+                        # (skipping it would wedge deferred rounds and
+                        # misattribute ops); its sends are suppressed by
+                        # the generation adopted above.
                         _, rfuts, finish = r
                         nf = len(rfuts)
                         chunk = vals[vi : vi + nf]
                         vi += nf  # before finish: a throw must not
-                        finish(chunk)  # misalign later records' slices
+                        # misalign later records' slices.  deferred_scope:
+                        # pump/judge crashes inside a deferred completion
+                        # happen on THIS thread, outside any dispatcher
+                        # round — recorded sticky so the next round_start
+                        # can't erase them before they hold the streak.
+                        self.guard.deferred_scope(finish, chunk)
                     elif r[0] == "ready":
-                        _, client, seq, entries = r
-                        client.send_verdicts(seq, entries)
+                        _, client, batch, entries = r
+                        client.send_verdicts(
+                            batch.seq, entries, batch=batch
+                        )
                 except Exception:  # noqa: BLE001 — worker must survive
                     log.exception("completion failed")
+                finally:
+                    cur._disp_round = None
 
     _ERR_ROW = np.frombuffer(b"ERROR\r\n", np.uint8)
 
@@ -1770,12 +1932,6 @@ class VerdictService:
     def _verdict_frame(self, seq, conn_ids, lengths, allow) -> bytes:
         return struct.pack("<QI", seq, len(conn_ids)) + self._verdict_body(
             conn_ids, lengths, allow
-        )
-
-    def _send_columnar(self, client, seq, conn_ids, lengths, allow) -> None:
-        client.send(
-            wire.MSG_VERDICT_BATCH,
-            self._verdict_frame(seq, conn_ids, lengths, allow),
         )
 
     def _process_entrywise(self, items: list) -> None:
@@ -1893,7 +2049,8 @@ class VerdictService:
                         _, client, batch = item
                         try:
                             client.send_verdicts(
-                                batch.seq, responses[id(item)]
+                                batch.seq, responses[id(item)],
+                                batch=batch,
                             )
                         except Exception:  # noqa: BLE001 — client gone
                             log.exception("verdict send failed")
@@ -1907,7 +2064,7 @@ class VerdictService:
                                 else:
                                     self._async_pending[cid] = n
 
-            self._completions.put(("entry2", futs, finish))
+            self._completion_put(("entry2", futs, finish))
             return
 
         # Sync fallback.  If any conn in this round has an UNFINISHED
@@ -1934,16 +2091,18 @@ class VerdictService:
                 _, client, batch = item
                 if self._inline_complete or deferred:
                     try:
-                        client.send_verdicts(batch.seq, responses[id(item)])
+                        client.send_verdicts(
+                            batch.seq, responses[id(item)], batch=batch
+                        )
                     except Exception:  # noqa: BLE001 — client may be gone
                         log.exception("verdict send failed")
                 else:
-                    self._completions.put(
-                        ("ready", client, batch.seq, responses[id(item)])
+                    self._completion_put(
+                        ("ready", client, batch, responses[id(item)])
                     )
 
         if deferred:
-            self._completions.put(("entry2", [], run_sync_and_respond))
+            self._completion_put(("entry2", [], run_sync_and_respond))
         else:
             run_sync_and_respond()
 
@@ -2072,14 +2231,31 @@ class VerdictService:
             else:
                 allows.append(np.asarray(v))
         for key, i, sc, conn_id, engine, more, slots in plan:
-            ops, inject = engine.settle_entry(
-                conn_id,
-                [
-                    (msg, msg_len, bool(allows[bi][j]))
-                    for bi, j, msg, msg_len in slots
-                ],
-                more,
-            )
+            try:
+                ops, inject = engine.settle_entry(
+                    conn_id,
+                    [
+                        (msg, msg_len, bool(allows[bi][j]))
+                        for bi, j, msg, msg_len in slots
+                    ],
+                    more,
+                )
+            except Exception:  # noqa: BLE001 — per-entry containment
+                # The flow can be GONE by finish time: a quarantine
+                # demotion (_demote_to_oracle pops engine.flows) or a
+                # close raced this deferred completion — typically on a
+                # deposed round whose seq the SHED reply already
+                # answered.  One gone conn must not abort the rest of
+                # the round's drain (their ops would leak into the next
+                # round's take_ops); this entry fails closed typed.
+                log.exception(
+                    "async settle failed (conn %d)", conn_id
+                )
+                self.error_entries += 1
+                responses[key][i] = (
+                    conn_id, int(FilterResult.UNKNOWN_ERROR), [], b"", b"",
+                )
+                continue
             responses[key][i] = self._entry_response(
                 conn_id, ops, b"", inject
             )
@@ -2204,7 +2380,7 @@ class VerdictService:
                     engine.pump()
                 except Exception as exc:  # noqa: BLE001 — contain per engine
                     log.exception("engine pump failed")
-                    self.guard.record_failure(
+                    self._record_contained_failure(
                         f"pump-crash: {type(exc).__name__}"
                     )
                     failed.add(eid)
@@ -2386,13 +2562,21 @@ def _matrix_to_batch(mb: wire.MatrixBatch) -> wire.DataBatch:
     parts = [
         mb.rows[i, : int(mb.lengths[i])].tobytes() for i in range(mb.count)
     ]
-    return wire.DataBatch(
+    batch = wire.DataBatch(
         mb.seq,
         mb.conn_ids,
         np.zeros(mb.count, np.uint8),
         mb.lengths,
         b"".join(parts),
     )
+    # Alias the answered cell: real-verdict sends mark the conversion,
+    # but the dispatcher's _current_batch (what a deposal/crash sweep
+    # iterates) still holds the ORIGINAL mat item — a separate flag
+    # would let the sweep double-reply a seq the round already served.
+    batch._acell = mb._acell
+    batch.deadline = mb.deadline
+    batch.arrival = mb.arrival
+    return batch
 
 
 class _ClientHandler:
@@ -2403,40 +2587,115 @@ class _ClientHandler:
         self.sock = sock
         self._wlock = threading.Lock()
         self.module_id = 0
+        # Kernel send timeout (send only — settimeout would also bound
+        # the reader's recv): a shim that stopped READING wedges
+        # sendall while this handler's _wlock is held, and every later
+        # replier for this client — including the stall watchdog's
+        # deposal shed sweep — blocks behind it unboundedly, disabling
+        # stall containment service-wide.  With the bound, the wedged
+        # write errors out, releases the lock, and the handler is torn
+        # down (_kill) — one dead peer costs its own connection, never
+        # the watchdog.
+        timeout_s = service.guard.timeout_s or 10.0
+        try:
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", int(timeout_s),
+                            int((timeout_s % 1.0) * 1e6)),
+            )
+        except OSError:  # pragma: no cover — platform without SNDTIMEO
+            pass
+
+    def _kill(self) -> None:
+        """Tear the socket down after a failed/timed-out write: the
+        frame may be half-written, so the stream is unusable — a peer
+        still reading it would desync.  shutdown() wakes the reader
+        thread (which owns the close) and makes every later write fail
+        fast; the shim sees EOF and fails over/reconnects."""
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
 
     def _suppressed(self) -> bool:
-        """True on a dispatcher worker deposed by the stall watchdog —
-        its batch already received typed shed verdicts, so a late send
+        """True on a thread whose round the stall watchdog shed (the
+        stuck worker/cut-through reader itself, or the send loop
+        emitting a record that round queued) and on a deposed worker —
+        the batch already received typed shed verdicts, so a late send
         (after the stall clears) would duplicate/interleave replies."""
-        return self.service.dispatcher.thread_is_deposed()
+        disp = self.service.dispatcher
+        return disp.thread_is_deposed() or disp.thread_round_is_shed()
 
-    def send(self, msg_type: int, payload: bytes) -> None:
+    def send(self, msg_type: int, payload: bytes, batches=None) -> bool:
+        """Returns True only when THIS call answered the covered
+        seq(s) — it marked the batches and attempted the write (an
+        OSError to a gone client still counts: there is no one left to
+        shed to).  False means the call stood down without writing:
+        round/generation-suppressed, or a racing reply already
+        answered.  Fail-closed repliers key their shed/error COUNTERS
+        on this — counting a stood-down reply would double-book an
+        entry as both served and shed.  ``batches``: the wire batches
+        this payload answers.  They are marked ``answered`` ATOMICALLY
+        under the write lock BEFORE the write, so a fail-closed
+        replier (shed/crash containment) racing a real-verdict send —
+        including one currently wedged inside this very sendall, which
+        is exactly what trips the stall watchdog — can never add a
+        second reply for a seq the shim will consume.  ANY batch
+        already answered stands the whole payload down: a packed
+        multi-seq payload cannot be split, and a deposal sweep that
+        got to one of its batches first will (or did) answer the
+        siblings typed too — writing anyway would double-reply the
+        answered seq."""
         if self._suppressed():
-            return
+            return False
         with self._wlock:
+            if batches:
+                if any(b.answered for b in batches):
+                    return False  # a racing reply already answered
+                for b in batches:
+                    b.answered = True
             try:
                 wire.send_msg(self.sock, msg_type, payload)
             except OSError:
-                pass
+                self._kill()
+        return True
 
-    def send_frames(self, msg_type: int, payloads: list[bytes]) -> None:
-        """One sendall for a round's worth of frames to this client."""
+    def send_frames(self, msg_type: int, payloads: list[bytes],
+                    batches=None) -> bool:
+        """One sendall for a round's worth of frames to this client;
+        ``batches`` parallels ``payloads``.  Same contract as send(),
+        per frame: a frame whose batch was already answered is dropped
+        under the write lock, the rest are marked answered before the
+        write; True only when this call answered at least one frame."""
         if self._suppressed():
-            return
-        buf = b"".join(
-            wire.HEADER.pack(wire.MAGIC, msg_type, len(p)) + p
-            for p in payloads
-        )
+            return False
         with self._wlock:
+            if batches is not None:
+                keep = [
+                    i for i, b in enumerate(batches) if not b.answered
+                ]
+                if not keep:
+                    return False  # every frame lost its race: stand down
+                for i in keep:
+                    batches[i].answered = True
+                if len(keep) != len(payloads):
+                    payloads = [payloads[i] for i in keep]
+            buf = b"".join(
+                wire.HEADER.pack(wire.MAGIC, msg_type, len(p)) + p
+                for p in payloads
+            )
             try:
                 self.sock.sendall(buf)
             except OSError:
-                pass
+                self._kill()
+        return True
 
-    def send_verdicts(self, seq: int, entries: list) -> None:
+    def send_verdicts(self, seq: int, entries: list, batch=None) -> bool:
         """entries: (conn_id, result, ops, inject_orig, inject_reply) —
         op lists longer than the ABI capacity split into continuation
-        entries (reference: 16-op OnIO array, cilium_proxylib.cc:199)."""
+        entries (reference: 16-op OnIO array, cilium_proxylib.cc:199).
+        Same contract as send(); ``batch`` is the wire batch this
+        reply answers."""
         conn_ids, results, op_counts = [], [], []
         inj_o, inj_r = [], []
         flat_ops: list[tuple[int, int]] = []
@@ -2464,12 +2723,13 @@ class _ClientHandler:
         if flat_ops:
             ops_arr["op"] = [o for o, _ in flat_ops]
             ops_arr["n_bytes"] = [n for _, n in flat_ops]
-        self.send(
+        return self.send(
             wire.MSG_VERDICT_BATCH,
             wire.pack_verdict_batch(
                 seq, conn_ids, results, op_counts, inj_o, inj_r,
                 ops_arr, bytes(blob),
             ),
+            batches=None if batch is None else [batch],
         )
 
     @staticmethod

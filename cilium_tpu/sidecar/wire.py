@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import socket
 import struct
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -246,10 +246,31 @@ def unpack_new_connection(payload: bytes):
     )
 
 
+class _AnsweredCell:
+    """Whether a real (non-suppressed) verdict reply for this batch's
+    seq has been emitted — a stall deposal must not shed an item the
+    round already served (the client would receive both a
+    VERDICT_BATCH and a SHED batch for one seq).  The flag lives in
+    the subclass's ``_acell`` one-element list so a batch DERIVED from
+    another (a demoted MatrixBatch's DataBatch conversion) can alias
+    its origin's state: the real-verdict send marks the copy, the
+    deposal/crash sweeps check the original — they must observe one
+    flag or the seq is double-replied.  THE one definition, shared by
+    both wire batch types; an edit here cannot diverge between them."""
+
+    @property
+    def answered(self) -> bool:
+        return self._acell[0]
+
+    @answered.setter
+    def answered(self, v: bool) -> None:
+        self._acell[0] = v
+
+
 # --- DATA_BATCH ----------------------------------------------------------
 
 @dataclass
-class DataBatch:
+class DataBatch(_AnsweredCell):
     seq: int
     conn_ids: np.ndarray  # u64[n]
     flags: np.ndarray  # u8[n]
@@ -257,10 +278,11 @@ class DataBatch:
     blob: bytes  # concatenated entry payloads
     _offsets: np.ndarray | None = None
     # Containment bookkeeping (service-side, never serialized): absolute
-    # monotonic deadline from a DATA_BATCH_DL budget, and arrival time
-    # for the queue-age watermark.
+    # monotonic deadline from a DATA_BATCH_DL budget, arrival time for
+    # the queue-age watermark, and the _AnsweredCell answered flag.
     deadline: float | None = None
     arrival: float = 0.0
+    _acell: list = field(default_factory=lambda: [False])
 
     @property
     def count(self) -> int:
@@ -338,16 +360,18 @@ def unpack_data_batch_dl(payload: bytes) -> tuple[float, DataBatch]:
 # --- DATA_MATRIX ---------------------------------------------------------
 
 @dataclass
-class MatrixBatch:
+class MatrixBatch(_AnsweredCell):
     seq: int
     width: int
     conn_ids: np.ndarray  # u64[n]
     lengths: np.ndarray  # u32[n]
     rows: np.ndarray  # u8[n, width], zero-padded past lengths
     flags: int = 0  # MAT_FLAG_* bits
-    # Containment bookkeeping (service-side, never serialized).
+    # Containment bookkeeping (service-side, never serialized):
+    # deadline/arrival as in DataBatch, plus the _AnsweredCell flag.
     deadline: float | None = None
     arrival: float = 0.0
+    _acell: list = field(default_factory=lambda: [False])
 
     @property
     def count(self) -> int:

@@ -66,7 +66,11 @@ def service(tmp_path):
 
 @pytest.fixture
 def client(service):
-    c = SidecarClient(service.socket_path)
+    # The service's first engine prewarm runs the dispatch-mode probe
+    # (eager AND jit compiles) lazily inside whichever RPC triggers it;
+    # compile wall-time late in a long pytest process can exceed the
+    # default 10s RPC timeout and flake the test that got unlucky.
+    c = SidecarClient(service.socket_path, timeout=60.0)
     yield c
     c.close()
 
@@ -371,7 +375,7 @@ def test_sidecar_dispatch_modes_bit_identical(tmp_path, mode, device):
         str(tmp_path / f"verdict-{mode}-{device}.sock"), cfg
     ).start()
     try:
-        c = SidecarClient(svc.socket_path)
+        c = SidecarClient(svc.socket_path, timeout=60.0)
         try:
             exp = oracle_ops(r2d2_policy(), CORPUS)
             got = shim_ops(c, CORPUS)
@@ -394,7 +398,7 @@ def test_sidecar_dispatch_auto_resolves_by_measurement(tmp_path):
     svc = VerdictService(str(tmp_path / "verdict-auto.sock"), cfg).start()
     try:
         assert svc.dispatch_mode_chosen is None
-        c = SidecarClient(svc.socket_path)
+        c = SidecarClient(svc.socket_path, timeout=60.0)
         try:
             exp = oracle_ops(r2d2_policy(), CORPUS)
             got = shim_ops(c, CORPUS)
@@ -431,7 +435,7 @@ def test_grouped_matrix_round_multi_verdicts(tmp_path):
     inst.reset_module_registry()
     cfg = DaemonConfig(batch_timeout_ms=0.0, batch_flows=512)
     svc = VerdictService(str(tmp_path / "v2.sock"), cfg).start()
-    c = SidecarClient(svc.socket_path)
+    c = SidecarClient(svc.socket_path, timeout=60.0)
     try:
         mod = open_with_policy(c)
         width = cfg.batch_width

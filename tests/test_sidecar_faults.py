@@ -465,6 +465,74 @@ def test_client_reconnect_after_service_restart(tmp_path):
         inst.reset_module_registry()
 
 
+def test_reconnect_single_loop_when_replay_socket_dies(tmp_path):
+    """A replay socket dying MID-replay (service restarting again) must
+    not spawn a second reconnect loop: _resume re-arms the disconnect
+    latch before replaying, so the dying reader's _on_disconnect fires
+    while the first loop is still active — without loop ownership, two
+    loops race over self.sock, the session replays twice, and the
+    loser's socket is orphaned with a live reader."""
+    import os
+    import socket as socket_mod
+
+    svc = _service(tmp_path, "oneloop")
+    path = svc.socket_path
+    client = SidecarClient(path, timeout=8.0, auto_reconnect=True)
+
+    def loops():
+        return [
+            t for t in threading.enumerate()
+            if t.name == "sidecar-reconnect" and t.is_alive()
+        ]
+
+    try:
+        _open_conn(client, 7501)
+        svc.stop()
+        _wait(lambda: not client.connected, 5.0, "client down")
+
+        # Flaky phase: a raw acceptor that kills every connection
+        # immediately — each cycle gets _resume far enough to start a
+        # reader whose prompt death runs _on_disconnect with the latch
+        # re-armed (the double-spawn window).
+        flaky = socket_mod.socket(
+            socket_mod.AF_UNIX, socket_mod.SOCK_STREAM
+        )
+        flaky.bind(path)
+        flaky.listen(8)
+        flaky.settimeout(8.0)
+        try:
+            for _ in range(4):
+                conn, _ = flaky.accept()
+                conn.close()
+        finally:
+            flaky.close()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        assert len(loops()) <= 1, [t.name for t in loops()]
+
+        # Healthy service returns: the one loop replays exactly once,
+        # verdicts flow, and the loop winds down.
+        inst.reset_module_registry()
+        svc2 = VerdictService(path, DaemonConfig(
+            batch_timeout_ms=2.0, batch_flows=256, dispatch_mode="eager",
+        )).start()
+        try:
+            _wait(
+                lambda: client.connected and client.reconnects >= 1,
+                10.0, "client reconnect",
+            )
+            assert client.reconnects == 1
+            _wait(lambda: not loops(), 5.0, "reconnect loop exit")
+        finally:
+            svc2.stop()
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
 # --- flow buffer caps: typed protocol-error DROP + close -------------------
 
 def test_flow_buffer_cap_request_direction(tmp_path):
@@ -583,3 +651,474 @@ def test_cli_sidecar_status(tmp_path, capsys):
         client.close()
         svc.stop()
         inst.reset_module_registry()
+
+
+# --- review regressions: deposal vs cut-through / send pipeline ------------
+
+def test_cut_through_survives_mid_round_deposal(tmp_path):
+    """The stall watchdog can depose (swap _in_process_lock, bump the
+    generation) WHILE a cut-through round holds the lock.  The finally
+    must release the lock it acquired — releasing the swapped-in fresh
+    lock instead raises RuntimeError out of submit_data (killing the
+    shim connection) and leaks the old lock held forever."""
+    svc = VerdictService(
+        str(tmp_path / "ct.sock"),
+        DaemonConfig(batch_timeout_ms=0.0, dispatch_mode="eager"),
+    )
+    disp = svc.dispatcher
+    old_lock = disp._in_process_lock
+
+    def deposing_process(items):  # what the watchdog does mid-round
+        disp._gen += 1
+        disp._in_process_lock = threading.Lock()
+
+    svc._process = deposing_process
+    item = ("data", None, object())
+    assert svc._try_cut_through(item) is True  # no RuntimeError escapes
+    # The lock cut-through held was released (not leaked held)...
+    assert old_lock.acquire(blocking=False)
+    old_lock.release()
+    # ...and the replacement generation's lock was never touched.
+    assert disp._in_process_lock.acquire(blocking=False)
+    disp._in_process_lock.release()
+
+
+def test_send_loop_suppresses_only_shed_rounds(tmp_path):
+    """Vec/ready groups a stuck round already queued to the send
+    pipeline are emitted by the send thread, not the stuck worker —
+    the send loop must adopt each record's ROUND id so exactly the
+    shed round's sends are suppressed (its batch already got typed
+    SHED verdicts), while a deposed worker's EARLIER completed rounds
+    still in the pipeline are emitted — never silently lost."""
+    svc = VerdictService(
+        str(tmp_path / "sl.sock"),
+        DaemonConfig(batch_timeout_ms=2.0, dispatch_mode="eager"),
+    )
+
+    import socket
+
+    from cilium_tpu.sidecar.service import _ClientHandler
+
+    a_sock, b_sock = socket.socketpair()
+
+    class _Probe(_ClientHandler):
+        def __init__(self):
+            super().__init__(svc, a_sock)
+            self.calls = []
+
+        def send_verdicts(self, seq, entries, batch=None):
+            self.calls.append(
+                (seq, svc.dispatcher.thread_round_is_shed())
+            )
+            return super().send_verdicts(seq, entries, batch=batch)
+
+    class _Batch:
+        def __init__(self, seq):
+            self.seq = seq
+            self.answered = False
+
+    probe = _Probe()
+    batches = [_Batch(1), _Batch(2), _Batch(3)]
+    t = threading.Thread(target=svc._send_loop, daemon=True)
+    t.start()
+    # Watchdog deposed the worker mid-round 7; rounds 6 (completed
+    # earlier, records still queued) and 8 (replacement worker) were
+    # never shed.
+    svc.dispatcher._shed_rounds.add(7)
+    svc._sends.put(([(6, ("ready", probe, batches[0], []))], None, 0))
+    svc._sends.put(([(7, ("ready", probe, batches[1], []))], None, 0))
+    svc._sends.put(([(8, ("ready", probe, batches[2], []))], None, 0))
+    svc._sends.put(None)
+    t.join(5)
+    a_sock.close()
+    b_sock.close()
+    assert not t.is_alive()
+    assert probe.calls == [(1, False), (2, True), (3, False)]
+    # The shed round's batch stays unanswered (its typed SHED reply was
+    # the answer); the emitted rounds' batches are marked answered so a
+    # later deposal can never double-reply their seqs.
+    assert [b.answered for b in batches] == [True, False, True]
+
+
+def test_crash_containment_skips_answered_items(tmp_path):
+    """A greedy multi-group round can serve one group's real verdicts
+    inline, then crash in a later group: _on_batch_error must answer
+    only the still-unanswered items — a second reply for a seq the
+    shim already consumed would desync it."""
+    svc = VerdictService(
+        str(tmp_path / "cc.sock"),
+        DaemonConfig(batch_timeout_ms=2.0, dispatch_mode="eager"),
+    )
+
+    class _Probe:
+        def __init__(self):
+            self.calls = []
+
+        def send_verdicts(self, seq, entries, batch=None):
+            self.calls.append((seq, [r for _, r, *_ in entries]))
+            if batch is not None:
+                batch.answered = True
+            return True
+
+    class _Batch:
+        def __init__(self, seq):
+            self.seq = seq
+            self.count = 1
+            self.conn_ids = np.array([5], "<u8")
+            self.answered = False
+
+    probe = _Probe()
+    served, unserved = _Batch(1), _Batch(2)
+    served.answered = True  # its real verdicts already went out
+    svc._on_batch_error(
+        [("data", probe, served), ("data", probe, unserved)],
+        RuntimeError("boom"),
+    )
+    assert [seq for seq, _ in probe.calls] == [2]
+    assert probe.calls[0][1] == [int(FilterResult.UNKNOWN_ERROR)]
+    assert unserved.answered
+
+
+def test_demoted_matrix_shares_answered_state():
+    """A demoted mat item is served via its DataBatch conversion while
+    the dispatcher's _current_batch (what a deposal/crash sweep
+    iterates) still holds the ORIGINAL MatrixBatch — the two must
+    share ONE answered flag, or the sweep sends a typed SHED/error for
+    a seq the round already served (shim desync)."""
+    from cilium_tpu.sidecar import wire
+    from cilium_tpu.sidecar.service import _matrix_to_batch
+
+    mb = wire.MatrixBatch(
+        seq=9,
+        width=16,
+        conn_ids=np.array([1, 2], "<u8"),
+        lengths=np.array([4, 4], "<u4"),
+        rows=np.zeros((2, 16), np.uint8),
+    )
+    batch = _matrix_to_batch(mb)
+    assert not mb.answered
+    batch.answered = True  # real verdicts served via the conversion
+    assert mb.answered  # the sweep must stand down
+
+
+def test_send_marks_answered_under_write_lock(tmp_path):
+    """The real-verdict send paths mark their wire batches answered
+    under the client write lock BEFORE the write: a fail-closed
+    replier racing an in-flight sendall for the same seq — the wedged
+    send that trips the stall watchdog — finds the batch already
+    answered and stands down.  Conversely, a frame whose batch a
+    fail-closed reply already answered is dropped under the same lock,
+    never written."""
+    import socket
+
+    from cilium_tpu.sidecar import wire
+    from cilium_tpu.sidecar.service import _ClientHandler
+
+    svc = VerdictService(
+        str(tmp_path / "wl.sock"),
+        DaemonConfig(batch_timeout_ms=2.0, dispatch_mode="eager"),
+    )
+    a_sock, b_sock = socket.socketpair()
+    try:
+        handler = _ClientHandler(svc, a_sock)
+
+        class _Batch:
+            answered = False
+
+        fresh, shed = _Batch(), _Batch()
+        shed.answered = True  # a SHED reply already answered this seq
+        assert handler.send_frames(
+            wire.MSG_VERDICT_BATCH, [b"fresh", b"stale"],
+            batches=[fresh, shed],
+        )
+        assert fresh.answered
+        # Only the fresh frame reached the wire.
+        b_sock.settimeout(2.0)
+        reader = wire.BufferedReader(b_sock)
+        _, payload = reader.recv_msg()
+        assert payload == b"fresh"
+        assert not reader.pending
+        # send() with ANY covered batch answered stands the whole
+        # payload down (a packed multi-seq payload cannot be split) and
+        # leaves the unanswered sibling unmarked — the deposal sweep
+        # still owes it a typed reply; marking it here would make the
+        # sweep skip it (silent loss).  The stand-down returns False
+        # (this call answered nothing) so fail-closed repliers don't
+        # count a shed/error for an entry that was actually served.
+        fresh2 = _Batch()
+        assert not handler.send(
+            wire.MSG_VERDICT_BATCH, b"dup", batches=[fresh2, shed]
+        )
+        assert not fresh2.answered
+        b_sock.setblocking(False)
+        with pytest.raises(BlockingIOError):
+            b_sock.recv(64)
+        # A write to a dead peer must not raise out of the send path
+        # (the handler tears its own socket down instead).
+        b_sock.close()
+        assert handler.send(
+            wire.MSG_VERDICT_BATCH, b"gone", batches=[_Batch()]
+        )
+    finally:
+        a_sock.close()
+        try:
+            b_sock.close()
+        except OSError:
+            pass
+
+
+def test_cut_through_stall_on_idle_service_is_shed(tmp_path, fault_model):
+    """Greedy mode, idle service: the round runs inline on the shim
+    reader thread (cut-through), where a hung device call used to be
+    invisible to the stall watchdog (_busy never set — no deposal, no
+    quarantine, a wedged reader, and a client waiting forever).  The
+    cut-through round must arm the watchdog: the stuck round is shed
+    with typed SHED verdicts within the deadline and the device is
+    quarantined."""
+    svc = _service(
+        tmp_path, "ctstall",
+        batch_timeout_ms=0.0,
+        device_call_timeout_s=0.5,
+        device_reprobe_interval_s=30.0,  # no heal during the test
+    )
+    client = SidecarClient(svc.socket_path, timeout=10.0)
+    model = None
+    try:
+        _, shim = _open_conn(client, 7701)
+        model = fault_model[-1]
+        model.stall.set()
+        t0 = time.monotonic()
+        result, entries = client._on_data_rpc(
+            shim.conn_id, False, False, b"HALT\r\n"
+        )
+        elapsed = time.monotonic() - t0
+        assert entries, "no reply for the stalled cut-through round"
+        assert all(
+            r == int(FilterResult.SHED) for _, r, *_ in entries
+        ), entries
+        assert elapsed < 5.0  # bounded by the watchdog, not the stall
+        assert svc.guard.quarantined
+        assert svc.dispatcher.stall_deposals >= 1
+    finally:
+        if model is not None:
+            model.stall.clear()
+        client.close()
+        # Wait for the unstuck reader thread to drain out of the
+        # service (it prunes itself from _clients on exit): a daemon
+        # thread dying inside an XLA call at interpreter teardown
+        # aborts the process ("terminate called without an active
+        # exception").
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with svc._lock:
+                if not svc._clients:
+                    break
+            time.sleep(0.02)
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_guard_streak_is_consecutive_rounds():
+    """Alternating crashed/clean rounds must never reach the
+    fail_threshold: a crashed round's taint (it never records ok) is
+    round-local and must not swallow the NEXT clean round's reset."""
+    from cilium_tpu.sidecar import DeviceGuard
+
+    g = DeviceGuard(fail_threshold=3)
+    for _ in range(5):
+        g.round_start()
+        g.record_failure("crash")  # round crashed: no record_ok
+        g.round_start()
+        g.record_ok()  # genuinely clean round resets the streak
+    assert not g.quarantined
+    # Contained in-round failures still count as a streak: the round
+    # completes (record_ok fires) but its taint holds the counter.
+    g2 = DeviceGuard(fail_threshold=3)
+    for _ in range(3):
+        g2.round_start()
+        g2.record_failure("contained")
+        g2.record_ok()
+    assert g2.quarantined
+
+
+def test_zombie_round_guard_calls_are_suppressed(tmp_path):
+    """A deposed (shed) round that unsticks must not touch the guard's
+    streak bookkeeping: its late record_ok would reset a genuine crash
+    streak the replacement worker is accumulating (or consume a live
+    round's taint), and a crash on the way out must not taint the live
+    rounds — deposal already booked the stall."""
+    svc = VerdictService(
+        str(tmp_path / "zg.sock"),
+        DaemonConfig(batch_timeout_ms=2.0, dispatch_mode="eager"),
+    )
+    cur = threading.current_thread()
+    try:
+        svc.guard._crash_streak = 2
+        svc.dispatcher._shed_rounds.add(99)
+        cur._disp_round = 99  # this thread carries the shed round
+        svc._process([])  # empty round: reaches the record_ok epilogue
+        assert svc.guard._crash_streak == 2  # not reset by the zombie
+        svc._on_batch_error([], RuntimeError("zombie crash"))
+        assert svc.guard._crash_streak == 2  # not tainted either
+        cur._disp_round = None  # a LIVE round's epilogue does reset
+        svc._process([])
+        assert svc.guard._crash_streak == 0
+    finally:
+        cur._disp_round = None
+
+
+def test_engine_overflow_drops_only_overflowing_direction():
+    """The retained-bytes cap must not clear the OPPOSITE direction's
+    buffer: those bytes are still mirrored by the shim, and vanishing
+    them with no covering op desyncs the mirror."""
+    from cilium_tpu.proxylib.types import DROP, ERROR
+    from cilium_tpu.runtime.l7engine import DeviceAssistedEngine
+
+    class _MiniEngine(DeviceAssistedEngine):
+        proto = "mini"
+
+        def _make_parser(self, conn):
+            return None
+
+    eng = _MiniEngine(None, True, 80, None, max_buffer=64)
+    eng.feed(1, b"x" * 40, reply=False)  # request-direction retained
+    eng.feed(1, b"y" * 40, reply=True)  # 40 + 40 > 64: reply overflows
+    st = eng.flows[1]
+    assert st.overflowed
+    # The DROP covers exactly the reply direction's cleared bytes...
+    assert st.ops[True][0] == (DROP, 40)
+    assert st.ops[True][1][0] == ERROR
+    # ...and the request direction's retained bytes stay accounted.
+    assert bytes(st.bufs[False]) == b"x" * 40
+    assert not st.ops[False]
+
+
+def test_worker_waits_out_inline_round():
+    """A submit landing while a cut-through inline round is in flight
+    must NOT be popped until that round closes: _pop_locked would
+    overwrite the watchdog's round state (_round_start, round_seq,
+    _current_batch) with the merely lock-blocked pop's, leaving the
+    genuinely stuck inline item invisible to deposal."""
+    processed = []
+    disp = BatchDispatcher(
+        lambda b: processed.append(list(b)), timeout_ms=0.0,
+        name="t-inline-wait",
+    ).start()
+    armed = threading.Event()
+    release = threading.Event()
+    rid_box = {}
+
+    def reader():  # a shim reader mid-cut-through, "hung" in the device
+        lock = disp._in_process_lock
+        with lock:
+            rid_box["rid"] = disp.begin_inline_round(["inline-item"])
+            armed.set()
+            release.wait(10)
+        disp.end_inline_round(rid_box["rid"])
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    assert armed.wait(5) and rid_box["rid"] is not None
+    disp.submit("queued-behind")
+    time.sleep(0.15)  # window for a (buggy) worker pop to clobber
+    assert disp.round_seq == rid_box["rid"]
+    assert disp._current_batch == ["inline-item"]
+    assert processed == []
+    release.set()
+    t.join(5)
+    assert disp.flush(5)
+    assert processed == [["queued-behind"]]
+    disp.stop()
+
+
+def test_watchdog_sheds_stuck_inline_round_under_load():
+    """The loaded variant of the cut-through stall: with traffic queued
+    behind a stuck inline round, the watchdog must shed the INLINE
+    round (the one actually holding the device), not the lock-blocked
+    pop — and the queued work must then be served by the replacement
+    generation."""
+    shed, processed = [], []
+    disp = BatchDispatcher(
+        lambda b: processed.append(list(b)), timeout_ms=0.0,
+        stall_timeout_s=0.3, on_stall=lambda b: shed.append(list(b)),
+        name="t-ct-load",
+    ).start()
+    armed = threading.Event()
+    release = threading.Event()
+    rid_box = {}
+
+    def reader():
+        lock = disp._in_process_lock
+        with lock:
+            rid_box["rid"] = disp.begin_inline_round(["stuck-inline"])
+            armed.set()
+            release.wait(10)
+        disp.end_inline_round(rid_box["rid"])
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    assert armed.wait(5) and rid_box["rid"] is not None
+    disp.submit("queued-behind")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not shed:
+        time.sleep(0.02)
+    assert shed == [["stuck-inline"]]
+    assert rid_box["rid"] in disp._shed_rounds
+    assert disp.flush(5)
+    assert processed == [["queued-behind"]]
+    release.set()
+    t.join(5)
+    disp.stop()
+
+
+def test_cut_through_releases_lock_before_round_close(tmp_path):
+    """_try_cut_through must mirror _run's ordering — release the
+    in-process lock BEFORE clearing _busy: the watchdog reads a free
+    lock as 'process() returned, verdicts sent' and skips deposal, so
+    the inverse ordering leaves a busy+locked window in which a round
+    completing just past the deadline is deposed and double-replied."""
+    svc = VerdictService(
+        str(tmp_path / "ord.sock"),
+        DaemonConfig(batch_timeout_ms=0.0, dispatch_mode="eager"),
+    )
+    disp = svc.dispatcher
+    svc._process = lambda items: None
+    seen = {}
+    orig = disp.end_inline_round
+
+    def probing_end(rid):
+        lk = disp._in_process_lock
+        free = lk.acquire(blocking=False)
+        if free:
+            lk.release()
+        seen["lock_free_at_close"] = free
+        orig(rid)
+
+    disp.end_inline_round = probing_end
+    assert svc._try_cut_through(("data", None, object())) is True
+    assert seen["lock_free_at_close"] is True
+
+
+def test_guard_deferred_failures_hold_streak_across_rounds():
+    """A deferred completion crashing on the send loop lands OUTSIDE
+    any dispatcher round — round_start must not erase that taint, and
+    record_ok must consume it without resetting, so an engine whose
+    every deferred round crashes still reaches fail_threshold."""
+    from cilium_tpu.sidecar import DeviceGuard
+
+    g = DeviceGuard(fail_threshold=3)
+    for _ in range(3):
+        g.round_start()
+        g.record_ok()  # the round's sync part is clean
+        # ...its deferred completion crashes later, in the gap.
+        g.deferred_scope(g.record_failure, "pump-crash")
+    assert g.quarantined
+    # Round-local semantics are unchanged: alternating sync crash /
+    # clean rounds still reset (the original review's contract).
+    g2 = DeviceGuard(fail_threshold=3)
+    for _ in range(5):
+        g2.round_start()
+        g2.record_failure("crash")
+        g2.round_start()
+        g2.record_ok()
+    assert not g2.quarantined
