@@ -17,6 +17,7 @@ import threading
 from typing import Callable
 
 from ..utils.logging import get_logger
+from ..utils.sockutil import shutdown_close
 from .record import LogRecord
 
 log = get_logger("accesslog")
@@ -91,10 +92,7 @@ class AccessLogServer:
         except OSError:
             pass
         finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            shutdown_close(conn)
 
     def _handle(self, rec: LogRecord) -> None:
         with self._mutex:
@@ -115,23 +113,34 @@ class AccessLogServer:
 
     def close(self) -> None:
         self._stop.set()
+        # shutdown wakes the accept thread parked on the listener so
+        # the fd tears down now, not at its next timeout tick.
         try:
-            self._sock.close()
+            shutdown_close(self._sock)
         finally:
             if os.path.exists(self.path):
                 os.unlink(self.path)
 
 
 class AccessLogClient:
-    """Sender side (reference: proxylib/accesslog/client.go)."""
+    """Sender side (reference: proxylib/accesslog/client.go).
 
-    def __init__(self, path: str) -> None:
+    ``timeout`` bounds connect and sendall: access logging is
+    best-effort by contract (a failed log() returns False and the
+    verdict still flows), so a wedged collector — bound but not
+    accepting, or accepting but never reading until the socket buffer
+    fills — must cost ONE bounded wait, not hang the datapath caller
+    under the client mutex forever."""
+
+    def __init__(self, path: str, timeout: float = 5.0) -> None:
         self.path = path
+        self.timeout = timeout
         self._sock: socket.socket | None = None
         self._mutex = threading.Lock()
 
     def _connect(self) -> socket.socket:
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
         s.connect(self.path)
         return s
 
@@ -145,22 +154,18 @@ class AccessLogClient:
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
-                    self._sock.sendall(frame)
+                    # One socket serialized by design; the sendall is
+                    # bounded by the constructor timeout, so a wedged
+                    # collector fails this log() instead of wedging it.
+                    self._sock.sendall(frame)  # lint: disable=R2 -- bounded by settimeout; serializing the shared socket is the point
                     return True
                 except OSError:
-                    if self._sock is not None:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
+                    shutdown_close(self._sock)
                     self._sock = None
         return False
 
     def close(self) -> None:
         with self._mutex:
             if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
+                shutdown_close(self._sock)
                 self._sock = None

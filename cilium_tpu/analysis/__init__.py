@@ -1,0 +1,38 @@
+"""cilium-lint: AST-based concurrency & hot-path invariant analysis.
+
+PRs 1-2 found an entire taxonomy of concurrency bugs in the verdict hot
+path by hand (re-read lock release after deposal swap, bare close()
+without shutdown(), blocking calls under locks, double-booked reply
+counters).  The paper's north star — >=1M L7 verdicts/sec at <1ms added
+p99, bit-identical verdicts — demands those invariants hold permanently,
+not just at review time, so this package encodes them as machine-checked
+rules over the repo's own AST (stdlib ``ast`` only, no third-party
+linter):
+
+  R1  lock discipline (acquire/finally pairing, captured-binding
+      release, recorded lock-order graph)
+  R2  blocking calls inside a held-lock region
+  R3  socket close() with no dominating shutdown()
+  R4  purity of functions reached from jax.jit/vmap/scan call sites
+  R5  wire MSG_* / FilterResult handler exhaustiveness
+  R6  thread hygiene (Thread() without daemon= or local join)
+  R0  lint pragma hygiene (malformed / unjustified suppressions)
+
+Run ``bin/cilium-lint cilium_tpu/`` (see README "Invariants & lint").
+Suppress a false positive on its line with a JUSTIFIED pragma::
+
+    risky_call()  # lint: disable=R2 -- why this is safe here
+
+A pragma without a justification is itself a finding (R0) and cannot
+be suppressed.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    RULE_DOCS,
+    SourceFile,
+    analyze_paths,
+    findings_to_json,
+    load_baseline,
+    split_findings,
+)

@@ -1,0 +1,120 @@
+"""``cilium-lint`` CLI.
+
+Text mode prints one line per active finding and exits 1 when any
+survive suppression; ``--json`` emits the full machine-readable report
+(active + suppressed + per-rule counts) for CI consumption.  The
+baseline (``--baseline``, default ``tests/lint_baseline.json`` when it
+exists next to the scanned tree) accepts findings wholesale so new
+violations fail the build while grandfathered ones don't.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (
+    RULE_DOCS,
+    _collect_py,
+    analyze_paths,
+    findings_to_json,
+    load_baseline,
+    split_findings,
+)
+
+
+def _default_baseline(paths) -> str | None:
+    """tests/lint_baseline.json next to the scanned package, if any."""
+    for p in paths:
+        d = os.path.abspath(p)
+        if not os.path.isdir(d):
+            d = os.path.dirname(d)
+        for root in (d, os.path.dirname(d)):
+            cand = os.path.join(root, "tests", "lint_baseline.json")
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="cilium-lint",
+        description="AST-based concurrency & hot-path invariant "
+                    "analyzer (rules R1-R6; see README 'Invariants & "
+                    "lint')",
+    )
+    p.add_argument("paths", nargs="*", default=["cilium_tpu"],
+                   help="files or directories to scan "
+                        "(default: cilium_tpu)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit a JSON report (active + suppressed + "
+                        "per-rule counts)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="JSON list of {rule,file,symbol} accepted "
+                        "findings (default: tests/lint_baseline.json "
+                        "next to the scanned tree, when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print pragma-/baseline-suppressed "
+                        "findings (text mode)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule set and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule, doc in sorted(RULE_DOCS.items()):
+            print(f"{rule}  {doc}")
+        return 0
+
+    # The gate must fail CLOSED on a misconfigured invocation: a
+    # typo'd path (or a CI job run from the wrong cwd) scanning zero
+    # files would otherwise print '0 finding(s)' and go green forever.
+    missing = [pth for pth in args.paths if not os.path.exists(pth)]
+    if missing:
+        for pth in missing:
+            print(f"cilium-lint: no such path: {pth}", file=sys.stderr)
+        return 2
+    if not _collect_py(args.paths):
+        print("cilium-lint: no Python files found under "
+              + " ".join(args.paths), file=sys.stderr)
+        return 2
+
+    baseline = None
+    if not args.no_baseline:
+        path = args.baseline or _default_baseline(args.paths)
+        if path is not None:
+            try:
+                baseline = load_baseline(path)
+            except (OSError, ValueError) as e:
+                print(f"cilium-lint: bad baseline {path}: {e}",
+                      file=sys.stderr)
+                return 2
+
+    findings = analyze_paths(args.paths, baseline=baseline)
+    active, muted = split_findings(findings)
+
+    if args.as_json:
+        print(json.dumps(findings_to_json(findings), indent=2))
+        return 1 if active else 0
+
+    for f in active:
+        print(f.render())
+    if args.show_suppressed:
+        for f in muted:
+            tag = "baseline" if f.baselined else "pragma"
+            why = f" ({f.justification})" if f.justification else ""
+            print(f"suppressed[{tag}]: {f.render()}{why}")
+    n_files = len({f.path for f in findings}) if findings else 0
+    print(
+        f"cilium-lint: {len(active)} finding(s), "
+        f"{len(muted)} suppressed"
+        + (f" across {n_files} file(s)" if findings else "")
+    )
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

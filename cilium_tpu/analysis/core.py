@@ -1,0 +1,388 @@
+"""Framework for the invariant analyzer: findings, pragmas, file walk.
+
+A *rule* is a function ``check(files) -> Iterable[Finding]`` where
+``files`` maps absolute path -> :class:`SourceFile`.  Rules see the
+whole scanned set at once so cross-file invariants (R5's wire
+exhaustiveness, R1's swappable-attribute pre-pass) need no side
+channel.
+
+Suppression model — two layers, both checked in:
+
+- **Pragmas** (per line, justified): ``# lint: disable=R2 -- reason``.
+  The justification is mandatory; a pragma without one is an R0
+  finding that cannot itself be suppressed.  A pragma on a
+  comment-only line applies to the next line (for statements whose
+  flagged line has no room).
+- **Baseline** (``tests/lint_baseline.json``): a checked-in list of
+  ``{rule, file, symbol}`` entries for findings that are accepted
+  wholesale.  New violations are never in the baseline, so they fail
+  the build.  The shipped baseline is empty — inline pragmas carry
+  every accepted suppression with its one-line why.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+RULE_DOCS = {
+    "R0": "lint hygiene: unparseable file or malformed/unjustified pragma",
+    "R1": "lock discipline: acquire/finally pairing, captured-binding "
+          "release, recorded lock-order graph",
+    "R2": "blocking call (socket/queue/join/sleep/device) inside a "
+          "held-lock region",
+    "R3": "socket close() with no dominating shutdown() — zombie "
+          "listener / wedged-reader bug class",
+    "R4": "function reached from jax.jit/vmap/scan mutates self, takes "
+          "locks, does I/O, or reads the wall clock",
+    "R5": "wire MSG_* constants and FilterResult codes must be "
+          "exhaustively handled (or fall into a fail-closed default)",
+    "R6": "threading.Thread(...) without daemon= or a local join — "
+          "leaks past the conftest thread guard",
+}
+
+# ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
+_PRAGMA_OK = re.compile(
+    r"#\s*lint:\s*disable=([A-Z0-9]+(?:\s*,\s*[A-Z0-9]+)*)\s*"
+    r"(?:--|—)\s*(\S.*?)\s*$"
+)
+_PRAGMA_ANY = re.compile(r"#\s*lint:")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str = ""
+    suppressed: bool = False
+    justification: str = ""
+    baselined: bool = False
+
+    def render(self) -> str:
+        where = f" [in {self.symbol}]" if self.symbol else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+            f"{self.message}{where}"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "file": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "symbol": self.symbol,
+            "suppressed": self.suppressed,
+            "justification": self.justification,
+            "baselined": self.baselined,
+        }
+
+
+class SourceFile:
+    """One parsed file: tree, lines, and its pragma table."""
+
+    def __init__(self, path: str, text: str) -> None:
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: str | None = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        # line -> (set of rule ids, justification)
+        self.pragmas: dict[int, tuple[set[str], str]] = {}
+        # lines carrying a pragma-looking comment that failed the format
+        self.bad_pragmas: list[tuple[int, str]] = []
+        self._scan_pragmas()
+
+    def _scan_pragmas(self) -> None:
+        # Scan real COMMENT tokens, not raw lines: a pragma-shaped
+        # substring inside a string/docstring (e.g. this framework's
+        # own docs documenting the format) must neither register a
+        # suppression nor trip R0.
+        try:
+            toks = [
+                t for t in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline)
+                if t.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            # Untokenizable ⇒ unparseable: analyze_paths already emits
+            # the R0 parse error and never consults this pragma table.
+            return
+        for tok in toks:
+            i, col = tok.start
+            comment = tok.string
+            if not _PRAGMA_ANY.search(comment):
+                continue
+            m = _PRAGMA_OK.search(comment)
+            if not m:
+                self.bad_pragmas.append(
+                    (i, "malformed lint pragma: expected "
+                        "'# lint: disable=<RULES> -- <justification>' "
+                        "(justification mandatory)")
+                )
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            just = m.group(2).strip()
+            entry = (rules, just)
+            self._merge_pragma(i, entry)
+            if not self.lines[i - 1][:col].strip():
+                # Comment-only line: the pragma governs the next line.
+                self._merge_pragma(i + 1, entry)
+
+    def _merge_pragma(self, line: int, entry: tuple[set[str], str]) -> None:
+        old = self.pragmas.get(line)
+        if old is None:
+            self.pragmas[line] = (set(entry[0]), entry[1])
+        else:
+            old[0].update(entry[0])
+
+    def suppression(self, line: int, rule: str) -> str | None:
+        """Justification text if ``rule`` is pragma-suppressed at
+        ``line``, else None."""
+        got = self.pragmas.get(line)
+        if got is not None and rule in got[0]:
+            return got[1]
+        return None
+
+
+# --- shared AST helpers ---------------------------------------------------
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — defensive; lint must not crash
+        return "<?>"
+
+
+def terminal_name(expr: ast.AST) -> str:
+    """Last path component of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def call_func_name(call: ast.Call) -> str:
+    return terminal_name(call.func)
+
+
+_LOCK_NAME = re.compile(r"(lock|mutex|mu)$", re.IGNORECASE)
+_LOCK_EXTRA = {"_down_once", "_cond", "_done"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Mutex", "RWMutex"}
+
+
+def is_lock_like_name(name: str) -> bool:
+    return bool(name) and (bool(_LOCK_NAME.search(name))
+                           or name in _LOCK_EXTRA)
+
+
+def is_lock_ctor(expr: ast.AST) -> bool:
+    return (isinstance(expr, ast.Call)
+            and call_func_name(expr) in _LOCK_CTORS)
+
+
+def local_assignments(func: ast.AST) -> dict[str, ast.AST]:
+    """name -> last simple-RHS assignment in the function body (used to
+    resolve ``lk = self._in_process_lock`` style aliases)."""
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                out[t.id] = node.value
+    return out
+
+
+def lock_terminal(expr: ast.AST, aliases: dict[str, ast.AST]) -> str:
+    """Terminal lock name for a with/acquire receiver, following one
+    level of local alias (``lk = self._in_process_lock``)."""
+    if isinstance(expr, ast.Name) and expr.id in aliases:
+        aliased = terminal_name(aliases[expr.id])
+        if aliased:
+            return aliased
+    # ``rw.read()`` reader guard: the lock is the receiver.
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+        return lock_terminal(expr.func.value, aliases)
+    return terminal_name(expr)
+
+
+def is_lock_like_expr(expr: ast.AST, aliases: dict[str, ast.AST]) -> bool:
+    name = lock_terminal(expr, aliases)
+    if is_lock_like_name(name):
+        return True
+    if isinstance(expr, ast.Name):
+        rhs = aliases.get(expr.id)
+        if rhs is not None and (is_lock_ctor(rhs)
+                                or is_lock_like_name(terminal_name(rhs))):
+            return True
+    return False
+
+
+def walk_functions(tree: ast.Module):
+    """Yield (funcdef, qualname, enclosing_class_or_None), outermost
+    first, for every def/async def in the module."""
+    def rec(node, stack, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                yield child, qual, cls
+                yield from rec(child, stack + [child.name], cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, stack + [child.name], child)
+            else:
+                yield from rec(child, stack, cls)
+
+    yield from rec(tree, [], None)
+
+
+def enclosing_symbol(tree: ast.Module, line: int) -> str:
+    """Qualname of the innermost function containing ``line``."""
+    best = ""
+    best_span = None
+    for fn, qual, _cls in walk_functions(tree):
+        end = getattr(fn, "end_lineno", fn.lineno)
+        if fn.lineno <= line <= end:
+            span = end - fn.lineno
+            if best_span is None or span <= best_span:
+                best, best_span = qual, span
+    return best
+
+
+# --- baseline -------------------------------------------------------------
+
+def load_baseline(path: str) -> list[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {path}: expected a JSON list")
+    return data
+
+
+def _baseline_matches(entry: dict, f: Finding) -> bool:
+    if entry.get("rule") != f.rule:
+        return False
+    ef = entry.get("file", "")
+    norm = f.path.replace(os.sep, "/")
+    if ef and not norm.endswith(ef):
+        return False
+    sym = entry.get("symbol")
+    if sym is not None and sym != f.symbol:
+        return False
+    return True
+
+
+# --- driver ---------------------------------------------------------------
+
+def _collect_py(paths) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        out.append(os.path.join(root, n))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def all_rules():
+    from . import rules_jit, rules_locks, rules_sockets, rules_wire
+
+    return [
+        rules_locks.check_r1,
+        rules_locks.check_r2,
+        rules_sockets.check_r3,
+        rules_jit.check_r4,
+        rules_wire.check_r5,
+        rules_sockets.check_r6,
+    ]
+
+
+def analyze_paths(
+    paths,
+    rules=None,
+    baseline: list[dict] | None = None,
+) -> list[Finding]:
+    """Run the rule set; returns ALL findings (suppressed/baselined ones
+    flagged, not dropped) sorted by (path, line, rule)."""
+    files: dict[str, SourceFile] = {}
+    findings: list[Finding] = []
+    for path in _collect_py(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError as e:
+            findings.append(Finding("R0", path, 0, 0, f"unreadable: {e}"))
+            continue
+        sf = SourceFile(path, text)
+        if sf.parse_error is not None:
+            findings.append(
+                Finding("R0", path, 0, 0, f"parse error: {sf.parse_error}")
+            )
+            continue
+        files[path] = sf
+        for line, msg in sf.bad_pragmas:
+            findings.append(Finding("R0", path, line, 0, msg))
+
+    for rule in (rules if rules is not None else all_rules()):
+        findings.extend(rule(files))
+
+    for f in findings:
+        sf = files.get(f.path)
+        if sf is None:
+            continue
+        if not f.symbol and sf.tree is not None:
+            f.symbol = enclosing_symbol(sf.tree, f.line)
+        if f.rule == "R0":
+            continue  # pragma hygiene findings are unsuppressable
+        just = sf.suppression(f.line, f.rule)
+        if just is not None:
+            f.suppressed = True
+            f.justification = just
+        if baseline:
+            for entry in baseline:
+                if _baseline_matches(entry, f):
+                    f.baselined = True
+                    break
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def split_findings(findings):
+    """(active, suppressed) — active findings fail the build."""
+    active = [f for f in findings if not f.suppressed and not f.baselined]
+    muted = [f for f in findings if f.suppressed or f.baselined]
+    return active, muted
+
+
+def findings_to_json(findings) -> dict:
+    active, muted = split_findings(findings)
+    counts: dict[str, int] = {}
+    for f in active:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "findings": [f.to_dict() for f in active],
+        "suppressed": [f.to_dict() for f in muted],
+        "counts": counts,
+        "total": len(active),
+    }

@@ -1,0 +1,195 @@
+"""R4 — JIT purity.
+
+Functions reached from ``jax.jit`` / ``jax.vmap`` / ``jax.lax.scan``
+call sites execute as traced computations: they run ONCE at trace time
+and never again, so any side effect — mutating ``self``, taking a
+lock, doing I/O, reading the wall clock — silently bakes the
+trace-time value into the compiled executable.  PR 2's fault-injection
+caveat is the operational proof: Python-level wrappers only fire on
+eager calls; the jitted vec path never re-enters Python.  A lock taken
+inside a jitted function is worse than useless (it guards one trace,
+then lies), and wall-clock reads make verdicts non-bit-identical
+across replicas — breaking the paper's determinism north star.
+
+Reachability is same-module: decorated functions (``@jax.jit``,
+``@partial(jax.jit, ...)``), functions passed to jit/vmap/pmap or
+``lax.scan``/``while_loop``/``fori_loop``/``cond``/``switch`` call
+sites, plus everything they call by simple name or ``self.method``
+within the module.  Cross-module reachability is out of scope (the
+callee module is linted under its own call sites).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (
+    Finding,
+    call_func_name,
+    is_lock_like_expr,
+    local_assignments,
+    unparse,
+    walk_functions,
+)
+
+_JIT_WRAPPERS = {"jit", "vmap", "pmap"}
+_LAX_COMBINATORS = {
+    "scan", "while_loop", "fori_loop", "cond", "switch",
+    "associative_scan",
+}
+_IO_CALLS = {
+    "open", "print", "recv", "recv_into", "recvfrom", "accept",
+    "connect", "sendall", "send_msg", "unlink", "makedirs", "remove",
+}
+_CLOCK_ATTRS = {
+    "time", "monotonic", "perf_counter", "time_ns",
+    "perf_counter_ns", "monotonic_ns", "now",
+}
+_CLOCK_MODULES = {"time", "datetime", "datetime.datetime"}
+
+
+def _decorated_jit(fn) -> bool:
+    return any("jit" in unparse(d) or "vmap" in unparse(d)
+               for d in fn.decorator_list)
+
+
+def _module_functions(tree):
+    """name -> [function nodes]; methods are also indexed by bare name
+    so ``self.step`` resolves (approximately) across the module."""
+    table: dict[str, list] = {}
+    for fn, _qual, _cls in walk_functions(tree):
+        table.setdefault(fn.name, []).append(fn)
+    return table
+
+
+def _jit_roots(tree, table):
+    roots = []
+    lambdas = []
+    for fn, _qual, _cls in walk_functions(tree):
+        if _decorated_jit(fn):
+            roots.append(fn)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_func_name(node)
+        fargs = []
+        if name in _JIT_WRAPPERS and node.args:
+            fargs = [node.args[0]]
+        elif name in _LAX_COMBINATORS and "lax" in unparse(node.func):
+            fargs = list(node.args)
+        for a in fargs:
+            if isinstance(a, ast.Lambda):
+                lambdas.append(a)
+            else:
+                tname = (a.attr if isinstance(a, ast.Attribute)
+                         else a.id if isinstance(a, ast.Name) else "")
+                roots.extend(table.get(tname, ()))
+    return roots, lambdas
+
+
+def _called_names(fn):
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif (isinstance(f, ast.Attribute)
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id == "self"):
+                out.add(f.attr)
+    return out
+
+
+def _impurities(sf, fn, qual):
+    aliases = local_assignments(fn) if not isinstance(fn, ast.Lambda) \
+        else {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    yield Finding(
+                        "R4", sf.path, node.lineno, node.col_offset,
+                        f"jit-reached function mutates self."
+                        f"{t.attr}: traced once, the mutation happens "
+                        f"at trace time only and the compiled "
+                        f"executable silently reuses the stale value",
+                        symbol=qual,
+                    )
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if is_lock_like_expr(item.context_expr, aliases):
+                    yield Finding(
+                        "R4", sf.path, node.lineno, node.col_offset,
+                        "jit-reached function takes a lock: it guards "
+                        "one trace and then lies — the compiled "
+                        "executable never re-enters Python",
+                        symbol=qual,
+                    )
+        elif isinstance(node, ast.Call):
+            name = call_func_name(node)
+            if (name == "acquire"
+                    and isinstance(node.func, ast.Attribute)
+                    and is_lock_like_expr(node.func.value, aliases)):
+                yield Finding(
+                    "R4", sf.path, node.lineno, node.col_offset,
+                    "jit-reached function takes a lock: it guards one "
+                    "trace and then lies — the compiled executable "
+                    "never re-enters Python",
+                    symbol=qual,
+                )
+            elif name in _IO_CALLS:
+                yield Finding(
+                    "R4", sf.path, node.lineno, node.col_offset,
+                    f"jit-reached function performs I/O ({name}): "
+                    f"runs at trace time only, never per verdict",
+                    symbol=qual,
+                )
+            elif (name in _CLOCK_ATTRS
+                  and isinstance(node.func, ast.Attribute)
+                  and unparse(node.func.value) in _CLOCK_MODULES):
+                yield Finding(
+                    "R4", sf.path, node.lineno, node.col_offset,
+                    f"jit-reached function reads the wall clock "
+                    f"({unparse(node.func)}): the trace-time value is "
+                    f"baked into the executable, and verdicts stop "
+                    f"being bit-identical across replicas",
+                    symbol=qual,
+                )
+
+
+def check_r4(files):
+    for sf in files.values():
+        table = _module_functions(sf.tree)
+        quals = {id(fn): qual
+                 for fn, qual, _cls in walk_functions(sf.tree)}
+        roots, lambdas = _jit_roots(sf.tree, table)
+        seen: set[int] = set()
+        frontier = list(roots)
+        reached = []
+        while frontier:
+            fn = frontier.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            reached.append(fn)
+            for cname in _called_names(fn):
+                frontier.extend(table.get(cname, ()))
+        emitted: set[tuple[int, int, str]] = set()
+        for fn in reached:
+            for f in _impurities(sf, fn, quals.get(id(fn), fn.name)):
+                key = (f.line, f.col, f.message[:40])
+                if key not in emitted:
+                    emitted.add(key)
+                    yield f
+        for lam in lambdas:
+            for f in _impurities(sf, lam, "<lambda>"):
+                key = (f.line, f.col, f.message[:40])
+                if key not in emitted:
+                    emitted.add(key)
+                    yield f

@@ -1,0 +1,327 @@
+"""R1 (lock discipline) and R2 (blocking-under-lock).
+
+R1 encodes the lock invariants PRs 1-2 paid for in review time:
+
+- **R1.1 unpaired acquire** — a blocking ``X.acquire()`` statement must
+  have a ``try/finally`` releasing the *same binding* ``X`` in the same
+  function.  Try-locks (``blocking=False`` / ``timeout=``) are exempt,
+  as are lock-wrapper classes (a class defining ``release`` IS the
+  pairing, spanning methods by design).
+- **R1.2 re-read-attribute capture** — ``self.X.acquire()`` /
+  ``self.X.release()`` where attribute ``X`` is *swapped at runtime*
+  (assigned outside ``__init__`` anywhere in the tree).  The exact
+  ``_in_process_lock`` deposal bug: the stall watchdog swaps the
+  attribute, so release-by-re-read releases a DIFFERENT lock object,
+  raising out of the hot path while leaking the held lock.  The fix
+  captures the object in a local before acquire (``with self.X:`` is
+  safe — the expression is evaluated once).
+- **R1.3 lock-order inversion** — lexically nested ``with`` statements
+  must not invert the recorded lock-order graph.  Seeded from the
+  sidecar session machinery: ``_wlock`` may be held when taking
+  ``_down_once`` (client.py _resume), NEVER the reverse — _down_once
+  holders run in disconnect callbacks that must not wait behind a
+  sendall wedged under ``_wlock``.  Same-lock nesting of a
+  non-reentrant lock is self-deadlock and also flagged.
+
+R2 flags blocking calls — socket ops, ``queue.get``, ``Thread.join``,
+``sleep``, device readbacks — lexically inside a held-lock ``with``
+region.  ``.wait()`` is exempt everywhere: Condition.wait RELEASES the
+lock, and flagging it would outlaw the dispatcher's core idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    Finding,
+    call_func_name,
+    is_lock_like_expr,
+    is_lock_like_name,
+    local_assignments,
+    lock_terminal,
+    unparse,
+    walk_functions,
+)
+
+# Recorded lock-order graph: (outer, inner) pairs that are LEGAL; taking
+# `outer` while already holding `inner` is an inversion.  Seeded from
+# sidecar/client.py (_resume nests _down_once inside _wlock; _down_once
+# holders never take _wlock).
+LOCK_ORDER: set[tuple[str, str]] = {
+    ("_wlock", "_down_once"),
+}
+
+# Functions that ARE lock implementations or guards: the
+# acquire/release pairing intentionally spans call boundaries there.
+_WRAPPER_FUNCS = {
+    "acquire", "release", "r_acquire", "r_release",
+    "__enter__", "__exit__", "locked", "read",
+}
+
+
+def _class_defines_release(cls: ast.ClassDef | None) -> bool:
+    if cls is None:
+        return False
+    return any(
+        isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name in ("release", "r_release")
+        for n in cls.body
+    )
+
+
+def _own_nodes(root: ast.AST):
+    """``ast.walk`` limited to the function's OWN body: nested
+    defs/lambdas are separate functions (walk_functions yields them on
+    their own), so a finally-release tucked inside a closure must not
+    satisfy the enclosing function's acquire pairing — the closure may
+    never run on the exception path."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_try_lock(call: ast.Call) -> bool:
+    if any(kw.arg in ("blocking", "timeout") for kw in call.keywords):
+        return True
+    return bool(call.args)  # acquire(<blocking/timeout expr>)
+
+
+def _swappable_lock_attrs(files) -> set[str]:
+    """Lock-like attribute names assigned ANYWHERE outside __init__ —
+    the attributes a concurrent swap can re-point between an acquire
+    and a re-read release."""
+    out: set[str] = set()
+    for sf in files.values():
+        for fn, _qual, _cls in walk_functions(sf.tree):
+            if fn.name == "__init__":
+                continue
+            for node in ast.walk(fn):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and is_lock_like_name(t.attr)):
+                        out.add(t.attr)
+    return out
+
+
+def _reentrant_names(files) -> set[str]:
+    """Attribute/local names bound to threading.RLock() anywhere —
+    exempt from the same-lock-nesting check."""
+    out: set[str] = set()
+    for sf in files.values():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ) and call_func_name(node.value) == "RLock":
+                for t in node.targets:
+                    name = (t.attr if isinstance(t, ast.Attribute)
+                            else t.id if isinstance(t, ast.Name) else "")
+                    if name:
+                        out.add(name)
+    return out
+
+
+def check_r1(files):
+    swappable = _swappable_lock_attrs(files)
+    reentrant = _reentrant_names(files)
+    for sf in files.values():
+        for fn, qual, cls in walk_functions(sf.tree):
+            if fn.name in _WRAPPER_FUNCS or _class_defines_release(cls):
+                continue
+            aliases = local_assignments(fn)
+            yield from _r1_acquire_pairing(sf, fn, qual, aliases,
+                                           swappable)
+            yield from _r1_with_order(sf, fn, qual, aliases, reentrant)
+
+
+def _r1_acquire_pairing(sf, fn, qual, aliases, swappable):
+    finally_released: set[str] = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for sub in _own_nodes(stmt):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "release"):
+                        finally_released.add(unparse(sub.func.value))
+
+    for node in _own_nodes(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        recv = node.func.value
+        if node.func.attr == "acquire":
+            if not is_lock_like_expr(recv, aliases):
+                continue
+            if (isinstance(recv, ast.Attribute)
+                    and recv.attr in swappable):
+                yield Finding(
+                    "R1", sf.path, node.lineno, node.col_offset,
+                    f"acquire on swappable lock attribute "
+                    f"{recv.attr!r} (assigned outside __init__): "
+                    f"capture the lock object in a local first, or a "
+                    f"concurrent swap makes the paired release free a "
+                    f"DIFFERENT lock (the _in_process_lock deposal "
+                    f"bug)",
+                    symbol=qual,
+                )
+            if _is_try_lock(node):
+                continue
+            if unparse(recv) not in finally_released:
+                yield Finding(
+                    "R1", sf.path, node.lineno, node.col_offset,
+                    f"blocking {unparse(recv)}.acquire() without a "
+                    f"try/finally release of the same binding in this "
+                    f"function — an exception between acquire and "
+                    f"release leaks the lock",
+                    symbol=qual,
+                )
+        elif node.func.attr == "release":
+            if (isinstance(recv, ast.Attribute)
+                    and recv.attr in swappable):
+                yield Finding(
+                    "R1", sf.path, node.lineno, node.col_offset,
+                    f"release re-reads swappable lock attribute "
+                    f"{recv.attr!r}: if the attribute was swapped "
+                    f"while held (stall-watchdog deposal), this "
+                    f"releases a different lock and raises with the "
+                    f"real lock still held — release the binding "
+                    f"captured at acquire instead",
+                    symbol=qual,
+                )
+
+
+def _r1_with_order(sf, fn, qual, aliases, reentrant):
+    findings: list[Finding] = []
+
+    def handle_with(node: ast.With, held: list[str]) -> None:
+        taken = []
+        for item in node.items:
+            expr = item.context_expr
+            if not is_lock_like_expr(expr, aliases):
+                continue
+            name = lock_terminal(expr, aliases)
+            if name in held and name not in reentrant:
+                findings.append(Finding(
+                    "R1", sf.path, node.lineno, node.col_offset,
+                    f"nested re-acquire of non-reentrant lock "
+                    f"{name!r} — self-deadlock",
+                    symbol=qual,
+                ))
+            for h in held:
+                if (name, h) in LOCK_ORDER:
+                    findings.append(Finding(
+                        "R1", sf.path, node.lineno, node.col_offset,
+                        f"lock-order inversion: taking {name!r} while "
+                        f"holding {h!r} inverts the recorded order "
+                        f"{name!r} outside {h!r} — deadlocks against "
+                        f"the legal nesting",
+                        symbol=qual,
+                    ))
+            taken.append(name)
+        for stmt in node.body:
+            walk(stmt, held + taken)
+
+    def walk(node: ast.AST, held: list[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # analyzed under their own (empty) stack
+        if isinstance(node, ast.With):
+            handle_with(node, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in fn.body:
+        walk(stmt, [])
+    yield from findings
+
+
+# --- R2 -------------------------------------------------------------------
+
+_SOCKET_BLOCKING = {
+    "recv", "recv_into", "recvfrom", "accept", "connect", "connect_ex",
+    "sendall", "create_connection",
+    # The repo's frame-write primitive (wire.send_msg) is a sendall.
+    "send_msg",
+}
+_DEVICE_BLOCKING = {"block_until_ready", "device_put", "device_get"}
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    name = call_func_name(call)
+    if name in _SOCKET_BLOCKING:
+        return f"socket {name}()"
+    if name in _DEVICE_BLOCKING:
+        return f"device {name}()"
+    if name == "sleep":
+        return "sleep()"
+    if isinstance(call.func, ast.Attribute):
+        if name == "join":
+            if isinstance(call.func.value, ast.Constant):
+                return None  # "sep".join(...)
+            if not call.args:
+                return "join()"  # thread/queue join (kwargs-only)
+            return None
+        if name == "get":
+            if not call.args and not call.keywords:
+                return "queue get()"
+            if any(kw.arg in ("timeout", "block")
+                   for kw in call.keywords):
+                return "queue get()"
+            if (call.args
+                    and isinstance(call.args[0], ast.Constant)
+                    and call.args[0].value is True):
+                return "queue get(True)"
+    return None
+
+
+def check_r2(files):
+    for sf in files.values():
+        for fn, qual, cls in walk_functions(sf.tree):
+            if fn.name in _WRAPPER_FUNCS or _class_defines_release(cls):
+                continue
+            aliases = local_assignments(fn)
+            findings: list[Finding] = []
+
+            def walk(node, lock_name, findings=findings,
+                     aliases=aliases, sf=sf, qual=qual):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    return
+                if isinstance(node, ast.With):
+                    inner = lock_name
+                    for item in node.items:
+                        if is_lock_like_expr(item.context_expr, aliases):
+                            inner = lock_terminal(item.context_expr,
+                                                  aliases)
+                    for stmt in node.body:
+                        walk(stmt, inner)
+                    return
+                if lock_name is not None and isinstance(node, ast.Call):
+                    reason = _blocking_reason(node)
+                    if reason is not None:
+                        findings.append(Finding(
+                            "R2", sf.path, node.lineno, node.col_offset,
+                            f"blocking {reason} while holding "
+                            f"{lock_name!r} — stalls every thread "
+                            f"contending on the lock for the full "
+                            f"wait",
+                            symbol=qual,
+                        ))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, lock_name)
+
+            for stmt in fn.body:
+                walk(stmt, None)
+            yield from findings

@@ -1,0 +1,140 @@
+"""R5 — wire / verdict exhaustiveness.
+
+Two halves:
+
+- **MSG coverage.**  Every ``MSG_*`` constant defined in a ``wire.py``
+  must be referenced by its sibling ``service.py`` AND ``client.py``
+  (the two ends of the seam).  A constant one side never mentions is a
+  message the other side can emit into a peer that has no branch for
+  it — at best dropped on the floor, at worst desynchronizing the
+  framing.  PR 2's MSG_DATA_BATCH_DL landed correctly only because
+  review checked both ends by hand; this rule makes that permanent.
+- **FilterResult coverage.**  A module that dispatches on specific
+  non-OK FilterResult codes (equality compares) must either cover
+  every member or carry the fail-closed OK-gate default
+  (``res != FilterResult.OK`` / ``== FilterResult.OK``): any code it
+  has no branch for then lands in the non-OK arm, which is deny.  The
+  extension codes (SHED=8, SERVICE_UNAVAILABLE=9) were designed to be
+  safe on old consumers exactly because of this gate — the rule keeps
+  new consumers honest.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Finding, unparse
+
+_FR_TOKEN = re.compile(r"FilterResult\.([A-Z_]+)")
+
+
+def _msg_constants(sf):
+    out = []
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.startswith("MSG_")):
+            out.append((node.targets[0].id, node.lineno))
+    return out
+
+
+def _referenced_msgs(sf) -> set[str]:
+    out = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Attribute) and node.attr.startswith("MSG_"):
+            out.add(node.attr)
+        elif isinstance(node, ast.Name) and node.id.startswith("MSG_"):
+            out.add(node.id)
+    return out
+
+
+def _filter_result_members(files) -> list[str]:
+    for sf in files.values():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "FilterResult":
+                return [
+                    n.targets[0].id
+                    for n in node.body
+                    if isinstance(n, ast.Assign)
+                    and isinstance(n.targets[0], ast.Name)
+                ]
+    try:  # linting a subset: fall back to the canonical enum
+        from ..proxylib.types import FilterResult
+
+        return [m.name for m in FilterResult]
+    except Exception:  # noqa: BLE001 — standalone corpus run
+        return []
+
+
+def check_r5(files):
+    # --- MSG coverage, per directory holding a wire.py ---
+    by_dir: dict[str, dict[str, object]] = {}
+    for path, sf in files.items():
+        base = os.path.basename(path)
+        if base in ("wire.py", "service.py", "client.py"):
+            by_dir.setdefault(os.path.dirname(path), {})[base] = sf
+
+    for dirname, group in sorted(by_dir.items()):
+        wire = group.get("wire.py")
+        if wire is None:
+            continue
+        consts = _msg_constants(wire)
+        if not consts:
+            continue
+        siblings = [
+            (name, group[name])
+            for name in ("service.py", "client.py")
+            if name in group
+        ]
+        for name, sib in siblings:
+            refs = _referenced_msgs(sib)
+            for const, line in consts:
+                if const not in refs:
+                    yield Finding(
+                        "R5", wire.path, line, 0,
+                        f"wire constant {const} has no handler "
+                        f"reference in sibling {name}: one seam end "
+                        f"can emit a message the other has no branch "
+                        f"for",
+                        symbol=const,
+                    )
+
+    # --- FilterResult dispatch coverage, per module ---
+    members = _filter_result_members(files)
+    if not members:
+        return
+    member_set = set(members)
+    for path, sf in files.items():
+        compared: set[str] = set()
+        first: tuple[int, int] | None = None
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not all(
+                isinstance(op, (ast.Eq, ast.NotEq, ast.In, ast.NotIn))
+                for op in node.ops
+            ):
+                continue
+            toks = set(_FR_TOKEN.findall(unparse(node)))
+            got = toks & member_set
+            if got:
+                compared |= got
+                if first is None:
+                    first = (node.lineno, node.col_offset)
+        non_ok = compared - {"OK"}
+        if not non_ok:
+            continue  # produces codes or only uses the OK gate: fine
+        if "OK" in compared or compared >= member_set:
+            continue
+        missing = sorted(member_set - compared)
+        yield Finding(
+            "R5", path, first[0], first[1],
+            f"dispatch over FilterResult codes covers "
+            f"{sorted(compared)} but not {missing} and has no "
+            f"fail-closed OK-gate default (compare against "
+            f"FilterResult.OK so every unknown code lands in the "
+            f"deny arm)",
+        )

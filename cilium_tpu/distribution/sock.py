@@ -21,6 +21,7 @@ import struct
 import threading
 
 from ..utils.logging import get_logger
+from ..utils.sockutil import shutdown_close
 from .server import DistributionServer
 
 log = get_logger("distribution-sock")
@@ -114,10 +115,10 @@ class SocketDistributionServer:
         finally:
             if sub is not None:
                 self.server.unsubscribe(sub)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            # shutdown first: the per-subscriber _send_loop thread may
+            # be inside send_frame on this socket — a bare close would
+            # defer the teardown until its next write.
+            shutdown_close(conn)
 
     def _send_loop(self, conn: socket.socket, sub) -> None:
         try:
@@ -135,8 +136,9 @@ class SocketDistributionServer:
 
     def close(self) -> None:
         self._stop.set()
+        # Wake the acceptor parked on the listener; see R3.
         try:
-            self._sock.close()
+            shutdown_close(self._sock)
         finally:
             if os.path.exists(self.path):
                 os.unlink(self.path)

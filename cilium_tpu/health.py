@@ -19,6 +19,7 @@ import time
 from dataclasses import dataclass, field
 
 from .utils.controller import ControllerManager, ControllerParams
+from .utils.sockutil import shutdown_close
 
 DEFAULT_PROBE_INTERVAL = 10.0  # reference: server.go ProbeInterval 10s
 PROBE_TIMEOUT = 1.0
@@ -47,22 +48,19 @@ class HealthResponder:
                 return
             try:
                 conn.sendall(b"\x01")
-                conn.close()
             except OSError:
                 pass
+            finally:
+                # A connect-and-close prober RSTs before our shutdown;
+                # close must still run or each such probe leaks one fd
+                # until accept() dies with EMFILE.
+                shutdown_close(conn)
 
     def close(self) -> None:
         self._stopped = True
         # shutdown() wakes the blocked accept(); close() alone leaves
         # the listener live (and serving!) until the next connection.
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        shutdown_close(self._sock)
 
 
 @dataclass

@@ -36,6 +36,8 @@ import struct
 import threading
 import time
 
+from ..utils.sockutil import shutdown_close
+
 log = logging.getLogger(__name__)
 
 
@@ -77,14 +79,7 @@ class ChaosProxy:
             # shutdown first: it wakes the accept thread parked in
             # accept(), without which close() defers the fd teardown
             # and the port stays bound — heal()'s rebind would fail.
-            try:
-                listener.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                listener.close()
-            except OSError:
-                pass
+            shutdown_close(listener)
 
     def _ensure_listener(self) -> None:
         with self._mutex:
@@ -145,8 +140,8 @@ class ChaosProxy:
             self._reset_conn(a, b)
 
     @staticmethod
-    def _reset_conn(a: socket.socket, b: socket.socket) -> None:
-        for s in (a, b):
+    def _reset_conn(*socks: socket.socket) -> None:
+        for s in socks:
             try:
                 s.setsockopt(
                     socket.SOL_SOCKET, socket.SO_LINGER,
@@ -159,14 +154,7 @@ class ChaosProxy:
             # bare close() would defer the teardown (and the
             # RST/FIN to the peers) until that recv returns —
             # which it never would.
-            try:
-                s.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                s.close()
-            except OSError:
-                pass
+            shutdown_close(s)
 
     # -- plumbing ----------------------------------------------------------
 
@@ -182,24 +170,16 @@ class ChaosProxy:
                 # Fully partitioned: the network beyond this hop does
                 # not exist — drop the fresh connection on the floor.
                 self.counters["refused"] += 1
-                try:
-                    client.setsockopt(
-                        socket.SOL_SOCKET, socket.SO_LINGER,
-                        struct.pack("ii", 1, 0),
-                    )
-                    client.close()
-                except OSError:
-                    pass
+                # Same linger-0 + shutdown-then-close teardown as
+                # reset_all: RST semantics, and no deferred fd.
+                self._reset_conn(client)
                 continue
             try:
                 server = socket.create_connection(self._target, timeout=5.0)
             except OSError as e:
                 log.debug("chaos: target %s unreachable: %s",
                           self._target, e)
-                try:
-                    client.close()
-                except OSError:
-                    pass
+                shutdown_close(client)
                 continue
             for s in (client, server):
                 try:
@@ -257,11 +237,14 @@ class ChaosProxy:
         except OSError:
             pass
         finally:
+            # shutdown BEFORE close, both legs: when this pump exits
+            # (its src saw EOF/error) the SIBLING pump is still parked
+            # in recv on the other socket — a bare close from this
+            # thread defers that fd's teardown and the sibling (plus
+            # both kernel objects) leaks until process exit if the
+            # remaining peer stays silent.  shutdown wakes it now.
             for s in (src, dst):
-                try:
-                    s.close()
-                except OSError:
-                    pass
+                shutdown_close(s)
 
     def close(self) -> None:
         self._stopped = True

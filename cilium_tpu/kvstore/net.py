@@ -105,6 +105,7 @@ from .backend import (
 )
 from .local import LocalBackend
 from ..utils.backoff import Exponential
+from ..utils.sockutil import shutdown_close
 
 log = logging.getLogger(__name__)
 
@@ -439,14 +440,7 @@ class _Session:
                 self.server.counters.inc("server_lease_revoke_failed")
                 log.warning("lease revoke of %s failed: %s", k, e)
         self.leased.clear()
-        try:
-            self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        shutdown_close(self.sock)
         self.server._drop_session(self)
 
 
@@ -614,14 +608,7 @@ class KvstoreServer:
         # shutdown() first: it wakes the accept loop so the listening
         # fd actually releases (close() alone leaves the thread parked
         # in accept() holding the socket, and the port stays bound).
-        try:
-            self._listener.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        shutdown_close(self._listener)
         with self._mutex:
             sessions = list(self._sessions)
         for s in sessions:
@@ -1097,13 +1084,15 @@ class NetBackend(Backend):
                     delay = boff.duration()
                     if time.monotonic() + delay > deadline:
                         return False
+                    # lint: disable=R2 -- one reconnect per generation serializes the whole walk by design; contenders need this attempt's outcome and would only dial in parallel
                     time.sleep(delay)
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            try:
-                self.sock.close()
-            except OSError:
-                pass
+            # shutdown-then-close: the old generation's reader may be
+            # parked in recv on this socket (a writer detected the
+            # death first) — wake it so it exits instead of holding
+            # the dead fd to process exit.
+            shutdown_close(self.sock)
             with self._mutex:
                 self.sock = sock
                 self._generation += 1
@@ -1526,14 +1515,7 @@ class NetBackend(Backend):
         # shutdown() first: close() alone does not send FIN while the
         # reader thread is blocked in recv on the same fd, so the server
         # would never see the session die (and leases would leak).
-        try:
-            self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        shutdown_close(self.sock)
         self._fail_pending()
 
 

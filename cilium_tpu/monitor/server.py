@@ -24,6 +24,7 @@ import threading
 from typing import Callable, Optional
 
 from ..utils.logging import get_logger
+from ..utils.sockutil import shutdown_close
 from .monitor import Monitor, MonitorEvent
 
 log = get_logger("monitor-server")
@@ -111,10 +112,7 @@ class MonitorServer:
                     self._subs.remove(sub)
                 except ValueError:
                     pass
-            try:
-                sub.conn.close()
-            except OSError:
-                pass
+            shutdown_close(sub.conn)
 
     def subscriber_count(self) -> int:
         with self._mutex:
@@ -122,11 +120,12 @@ class MonitorServer:
 
     def close(self) -> None:
         self._stop.set()
+        # shutdown-then-close: a bare close while an accept thread
+        # holds the fd defers the kernel teardown, and the listener
+        # keeps accepting into a dead server until the thread's next
+        # timeout tick (the PR 2 zombie-service bug class).
         for s in self._socks.values():
-            try:
-                s.close()
-            except OSError:
-                pass
+            shutdown_close(s)
         for p in (self.path, self.path_1_0):
             if os.path.exists(p):
                 os.unlink(p)
@@ -179,7 +178,9 @@ class MonitorClient:
         return buf
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # shutdown first: a consumer thread blocked in next_event's
+        # recv holds the fd, so a bare close would never wake it — the
+        # reader lingered to process exit (the sidecar-client PR 2 bug,
+        # here on the monitor consumer side).  After shutdown the recv
+        # returns b"" and next_event reports end-of-stream with None.
+        shutdown_close(self._sock)

@@ -46,25 +46,10 @@ import numpy as np
 from ..proxylib.types import DROP, ERROR, INJECT, MORE, PASS, FilterResult
 from ..utils import metrics
 from ..utils.backoff import Exponential
+from ..utils.sockutil import shutdown_close as _teardown
 from . import wire
 
 log = logging.getLogger(__name__)
-
-
-def _teardown(sock: socket.socket) -> None:
-    """shutdown-then-close, the one definition: a bare close() neither
-    wakes a reader blocked in recv on the same socket object nor sends
-    the peer a prompt FIN, so every teardown in this module must
-    shutdown first or it leaks a reader thread and a half-open
-    service connection."""
-    try:
-        sock.shutdown(socket.SHUT_RDWR)
-    except OSError:
-        pass
-    try:
-        sock.close()
-    except OSError:
-        pass
 
 
 class SidecarUnavailable(wire.WireError):
@@ -354,6 +339,7 @@ class SidecarClient:
         with self._wlock:
             sock = self.sock
             try:
+                # lint: disable=R2 -- _wlock exists to serialize frame writes; the OSError path runs _teardown so a wedged peer cannot hold the lock past the write timeout
                 wire.send_msg(sock, msg_type, payload)
             except OSError as e:
                 # Tear down only the socket we actually wrote to:

@@ -34,6 +34,7 @@ import numpy as np
 from ..proxylib import instance as pl
 from ..proxylib.types import FilterResult
 from ..utils.option import DaemonConfig
+from ..utils.sockutil import shutdown_close
 from . import wire
 from .client import SidecarClient
 from .service import VerdictService
@@ -144,17 +145,14 @@ class NullVerdictServer:
         except (wire.ConnectionClosed, OSError):
             pass
         finally:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            shutdown_close(sock)
 
     def stop(self) -> None:
         self._stopped = True
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        # shutdown wakes the acceptor so the listener dies NOW — a
+        # bare close deferred the teardown behind the blocked accept
+        # and the port kept accepting into a stopped server (R3).
+        shutdown_close(self._listener)
         try:
             os.unlink(self.socket_path)
         except OSError:

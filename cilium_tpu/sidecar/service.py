@@ -50,6 +50,7 @@ from ..proxylib.types import DROP, ERROR, MORE, PASS, FilterResult, OpError
 from ..runtime.batch import R2d2BatchEngine
 from ..utils import metrics
 from ..utils.option import DaemonConfig
+from ..utils.sockutil import shutdown_close
 from . import wire
 from .dispatch import BatchDispatcher
 from .guard import DeviceGuard
@@ -308,14 +309,7 @@ class VerdictService:
         # dispatcher is dead) instead of failing over to the restarted
         # one.  Unlink the path immediately for the same reason.
         if self._listener is not None:
-            try:
-                self._listener.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                self._listener.close()
-            except OSError:
-                pass
+            shutdown_close(self._listener)
         try:
             os.unlink(self.socket_path)
         except OSError:
@@ -326,14 +320,7 @@ class VerdictService:
         with self._lock:
             clients = list(self._clients)
         for client in clients:
-            try:
-                client.sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                client.sock.close()
-            except OSError:
-                pass
+            shutdown_close(client.sock)
         self.dispatcher.stop()
         if self._completion_thread is not None:
             self._completion_put(("stop",))
@@ -357,10 +344,7 @@ class VerdictService:
             if self._stopped:
                 # Raced stop(): never hand a connection to a dead
                 # service — the peer must see EOF and fail over.
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+                shutdown_close(sock)
                 return
             client = _ClientHandler(self, sock)
             with self._lock:
@@ -2655,6 +2639,7 @@ class _ClientHandler:
                 for b in batches:
                     b.answered = True
             try:
+                # lint: disable=R2 -- _wlock IS the sendall serializer (the answered-flag dance requires it); a wedged write trips the stall watchdog and _kill breaks the socket
                 wire.send_msg(self.sock, msg_type, payload)
             except OSError:
                 self._kill()
@@ -2685,6 +2670,7 @@ class _ClientHandler:
                 for p in payloads
             )
             try:
+                # lint: disable=R2 -- same contract as send(): _wlock serializes the one-sendall round write; watchdog+_kill bound a wedge
                 self.sock.sendall(buf)
             except OSError:
                 self._kill()
@@ -2799,10 +2785,10 @@ class _ClientHandler:
         except OSError:
             pass
         finally:
-            try:
-                self.sock.close()
-            except OSError:
-                pass
+            # The reader owns the close (see _kill); shutdown first so
+            # a send-loop thread mid-sendall on this socket fails fast
+            # instead of deferring the fd teardown.
+            shutdown_close(self.sock)
             # Prune this handler so reconnecting shims don't accumulate
             # dead entries for the service's lifetime.
             with self.service._lock:

@@ -1,0 +1,32 @@
+"""Socket teardown helper — THE one definition of shutdown-then-close.
+
+A bare ``close()`` on a socket another thread is blocked on
+(``accept()``/``recv()``) does not tear the kernel object down: the
+close is deferred until that call returns, which only the teardown
+would have made happen.  PR 2 fixed this by hand in the verdict
+service (zombie listener kept accepting into a dead service) and the
+sidecar client (reader parked in recv to process exit); cilium-lint
+rule R3 now flags the pattern tree-wide and this helper is the fix it
+points at: ``shutdown(SHUT_RDWR)`` first — which wakes any blocked
+peer and accept/recv callers — then ``close()``.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def shutdown_close(sock: socket.socket | None) -> None:
+    """Shutdown (waking any thread blocked on the socket) then close.
+    Both steps swallow OSError: teardown must be callable from any
+    state — never-connected, already shut down, already closed."""
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass

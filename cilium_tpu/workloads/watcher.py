@@ -161,11 +161,17 @@ class WorkloadWatcher:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._mutex:
-                if all(q.empty() for q in self._queues.values()):
-                    time.sleep(0.02)  # let in-flight handlers finish
+                empty = all(q.empty() for q in self._queues.values())
+            if empty:
+                # Settle OUTSIDE the mutex: in-flight handlers need it
+                # to drain, so sleeping while holding it stalled the
+                # very completion this poll is waiting for (lint R2).
+                time.sleep(0.02)
+                with self._mutex:
                     if all(q.empty() for q in self._queues.values()):
                         return
-            time.sleep(0.01)
+            else:
+                time.sleep(0.01)
 
     def close(self) -> None:
         if self._own_controllers:

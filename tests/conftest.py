@@ -38,9 +38,67 @@ def pytest_configure(config):
 # dispatcher worker or module-spawned non-daemon thread fails that module
 # with the thread list in the message.
 
+import socket as _socket
 import threading
+import weakref
 
 import pytest
+
+# --- listening-socket leak guard (complements lint rules R3/R6) ------------
+#
+# A server that a test never close()s keeps its LISTENING socket alive for
+# the rest of the run: the port/path keeps accepting into a dead object
+# (the exact zombie-listener shape rule R3 flags in production code).
+# Track every socket that listen()s; at module teardown any socket that
+# started listening during the module and is still open fails the module,
+# named by address.
+
+_listening: "weakref.WeakSet[_socket.socket]" = weakref.WeakSet()
+_orig_listen = _socket.socket.listen
+
+
+def _tracking_listen(self, *args):
+    _listening.add(self)
+    return _orig_listen(self, *args)
+
+
+_socket.socket.listen = _tracking_listen
+
+
+def _open_listeners():
+    out = []
+    for s in list(_listening):
+        try:
+            if s.fileno() != -1:
+                out.append(s)
+        except OSError:
+            pass
+    return out
+
+
+def _describe_sock(s):
+    try:
+        return repr(s.getsockname())
+    except OSError:
+        return "<unknown addr>"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_leaked_listening_sockets():
+    baseline = set(_open_listeners())
+    yield
+    import time as _time
+
+    deadline = _time.monotonic() + 2.0
+    leaked = [s for s in _open_listeners() if s not in baseline]
+    while leaked and _time.monotonic() < deadline:
+        _time.sleep(0.05)  # teardown threads may still be closing
+        leaked = [s for s in _open_listeners() if s not in baseline]
+    assert not leaked, (
+        "leaked LISTENING socket(s) survived the module (a server was "
+        "not close()d — the zombie-listener shape lint rule R3 flags): "
+        f"{[_describe_sock(s) for s in leaked]}"
+    )
 
 
 @pytest.fixture(scope="module", autouse=True)

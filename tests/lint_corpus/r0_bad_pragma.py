@@ -1,0 +1,5 @@
+"""KNOWN-BAD corpus: a suppression pragma with no justification is
+itself a finding (R0) and cannot be suppressed — every accepted
+violation in the tree must carry its one-line why."""
+
+X = 1  # lint: disable=R2  # EXPECT[R0]

@@ -1,0 +1,17 @@
+"""KNOWN-BAD corpus: pragma text inside a STRING is not a pragma.
+
+A well-formed pragma in a string literal must not suppress the real
+finding on its line, and a malformed one in a docstring — like this:
+# lint: disable=R2
+— must not trip R0 either.  Only real COMMENT tokens count.
+"""
+
+import threading
+import time
+
+_mu = threading.Lock()
+
+
+def hold():
+    with _mu:
+        time.sleep("# lint: disable=R2 -- not a comment")  # EXPECT[R2]

@@ -1,0 +1,13 @@
+"""KNOWN-GOOD corpus: a justified pragma suppresses its rule on its
+line (here via the comment-line form governing the next line)."""
+
+import threading
+import time
+
+_mu = threading.Lock()
+
+
+def settle():
+    with _mu:
+        # lint: disable=R2 -- corpus demo: the settle sleep under the lock is the documented contract here
+        time.sleep(0.01)

@@ -1,0 +1,25 @@
+"""KNOWN-BAD corpus: lock-order inversion + same-lock re-entry.
+
+The recorded order (seeded from sidecar/client.py) is ``_wlock``
+OUTSIDE ``_down_once``: _resume nests the disconnect latch inside the
+write lock, and _down_once holders must never wait behind a sendall
+wedged under _wlock.  Taking them in the other order deadlocks against
+the legal nesting."""
+
+import threading
+
+
+class Session:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self._down_once = threading.Lock()
+
+    def on_disconnect_inverted(self):
+        with self._down_once:
+            with self._wlock:  # EXPECT[R1]
+                pass
+
+    def double_acquire(self):
+        with self._wlock:
+            with self._wlock:  # EXPECT[R1]
+                pass

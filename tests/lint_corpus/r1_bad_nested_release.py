@@ -1,0 +1,19 @@
+"""KNOWN-BAD corpus: a finally-release inside a NESTED function must
+not satisfy the outer function's acquire pairing — the closure may
+never run on the exception path, leaking the held lock."""
+
+import threading
+
+_mu = threading.Lock()
+
+
+def outer():
+    _mu.acquire()  # EXPECT[R1]
+
+    def helper():
+        try:
+            pass
+        finally:
+            _mu.release()
+
+    helper()

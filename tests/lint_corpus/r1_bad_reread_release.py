@@ -1,0 +1,27 @@
+"""KNOWN-BAD corpus: the PR 2 ``_in_process_lock`` deposal bug.
+
+The stall watchdog swaps the attribute for a fresh lock at deposal, so
+acquire-by-attribute + release-by-re-read releases a DIFFERENT object:
+RuntimeError out of the hot path, the real lock leaked held, the
+deposed worker permanently wedged.  (Fixed by hand in PR 2 review
+item 1; mechanized as rule R1.)
+"""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._in_process_lock = threading.Lock()
+
+    def _watch(self):
+        # Deposal swaps the attribute — this is what makes the re-read
+        # below a different object.
+        self._in_process_lock = threading.Lock()
+
+    def submit(self, batch):
+        self._in_process_lock.acquire()  # EXPECT[R1]
+        try:
+            return len(batch)
+        finally:
+            self._in_process_lock.release()  # EXPECT[R1]

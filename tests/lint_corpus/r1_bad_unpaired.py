@@ -1,0 +1,12 @@
+"""KNOWN-BAD corpus: blocking acquire with no try/finally release —
+an exception between the acquire and the release leaks the lock."""
+
+import threading
+
+_mu = threading.Lock()
+
+
+def update(counters):
+    _mu.acquire()  # EXPECT[R1]
+    counters["n"] += 1  # a KeyError here leaks _mu held forever
+    _mu.release()

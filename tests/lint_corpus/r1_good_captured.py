@@ -1,0 +1,20 @@
+"""KNOWN-GOOD corpus: the deposal-safe capture pattern for R1.
+
+The lock object is captured in a local before use; ``with`` evaluates
+the expression once, so even a concurrent attribute swap releases the
+object that was acquired."""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._in_process_lock = threading.Lock()
+
+    def _watch(self):
+        self._in_process_lock = threading.Lock()
+
+    def submit(self, batch):
+        lock = self._in_process_lock  # capture: deposal swaps the attr
+        with lock:
+            return len(batch)

@@ -1,0 +1,21 @@
+"""KNOWN-GOOD corpus: the legal lock nesting (the _resume shape) and
+re-entry on an RLock."""
+
+import threading
+
+
+class Session:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self._down_once = threading.Lock()
+        self.mutex = threading.RLock()
+
+    def resume(self):
+        with self._wlock:
+            with self._down_once:
+                pass
+
+    def reentrant_status(self):
+        with self.mutex:
+            with self.mutex:  # RLock: re-entry is the feature
+                pass

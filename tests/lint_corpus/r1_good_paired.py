@@ -1,0 +1,24 @@
+"""KNOWN-GOOD corpus: acquire paired with a finally release of the
+same binding; try-locks with consumed results are also fine."""
+
+import threading
+
+_mu = threading.Lock()
+
+
+def update(counters):
+    _mu.acquire()
+    try:
+        counters["n"] += 1
+    finally:
+        _mu.release()
+
+
+def try_update(counters):
+    if not _mu.acquire(timeout=0.1):
+        return False
+    try:
+        counters["n"] += 1
+    finally:
+        _mu.release()
+    return True

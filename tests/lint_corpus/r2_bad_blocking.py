@@ -1,0 +1,23 @@
+"""KNOWN-BAD corpus: blocking calls inside held-lock regions — every
+other thread contending on the lock stalls for the full wait."""
+
+import socket
+import threading
+import time
+
+
+class Pump:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+
+    def push(self, frame):
+        with self._mutex:
+            self._sock.sendall(frame)  # EXPECT[R2]
+            time.sleep(0.1)  # EXPECT[R2]
+
+    def drain(self, q, worker):
+        with self._mutex:
+            item = q.get(timeout=0.2)  # EXPECT[R2]
+            worker.join()  # EXPECT[R2]
+            return item

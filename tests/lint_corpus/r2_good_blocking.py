@@ -1,0 +1,30 @@
+"""KNOWN-GOOD corpus for R2: blocking work happens OUTSIDE the lock;
+Condition.wait under its own lock is the sanctioned idiom (wait
+releases the lock), and dict .get / str .join are not blocking."""
+
+import socket
+import threading
+import time
+
+
+class Pump:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._cond = threading.Condition()
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._ready = False
+
+    def push(self, frame):
+        with self._mutex:
+            buf = bytes(frame)
+        self._sock.sendall(buf)
+        time.sleep(0.01)
+
+    def wait_ready(self):
+        with self._cond:
+            while not self._ready:
+                self._cond.wait(0.1)  # releases the lock while parked
+
+    def labels(self, d):
+        with self._mutex:
+            return ", ".join(d.get("names", []))

@@ -1,0 +1,19 @@
+"""KNOWN-BAD corpus: PR 2's VerdictService.stop() zombie-listener bug.
+
+stop() closed the listener with the acceptor thread still blocked in
+accept() holding the fd: the kernel teardown was DEFERRED, the socket
+kept accepting, and reconnecting shims attached to a zombie service
+whose dispatcher was already dead — a silent hang.  shutdown() first
+wakes the acceptor and makes the teardown happen now."""
+
+import socket
+
+
+class Service:
+    def __init__(self, path):
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(16)
+
+    def stop(self):
+        self._listener.close()  # EXPECT[R3]

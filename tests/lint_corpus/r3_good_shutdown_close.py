@@ -1,0 +1,32 @@
+"""KNOWN-GOOD corpus for R3: shutdown dominates the close — directly,
+or via a teardown helper taking the socket."""
+
+import socket
+
+
+def _teardown(sock: socket.socket) -> None:
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class Service:
+    def __init__(self, path):
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(16)
+
+    def stop(self):
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._listener.close()
+
+    def stop_via_helper(self):
+        _teardown(self._listener)

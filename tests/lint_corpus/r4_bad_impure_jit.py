@@ -1,0 +1,31 @@
+"""KNOWN-BAD corpus: impurity in jit-reached functions.  Traced code
+runs ONCE; mutations, locks, I/O and wall-clock reads bake the
+trace-time behavior into the executable (and wall-clock reads break
+bit-identical verdicts across replicas)."""
+
+import threading
+import time
+
+import jax
+
+
+class Engine:
+    def __init__(self):
+        self.calls = 0
+        self._mutex = threading.Lock()
+
+    def _step(self, x):
+        self.calls += 1  # EXPECT[R4]
+        return x * 2
+
+    def _guarded(self, x):
+        with self._mutex:  # EXPECT[R4]
+            return x + 1
+
+    def compile(self):
+        return jax.jit(self._step), jax.jit(self._guarded)
+
+
+@jax.jit
+def stamp(x):
+    return x + time.time()  # EXPECT[R4]

@@ -1,0 +1,23 @@
+"""KNOWN-GOOD corpus for R4: pure jit-reached functions (including a
+helper reached through the same-module call graph), and impure code
+that is NOT jit-reached."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _helper(x):
+    return jnp.tanh(x)
+
+
+@jax.jit
+def forward(x):
+    return _helper(x) * 2
+
+
+def eager_logger(x):
+    # Impure, but never reached from a jit call site: fine.
+    print("observed", time.time())
+    return x

@@ -1,0 +1,12 @@
+"""Corpus sibling: the client end is MISSING the MSG_QUIESCE branch —
+a quiesce frame from the service lands in no handler."""
+
+from . import wire
+
+
+def handle(msg_type, payload):
+    if msg_type == wire.MSG_OPEN:
+        return "open"
+    if msg_type == wire.MSG_DATA:
+        return "data"
+    return None

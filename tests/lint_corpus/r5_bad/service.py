@@ -1,0 +1,13 @@
+"""Corpus sibling: the service end handles every message."""
+
+from . import wire
+
+
+def handle(msg_type, payload):
+    if msg_type == wire.MSG_OPEN:
+        return "open"
+    if msg_type == wire.MSG_DATA:
+        return "data"
+    if msg_type == wire.MSG_QUIESCE:
+        return "quiesce"
+    return None

@@ -1,0 +1,7 @@
+"""KNOWN-BAD corpus (R5, with siblings): MSG_QUIESCE is referenced by
+service.py but has NO handler in client.py — the service can emit a
+message the client has no branch for."""
+
+MSG_OPEN = 1
+MSG_DATA = 2
+MSG_QUIESCE = 3  # EXPECT[R5]

@@ -1,0 +1,15 @@
+"""KNOWN-BAD corpus: a FilterResult dispatch that enumerates two codes
+and FORWARDS everything else — fail-open.  A new code (SHED=8 was
+added in PR 2) silently becomes an allow on this consumer.  The fix is
+the OK-gate default: compare against FilterResult.OK so every unknown
+code lands in the deny arm."""
+
+from cilium_tpu.proxylib.types import FilterResult
+
+
+def apply(res):
+    if res == FilterResult.POLICY_DROP:  # EXPECT[R5]
+        return "drop"
+    if res == FilterResult.PARSER_ERROR:
+        return "drop"
+    return "forward"  # unknown codes fall through OPEN

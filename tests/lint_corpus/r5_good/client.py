@@ -1,0 +1,9 @@
+from . import wire
+
+
+def handle(msg_type, payload):
+    if msg_type == wire.MSG_OPEN:
+        return "open"
+    if msg_type == wire.MSG_DATA:
+        return "data"
+    return None

@@ -1,0 +1,5 @@
+"""KNOWN-GOOD corpus (R5, with siblings): every constant has a handler
+reference on both seam ends."""
+
+MSG_OPEN = 1
+MSG_DATA = 2

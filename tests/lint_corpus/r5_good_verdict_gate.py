@@ -1,0 +1,12 @@
+"""KNOWN-GOOD corpus: the fail-closed OK-gate — every code that is not
+exactly OK lands in the deny arm, so codes added later (SHED,
+SERVICE_UNAVAILABLE) are fail-closed on this consumer by
+construction."""
+
+from cilium_tpu.proxylib.types import FilterResult
+
+
+def apply(res):
+    if res != FilterResult.OK:
+        return "deny"
+    return "forward"

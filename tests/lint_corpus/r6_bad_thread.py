@@ -1,0 +1,11 @@
+"""KNOWN-BAD corpus: Thread without daemon= and without a local join —
+it outlives its spawner silently and the conftest leak guard fails the
+whole module instead of this site."""
+
+import threading
+
+
+def spawn(fn):
+    t = threading.Thread(target=fn)  # EXPECT[R6]
+    t.start()
+    return t

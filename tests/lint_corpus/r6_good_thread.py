@@ -1,0 +1,16 @@
+"""KNOWN-GOOD corpus for R6: daemonized (and named) threads, or
+short-lived workers joined where they are spawned."""
+
+import threading
+
+
+def spawn(fn):
+    t = threading.Thread(target=fn, daemon=True, name="corpus-worker")
+    t.start()
+    return t
+
+
+def run_briefly(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=5.0)

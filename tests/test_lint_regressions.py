@@ -1,0 +1,176 @@
+"""Regression tests for the production fixes cilium-lint's triage
+landed (PR 3) — each reproduces the failure mode the bare pattern
+caused, so a revert fails here and not in a soak:
+
+- R3 @ monitor/server.py: MonitorClient.close() must WAKE a consumer
+  thread blocked in next_event's recv (bare close left it parked to
+  process exit — the sidecar-client PR 2 bug on the consumer side).
+- R3 @ kvstore/chaos.py: a pump exiting on one leg's EOF must wake the
+  SIBLING pump parked in recv on the other leg (bare close leaked the
+  thread + both kernel objects while the surviving peer stayed
+  silent).
+- R2/R3 @ accesslog/server.py: AccessLogClient.log() against a wedged
+  collector (bound, never reading) must fail False within its bounded
+  timeout instead of hanging the datapath caller in sendall under the
+  client mutex forever.
+- R3 @ monitor/accesslog close(): shutdown-then-close lets a server be
+  closed and immediately re-created on the same path, acceptors gone.
+"""
+
+import socket
+import threading
+import time
+
+from cilium_tpu.accesslog.record import LogRecord
+from cilium_tpu.accesslog.server import AccessLogClient, AccessLogServer
+from cilium_tpu.kvstore.chaos import ChaosProxy
+from cilium_tpu.monitor.monitor import Monitor, MonitorEvent
+from cilium_tpu.monitor.server import MonitorClient, MonitorServer
+
+
+def test_monitor_client_close_wakes_blocked_reader(tmp_path):
+    path = str(tmp_path / "monitor.sock")
+    mon = Monitor()
+    srv = MonitorServer(mon, path)
+    try:
+        cli = MonitorClient(path)
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(cli.next_event(timeout=None)),
+            daemon=True, name="monitor-consumer",
+        )
+        t.start()
+        time.sleep(0.3)  # let the reader park in recv
+        assert t.is_alive()
+        cli.close()  # bare close never woke the parked recv
+        t.join(timeout=2.0)
+        assert not t.is_alive(), (
+            "close() did not wake the blocked next_event reader"
+        )
+        assert got == [None]  # clean end-of-stream, not an exception
+    finally:
+        srv.close()
+
+
+def test_monitor_server_survives_same_path_restart(tmp_path):
+    path = str(tmp_path / "monitor.sock")
+    mon = Monitor()
+    srv = MonitorServer(mon, path)
+    acceptors = [
+        t for t in threading.enumerate()
+        if t.name.startswith("monitor-server-")
+    ]
+    assert acceptors
+    srv.close()
+    for t in acceptors:
+        t.join(timeout=2.0)
+    assert not any(t.is_alive() for t in acceptors), (
+        "shutdown-then-close should wake the acceptors immediately"
+    )
+    # Immediate rebind on the same path serves fresh subscribers.
+    srv2 = MonitorServer(mon, path)
+    try:
+        cli = MonitorClient(path)
+        deadline = time.monotonic() + 2.0
+        while (srv2.subscriber_count() == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        mon.notify(MonitorEvent(type=1, payload={"restart": True}))
+        ev = cli.next_event(timeout=2.0)
+        assert ev is not None and ev.payload == {"restart": True}
+        cli.close()
+    finally:
+        srv2.close()
+
+
+def test_chaos_pump_threads_exit_on_one_sided_eof():
+    # A server that accepts and then stays SILENT: after the client
+    # drops, only the c2s pump sees EOF — the s2c pump is parked in
+    # recv on the server leg and exits only if its sibling's teardown
+    # shuts the socket down (bare close leaked it to process exit).
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    proxy = ChaosProxy("127.0.0.1:%d" % srv.getsockname()[1])
+    try:
+        host, _, port = proxy.address.rpartition(":")
+        cli = socket.create_connection((host, int(port)), timeout=5.0)
+        accepted, _ = srv.accept()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            pumps = [
+                t for t in threading.enumerate()
+                if t.name in ("chaos-c2s", "chaos-s2c") and t.is_alive()
+            ]
+            if len(pumps) >= 2:
+                break
+            time.sleep(0.01)
+        assert len(pumps) >= 2
+        cli.close()  # client EOF; the server leg stays silent
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if not any(t.is_alive() for t in pumps):
+                break
+            time.sleep(0.02)
+        assert not any(t.is_alive() for t in pumps), (
+            "sibling pump leaked: shutdown-before-close regressed in "
+            "ChaosProxy._pump"
+        )
+        accepted.close()
+    finally:
+        proxy.close()
+        srv.close()
+
+
+def test_accesslog_client_bounded_against_wedged_collector(tmp_path):
+    # Bound + listen but NEVER accept/read: sendall eventually blocks
+    # on a full socket buffer.  The bounded client must turn that into
+    # log() == False within its timeout, not a forever-hang under the
+    # client mutex.
+    path = str(tmp_path / "accesslog.sock")
+    wedged = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    wedged.bind(path)
+    wedged.listen(1)
+    cli = AccessLogClient(path, timeout=0.5)
+    rec = LogRecord(info="x" * (256 * 1024))
+    results = []
+
+    def run():
+        for _ in range(20):
+            if not cli.log(rec):
+                results.append(False)
+                return
+        results.append(True)
+
+    t = threading.Thread(target=run, daemon=True, name="accesslog-wedge")
+    t.start()
+    t.join(timeout=20.0)
+    try:
+        assert not t.is_alive(), (
+            "log() hung against a wedged collector — the bounded "
+            "socket timeout regressed"
+        )
+        assert results == [False]
+    finally:
+        cli.close()
+        wedged.close()
+
+
+def test_accesslog_server_survives_same_path_restart(tmp_path):
+    path = str(tmp_path / "accesslog.sock")
+    srv = AccessLogServer(path)
+    srv.close()
+    srv2 = AccessLogServer(path)
+    try:
+        cli = AccessLogClient(path, timeout=2.0)
+        assert cli.log(LogRecord(info="after-restart"))
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            recs = [r for r in srv2.records if r.info == "after-restart"]
+            if recs:
+                break
+            time.sleep(0.01)
+        assert recs
+        cli.close()
+    finally:
+        srv2.close()

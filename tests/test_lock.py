@@ -124,3 +124,168 @@ def test_mutex_owner_survives_debug_toggle():
     lk.enable_debug()
     assert m.acquire()  # free lock: no spurious deadlock error
     m.release()
+
+
+# --- PR 3 expansion: the semantics cilium-lint's R1 model relies on -------
+
+
+def test_mutex_release_releases_called_object_after_attribute_swap():
+    """The R1 capture contract, demonstrated at runtime: release()
+    frees the OBJECT it is called on.  After a watchdog-style attribute
+    swap, releasing the captured binding frees the original lock, while
+    release-by-re-read would have freed the (unheld) replacement and
+    left the original held forever — the _in_process_lock deposal bug."""
+
+    class Holder:
+        def __init__(self):
+            self.lock = lk.Mutex("swapped")
+
+    h = Holder()
+    captured = h.lock
+    captured.acquire()
+    h.lock = lk.Mutex("fresh")  # concurrent deposal swap
+    captured.release()  # frees the lock actually held
+    assert captured.acquire(timeout=0.1)  # original is free again
+    captured.release()
+    assert h.lock.acquire(timeout=0.1)  # replacement was never touched
+    h.lock.release()
+
+
+def test_mutex_release_of_unheld_lock_raises():
+    m = lk.Mutex("unheld")
+    with pytest.raises(RuntimeError):
+        m.release()
+
+
+def test_mutex_context_manager_releases_on_exception():
+    m = lk.Mutex("exc")
+    with pytest.raises(ValueError):
+        with m:
+            raise ValueError("boom")
+    assert m.acquire(timeout=0.1)  # not leaked held
+    m.release()
+
+
+def test_mutex_timeout_expiry_keeps_owner_and_exclusion():
+    m = lk.Mutex("t2")
+    m.acquire()
+    got = []
+    t = threading.Thread(target=lambda: got.append(m.acquire(timeout=0.05)))
+    t.start()
+    t.join()
+    assert got == [False]  # expiry, not a steal
+    m.release()
+    assert m.acquire(timeout=0.5)
+    m.release()
+
+
+def test_mutex_debug_timeout_reacquire_is_trylock_not_deadlock():
+    """acquire(timeout=...) is documented as plain try-lock semantics:
+    even a same-thread re-acquire in debug mode must return False
+    instead of raising the deadlock error the blocking path raises."""
+    lk.enable_debug()
+    m = lk.Mutex("try2")
+    m.acquire()
+    assert m.acquire(timeout=0.05) is False
+    m.release()
+
+
+def test_rwmutex_writer_preference_blocks_new_readers():
+    """Go RWMutex contract: an ARRIVING writer blocks NEW readers, so
+    writers cannot starve behind a steady reader stream."""
+    rw = lk.RWMutex("pref")
+    order = []
+    rw.r_acquire()  # steady reader holds the lock
+
+    writer_started = threading.Event()
+
+    def writer():
+        writer_started.set()
+        rw.acquire()
+        order.append("writer")
+        rw.release()
+
+    def late_reader():
+        rw.r_acquire()
+        order.append("reader")
+        rw.r_release()
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+    writer_started.wait(1.0)
+    time.sleep(0.1)  # writer is now parked waiting on the held read lock
+    rt = threading.Thread(target=late_reader, daemon=True)
+    rt.start()
+    time.sleep(0.1)
+    assert order == []  # late reader must NOT slip past the waiting writer
+    rw.r_release()
+    wt.join(2.0)
+    rt.join(2.0)
+    assert order == ["writer", "reader"]
+
+
+def test_rwmutex_writer_waits_for_every_reader():
+    rw = lk.RWMutex("multi")
+    rw.r_acquire()
+    rw.r_acquire()
+    acquired = threading.Event()
+
+    def writer():
+        rw.acquire()
+        acquired.set()
+        rw.release()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    rw.r_release()
+    time.sleep(0.05)
+    assert not acquired.is_set()  # one reader still in
+    rw.r_release()
+    assert acquired.wait(2.0)
+    t.join(2.0)
+
+
+def test_rwmutex_read_guard_context_manager():
+    rw = lk.RWMutex("guard")
+    with rw.read():
+        with rw.read():  # readers share, including with themselves
+            pass
+    # All reader state drained: a writer gets in immediately.
+    acquired = threading.Event()
+
+    def writer():
+        rw.acquire()
+        acquired.set()
+        rw.release()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    assert acquired.wait(2.0)
+    t.join(2.0)
+
+
+def test_rwmutex_debug_write_reacquire_raises():
+    lk.enable_debug()
+    rw = lk.RWMutex("rw3")
+    rw.acquire()
+    with pytest.raises(RuntimeError, match="deadlock"):
+        rw.acquire()
+    rw.release()
+
+
+def test_rwmutex_debug_selfish_write_hold_warns(caplog):
+    lk.enable_debug()
+    rw = lk.RWMutex("slow-w")
+    with caplog.at_level(logging.WARNING, logger="cilium_tpu.utils.lock"):
+        rw.acquire()
+        time.sleep(lk.SELFISH_THRESHOLD + 0.05)
+        rw.release()
+    assert any("held for" in r.getMessage() for r in caplog.records)
+
+
+def test_debug_toggle_roundtrip():
+    assert not lk.debug_enabled()
+    lk.enable_debug()
+    assert lk.debug_enabled()
+    lk.disable_debug()
+    assert not lk.debug_enabled()

@@ -1,0 +1,265 @@
+"""cilium-lint: the analyzer analyzes itself (tier-1).
+
+Three layers:
+
+1. **Tree gate** — the shipped tree has ZERO unsuppressed findings
+   against the checked-in baseline; new violations fail this test.
+2. **Corpus regression** — every rule catches its known-bad snippets
+   (``# EXPECT[Rn]`` markers pin file+line) and stays silent on the
+   known-good twins, including the three historical PR 2 bug shapes:
+   re-read lock release (R1), bare listener close (R3), inverted lock
+   order (R1).
+3. **CLI contract** — exit codes, --json schema, baseline loading.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+import cilium_tpu
+from cilium_tpu.analysis import (
+    analyze_paths,
+    load_baseline,
+    split_findings,
+)
+from cilium_tpu.analysis.cli import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.dirname(os.path.abspath(cilium_tpu.__file__))
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "lint_corpus")
+BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_baseline.json")
+
+_EXPECT = re.compile(r"#\s*EXPECT\[(R[0-9]+)\]")
+
+
+@pytest.fixture(scope="module")
+def tree_findings():
+    return analyze_paths([PKG], baseline=load_baseline(BASELINE))
+
+
+def _expected_markers(path):
+    """{(line, rule), ...} from # EXPECT[Rn] markers in the file(s)."""
+    out = set()
+    paths = []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".py"):
+                paths.append(os.path.join(path, name))
+    else:
+        paths.append(path)
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as f:
+            for i, line in enumerate(f, start=1):
+                for m in _EXPECT.finditer(line):
+                    out.add((os.path.basename(p), i, m.group(1)))
+    return out
+
+
+def _active_markers(findings):
+    active, _ = split_findings(findings)
+    return {(os.path.basename(f.path), f.line, f.rule) for f in active}
+
+
+# --- 1. tree gate ---------------------------------------------------------
+
+def test_shipped_tree_is_clean(tree_findings):
+    active, _ = split_findings(tree_findings)
+    assert not active, (
+        "new invariant violations in cilium_tpu/ — fix them or add a "
+        "JUSTIFIED pragma (lint: disable=Rn -- why):\n"
+        + "\n".join(f.render() for f in active)
+    )
+
+
+def test_every_pragma_suppression_is_justified(tree_findings):
+    # R0 (malformed/unjustified pragma) is unsuppressable, so the tree
+    # gate already fails on naked pragmas; assert the invariant
+    # directly too, and that every applied suppression carries text.
+    assert not [f for f in tree_findings if f.rule == "R0"]
+    for f in tree_findings:
+        if f.suppressed:
+            assert f.justification.strip(), f.render()
+
+
+def test_baseline_is_loadable_and_list_shaped():
+    assert isinstance(load_baseline(BASELINE), list)
+
+
+# --- 2. corpus regression -------------------------------------------------
+
+_CORPUS_CASES = [
+    "r0_bad_pragma.py",
+    "r0_bad_pragma_in_string.py",
+    "r1_bad_nested_release.py",
+    "r1_bad_reread_release.py",
+    "r1_bad_unpaired.py",
+    "r1_bad_lock_order.py",
+    "r2_bad_blocking.py",
+    "r3_bad_bare_close.py",
+    "r4_bad_impure_jit.py",
+    "r5_bad",
+    "r5_bad_verdict_dispatch.py",
+    "r6_bad_thread.py",
+]
+
+_CORPUS_CLEAN = [
+    "r0_good_pragma.py",
+    "r1_good_captured.py",
+    "r1_good_paired.py",
+    "r1_good_lock_order.py",
+    "r2_good_blocking.py",
+    "r3_good_shutdown_close.py",
+    "r4_good_pure_jit.py",
+    "r5_good",
+    "r5_good_verdict_gate.py",
+    "r6_good_thread.py",
+]
+
+
+@pytest.mark.parametrize("name", _CORPUS_CASES)
+def test_corpus_known_bad(name):
+    path = os.path.join(CORPUS, name)
+    got = _active_markers(analyze_paths([path]))
+    want = _expected_markers(path)
+    assert got == want, (
+        f"{name}: rule output drifted from EXPECT markers\n"
+        f"  missing: {sorted(want - got)}\n"
+        f"  extra:   {sorted(got - want)}"
+    )
+
+
+@pytest.mark.parametrize("name", _CORPUS_CLEAN)
+def test_corpus_known_good(name):
+    path = os.path.join(CORPUS, name)
+    active, _ = split_findings(analyze_paths([path]))
+    assert not active, "\n".join(f.render() for f in active)
+
+
+# Historical PR 2 bug shapes, pinned by name so a rules refactor that
+# stops catching them fails LOUDLY, not via a generic corpus diff.
+
+def test_catches_reread_lock_release_deposal_bug():
+    path = os.path.join(CORPUS, "r1_bad_reread_release.py")
+    active, _ = split_findings(analyze_paths([path]))
+    msgs = " | ".join(f.message for f in active)
+    assert any(f.rule == "R1" for f in active)
+    assert "swappable lock attribute" in msgs
+    assert "_in_process_lock" in msgs
+
+
+def test_catches_bare_listener_close_zombie_service_bug():
+    path = os.path.join(CORPUS, "r3_bad_bare_close.py")
+    active, _ = split_findings(analyze_paths([path]))
+    assert [f.rule for f in active] == ["R3"]
+    assert "shutdown" in active[0].message
+
+
+def test_catches_inverted_lock_order():
+    path = os.path.join(CORPUS, "r1_bad_lock_order.py")
+    active, _ = split_findings(analyze_paths([path]))
+    assert any("lock-order inversion" in f.message for f in active)
+    assert any("self-deadlock" in f.message for f in active)
+
+
+def test_pragma_in_string_neither_suppresses_nor_flags():
+    path = os.path.join(CORPUS, "r0_bad_pragma_in_string.py")
+    findings = analyze_paths([path])
+    active, _ = split_findings(findings)
+    assert [f.rule for f in active] == ["R2"]
+    assert not [f for f in findings if f.rule == "R0"]
+
+
+def test_unjustified_pragma_is_unsuppressable():
+    path = os.path.join(CORPUS, "r0_bad_pragma.py")
+    findings = analyze_paths([path])
+    r0 = [f for f in findings if f.rule == "R0"]
+    assert r0 and not any(f.suppressed for f in r0)
+
+
+# --- 3. CLI contract ------------------------------------------------------
+
+def test_cli_clean_file_exits_zero(capsys):
+    rc = lint_main([os.path.join(CORPUS, "r1_good_captured.py"),
+                    "--no-baseline"])
+    assert rc == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_cli_bad_file_exits_one(capsys):
+    rc = lint_main([os.path.join(CORPUS, "r3_bad_bare_close.py"),
+                    "--no-baseline"])
+    assert rc == 1
+    assert "R3" in capsys.readouterr().out
+
+
+def test_cli_json_mode(capsys):
+    rc = lint_main(["--json", "--no-baseline",
+                    os.path.join(CORPUS, "r2_bad_blocking.py")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    report = json.loads(out)
+    assert report["total"] == len(report["findings"]) == 4
+    assert report["counts"] == {"R2": 4}
+    for f in report["findings"]:
+        assert {"rule", "file", "line", "col", "message",
+                "symbol"} <= set(f)
+
+
+def test_cli_json_clean_tree_against_baseline(capsys):
+    rc = lint_main(["--json", "--baseline", BASELINE, PKG])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    report = json.loads(out)
+    assert report["total"] == 0
+    # The 5 by-design hot-path suppressions stay visible (auditable).
+    assert all(f["justification"] for f in report["suppressed"]
+               if not f["baselined"])
+
+
+def test_cli_baseline_accepts_findings(tmp_path, capsys):
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        [{"rule": "R3", "file": "r3_bad_bare_close.py"}]
+    ))
+    rc = lint_main([os.path.join(CORPUS, "r3_bad_bare_close.py"),
+                    "--baseline", str(baseline)])
+    assert rc == 0
+    capsys.readouterr()
+
+
+def test_cli_fails_closed_on_missing_path(capsys):
+    assert lint_main(["no_such_dir_xyz/", "--no-baseline"]) == 2
+    assert "no such path" in capsys.readouterr().err
+
+
+def test_cli_fails_closed_on_zero_python_files(tmp_path, capsys):
+    # A real directory with no .py files (e.g. a CI job run from the
+    # wrong cwd) must error, not print '0 finding(s)' and go green.
+    (tmp_path / "README.txt").write_text("not python")
+    assert lint_main([str(tmp_path), "--no-baseline"]) == 2
+    assert "no Python files" in capsys.readouterr().err
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("R1", "R2", "R3", "R4", "R5", "R6"):
+        assert rule in out
+
+
+def test_bin_entrypoint_runs():
+    """bin/cilium-lint is executable end-to-end (subprocess, --json)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "cilium-lint"),
+         "--json", "--no-baseline",
+         os.path.join(CORPUS, "r6_bad_thread.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1, proc.stderr
+    assert json.loads(proc.stdout)["counts"] == {"R6": 1}
