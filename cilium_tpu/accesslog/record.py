@@ -63,6 +63,19 @@ class L7LogEntry:
 
 
 @dataclass
+class LatencyInfo:
+    """Verdict-path latency breakdown attached to slow-verdict
+    exemplars by the sidecar tracer (sidecar/trace.py): end-to-end
+    microseconds, the serving path (vec|oracle|host|shed), and the
+    per-stage decomposition (queue/batch_form/device_submit/device/
+    drain/send)."""
+
+    total_us: float = 0.0
+    path: str = ""
+    stages_us: dict = field(default_factory=dict)
+
+
+@dataclass
 class LogRecord:
     """reference: record.go:140 LogRecord."""
 
@@ -77,6 +90,7 @@ class LogRecord:
     http: Optional[HttpLogEntry] = None
     kafka: Optional[KafkaLogEntry] = None
     l7: Optional[L7LogEntry] = None
+    latency: Optional[LatencyInfo] = None
 
     def __post_init__(self) -> None:
         if not self.timestamp:
@@ -108,4 +122,6 @@ class LogRecord:
             rec.kafka = KafkaLogEntry(**d["kafka"])
         if d.get("l7"):
             rec.l7 = L7LogEntry(**d["l7"])
+        if d.get("latency"):
+            rec.latency = LatencyInfo(**d["latency"])
         return rec

@@ -44,6 +44,9 @@ RULE_DOCS = {
           "exhaustively handled (or fall into a fail-closed default)",
     "R6": "threading.Thread(...) without daemon= or a local join — "
           "leaks past the conftest thread guard",
+    "R7": "metric hygiene: registered-but-unreferenced metric "
+          "(permanently-zero series), or Histogram.observe inside a "
+          "dispatch hot loop without per-round/sample guarding",
 }
 
 # ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
@@ -305,7 +308,13 @@ def _collect_py(paths) -> list[str]:
 
 
 def all_rules():
-    from . import rules_jit, rules_locks, rules_sockets, rules_wire
+    from . import (
+        rules_jit,
+        rules_locks,
+        rules_metrics,
+        rules_sockets,
+        rules_wire,
+    )
 
     return [
         rules_locks.check_r1,
@@ -314,6 +323,7 @@ def all_rules():
         rules_jit.check_r4,
         rules_wire.check_r5,
         rules_sockets.check_r6,
+        rules_metrics.check_r7,
     ]
 
 

@@ -185,8 +185,30 @@ def cmd_config(args):
     return 0
 
 
+def _filter_metrics(text: str, prefix: str) -> str:
+    """Name-prefix filter over Prometheus text exposition: keeps the
+    HELP/TYPE/sample lines of metrics whose name starts with ``prefix``
+    (with or without the ``cilium_tpu_`` namespace), including their
+    ``_bucket``/``_sum``/``_count`` series."""
+    if not prefix:
+        return text
+    from .utils.metrics import NAMESPACE
+
+    prefixes = (prefix, f"{NAMESPACE}_{prefix}")
+    out = []
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            name = line.split(" ", 3)[2]
+        else:
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+        if name.startswith(prefixes):
+            out.append(line)
+    return "\n".join(out) + ("\n" if out else "")
+
+
 def cmd_metrics(args):
-    print(_client(args).get("/metrics"), end="")
+    text = _client(args).get("/metrics")
+    print(_filter_metrics(text, args.prefix), end="")
     return 0
 
 
@@ -440,6 +462,61 @@ def cmd_sidecar_status(args):
         print(f"quarantine: {cont.get('reason', '')} "
               f"for {cont.get('quarantined_for_s', 0)}s "
               f"(probes: {cont.get('probes', 0)})")
+    lat = st.get("latency") or {}
+    if lat.get("rounds"):
+        print(f"latency: {lat['rounds']} rounds, "
+              f"{lat.get('spans_sampled', 0)} sampled spans, "
+              f"{lat.get('slow_exemplars', 0)} slow exemplars "
+              f"(threshold {lat.get('slow_threshold_ms', 0)}ms, "
+              f"sample 1/{lat.get('sample_every', 0)})")
+        for path, stages in sorted((lat.get("stages") or {}).items()):
+            cells = " ".join(
+                f"{stage}={rec['mean_us']:.0f}us"
+                + (f"/p99<={rec['p99_us']:.0f}us"
+                   if rec.get("p99_us") is not None else "")
+                for stage, rec in stages.items()
+            )
+            print(f"  [{path}] {cells}")
+    return 0
+
+
+def cmd_sidecar_trace(args):
+    """Dump the verdict service's latency-trace ring: sampled per-entry
+    spans plus every slow-verdict exemplar, with per-stage breakdowns
+    (the forensic half of the always-on stage histograms)."""
+    from .sidecar import SidecarClient, SidecarUnavailable
+
+    try:
+        cl = SidecarClient(args.address, timeout=3.0)
+    except OSError as e:
+        print(f"Error: cannot reach verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        out = cl.trace(n=args.n, kind=args.kind)
+    except (SidecarUnavailable, TimeoutError) as e:
+        print(f"Error: verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        cl.close()
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    spans = out.get("spans", [])
+    lat = out.get("latency", {})
+    print(f"{args.address}: {len(spans)} span(s) "
+          f"({lat.get('spans_sampled', 0)} sampled, "
+          f"{lat.get('slow_exemplars', 0)} slow, "
+          f"{lat.get('shed_spans', 0)} shed)")
+    from .sidecar.trace import format_stages_us
+
+    for s in spans:
+        stages = format_stages_us(s.get("stages_us", {}))
+        reason = f" reason={s['reason']}" if s.get("reason") else ""
+        print(f"  {s['kind']:<6} path={s['path']:<6} seq={s['seq']:<8} "
+              f"conn={s['conn_id']:<6} n={s['entries']:<5} "
+              f"e2e={s['e2e_us'] / 1e3:.3f}ms{reason} {stages}")
     return 0
 
 
@@ -535,6 +612,9 @@ def build_parser() -> argparse.ArgumentParser:
     x.set_defaults(fn=cmd_config)
 
     x = sub.add_parser("metrics", help="Prometheus metrics")
+    x.add_argument("prefix", nargs="?", default="",
+                   help="only metrics whose name starts with this "
+                        "prefix (namespace optional)")
     x.set_defaults(fn=cmd_metrics)
 
     x = sub.add_parser("monitor", help="live event stream")
@@ -613,6 +693,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="verdict service unix socket path")
     x.add_argument("--json", action="store_true")
     x.set_defaults(fn=cmd_sidecar_status)
+    x = sc.add_parser(
+        "trace",
+        help="latency-trace ring: sampled spans + slow-verdict "
+             "exemplars with stage breakdowns",
+    )
+    x.add_argument("--address", required=True,
+                   help="verdict service unix socket path")
+    x.add_argument("-n", type=int, default=50, help="max spans")
+    x.add_argument("--kind", choices=["sample", "slow", "shed"],
+                   default=None, help="only spans of this kind")
+    x.add_argument("--json", action="store_true")
+    x.set_defaults(fn=cmd_sidecar_trace)
 
     x = sub.add_parser("version")
     x.set_defaults(fn=cmd_version)

@@ -104,6 +104,7 @@ from .backend import (
     Watcher,
 )
 from .local import LocalBackend
+from ..utils import metrics
 from ..utils.backoff import Exponential
 from ..utils.sockutil import shutdown_close
 
@@ -137,15 +138,21 @@ class KvstoreCounters:
     kvstore errors surface through controller failure counts,
     pkg/kvstore/events.go).  Surfaced through server/client status and
     the daemon status section — a malformed frame or revoke failure
-    increments here instead of vanishing."""
+    increments here instead of vanishing.  Every increment is ALSO
+    bridged into the global Prometheus registry
+    (``cilium_tpu_kvstore_events_total{scope,event}``) so fencing and
+    traffic counters appear in ``/metrics``, not only in status RPCs;
+    ``scope`` names the owning end (server|client)."""
 
-    def __init__(self) -> None:
+    def __init__(self, scope: str = "kvstore") -> None:
+        self._scope = scope
         self._mutex = threading.Lock()
         self._counts: dict[str, int] = {}
 
     def inc(self, name: str) -> None:
         with self._mutex:
             self._counts[name] = self._counts.get(name, 0) + 1
+        metrics.KvstoreEvents.inc(self._scope, name)
 
     def snapshot(self) -> dict[str, int]:
         with self._mutex:
@@ -466,7 +473,7 @@ class KvstoreServer:
                 else LocalBackend()
             )
         self.backend = backend
-        self.counters = KvstoreCounters()
+        self.counters = KvstoreCounters("server")
         # Fencing state.  The role is fixed BEFORE the listener starts:
         # a session racing construction must never see a follower as
         # writable (the write it sneaked in would be pruned at the
@@ -930,7 +937,7 @@ class NetBackend(Backend):
             raise KvstoreError("no kvstore address given")
         self.address = self.addresses[0]
         self.timeout = timeout
-        self.counters = KvstoreCounters()
+        self.counters = KvstoreCounters("client")
         # Highest fencing epoch observed on any response: carried on
         # every request (the gossip that fences stale primaries) and
         # surfaced through daemon status / `cilium kvstore status`.

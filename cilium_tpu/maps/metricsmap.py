@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from ..utils.metrics import DropCount, ForwardCount
+
 REASON_FORWARDED = 0
 
 # The metrics map's direction encoding differs from policy_key's 0/1 bit
@@ -37,6 +39,13 @@ class MetricsMap:
         v = self.values.setdefault((reason, direction), MetricsValue())
         v.count += count
         v.bytes += nbytes
+        # Bridge into the Prometheus registry (reference: pkg/metrics
+        # drop_count_total/forward_count_total are fed from this map).
+        d = _DIR_NAMES.get(direction, str(direction))
+        if reason == REASON_FORWARDED:
+            ForwardCount.inc(d, amount=count)
+        else:
+            DropCount.inc(str(reason), d, amount=count)
 
     def get(self, reason: int, direction: int) -> MetricsValue:
         return self.values.get((reason, direction), MetricsValue())

@@ -48,6 +48,21 @@ def format_event(ev: MonitorEvent) -> str:
             f"{p.get('l7_protocol', '?')} {p.get('info', '')}"
         )
     if ev.type == MSG_TYPE_TRACE:
+        sv = p.get("slow_verdict") if isinstance(p, dict) else None
+        if sv:
+            # Slow-verdict exemplar from the sidecar latency tracer
+            # (sidecar/trace.py): name the request and where its time
+            # went, largest stage first.
+            from ..sidecar.trace import format_stages_us
+
+            stages = format_stages_us(sv.get("stages_us", {}))
+            reason = f" reason={sv['reason']}" if sv.get("reason") else ""
+            return (
+                f"{ts} SLOW-VERDICT: path={sv.get('path', '?')} "
+                f"seq={sv.get('seq')} conn={sv.get('conn_id')} "
+                f"n={sv.get('entries')} "
+                f"e2e={sv.get('e2e_us', 0) / 1e3:.2f}ms{reason} {stages}"
+            )
         return f"{ts} TRACE: {p}"
     if ev.type == MSG_TYPE_DEBUG:
         return f"{ts} DEBUG: {p}"

@@ -18,6 +18,8 @@ This package is the TPU-native equivalent of that seam:
                  device models behind the wire protocol
 - ``client``   — a Python datapath shim (per-connection buffering, the
                  OnIO byte-accounting contract) used by tests and benches
+- ``trace``    — verdict-path latency decomposition: per-round stage
+                 histograms, sampled spans, slow-verdict exemplars
 
 The native C++ shim implementing the same client contract lives in
 ``native/`` (built to ``libcilium_tpu_shim.so``).
@@ -27,13 +29,16 @@ from .client import ShimConnection, SidecarClient, SidecarUnavailable
 from .dispatch import BatchDispatcher
 from .guard import DeviceGuard, DeviceStall
 from .service import VerdictService
+from .trace import RoundTrace, VerdictTracer
 
 __all__ = [
     "BatchDispatcher",
     "DeviceGuard",
     "DeviceStall",
+    "RoundTrace",
     "ShimConnection",
     "SidecarClient",
     "SidecarUnavailable",
     "VerdictService",
+    "VerdictTracer",
 ]

@@ -562,6 +562,19 @@ class SidecarClient:
         )
         return json.loads(got.decode())
 
+    def trace(self, n: int = 100, kind: str | None = None) -> dict:
+        """Latency-trace dump (MSG_TRACE round trip): the service's
+        most recent sampled spans / slow exemplars plus its per-stage
+        latency aggregate — the `cilium sidecar trace` surface."""
+        req: dict = {"n": int(n)}
+        if kind:
+            req["kind"] = kind
+        got = self._control_rpc(
+            lambda: (wire.MSG_TRACE, json.dumps(req).encode()),
+            wire.MSG_TRACE_REPLY,
+        )
+        return json.loads(got.decode())
+
     def _raw_policy_update(self, wire_mod: int, payload: bytes) -> int:
         got = self._control_rpc(
             lambda: (

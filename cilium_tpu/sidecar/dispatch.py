@@ -137,6 +137,11 @@ class BatchDispatcher:
         self.shed_submits = 0
         self.shed_weight = 0
         self.stall_deposals = 0
+        # Cumulative wall-clock with a round in flight (worker OR
+        # cut-through inline) — the dispatcher-busy half of the device
+        # telemetry; the tracer's device-busy gauge covers the chip
+        # half.  Written only at round close (one float add per round).
+        self.busy_seconds = 0.0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -263,6 +268,7 @@ class BatchDispatcher:
             if self.round_seq == rid:
                 self._busy = False
                 self._current_batch = None
+                self.busy_seconds += time.perf_counter() - self._round_start
                 self._done.notify_all()
                 # The worker parks in _take while an inline round is
                 # busy (it must not clobber the round state) — wake it
@@ -354,6 +360,10 @@ class BatchDispatcher:
                     return  # deposed mid-round: a replacement owns the queue
                 self._busy = False
                 self._current_batch = None
+                if batch:
+                    self.busy_seconds += (
+                        time.perf_counter() - self._round_start
+                    )
                 self._done.notify_all()
                 if self._stopped and not self._pending:
                     return

@@ -88,6 +88,10 @@ class DeviceGuard:
         self._probe_inflight = False
         self._last_probe = 0.0
         self._quarantined_at = 0.0
+        # Cumulative seconds spent quarantined (closed intervals; the
+        # live interval is added in status()) — the "how long were we
+        # on the host fallback" device-telemetry number.
+        self._quarantined_total_s = 0.0
 
     @property
     def enabled(self) -> bool:
@@ -181,6 +185,9 @@ class DeviceGuard:
             self._crash_streak = 0
             self._tainted = False
             self._sticky_taint = False
+            self._quarantined_total_s += (
+                time.monotonic() - self._quarantined_at
+            )
         log.warning("device un-quarantined (probe succeeded)")
         if self.on_change is not None:
             try:
@@ -232,12 +239,16 @@ class DeviceGuard:
 
     def status(self) -> dict:
         with self._lock:
+            total = self._quarantined_total_s
+            if self.quarantined:
+                total += time.monotonic() - self._quarantined_at
             out = {
                 "quarantined": self.quarantined,
                 "reason": self.reason,
                 "stalls": self.stalls,
                 "quarantine_events": self.quarantine_events,
                 "probes": self.probes,
+                "quarantined_total_s": round(total, 3),
             }
             if self.quarantined:
                 out["quarantined_for_s"] = round(

@@ -54,6 +54,7 @@ from ..utils.sockutil import shutdown_close
 from . import wire
 from .dispatch import BatchDispatcher
 from .guard import DeviceGuard
+from .trace import PATH_HOST, PATH_ORACLE, PATH_VEC, VerdictTracer
 
 log = logging.getLogger(__name__)
 
@@ -182,6 +183,17 @@ class VerdictService:
             stall_timeout_s=self.config.device_call_timeout_s,
             on_batch_error=self._on_batch_error,
             on_stall=self._on_dispatch_stall,
+        )
+        # Latency decomposition: per-round stage stamps -> microsecond
+        # histograms + sampled spans / slow exemplars (trace.py).  The
+        # tracer is always constructed; trace_stage_metrics=False turns
+        # the metric observes off (the bench's disabled baseline).
+        self.tracer = VerdictTracer(
+            sample_every=self.config.trace_sample_every,
+            slow_ms=self.config.trace_slow_ms,
+            ring=self.config.trace_ring,
+            stage_metrics=self.config.trace_stage_metrics,
+            batch_capacity=self.config.batch_flows,
         )
         # Containment telemetry (status/metrics).
         self.shed_entries = 0
@@ -385,7 +397,11 @@ class VerdictService:
                 ),
                 "stall_deposals": self.dispatcher.stall_deposals,
                 "shed_submits": self.dispatcher.shed_submits,
+                "busy_seconds": round(self.dispatcher.busy_seconds, 3),
             },
+            # Latency decomposition (sidecar/trace.py): per-stage means
+            # by serving path + span/exemplar counters.
+            "latency": self.tracer.status(),
             # Degradation ladder: device -> quarantine -> host fallback
             # -> shed.  Every rung typed and counted.
             "containment": {
@@ -395,6 +411,14 @@ class VerdictService:
                 "fallback_entries": self.fallback_entries,
                 **self.guard.status(),
             },
+        }
+
+    def trace_dump(self, n: int = 100, kind: str | None = None) -> dict:
+        """Span-ring snapshot + tracer status for `cilium sidecar
+        trace` (MSG_TRACE)."""
+        return {
+            "spans": self.tracer.spans(n, kind),
+            "latency": self.tracer.status(),
         }
 
     def close_module(self, module_id: int) -> None:
@@ -665,7 +689,8 @@ class VerdictService:
 
     def submit_data(self, client, batch: wire.DataBatch,
                     backlogged: bool = False) -> None:
-        batch.arrival = time.monotonic()
+        if not batch.arrival:  # wire unpack stamps ingress; keep it
+            batch.arrival = time.monotonic()
         item = ("data", client, batch)
         if not backlogged and self._try_cut_through(item):
             return
@@ -674,7 +699,8 @@ class VerdictService:
 
     def submit_matrix(self, client, mb: wire.MatrixBatch,
                       backlogged: bool = False) -> None:
-        mb.arrival = time.monotonic()
+        if not mb.arrival:  # wire unpack stamps ingress; keep it
+            mb.arrival = time.monotonic()
         item = ("mat", client, mb)
         if not backlogged and self._try_cut_through(item):
             return
@@ -761,7 +787,23 @@ class VerdictService:
                 lock.release()
         return True
 
-    def _run_mat_group(self, items: list) -> bool:
+    @staticmethod
+    def _batch_desc(batch) -> tuple:
+        """(seq, n, arrival, first conn) — the tracer's per-wire-batch
+        descriptor for e2e observation and span naming."""
+        return (
+            batch.seq, batch.count, batch.arrival,
+            int(batch.conn_ids[0]) if batch.count else 0,
+        )
+
+    @staticmethod
+    def _oldest_arrival(items: list) -> float:
+        """Oldest ingress stamp across a round's data items (the
+        tracer's admit boundary — worst queue wait in the round)."""
+        arr = [it[2].arrival for it in items if it[2].arrival]
+        return min(arr) if arr else 0.0
+
+    def _run_mat_group(self, items: list, t_pop: float) -> bool:
         """Whole-round fast path: every item is a complete-flag matrix
         batch, judged with ONE eligibility gather, ONE (chunked) device
         dispatch, ONE batched readback, and ONE verdict frame per
@@ -812,6 +854,10 @@ class VerdictService:
         if int(lengths.min()) < 2 or int(lengths.max()) > self.config.batch_width:
             return False
         mark("eligibility")
+        rt = self.tracer.begin_round(
+            PATH_VEC, n, self._oldest_arrival(items), t_pop
+        )
+        rt.formed()
         # Issue device chunks with the precomputed remotes, then one
         # batched readback for the whole round.
         lens32 = lengths.astype(np.int32)
@@ -835,6 +881,7 @@ class VerdictService:
             _, _, chunk_allow = self._model_call(engine.model, data, lens, rem)
             issued.append((chunk_allow, a, b, cn))
         mark("device_issue")
+        rt.submitted()
         allow = np.empty(n, bool)
         for fut, a, b, cn in issued:
             # np.asarray per array beats one batched device_get for the
@@ -845,9 +892,14 @@ class VerdictService:
                 log.exception("device readback failed")
                 allow[a:b] = False
         mark("readback")
+        # Device-complete is this FENCED boundary (np.asarray readback)
+        # — block_until_ready can return pre-execution on the tunneled
+        # transport and would book device time into the send stage.
+        rt.completed()
         self.fast_log.log_batch("r2d2", n, int(n - allow.sum()))
         self.vec_batches += 1
         self.vec_entries += n
+        metrics.ProxyBatches.inc()
         # Responses: one frame per client — a plain VERDICT_BATCH for a
         # single seq, a VERDICT_MULTI covering all its seqs otherwise.
         per_client: dict[int, list] = {}
@@ -860,6 +912,7 @@ class VerdictService:
             rec[3].append((start, start + mb.count))
             rec[4].append(mb)
             start += mb.count
+        rt.drained()
         for client, seqs, counts, spans, mbs in per_client.values():
             # ``batches=mbs``: send() marks every covered wire batch
             # answered under the write lock before writing, so a stall
@@ -895,6 +948,10 @@ class VerdictService:
             except Exception:  # noqa: BLE001 — client may be gone
                 log.exception("verdict send failed")
         mark("respond")
+        if not self._round_thread_suppressed():
+            self.tracer.finish_round(
+                rt, [self._batch_desc(it[2]) for it in items]
+            )
         return True
 
     def submit_close(self, conn_id: int) -> None:
@@ -953,6 +1010,10 @@ class VerdictService:
             # rate would over-report).
             self.shed_entries += n
             metrics.SidecarShedTotal.inc(reason, amount=n)
+            self.tracer.record_shed(
+                batch.seq, n, batch.arrival,
+                int(batch.conn_ids[0]) if n else 0, reason,
+            )
 
     def _on_batch_error(self, items: list, exc: BaseException) -> None:
         """Crash containment: a failed process(batch) produces typed
@@ -1122,6 +1183,9 @@ class VerdictService:
         preserving per-connection op order.
         """
         self.guard.round_start()
+        # Queue-pop boundary for the latency decomposition: everything
+        # before this stamp is admission-queue time.
+        t_pop = time.monotonic()
         items = self._admit(items)
         closes = [it[1:] for it in items if it[0] == "close"]
         data_items = [it for it in items if it[0] in ("data", "mat")]
@@ -1142,7 +1206,7 @@ class VerdictService:
                 and it[2].width == self.config.batch_width
                 for it in data_items
             )
-            and self._run_mat_group(data_items)
+            and self._run_mat_group(data_items, t_pop)
         ):
             for close_args in closes:
                 self.close_connection(*close_args)
@@ -1188,9 +1252,9 @@ class VerdictService:
                 general.sort(key=lambda rec: rec[0])
             vec = kept
         if vec:
-            self._run_vec([(it, eng) for _, it, eng in vec], snap)
+            self._run_vec([(it, eng) for _, it, eng in vec], snap, t_pop)
         if general:
-            self._process_entrywise([it for _, it in general])
+            self._process_entrywise([it for _, it in general], t_pop)
         for close_args in closes:
             self.close_connection(*close_args)
         # The round completed without raising — reset the poisoned-
@@ -1482,7 +1546,8 @@ class VerdictService:
                 )
                 np.asarray(out)
 
-    def _run_vec(self, vec_items: list, snap: "_TabSnap") -> None:
+    def _run_vec(self, vec_items: list, snap: "_TabSnap",
+                 t_pop: float) -> None:
         """One device call per engine chunk over the concatenated
         batches, ops emitted columnar straight from the verdict arrays."""
         groups: dict[int, list] = {}
@@ -1496,6 +1561,10 @@ class VerdictService:
             # row-slices, no gather.  Aggregate across items so one
             # device pass covers the whole round.
             if mats:
+                rt = self.tracer.begin_round(
+                    PATH_VEC, sum(it[2].count for it in mats),
+                    self._oldest_arrival(mats), t_pop,
+                )
                 if len(mats) == 1:
                     m_rows = mats[0][2].rows
                     m_lens = mats[0][2].lengths.astype(np.int32)
@@ -1506,7 +1575,9 @@ class VerdictService:
                         [it[2].lengths for it in mats]
                     ).astype(np.int32)
                     m_ids = np.concatenate([it[2].conn_ids for it in mats])
+                rt.formed()
                 issued = self._issue_chunks(engine, m_rows, m_lens, m_ids, snap)
+                rt.submitted()
                 sends, start = [], 0
                 for _, client, mb in mats:
                     sends.append(
@@ -1515,11 +1586,15 @@ class VerdictService:
                     )
                     start += mb.count
                 if self._inline_complete:
-                    self._finish_vec(issued, start, sends)
+                    self._finish_vec(issued, start, sends, rt)
                 else:
-                    self._completion_put(("vec", issued, start, sends))
+                    self._completion_put(("vec", issued, start, sends, rt))
             if not datas:
                 continue
+            rt = self.tracer.begin_round(
+                PATH_VEC, sum(it[2].count for it in datas),
+                self._oldest_arrival(datas), t_pop,
+            )
             batches = [it[2] for it in datas]
             conn_ids = np.concatenate([b.conn_ids for b in batches])
             lengths = np.concatenate(
@@ -1532,9 +1607,11 @@ class VerdictService:
             offs = np.concatenate(
                 ([0], np.cumsum(lengths, dtype=np.int64))
             )[:-1].astype(np.int32)
+            rt.formed()
             issued = self._issue_chunks_blob(
                 engine, blob, offs, lengths, conn_ids, snap
             )
+            rt.submitted()
             sends, start = [], 0
             for _, client, batch in datas:
                 sends.append(
@@ -1544,9 +1621,9 @@ class VerdictService:
                 )
                 start += batch.count
             if self._inline_complete:
-                self._finish_vec(issued, n, sends)
+                self._finish_vec(issued, n, sends, rt)
             else:
-                self._completion_put(("vec", issued, n, sends))
+                self._completion_put(("vec", issued, n, sends, rt))
 
     def _issue_chunks(self, engine, rows, lengths, conn_ids,
                       snap: "_TabSnap") -> list:
@@ -1667,7 +1744,7 @@ class VerdictService:
         rid = getattr(threading.current_thread(), "_disp_round", None)
         self._completions.put((rid, rec))
 
-    def _finish_vec(self, issued, n, sends) -> None:
+    def _finish_vec(self, issued, n, sends, rt=None) -> None:
         """Inline completion (greedy mode): materialize this round's
         futures and send — runs on the dispatcher thread, so per-conn
         FIFO order is trivially preserved.  The queue/worker variant in
@@ -1681,10 +1758,17 @@ class VerdictService:
             except Exception:  # noqa: BLE001 — deny on device error
                 log.exception("device readback failed")
                 allow[a:b] = False
+        if rt is not None:
+            rt.completed()  # fenced: np.asarray above IS the readback
         self.fast_log.log_batch("r2d2", n, int(n - allow.sum()))
         self.vec_batches += 1
         self.vec_entries += n
+        metrics.ProxyBatches.inc()
         self._send_vec_frames(sends, allow)
+        if rt is not None and not self._round_thread_suppressed():
+            self.tracer.finish_round(
+                rt, [self._batch_desc(s[6]) for s in sends]
+            )
 
     def _send_vec_frames(self, sends, allow) -> None:
         """Emit a vec round's verdicts: one VERDICT_BATCH frame per
@@ -1822,6 +1906,13 @@ class VerdictService:
             except Exception:  # noqa: BLE001
                 log.exception("device readback failed")
                 vals = [None] * n_futs
+            # One batched get covered every vec group in this drain:
+            # stamp their fenced device-complete boundary NOW, before
+            # earlier records' sends run, or later groups would book
+            # sibling send time as device time.
+            for _rid, r in recs:
+                if r[0] == "vec":
+                    r[4].completed()
             vi = 0
             cur = threading.current_thread()
             for rid, r in recs:
@@ -1839,7 +1930,7 @@ class VerdictService:
                 try:
                     deposed = self.dispatcher.thread_round_is_shed()
                     if r[0] == "vec":
-                        _, issued, n, sends = r
+                        _, issued, n, sends, rt = r
                         if deposed:
                             vi += len(issued)  # keep later slices aligned
                             continue
@@ -1851,12 +1942,17 @@ class VerdictService:
                                 allow[a:b] = False
                             else:
                                 allow[a:b] = np.asarray(v)[:cn]
+                        rt.drained()
                         self.fast_log.log_batch(
                             "r2d2", n, int(n - allow.sum())
                         )
                         self.vec_batches += 1
                         self.vec_entries += n
+                        metrics.ProxyBatches.inc()
                         self._send_vec_frames(sends, allow)
+                        self.tracer.finish_round(
+                            rt, [self._batch_desc(s[6]) for s in sends]
+                        )
                     elif r[0] == "entry2":
                         # Runs even when deposed: finish() drains engine
                         # ops/inject and the async-pending refcounts
@@ -1874,10 +1970,13 @@ class VerdictService:
                         # can't erase them before they hold the streak.
                         self.guard.deferred_scope(finish, chunk)
                     elif r[0] == "ready":
-                        _, client, batch, entries = r
+                        _, client, batch, entries, rtd = r
                         client.send_verdicts(
                             batch.seq, entries, batch=batch
                         )
+                        if rtd is not None and not deposed:
+                            rt, descs = rtd
+                            self.tracer.finish_round(rt, descs)
                 except Exception:  # noqa: BLE001 — worker must survive
                     log.exception("completion failed")
                 finally:
@@ -1918,7 +2017,7 @@ class VerdictService:
             conn_ids, lengths, allow
         )
 
-    def _process_entrywise(self, items: list) -> None:
+    def _process_entrywise(self, items: list, t_pop: float = 0.0) -> None:
         # Per-entry path, preserving per-connection order: an entry is
         # fast only if nothing earlier in this round put its connection
         # on the slow path.
@@ -1928,6 +2027,15 @@ class VerdictService:
         slow_conns: set[int] = set()
 
         quarantined = self.guard.quarantined
+        # Path label for the decomposition: a quarantined round IS the
+        # host-fallback rung (oracle demotion / host policy.matches);
+        # otherwise the entrywise round is the engine/parser slow path.
+        rt = self.tracer.begin_round(
+            PATH_HOST if quarantined else PATH_ORACLE,
+            sum(it[2].count for it in items),
+            self._oldest_arrival(items),
+            t_pop or None,
+        )
         for item in items:
             _, client, batch = item
             key = id(item)
@@ -2002,8 +2110,10 @@ class VerdictService:
         # RTT-serial: 10k verdicts/s through the tunnel vs the vec
         # path's millions (see BENCH_NOTES round 5).
         if not self._inline_complete and self._slow_async_eligible(slow):
+            rt.formed()
             fast_issued = self._issue_fast(fast) if fast else []
             buckets, plan = self._issue_slow_async(slow, responses)
+            rt.submitted()
             futs = [g[0] for g in fast_issued] + [b[0] for b in buckets]
             pend = {conn_id for _k, _i, _sc, conn_id, *_ in plan}
             if pend:
@@ -2015,6 +2125,9 @@ class VerdictService:
 
             def finish(vals: list | None) -> None:
                 try:
+                    # The completion loop's batched device_get (or the
+                    # inline np.asarray fallback) fenced this round.
+                    rt.completed()
                     nf = len(fast_issued)
                     self._finish_fast(
                         fast_issued, responses,
@@ -2029,6 +2142,7 @@ class VerdictService:
                             else [None] * len(buckets)
                         ),
                     )
+                    rt.drained()
                     for item in items:
                         _, client, batch = item
                         try:
@@ -2038,6 +2152,11 @@ class VerdictService:
                             )
                         except Exception:  # noqa: BLE001 — client gone
                             log.exception("verdict send failed")
+                    if not self._round_thread_suppressed():
+                        self.tracer.finish_round(
+                            rt,
+                            [self._batch_desc(it[2]) for it in items],
+                        )
                 finally:
                     if pend:
                         with self._lock:
@@ -2068,10 +2187,16 @@ class VerdictService:
                 deferred = bool(round_conns & pending_now)
 
         def run_sync_and_respond(_vals: list | None = None) -> None:
+            rt.formed()
             if fast:
                 self._run_fast(fast, responses)
             self._run_slow_batched(slow, responses)
-            for item in items:
+            # Sync paths read back inside the engine pump/fast finish:
+            # submit/complete collapse onto this boundary and the work
+            # shows up in the drain stage (still fenced — the pump's
+            # np.asarray readbacks have executed by here).
+            rt.drained()
+            for i_item, item in enumerate(items):
                 _, client, batch = item
                 if self._inline_complete or deferred:
                     try:
@@ -2081,8 +2206,20 @@ class VerdictService:
                     except Exception:  # noqa: BLE001 — client may be gone
                         log.exception("verdict send failed")
                 else:
+                    # The LAST item's ready record carries the round
+                    # trace (+ every covered batch's descriptor): the
+                    # send loop emits records in FIFO order, so the
+                    # round closes once every frame is on the wire.
+                    last = i_item == len(items) - 1
                     self._completion_put(
-                        ("ready", client, batch, responses[id(item)])
+                        ("ready", client, batch, responses[id(item)],
+                         (rt, [self._batch_desc(it2[2]) for it2 in items])
+                         if last else None)
+                    )
+            if self._inline_complete or deferred:
+                if not self._round_thread_suppressed():
+                    self.tracer.finish_round(
+                        rt, [self._batch_desc(it[2]) for it in items]
                     )
 
         if deferred:
@@ -2777,6 +2914,26 @@ class _ClientHandler:
                     self.send(
                         wire.MSG_STATUS_REPLY,
                         json.dumps(self.service.status()).encode(),
+                    )
+                elif msg_type == wire.MSG_TRACE:
+                    # A malformed diagnostic request must never kill
+                    # this read loop (it would tear down every flow on
+                    # the shim connection): any parse/shape problem
+                    # degrades to the defaults.
+                    try:
+                        req = json.loads(payload.decode()) if payload else {}
+                        n = int(req.get("n", 100))
+                        kind = req.get("kind")
+                        if kind is not None:
+                            kind = str(kind)
+                    except (ValueError, TypeError, AttributeError,
+                            UnicodeDecodeError):
+                        n, kind = 100, None
+                    self.send(
+                        wire.MSG_TRACE_REPLY,
+                        json.dumps(
+                            self.service.trace_dump(n, kind)
+                        ).encode(),
                     )
                 else:
                     log.warning("unknown message type %d", msg_type)

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import socket
 import struct
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -66,6 +67,13 @@ MSG_VERDICT_MULTI = 13
 # — the fail-closed alternative to a silent queue hang.  Old clients
 # (incl. the native shim) keep sending plain DATA_BATCH.
 MSG_DATA_BATCH_DL = 14
+# Latency-trace dump: request carries optional JSON
+# ``{"n": <max spans>, "kind": "sample"|"slow"|"shed"}``; the reply is
+# JSON ``{"spans": [...], "latency": {...}}`` from the service's
+# verdict tracer (sidecar/trace.py) — the wire surface behind
+# `cilium sidecar trace`.
+MSG_TRACE = 15
+MSG_TRACE_REPLY = 16
 
 # OnIO op capacity per verdict entry (reference: cilium_proxylib.cc:199).
 MAX_OPS_PER_ENTRY = 16
@@ -339,7 +347,11 @@ def unpack_data_batch(payload: bytes) -> DataBatch:
     off += n
     lengths = np.frombuffer(payload, "<u4", n, off)
     off += 4 * n
-    return DataBatch(seq, conn_ids, flags, lengths, payload[off:])
+    # Ingress stamp, threaded from the wire seam: everything downstream
+    # (queue-age shedding, the latency tracer's queue/e2e stages) is
+    # anchored at frame decode, not at some later submit point.
+    return DataBatch(seq, conn_ids, flags, lengths, payload[off:],
+                     arrival=time.monotonic())
 
 
 def pack_data_batch_dl(
@@ -401,7 +413,9 @@ def unpack_data_matrix(payload: bytes) -> MatrixBatch:
     lengths = np.frombuffer(payload, "<u4", n, off)
     off += 4 * n
     rows = np.frombuffer(payload, "u1", n * width, off).reshape(n, width)
-    return MatrixBatch(seq, width, conn_ids, lengths, rows, flags)
+    # Ingress stamp — see unpack_data_batch.
+    return MatrixBatch(seq, width, conn_ids, lengths, rows, flags,
+                       arrival=time.monotonic())
 
 
 # --- VERDICT_BATCH -------------------------------------------------------

@@ -83,8 +83,32 @@ DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10
 )
 
+# Microsecond-scale buckets (seconds) for the verdict-path stage
+# histograms: DEFAULT_BUCKETS starts at 5ms, which is useless against a
+# <1ms p99 target — every observation would land in the first bucket.
+# 1µs resolution at the bottom, 100ms at the top (anything slower is a
+# stall, not a latency distribution).
+MICRO_BUCKETS = (
+    1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5,
+    1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+    1e-2, 2.5e-2, 5e-2, 0.1,
+)
+
+# Sub-millisecond-to-seconds buckets for end-to-end verdict latency:
+# the budgeted region (<1ms) keeps 50µs resolution; the tail out to
+# 10s exists to see shed/stall behavior, not to be lived in.
+SUBMS_BUCKETS = (
+    5e-5, 1e-4, 2.5e-4, 5e-4, 7.5e-4, 1e-3, 2.5e-3, 5e-3,
+    1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+
 
 class Histogram:
+    """Prometheus-style histogram.  ``observe`` is O(1) — one bisect
+    plus one bucket increment under the mutex (it sits on the verdict
+    hot path, once per stage per ROUND); the cumulative-bucket
+    semantics the text format requires are computed at collect time."""
+
     def __init__(
         self, name: str, help_: str, label_names: tuple = (),
         buckets: tuple = DEFAULT_BUCKETS,
@@ -93,19 +117,20 @@ class Histogram:
         self.help = help_
         self.label_names = label_names
         self.buckets = tuple(sorted(buckets))
+        # Per-bucket (NON-cumulative) counts; overflow (> last bound)
+        # lives only in _totals (the +Inf bucket).
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
         self._mutex = threading.Lock()
 
     def observe(self, value: float, *label_values) -> None:
+        j = bisect_left(self.buckets, value)
         with self._mutex:
-            counts = self._counts.setdefault(
-                label_values, [0] * len(self.buckets)
-            )
-            # Cumulative buckets: value counts into every bucket with
-            # bound >= value (le is inclusive).
-            for j in range(bisect_left(self.buckets, value), len(self.buckets)):
+            counts = self._counts.get(label_values)
+            if counts is None:
+                counts = self._counts[label_values] = [0] * len(self.buckets)
+            if j < len(counts):
                 counts[j] += 1
             self._sums[label_values] = self._sums.get(label_values, 0.0) + value
             self._totals[label_values] = self._totals.get(label_values, 0) + 1
@@ -113,25 +138,56 @@ class Histogram:
     def get_count(self, *label_values) -> int:
         return self._totals.get(label_values, 0)
 
+    def get_sum(self, *label_values) -> float:
+        return self._sums.get(label_values, 0.0)
+
+    def quantile(self, q: float, *label_values) -> float | None:
+        """Upper bucket bound at quantile ``q`` (conservative — the true
+        value is <= the returned bound unless it overflowed the last
+        bucket, in which case the last bound is returned).  None when
+        nothing was observed."""
+        with self._mutex:
+            total = self._totals.get(label_values, 0)
+            if not total:
+                return None
+            counts = list(self._counts.get(label_values, ()))
+        target = q * total
+        running = 0
+        for j, b in enumerate(self.buckets):
+            running += counts[j] if j < len(counts) else 0
+            if running >= target:
+                return b
+        return self.buckets[-1] if self.buckets else None
+
     def collect(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
-        for lv in sorted(self._totals):
-            counts = self._counts[lv]
+        with self._mutex:
+            snap = {
+                lv: (list(self._counts.get(lv, ())), self._sums.get(lv, 0.0),
+                     self._totals[lv])
+                for lv in self._totals
+            }
+        for lv in sorted(snap):
+            counts, sum_, total = snap[lv]
+            running = 0
             for j, b in enumerate(self.buckets):
+                # Cumulative buckets: le is inclusive, every bucket
+                # counts all observations <= its bound.
+                running += counts[j] if j < len(counts) else 0
                 labels = _fmt_labels(
                     self.label_names + ("le",), lv + (f"{b:g}",)
                 )
-                yield f"{self.name}_bucket{labels} {counts[j]}"
+                yield f"{self.name}_bucket{labels} {running}"
             labels_inf = _fmt_labels(self.label_names + ("le",), lv + ("+Inf",))
-            yield f"{self.name}_bucket{labels_inf} {self._totals[lv]}"
+            yield f"{self.name}_bucket{labels_inf} {total}"
             yield (
                 f"{self.name}_sum{_fmt_labels(self.label_names, lv)} "
-                f"{self._sums[lv]:g}"
+                f"{sum_:g}"
             )
             yield (
                 f"{self.name}_count{_fmt_labels(self.label_names, lv)} "
-                f"{self._totals[lv]}"
+                f"{total}"
             )
 
 
@@ -253,4 +309,54 @@ FlowBufferOverflows = registry.counter(
     "Flows dropped for exceeding the retained-bytes cap without a "
     "frame boundary (typed protocol-error DROP + close)",
     ("proto",),
+)
+
+# Verdict-path latency decomposition (sidecar/trace.py).  Stage
+# histograms are observed once per STAGE per dispatch ROUND (amortized
+# — never per entry), labeled by serving path:
+#   vec    — vectorized device path (matrix/vec rounds)
+#   oracle — entrywise slow path (engines + in-process parsers)
+#   host   — quarantine host-fallback rounds
+#   shed   — typed SHED (queue_full / deadline / stall)
+VerdictStageSeconds = registry.histogram(
+    "verdict_stage_seconds",
+    "Per-round verdict latency by stage: queue (admit->pop), "
+    "batch_form, device_submit (host-side dispatch), device (fenced "
+    "readback), drain, send",
+    ("stage", "path"),
+    buckets=MICRO_BUCKETS,
+)
+VerdictE2ESeconds = registry.histogram(
+    "verdict_e2e_seconds",
+    "End-to-end verdict latency (wire ingress -> verdict frame "
+    "written), one observation per wire batch",
+    ("path",),
+    buckets=SUBMS_BUCKETS,
+)
+VerdictBatchOccupancy = registry.gauge(
+    "verdict_batch_occupancy",
+    "Entries in the last dispatch round / configured batch capacity",
+)
+DeviceBusyFraction = registry.gauge(
+    "verdict_device_busy_fraction",
+    "Fraction of wall-clock spent in the device stage (fenced "
+    "submit->complete), windowed over the last ~1s of rounds",
+)
+VerdictTraceSpans = registry.counter(
+    "verdict_trace_spans_total",
+    "Per-entry verdict spans captured by the trace ring "
+    "(sample = 1-in-N, slow = exceeded the slow threshold, "
+    "shed = typed SHED exemplar)",
+    ("kind",),
+)
+
+# Kvstore traffic/fencing counters bridged from KvstoreCounters
+# (kvstore/net.py): every named event increments here too, so the
+# store's failure/fencing behavior shows up in /metrics instead of
+# only in status RPCs.
+KvstoreEvents = registry.counter(
+    "kvstore_events_total",
+    "Kvstore server/client event counters (fencing, replication, "
+    "transport failures) bridged from kvstore/net.py KvstoreCounters",
+    ("scope", "event"),
 )

@@ -187,6 +187,21 @@ class DaemonConfig:
     # matching the reference's bounded retained-data contract.
     max_flow_buffer: int = 1 << 20
 
+    # Verdict-path latency decomposition (sidecar/trace.py).
+    # Always-on per-round stage histograms + occupancy/busy gauges
+    # (False removes the metric observes; the bench's instrumentation-
+    # disabled baseline — stamps themselves are ~ns and stay on).
+    trace_stage_metrics: bool = True
+    # 1-in-N per-entry span sampling into the trace ring (0 disables
+    # sampling; slow exemplars are captured regardless).
+    trace_sample_every: int = 4096
+    # End-to-end latency above which a wire batch becomes a slow
+    # exemplar (monitor event + accesslog annotation + ring).  0 makes
+    # EVERY batch an exemplar — the e2e-test/forensics setting.
+    trace_slow_ms: float = 50.0
+    # Span ring capacity (bounded; oldest spans are evicted).
+    trace_ring: int = 512
+
     # Modes
     dry_mode: bool = False  # reference: DryMode, pkg/endpoint/bpf.go:510
     restore_state: bool = True
@@ -232,6 +247,14 @@ class DaemonConfig:
             or self.max_flow_buffer < 0
         ):
             raise ValueError("containment thresholds must be non-negative")
+        if (
+            self.trace_sample_every < 0
+            or self.trace_slow_ms < 0
+            or self.trace_ring <= 0
+        ):
+            raise ValueError(
+                "trace knobs must be non-negative (ring positive)"
+            )
 
 
 # Global config (reference: option.Config singleton).

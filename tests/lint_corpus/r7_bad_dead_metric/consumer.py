@@ -1,0 +1,8 @@
+"""Sibling consumer: references LiveCounter (so only DeadGauge is a
+finding)."""
+
+from . import metrics  # noqa: F401 — corpus file, never imported
+
+
+def record():
+    metrics.LiveCounter.inc()

@@ -1,0 +1,17 @@
+"""KNOWN-BAD corpus (R7, with sibling consumer.py): DeadGauge is
+registered but never referenced outside this file — it exports a
+permanently-zero series that dashboards read as "nothing is wrong"."""
+
+
+class _Registry:
+    def counter(self, name, help_, label_names=()):
+        return object()
+
+    def gauge(self, name, help_, label_names=()):
+        return object()
+
+
+registry = _Registry()
+
+LiveCounter = registry.counter("live_total", "incremented by consumer.py")
+DeadGauge = registry.gauge("dead_gauge", "never referenced anywhere")  # EXPECT[R7]

@@ -1,0 +1,28 @@
+"""KNOWN-BAD corpus (R7): Histogram.observe per ENTRY inside the
+dispatch hot loop — the latency-decomposition contract is one observe
+per stage per ROUND.  Includes the two guard-dodging shapes the first
+rule cut missed: an observe in the ELSE branch of a sample guard (runs
+on every un-sampled iteration), and a guard OUTSIDE the loop (does not
+rate-limit the per-entry observes inside it)."""
+
+LATENCY = None  # stands in for a Histogram
+SAMPLE_EVERY = 1024
+
+
+def process(items, now):
+    for item in items:
+        LATENCY.observe(now - item.arrival)  # EXPECT[R7]
+
+
+def process_else_branch(items, now, sampled):
+    for item in items:
+        if sampled:
+            pass
+        else:
+            LATENCY.observe(now - item.arrival)  # EXPECT[R7]
+
+
+def process_outer_guard(items, now, slow):
+    if slow:
+        for item in items:
+            LATENCY.observe(now - item.arrival)  # EXPECT[R7]

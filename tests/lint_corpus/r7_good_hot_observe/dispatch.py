@@ -1,0 +1,14 @@
+"""KNOWN-GOOD twin of r7_bad_hot_observe: the per-round observe sits
+outside the loop, and the in-loop observe is sample-guarded."""
+
+LATENCY = None  # stands in for a Histogram
+SAMPLE_EVERY = 1024
+
+
+def process(items, now):
+    oldest = now
+    for i, item in enumerate(items):
+        oldest = min(oldest, item.arrival)
+        if i % SAMPLE_EVERY == 0:
+            LATENCY.observe(now - item.arrival)
+    LATENCY.observe(now - oldest)

@@ -1,0 +1,8 @@
+"""Sibling consumer referencing every registered metric."""
+
+from . import metrics  # noqa: F401 — corpus file, never imported
+
+
+def record(dt):
+    metrics.LiveCounter.inc()
+    metrics.LiveHistogram.observe(dt)

@@ -1,0 +1,16 @@
+"""KNOWN-GOOD twin of r7_bad_dead_metric: every registration is
+referenced by the sibling consumer."""
+
+
+class _Registry:
+    def counter(self, name, help_, label_names=()):
+        return object()
+
+    def histogram(self, name, help_, label_names=(), buckets=()):
+        return object()
+
+
+registry = _Registry()
+
+LiveCounter = registry.counter("live_total", "incremented by consumer.py")
+LiveHistogram = registry.histogram("live_seconds", "observed by consumer.py")

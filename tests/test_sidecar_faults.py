@@ -725,9 +725,9 @@ def test_send_loop_suppresses_only_shed_rounds(tmp_path):
     # earlier, records still queued) and 8 (replacement worker) were
     # never shed.
     svc.dispatcher._shed_rounds.add(7)
-    svc._sends.put(([(6, ("ready", probe, batches[0], []))], None, 0))
-    svc._sends.put(([(7, ("ready", probe, batches[1], []))], None, 0))
-    svc._sends.put(([(8, ("ready", probe, batches[2], []))], None, 0))
+    svc._sends.put(([(6, ("ready", probe, batches[0], [], None))], None, 0))
+    svc._sends.put(([(7, ("ready", probe, batches[1], [], None))], None, 0))
+    svc._sends.put(([(8, ("ready", probe, batches[2], [], None))], None, 0))
     svc._sends.put(None)
     t.join(5)
     a_sock.close()
@@ -1122,3 +1122,129 @@ def test_guard_deferred_failures_hold_streak_across_rounds():
         g2.round_start()
         g2.record_ok()
     assert not g2.quarantined
+
+
+# --- latency decomposition across the degradation ladder -------------------
+
+def test_stage_histograms_follow_degradation_ladder(tmp_path, fault_model):
+    """PR 4 acceptance: stage histograms and trace exemplars carry the
+    correct serving-path label at every rung of the PR 2 ladder —
+    vec (device vectorized) → oracle (entrywise slow path) →
+    shed (typed SHED under a wire deadline) → host (quarantine
+    fallback)."""
+    from cilium_tpu.utils import metrics as m
+
+    svc = _service(
+        tmp_path, "ladder",
+        device_call_timeout_s=10.0,  # no deposal: the stall is brief
+        shed_queue_age_ms=0.0,
+        trace_slow_ms=0.0,  # every answered batch leaves an exemplar
+        trace_sample_every=0,
+    )
+    client = SidecarClient(svc.socket_path, timeout=60.0)
+    paths = ("vec", "oracle", "host", "shed")
+
+    def e2e_counts():
+        return {p: m.VerdictE2ESeconds.get_count(p) for p in paths}
+
+    def stage_counts(stage):
+        return {p: m.VerdictStageSeconds.get_count(stage, p)
+                for p in paths}
+
+    try:
+        _, shim = _open_conn(client, 9301)
+        model = fault_model[0]
+        base = e2e_counts()
+        base_q = stage_counts("queue")
+
+        # Rung 1 — vec: a single complete frame rides the vectorized
+        # device path.
+        _shim_run(client, shim, [b"READ /public/ladder.txt\r\n"])
+        _wait(lambda: e2e_counts()["vec"] > base["vec"], 10,
+              "vec e2e histogram")
+        _wait(lambda: stage_counts("device")["vec"] > 0, 10,
+              "vec device stage")
+
+        # Rung 2 — oracle: a pipelined (two-frame) entry takes the
+        # entrywise slow path, no quarantine.
+        _shim_run(client, shim, [PIPELINED])
+        _wait(lambda: e2e_counts()["oracle"] > base["oracle"], 10,
+              "oracle e2e histogram")
+
+        # Rung 3 — shed: a deadline-stamped entry queued behind a
+        # stalled round sheds typed, labeled shed.
+        model.stall.set()
+        results = {}
+
+        def slow_req():
+            r, _ = client._on_data_rpc(
+                shim.conn_id, False, False, PIPELINED
+            )
+            results["slow"] = r
+
+        t = threading.Thread(target=slow_req)
+        t.start()
+        time.sleep(0.1)  # the stalled round is now in-process
+        res, shim_b = client.new_connection(
+            1, "r2d2", 9302, True, 1, 2, "1.1.1.1:1", "2.2.2.2:80",
+            "sidecar-pol",
+        )
+        assert res == int(FilterResult.OK)
+
+        def dl_req():
+            r, _ = client._on_data_rpc(
+                shim_b.conn_id, False, False, b"HALT\r\n",
+                deadline_ms=30.0,
+            )
+            results["dl"] = r
+
+        tb = threading.Thread(target=dl_req)
+        tb.start()
+        time.sleep(0.4)
+
+        model.stall.clear()
+        t.join(10.0)
+        tb.join(10.0)
+        assert not t.is_alive() and not tb.is_alive()
+        assert results["dl"] == int(FilterResult.SHED)
+        _wait(lambda: e2e_counts()["shed"] > base["shed"], 10,
+              "shed e2e histogram")
+
+        # Rung 4 — host: quarantine (as a real stall would) with the
+        # model re-wedged so traffic-driven probes hang and the
+        # quarantine HOLDS; the fallback serves bit-identically and
+        # its rounds are labeled host.
+        model.stall.set()
+        svc.guard.record_stall("ladder-stall")
+        assert svc.guard.quarantined
+        _shim_run(client, shim, [b"READ /public/fallback.txt\r\n"])
+        _wait(lambda: e2e_counts()["host"] > base["host"], 10,
+              "host e2e histogram")
+        model.stall.clear()
+
+        # Every rung also observed its queue stage...
+        after_q = stage_counts("queue")
+        for p in paths:
+            assert after_q[p] > base_q[p], f"no queue stage for {p}"
+        # ...and left a correctly-labeled exemplar in the trace ring
+        # (slow threshold 0: every answered batch; shed spans carry
+        # their reason).
+        spans = svc.tracer.spans(10_000)
+        seen = {s["path"] for s in spans}
+        assert seen >= set(paths), f"missing exemplar paths: {seen}"
+        shed_spans = [s for s in spans if s["path"] == "shed"]
+        assert shed_spans and shed_spans[0]["kind"] == "shed"
+        assert shed_spans[0]["reason"] == "deadline"
+        assert all(
+            s["stages_us"].get("queue") is not None for s in spans
+        )
+        # Status surfaces the same decomposition per path.
+        lat = svc.status()["latency"]
+        assert set(lat["stages"]) >= set(paths)
+        assert lat["slow_exemplars"] > 0
+    finally:
+        for fm in fault_model:
+            fm.stall.clear()
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()

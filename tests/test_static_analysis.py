@@ -106,6 +106,8 @@ _CORPUS_CASES = [
     "r5_bad",
     "r5_bad_verdict_dispatch.py",
     "r6_bad_thread.py",
+    "r7_bad_dead_metric",
+    "r7_bad_hot_observe",
 ]
 
 _CORPUS_CLEAN = [
@@ -119,6 +121,8 @@ _CORPUS_CLEAN = [
     "r5_good",
     "r5_good_verdict_gate.py",
     "r6_good_thread.py",
+    "r7_good_metrics",
+    "r7_good_hot_observe",
 ]
 
 
@@ -165,6 +169,24 @@ def test_catches_inverted_lock_order():
     active, _ = split_findings(analyze_paths([path]))
     assert any("lock-order inversion" in f.message for f in active)
     assert any("self-deadlock" in f.message for f in active)
+
+
+def test_catches_dead_metric_and_hot_loop_observe():
+    """R7's two halves, pinned by message: a registered-but-
+    unreferenced metric and a per-entry observe in the dispatch hot
+    loop."""
+    path = os.path.join(CORPUS, "r7_bad_dead_metric")
+    active, _ = split_findings(analyze_paths([path]))
+    assert [f.rule for f in active] == ["R7"]
+    assert "DeadGauge" in active[0].message
+    assert "permanently-zero" in active[0].message
+
+    # Three shapes: plain per-entry observe, observe in the ELSE branch
+    # of a sample guard, and a guard OUTSIDE the loop.
+    path = os.path.join(CORPUS, "r7_bad_hot_observe")
+    active, _ = split_findings(analyze_paths([path]))
+    assert [f.rule for f in active] == ["R7", "R7", "R7"]
+    assert all("hot loop" in f.message for f in active)
 
 
 def test_pragma_in_string_neither_suppresses_nor_flags():
@@ -249,7 +271,7 @@ def test_cli_fails_closed_on_zero_python_files(tmp_path, capsys):
 def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule in ("R1", "R2", "R3", "R4", "R5", "R6"):
+    for rule in ("R1", "R2", "R3", "R4", "R5", "R6", "R7"):
         assert rule in out
 
 
