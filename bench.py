@@ -1564,6 +1564,145 @@ def bench_verdict_trace_overhead():
     }
 
 
+def bench_flow_observe_overhead():
+    """Cost of always-on flow records + device-side rule attribution
+    (PR 5): the flow observability layer rides the exact vec hot path,
+    so it must prove its own overhead like verdict_trace_overhead did
+    for the stage metrics.
+
+    Method (same `_pipelined_rate` marginal/fence harness): the device
+    term is measured directly — the ATTRIBUTED model call (verdict +
+    first-match argmax fused) vs the plain call at a realistic round
+    size; the host term is the per-round flow-record emission
+    (one columnar add_round of F entries: verdict/rule arrays, metric
+    aggregation, ring append) over 20k rounds.  Implied throughput
+    ratio = attributed+recorded rate vs plain rate; the assertion
+    bounds the loss at <2%.  Conservative like the tracer bench: the
+    denominator excludes the wire/response work a real round also
+    pays."""
+    from cilium_tpu.flowlog import FlowLog
+    from cilium_tpu.models.r2d2 import (
+        build_r2d2_model,
+        r2d2_verdicts,
+        r2d2_verdicts_attr,
+    )
+    from cilium_tpu.proxylib import (
+        NetworkPolicy,
+        PortNetworkPolicy,
+        PortNetworkPolicyRule,
+        find_instance,
+        open_module,
+        reset_module_registry,
+    )
+
+    policy_cfg = NetworkPolicy(
+        name="bench-observe",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=80,
+                rules=[
+                    PortNetworkPolicyRule(
+                        l7_proto="r2d2",
+                        l7_rules=[
+                            {"cmd": "READ", "file": "/public/.*"},
+                            {"cmd": "HALT"},
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+    reset_module_registry()
+    mod = open_module([], True)
+    ins = find_instance(mod)
+    ins.policy_update([policy_cfg])
+    model = build_r2d2_model(
+        ins.policy_map()["bench-observe"], ingress=True, port=80
+    )
+    rng = random.Random(17)
+    F, L = 2048, 64  # a realistic aggregated-round size
+    data = np.zeros((F, L), np.uint8)
+    lengths = np.zeros((F,), np.int32)
+    for i in range(F):
+        m = f"READ /public/f{rng.randrange(1000)}.txt\r\n".encode()
+        data[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lengths[i] = len(m)
+    remotes = np.ones((F,), np.int32)
+    rate_plain = _pipelined_rate(
+        r2d2_verdicts, (model, data, lengths, remotes), F
+    )
+    round_plain = F / rate_plain
+
+    # Device term: the attributed call's MARGINAL cost over the plain
+    # call, from PAIRED timed windows on device-staged args — each
+    # trial times attr and plain back-to-back, so slow host/tunnel
+    # drift cancels inside the pair, and the minimum over 5 paired
+    # differences (floored at 0) is the honest reading: any stall only
+    # inflates a difference.  Two independent _pipelined_rate
+    # measurements were tried first and rejected: their run-to-run
+    # variance (several % on the tunneled chip) lands directly in the
+    # subtraction and flaked the 2% assertion at a spurious 3.1%.
+    import jax
+
+    dev_args = tuple(jax.device_put(a) for a in (data, lengths, remotes))
+
+    def timed(fn) -> float:
+        return _timed_calls(fn, (model, *dev_args), 8) / 8
+
+    jit_plain = jax.jit(r2d2_verdicts)
+    jit_attr = jax.jit(r2d2_verdicts_attr)
+    _fence(jit_plain(model, *dev_args))
+    _fence(jit_attr(model, *dev_args))
+    attr_extra = min(
+        timed(jit_attr) - timed(jit_plain) for _ in range(5)
+    )
+    attr_extra = max(attr_extra, 0.0)
+
+    def ring_cost() -> float:
+        fl = FlowLog(capacity=8192)
+        conn_ids = np.arange(F, dtype=np.int64)
+        codes = np.zeros(F, np.int8)
+        codes[::7] = 1
+        rules = np.zeros(F, np.int32)
+        rules[::7] = -1
+        kinds = model.match_kinds
+        K = 20_000
+        t0 = time.perf_counter()
+        for _ in range(K):
+            fl.add_round("vec", conn_ids, codes, rules, kinds=kinds)
+        return (time.perf_counter() - t0) / K
+
+    # Best-of-3: a scheduler stall only ever INFLATES the cost.
+    rec_cost = min(ring_cost() for _ in range(3))
+    round_attr = round_plain + attr_extra
+    rate_on = F / (round_attr + rec_cost)
+    rate_off = rate_plain
+    overhead = max(1.0 - rate_on / rate_off, 0.0)
+    print(
+        f"bench flow_observe_overhead: round_plain={round_plain * 1e6:.1f}us "
+        f"attr_extra={attr_extra * 1e6:.2f}us "
+        f"record={rec_cost * 1e6:.2f}us/round "
+        f"implied {rate_off:,.0f}/s -> {rate_on:,.0f}/s "
+        f"({overhead:.4%} loss)",
+        file=sys.stderr,
+    )
+    # The acceptance contract: always-on flow records + attribution
+    # cost <2% throughput vs disabled.
+    assert overhead < 0.02, (
+        f"flow-observe overhead {overhead:.3%} exceeds the 2% budget"
+    )
+    reset_module_registry()
+    return {
+        "overhead_pct": overhead * 100.0,
+        "round_plain_us": round_plain * 1e6,
+        "round_attr_us": round_attr * 1e6,
+        "record_us": rec_cost * 1e6,
+        "implied_rate_on": rate_on,
+        "implied_rate_off": rate_off,
+    }
+
+
 def run_one(which: str) -> None:
     import jax
 
@@ -1740,6 +1879,20 @@ def run_one(which: str) -> None:
             implied_rate_off=round(out["implied_rate_off"]),
             budget_pct=2.0,
         )
+    elif which == "flow_observe_overhead":
+        out = bench_flow_observe_overhead()
+        # Smaller is better; same scoring shape as the trace-overhead
+        # config.  The <2% contract is asserted inside the bench.
+        _emit(
+            "flow_observe_overhead_pct", out["overhead_pct"], "%",
+            2.0 / max(out["overhead_pct"], 0.1),
+            round_plain_us=round(out["round_plain_us"], 1),
+            round_attr_us=round(out["round_attr_us"], 1),
+            record_us=round(out["record_us"], 2),
+            implied_rate_on=round(out["implied_rate_on"]),
+            implied_rate_off=round(out["implied_rate_off"]),
+            budget_pct=2.0,
+        )
     elif which == "mixed":
         out = bench_mixed()
         _emit(
@@ -1788,6 +1941,7 @@ CONFIGS = (
     "http", "kafka", "cassandra", "memcached", "latency",
     "latency_colocated", "mixed", "datapath", "stress",
     "kvstore_failover", "verdict_overload", "verdict_trace_overhead",
+    "flow_observe_overhead",
     "r2d2",
 )
 
@@ -1913,7 +2067,8 @@ def _check_regressions(lines: list[str],
                       "sidecar_seam_p99_minus_null_ms_colocated",
                       "kvstore_failover_write_outage_s",
                       "verdict_overload_p99_ms_at_2x",
-                      "verdict_trace_overhead_pct"}
+                      "verdict_trace_overhead_pct",
+                      "flow_observe_overhead_pct"}
     rc = 0
     seen: set = set()
     for line in lines:

@@ -16,6 +16,13 @@ Two halves:
   contract is one observe per stage per ROUND; a loop observe must be
   sample-guarded (an enclosing ``if`` whose condition mentions
   ``sample``/``slow`` or uses a modulo) or carry a justified pragma.
+- **Hot-loop flow-record emission.**  Same modules, same reasoning for
+  the flow-record ring (flowlog/ring.py): ``<flowlog>.add(...)`` /
+  ``<flowlog>.append(...)`` inside a loop takes the ring lock per
+  ENTRY.  The emission contract is per-ROUND columnar batches
+  (``add_round``/``add_entries`` — the hot loop builds a plain list,
+  the lock is taken once); a per-entry append must be sample-guarded
+  or carry a justified pragma.
 """
 
 from __future__ import annotations
@@ -110,6 +117,25 @@ def _check_hot_loop_observes(files):
                         "loop — per-entry metric cost on the "
                         "verdict path; record per ROUND or guard "
                         "with sampling",
+                    )
+                )
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("add", "append")
+                and "flowlog" in unparse(node.func.value)
+                .lower().replace("_", "")
+                and loop_depth > 0
+                and not guarded
+            ):
+                findings.append(
+                    Finding(
+                        "R7", path, node.lineno, node.col_offset,
+                        "per-entry flow-record emission inside a "
+                        "dispatch hot loop — the ring lock is taken "
+                        "per ENTRY; build a plain list and emit one "
+                        "add_round/add_entries per ROUND (or guard "
+                        "with sampling)",
                     )
                 )
             if isinstance(node, ast.If) and _is_sample_guard(node.test):

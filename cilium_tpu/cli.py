@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from .api import ApiClient, ApiError
 from .utils import defaults
@@ -520,6 +521,98 @@ def cmd_sidecar_trace(args):
     return 0
 
 
+def _format_flow_record(rec: dict) -> str:
+    """One human line per flow record: who -> whom, verdict, serving
+    path, and the deciding rule (`rule=<row> (<match kind>)`)."""
+    import time as _time
+
+    ts = _time.strftime("%H:%M:%S", _time.localtime(rec.get("ts", 0)))
+    arrow = "->" if rec.get("ingress", True) else "<-"
+    src = rec.get("src_identity", "?")
+    dst = rec.get("dst_identity", "?")
+    where = (
+        f"{rec.get('proto', '?')}:{rec.get('dport', '?')}"
+        + (f" policy={rec['policy']}" if rec.get("policy") else "")
+    )
+    rule = rec.get("rule_id", -1)
+    attr = (
+        f" rule={rule} ({rec.get('match_kind') or '?'})"
+        if rule >= 0 else ""
+    )
+    reason = f" reason={rec['reason']}" if rec.get("reason") else ""
+    return (
+        f"{ts} [{rec.get('path', '?')}] {rec.get('verdict', '?').upper()}: "
+        f"identity {src} {arrow} {dst} conn={rec.get('conn_id')} "
+        f"{where}{attr}{reason}"
+    )
+
+
+def cmd_observe(args):
+    """Per-flow verdict records from the verdict service's flow log:
+    why did flow X get verdict Y, and which rule decided it — the
+    `cilium observe` / Hubble analog over MSG_OBSERVE."""
+    from .sidecar import SidecarClient, SidecarUnavailable
+
+    try:
+        cl = SidecarClient(args.address, timeout=3.0)
+    except OSError as e:
+        print(f"Error: cannot reach verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    filters = dict(
+        verdict=args.verdict, path=args.path,
+        rule=args.rule, conn=args.conn,
+    )
+    try:
+        if not args.follow:
+            out = cl.observe(n=args.last, **filters)
+            records = out.get("records", [])
+            if args.json:
+                print(json.dumps(out, indent=2))
+                return 0
+            stats = out.get("stats", {})
+            if stats.get("disabled"):
+                print("flow observability is disabled "
+                      "(flow_observe=False)", file=sys.stderr)
+                return 1
+            for rec in reversed(records):  # oldest first for reading
+                print(_format_flow_record(rec))
+            print(f"{len(records)} record(s) "
+                  f"({stats.get('records_total', 0)} total, ring "
+                  f"{stats.get('records', 0)}/{stats.get('capacity', 0)})")
+            return 0
+        # Follow mode: poll with the seq cursor; records stream in
+        # ascending order, each printed exactly once.
+        cursor = None
+        try:
+            while True:
+                out = cl.observe(n=args.last, since=cursor, **filters)
+                if cursor is None and out.get("stats", {}).get("disabled"):
+                    print("flow observability is disabled "
+                          "(flow_observe=False)", file=sys.stderr)
+                    return 1
+                if cursor is None:
+                    # Start at the CURRENT tail: follow shows new
+                    # records, not history (use a plain query for that).
+                    cursor = out.get("stats", {}).get("next_seq", 0) - 1
+                    continue
+                for rec in out.get("records", []):
+                    if args.json:
+                        print(json.dumps(rec))
+                    else:
+                        print(_format_flow_record(rec))
+                    cursor = max(cursor, rec["seq"])
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+    except (SidecarUnavailable, TimeoutError) as e:
+        print(f"Error: verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        cl.close()
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cilium-tpu",
@@ -705,6 +798,31 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None, help="only spans of this kind")
     x.add_argument("--json", action="store_true")
     x.set_defaults(fn=cmd_sidecar_trace)
+
+    x = sub.add_parser(
+        "observe",
+        help="per-flow verdict records with rule attribution "
+             "(verdict service flow log)",
+    )
+    x.add_argument("--address", required=True,
+                   help="verdict service unix socket path")
+    x.add_argument("--last", type=int, default=20,
+                   help="max records per query")
+    x.add_argument("--verdict",
+                   choices=["Forwarded", "Denied", "Shed", "Error"],
+                   default=None)
+    x.add_argument("--path", default=None,
+                   help="serving path filter (vec|oracle|host|shed|...)")
+    x.add_argument("--rule", type=int, default=None,
+                   help="deciding rule row filter")
+    x.add_argument("--conn", type=int, default=None,
+                   help="connection id filter")
+    x.add_argument("--follow", "-f", action="store_true",
+                   help="stream new records (poll with a seq cursor)")
+    x.add_argument("--interval", type=float, default=0.5,
+                   help="follow poll interval seconds")
+    x.add_argument("--json", action="store_true")
+    x.set_defaults(fn=cmd_observe)
 
     x = sub.add_parser("version")
     x.set_defaults(fn=cmd_version)

@@ -189,6 +189,19 @@ class Daemon:
 
         # Monitor + access log
         self.monitor = Monitor(self.config.monitor_queue_size)
+        # Flow-record ring (flowlog/): the datapath accounting pass and
+        # the daemon-side L7 engines feed it; POLICY-VERDICT monitor
+        # events ride the PolicyVerdictNotification runtime option.
+        from ..flowlog import FlowLog
+
+        self.flowlog = (
+            FlowLog(
+                capacity=self.config.flowlog_ring,
+                opts=self.config.opts,
+                monitor=self.monitor,
+            )
+            if self.config.flow_observe else None
+        )
         self.access_logger = AccessLogger(
             endpoint_lookup=self.endpoint_manager.lookup,
             notify=lambda rec: self.monitor.notify(

@@ -1,33 +1,47 @@
-"""Datapath verdict accounting: metrics counters + monitor notifications.
+"""Datapath verdict accounting: metrics counters + monitor notifications
++ flow records.
 
 The batched analog of the per-packet observability the kernel programs
 emit inline (reference: bpf/lib/metrics.h update_metrics — every packet
 counts into the {reason, direction} metrics map; bpf/lib/drop.h
 send_drop_notify and trace.h send_trace_notify — perf-ring events the
-monitor fans out).  Here one numpy pass over a composed-pipeline output
-dict accounts the whole batch, and a BOUNDED sample of drops is emitted
-as monitor events (the reference rate-limits notifications at the
-perf-ring boundary for the same reason: observability must not cost a
-per-packet host loop).
+monitor fans out; bpf/lib/policy_log.h send_policy_verdict_notify —
+gated by the POLICY_VERDICT_NOTIFY option).  Here one numpy pass over a
+composed-pipeline output dict accounts the whole batch, a BOUNDED
+sample of drops is emitted as monitor events, allowed-verdict
+POLICY-VERDICT events ride the (previously dead)
+``OPTION_POLICY_VERDICT_NOTIFY`` runtime option, and the whole batch
+lands in the flow-record ring as ONE columnar round (flowlog/ring.py)
+— observability must not cost a per-packet host loop.
 """
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
+from ..maps.ctmap import CtMap
 from ..maps.metricsmap import (
     METRIC_DIR_EGRESS,
     MetricsMap,
     REASON_FORWARDED,
 )
+from ..utils import flowdebug
+from ..utils.option import OPTION_POLICY_VERDICT_NOTIFY
 from .ingress import TO_HOST, TO_OVERLAY
 from .pipeline import DROP, FORWARD, TO_PROXY
+
+# Per-flow debug stream, flowdebug-gated (one boolean when disabled) —
+# the datapath twin of pkg/flowdebug consumers in pkg/datapath.
+_flow_log = logging.getLogger("cilium_tpu.datapath.flow")
 
 # Metrics reasons are the NEGATED drop codes (reference: bpf_lxc.c
 # send_drop_notify callers pass -ret into update_metrics).
 DROP_POLICY_REASON = 133  # reference: common.h DROP_POLICY = -133
 
 MAX_DROP_NOTIFICATIONS = 64  # per accounting pass (perf-ring analog cap)
+MAX_VERDICT_NOTIFICATIONS = 64  # allowed-verdict events per pass
 
 
 def account_verdicts(
@@ -39,11 +53,20 @@ def account_verdicts(
     dports=None,
     proto=None,
     src_identity=None,
+    flowlog=None,
+    opts=None,
 ) -> dict:
     """Account one pipeline output batch.
 
     ``out`` is a datapath_verdicts/netdev_verdicts-style dict; packet
     byte ``lengths`` are optional (count-only accounting without them).
+    ``flowlog`` receives the batch as ONE columnar flow-record round
+    (path "datapath", match kind l3/l4, ct state from the pipeline's
+    ``established`` column).  ``opts`` is the runtime OptionMap: with
+    ``PolicyVerdictNotification`` enabled, a bounded sample of ALLOWED
+    verdicts is published as POLICY-VERDICT monitor events alongside
+    the existing drop sample (reference: send_policy_verdict_notify is
+    compiled out unless the option is set).
     Returns {"forwarded": n, "dropped": n, "proxied": n}.
     """
     verdict = np.asarray(out["verdict"])
@@ -60,39 +83,124 @@ def account_verdicts(
     n_fwd = int(fwd.sum())
     n_drp = int(drp.sum())
     n_prx = int(prx.sum())
+
+    # Identity/port context shared by the drop sample, the verdict
+    # sample, and the flow records.
+    ids_dst = out.get("dst_identity")
+    ids_src = out.get("src_identity")
+    # The port the verdict was COMPUTED on: post-DNAT when the
+    # pipeline did service translation.
+    dp_arr = out.get("new_dport", dports)
+    dp = np.asarray(dp_arr) if dp_arr is not None else None
+    pr = np.asarray(proto) if proto is not None else None
+    si = (
+        np.asarray(src_identity) if src_identity is not None
+        else (np.asarray(ids_src) if ids_src is not None else None)
+    )
+    di = np.asarray(ids_dst) if ids_dst is not None else None
+
+    def ctx(i: int) -> tuple[int, int, int, int]:
+        return (
+            int(si[i]) if si is not None else 0,
+            int(di[i]) if di is not None else 0,
+            int(dp[i]) if dp is not None else 0,
+            int(pr[i]) if pr is not None else 0,
+        )
+
     if n_fwd or n_prx:
         # Proxy redirects still forward bytes (toward the proxy).
         metrics.update(
             REASON_FORWARDED, direction, count=n_fwd + n_prx,
             nbytes=int(nbytes[fwd | prx].sum()),
         )
+        if (
+            monitor is not None
+            and opts is not None
+            and opts.get(OPTION_POLICY_VERDICT_NOTIFY)
+            and (
+                flowlog is None
+                or flowlog.monitor is None
+                or flowlog.opts is None
+            )
+        ):
+            # Allowed-verdict sample, option-gated: the reference only
+            # emits policy-verdict events when the endpoint option is
+            # set (policy_log.h POLICY_VERDICT_LOG_FILTER).  Skipped
+            # when a monitor-wired flowlog is recording this batch —
+            # its own POLICY-VERDICT fan-out covers it (emitting both
+            # would double-count every allowed flow).
+            ppt = out.get("proxy_port")
+            pp = np.asarray(ppt) if ppt is not None else None
+            for i in np.flatnonzero(fwd | prx)[:MAX_VERDICT_NOTIFICATIONS]:
+                s, d, port, protonum = ctx(i)
+                monitor.send_verdict(
+                    src_identity=s, dst_identity=d, dport=port,
+                    proto=protonum, allowed=True,
+                    proxy_port=int(pp[i]) if pp is not None else 0,
+                )
     if n_drp:
         metrics.update(
             DROP_POLICY_REASON, direction, count=n_drp,
             nbytes=int(nbytes[drp].sum()),
         )
         if monitor is not None:
-            # Identity context: the egress pipeline carries the
-            # destination identity; the ingress programs carry the
-            # (remote) source identity instead.
-            ids_dst = out.get("dst_identity")
-            ids_src = out.get("src_identity")
-            # The port the verdict was COMPUTED on: post-DNAT when the
-            # pipeline did service translation.
-            dp_arr = out.get("new_dport", dports)
-            dp = np.asarray(dp_arr) if dp_arr is not None else None
-            pr = np.asarray(proto) if proto is not None else None
-            si = (
-                np.asarray(src_identity) if src_identity is not None
-                else (np.asarray(ids_src) if ids_src is not None else None)
-            )
-            di = np.asarray(ids_dst) if ids_dst is not None else None
             for i in np.flatnonzero(drp)[:MAX_DROP_NOTIFICATIONS]:
+                s, d, port, protonum = ctx(i)
                 monitor.send_verdict(
-                    src_identity=int(si[i]) if si is not None else 0,
-                    dst_identity=int(di[i]) if di is not None else 0,
-                    dport=int(dp[i]) if dp is not None else 0,
-                    proto=int(pr[i]) if pr is not None else 0,
-                    allowed=False,
+                    src_identity=s, dst_identity=d, dport=port,
+                    proto=protonum, allowed=False,
                 )
+                flowdebug.log(
+                    _flow_log,
+                    "datapath drop: identity %d -> %d dport %d proto %d",
+                    s, d, port, protonum,
+                )
+    if flowlog is not None and len(verdict):
+        _record_batch(flowlog, out, verdict, fwd | prx, drp, si, di, dp, pr)
     return {"forwarded": n_fwd, "dropped": n_drp, "proxied": n_prx}
+
+
+def _record_batch(flowlog, out, verdict, allowed, dropped,
+                  si, di, dp, pr) -> None:
+    """One columnar flow-record round for the whole batch.  Packet-
+    layer verdicts have no L7 rule row: rule_id is -1 and the match
+    kind column says which layer decided (l4 when a port policy was
+    consulted, l3 otherwise)."""
+    from ..flowlog import (
+        CODE_DENIED,
+        CODE_FORWARDED,
+        MATCH_L3,
+        MATCH_L4,
+        PATH_DATAPATH,
+    )
+
+    sel = allowed | dropped
+    idx = np.flatnonzero(sel)
+    if not len(idx):
+        return
+    n = len(idx)
+    codes = np.where(dropped[idx], CODE_DENIED, CODE_FORWARDED).astype(np.int8)
+    kind = MATCH_L4 if dp is not None else MATCH_L3
+    cols = {
+        "match_kind": [kind] * n,
+        "drop_reason": np.where(
+            dropped[idx], DROP_POLICY_REASON, 0
+        ).astype(np.int32),
+    }
+    if si is not None:
+        cols["src_identity"] = si[idx]
+    if di is not None:
+        cols["dst_identity"] = di[idx]
+    if dp is not None:
+        cols["dport"] = dp[idx]
+    if pr is not None:
+        cols["proto"] = pr[idx]
+    est = out.get("established")
+    if est is not None:
+        cols["ct_state"] = CtMap.state_codes(np.asarray(est)[idx])
+    flowlog.add_round(
+        PATH_DATAPATH,
+        idx.astype(np.int64),  # batch row index stands in for a conn id
+        codes,
+        cols=cols,
+    )

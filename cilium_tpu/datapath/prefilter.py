@@ -12,7 +12,9 @@ import ipaddress
 import threading
 from typing import Optional
 
-from ..ops.lpm import DeviceLpm, build_lpm
+import numpy as np
+
+from ..ops.lpm import DeviceLpm, build_lpm, lpm_lookup
 
 
 class PreFilter:
@@ -77,3 +79,44 @@ class PreFilter:
                 )
                 self._dirty = False
             return self._device_v6 if v6 else self._device_v4
+
+    def filter_batch(self, saddr, v6: bool = False, flowlog=None,
+                     monitor=None) -> np.ndarray:
+        """XDP source-drop pass over a batch (reference: bpf_xdp.c
+        check_v4 — drop any packet whose source matches the deny LPM).
+        ``saddr``: for v4 one [F] int32 word array, for v6 the four
+        word arrays stacked [4, F].  Returns the [F] bool KEEP mask.
+
+        Observability per BATCH, not per packet: drops land in the
+        flow-record ring as one columnar round (path "xdp", match kind
+        l3) and a bounded sample fans out as monitor drop events."""
+        lpm = self.device_lpm(v6)
+        words = (
+            [np.asarray(saddr[w]) for w in range(4)] if v6
+            else [np.asarray(saddr)]
+        )
+        found, _value, _plen = lpm_lookup(lpm, *words)
+        dropped = np.asarray(found)
+        keep = ~dropped
+        idx = np.flatnonzero(dropped)
+        if len(idx) and monitor is not None:
+            for i in idx[:64]:  # perf-ring analog cap
+                monitor.send_verdict(
+                    src_identity=0, dst_identity=0, dport=0, proto=0,
+                    allowed=False,
+                )
+        if len(idx) and flowlog is not None:
+            from ..flowlog import CODE_DENIED, MATCH_L3, PATH_XDP
+
+            cols = {
+                "match_kind": [MATCH_L3] * len(idx),
+                "src_addr_word": words[0][idx].astype(np.int64),
+            }
+            flowlog.add_round(
+                PATH_XDP,
+                idx.astype(np.int64),  # batch row index as flow handle
+                np.full(len(idx), CODE_DENIED, np.int8),
+                reason="prefilter",
+                cols=cols,
+            )
+        return keep

@@ -21,6 +21,10 @@ TUPLE_F_RELATED = 2
 TUPLE_F_SERVICE = 4
 
 # Lifetimes in seconds (reference: bpf/lib/conntrack.h:31-50).
+CT_STATE_UNKNOWN = 0  # flowlog ct_state codes (flowlog/record.CT_NAMES)
+CT_STATE_NEW = 1
+CT_STATE_ESTABLISHED = 2
+
 CT_DEFAULT_LIFETIME = 21600  # TCP, 6 hours
 CT_DEFAULT_LIFETIME_NONTCP = 60
 TCP_CLOSING_LIFETIME = 10  # CT_DEFAULT_CLOSE_TIMEOUT
@@ -200,6 +204,17 @@ class CtMap:
             self.entries.items(),
             key=lambda kv: (kv[0].daddr, kv[0].saddr, kv[0].dport, kv[0].sport),
         )
+
+    @staticmethod
+    def state_codes(established) -> np.ndarray:
+        """[F] int8 flowlog ct_state codes from a pipeline batch's
+        ``established`` column: the CT half of a flow record (a verdict
+        on an established flow was admitted at connect time, reference:
+        handle_ipv4 CT_ESTABLISHED path)."""
+        est = np.asarray(established)
+        return np.where(
+            est, CT_STATE_ESTABLISHED, CT_STATE_NEW
+        ).astype(np.int8)
 
     def to_device_arrays(self):
         """Export tuples as column arrays for batched established-checks."""

@@ -46,9 +46,32 @@ class SeamProbe(VerdictModel):
     seam itself adds.  Matches the (complete, msg_len, allow) batch
     model contract."""
 
+    match_kinds: tuple = ("probe",)
+
     def __call__(self, data, lengths, remotes):
         ok = jnp.asarray(lengths) >= 0
         return ok, jnp.asarray(lengths), ok
+
+    def verdicts_attr(self, data, lengths, remotes):
+        ok = jnp.asarray(lengths) >= 0
+        return ok, jnp.asarray(lengths), ok, jnp.zeros_like(
+            jnp.asarray(lengths, jnp.int32)
+        )
+
+
+def first_match(hits: jax.Array, allow: jax.Array) -> jax.Array:
+    """[F] int32 index of the FIRST matching rule row per flow, -1
+    where nothing allowed — the device half of rule attribution.
+
+    Priority order is row order, which the model builders construct in
+    the host oracle's walk order (exact-port rules before wildcard-port
+    rules, matchers within a rule in declaration order), so
+    ``argmax`` over the boolean hit matrix IS the host's first-match
+    semantics.  Rides in the same fused computation as the verdict
+    reduction — no extra device round-trip."""
+    return jnp.where(
+        allow, jnp.argmax(hits, axis=1).astype(jnp.int32), jnp.int32(-1)
+    )
 
 
 def pack_remote_sets(remote_sets: list[frozenset[int]]) -> tuple[np.ndarray, np.ndarray]:

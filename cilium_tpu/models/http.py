@@ -44,7 +44,7 @@ from ..ops.nfa import DeviceNfa, device_nfa, nfa_search_spans
 from ..policy.api import PortRuleHTTP
 from ..regex import compile_patterns
 from ..regex.parse import DOT_BYTES, ParseError, parse
-from .base import ConstVerdict, pack_remote_sets, remote_ok
+from .base import ConstVerdict, first_match, pack_remote_sets, remote_ok
 
 _RE_META = set("\\^$.[]|()*+?{}")
 
@@ -253,6 +253,9 @@ class HttpBatchModel:
     # automaton pass entirely (half the regex-tier cost).
     has_method_rx: bool = False
     has_path_rx: bool = False
+    # Per-rule compiled match kind (literal|regex|nfa) — static aux for
+    # rule attribution labels, never device data.
+    match_kinds: tuple = ()
 
     def tree_flatten(self):
         return (
@@ -262,7 +265,8 @@ class HttpBatchModel:
              self.line_nfa, self.line_rule, self.line_slot,
              self.head_nfa, self.head_rule, self.head_count,
              self.remote_ids, self.any_remote),
-            (self.n_rules, self.has_method_rx, self.has_path_rx),
+            (self.n_rules, self.has_method_rx, self.has_path_rx,
+             self.match_kinds),
         )
 
     @classmethod
@@ -270,10 +274,14 @@ class HttpBatchModel:
         return cls(
             *leaves, n_rules=aux[0],
             has_method_rx=aux[1], has_path_rx=aux[2],
+            match_kinds=aux[3] if len(aux) > 3 else (),
         )
 
     def __call__(self, data, lengths, remotes):
         return http_verdicts(self, data, lengths, remotes)
+
+    def verdicts_attr(self, data, lengths, remotes):
+        return http_verdicts_attr(self, data, lengths, remotes)
 
 
 def build_http_model(
@@ -307,6 +315,23 @@ def build_http_model(
     line_tab = _compile_line_tables(line_patterns, rx_backend)
     head_tab = _compile_line_tables(head_patterns, rx_backend)
 
+    def _tier(tab) -> str:
+        from ..ops.nfa import DeviceNfa as _Nfa
+
+        return "nfa" if isinstance(tab, _Nfa) else "regex"
+
+    # Per-rule match kind for attribution: a rule is "literal" when its
+    # method/path resolved to tier 0/1 and it carries no head patterns;
+    # any automaton involvement labels it by that automaton's backend
+    # ("nfa" dense matmul / "regex" per-pattern DFA), nfa winning when
+    # a rule touches both tables.
+    kinds = ["literal"] * r
+    for i in line_rule:
+        kinds[i] = _tier(line_tab)
+    for i in head_rule:
+        if kinds[i] != "nfa":
+            kinds[i] = _tier(head_tab)
+
     return HttpBatchModel(
         m_needle=jnp.asarray(mn),
         m_len=jnp.asarray(ml),
@@ -331,6 +356,7 @@ def build_http_model(
         n_rules=r,
         has_method_rx=any(s == 0 for s in line_slot),
         has_path_rx=any(s == 1 for s in line_slot),
+        match_kinds=tuple(kinds),
     )
 
 
@@ -385,14 +411,15 @@ def _scatter_or(hits, rule_idx, n_rules):
     return counts > 0
 
 
-@jax.jit
-def http_verdicts(
+def _http_rule_hits(
     model: HttpBatchModel,
     data: jax.Array,  # [F, L] uint8 — complete request heads
     lengths: jax.Array,  # [F] int32 — head length incl. final CRLFCRLF
     remotes: jax.Array,  # [F] int32
 ):
-    """Returns (complete [F] bool, head_len [F] int32, allow [F] bool)."""
+    """Shared tokenize/tier pass; returns (complete [F] bool, head_len
+    [F] int32, hits [F, R] bool) — the per-rule-row hit matrix both
+    reductions (any-allow and first-match attribution) consume."""
     lengths = jnp.asarray(lengths, jnp.int32)
     remotes = jnp.asarray(remotes, jnp.int32)
     r = model.n_rules
@@ -464,8 +491,38 @@ def http_verdicts(
         head_ok = jnp.ones((f, r), bool)
 
     rok = remote_ok(remotes, model.remote_ids, model.any_remote)
-    allow = jnp.any(method_ok & path_ok & head_ok & rok, axis=1)
+    return complete, head_len, method_ok & path_ok & head_ok & rok
+
+
+@jax.jit
+def http_verdicts(
+    model: HttpBatchModel,
+    data: jax.Array,  # [F, L] uint8 — complete request heads
+    lengths: jax.Array,  # [F] int32 — head length incl. final CRLFCRLF
+    remotes: jax.Array,  # [F] int32
+):
+    """Returns (complete [F] bool, head_len [F] int32, allow [F] bool)."""
+    complete, head_len, hits = _http_rule_hits(model, data, lengths, remotes)
+    allow = jnp.any(hits, axis=1)
     return complete, head_len, allow & complete
+
+
+@jax.jit
+def http_verdicts_attr(
+    model: HttpBatchModel,
+    data: jax.Array,
+    lengths: jax.Array,
+    remotes: jax.Array,
+):
+    """http_verdicts plus the deciding rule row: (complete, head_len,
+    allow, rule [F] int32).  ``rule`` is the FIRST matching rule row in
+    the host oracle's walk order (exact-port rules then wildcard, one
+    row per (rule, matcher) — build_http_model_for_port's flattening),
+    or -1 where not allowed; an argmax over the same hit matrix in the
+    same fused pass."""
+    complete, head_len, hits = _http_rule_hits(model, data, lengths, remotes)
+    allow = jnp.any(hits, axis=1) & complete
+    return complete, head_len, allow, first_match(hits, allow)
 
 
 def _first_crlfcrlf(data: jax.Array, lengths: jax.Array) -> jax.Array:

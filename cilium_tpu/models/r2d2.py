@@ -32,7 +32,7 @@ from ..ops.rxsearch import (
 )
 from ..proxylib.parsers.r2d2 import R2d2Rule
 from ..proxylib.policy import CompiledPortRules, PolicyInstance
-from .base import ConstVerdict, VerdictModel, pack_remote_sets, remote_ok
+from .base import ConstVerdict, VerdictModel, first_match, pack_remote_sets, remote_ok
 
 MAX_CMD = 8  # longest r2d2 command is "RESET" (5)
 
@@ -46,20 +46,26 @@ class R2d2BatchModel(VerdictModel):
     cmd_any: jax.Array  # [R] bool
     remote_ids: jax.Array  # [R, MAX_REMOTES] int32
     any_remote: jax.Array  # [R] bool
+    # Per-row compiled match kind (literal|regex|nfa) — static aux used
+    # for rule attribution labels, never device data.
+    match_kinds: tuple = ()
 
     def tree_flatten(self):
         return (
             (self.nfa, self.cmd_needle, self.cmd_len, self.cmd_any,
              self.remote_ids, self.any_remote),
-            None,
+            (self.match_kinds,),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves)
+        return cls(*leaves, match_kinds=aux[0] if aux else ())
 
     def __call__(self, data, lengths, remotes):
         return r2d2_verdicts(self, data, lengths, remotes)
+
+    def verdicts_attr(self, data, lengths, remotes):
+        return r2d2_verdicts_attr(self, data, lengths, remotes)
 
 
 def _collect_rows(rules: CompiledPortRules):
@@ -131,29 +137,32 @@ def build_r2d2_model_from_rows(
         cmd_len[i] = len(b)
         cmd_any[i] = len(b) == 0
 
+    nfa = compile_automaton([r[2] for r in rows])
+    kinds = tuple(
+        "literal" if not file_rx
+        else ("nfa" if isinstance(nfa, DeviceNfa) else "regex")
+        for _, _, file_rx in rows
+    )
     return R2d2BatchModel(
-        nfa=compile_automaton([r[2] for r in rows]),
+        nfa=nfa,
         cmd_needle=jnp.asarray(cmd_needle),
         cmd_len=jnp.asarray(cmd_len),
         cmd_any=jnp.asarray(cmd_any),
         remote_ids=jnp.asarray(packed_ids),
         any_remote=jnp.asarray(any_remote),
+        match_kinds=kinds,
     )
 
 
-@jax.jit
-def r2d2_verdicts(
+def _r2d2_rule_hits(
     model: R2d2BatchModel,
     data: jax.Array,  # [F, L] uint8 — buffered stream per flow
     lengths: jax.Array,  # [F] int32
     remotes: jax.Array,  # [F] int32 — source security identity
 ):
-    """Returns (complete [F] bool, msg_len [F] int32, allow [F] bool).
-
-    msg_len counts the CRLF (the oracle's PASS/DROP byte count,
-    reference: r2d2parser.go:166).  allow is meaningful only where
-    complete.
-    """
+    """Shared frame/tokenize/match pass; returns (complete [F] bool,
+    msg_len [F] int32, hits [F, R] bool) — the per-rule-row hit matrix
+    both reductions (any-allow and first-match attribution) consume."""
     crlf = first_subsequence2(data, lengths, 0x0D, 0x0A)  # [F]
     complete = crlf < lengths
     msg_len = crlf + 2
@@ -172,6 +181,38 @@ def r2d2_verdicts(
     )  # [F, R]
     file_ok = automaton_search_spans(model.nfa, data, file_start, file_end)  # [F, R]
     rem_ok = remote_ok(remotes, model.remote_ids, model.any_remote)  # [F, R]
+    return complete, msg_len, cmd_ok & file_ok & rem_ok
 
-    allow = jnp.any(cmd_ok & file_ok & rem_ok, axis=1)
-    return complete, msg_len, allow
+
+@jax.jit
+def r2d2_verdicts(
+    model: R2d2BatchModel,
+    data: jax.Array,  # [F, L] uint8 — buffered stream per flow
+    lengths: jax.Array,  # [F] int32
+    remotes: jax.Array,  # [F] int32 — source security identity
+):
+    """Returns (complete [F] bool, msg_len [F] int32, allow [F] bool).
+
+    msg_len counts the CRLF (the oracle's PASS/DROP byte count,
+    reference: r2d2parser.go:166).  allow is meaningful only where
+    complete.
+    """
+    complete, msg_len, hits = _r2d2_rule_hits(model, data, lengths, remotes)
+    return complete, msg_len, jnp.any(hits, axis=1)
+
+
+@jax.jit
+def r2d2_verdicts_attr(
+    model: R2d2BatchModel,
+    data: jax.Array,
+    lengths: jax.Array,
+    remotes: jax.Array,
+):
+    """r2d2_verdicts plus the deciding rule row: (complete, msg_len,
+    allow, rule [F] int32).  ``rule`` is the FIRST matching flattened
+    (rule, matcher) row — the host oracle's first-match walk order —
+    or -1 where not allowed; computed by an argmax over the same hit
+    matrix in the same fused pass."""
+    complete, msg_len, hits = _r2d2_rule_hits(model, data, lengths, remotes)
+    allow = jnp.any(hits, axis=1)
+    return complete, msg_len, allow, first_match(hits, allow)

@@ -20,6 +20,23 @@ from .monitor import (
 _PROTO = {6: "tcp", 17: "udp", 0: "any"}
 
 
+def _rule_attribution(p: dict) -> str:
+    """Render the deciding-rule fields a flow-record-fed event carries
+    (flowlog/ring.py): ` rule=<row> (<match kind>) policy=<name>` —
+    THE one rendering shared by the DROP and POLICY-VERDICT lines (an
+    operator correlates one against the other)."""
+    if "rule_id" not in p:
+        return ""
+    rule = p.get("rule_id", -1)
+    out = ""
+    if rule is not None and rule >= 0:
+        kind = p.get("match_kind") or "?"
+        out = f" rule={rule} ({kind})"
+    if p.get("policy"):
+        out += f" policy={p['policy']}"
+    return out
+
+
 def format_event(ev: MonitorEvent) -> str:
     ts = time.strftime("%H:%M:%S", time.localtime(ev.timestamp))
     p = ev.payload
@@ -29,16 +46,20 @@ def format_event(ev: MonitorEvent) -> str:
             f"{p.get('dst_identity')} dport {p.get('dport')}"
             f"/{_PROTO.get(p.get('proto'), p.get('proto'))}"
             + (f" ({p['l7']})" if p.get("l7") else "")
+            + _rule_attribution(p)
         )
     if ev.type == MSG_TYPE_POLICY_VERDICT:
         redirect = (
             f" redirect :{p['proxy_port']}" if p.get("proxy_port") else ""
         )
+        word = "ALLOW" if p.get("allowed", True) else "DENY"
         return (
-            f"{ts} ALLOW: identity {p.get('src_identity')} -> "
+            f"{ts} POLICY-VERDICT: {word} identity "
+            f"{p.get('src_identity')} -> "
             f"{p.get('dst_identity')} dport {p.get('dport')}"
             f"/{_PROTO.get(p.get('proto'), p.get('proto'))}{redirect}"
             + (f" ({p['l7']})" if p.get("l7") else "")
+            + _rule_attribution(p)
         )
     if ev.type == MSG_TYPE_AGENT:
         return f"{ts} AGENT: {p.get('text', '')}"

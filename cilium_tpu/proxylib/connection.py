@@ -79,6 +79,11 @@ class Connection:
     parser: Any = None
     orig_buf: InjectBuf = field(default_factory=lambda: InjectBuf(1024))
     reply_buf: InjectBuf = field(default_factory=lambda: InjectBuf(1024))
+    # Rule attribution of the most recent policy decision on this
+    # connection (flattened first-match row, -1 = denied/unattributed):
+    # stamped by matches() below and by the device-assisted engines'
+    # precomputed-verdict hook, read by the flow-record emission.
+    last_rule_id: int = -1
 
     def on_data(
         self,
@@ -123,6 +128,14 @@ class Connection:
             return FilterResult.PARSER_ERROR
 
     def matches(self, l7_data) -> bool:
+        at = getattr(self.instance, "policy_matches_at", None)
+        if at is not None:
+            ok, rule = at(
+                self.policy_name, self.ingress, self.port, self.src_id,
+                l7_data,
+            )
+            self.last_rule_id = rule
+            return ok
         return self.instance.policy_matches(
             self.policy_name, self.ingress, self.port, self.src_id, l7_data
         )

@@ -38,6 +38,17 @@ class Instance:
         policy = self._policy_map.get(policy_name)
         return policy is not None and policy.matches(ingress, port, remote_id, l7_data)
 
+    def policy_matches_at(
+        self, policy_name: str, ingress: bool, port: int, remote_id: int, l7_data
+    ) -> tuple[bool, int]:
+        """policy_matches plus the deciding flattened rule row (-1 for
+        deny/unattributed) — the attribution walk Connection.matches
+        records onto ``last_rule_id`` for flow-record emission."""
+        policy = self._policy_map.get(policy_name)
+        if policy is None:
+            return False, -1
+        return policy.matches_at(ingress, port, remote_id, l7_data)
+
     def has_policy(self, policy_name: str) -> bool:
         return policy_name in self._policy_map
 

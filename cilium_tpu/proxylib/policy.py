@@ -36,6 +36,28 @@ class CompiledRule:
             return any(m.matches(l7_data) for m in self.l7_matchers)
         return True  # empty set matches any payload
 
+    def n_rows(self) -> int:
+        """Flattened (rule, matcher) device rows this rule contributes
+        (a matcherless rule is one always-match row) — mirrors
+        models/r2d2.collect_policy_rows and models/http's
+        build_http_model_for_port flattening."""
+        return max(len(self.l7_matchers), 1)
+
+    def matches_with_rule(self, remote_id: int, l7_data) -> tuple[bool, int]:
+        """(allow, row): ``row`` is the rule-local index of the FIRST
+        matching matcher row, or -1 when nothing matches.  Walk order
+        is declaration order — the same priority the device models'
+        argmax reduction uses, so host and device attribute the same
+        row bit-identically."""
+        if self.allowed_remotes and remote_id not in self.allowed_remotes:
+            return False, -1
+        if not self.l7_matchers:
+            return True, 0  # the single always-match row
+        for j, m in enumerate(self.l7_matchers):
+            if m.matches(l7_data):
+                return True, j
+        return False, -1
+
 
 @dataclass
 class CompiledPortRules:
@@ -50,6 +72,28 @@ class CompiledPortRules:
         if not self.rules:
             return True
         return any(r.matches(remote_id, l7_data) for r in self.rules)
+
+    def n_rows(self) -> int:
+        return sum(r.n_rows() for r in self.rules)
+
+    def matches_with_rule(
+        self, remote_id: int, l7_data, base: int = 0
+    ) -> tuple[bool, int]:
+        """The attribution twin of matches(): (allow, rule_id) where
+        ``rule_id`` indexes the flattened (rule, matcher) rows starting
+        at ``base`` (the port cascade offsets the wildcard set past the
+        exact-port rows), or -1 for L4-final/empty-set allows and for
+        deny.  Bit-identical allow to matches() by construction: the
+        same rule walk, the same matcher order."""
+        if not self.have_l7_rules or not self.rules:
+            return self.matches(remote_id, l7_data), -1
+        row = base
+        for r in self.rules:
+            ok, j = r.matches_with_rule(remote_id, l7_data)
+            if ok:
+                return True, row + j
+            row += r.n_rows()
+        return False, -1
 
 
 def _compile_rule(config: PortNetworkPolicyRule) -> tuple[CompiledRule | None, bool]:
@@ -79,6 +123,30 @@ class CompiledPortPolicies:
         if wc is not None and wc.matches(remote_id, l7_data):
             return True
         return False
+
+    def matches_at(
+        self, port: int, remote_id: int, l7_data
+    ) -> tuple[bool, int]:
+        """(allow, rule_id) over the port cascade's flattened rows:
+        exact-port rules first, wildcard-port rules offset past them —
+        exactly the device builders' row order (collect_policy_rows /
+        build_http_model_for_port iterate ``(port, 0)``), so the id
+        here and the device argmax name the same row.  Degenerate
+        allows (L4-final / empty rule list) attribute -1; the device is
+        never consulted there (ConstVerdict)."""
+        rules = self.by_port.get(port)
+        base = 0
+        if rules is not None:
+            ok, row = rules.matches_with_rule(remote_id, l7_data, 0)
+            if ok:
+                return True, row
+            base = rules.n_rows()
+        wc = self.by_port.get(0)
+        if wc is not None and wc is not rules:
+            ok, row = wc.matches_with_rule(remote_id, l7_data, base)
+            if ok:
+                return True, row
+        return False, -1
 
 
 def _compile_port_policies(configs: list[PortNetworkPolicy]) -> CompiledPortPolicies:
@@ -125,6 +193,16 @@ class PolicyInstance:
     def matches(self, ingress: bool, port: int, remote_id: int, l7_data) -> bool:
         side = self.ingress if ingress else self.egress
         return side.matches(port, remote_id, l7_data)
+
+    def matches_at(
+        self, ingress: bool, port: int, remote_id: int, l7_data
+    ) -> tuple[bool, int]:
+        """matches() plus the deciding flattened rule row (-1 when
+        denied or decided without an L7 rule walk) — the host oracle
+        half of rule attribution; the device half is the models'
+        ``verdicts_attr`` argmax over the same row order."""
+        side = self.ingress if ingress else self.egress
+        return side.matches_at(port, remote_id, l7_data)
 
 
 PolicyMap = dict[str, PolicyInstance]

@@ -19,6 +19,7 @@ direction + DROP msg_len; reply direction always passes.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -26,6 +27,10 @@ import numpy as np
 from ..models.base import ConstVerdict
 from ..proxylib.accesslog import EntryType, LogEntry
 from ..proxylib.types import DROP, ERROR, MORE, PASS, OpError, OpType
+from ..utils import flowdebug
+
+# Per-flow debug stream, flowdebug-gated (one boolean when disabled).
+_flow_log = logging.getLogger("cilium_tpu.runtime.flow")
 
 
 @dataclass
@@ -48,17 +53,26 @@ class FlowState:
     # dropped with a typed protocol-error op sequence and the flow is
     # dead (the caller closes the connection on the ERROR result).
     overflowed: bool = False
+    # Rule attribution of the most recent device verdict on this flow
+    # (flattened first-match row, -1 = denied/unattributed) — read by
+    # the service's flow-record emission for pump-path entries.
+    last_rule_id: int = -1
 
 
 class R2d2BatchEngine:
     """Batch engine for the r2d2 model (the flagship end-to-end slice)."""
 
     def __init__(self, model, capacity: int = 2048, width: int = 256,
-                 logger=None, max_buffer: int = 1 << 20):
+                 logger=None, max_buffer: int = 1 << 20,
+                 attr_enabled: bool = True):
         self.model = model
         self.capacity = capacity
         self.width = width
         self.logger = logger
+        # Rule attribution gate: False (flow_observe off) keeps the
+        # pump on the PLAIN model call — no argmax, no extra readback
+        # (the flow_observe_overhead bench's disabled baseline).
+        self.attr_enabled = attr_enabled
         # Per-flow retained-bytes cap: a flow that buffers more than
         # this without a frame delimiter is dropped with a typed
         # protocol-error (bounded retained-data contract; the streaming
@@ -168,7 +182,11 @@ class R2d2BatchEngine:
         this entry's ops.  Returns (ops, inject) exactly as take_ops
         would."""
         st = self.flows[flow_id]
-        for msg, msg_len, allow in frames:
+        for frame in frames:
+            # (msg, msg_len, allow) or (msg, msg_len, allow, rule) —
+            # the attributed variant stamps the deciding rule row.
+            msg, msg_len, allow = frame[0], frame[1], frame[2]
+            st.last_rule_id = frame[3] if len(frame) > 3 else -1
             self._emit(st, msg, allow, msg_len, drain=False)
         if more and (not st.ops or st.ops[-1][0] != MORE):
             st.ops.append((MORE, 1))
@@ -243,7 +261,16 @@ class R2d2BatchEngine:
             lengths[i] = n
             remotes[i] = st.remote_id
 
-        complete, msg_len, allow = self.model(data, lengths, remotes)
+        attr = (
+            getattr(self.model, "verdicts_attr", None)
+            if self.attr_enabled else None
+        )
+        if attr is not None:
+            complete, msg_len, allow, rule = attr(data, lengths, remotes)
+            rule = np.asarray(rule)
+        else:
+            complete, msg_len, allow = self.model(data, lengths, remotes)
+            rule = None
         complete = np.asarray(complete)
         msg_len = np.asarray(msg_len)
         allow = np.asarray(allow)
@@ -252,11 +279,17 @@ class R2d2BatchEngine:
             if not complete[i]:
                 continue
             n = int(msg_len[i])
+            st.last_rule_id = int(rule[i]) if rule is not None else -1
             self._emit(st, bytes(st.buffer[: n - 2]), bool(allow[i]), n)
         return True
 
     def _emit(self, st: FlowState, msg: bytes, allow: bool, msg_len: int,
               drain: bool = True) -> None:
+        flowdebug.log(
+            _flow_log, "flow %d r2d2 %s n=%d rule=%d",
+            st.flow_id, "PASS" if allow else "DROP", msg_len,
+            st.last_rule_id,
+        )
         if self.logger is not None:
             fields = msg.decode("utf-8", "surrogateescape").split(" ")
             file_ = fields[1] if len(fields) == 2 else ""

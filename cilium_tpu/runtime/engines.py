@@ -10,6 +10,7 @@ OnIO contract (reference: envoy/cilium_proxylib.cc:125).
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -25,10 +26,14 @@ from ..kafka import matches_rule, parse_request
 from ..kafka.request import KafkaParseError, frame_length
 from ..models.base import ConstVerdict
 from ..models.builder import build_model_for_filter
-from ..models.http import http_verdicts
+from ..models.http import http_verdicts, http_verdicts_attr
 from ..models.kafka import encode_requests, kafka_verdicts
 from ..policy.l4 import PARSER_TYPE_HTTP, PARSER_TYPE_KAFKA
 from ..proxylib.types import DROP, MORE, PASS, OpType
+from ..utils import flowdebug
+
+# Per-flow debug stream, flowdebug-gated (one boolean when disabled).
+_flow_log = logging.getLogger("cilium_tpu.runtime.flow")
 
 # Shared with the streaming parser so both HTTP paths inject the
 # reference's exact denial (envoy/cilium_l7policy.cc:91).
@@ -55,10 +60,16 @@ class EngineFlow:
 class BaseBatchEngine:
     """Shared flow/buffer management (the OnIO byte accounting)."""
 
-    def __init__(self, capacity: int = 2048, logger=None, monitor=None):
+    proto = ""
+
+    def __init__(self, capacity: int = 2048, logger=None, monitor=None,
+                 flowlog=None):
         self.capacity = capacity
         self.logger = logger
         self.monitor = monitor
+        # Flow-record sink (flowlog/ring.py): subclasses emit ONE
+        # columnar round per _step — never per-request appends.
+        self.flowlog = flowlog
         self.flows: dict[int, EngineFlow] = {}
 
     def flow(self, flow_id: int, remote_id: int = 0, **kw) -> EngineFlow:
@@ -90,8 +101,29 @@ class BaseBatchEngine:
 
     # to implement: _step() -> bool
 
+    def _record_round(self, entries: list, kinds: tuple = ()) -> None:
+        """One flow-record batch per engine step; ``entries`` is
+        [(flow_id, allow, rule)] built by the step's hot loop."""
+        if self.flowlog is None or not entries:
+            return
+        from ..flowlog import CODE_DENIED, CODE_FORWARDED, PATH_ENGINE
+
+        self.flowlog.add_entries(
+            PATH_ENGINE,
+            [
+                (fid, CODE_FORWARDED if allow else CODE_DENIED, rule)
+                for fid, allow, rule in entries
+            ],
+            kinds=kinds,
+        )
+
     def _emit(self, st: EngineFlow, allow: bool, n: int,
               inject: bytes = b"", record: LogRecord | None = None) -> None:
+        flowdebug.log(
+            _flow_log, "flow %d %s %s n=%d",
+            st.flow_id, self.proto or type(self).__name__,
+            "PASS" if allow else "DROP", n,
+        )
         if allow:
             st.ops.append((PASS, n))
         else:
@@ -109,6 +141,8 @@ class BaseBatchEngine:
 class HttpBatchEngine(BaseBatchEngine):
     """HTTP request-head framing + device verdicts + 403 injection
     (reference: envoy/cilium_l7policy.cc request path)."""
+
+    proto = "http"
 
     # Fixed width/row buckets: padded shapes are drawn from these sets
     # so XLA compiles each (width, rows) pair once — one oversized head
@@ -161,8 +195,13 @@ class HttpBatchEngine(BaseBatchEngine):
         if isinstance(self.model, ConstVerdict):
             for st, head_len, body_len in active:
                 self._emit_http(st, bool(self.model.allow), head_len, body_len)
+            self._record_round(
+                [(st.flow_id, bool(self.model.allow), -1)
+                 for st, _, _ in active]
+            )
             return True
 
+        recs: list[tuple[int, bool, int]] = []
         # Group flows into per-width buckets so one oversized head does
         # not force a wide (and freshly compiled) scan for everyone.
         buckets: dict[int, list[tuple[EngineFlow, int, int]]] = {}
@@ -170,6 +209,7 @@ class HttpBatchEngine(BaseBatchEngine):
             if head_len > self.MAX_WIDTH:
                 # Pathological request head: deny without a device pass.
                 self._emit_http(st, False, head_len, body_len)
+                recs.append((st.flow_id, False, -1))
                 continue
             buckets.setdefault(
                 self._width_bucket(head_len), []
@@ -187,10 +227,25 @@ class HttpBatchEngine(BaseBatchEngine):
                 )
                 lengths[i] = head_len
                 remotes[i] = st.remote_id
-            _, _, allow = http_verdicts(self.model, data, lengths, remotes)
+            # Attribution only when a record sink is wired: without a
+            # flowlog the rule index would be computed, read back, and
+            # dropped (the flow_observe=False cost contract).
+            if self.flowlog is not None:
+                _, _, allow, rule = http_verdicts_attr(
+                    self.model, data, lengths, remotes
+                )
+                rule = np.asarray(rule)
+            else:
+                _, _, allow = http_verdicts(self.model, data, lengths, remotes)
+                rule = None
             allow = np.asarray(allow)
             for i, (st, head_len, body_len) in enumerate(group):
                 self._emit_http(st, bool(allow[i]), head_len, body_len)
+                recs.append((
+                    st.flow_id, bool(allow[i]),
+                    int(rule[i]) if rule is not None else -1,
+                ))
+        self._record_round(recs, getattr(self.model, "match_kinds", ()))
         return True
 
     def _emit_http(self, st: EngineFlow, allow: bool, head_len: int,
@@ -211,6 +266,8 @@ class HttpBatchEngine(BaseBatchEngine):
 class KafkaBatchEngine(BaseBatchEngine):
     """Kafka frame parse + device topic-ACL verdicts + error injection
     (reference: pkg/proxy/kafka.go:233 handleRequest)."""
+
+    proto = "kafka"
 
     def __init__(self, model, host_rows=None, **kw):
         super().__init__(**kw)
@@ -267,12 +324,15 @@ class KafkaBatchEngine(BaseBatchEngine):
             [st.remote_id for st, _, _ in active], np.int32
         )
         allow = np.asarray(kafka_verdicts(self.model, batch, remotes))
+        recs = []
         for i, (st, n, req) in enumerate(active):
             a = bool(allow[i])
             if batch.overflow[i]:
                 # Device refused to judge: exact host-oracle decision.
                 a = self._host_allow(req, st.remote_id)
             self._emit_kafka(st, a, n, req)
+            recs.append((st.flow_id, a, -1))
+        self._record_round(recs)
         return True
 
     def _emit_kafka(self, st: EngineFlow, allow: bool, n: int, req) -> None:
@@ -301,7 +361,11 @@ def create_engine_for_redirect(daemon, redirect):
         return None
     identity_cache = daemon.get_identity_cache()
     model = build_model_for_filter(f, identity_cache)
-    common = dict(logger=daemon.access_logger, monitor=daemon.monitor)
+    common = dict(
+        logger=daemon.access_logger,
+        monitor=daemon.monitor,
+        flowlog=getattr(daemon, "flowlog", None),
+    )
     if f.l7_parser == PARSER_TYPE_HTTP:
         return HttpBatchEngine(model, **common)
     if f.l7_parser == PARSER_TYPE_KAFKA:

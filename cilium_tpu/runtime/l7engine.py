@@ -45,8 +45,13 @@ from ..proxylib.parsers.memcached import (
 import logging
 
 from ..proxylib.types import MORE, DROP, ERROR, PASS, FilterResult, OpError
+from ..utils import flowdebug
 
 log = logging.getLogger(__name__)
+# Per-flow debug stream: every per-frame/per-op message in this module
+# rides the flowdebug gate (one boolean when disabled) — never a bare
+# log.debug on the verdict hot path.
+_flow_log = logging.getLogger("cilium_tpu.runtime.flow")
 
 
 class _EngineInstance:
@@ -56,17 +61,28 @@ class _EngineInstance:
     def __init__(self, engine):
         self.engine = engine
 
-    def policy_matches(self, policy_name, ingress, port, remote_id, l7):
+    def policy_matches_at(self, policy_name, ingress, port, remote_id, l7):
+        """(allow, rule) — Connection.matches stamps the rule onto the
+        connection's ``last_rule_id`` for flow-record emission.  Device
+        rounds answer from the precomputed (verdict, rule) queue; host
+        fallback walks the oracle's matches_at (the same flattened row
+        order the device argmax uses)."""
         q = self.engine._pending_verdicts.get(self.engine._driving_flow)
         if q:
-            return bool(q.popleft())
+            allow, rule = q.popleft()
+            return bool(allow), int(rule)
         # Host fallback: overflow frames, frames beyond the peek
         # horizon, or a quarantined device — exact oracle decision.
         self.engine.host_judged += 1
         policy = self.engine.policy
-        return policy is not None and policy.matches(
-            ingress, port, remote_id, l7
-        )
+        if policy is None:
+            return False, -1
+        return policy.matches_at(ingress, port, remote_id, l7)
+
+    def policy_matches(self, policy_name, ingress, port, remote_id, l7):
+        return self.policy_matches_at(
+            policy_name, ingress, port, remote_id, l7
+        )[0]
 
     def log(self, entry) -> None:
         if self.engine.logger is not None:
@@ -106,8 +122,12 @@ class DeviceAssistedEngine:
 
     def __init__(self, policy, ingress: bool, port: int, model,
                  logger=None, capacity: int = 2048,
-                 max_buffer: int = 1 << 20):
+                 max_buffer: int = 1 << 20, attr_enabled: bool = True):
         self.policy = policy  # PolicyInstance for host fallback
+        # Rule attribution gate: False (flow_observe off) keeps the
+        # judge on the plain verdict call — no argmax, no extra
+        # readback.
+        self.attr_enabled = attr_enabled
         self.ingress = ingress
         self.port = port
         self.model = model
@@ -237,7 +257,7 @@ class DeviceAssistedEngine:
             and not isinstance(self.model, ConstVerdict)
         ):
             try:
-                verdicts, overflow = self._judge(
+                judged = self._judge(
                     [d for _, d in batch_entries],
                     np.asarray(
                         [self.flows[fid].conn.src_id
@@ -245,6 +265,14 @@ class DeviceAssistedEngine:
                         np.int32,
                     ),
                 )
+                # Engines with device-side rule attribution return a
+                # third per-frame array of first-match rule rows; the
+                # rest attribute -1 (the queue always carries pairs).
+                if len(judged) == 3:
+                    verdicts, overflow, rules = judged
+                else:
+                    verdicts, overflow = judged
+                    rules = None
             except Exception as exc:  # noqa: BLE001 — host fallback
                 log.exception("device judge failed; host fallback")
                 if self.device_fail_hook is not None:
@@ -252,7 +280,7 @@ class DeviceAssistedEngine:
                         self.device_fail_hook(exc)
                     except Exception:  # noqa: BLE001
                         pass
-                verdicts, overflow = None, None
+                verdicts, overflow, rules = None, None, None
             if verdicts is not None:
                 stopped: set[int] = set()
                 for i, (fid, _) in enumerate(batch_entries):
@@ -264,13 +292,14 @@ class DeviceAssistedEngine:
                         stopped.add(fid)
                         continue
                     self._pending_verdicts.setdefault(fid, deque()).append(
-                        bool(verdicts[i])
+                        (bool(verdicts[i]),
+                         int(rules[i]) if rules is not None else -1)
                     )
                     self.device_judged += 1
         elif batch_entries and isinstance(self.model, ConstVerdict):
             for fid, _ in batch_entries:
                 self._pending_verdicts.setdefault(fid, deque()).append(
-                    bool(self.model.allow)
+                    (bool(self.model.allow), -1)
                 )
 
         # 3. drive the oracle op loop per (flow, direction)
@@ -285,6 +314,11 @@ class DeviceAssistedEngine:
                     reply, False, [bytes(st.bufs[reply])], ops
                 )
                 self._driving_flow = None
+                flowdebug.log(
+                    _flow_log, "flow %d %s %s drive: %d op(s) rule=%d",
+                    fid, self.proto, "reply" if reply else "orig",
+                    len(ops), st.conn.last_rule_id,
+                )
                 consumed = 0
                 for op, n in ops:
                     st.ops[reply].append((op, n))
@@ -510,11 +544,12 @@ class HttpSidecarEngine(DeviceAssistedEngine):
         return descs
 
     def _judge(self, descs, remotes):
-        from ..models.http import http_verdicts
+        from ..models.http import http_verdicts, http_verdicts_attr
 
         n = len(descs)
         allow = np.zeros(n, bool)
         overflow = np.zeros(n, bool)
+        rules = np.full(n, -1, np.int32)
         buckets: dict[int, list[int]] = {}
         for i, head in enumerate(descs):
             if len(head) > self.MAX_WIDTH:
@@ -536,8 +571,17 @@ class HttpSidecarEngine(DeviceAssistedEngine):
                 data[j, : len(h)] = np.frombuffer(h, np.uint8)
                 lengths[j] = len(h)
                 rem[j] = remotes[i]
-            _, _, a = http_verdicts(self.model, data, lengths, rem)
+            if self.attr_enabled:
+                _, _, a, r = http_verdicts_attr(
+                    self.model, data, lengths, rem
+                )
+                r = np.asarray(r)
+            else:
+                _, _, a = http_verdicts(self.model, data, lengths, rem)
+                r = None
             a = np.asarray(a)
             for j, i in enumerate(idxs):
                 allow[i] = bool(a[j])
-        return allow, overflow
+                if r is not None:
+                    rules[i] = int(r[j])
+        return allow, overflow, rules

@@ -575,6 +575,31 @@ class SidecarClient:
         )
         return json.loads(got.decode())
 
+    def observe(self, n: int = 100, verdict: str | None = None,
+                path: str | None = None, rule: int | None = None,
+                conn: int | None = None,
+                since: int | None = None) -> dict:
+        """Flow-record query (MSG_OBSERVE round trip): the service's
+        per-flow verdict records with device-side rule attribution —
+        the `cilium observe` surface.  ``since`` is the follow cursor
+        (records with seq > since, ascending)."""
+        req: dict = {"n": int(n)}
+        if verdict is not None:
+            req["verdict"] = verdict
+        if path is not None:
+            req["path"] = path
+        if rule is not None:
+            req["rule"] = int(rule)
+        if conn is not None:
+            req["conn"] = int(conn)
+        if since is not None:
+            req["since"] = int(since)
+        got = self._control_rpc(
+            lambda: (wire.MSG_OBSERVE, json.dumps(req).encode()),
+            wire.MSG_OBSERVE_REPLY,
+        )
+        return json.loads(got.decode())
+
     def _raw_policy_update(self, wire_mod: int, payload: bytes) -> int:
         got = self._control_rpc(
             lambda: (

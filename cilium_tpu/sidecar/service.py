@@ -42,32 +42,46 @@ from concurrent.futures import TimeoutError as _FuturesTimeout
 
 import numpy as np
 
+from ..flowlog import (
+    CODE_DENIED,
+    CODE_ERROR,
+    CODE_FORWARDED,
+    CODE_SHED,
+    FlowLog,
+)
 from ..models.base import ConstVerdict
 from ..proxylib import instance as pl
 from ..proxylib.accesslog import EntryType, LogEntry
 from ..proxylib.npds import policy_from_dict
 from ..proxylib.types import DROP, ERROR, MORE, PASS, FilterResult, OpError
 from ..runtime.batch import R2d2BatchEngine
-from ..utils import metrics
+from ..utils import flowdebug, metrics
 from ..utils.option import DaemonConfig
 from ..utils.sockutil import shutdown_close
 from . import wire
 from .dispatch import BatchDispatcher
 from .guard import DeviceGuard
-from .trace import PATH_HOST, PATH_ORACLE, PATH_VEC, VerdictTracer
+from .trace import PATH_HOST, PATH_ORACLE, PATH_SHED, PATH_VEC, VerdictTracer
 
 log = logging.getLogger(__name__)
+# Per-flow debug stream, flowdebug-gated (one boolean when disabled).
+_flow_log = logging.getLogger("cilium_tpu.sidecar.flow")
 
 
-def _gather_model(model, blob, offs, lens, remotes, width: int):
+def _gather_model(model, blob, offs, lens, remotes, width: int,
+                  attr: bool = False):
     """On-device row build: gather each entry's bytes from the flat
     payload blob into the [n, width] layout the batch models consume,
-    masking the padding tail to zero."""
+    masking the padding tail to zero.  ``attr`` routes through the
+    model's attributed variant (verdict + deciding-rule argmax in the
+    same fused executable)."""
     import jax.numpy as jnp
 
     col = jnp.arange(width, dtype=jnp.int32)[None, :]
     g = jnp.clip(offs[:, None] + col, 0, blob.shape[0] - 1)
     rows = jnp.where(col < lens[:, None], blob[g], 0)
+    if attr:
+        return model.verdicts_attr(rows, lens, remotes)
     return model(rows, lens, remotes)
 
 
@@ -227,10 +241,21 @@ class VerdictService:
         self._engine_idx: dict[int, int] = {}  # id(engine) -> table idx
         self._engine_free: list[int] = []
         self._objs_cache: tuple | None = None  # invalidated on mutation
+        # Flow-level verdict observability: the per-node record ring
+        # MSG_OBSERVE / `cilium observe` reads.  flow_observe=False
+        # removes record emission and the attributed device call (the
+        # flow_observe_overhead bench's disabled baseline).
+        self._flow_observe = self.config.flow_observe
+        self.flowlog = (
+            FlowLog(capacity=self.config.flowlog_ring,
+                    opts=self.config.opts)
+            if self._flow_observe else None
+        )
         # id(model) -> (model, jitted fn); the model reference pins the
         # id so a gc'd model can never alias a cache entry.
         self._jit_cache: dict[int, tuple] = {}
         self._jit_gather: dict[int, tuple] = {}
+        self._jit_attr: dict[int, tuple] = {}
         # Dispatch mode: 'eager'/'jit' honored as configured; 'auto' is
         # resolved by measurement at the first engine prewarm (guarded
         # by _dispatch_lock: concurrent first binds must not measure
@@ -402,6 +427,10 @@ class VerdictService:
             # Latency decomposition (sidecar/trace.py): per-stage means
             # by serving path + span/exemplar counters.
             "latency": self.tracer.status(),
+            # Flow-record ring occupancy (flowlog/): None = disabled.
+            "flowlog": (
+                self.flowlog.stats() if self.flowlog is not None else None
+            ),
             # Degradation ladder: device -> quarantine -> host fallback
             # -> shed.  Every rung typed and counted.
             "containment": {
@@ -448,6 +477,7 @@ class VerdictService:
                 mid = id(getattr(eng, "model", None))
                 self._jit_cache.pop(mid, None)
                 self._jit_gather.pop(mid, None)
+                self._jit_attr.pop(mid, None)
             affected = [
                 sc for sc in self._conns.values() if sc.conn.instance is ins
             ]
@@ -481,6 +511,14 @@ class VerdictService:
                 self._tab_src[conn_id] = conn.src_id
                 self._tab_dirty[conn_id] = 0
             self._tab_set_engine(conn_id, sc.engine if sc.fast_ok else None)
+        if self.flowlog is not None:
+            # Connection metadata registered ONCE here (and dropped at
+            # close) so per-round record emission stores bare arrays —
+            # the query side joins against this registry.
+            self.flowlog.register_conn(
+                conn_id, policy_name, ingress, src_id, dst_id,
+                src_addr, dst_addr, proto, conn.port,
+            )
         return int(res)
 
     _TAB_MAX = 1 << 22  # conns with larger ids use the entrywise path
@@ -628,6 +666,7 @@ class VerdictService:
                 width=self.config.batch_width,
                 logger=ins.access_logger,
                 max_buffer=self.config.max_flow_buffer,
+                attr_enabled=self._flow_observe,
             )
             self.prewarm(eng)
             return eng
@@ -657,6 +696,7 @@ class VerdictService:
             logger=ins.access_logger,
             capacity=self.config.batch_flows,
             max_buffer=self.config.max_flow_buffer,
+            attr_enabled=self._flow_observe,
         )
         # Containment hooks: the judge step is skipped while the device
         # is quarantined (host policy.matches fallback, bit-identical),
@@ -684,6 +724,8 @@ class VerdictService:
         if sc.engine is not None:
             sc.engine.close_flow(conn_id)
         pl.close_connection(conn_id)
+        if self.flowlog is not None:
+            self.flowlog.forget_conn(conn_id)
 
     # -- data plane (dispatcher worker thread only) -----------------------
 
@@ -878,19 +920,13 @@ class VerdictService:
                 lens[:cn] = lens32[a:b]
                 rem = np.zeros(f_pad, np.int32)
                 rem[:cn] = remotes[a:b]
-            _, _, chunk_allow = self._model_call(engine.model, data, lens, rem)
-            issued.append((chunk_allow, a, b, cn))
+            _, _, chunk_allow, chunk_rule = self._model_call_attr(
+                engine.model, data, lens, rem
+            )
+            issued.append((chunk_allow, chunk_rule, a, b, cn))
         mark("device_issue")
         rt.submitted()
-        allow = np.empty(n, bool)
-        for fut, a, b, cn in issued:
-            # np.asarray per array beats one batched device_get for the
-            # typical 1-2 co-located chunks (measured 3µs vs 20µs).
-            try:
-                allow[a:b] = np.asarray(fut)[:cn]
-            except Exception:  # noqa: BLE001 — deny on device error
-                log.exception("device readback failed")
-                allow[a:b] = False
+        allow, rules = self._readback_chunks(issued, n)
         mark("readback")
         # Device-complete is this FENCED boundary (np.asarray readback)
         # — block_until_ready can return pre-execution on the tunneled
@@ -952,7 +988,161 @@ class VerdictService:
             self.tracer.finish_round(
                 rt, [self._batch_desc(it[2]) for it in items]
             )
+            self._record_vec_round(engine, ids, allow, rules)
         return True
+
+    def _readback_chunks(self, issued: list, n: int):
+        """Materialize a round's (allow, rule) chunk futures into host
+        arrays.  np.asarray per array beats one batched device_get for
+        the typical 1-2 co-located chunks (measured 3µs vs 20µs).
+        Device errors deny (and unattribute) the chunk."""
+        allow = np.empty(n, bool)
+        rules = np.full(n, -1, np.int32)
+        for fut, rfut, a, b, cn in issued:
+            try:
+                allow[a:b] = np.asarray(fut)[:cn]
+            except Exception:  # noqa: BLE001 — deny on device error
+                log.exception("device readback failed")
+                allow[a:b] = False
+                continue
+            if rfut is not None:
+                # Separate containment: the rule array exists for
+                # OBSERVABILITY only — a failed rule readback
+                # unattributes the chunk, it must never flip verdicts
+                # that already materialized successfully.
+                try:
+                    rules[a:b] = np.asarray(rfut)[:cn]
+                except Exception:  # noqa: BLE001 — unattribute only
+                    log.exception("rule-attribution readback failed")
+        return allow, rules
+
+    def _record_vec_round(self, engine, conn_ids, allow, rules) -> None:
+        """One flow-record batch for a vec/matrix round: columnar
+        arrays straight from the readback, ONE ring append (R7: no
+        per-entry work on the hot path)."""
+        if self.flowlog is None:
+            return
+        self.flowlog.add_round(
+            PATH_VEC,
+            conn_ids,
+            np.where(allow, CODE_FORWARDED, CODE_DENIED).astype(np.int8),
+            rules,
+            kinds=getattr(engine.model, "match_kinds", ()),
+        )
+
+    @staticmethod
+    def _entry_code(result: int, ops) -> int | None:
+        """Flow-record verdict code for one entrywise response: first
+        DROP/ERROR op decides, else PASS forwards; a MORE-only entry
+        made no decision (no record)."""
+        if result == int(FilterResult.SHED):
+            return CODE_SHED
+        if result != int(FilterResult.OK):
+            return CODE_ERROR
+        has_pass = False
+        for op, _n in ops:
+            if op == int(DROP):
+                return CODE_DENIED
+            if op == int(ERROR):
+                return CODE_ERROR
+            if op == int(PASS):
+                has_pass = True
+        return CODE_FORWARDED if has_pass else None
+
+    @staticmethod
+    def _kind_for(model, rule: int) -> str:
+        kinds = getattr(model, "match_kinds", ()) if model is not None else ()
+        return kinds[rule] if 0 <= rule < len(kinds) else ""
+
+    def _entry_rule_kind(self, sc, conn_id: int) -> tuple[int, str]:
+        """Rule attribution for an entrywise entry decided inside an
+        engine pump or the oracle parser: the device-assisted engines
+        and the oracle stamp Connection.last_rule_id (via matches_at /
+        the precomputed-verdict queue), the r2d2 pump stamps
+        FlowState.last_rule_id."""
+        if sc is None:
+            return -1, ""
+        eng = sc.engine
+        if eng is not None:
+            fl = eng.flows.get(conn_id)
+            if fl is not None:
+                conn = getattr(fl, "conn", None)
+                rule = (
+                    conn.last_rule_id if conn is not None
+                    else getattr(fl, "last_rule_id", -1)
+                )
+                return int(rule), self._kind_for(eng.model, int(rule))
+        # Oracle path (no engine): the in-process Connection's walk.
+        return int(sc.conn.last_rule_id), ""
+
+    def _record_entrywise(self, path: str, items: list, responses: dict,
+                          rules_out: dict | None) -> None:
+        """One flow-record batch for an entrywise round: the hot loop
+        builds plain lists; the ring lock is taken ONCE in add_round
+        (R7: per-round, never per-entry-under-the-lock)."""
+        if self.flowlog is None:
+            return
+        # Plain reference: per-key dict reads are GIL-atomic, and a conn
+        # closed mid-iteration just materializes without metadata.
+        conns = self._conns
+        conn_ids: list[int] = []
+        codes: list[int] = []
+        rules: list[int] = []
+        kinds: list[str] = []
+        for item in items:
+            resp = responses.get(id(item))
+            if resp is None:
+                continue
+            batch = item[2]
+            for i in range(batch.count):
+                r = resp[i]
+                if r is None:
+                    continue
+                conn_id, result, ops = r[0], r[1], r[2]
+                code = self._entry_code(result, ops)
+                if code is None:
+                    continue
+                sc = conns.get(conn_id)
+                judged = (
+                    rules_out.get((id(item), i)) if rules_out else None
+                )
+                if judged is not None:
+                    rule, kind = judged  # captured at judge time
+                elif code == CODE_FORWARDED:
+                    rule, kind = self._entry_rule_kind(sc, conn_id)
+                else:
+                    # last_rule_id is the LAST decision's rule; a
+                    # non-forwarded entry (its first DROP decided) must
+                    # not borrow a later allowing frame's rule —
+                    # denied/shed/error records are unattributed, like
+                    # the vec path's deny rows.
+                    rule, kind = -1, ""
+                conn_ids.append(conn_id)
+                codes.append(code)
+                rules.append(rule)
+                kinds.append(kind)
+        if conn_ids:
+            self.flowlog.add_round(
+                path,
+                np.asarray(conn_ids, np.int64),
+                np.asarray(codes, np.int8),
+                np.asarray(rules, np.int32),
+                cols={"match_kind": kinds},
+            )
+
+    def observe_dump(self, req: dict) -> dict:
+        """Flow-record query for MSG_OBSERVE (`cilium observe`)."""
+        if self.flowlog is None:
+            return {"records": [], "stats": {"disabled": True}}
+        records = self.flowlog.query(
+            n=int(req.get("n", 100)),
+            verdict=req.get("verdict"),
+            path=req.get("path"),
+            rule=req.get("rule"),
+            conn=req.get("conn"),
+            since=req.get("since"),
+        )
+        return {"records": records, "stats": self.flowlog.stats()}
 
     def submit_close(self, conn_id: int) -> None:
         with self._lock:
@@ -1014,6 +1204,14 @@ class VerdictService:
                 batch.seq, n, batch.arrival,
                 int(batch.conn_ids[0]) if n else 0, reason,
             )
+            if self.flowlog is not None:
+                # One columnar batch per shed wire batch (cold path).
+                self.flowlog.add_round(
+                    PATH_SHED,
+                    batch.conn_ids,
+                    np.full(n, CODE_SHED, np.int8),
+                    reason=reason,
+                )
 
     def _on_batch_error(self, items: list, exc: BaseException) -> None:
         """Crash containment: a failed process(batch) produces typed
@@ -1051,6 +1249,13 @@ class VerdictService:
                 continue
             if sent:  # see _shed_item: never double-book served entries
                 self.error_entries += batch.count
+                if self.flowlog is not None:
+                    self.flowlog.add_round(
+                        PATH_SHED,
+                        batch.conn_ids,
+                        np.full(batch.count, CODE_ERROR, np.int8),
+                        reason="batch-crash",
+                    )
 
     def _on_dispatch_stall(self, items: list) -> None:
         """Watchdog deposed a stuck round (device hang): quarantine the
@@ -1470,6 +1675,30 @@ class VerdictService:
                 return fn(data, lens, remotes)
             return model(data, lens, remotes)
 
+    def _model_call_attr(self, model, data, lens, remotes):
+        """_model_call plus device-side rule attribution: returns
+        (complete, msg_len, allow, rule-or-None).  The rule index rides
+        the SAME fused computation (an argmax over the hit matrix the
+        verdict reduction already builds — no extra device pass); when
+        flow observability is off or the model has no attributed
+        variant, this degrades to the plain call with rule None."""
+        fn = (
+            getattr(model, "verdicts_attr", None)
+            if self._flow_observe else None
+        )
+        if fn is None:
+            c, m, a = self._model_call(model, data, lens, remotes)
+            return c, m, a, None
+        uj = self._use_jit
+        with self._device_ctx():
+            if uj and not isinstance(model, ConstVerdict):
+                jfn = self._jit_for(
+                    self._jit_attr, model,
+                    lambda d, ln, r: model.verdicts_attr(d, ln, r),
+                )
+                return jfn(data, lens, remotes)
+            return fn(data, lens, remotes)
+
     def _measure_dispatch_mode(self, engine) -> None:
         """Resolve dispatch_mode='auto': time the service's ACTUAL
         per-round pattern — issue N batches without blocking, then ONE
@@ -1523,13 +1752,17 @@ class VerdictService:
                     self._dispatch_resolved = True
         width = self.config.batch_width
         for b in self._buckets():
-            out = self._model_call(
+            # The attributed variant is the serving-path call when flow
+            # observability is on; it degrades to the plain call (rule
+            # None) otherwise — either way this warms the executable
+            # real rounds will launch.
+            out = self._model_call_attr(
                 engine.model,
                 np.zeros((b, width), np.uint8),
                 np.zeros(b, np.int32),
                 np.zeros(b, np.int32),
             )
-            np.asarray(out[-1])
+            np.asarray(out[2])
             if not self._inline_complete:
                 # The gather (blob-window) path has its own executable
                 # per flow bucket — warm it so first real traffic never
@@ -1537,14 +1770,14 @@ class VerdictService:
                 # (co-located) services skip this: their compiles are
                 # local and cheap, so first-use compiles lazily instead
                 # of doubling every engine build.
-                out = self._gathered_call(
+                allow, _rule = self._gathered_call(
                     engine.model,
                     np.zeros(self.BLOB_CHUNK, np.uint8),
                     np.zeros(b, np.int32),
                     np.zeros(b, np.int32),
                     np.zeros(b, np.int32),
                 )
-                np.asarray(out)
+                np.asarray(allow)
 
     def _run_vec(self, vec_items: list, snap: "_TabSnap",
                  t_pop: float) -> None:
@@ -1586,9 +1819,11 @@ class VerdictService:
                     )
                     start += mb.count
                 if self._inline_complete:
-                    self._finish_vec(issued, start, sends, rt)
+                    self._finish_vec(issued, start, sends, rt, engine)
                 else:
-                    self._completion_put(("vec", issued, start, sends, rt))
+                    self._completion_put(
+                        ("vec", issued, start, sends, rt, engine)
+                    )
             if not datas:
                 continue
             rt = self.tracer.begin_round(
@@ -1621,15 +1856,16 @@ class VerdictService:
                 )
                 start += batch.count
             if self._inline_complete:
-                self._finish_vec(issued, n, sends, rt)
+                self._finish_vec(issued, n, sends, rt, engine)
             else:
-                self._completion_put(("vec", issued, n, sends, rt))
+                self._completion_put(("vec", issued, n, sends, rt, engine))
 
     def _issue_chunks(self, engine, rows, lengths, conn_ids,
                       snap: "_TabSnap") -> list:
         """Issue device calls over [n, width] rows in fixed bucket-shaped
-        chunks WITHOUT blocking; returns [(allow_future, a, b, cn)] for
-        the completion worker to materialize."""
+        chunks WITHOUT blocking; returns [(allow_future, rule_future,
+        a, b, cn)] (rule None without attribution) for the completion
+        worker to materialize."""
         n = len(conn_ids)
         width = rows.shape[1]
         issued = []
@@ -1652,7 +1888,9 @@ class VerdictService:
                 lens[:cn] = lengths[a:b]
             remotes = np.zeros(f_pad, np.int32)
             remotes[:cn] = snap.src[snap.lookup(conn_ids[a:b])]
-            _, _, chunk_allow = self._model_call(engine.model, data, lens, remotes)
+            _, _, chunk_allow, chunk_rule = self._model_call_attr(
+                engine.model, data, lens, remotes
+            )
             if self._inline_complete and hasattr(chunk_allow, "copy_to_host_async"):
                 # Co-located/greedy mode materializes chunks
                 # sequentially right after issue; starting the
@@ -1661,7 +1899,9 @@ class VerdictService:
                 # would defeat the completion worker's batched readback
                 # (one round trip for all pending arrays).
                 chunk_allow.copy_to_host_async()
-            issued.append((chunk_allow, a, b, cn))
+                if chunk_rule is not None:
+                    chunk_rule.copy_to_host_async()
+            issued.append((chunk_allow, chunk_rule, a, b, cn))
         return issued
 
     # Fixed device blob window for the gather path: every chunk uploads
@@ -1703,12 +1943,14 @@ class VerdictService:
             lens[:cn] = lengths[a:b]
             remotes = np.zeros(f_pad, np.int32)
             remotes[:cn] = snap.src[snap.lookup(conn_ids[a:b])]
-            chunk_allow = self._gathered_call(
+            chunk_allow, chunk_rule = self._gathered_call(
                 engine.model, bp, o, lens, remotes
             )
             if self._inline_complete and hasattr(chunk_allow, "copy_to_host_async"):
                 chunk_allow.copy_to_host_async()
-            issued.append((chunk_allow, a, b, cn))
+                if chunk_rule is not None:
+                    chunk_rule.copy_to_host_async()
+            issued.append((chunk_allow, chunk_rule, a, b, cn))
             a = b
         return issued
 
@@ -1718,17 +1960,25 @@ class VerdictService:
         gather+model launch is a single dispatch on any transport,
         while an eager gather chain pays per-op dispatch (measured
         catastrophic — seconds per round — through the tunneled
-        link)."""
+        link).  Returns (allow, rule-or-None); with flow observability
+        on and an attributed model, the rule argmax is fused into the
+        same executable."""
         width = self.config.batch_width
+        attr = self._flow_observe and hasattr(model, "verdicts_attr")
         # ConstVerdict engines never reach here: vec eligibility
         # excludes them (their verdict needs no payload at all).
         with self._device_ctx():
             fn = self._jit_for(
                 self._jit_gather,
                 model,
-                lambda bl, o, ln, r: _gather_model(model, bl, o, ln, r, width),
+                lambda bl, o, ln, r: _gather_model(
+                    model, bl, o, ln, r, width, attr
+                ),
             )
-            return fn(blob_dev, offs, lens, remotes)[-1]
+            out = fn(blob_dev, offs, lens, remotes)
+        if attr:
+            return out[2], out[3]
+        return out[-1], None
 
     def _completion_put(self, rec) -> None:
         """Queue a record into the completion pipeline tagged with the
@@ -1744,20 +1994,14 @@ class VerdictService:
         rid = getattr(threading.current_thread(), "_disp_round", None)
         self._completions.put((rid, rec))
 
-    def _finish_vec(self, issued, n, sends, rt=None) -> None:
+    def _finish_vec(self, issued, n, sends, rt=None, engine=None) -> None:
         """Inline completion (greedy mode): materialize this round's
         futures and send — runs on the dispatcher thread, so per-conn
         FIFO order is trivially preserved.  The queue/worker variant in
         _completion_loop batches readbacks instead (high-latency link).
         Failures are isolated per chunk/per client like the queue path:
         one dead client or device error must not abort the round."""
-        allow = np.empty(n, bool)
-        for fut, a, b, cn in issued:
-            try:
-                allow[a:b] = np.asarray(fut)[:cn]
-            except Exception:  # noqa: BLE001 — deny on device error
-                log.exception("device readback failed")
-                allow[a:b] = False
+        allow, rules = self._readback_chunks(issued, n)
         if rt is not None:
             rt.completed()  # fenced: np.asarray above IS the readback
         self.fast_log.log_batch("r2d2", n, int(n - allow.sum()))
@@ -1765,10 +2009,17 @@ class VerdictService:
         self.vec_entries += n
         metrics.ProxyBatches.inc()
         self._send_vec_frames(sends, allow)
-        if rt is not None and not self._round_thread_suppressed():
-            self.tracer.finish_round(
-                rt, [self._batch_desc(s[6]) for s in sends]
-            )
+        if not self._round_thread_suppressed():
+            if rt is not None:
+                self.tracer.finish_round(
+                    rt, [self._batch_desc(s[6]) for s in sends]
+                )
+            if engine is not None and sends:
+                self._record_vec_round(
+                    engine,
+                    np.concatenate([s[2] for s in sends]),
+                    allow, rules,
+                )
 
     def _send_vec_frames(self, sends, allow) -> None:
         """Emit a vec round's verdicts: one VERDICT_BATCH frame per
@@ -1864,7 +2115,13 @@ class VerdictService:
             futs = []
             for _rid, r in recs:
                 if r[0] == "vec":
-                    futs.extend(fut for fut, _, _, _ in r[1])
+                    # Per chunk: the allow future, then (attribution
+                    # on) the rule future — the send loop consumes
+                    # them in the same order.
+                    for fut, rfut, _, _, _ in r[1]:
+                        futs.append(fut)
+                        if rfut is not None:
+                            futs.append(rfut)
                 elif r[0] == "entry2":
                     futs.extend(r[1])
             if futs:
@@ -1930,18 +2187,29 @@ class VerdictService:
                 try:
                     deposed = self.dispatcher.thread_round_is_shed()
                     if r[0] == "vec":
-                        _, issued, n, sends, rt = r
+                        _, issued, n, sends, rt, engine = r
+                        n_futs_round = sum(
+                            2 if rfut is not None else 1
+                            for _, rfut, _, _, _ in issued
+                        )
                         if deposed:
-                            vi += len(issued)  # keep later slices aligned
+                            vi += n_futs_round  # keep later slices aligned
                             continue
                         allow = np.empty(n, bool)
-                        for _, a, b, cn in issued:
+                        rules = np.full(n, -1, np.int32)
+                        for _, rfut, a, b, cn in issued:
                             v = vals[vi]
                             vi += 1
+                            rv = None
+                            if rfut is not None:
+                                rv = vals[vi]
+                                vi += 1
                             if v is None:
                                 allow[a:b] = False
                             else:
                                 allow[a:b] = np.asarray(v)[:cn]
+                                if rv is not None:
+                                    rules[a:b] = np.asarray(rv)[:cn]
                         rt.drained()
                         self.fast_log.log_batch(
                             "r2d2", n, int(n - allow.sum())
@@ -1953,6 +2221,12 @@ class VerdictService:
                         self.tracer.finish_round(
                             rt, [self._batch_desc(s[6]) for s in sends]
                         )
+                        if engine is not None and sends:
+                            self._record_vec_round(
+                                engine,
+                                np.concatenate([s[2] for s in sends]),
+                                allow, rules,
+                            )
                     elif r[0] == "entry2":
                         # Runs even when deposed: finish() drains engine
                         # ops/inject and the async-pending refcounts
@@ -2114,7 +2388,21 @@ class VerdictService:
             fast_issued = self._issue_fast(fast) if fast else []
             buckets, plan = self._issue_slow_async(slow, responses)
             rt.submitted()
-            futs = [g[0] for g in fast_issued] + [b[0] for b in buckets]
+            # Per group/bucket: the allow future, then (attribution on)
+            # the rule future — _finish_fast/_finish_slow_async consume
+            # vals in the same order.
+            futs = []
+            for g in fast_issued:
+                futs.append(g[0])
+                if g[1] is not None:
+                    futs.append(g[1])
+            for bk in buckets:
+                futs.append(bk[0])
+                if bk[1] is not None:
+                    futs.append(bk[1])
+            n_fast_futs = sum(
+                2 if g[1] is not None else 1 for g in fast_issued
+            )
             pend = {conn_id for _k, _i, _sc, conn_id, *_ in plan}
             if pend:
                 with self._lock:
@@ -2128,18 +2416,20 @@ class VerdictService:
                     # The completion loop's batched device_get (or the
                     # inline np.asarray fallback) fenced this round.
                     rt.completed()
-                    nf = len(fast_issued)
+                    rules_out: dict = {}
                     self._finish_fast(
                         fast_issued, responses,
                         vals=(
-                            vals[:nf] if vals is not None else [None] * nf
+                            vals[:n_fast_futs] if vals is not None
+                            else [None] * n_fast_futs
                         ),
+                        rules_out=rules_out,
                     )
                     self._finish_slow_async(
                         buckets, plan, responses,
                         vals=(
-                            vals[nf:] if vals is not None
-                            else [None] * len(buckets)
+                            vals[n_fast_futs:] if vals is not None
+                            else [None] * (len(futs) - n_fast_futs)
                         ),
                     )
                     rt.drained()
@@ -2156,6 +2446,9 @@ class VerdictService:
                         self.tracer.finish_round(
                             rt,
                             [self._batch_desc(it[2]) for it in items],
+                        )
+                        self._record_entrywise(
+                            rt.path, items, responses, rules_out
                         )
                 finally:
                     if pend:
@@ -2188,8 +2481,9 @@ class VerdictService:
 
         def run_sync_and_respond(_vals: list | None = None) -> None:
             rt.formed()
+            rules_out: dict = {}
             if fast:
-                self._run_fast(fast, responses)
+                self._run_fast(fast, responses, rules_out)
             self._run_slow_batched(slow, responses)
             # Sync paths read back inside the engine pump/fast finish:
             # submit/complete collapse onto this boundary and the work
@@ -2221,6 +2515,10 @@ class VerdictService:
                     self.tracer.finish_round(
                         rt, [self._batch_desc(it[2]) for it in items]
                     )
+            # Record emission at decision time (the pipelined sends are
+            # already queued in FIFO order behind this round).
+            if not self._round_thread_suppressed():
+                self._record_entrywise(rt.path, items, responses, rules_out)
 
         if deferred:
             self._completion_put(("entry2", [], run_sync_and_respond))
@@ -2251,9 +2549,9 @@ class VerdictService:
         entry, collect its completed frames, batch ALL frames into one
         model call per (engine, width) bucket — futures only.  Oracle
         entries (host parsers) are computed right here.  Returns
-        (buckets, plan): buckets = [(allow_dev, metas, engine)] where
-        metas = [(plan_idx, msg, msg_len)], plan = per-entry records
-        for the finish half."""
+        (buckets, plan): buckets = [(allow_dev, rule_dev, metas,
+        engine)] where metas = [(plan_idx, msg, msg_len)], plan =
+        per-entry records for the finish half."""
         plan = []  # (kind, key, i, sc, conn_id, frames | None)
         by_group: dict[tuple, list] = {}  # (id(engine), width) -> metas
         engines: dict[int, object] = {}
@@ -2281,6 +2579,10 @@ class VerdictService:
                 policy_name=conn.policy_name, ingress=conn.ingress,
                 dst_id=conn.dst_id, src_addr=conn.src_addr,
                 dst_addr=conn.dst_addr,
+            )
+            flowdebug.log(
+                _flow_log, "flow %d extract: %d frame(s)",
+                conn_id, len(frames),
             )
             # The MORE decision belongs to THIS entry's residue — decide
             # it now, not at finish time, when a later round may already
@@ -2313,7 +2615,7 @@ class VerdictService:
                 data_m[j, : len(row)] = row
                 lengths[j] = msg_len
                 remotes[j] = rec[2].conn.src_id
-            _c, _m, allow = self._model_call(
+            _c, _m, allow, rule = self._model_call_attr(
                 engine.model, data_m, lengths, remotes
             )
             # Record each frame's (bucket, slot) so the finish half can
@@ -2321,7 +2623,7 @@ class VerdictService:
             bi = len(buckets)
             for j, (rec, msg, msg_len) in enumerate(metas):
                 rec[6].append((bi, j, msg, msg_len))
-            buckets.append((allow, metas, engine))
+            buckets.append((allow, rule, metas, engine))
         if oracle_marks:
             self._tab_mark_many(oracle_marks)
         # Dirty flags for extract conns are written NOW, on the
@@ -2337,26 +2639,46 @@ class VerdictService:
     def _finish_slow_async(self, buckets: list, plan: list,
                            responses: dict, vals: list) -> None:
         """Finish half: one readback per bucket (batched by the
-        completion loop via ``vals``), then per-entry op emission in
-        arrival order — MORE parity and inject draining identical to
-        the wave path's pump()/take_ops."""
+        completion loop via ``vals`` — allow then, with attribution on,
+        rule per bucket), then per-entry op emission in arrival order —
+        MORE parity and inject draining identical to the wave path's
+        pump()/take_ops."""
         allows = []
-        for bi, (allow_dev, metas, _engine) in enumerate(buckets):
-            v = vals[bi] if bi < len(vals) else None
+        ruless = []
+        vi = 0
+        for allow_dev, rule_dev, metas, _engine in buckets:
+            v = vals[vi] if vi < len(vals) else None
+            vi += 1
+            rv = None
+            if rule_dev is not None:
+                rv = vals[vi] if vi < len(vals) else None
+                vi += 1
             if v is None:
                 try:
                     allows.append(np.asarray(allow_dev))
                 except Exception:  # noqa: BLE001 — deny on device error
                     log.exception("device readback failed")
                     allows.append(np.zeros(len(metas), bool))
+                    ruless.append(np.full(len(metas), -1, np.int32))
+                    continue
             else:
                 allows.append(np.asarray(v))
+            if rv is not None:
+                ruless.append(np.asarray(rv))
+            elif rule_dev is not None:
+                try:
+                    ruless.append(np.asarray(rule_dev))
+                except Exception:  # noqa: BLE001
+                    ruless.append(np.full(len(metas), -1, np.int32))
+            else:
+                ruless.append(np.full(len(metas), -1, np.int32))
         for key, i, sc, conn_id, engine, more, slots in plan:
             try:
                 ops, inject = engine.settle_entry(
                     conn_id,
                     [
-                        (msg, msg_len, bool(allows[bi][j]))
+                        (msg, msg_len, bool(allows[bi][j]),
+                         int(ruless[bi][j]))
                         for bi, j, msg, msg_len in slots
                     ],
                     more,
@@ -2384,7 +2706,8 @@ class VerdictService:
     def _issue_fast(self, fast: list) -> list:
         """Vectorized single-frame path, issue half: entries grouped
         per engine, one device call per group, futures kept — no
-        readback here.  Returns [(allow_dev, recs)]."""
+        readback here.  Returns [(allow_dev, rule_dev, recs)] (rule
+        None without attribution)."""
         # Capture each record's engine ONCE at grouping: policy_update
         # rebinds sc.engine concurrently, and a re-read after grouping
         # could judge the group with a different engine's model.
@@ -2407,30 +2730,53 @@ class VerdictService:
                 data[i, : len(arr)] = arr
                 lengths[i] = len(arr)
                 remotes[i] = sc.conn.src_id
-            complete, msg_len, allow = self._model_call(
+            complete, msg_len, allow, rule = self._model_call_attr(
                 engine.model, data, lengths, remotes
             )
-            issued.append((allow, recs))
+            issued.append((allow, rule, recs, engine))
         return issued
 
     def _finish_fast(self, issued: list, responses: dict,
-                     vals: list | None = None) -> None:
+                     vals: list | None = None,
+                     rules_out: dict | None = None) -> None:
         """Readback + per-entry response build for _issue_fast groups.
         ``vals`` carries pre-fetched values (completion-loop batched
-        device_get); None entries mean the readback failed → deny."""
-        for gi, (allow_dev, recs) in enumerate(issued):
+        device_get — allow then, with attribution on, rule per group);
+        None entries mean the readback failed → deny.  ``rules_out``
+        collects each entry's (deciding rule, match kind) keyed
+        (item_key, entry_idx) for flow-record emission — the kind is
+        resolved against the engine CAPTURED at judge time, not a
+        re-read sc.engine (policy_update rebinds it concurrently and
+        the rule row indexes the judging model's tables)."""
+        vi = 0
+        for allow_dev, rule_dev, recs, engine in issued:
             n = len(recs)
+            rules = None
             if vals is not None:
-                v = vals[gi]
+                v = vals[vi]
+                vi += 1
+                rv = None
+                if rule_dev is not None:
+                    rv = vals[vi]
+                    vi += 1
                 allow = (
                     np.zeros(n, bool) if v is None else np.asarray(v)[:n]
                 )
+                # Unattribute when the ALLOW readback failed: the
+                # entries were forced to deny, and stamping them with
+                # the device's (allowing) rule would label a deny with
+                # the rule that allowed it — mirror _readback_chunks.
+                if rv is not None and v is not None:
+                    rules = np.asarray(rv)[:n]
             else:
                 try:
                     allow = np.asarray(allow_dev)[:n]
+                    if rule_dev is not None:
+                        rules = np.asarray(rule_dev)[:n]
                 except Exception:  # noqa: BLE001 — deny on device error
                     log.exception("device readback failed")
                     allow = np.zeros(n, bool)
+                    rules = None
             denied = int(n - allow.sum())
             self.fast_log.log_batch("r2d2", n, denied)
             for i, (key, idx, sc, conn_id, payload) in enumerate(recs):
@@ -2440,6 +2786,11 @@ class VerdictService:
                 else:
                     ops = [(int(DROP), len(payload)), (int(MORE), 1)]
                     inj = b"ERROR\r\n"
+                if rules_out is not None:
+                    r_i = int(rules[i]) if rules is not None else -1
+                    rules_out[(key, idx)] = (
+                        r_i, self._kind_for(engine.model, r_i)
+                    )
                 responses[key][idx] = (
                     conn_id,
                     int(FilterResult.OK),
@@ -2448,9 +2799,11 @@ class VerdictService:
                     inj,
                 )
 
-    def _run_fast(self, fast: list, responses: dict) -> None:
+    def _run_fast(self, fast: list, responses: dict,
+                  rules_out: dict | None = None) -> None:
         """Synchronous fast path (inline mode): issue + finish."""
-        self._finish_fast(self._issue_fast(fast), responses)
+        self._finish_fast(self._issue_fast(fast), responses,
+                          rules_out=rules_out)
 
     def _run_slow_batched(self, slow: list, responses: dict) -> None:
         """Engine-backed slow entries are processed in WAVES: the nth
@@ -2934,6 +3287,23 @@ class _ClientHandler:
                         json.dumps(
                             self.service.trace_dump(n, kind)
                         ).encode(),
+                    )
+                elif msg_type == wire.MSG_OBSERVE:
+                    # Same containment as MSG_TRACE: a malformed
+                    # diagnostic request degrades to defaults, never
+                    # kills the shim connection's read loop.
+                    try:
+                        req = json.loads(payload.decode()) if payload else {}
+                        if not isinstance(req, dict):
+                            req = {}
+                    except (ValueError, UnicodeDecodeError):
+                        req = {}
+                    try:
+                        out = self.service.observe_dump(req)
+                    except (TypeError, ValueError):
+                        out = self.service.observe_dump({})
+                    self.send(
+                        wire.MSG_OBSERVE_REPLY, json.dumps(out).encode()
                     )
                 else:
                     log.warning("unknown message type %d", msg_type)

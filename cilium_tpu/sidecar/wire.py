@@ -74,6 +74,14 @@ MSG_DATA_BATCH_DL = 14
 # `cilium sidecar trace`.
 MSG_TRACE = 15
 MSG_TRACE_REPLY = 16
+# Flow-record query: request carries optional JSON filters
+# ``{"n": <max records>, "verdict": "Forwarded"|"Denied"|"Shed"|
+# "Error", "path": "vec"|"oracle"|"host"|"shed", "rule": <rule id>,
+# "conn": <conn id>, "since": <record seq cursor>}``; the reply is
+# JSON ``{"records": [...], "stats": {...}}`` from the service's flow
+# log (flowlog/ring.py) — the wire surface behind `cilium observe`.
+MSG_OBSERVE = 17
+MSG_OBSERVE_REPLY = 18
 
 # OnIO op capacity per verdict entry (reference: cilium_proxylib.cc:199).
 MAX_OPS_PER_ENTRY = 16

@@ -350,6 +350,17 @@ VerdictTraceSpans = registry.counter(
     ("kind",),
 )
 
+# Flow-level verdict observability (flowlog/): one increment per
+# distinct (verdict, path, match_kind) tuple per ROUND — the counter
+# twin of the flow-record ring, so dashboards see verdict mix by
+# serving path and by how the deciding rule was compiled.
+FlowVerdictsTotal = registry.counter(
+    "flow_verdicts_total",
+    "Flow verdict records by verdict, serving path, and the deciding "
+    "rule's compiled match kind (literal|regex|nfa|l3|l4)",
+    ("verdict", "path", "match_kind"),
+)
+
 # Kvstore traffic/fencing counters bridged from KvstoreCounters
 # (kvstore/net.py): every named event increments here too, so the
 # store's failure/fencing behavior shows up in /metrics instead of

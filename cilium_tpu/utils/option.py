@@ -202,6 +202,15 @@ class DaemonConfig:
     # Span ring capacity (bounded; oldest spans are evicted).
     trace_ring: int = 512
 
+    # Flow-level verdict observability (flowlog/): per-flow records
+    # with device-side rule attribution, populated per ROUND from all
+    # decision layers and queryable via `cilium observe`/MSG_OBSERVE.
+    # False removes record emission AND the attributed device call —
+    # the flow_observe_overhead bench's disabled baseline.
+    flow_observe: bool = True
+    # Flow-record ring capacity in RECORDS (oldest rounds evicted whole).
+    flowlog_ring: int = 8192
+
     # Modes
     dry_mode: bool = False  # reference: DryMode, pkg/endpoint/bpf.go:510
     restore_state: bool = True
@@ -255,6 +264,8 @@ class DaemonConfig:
             raise ValueError(
                 "trace knobs must be non-negative (ring positive)"
             )
+        if self.flowlog_ring <= 0:
+            raise ValueError("flowlog_ring must be positive")
 
 
 # Global config (reference: option.Config singleton).

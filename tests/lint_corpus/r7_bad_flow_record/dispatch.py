@@ -1,0 +1,17 @@
+"""KNOWN-BAD corpus (R7): per-entry flow-record emission inside the
+dispatch hot loop — each ``.add`` takes the ring lock per ENTRY.  The
+emission contract is per-ROUND columnar batches (the hot loop builds a
+plain list; add_round/add_entries take the lock once)."""
+
+FLOWLOG = None  # stands in for a flowlog.FlowLog
+FLOW_LOG_RING = None
+
+
+def process(items):
+    for item in items:
+        FLOWLOG.add(item)  # EXPECT[R7]
+
+
+def process_alias(items):
+    for item in items:
+        FLOW_LOG_RING.append(item)  # EXPECT[R7]

@@ -1,0 +1,18 @@
+"""KNOWN-GOOD twin of r7_bad_flow_record: the hot loop builds a plain
+list (no lock), and ONE per-round batch emission follows the loop."""
+
+FLOWLOG = None  # stands in for a flowlog.FlowLog
+SAMPLE_EVERY = 1024
+
+
+def process(items):
+    records = []
+    for item in items:
+        records.append((item.conn_id, item.code, item.rule))
+    FLOWLOG.add_entries("vec", records)
+
+
+def process_sampled(items):
+    for i, item in enumerate(items):
+        if i % SAMPLE_EVERY == 0:
+            FLOWLOG.add(item)  # sample-guarded: bounded lock traffic
