@@ -153,6 +153,7 @@ class AccessLogClient:
             for _ in range(2):
                 try:
                     if self._sock is None:
+                        # lint: disable=R2 -- connect is bounded by the constructor timeout; dialing under the mutex is the one-socket serialization this client is built on
                         self._sock = self._connect()
                     # One socket serialized by design; the sendall is
                     # bounded by the constructor timeout, so a wedged

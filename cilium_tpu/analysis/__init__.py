@@ -10,15 +10,36 @@ rules over the repo's own AST (stdlib ``ast`` only, no third-party
 linter):
 
   R1  lock discipline (acquire/finally pairing, captured-binding
-      release, recorded lock-order graph)
-  R2  blocking calls inside a held-lock region
+      release, WHOLE-PROGRAM lock-order graph incl. call-mediated
+      cross-module inversions)
+  R2  blocking calls inside a held-lock region, incl. blocking
+      reached THROUGH helper chains (interprocedural taint)
   R3  socket close() with no dominating shutdown()
   R4  purity of functions reached from jax.jit/vmap/scan call sites
-  R5  wire MSG_* / FilterResult handler exhaustiveness
+      (whole-program reachability through import-resolved calls)
+  R5  wire MSG_* / FilterResult handler exhaustiveness + field-level
+      JSON payload symmetry (MSG_TRACE/MSG_OBSERVE request & reply)
   R6  thread hygiene (Thread() without daemon= or local join)
+  R7  metric hygiene (dead registrations, hot-loop observes)
+  R8  recompilation hazards in jit-reached code (concretized scalars,
+      weak-typed constants, unhashable static args)
+  R9  implicit host transfers (.item()/np coercion in traced code;
+      block_until_ready on the dispatch hot path)
+  R10 shard_map/pjit in_specs/out_specs vs function arity
+  R11 fused-attribution integrity (one shared hit-matrix pass)
   R0  lint pragma hygiene (malformed / unjustified suppressions)
 
-Run ``bin/cilium-lint cilium_tpu/`` (see README "Invariants & lint").
+Layer 1 is the interprocedural engine (``callgraph.py``): a project-
+wide call graph with import/attribute resolution, per-function
+blocking/lock summaries and a fixed-point taint pass — what upgrades
+R1/R2/R4 from per-module to whole-program.  Layer 2 is the device-
+contract pair: ``rules_device.py`` (AST half) and ``devicecheck.py``
+(abstract tracing of the REAL verdict models via eval_shape/make_jaxpr
+under JAX_PLATFORMS=cpu — no device, zero runtime cost).
+
+Run ``bin/cilium-lint cilium_tpu/`` (see README "Invariants & lint");
+``--ratchet`` gates the suppression count one-way downward,
+``--device-contracts`` adds the abstract-trace layer.
 Suppress a false positive on its line with a JUSTIFIED pragma::
 
     risky_call()  # lint: disable=R2 -- why this is safe here
@@ -34,5 +55,6 @@ from .core import (  # noqa: F401
     analyze_paths,
     findings_to_json,
     load_baseline,
+    load_baseline_full,
     split_findings,
 )

@@ -6,6 +6,18 @@ survive suppression; ``--json`` emits the full machine-readable report
 baseline (``--baseline``, default ``tests/lint_baseline.json`` when it
 exists next to the scanned tree) accepts findings wholesale so new
 violations fail the build while grandfathered ones don't.
+
+``--ratchet`` turns the baseline's ``max_suppressed`` into a one-way
+gate: the tree's suppressed-finding count (pragmas + baselined) may
+only DECREASE.  Growth fails the build — a new pragma must displace an
+old one or argue its way into a recorded, reviewed ratchet bump via
+``--ratchet-update``; a missing ``max_suppressed`` fails CLOSED, so
+the gate cannot be disarmed by deleting the number.
+
+``--device-contracts`` additionally runs the abstract-trace layer
+(``analysis/devicecheck.py``): the real verdict models are traced
+under ``JAX_PLATFORMS=cpu`` (eval_shape/make_jaxpr — no device, no
+execution) and the R8-R11 contracts verified on the jaxprs themselves.
 """
 
 from __future__ import annotations
@@ -20,7 +32,7 @@ from .core import (
     _collect_py,
     analyze_paths,
     findings_to_json,
-    load_baseline,
+    load_baseline_full,
     split_findings,
 )
 
@@ -38,12 +50,67 @@ def _default_baseline(paths) -> str | None:
     return None
 
 
+def _ratchet(args, baseline_path, baseline_full, muted) -> int | None:
+    """Enforce max_suppressed; returns an exit code to stop with, or
+    None to continue into normal reporting."""
+    count = len(muted)
+    if baseline_path is None or baseline_full is None:
+        print("cilium-lint: --ratchet needs a baseline file "
+              "(tests/lint_baseline.json) to ratchet against",
+              file=sys.stderr)
+        return 2
+    # Advisory/status lines go to stderr: --ratchet composes with
+    # --json, whose stdout must stay pure machine-readable report.
+    def write_count(verb, old):
+        baseline_full["max_suppressed"] = count
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            json.dump(baseline_full, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"cilium-lint: ratchet {verb} "
+              f"{old if old is not None else '(unset)'} -> {count}",
+              file=sys.stderr)
+
+    recorded = baseline_full.get("max_suppressed")
+    if recorded is None:
+        if args.ratchet_update:  # bootstrap the ratchet
+            write_count("recorded", None)
+            return None
+        # Fail CLOSED: an unrecorded ratchet is indistinguishable from
+        # a deleted one.
+        print(f"cilium-lint: baseline {baseline_path} has no "
+              f"max_suppressed — record the current count "
+              f"({count}) with --ratchet --ratchet-update",
+              file=sys.stderr)
+        return 2
+    if count > recorded:
+        if args.ratchet_update:
+            # The reviewed-bump path: the flag on the command line IS
+            # the explicit sign-off, and the diff to the baseline file
+            # is what review sees.
+            write_count("RAISED", recorded)
+            return None
+        print(f"cilium-lint: RATCHET VIOLATION — {count} suppressed "
+              f"finding(s), baseline allows {recorded}.  The "
+              f"suppressed count may only decrease; remove a pragma "
+              f"or record a reviewed bump with --ratchet "
+              f"--ratchet-update.", file=sys.stderr)
+        return 1
+    if count < recorded:
+        if args.ratchet_update:
+            write_count("lowered", recorded)
+        else:
+            print(f"cilium-lint: suppressed count {count} is below "
+                  f"the recorded {recorded} — lock in the progress "
+                  f"with --ratchet --ratchet-update", file=sys.stderr)
+    return None
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="cilium-lint",
-        description="AST-based concurrency & hot-path invariant "
-                    "analyzer (rules R1-R6; see README 'Invariants & "
-                    "lint')",
+        description="whole-program concurrency & device-contract "
+                    "invariant analyzer (rules R0-R11; see README "
+                    "'Invariants & lint')",
     )
     p.add_argument("paths", nargs="*", default=["cilium_tpu"],
                    help="files or directories to scan "
@@ -60,6 +127,17 @@ def main(argv=None) -> int:
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print pragma-/baseline-suppressed "
                         "findings (text mode)")
+    p.add_argument("--ratchet", action="store_true",
+                   help="enforce the baseline's max_suppressed: the "
+                        "suppressed-finding count may only decrease "
+                        "(fails closed when unrecorded)")
+    p.add_argument("--ratchet-update", action="store_true",
+                   help="with --ratchet: record the current (lower) "
+                        "suppressed count into the baseline file")
+    p.add_argument("--device-contracts", action="store_true",
+                   help="also verify R8-R11 on the real verdict "
+                        "models by abstract tracing (JAX_PLATFORMS="
+                        "cpu; no device, no model execution)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule set and exit")
     args = p.parse_args(argv)
@@ -83,18 +161,40 @@ def main(argv=None) -> int:
         return 2
 
     baseline = None
+    baseline_path = None
+    baseline_full = None
     if not args.no_baseline:
-        path = args.baseline or _default_baseline(args.paths)
-        if path is not None:
+        baseline_path = args.baseline or _default_baseline(args.paths)
+        if baseline_path is not None:
             try:
-                baseline = load_baseline(path)
+                baseline_full = load_baseline_full(baseline_path)
+                baseline = baseline_full["accepted"]
             except (OSError, ValueError) as e:
-                print(f"cilium-lint: bad baseline {path}: {e}",
+                print(f"cilium-lint: bad baseline {baseline_path}: {e}",
                       file=sys.stderr)
                 return 2
 
     findings = analyze_paths(args.paths, baseline=baseline)
+    if args.device_contracts:
+        from . import devicecheck
+        from .core import _baseline_matches
+
+        extra = devicecheck.check_device_contracts()
+        # Device-contract findings have no source line, so a pragma
+        # can never reach them — the baseline's accepted list is their
+        # ONE escape hatch (a jax upgrade shifting an equation count
+        # must be acceptable without editing the tool).
+        if baseline:
+            for f in extra:
+                if any(_baseline_matches(e, f) for e in baseline):
+                    f.baselined = True
+        findings.extend(extra)
     active, muted = split_findings(findings)
+
+    if args.ratchet:
+        rc = _ratchet(args, baseline_path, baseline_full, muted)
+        if rc is not None:
+            return rc
 
     if args.as_json:
         print(json.dumps(findings_to_json(findings), indent=2))

@@ -23,6 +23,7 @@ Suppression model — two layers, both checked in:
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
 import json
 import os
@@ -47,6 +48,21 @@ RULE_DOCS = {
     "R7": "metric hygiene: registered-but-unreferenced metric "
           "(permanently-zero series), or Histogram.observe inside a "
           "dispatch hot loop without per-round/sample guarding",
+    "R8": "recompilation hazard in jit-reached code: Python-scalar "
+          "concretization (int()/float()/bool() on traced args), "
+          "weak-typed scalar constants (jnp.array(0.5) without dtype), "
+          "or unhashable static_argnums call sites",
+    "R9": "implicit host transfer: .item()/host-numpy coercion/"
+          "device_get inside a traced function, or "
+          "block_until_ready on the dispatch hot path (the fenced "
+          "np.asarray readback is the one sanctioned sync point)",
+    "R10": "sharding-spec consistency: shard_map/pjit in_specs arity "
+           "must match the wrapped function's positional signature and "
+           "out_specs its return tuple",
+    "R11": "fused-attribution integrity: verdicts and verdicts_attr "
+           "must consume ONE shared hit-matrix pass — the attr twin "
+           "calling the plain twin (or a diverged hits helper) is a "
+           "second device pass",
 }
 
 # ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
@@ -93,9 +109,12 @@ class Finding:
 class SourceFile:
     """One parsed file: tree, lines, and its pragma table."""
 
-    def __init__(self, path: str, text: str) -> None:
+    def __init__(self, path: str, text: str,
+                 content_hash: str | None = None) -> None:
         self.path = path
         self.text = text
+        self.content_hash = content_hash or hashlib.sha256(
+            text.encode()).hexdigest()
         self.lines = text.splitlines()
         self.tree: ast.Module | None = None
         self.parse_error: str | None = None
@@ -267,12 +286,32 @@ def enclosing_symbol(tree: ast.Module, line: int) -> str:
 
 # --- baseline -------------------------------------------------------------
 
-def load_baseline(path: str) -> list[dict]:
+def load_baseline_full(path: str) -> dict:
+    """Normalized baseline: {"accepted": [entries], "max_suppressed":
+    int | None}.  Accepts the legacy bare-list form (accepted entries
+    only) and the ratchet form ({"accepted": [...], "max_suppressed":
+    N} — the count ``--ratchet`` enforces may only decrease)."""
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
-    if not isinstance(data, list):
-        raise ValueError(f"baseline {path}: expected a JSON list")
-    return data
+    if isinstance(data, list):
+        return {"accepted": data, "max_suppressed": None}
+    if isinstance(data, dict):
+        accepted = data.get("accepted", [])
+        maxs = data.get("max_suppressed")
+        if not isinstance(accepted, list) or not (
+            maxs is None or isinstance(maxs, int)
+        ):
+            raise ValueError(
+                f"baseline {path}: expected accepted=list, "
+                f"max_suppressed=int"
+            )
+        return {"accepted": accepted, "max_suppressed": maxs}
+    raise ValueError(f"baseline {path}: expected a JSON list or object")
+
+
+def load_baseline(path: str) -> list[dict]:
+    """Accepted-entry list (both baseline forms)."""
+    return load_baseline_full(path)["accepted"]
 
 
 def _baseline_matches(entry: dict, f: Finding) -> bool:
@@ -289,6 +328,28 @@ def _baseline_matches(entry: dict, f: Finding) -> bool:
 
 
 # --- driver ---------------------------------------------------------------
+
+# Content-hash-keyed parse cache: parsing + tokenizing dominates a lint
+# pass, and the tier-1 gate runs analyze_paths dozens of times over the
+# same tree in one process (tree gate, corpus cases, CLI-contract
+# tests).  Keyed by (path, sha256) so an edited file re-parses while
+# everything else is reused; bounded so a long-lived process (or the
+# corpus churn of a test run) cannot grow it without limit.
+_SF_CACHE: dict[tuple[str, str], SourceFile] = {}
+_SF_CACHE_MAX = 4096
+
+
+def _load_source(path: str, text: str) -> SourceFile:
+    digest = hashlib.sha256(text.encode()).hexdigest()
+    key = (path, digest)
+    sf = _SF_CACHE.get(key)
+    if sf is None:
+        if len(_SF_CACHE) >= _SF_CACHE_MAX:
+            _SF_CACHE.clear()
+        sf = SourceFile(path, text, content_hash=digest)
+        _SF_CACHE[key] = sf
+    return sf
+
 
 def _collect_py(paths) -> list[str]:
     out = []
@@ -309,6 +370,7 @@ def _collect_py(paths) -> list[str]:
 
 def all_rules():
     from . import (
+        rules_device,
         rules_jit,
         rules_locks,
         rules_metrics,
@@ -324,6 +386,31 @@ def all_rules():
         rules_wire.check_r5,
         rules_sockets.check_r6,
         rules_metrics.check_r7,
+        rules_device.check_r8,
+        rules_device.check_r9,
+        rules_device.check_r10,
+        rules_device.check_r11,
+    ]
+
+
+def _run_rule_cached(rule, files):
+    """Run a rule through the content-keyed memo: identical scanned
+    content re-yields a rule's findings without re-walking a single
+    AST.  Findings are REBUILT fresh on every hit — analyze_paths
+    mutates suppression/baseline state per run, and that state must
+    never leak between runs with different baselines."""
+    from .callgraph import get_graph
+
+    memo = get_graph(files).rule_memo
+    key = f"{rule.__module__}.{rule.__qualname__}"
+    got = memo.get(key)
+    if got is None:
+        got = list(rule(files))
+        memo[key] = got
+    return [
+        Finding(f.rule, f.path, f.line, f.col, f.message,
+                symbol=f.symbol)
+        for f in got
     ]
 
 
@@ -343,7 +430,7 @@ def analyze_paths(
         except OSError as e:
             findings.append(Finding("R0", path, 0, 0, f"unreadable: {e}"))
             continue
-        sf = SourceFile(path, text)
+        sf = _load_source(path, text)
         if sf.parse_error is not None:
             findings.append(
                 Finding("R0", path, 0, 0, f"parse error: {sf.parse_error}")
@@ -354,7 +441,7 @@ def analyze_paths(
             findings.append(Finding("R0", path, line, 0, msg))
 
     for rule in (rules if rules is not None else all_rules()):
-        findings.extend(rule(files))
+        findings.extend(_run_rule_cached(rule, files))
 
     for f in findings:
         sf = files.get(f.path)

@@ -11,12 +11,15 @@ inside a jitted function is worse than useless (it guards one trace,
 then lies), and wall-clock reads make verdicts non-bit-identical
 across replicas — breaking the paper's determinism north star.
 
-Reachability is same-module: decorated functions (``@jax.jit``,
+Reachability is WHOLE-PROGRAM: decorated functions (``@jax.jit``,
 ``@partial(jax.jit, ...)``), functions passed to jit/vmap/pmap or
 ``lax.scan``/``while_loop``/``fori_loop``/``cond``/``switch`` call
-sites, plus everything they call by simple name or ``self.method``
-within the module.  Cross-module reachability is out of scope (the
-callee module is linted under its own call sites).
+sites, plus everything they call — by simple name or ``self.method``
+within the module (the PR 3 approximation), and through the
+interprocedural engine's import-resolved call graph across modules
+(``service.py`` jit sites reach ``models/base.py`` helpers; a clock
+read hidden in a helper two modules away is still a determinism
+break).  Findings land in the impure function's own file.
 """
 
 from __future__ import annotations
@@ -24,6 +27,7 @@ from __future__ import annotations
 import ast
 import re
 
+from .callgraph import get_graph
 from .core import (
     Finding,
     call_func_name,
@@ -163,33 +167,71 @@ def _impurities(sf, fn, qual):
                 )
 
 
-def check_r4(files):
-    for sf in files.values():
+def jit_reached(files):
+    """Whole-program jit reachability, memoized on the graph: the
+    FuncInfos reachable from any jit/vmap/scan site plus the lambdas
+    passed to them — shared by R4 (purity) and the device-contract
+    rules R8/R9 (recompile hazards / host transfers), which police the
+    same traced scope for different sins."""
+    graph = get_graph(files)
+    memo = graph.rule_memo.get("jit_reached")
+    if memo is not None:
+        return memo
+
+    # Jit roots + lambdas per module (lexical detection is unchanged).
+    seen: set[int] = set()
+    frontier: list = []
+    all_lambdas: list[tuple] = []
+    tables: dict[str, dict] = {}
+    for path, sf in files.items():
         table = _module_functions(sf.tree)
-        quals = {id(fn): qual
-                 for fn, qual, _cls in walk_functions(sf.tree)}
+        tables[path] = table
         roots, lambdas = _jit_roots(sf.tree, table)
-        seen: set[int] = set()
-        frontier = list(roots)
-        reached = []
-        while frontier:
-            fn = frontier.pop()
-            if id(fn) in seen:
-                continue
-            seen.add(id(fn))
-            reached.append(fn)
-            for cname in _called_names(fn):
-                frontier.extend(table.get(cname, ()))
-        emitted: set[tuple[int, int, str]] = set()
-        for fn in reached:
-            for f in _impurities(sf, fn, quals.get(id(fn), fn.name)):
-                key = (f.line, f.col, f.message[:40])
-                if key not in emitted:
-                    emitted.add(key)
-                    yield f
-        for lam in lambdas:
-            for f in _impurities(sf, lam, "<lambda>"):
-                key = (f.line, f.col, f.message[:40])
-                if key not in emitted:
-                    emitted.add(key)
-                    yield f
+        frontier.extend(roots)
+        all_lambdas.extend((sf, lam) for lam in lambdas)
+
+    # Whole-program reachability: same-module bare-name/self tables
+    # (the PR 3 approximation) PLUS import-resolved cross-module
+    # targets from the interprocedural engine.
+    reached: list = []
+    while frontier:
+        fn = frontier.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        fi = graph.by_node.get(id(fn))
+        if fi is None:
+            continue
+        reached.append(fi)
+        table = tables.get(fi.path, {})
+        for cname in _called_names(fn):
+            frontier.extend(table.get(cname, ()))
+        for _call, _line, _col, _held, keys in fi.calls:
+            for key in keys or ():
+                callee = graph.funcs.get(key)
+                if callee is not None and callee.path != fi.path:
+                    frontier.append(callee.node)
+
+    memo = (reached, all_lambdas)
+    graph.rule_memo["jit_reached"] = memo
+    return memo
+
+
+def check_r4(files):
+    reached, all_lambdas = jit_reached(files)
+    emitted: set[tuple[str, int, int, str]] = set()
+    for fi in reached:
+        sf = files.get(fi.path)
+        if sf is None:
+            continue
+        for f in _impurities(sf, fi.node, fi.qual):
+            key = (f.path, f.line, f.col, f.message[:40])
+            if key not in emitted:
+                emitted.add(key)
+                yield f
+    for sf, lam in all_lambdas:
+        for f in _impurities(sf, lam, "<lambda>"):
+            key = (f.path, f.line, f.col, f.message[:40])
+            if key not in emitted:
+                emitted.add(key)
+                yield f

@@ -27,12 +27,29 @@ R2 flags blocking calls — socket ops, ``queue.get``, ``Thread.join``,
 ``sleep``, device readbacks — lexically inside a held-lock ``with``
 region.  ``.wait()`` is exempt everywhere: Condition.wait RELEASES the
 lock, and flagging it would outlaw the dispatcher's core idiom.
+
+Both rules are WHOLE-PROGRAM since the interprocedural engine
+(``analysis/callgraph.py``) landed:
+
+- **R1.4 call-mediated lock-order graph** — every observed nesting,
+  lexical or through a call chain, contributes an edge
+  ``(held, taken)`` to a project-wide graph over QUALIFIED lock
+  identities (``Cls._lock`` / ``module:name``).  Flagged: an edge
+  inverting the recorded LOCK_ORDER, a pair of opposite edges observed
+  anywhere in the project (a cross-module deadlock cycle — each end
+  may look locally sane), and a call chain that re-acquires a
+  non-reentrant lock already held at the call site.
+- **R2 taint** — a call made under a held lock whose callee
+  TRANSITIVELY blocks (through helpers like ``utils.sockutil``) is the
+  same stall as a lexical ``sendall`` under the lock; the finding
+  names the helper chain.
 """
 
 from __future__ import annotations
 
 import ast
 
+from .callgraph import get_graph
 from .core import (
     Finding,
     call_func_name,
@@ -142,6 +159,7 @@ def check_r1(files):
             yield from _r1_acquire_pairing(sf, fn, qual, aliases,
                                            swappable)
             yield from _r1_with_order(sf, fn, qual, aliases, reentrant)
+    yield from _r1_lock_graph(files, reentrant)
 
 
 def _r1_acquire_pairing(sf, fn, qual, aliases, swappable):
@@ -211,14 +229,17 @@ def _r1_with_order(sf, fn, qual, aliases, reentrant):
             if not is_lock_like_expr(expr, aliases):
                 continue
             name = lock_terminal(expr, aliases)
-            if name in held and name not in reentrant:
+            # ``with a, b:`` nests b inside a — earlier items of the
+            # same statement count as held for the later ones.
+            effective = held + taken
+            if name in effective and name not in reentrant:
                 findings.append(Finding(
                     "R1", sf.path, node.lineno, node.col_offset,
                     f"nested re-acquire of non-reentrant lock "
                     f"{name!r} — self-deadlock",
                     symbol=qual,
                 ))
-            for h in held:
+            for h in effective:
                 if (name, h) in LOCK_ORDER:
                     findings.append(Finding(
                         "R1", sf.path, node.lineno, node.col_offset,
@@ -245,6 +266,119 @@ def _r1_with_order(sf, fn, qual, aliases, reentrant):
     for stmt in fn.body:
         walk(stmt, [])
     yield from findings
+
+
+# --- R1.4 whole-program lock-order graph ----------------------------------
+
+def _r1_lock_graph(files, reentrant):
+    """Project-wide lock-order edges over qualified identities.
+
+    An edge ``(A, B)`` means "B was taken (directly or through a call
+    chain) while A was held".  Three findings:
+
+    - a CALL-MEDIATED edge inverting the recorded LOCK_ORDER (the
+      lexical case is R1.3's);
+    - opposite edges ``(A, B)`` and ``(B, A)`` observed anywhere in the
+      scanned set — the classic distributed deadlock, each half locally
+      sane, often in different modules;
+    - a call chain that re-acquires a non-reentrant lock already held
+      at the call site (self-deadlock through a helper).
+    """
+    graph = get_graph(files)
+    # (outer_ident, inner_ident) -> [(path,line,col,qual,chain|None)]
+    edges: dict[tuple[str, str], list] = {}
+
+    def add_edge(outer, inner, site):
+        edges.setdefault((outer, inner), []).append(site)
+
+    for fi in graph.funcs.values():
+        if fi.name in _WRAPPER_FUNCS:
+            continue
+        # Lexical nestings come straight from the graph's function
+        # summaries (ONE With-walker, shared with the taint pass) and
+        # feed the global graph so a cross-FILE opposite nesting
+        # pairs up.
+        for outer, inner, line, col in fi.lex_nestings:
+            add_edge(outer, inner, (fi.path, line, col, fi.qual, None))
+
+        # Call-mediated acquisitions under a held lock.
+        for _call, line, col, held, keys in fi.calls:
+            if not held:
+                continue
+            for key in keys or ():
+                callee = graph.funcs.get(key)
+                if callee is None:
+                    continue
+                for ident, chain in callee.t_acquires.items():
+                    via = (key,) + chain
+                    for h in held:
+                        add_edge(h, ident,
+                                 (fi.path, line, col, fi.qual, via))
+
+    emitted: set = set()
+
+    def emit(path, line, col, qual, msg):
+        k = (path, line, col, msg[:60])
+        if k in emitted:
+            return None
+        emitted.add(k)
+        return Finding("R1", path, line, col, msg, symbol=qual)
+
+    term = graph.lock_terminal_of
+    for (outer, inner), sites in sorted(edges.items()):
+        # recorded-order inversion through a call chain
+        if (term(inner), term(outer)) in LOCK_ORDER and outer != inner:
+            for path, line, col, qual, via in sites:
+                if via is None:
+                    continue  # lexical: R1.3 already owns it
+                f = emit(
+                    path, line, col, qual,
+                    f"lock-order inversion via call chain "
+                    f"{graph.chain_text(via)}: the chain acquires "
+                    f"{term(inner)!r} while {term(outer)!r} is held "
+                    f"here, inverting the recorded order "
+                    f"{term(inner)!r} outside {term(outer)!r}",
+                )
+                if f:
+                    yield f
+            continue
+        # self-deadlock through a helper
+        if outer == inner and term(inner) not in reentrant:
+            for path, line, col, qual, via in sites:
+                if via is None:
+                    continue  # lexical same-lock nesting is R1.3's
+                f = emit(
+                    path, line, col, qual,
+                    f"call chain {graph.chain_text(via)} re-acquires "
+                    f"non-reentrant lock {term(inner)!r} already held "
+                    f"at this call site — self-deadlock through the "
+                    f"helper",
+                )
+                if f:
+                    yield f
+            continue
+        # opposite edges observed anywhere in the project
+        rev = edges.get((inner, outer))
+        if rev and outer != inner and outer < inner:
+            for direction, dsites in (((outer, inner), sites),
+                                      ((inner, outer), rev)):
+                for path, line, col, qual, via in dsites:
+                    how = (
+                        f"via call chain {graph.chain_text(via)} "
+                        if via else ""
+                    )
+                    f = emit(
+                        path, line, col, qual,
+                        f"lock-order cycle: {term(direction[1])!r} is "
+                        f"taken {how}while {term(direction[0])!r} is "
+                        f"held here, and the OPPOSITE nesting "
+                        f"({term(direction[0])!r} inside "
+                        f"{term(direction[1])!r}) is also reachable in "
+                        f"this tree — two threads on the two paths "
+                        f"deadlock",
+                    )
+                    if f:
+                        yield f
 
 
 # --- R2 -------------------------------------------------------------------
@@ -325,3 +459,41 @@ def check_r2(files):
             for stmt in fn.body:
                 walk(stmt, None)
             yield from findings
+    yield from _r2_taint(files)
+
+
+def _r2_taint(files):
+    """Blocking-call taint through helpers: a call under a held lock
+    whose callee TRANSITIVELY blocks is the same stall as a lexical
+    sendall under the lock — the helper boundary must not launder it.
+    Directly-blocking calls are the lexical rule's; this pass only
+    fires when the blocking site is at least one call away."""
+    graph = get_graph(files)
+    for fi in graph.funcs.values():
+        # Same exemptions as the lexical rule: lock wrappers and
+        # lock-implementation classes pair/block by design.
+        if fi.name in _WRAPPER_FUNCS:
+            continue
+        if _class_defines_release(fi.cls_node):
+            continue
+        for call, line, col, held, keys in fi.calls:
+            if not held:
+                continue
+            if _blocking_reason(call) is not None:
+                continue  # lexical R2 already flags it here
+            for key in keys or ():
+                callee = graph.funcs.get(key)
+                if callee is None or callee.blocks_via is None:
+                    continue
+                chain, reason = callee.blocks_via
+                via = (key,) + chain
+                yield Finding(
+                    "R2", fi.path, line, col,
+                    f"call while holding "
+                    f"{graph.lock_terminal_of(held[-1])!r} blocks via "
+                    f"helper chain {graph.chain_text(via)} "
+                    f"({reason}) — every thread contending on the "
+                    f"lock stalls for the full wait",
+                    symbol=fi.qual,
+                )
+                break  # one finding per call site is plenty

@@ -1,6 +1,6 @@
 """R5 — wire / verdict exhaustiveness.
 
-Two halves:
+Three halves:
 
 - **MSG coverage.**  Every ``MSG_*`` constant defined in a ``wire.py``
   must be referenced by its sibling ``service.py`` AND ``client.py``
@@ -17,6 +17,16 @@ Two halves:
   extension codes (SHED=8, SERVICE_UNAVAILABLE=9) were designed to be
   safe on old consumers exactly because of this gate — the rule keeps
   new consumers honest.
+- **JSON field symmetry** (the PR 4/5 payloads).  MSG_TRACE /
+  MSG_OBSERVE and their replies carry ``json.dumps`` payloads, so
+  message-NAME coverage alone proves nothing about fields: a request
+  key the client writes that the service never reads is a filter
+  silently ignored; a reply key the service emits that no consumer
+  anywhere reads is a dead field (and the next rename breaks the CLI
+  with no lint to catch it).  For every json-carried send site, each
+  written key must be read either by the PEER's handler chain
+  (import-resolved, two hops deep) or — for reply payloads the client
+  returns opaquely — by SOME consumer in the scanned tree.
 """
 
 from __future__ import annotations
@@ -25,7 +35,8 @@ import ast
 import os
 import re
 
-from .core import Finding, unparse
+from .callgraph import get_graph
+from .core import Finding, unparse, walk_functions
 
 _FR_TOKEN = re.compile(r"FilterResult\.([A-Z_]+)")
 
@@ -69,6 +80,227 @@ def _filter_result_members(files) -> list[str]:
         return []
 
 
+# --- JSON field symmetry --------------------------------------------------
+
+def _is_msg_token(node) -> str | None:
+    if isinstance(node, ast.Attribute) and node.attr.startswith("MSG_"):
+        return node.attr
+    if isinstance(node, ast.Name) and node.id.startswith("MSG_"):
+        return node.id
+    return None
+
+
+def _dumps_inner(node) -> ast.AST | None:
+    """The EXPR of ``json.dumps(EXPR)`` / ``json.dumps(EXPR).encode()``."""
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "encode"):
+        return _dumps_inner(node.func.value)
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "dumps"
+            and node.args):
+        return node.args[0]
+    return None
+
+
+def _own_nodes_with_lambdas(fn):
+    """A function's own body, lambdas included, nested defs excluded —
+    a payload built in a method and shipped via a ``lambda:`` send
+    thunk belongs to the METHOD."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _json_send_sites(sf):
+    """(msg_name, inner_expr, enclosing_fn_node, line, col): every
+    tuple/call that pairs a MSG_* token with a json.dumps payload."""
+    for fn, _qual, _cls in walk_functions(sf.tree):
+        if isinstance(fn, ast.Lambda):
+            continue
+        for node in _own_nodes_with_lambdas(fn):
+            parts = []
+            if isinstance(node, ast.Tuple):
+                parts = node.elts
+            elif isinstance(node, ast.Call):
+                parts = list(node.args)
+            if len(parts) < 2:
+                continue
+            msg = None
+            inner = None
+            for p in parts:
+                m = _is_msg_token(p)
+                if m is not None:
+                    msg = m
+                d = _dumps_inner(p)
+                if d is not None:
+                    inner = d
+            if msg is not None and inner is not None:
+                yield msg, inner, fn, node.lineno, node.col_offset
+
+
+def _written_keys(inner, fn, sf, graph) -> set[str]:
+    """Constant keys the payload expression carries: dict literals and
+    subscript-assigns for a Name; returned-dict keys (resolved through
+    the call graph) for a producing Call."""
+    keys: set[str] = set()
+
+    def dict_keys(d: ast.Dict):
+        for k in d.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+
+    if isinstance(inner, ast.Dict):
+        dict_keys(inner)
+        return keys
+    if isinstance(inner, ast.Name):
+        target = inner.id
+        for node in _own_nodes_with_lambdas(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Name) and t.id == target
+                            and isinstance(node.value, ast.Dict)):
+                        dict_keys(node.value)
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == target
+                            and isinstance(t.slice, ast.Constant)
+                            and isinstance(t.slice.value, str)):
+                        keys.add(t.slice.value)
+        return keys
+    if isinstance(inner, ast.Call):
+        fi = graph.info_for(fn)
+        if fi is None:
+            return keys
+        for target in graph.resolve_call(inner, fi):
+            tnode = target.node
+            ret_names: set[str] = set()
+            for node in ast.walk(tnode):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    if isinstance(node.value, ast.Dict):
+                        dict_keys(node.value)
+                    elif isinstance(node.value, ast.Name):
+                        ret_names.add(node.value.id)
+            for node in ast.walk(tnode):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (isinstance(t, ast.Name)
+                                and t.id in ret_names
+                                and isinstance(node.value, ast.Dict)):
+                            dict_keys(node.value)
+                        if (isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id in ret_names
+                                and isinstance(t.slice, ast.Constant)
+                                and isinstance(t.slice.value, str)):
+                            keys.add(t.slice.value)
+    return keys
+
+
+def _read_keys_in(fn) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)):
+            out.add(node.slice.value)
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.add(node.args[0].value)
+    return out
+
+
+def _peer_reader_keys(peer_sf, msg, graph, depth=2) -> set[str]:
+    """Keys read by the peer functions that reference ``msg``, plus
+    their import-resolved callees ``depth`` hops out (the handler
+    delegates to observe_dump/trace_dump)."""
+    keys: set[str] = set()
+    seeds = []
+    for fn, _qual, _cls in walk_functions(peer_sf.tree):
+        for node in ast.walk(fn):
+            if _is_msg_token(node) == msg:
+                seeds.append(fn)
+                break
+    seen: set[int] = set()
+    frontier = [(fn, 0) for fn in seeds]
+    while frontier:
+        fn, d = frontier.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        keys |= _read_keys_in(fn)
+        if d >= depth:
+            continue
+        fi = graph.info_for(fn)
+        if fi is None:
+            continue
+        for _call, _l, _c, _held, ks in fi.calls:
+            for key in ks or ():
+                callee = graph.funcs.get(key)
+                if callee is not None:
+                    frontier.append((callee.node, d + 1))
+    return keys
+
+
+def _check_json_fields(files, by_dir):
+    graph = get_graph(files)
+    # Fallback read-key pool (reply payloads are returned opaquely by
+    # the client and consumed by the CLI/tests/monitor layers).  The
+    # pool for one seam is its OWN directory plus every non-seam file:
+    # another seam's equally-named keys must not mask this seam's
+    # dropped field.
+    global_reads: dict[str, set[str]] = {
+        path: _read_keys_in(sf.tree) for path, sf in files.items()
+    }
+    seam_dirs = {
+        d for d, g in by_dir.items()
+        if "service.py" in g and "client.py" in g
+    }
+    for dirname, group in sorted(by_dir.items()):
+        pair = {"service.py": "client.py", "client.py": "service.py"}
+        for base, peer_base in pair.items():
+            sf = group.get(base)
+            peer = group.get(peer_base)
+            if sf is None or peer is None:
+                continue
+            for msg, inner, fn, line, col in _json_send_sites(sf):
+                written = _written_keys(inner, fn, sf, graph)
+                if not written:
+                    continue
+                peer_keys = _peer_reader_keys(peer, msg, graph)
+                missing = sorted(written - peer_keys)
+                for key in missing:
+                    read_somewhere = any(
+                        key in ks
+                        for path, ks in global_reads.items()
+                        if path != sf.path and (
+                            os.path.dirname(path) == dirname
+                            or os.path.dirname(path) not in seam_dirs
+                        )
+                    )
+                    if read_somewhere:
+                        continue
+                    yield Finding(
+                        "R5", sf.path, line, col,
+                        f"json field {key!r} of {msg} is written here "
+                        f"but never read by {peer_base}'s handler "
+                        f"chain nor any consumer in the tree — a "
+                        f"dropped field passes the message-name "
+                        f"coverage check silently",
+                        symbol=msg,
+                    )
+
+
 def check_r5(files):
     # --- MSG coverage, per directory holding a wire.py ---
     by_dir: dict[str, dict[str, object]] = {}
@@ -76,6 +308,8 @@ def check_r5(files):
         base = os.path.basename(path)
         if base in ("wire.py", "service.py", "client.py"):
             by_dir.setdefault(os.path.dirname(path), {})[base] = sf
+
+    yield from _check_json_fields(files, by_dir)
 
     for dirname, group in sorted(by_dir.items()):
         wire = group.get("wire.py")

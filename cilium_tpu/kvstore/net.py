@@ -201,11 +201,21 @@ class _Session:
     def send(self, obj: dict) -> None:
         with self.wlock:
             try:
+                # Bounded by SO_SNDTIMEO (set at accept): a wedged-but-
+                # alive subscriber that stops reading costs ONE bounded
+                # wait here, never a forever-parked pump thread.
+                # lint: disable=R2 -- wlock exists to serialize frame writes on this socket; the sendall is deadline-bounded and a timeout tears the session down below
                 _send_frame(self.sock, obj)
             except OSError as e:
-                # Reader notices the dead socket and cleans up.
+                # A dead peer's reader cleans up on its own; a TIMED
+                # OUT send means a wedged-alive peer (or a partial
+                # write that desynced the framing) — the reader would
+                # never notice either, so tear the session down here:
+                # shutdown wakes the serve() recv, whose cleanup stops
+                # watches, releases locks, and revokes leases.
                 self.server.counters.inc("server_send_failed")
                 log.debug("kvstore session %s send failed: %s", self.peer, e)
+                shutdown_close(self.sock)
 
     def serve(self) -> None:
         try:
@@ -464,9 +474,18 @@ class KvstoreServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  backend: Backend | None = None,
                  snapshot_path: str | None = None,
-                 role: str = "primary") -> None:
+                 role: str = "primary",
+                 send_timeout: float = 5.0) -> None:
         from .local import FileBackend
 
+        # Slow-consumer containment: session sends are bounded (the
+        # interprocedural lint's blocking-through-helper finding — a
+        # subscriber that stops reading used to park _pump_watch in
+        # sendall forever under the session wlock, with the session's
+        # watches/locks/leases pinned alive).  SO_SNDTIMEO over
+        # settimeout() so the serve loop's recv stays unbounded: idle
+        # sessions are normal, wedged WRITES are not.
+        self.send_timeout = send_timeout
         if backend is None:
             backend = (
                 FileBackend(snapshot_path) if snapshot_path
@@ -515,6 +534,11 @@ class KvstoreServer:
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.send_timeout:
+                sec = int(self.send_timeout)
+                usec = int((self.send_timeout - sec) * 1_000_000)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                                struct.pack("ll", sec, usec))
             sess = _Session(self, sock, f"{addr[0]}:{addr[1]}")
             with self._mutex:
                 self._sessions.append(sess)
@@ -1085,6 +1109,7 @@ class NetBackend(Backend):
                 try:
                     # Walks the failover list: a dead primary falls
                     # through to the follower.
+                    # lint: disable=R2 -- one reconnect per generation holds _reconnect_lock across the dial by design; contenders need this attempt's outcome and each dial leg is settimeout-bounded
                     sock = self._dial_any()
                     break
                 except KvstoreError:
@@ -1118,6 +1143,7 @@ class NetBackend(Backend):
             reader.start()
             # Replay session-owned state on the fresh session.
             try:
+                # lint: disable=R2 -- replay must finish before any contender sees the fresh generation; its sleeps/sends are backoff- and timeout-bounded
                 self._replay_session()
             except KvstoreError as e:
                 if isinstance(e, (EpochFencedError, NotPrimaryError)):
@@ -1334,6 +1360,7 @@ class NetBackend(Backend):
         req["id"] = rid
         with self._wlock:
             try:
+                # lint: disable=R2 -- _wlock exists to serialize frame writes on the shared socket; a dead peer raises immediately and a wedged one is bounded by the reader's liveness teardown
                 _send_frame(self.sock, req)
             except OSError as e:
                 with self._mutex:

@@ -498,6 +498,7 @@ class SidecarClient:
                 with self._clock:
                     self._control_evt.clear()
                     msg_type, payload = build()
+                    # lint: disable=R2 -- _clock serializes the control request/response pairing (one outstanding RPC by design); _send fails typed+fast on a dead socket and its own _wlock wedge handling is bounded
                     self._send(msg_type, payload)
                     if not self._control_evt.wait(self.timeout):
                         if not self._alive:

@@ -1,0 +1,22 @@
+"""KNOWN-BAD corpus: sharding-spec arity drift — in_specs shorter than
+the step signature, out_specs disagreeing with the return tuple.  Both
+only explode at first trace ON A MESH, which single-chip CI never
+runs."""
+
+from functools import partial
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+P = jax.sharding.PartitionSpec
+MESH = None
+
+
+@partial(shard_map, mesh=MESH, in_specs=(P("rules"), P("flows")), out_specs=P("flows"))  # EXPECT[R10]
+def step(model, data, lengths):
+    return lengths
+
+
+@partial(shard_map, mesh=MESH, in_specs=(P("rules"), P("flows"), P("flows")), out_specs=(P("flows"), P("flows")))  # EXPECT[R10]
+def step3(model, data, lengths):
+    return data, lengths, model

@@ -1,0 +1,20 @@
+"""KNOWN-GOOD corpus for R10: in_specs matches the positional
+signature, out_specs matches the return tuple."""
+
+from functools import partial
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+P = jax.sharding.PartitionSpec
+MESH = None
+
+
+@partial(shard_map, mesh=MESH, in_specs=(P("rules"), P("flows"), P("flows")), out_specs=P("flows"))
+def step(model, data, lengths):
+    return lengths
+
+
+@partial(shard_map, mesh=MESH, in_specs=(P("rules"), P("flows"), P("flows")), out_specs=(P("flows"), P("flows"), P("flows")))
+def step3(model, data, lengths):
+    return data, lengths, model

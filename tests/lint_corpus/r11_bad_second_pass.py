@@ -1,0 +1,48 @@
+"""KNOWN-BAD corpus: fused-attribution integrity — three shapes of
+"attribution pays a second device pass": the attr twin calling the
+plain twin, twins on DIVERGED hit helpers, and the shared helper
+invoked twice."""
+
+import jax.numpy as jnp
+
+
+def _toy_rule_hits(model, data):
+    return data @ model
+
+
+def toy_verdicts(model, data):
+    hits = _toy_rule_hits(model, data)
+    return jnp.any(hits, axis=1)
+
+
+def toy_verdicts_attr(model, data):  # EXPECT[R11]
+    allow = toy_verdicts(model, data)
+    hits = _toy_rule_hits(model, data)
+    return allow, jnp.argmax(hits, axis=1)
+
+
+def _hits_a(model, data):
+    return data @ model
+
+
+def _hits_b(model, data):
+    return (data + 1) @ model
+
+
+def fan_verdicts(model, data):
+    return jnp.any(_hits_a(model, data), axis=1)
+
+
+def fan_verdicts_attr(model, data):  # EXPECT[R11]
+    h = _hits_b(model, data)
+    return jnp.any(h, axis=1), jnp.argmax(h, axis=1)
+
+
+def twice_verdicts(model, data):
+    return jnp.any(_hits_a(model, data), axis=1)
+
+
+def twice_verdicts_attr(model, data):  # EXPECT[R11]
+    allow = jnp.any(_hits_a(model, data), axis=1)
+    rule = jnp.argmax(_hits_a(model, data), axis=1)
+    return allow, rule

@@ -1,0 +1,19 @@
+"""KNOWN-GOOD corpus for R11: one shared hit-matrix pass, two
+reductions — the PR 5 fused-attribution design."""
+
+import jax.numpy as jnp
+
+
+def _toy_rule_hits(model, data):
+    return data @ model
+
+
+def toy_verdicts(model, data):
+    hits = _toy_rule_hits(model, data)
+    return jnp.any(hits, axis=1)
+
+
+def toy_verdicts_attr(model, data):
+    hits = _toy_rule_hits(model, data)
+    allow = jnp.any(hits, axis=1)
+    return allow, jnp.where(allow, jnp.argmax(hits, axis=1), -1)

@@ -1,0 +1,19 @@
+"""KNOWN-BAD corpus (cross-module deadlock pair, half 1): flush()
+holds the STORE lock and calls into watcher, which takes the WATCH
+lock — locally sane."""
+
+import threading
+
+import watcher
+
+_store_lock = threading.Lock()
+
+
+def flush():
+    with _store_lock:
+        watcher.notify_all()  # EXPECT[R1]
+
+
+def flush_all():
+    with _store_lock:
+        pass

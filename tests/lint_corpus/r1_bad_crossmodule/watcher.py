@@ -1,0 +1,22 @@
+"""KNOWN-BAD corpus (cross-module deadlock pair, half 2): rescan()
+holds the WATCH lock and calls back into store, which takes the STORE
+lock — also locally sane.  Together the two halves are the classic
+distributed inversion: thread A in store.flush, thread B in
+watcher.rescan, each waiting on the other's lock, in DIFFERENT
+modules where no per-file rule can see the cycle."""
+
+import threading
+
+import store
+
+_watch_lock = threading.Lock()
+
+
+def notify_all():
+    with _watch_lock:
+        pass
+
+
+def rescan():
+    with _watch_lock:
+        store.flush_all()  # EXPECT[R1]

@@ -1,0 +1,17 @@
+"""KNOWN-BAD corpus (blocking through a helper): the lock holder calls
+a clean-looking helper whose callee sendalls — the helper boundary
+must not launder the stall."""
+
+import threading
+
+import sockhelpers
+
+
+class Pump:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self.sock = None
+
+    def push(self, frame):
+        with self._mutex:
+            sockhelpers.ship(self.sock, frame)  # EXPECT[R2]

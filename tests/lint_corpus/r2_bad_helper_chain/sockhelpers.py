@@ -1,0 +1,10 @@
+"""Helper module for the R2 taint corpus: ship() -> _write_frame() ->
+sendall, two hops from the lock."""
+
+
+def _write_frame(sock, frame):
+    sock.sendall(frame)
+
+
+def ship(sock, frame):
+    _write_frame(sock, frame)

@@ -1,0 +1,20 @@
+"""KNOWN-BAD corpus (JSON field symmetry, service side): the handler
+reads only "n" (the client's "kind" filter is dropped), and the reply
+carries a "zombie" field no consumer anywhere reads."""
+
+import json
+
+import wire
+
+
+class Service:
+    def snapshot(self):
+        return {"spans": [], "zombie": 1}
+
+    def handle(self, msg_type, payload):
+        if msg_type == wire.MSG_QUERY:
+            req = json.loads(payload.decode())
+            n = int(req.get("n", 10))
+            assert n >= 0
+            return (wire.MSG_QUERY_REPLY, json.dumps(self.snapshot()).encode())  # EXPECT[R5]
+        return None

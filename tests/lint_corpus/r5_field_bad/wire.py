@@ -1,0 +1,5 @@
+"""KNOWN-BAD corpus (JSON field symmetry): wire constants for a
+query/reply seam whose payloads are json.dumps dicts."""
+
+MSG_QUERY = 1
+MSG_QUERY_REPLY = 2

@@ -1,0 +1,23 @@
+"""KNOWN-GOOD corpus (JSON field symmetry, client side)."""
+
+import json
+
+import wire
+
+
+class Client:
+    def _rpc(self, msg):
+        return b"{}"
+
+    def query(self, n, kind=None):
+        req = {"n": int(n)}
+        if kind:
+            req["kind"] = kind
+        out = self._rpc((wire.MSG_QUERY, json.dumps(req).encode()))
+        return json.loads(out.decode())
+
+    def spans(self):
+        return self.query(5).get("spans", [])
+
+    def is_reply(self, msg_type):
+        return msg_type == wire.MSG_QUERY_REPLY

@@ -1,0 +1,20 @@
+"""KNOWN-GOOD corpus (JSON field symmetry, service side): the handler
+honors both request fields; every reply field has a consumer."""
+
+import json
+
+import wire
+
+
+class Service:
+    def snapshot(self, kind):
+        return {"spans": [k for k in (kind,) if k]}
+
+    def handle(self, msg_type, payload):
+        if msg_type == wire.MSG_QUERY:
+            req = json.loads(payload.decode())
+            n = int(req.get("n", 10))
+            kind = req.get("kind")
+            assert n >= 0
+            return (wire.MSG_QUERY_REPLY, json.dumps(self.snapshot(kind)).encode())
+        return None

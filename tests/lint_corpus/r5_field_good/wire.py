@@ -1,0 +1,5 @@
+"""KNOWN-GOOD corpus (JSON field symmetry): same seam, every field
+read on the far side."""
+
+MSG_QUERY = 1
+MSG_QUERY_REPLY = 2

@@ -1,0 +1,25 @@
+"""KNOWN-BAD corpus: recompilation hazards in jit-reached code —
+Python-scalar concretization, weak-typed constants, unhashable static
+args."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def score(data, lengths):
+    scale = float(lengths)  # EXPECT[R8]
+    bias = jnp.array(0.5)  # EXPECT[R8]
+    fill = jnp.full((4,), 1.5)  # EXPECT[R8]
+    return data * scale + bias + fill
+
+
+@partial(jax.jit, static_argnums=(1,))
+def gather(data, cols):
+    return data[:, cols]
+
+
+def caller(data):
+    return gather(data, [0, 1, 2])  # EXPECT[R8]

@@ -1,0 +1,24 @@
+"""KNOWN-GOOD corpus for R8: pinned dtypes, hashable static args —
+one executable per shape, forever."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def score(data, lengths):
+    scale = jnp.asarray(lengths, jnp.float32)
+    bias = jnp.array(0.5, jnp.float32)
+    fill = jnp.full((4,), 1.5, dtype=jnp.float32)
+    return data * scale + bias + fill
+
+
+@partial(jax.jit, static_argnums=(1,))
+def gather(data, cols):
+    return data[:, cols]
+
+
+def caller(data):
+    return gather(data, (0, 1, 2))

@@ -1,0 +1,13 @@
+"""KNOWN-BAD corpus: implicit host transfers inside a traced function
+— np coercion, .item(), block_until_ready on traced values."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def verdicts(data, lengths):
+    host = np.asarray(lengths)  # EXPECT[R9]
+    first = lengths.item()  # EXPECT[R9]
+    ready = data.block_until_ready()  # EXPECT[R9]
+    return host, first, ready

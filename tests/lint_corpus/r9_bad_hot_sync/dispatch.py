@@ -1,0 +1,10 @@
+"""KNOWN-BAD corpus (hot-path module name): per-entry host syncs on
+the dispatch path — block_until_ready / .item() outside the fenced
+readback."""
+
+
+class Dispatcher:
+    def _finish(self, out):
+        out.block_until_ready()  # EXPECT[R9]
+        first = out[0].item()  # EXPECT[R9]
+        return first

@@ -1,0 +1,19 @@
+"""KNOWN-GOOD corpus for R9: the traced function is pure jnp; the
+fenced np.asarray readback lives on the HOST side of the boundary
+(and dtype-scalar constants on literals are device-free)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def verdicts(data, lengths):
+    mask = jnp.asarray(lengths, jnp.int32) >= np.int32(0)
+    return mask & (data[:, 0] > 0)
+
+
+def readback(out):
+    # The sanctioned sync point: one fenced readback of the whole
+    # batch, indexed on host.
+    return np.asarray(out)

@@ -1,0 +1,183 @@
+"""Device-contract verification (R8-R11) by abstract tracing — tier-1.
+
+Everything here runs under JAX_PLATFORMS=cpu via eval_shape/
+make_jaxpr: no device, no model execution, no buffers.  Three layers:
+
+1. **Contract gate** — the real verdict models (http, r2d2, seam
+   probe) and the sharded steps verify clean: stable deterministic
+   jaxprs, no weak-typed outputs, no host-callback primitives, fused
+   attribution within the equation budget, sharding specs that trace
+   under a real (1x1) mesh.
+2. **Checker sensitivity** — deliberately-broken models must be
+   CAUGHT: a weak-type leak, a host callback, a Python branch on
+   traced data, and the PR 5 bug shape (a second device pass for
+   attribution).  A checker that stops failing these is dead weight.
+3. **CLI surface** — ``cilium-lint --device-contracts`` runs the same
+   layer.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+CORPUS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "lint_corpus")
+
+from cilium_tpu.analysis.devicecheck import (
+    ATTR_EXTRA_EQNS,
+    _check_model,
+    _check_sharded,
+    _iter_eqns,
+    check_device_contracts,
+)
+from cilium_tpu.models.base import first_match
+from cilium_tpu.models.r2d2 import (
+    _r2d2_rule_hits,
+    build_r2d2_model_from_rows,
+)
+
+
+# --- 1. contract gate -----------------------------------------------------
+
+def test_device_contracts_clean():
+    findings = check_device_contracts()
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_attr_jaxpr_is_plain_plus_bounded_epilogue():
+    """The R11 margin is meaningful: the real fused models sit WELL
+    inside the budget, so version-drift noise cannot flap the gate."""
+    model = build_r2d2_model_from_rows([
+        (frozenset(), "OPEN", "/etc/.*"),
+        (frozenset({3}), "", "docs/[a-z]+"),
+    ])
+    args = (
+        jax.ShapeDtypeStruct((8, 128), jnp.uint8),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+    )
+    n_plain = sum(1 for _ in _iter_eqns(
+        jax.make_jaxpr(model.__call__)(*args).jaxpr))
+    n_attr = sum(1 for _ in _iter_eqns(
+        jax.make_jaxpr(model.verdicts_attr)(*args).jaxpr))
+    assert n_attr <= n_plain + ATTR_EXTRA_EQNS
+    # A second hits pass would land near 2x; assert real headroom.
+    assert n_attr < 1.5 * n_plain
+
+
+# --- 2. checker sensitivity -----------------------------------------------
+
+class _WeakTypeModel:
+    def __call__(self, data, lengths, remotes):
+        ok = jnp.asarray(lengths) >= 0
+        return ok, jnp.asarray(lengths) * 1.5, ok  # weak float leaks
+
+
+class _CallbackModel:
+    def __call__(self, data, lengths, remotes):
+        ok = jnp.asarray(lengths) >= 0
+        echoed = jax.pure_callback(
+            lambda v: v,
+            jax.ShapeDtypeStruct(lengths.shape, jnp.int32),
+            lengths,
+        )
+        return ok, echoed, ok
+
+
+class _BranchModel:
+    def __call__(self, data, lengths, remotes):
+        if lengths[0] > 0:  # Python branch on traced data
+            return lengths, lengths, lengths
+        return lengths, lengths, lengths
+
+
+def _two_pass_model():
+    base = build_r2d2_model_from_rows([
+        (frozenset(), "OPEN", "/etc/.*"),
+        (frozenset({3}), "", "docs/[a-z]+"),
+    ])
+
+    class _TwoPass:
+        def __call__(self, d, l, r):
+            c, m, h = _r2d2_rule_hits(base, d, l, r)
+            return c, m, jnp.any(h, axis=1)
+
+        def verdicts_attr(self, d, l, r):
+            c, m, allow = self(d, l, r)  # pass 1
+            _, _, h = _r2d2_rule_hits(base, d, l, r)  # pass 2 (bug)
+            return c, m, allow, first_match(h, allow)
+
+    return _TwoPass()
+
+
+@pytest.mark.parametrize("model,rule,needle", [
+    (_WeakTypeModel(), "R8", "weak_type"),
+    (_CallbackModel(), "R9", "callback"),
+    (_BranchModel(), "R8", "trace"),
+], ids=["weak-type-leak", "host-callback", "python-branch"])
+def test_checker_catches_broken_models(model, rule, needle):
+    findings = _check_model("broken", "x.py", model)
+    assert any(
+        f.rule == rule and needle in f.message for f in findings
+    ), [f.render() for f in findings]
+
+
+def test_checker_catches_second_device_pass():
+    """The pinned PR 5 bug shape: attribution recomputes the hit
+    matrix — results bit-identical, device cost doubled, invisible to
+    every parity test.  The equation-count contract must catch it."""
+    findings = _check_model("twopass", "x.py", _two_pass_model())
+    assert any(
+        f.rule == "R11" and "SECOND device pass" in f.message
+        for f in findings
+    ), [f.render() for f in findings]
+
+
+def test_sharded_step_traces_on_cpu_mesh():
+    assert _check_sharded() == []
+
+
+# --- 3. CLI surface -------------------------------------------------------
+
+def test_cli_device_contracts_flag(capsys):
+    from cilium_tpu.analysis.cli import main as lint_main
+
+    rc = lint_main(["--device-contracts", "cilium_tpu/analysis"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_device_contract_findings_are_baselinable(
+    tmp_path, capsys, monkeypatch
+):
+    """Device-contract findings carry no source line, so a pragma can
+    never reach them — the baseline's accepted list must work as the
+    escape hatch (a jax upgrade shifting an equation count can't be
+    allowed to permanently brick the gate)."""
+    import json
+
+    from cilium_tpu.analysis import devicecheck
+    from cilium_tpu.analysis.cli import main as lint_main
+    from cilium_tpu.analysis.core import Finding
+
+    fake = Finding("R11", "cilium_tpu/models/r2d2.py", 0, 0,
+                   "[device-contract:r2d2] pretend drift", symbol="r2d2")
+    monkeypatch.setattr(devicecheck, "check_device_contracts",
+                        lambda: [fake])
+    target = os.path.join(CORPUS_DIR, "r11_good_fused.py")
+    # Unbaselined: the injected finding fails the run.
+    assert lint_main(["--device-contracts", "--no-baseline",
+                      target]) == 1
+    capsys.readouterr()
+    # Accepted in the baseline: the same finding is muted.
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        {"accepted": [{"rule": "R11", "file": "models/r2d2.py"}],
+         "max_suppressed": 5}
+    ))
+    fake.baselined = False
+    assert lint_main(["--device-contracts", "--baseline",
+                      str(baseline), target]) == 0
+    capsys.readouterr()

@@ -15,14 +15,28 @@ caused, so a revert fails here and not in a soak:
   client mutex forever.
 - R3 @ monitor/accesslog close(): shutdown-then-close lets a server be
   closed and immediately re-created on the same path, acceptors gone.
+
+PR 6 (interprocedural R2 — blocking-through-helper):
+
+- R2 @ kvstore/net.py `_Session.send` -> `_send_frame` -> sendall: a
+  watch subscriber that stops READING (wedged-alive, not dead) used to
+  park the server's _pump_watch thread in sendall forever under the
+  session wlock — the reader never notices a peer that is merely not
+  consuming, so the session's watches/locks/leases stayed pinned to
+  process exit.  Sends are now SO_SNDTIMEO-bounded and a timed-out
+  send tears the session down fail-closed (wakes the serve() recv,
+  whose cleanup revokes leases and stops watches).
 """
 
+import json
 import socket
+import struct
 import threading
 import time
 
 from cilium_tpu.accesslog.record import LogRecord
 from cilium_tpu.accesslog.server import AccessLogClient, AccessLogServer
+from cilium_tpu.kvstore import KvstoreServer, NetBackend
 from cilium_tpu.kvstore.chaos import ChaosProxy
 from cilium_tpu.monitor.monitor import Monitor, MonitorEvent
 from cilium_tpu.monitor.server import MonitorClient, MonitorServer
@@ -154,6 +168,73 @@ def test_accesslog_client_bounded_against_wedged_collector(tmp_path):
     finally:
         cli.close()
         wedged.close()
+
+
+def test_kvstore_server_contains_wedged_watch_subscriber():
+    # A subscriber that registers a watch and then stops READING: its
+    # TCP buffers fill, and the server's _pump_watch thread used to
+    # park in sendall forever holding the session wlock (the "reader
+    # notices a dead socket" cleanup assumption is false for a
+    # wedged-ALIVE peer).  With bounded sends the wedged session must
+    # be torn down within the timeout while healthy clients keep
+    # being served.
+    srv = KvstoreServer(send_timeout=0.5)
+    healthy = None
+    wedged = None
+    try:
+        host, _, port = srv.address.rpartition(":")
+        wedged = socket.create_connection((host, int(port)), timeout=5.0)
+        frame = json.dumps(
+            {"id": 1, "op": "watch", "wid": 1, "key": "w/",
+             "name": "wedge"}
+        ).encode()
+        wedged.sendall(struct.pack(">I", len(frame)) + frame)
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if len(srv._sessions) >= 1 and any(
+                s.watches for s in srv._sessions
+            ):
+                break
+            time.sleep(0.01)
+        assert any(s.watches for s in srv._sessions), "watch not armed"
+        # ... and never recv() again: the wedged-alive shape.
+
+        healthy = NetBackend(srv.address)
+        # Big values fill the server-side send buffer within a few
+        # events; the pump's bounded sendall then times out and the
+        # session is torn down fail-closed.
+        blob = b"x" * 65536
+        torn = False
+        deadline = time.monotonic() + 20.0
+        i = 0
+        while time.monotonic() < deadline:
+            healthy.set(f"w/k{i % 4}", blob)
+            i += 1
+            if srv.counters.snapshot().get("server_send_failed", 0):
+                torn = True
+                break
+        assert torn, (
+            "wedged subscriber never hit the bounded-send teardown — "
+            "the SO_SNDTIMEO containment regressed"
+        )
+        # The wedged session is dropped (its watches stopped, leases
+        # revocable) and the healthy client is still fully served.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if not any(s.watches for s in srv._sessions):
+                break
+            time.sleep(0.02)
+        assert not any(s.watches for s in srv._sessions), (
+            "wedged session still registered after send teardown"
+        )
+        healthy.set("w/final", b"ok")
+        assert healthy.get("w/final") == b"ok"
+    finally:
+        if healthy is not None:
+            healthy.close()
+        if wedged is not None:
+            wedged.close()
+        srv.close()
 
 
 def test_accesslog_server_survives_same_path_restart(tmp_path):

@@ -100,14 +100,22 @@ _CORPUS_CASES = [
     "r1_bad_reread_release.py",
     "r1_bad_unpaired.py",
     "r1_bad_lock_order.py",
+    "r1_bad_crossmodule",
     "r2_bad_blocking.py",
+    "r2_bad_helper_chain",
     "r3_bad_bare_close.py",
     "r4_bad_impure_jit.py",
     "r5_bad",
     "r5_bad_verdict_dispatch.py",
+    "r5_field_bad",
     "r6_bad_thread.py",
     "r7_bad_dead_metric",
     "r7_bad_hot_observe",
+    "r8_bad_recompile.py",
+    "r9_bad_host_transfer.py",
+    "r9_bad_hot_sync",
+    "r10_bad_specs.py",
+    "r11_bad_second_pass.py",
 ]
 
 _CORPUS_CLEAN = [
@@ -120,9 +128,15 @@ _CORPUS_CLEAN = [
     "r4_good_pure_jit.py",
     "r5_good",
     "r5_good_verdict_gate.py",
+    "r5_field_good",
     "r6_good_thread.py",
     "r7_good_metrics",
     "r7_good_hot_observe",
+    "r8_good_stable.py",
+    "r9_good_fenced.py",
+    "r9_good_hot_sync",
+    "r10_good_specs.py",
+    "r11_good_fused.py",
 ]
 
 
@@ -187,6 +201,98 @@ def test_catches_dead_metric_and_hot_loop_observe():
     active, _ = split_findings(analyze_paths([path]))
     assert [f.rule for f in active] == ["R7", "R7", "R7"]
     assert all("hot loop" in f.message for f in active)
+
+
+def test_interprocedural_lock_graph_spans_two_modules():
+    """PR 6's acceptance pin: the whole-program R1 lock-order graph
+    sees a deadlock cycle whose two halves live in DIFFERENT modules —
+    store.py nests the watch lock inside the store lock through an
+    import-resolved call, watcher.py nests the opposite way.  Both
+    call sites are flagged, each naming the cycle."""
+    path = os.path.join(CORPUS, "r1_bad_crossmodule")
+    active, _ = split_findings(analyze_paths([path]))
+    assert {os.path.basename(f.path) for f in active} == {
+        "store.py", "watcher.py"
+    }
+    assert all(f.rule == "R1" for f in active)
+    assert all("lock-order cycle" in f.message for f in active)
+    # Each finding names BOTH lock identities' terminals.
+    for f in active:
+        assert "_store_lock" in f.message and "_watch_lock" in f.message
+
+
+def test_multi_item_with_counts_as_nesting(tmp_path):
+    """``with a, b:`` is the same nesting as two nested withs — both
+    the lexical R1.3 check and the whole-program R1.4 graph must see
+    it (one side of a cross-file cycle written in the compact form
+    used to slip through)."""
+    (tmp_path / "one.py").write_text(
+        "import threading\n"
+        "_a_lock = threading.Lock()\n"
+        "_b_lock = threading.Lock()\n\n\n"
+        "def fwd():\n"
+        "    with _a_lock:\n"
+        "        with _b_lock:\n"
+        "            pass\n"
+    )
+    (tmp_path / "two.py").write_text(
+        "from one import _a_lock, _b_lock\n\n\n"
+        "def rev():\n"
+        "    with _b_lock, _a_lock:\n"
+        "        pass\n"
+    )
+    active, _ = split_findings(analyze_paths([str(tmp_path)]))
+    cyc = [f for f in active if "lock-order cycle" in f.message]
+    assert {os.path.basename(f.path) for f in cyc} == {
+        "one.py", "two.py"
+    }, [f.render() for f in active]
+    # Same-statement self-deadlock, compact form.
+    (tmp_path / "three.py").write_text(
+        "import threading\n"
+        "_c_lock = threading.Lock()\n\n\n"
+        "def twice():\n"
+        "    with _c_lock, _c_lock:\n"
+        "        pass\n"
+    )
+    active, _ = split_findings(
+        analyze_paths([str(tmp_path / "three.py")])
+    )
+    assert any("self-deadlock" in f.message for f in active)
+
+
+def test_blocking_taint_names_the_helper_chain():
+    """R2's interprocedural half: a sendall two import-resolved hops
+    away from the lock is flagged AT the lock-holding call site, with
+    the chain in the message."""
+    path = os.path.join(CORPUS, "r2_bad_helper_chain")
+    active, _ = split_findings(analyze_paths([path]))
+    assert [f.rule for f in active] == ["R2"]
+    msg = active[0].message
+    assert "ship" in msg and "_write_frame" in msg
+    assert "sendall" in msg
+    assert os.path.basename(active[0].path) == "pump.py"
+
+
+def test_catches_second_device_pass_for_attribution():
+    """The pinned R11 bug shape: verdicts_attr re-running the verdict
+    (or hits) pass — bit-identical results, doubled device cost."""
+    path = os.path.join(CORPUS, "r11_bad_second_pass.py")
+    active, _ = split_findings(analyze_paths([path]))
+    assert all(f.rule == "R11" for f in active)
+    msgs = " | ".join(f.message for f in active)
+    assert "SECOND device pass" in msgs
+    assert "share ONE" in msgs or "diverged" in msgs or "hits" in msgs
+
+
+def test_json_field_symmetry_catches_dropped_fields():
+    """R5's field-level half: a request filter the service never reads
+    and a reply field no consumer reads are both findings — message-
+    name coverage alone said this seam was fine."""
+    path = os.path.join(CORPUS, "r5_field_bad")
+    active, _ = split_findings(analyze_paths([path]))
+    assert all(f.rule == "R5" for f in active)
+    msgs = " | ".join(f.message for f in active)
+    assert "'kind'" in msgs and "'zombie'" in msgs
 
 
 def test_pragma_in_string_neither_suppresses_nor_flags():
@@ -271,8 +377,183 @@ def test_cli_fails_closed_on_zero_python_files(tmp_path, capsys):
 def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule in ("R1", "R2", "R3", "R4", "R5", "R6", "R7"):
-        assert rule in out
+    for rule in ("R1", "R2", "R3", "R4", "R5", "R6", "R7",
+                 "R8", "R9", "R10", "R11"):
+        assert f"{rule} " in out
+
+
+# --- 4. ratchet -----------------------------------------------------------
+
+def _suppressed_corpus(tmp_path):
+    """A scan target with exactly one pragma-suppressed finding."""
+    src = os.path.join(CORPUS, "r0_good_pragma.py")
+    dst = tmp_path / "suppressed.py"
+    with open(src, "r", encoding="utf-8") as f:
+        dst.write_text(f.read())
+    return str(dst)
+
+
+def test_ratchet_tree_gate():
+    """Tier-1 wiring: the shipped tree honors its recorded ratchet."""
+    assert lint_main(["--ratchet", "--baseline", BASELINE, PKG]) == 0
+
+
+def test_ratchet_fails_closed_without_recorded_count(tmp_path, capsys):
+    target = _suppressed_corpus(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps([]))  # legacy list: no ratchet
+    rc = lint_main(["--ratchet", "--baseline", str(baseline), target])
+    assert rc == 2
+    assert "max_suppressed" in capsys.readouterr().err
+
+
+def test_ratchet_fails_on_suppression_growth(tmp_path, capsys):
+    target = _suppressed_corpus(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        {"accepted": [], "max_suppressed": 0}
+    ))
+    rc = lint_main(["--ratchet", "--baseline", str(baseline), target])
+    assert rc == 1
+    assert "RATCHET VIOLATION" in capsys.readouterr().err
+
+
+def test_ratchet_update_locks_in_progress(tmp_path, capsys):
+    target = _suppressed_corpus(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        {"accepted": [], "max_suppressed": 7}
+    ))
+    rc = lint_main(["--ratchet", "--ratchet-update",
+                    "--baseline", str(baseline), target])
+    assert rc == 0
+    capsys.readouterr()
+    recorded = json.loads(baseline.read_text())["max_suppressed"]
+    # r0_good_pragma.py carries exactly one justified suppression.
+    assert recorded == 1
+    # ... and the lowered number now gates.
+    assert lint_main(["--ratchet", "--baseline", str(baseline),
+                      target]) == 0
+    capsys.readouterr()
+
+
+def test_ratchet_update_bootstraps_missing_count(tmp_path, capsys):
+    """A baseline without max_suppressed can be initialized by the
+    exact command the fail-closed error recommends."""
+    target = _suppressed_corpus(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps([]))
+    rc = lint_main(["--ratchet", "--ratchet-update",
+                    "--baseline", str(baseline), target])
+    assert rc == 0
+    capsys.readouterr()
+    assert json.loads(baseline.read_text())["max_suppressed"] == 1
+
+
+def test_ratchet_update_records_reviewed_bump(tmp_path, capsys):
+    """Growth with --ratchet-update is the reviewed-bump path: the
+    recorded number rises and subsequent plain --ratchet passes."""
+    target = _suppressed_corpus(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        {"accepted": [], "max_suppressed": 0}
+    ))
+    rc = lint_main(["--ratchet", "--ratchet-update",
+                    "--baseline", str(baseline), target])
+    assert rc == 0
+    capsys.readouterr()
+    assert json.loads(baseline.read_text())["max_suppressed"] == 1
+    assert lint_main(["--ratchet", "--baseline", str(baseline),
+                      target]) == 0
+    capsys.readouterr()
+
+
+def test_shipped_ratchet_matches_tree(tree_findings):
+    """The committed max_suppressed equals the tree's actual count —
+    a stale (too-high) number would leave headroom for silent new
+    suppressions."""
+    from cilium_tpu.analysis import load_baseline_full
+
+    _, muted = split_findings(tree_findings)
+    recorded = load_baseline_full(BASELINE)["max_suppressed"]
+    assert recorded == len(muted), (
+        f"ratchet drift: baseline allows {recorded}, tree has "
+        f"{len(muted)} — run bin/cilium-lint --ratchet "
+        f"--ratchet-update"
+    )
+
+
+# --- 5. cache + wall-clock budget -----------------------------------------
+
+def test_parse_cache_reuses_identical_content(tmp_path):
+    from cilium_tpu.analysis.core import _load_source
+
+    text = "x = 1\n"
+    a = _load_source(str(tmp_path / "m.py"), text)
+    b = _load_source(str(tmp_path / "m.py"), text)
+    assert a is b
+    c = _load_source(str(tmp_path / "m.py"), "x = 2\n")
+    assert c is not a
+
+
+def test_multi_dir_scan_keeps_interprocedural_precision():
+    """Same-stem files in different directories (two seams' client.py/
+    service.py, the corpus' many dispatch.py) must not clobber each
+    other's symbol tables: the bad twin keeps its findings when
+    scanned BESIDE its good twin, and one seam's reads never mask
+    another seam's dropped field."""
+    both = analyze_paths([
+        os.path.join(CORPUS, "r5_field_bad"),
+        os.path.join(CORPUS, "r5_field_good"),
+    ])
+    active, _ = split_findings(both)
+    got = {(os.path.basename(f.path), f.rule) for f in active}
+    assert got == {("client.py", "R5"), ("service.py", "R5")}, (
+        [f.render() for f in active]
+    )
+    # Cross-module lock cycle survives a combined scan too.
+    active, _ = split_findings(analyze_paths([
+        os.path.join(CORPUS, "r1_bad_crossmodule"),
+        os.path.join(CORPUS, "r2_bad_helper_chain"),
+    ]))
+    assert {os.path.basename(f.path) for f in active
+            if f.rule == "R1"} == {"store.py", "watcher.py"}
+
+
+def test_callgraph_memoized_by_content():
+    from cilium_tpu.analysis.callgraph import get_graph
+    from cilium_tpu.analysis.core import _load_source
+
+    path = os.path.join(CORPUS, "r1_good_captured.py")
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    files = {path: _load_source(path, text)}
+    assert get_graph(files) is get_graph(dict(files))
+
+
+def test_tree_lint_wall_clock_budget():
+    """The tier-1 gate must stay fast as the tree grows: one COLD
+    full-tree pass within budget, and the content-hash cache makes a
+    WARM pass near-free (this is what keeps the dozens of
+    analyze_paths calls in this file cheap)."""
+    import time
+
+    from cilium_tpu.analysis.callgraph import _GRAPH_CACHE
+    from cilium_tpu.analysis.core import _SF_CACHE
+
+    _GRAPH_CACHE.clear()
+    _SF_CACHE.clear()
+    t0 = time.monotonic()
+    analyze_paths([PKG])
+    cold = time.monotonic() - t0
+    t0 = time.monotonic()
+    analyze_paths([PKG])
+    warm = time.monotonic() - t0
+    assert cold < 120.0, f"cold full-tree lint took {cold:.1f}s"
+    assert warm < max(3.0, cold / 4), (
+        f"warm lint took {warm:.2f}s vs {cold:.2f}s cold — the "
+        f"content-hash cache regressed"
+    )
 
 
 def test_bin_entrypoint_runs():
