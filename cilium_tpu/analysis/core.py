@@ -36,13 +36,16 @@ RULE_DOCS = {
     "R1": "lock discipline: acquire/finally pairing, captured-binding "
           "release, recorded lock-order graph",
     "R2": "blocking call (socket/queue/join/sleep/device) inside a "
-          "held-lock region",
+          "held-lock region, or an unbounded spin-wait polling a "
+          "shared slot without backoff/deadline",
     "R3": "socket close() with no dominating shutdown() — zombie "
           "listener / wedged-reader bug class",
     "R4": "function reached from jax.jit/vmap/scan mutates self, takes "
           "locks, does I/O, or reads the wall clock",
     "R5": "wire MSG_* constants and FilterResult codes must be "
-          "exhaustively handled (or fall into a fail-closed default)",
+          "exhaustively handled (or fall into a fail-closed default); "
+          "pack_/unpack_ struct formats and JSON fields must be "
+          "symmetric across the seam",
     "R6": "threading.Thread(...) without daemon= or a local join — "
           "leaks past the conftest thread guard",
     "R7": "metric hygiene: registered-but-unreferenced metric "
@@ -54,8 +57,9 @@ RULE_DOCS = {
           "or unhashable static_argnums call sites",
     "R9": "implicit host transfer: .item()/host-numpy coercion/"
           "device_get inside a traced function, or "
-          "block_until_ready on the dispatch hot path (the fenced "
-          "np.asarray readback is the one sanctioned sync point)",
+          "block_until_ready / readiness spin-polls on the dispatch "
+          "hot path (the fenced np.asarray readback is the one "
+          "sanctioned sync point)",
     "R10": "sharding-spec consistency: shard_map/pjit in_specs arity "
            "must match the wrapped function's positional signature and "
            "out_specs its return tuple",

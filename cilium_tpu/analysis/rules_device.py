@@ -46,7 +46,7 @@ from .callgraph import get_graph
 from .core import Finding, call_func_name, terminal_name, unparse
 from .rules_jit import jit_reached
 
-_HOT_BASENAMES = {"dispatch.py", "service.py"}
+_HOT_BASENAMES = {"dispatch.py", "service.py", "shm.py"}
 _NP_NAMES = {"np", "numpy"}
 _JNP_NAMES = {"jnp", "numpy", "np"}  # jnp aliases checked w/ receiver
 _CONCRETIZERS = {"int", "float", "bool"}
@@ -293,6 +293,35 @@ def _r9_traced_body(sf, fn, qual):
             )
 
 
+_POLL_METHODS = {"is_ready", "is_deleted"}
+
+
+def _r9_spin_poll(path, sf):
+    """A ``while`` spinning on device-array readiness (is_ready /
+    is_deleted in the loop condition) in a hot-path module: the
+    device-future poll twin of R2.2's shared-slot spin — it burns a
+    core per outstanding round and hides the sync from the stage
+    histograms.  The fenced np.asarray readback (or the completion
+    pipeline's batched device_get) is the sanctioned wait."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.While):
+            continue
+        for sub in ast.walk(node.test):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _POLL_METHODS):
+                yield Finding(
+                    "R9", path, node.lineno, node.col_offset,
+                    f"spin-polling {sub.func.attr}() on the dispatch "
+                    f"hot path: the readiness loop burns a core per "
+                    f"outstanding round and the sync is invisible to "
+                    f"the stage histograms — use the fenced "
+                    f"np.asarray readback (or the completion "
+                    f"pipeline's batched device_get)",
+                )
+                break
+
+
 def _r9_hot_path(files):
     """In dispatch hot-path modules, the fenced np.asarray readback is
     the ONE sanctioned sync point; .item() / block_until_ready are
@@ -300,6 +329,7 @@ def _r9_hot_path(files):
     for path, sf in sorted(files.items()):
         if os.path.basename(path) not in _HOT_BASENAMES:
             continue
+        yield from _r9_spin_poll(path, sf)
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.Call):
                 continue

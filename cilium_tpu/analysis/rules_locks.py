@@ -27,6 +27,11 @@ R2 flags blocking calls — socket ops, ``queue.get``, ``Thread.join``,
 ``sleep``, device readbacks — lexically inside a held-lock ``with``
 region.  ``.wait()`` is exempt everywhere: Condition.wait RELEASES the
 lock, and flagging it would outlaw the dispatcher's core idiom.
+R2.2 flags unbounded spin-waits: a ``while`` polling a shared slot
+(subscript condition, or while-True with a subscript-compare break)
+with no backoff, blocking call, or deadline — the shared-memory ring
+transport's bug shape (its sanctioned shapes are doorbell-driven
+consumption or backoff+deadline).
 
 Both rules are WHOLE-PROGRAM since the interprocedural engine
 (``analysis/callgraph.py``) landed:
@@ -420,7 +425,139 @@ def _blocking_reason(call: ast.Call) -> str | None:
     return None
 
 
+# --- R2.2 spin-wait -------------------------------------------------------
+#
+# The shared-memory ring transport (sidecar/shm.py) made this bug shape
+# reachable: an unbounded ``while`` that polls a shared slot — a
+# subscript read in the loop condition, or a ``while True`` whose only
+# exit compares a subscripted read — without yielding (sleep / wait /
+# a blocking recv) and without bounding the wait (deadline / timeout /
+# retry budget).  Under the GIL a spinning consumer actively STARVES
+# the producer it waits on; the sanctioned shapes are doorbell-driven
+# consumption (no wait at all) or a backoff loop with a deadline.
+
+_SPIN_YIELDING = {
+    "sleep", "wait", "recv", "recv_into", "recv_msg", "accept", "get",
+    "select", "poll", "acquire", "join", "backoff",
+}
+_SPIN_BOUND_HINTS = (
+    "deadline", "timeout", "budget", "remaining", "retries", "attempts",
+    "waited", "tries",
+)
+
+
+def _spin_names(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _spin_bounded(node) -> bool:
+    return any(
+        any(h in name.lower() for h in _SPIN_BOUND_HINTS)
+        for name in _spin_names(node)
+    )
+
+
+def _spin_yields(body_nodes) -> bool:
+    for node in body_nodes:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and (
+                call_func_name(sub) in _SPIN_YIELDING
+            ):
+                return True
+    return False
+
+
+def _subscript_bases(node) -> set[str]:
+    """Terminal base names of Subscript LOADS in ``node``."""
+    out: set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript) and isinstance(sub.ctx, ast.Load):
+            base = sub.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            name = (base.attr if isinstance(base, ast.Attribute)
+                    else base.id if isinstance(base, ast.Name) else "")
+            if name:
+                out.add(name)
+    return out
+
+
+def _body_mutates(body_nodes, bases: set[str]) -> bool:
+    """True when the loop body writes/mutates any polled base — the
+    loop is making its own progress (growing a list, compacting a
+    buffer), not waiting on another thread."""
+    for node in body_nodes:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target]
+                           if isinstance(sub, ast.AugAssign)
+                           else sub.targets)
+                for t in targets:
+                    while isinstance(t, ast.Subscript):
+                        t = t.value
+                    name = (t.attr if isinstance(t, ast.Attribute)
+                            else t.id if isinstance(t, ast.Name) else "")
+                    if name in bases:
+                        return True
+            elif (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)):
+                recv = sub.func.value
+                name = (recv.attr if isinstance(recv, ast.Attribute)
+                        else recv.id if isinstance(recv, ast.Name)
+                        else "")
+                if name in bases:
+                    return True  # method call on the polled object
+    return False
+
+
+def _while_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _r2_spin_wait(files):
+    for sf in files.values():
+        for fn, qual, _cls in walk_functions(sf.tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.While):
+                    continue
+                bases = _subscript_bases(node.test)
+                if not bases and _while_true(node.test):
+                    # while True whose ONLY exits are subscript-compare
+                    # breaks: the poll moved into the body.
+                    for sub in node.body:
+                        for inner in ast.walk(sub):
+                            if (isinstance(inner, ast.If)
+                                    and any(isinstance(s, ast.Break)
+                                            for s in inner.body)):
+                                bases |= _subscript_bases(inner.test)
+                if not bases:
+                    continue
+                scope = [node.test, *node.body]
+                if _spin_yields(scope):
+                    continue
+                if any(_spin_bounded(s) for s in scope):
+                    continue
+                if _body_mutates(node.body, bases):
+                    continue
+                yield Finding(
+                    "R2", sf.path, node.lineno, node.col_offset,
+                    f"unbounded spin-wait polling shared slot(s) "
+                    f"{sorted(bases)} with no backoff, blocking call, "
+                    f"or deadline — under the GIL a spinning consumer "
+                    f"starves the very producer it waits on; use "
+                    f"doorbell-driven consumption or a "
+                    f"backoff+deadline loop",
+                    symbol=qual,
+                )
+
+
 def check_r2(files):
+    yield from _r2_spin_wait(files)
     for sf in files.values():
         for fn, qual, cls in walk_functions(sf.tree):
             if fn.name in _WRAPPER_FUNCS or _class_defines_release(cls):

@@ -1,6 +1,6 @@
 """R5 — wire / verdict exhaustiveness.
 
-Three halves:
+Four halves:
 
 - **MSG coverage.**  Every ``MSG_*`` constant defined in a ``wire.py``
   must be referenced by its sibling ``service.py`` AND ``client.py``
@@ -27,6 +27,12 @@ Three halves:
   written key must be read either by the PEER's handler chain
   (import-resolved, two hops deep) or — for reply payloads the client
   returns opaquely — by SOME consumer in the scanned tree.
+- **Struct field symmetry** (the MSG_SHM_* payloads).  For every
+  ``pack_X``/``unpack_X`` pair in a ``wire.py``, the struct format
+  literals used inside the pair must agree: a doorbell packed
+  ``<IQQ`` but unpacked ``<IQ`` silently truncates a cursor and the
+  ring protocol desynchronizes with no parse error — message-name
+  coverage alone cannot see it.
 """
 
 from __future__ import annotations
@@ -301,7 +307,72 @@ def _check_json_fields(files, by_dir):
                     )
 
 
+# --- struct field symmetry ------------------------------------------------
+
+_STRUCT_CALLS = {"pack", "pack_into", "unpack", "unpack_from", "Struct",
+                 "calcsize"}
+_FMT = re.compile(r"^[@=<>!]?[0-9xcbB?hHiIlLqQnNefdspP]+$")
+
+
+def _struct_formats(fn) -> list[str]:
+    """Struct format literals used by struct pack/unpack calls in
+    ``fn``'s own body (sorted multiset)."""
+    out: list[str] = []
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, (ast.Attribute, ast.Name))):
+            continue
+        name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                else node.func.id)
+        if name not in _STRUCT_CALLS or not node.args:
+            continue
+        arg = node.args[0]
+        if (isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+                and _FMT.match(arg.value)):
+            out.append(arg.value)
+    return sorted(out)
+
+
+def _check_struct_symmetry(files):
+    """pack_X/unpack_X pairs in a wire.py must use matching struct
+    format literals (both halves considered as multisets — helpers
+    shared at module level, like a module-level Struct, contribute to
+    neither and stay exempt)."""
+    for path, sf in sorted(files.items()):
+        if os.path.basename(path) != "wire.py":
+            continue
+        fns = {
+            fn.name: fn
+            for fn in sf.tree.body
+            if isinstance(fn, ast.FunctionDef)
+        }
+        for name, fn in sorted(fns.items()):
+            if not name.startswith("pack_"):
+                continue
+            base = name[len("pack_"):]
+            if base.endswith("_parts"):
+                # Scatter-gather builders share the layout with their
+                # joined twin; their unpack is the base name's.
+                base = base[: -len("_parts")]
+            peer = fns.get("unpack_" + base)
+            if peer is None:
+                continue
+            got = _struct_formats(fn)
+            want = _struct_formats(peer)
+            if got and want and got != want:
+                yield Finding(
+                    "R5", path, fn.lineno, fn.col_offset,
+                    f"struct-format asymmetry: {name} packs "
+                    f"{got} but {peer.name} reads {want} — the "
+                    f"truncated/reordered field desynchronizes the "
+                    f"frame with no parse error",
+                    symbol=name,
+                )
+
+
 def check_r5(files):
+    yield from _check_struct_symmetry(files)
+
     # --- MSG coverage, per directory holding a wire.py ---
     by_dir: dict[str, dict[str, object]] = {}
     for path, sf in files.items():

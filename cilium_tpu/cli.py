@@ -459,6 +459,34 @@ def cmd_sidecar_status(args):
           f"fallback={cont.get('fallback_entries', 0)} "
           f"stalls={cont.get('stalls', 0)} "
           f"quarantine_events={cont.get('quarantine_events', 0)}")
+    tr = st.get("transport") or {}
+    if tr:
+        rejects = " ".join(
+            f"{k}={v}" for k, v in sorted((tr.get("rejects") or {}).items())
+        )
+        print(f"transport: shm_entries={tr.get('shm_entries', 0)}"
+              + (f" rejects: {rejects}" if rejects else ""))
+        for sess in tr.get("sessions", []):
+            mode = sess.get("mode", "socket")
+            if mode != "shm" and not sess.get("fallbacks"):
+                print(f"  [session] mode={mode}")
+                continue
+            data = sess.get("data") or {}
+            verdict = sess.get("verdict") or {}
+            fb = " ".join(
+                f"{k}={v}"
+                for k, v in sorted((sess.get("fallbacks") or {}).items())
+            )
+            print(
+                f"  [session] mode={mode} gen={sess.get('generation')} "
+                f"data={data.get('occupancy', 0)}/{data.get('slots', 0)} "
+                f"verdict={verdict.get('occupancy', 0)}"
+                f"/{verdict.get('slots', 0)} "
+                f"doorbells={sess.get('doorbells', 0)} "
+                f"(batch~{sess.get('doorbell_batch_mean', 0)}) "
+                f"credits={sess.get('credits', 0)}"
+                + (f" fallbacks: {fb}" if fb else "")
+            )
     if cont.get("quarantined"):
         print(f"quarantine: {cont.get('reason', '')} "
               f"for {cont.get('quarantined_for_s', 0)}s "

@@ -982,6 +982,12 @@ class NetBackend(Backend):
         self._watch_specs: dict[int, tuple[str, str]] = {}
         self._reconnect_lock = threading.Lock()
         self._generation = 0
+        # Session-rebuild gate: cleared while a reconnect has swapped
+        # the socket but not yet finished replaying leased keys and
+        # watches; _request waits on it so no caller can observe a
+        # half-rebuilt session as healthy (see _request).
+        self._ready = threading.Event()
+        self._ready.set()
         self._conn_dead = False  # reader saw EOF; requests must redial
         self._locks: list[_NetLock] = []  # held locks (loss marking)
         self.reconnects = 0
@@ -1120,6 +1126,12 @@ class NetBackend(Backend):
                     time.sleep(delay)
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Close the ready gate for the duration of the rebuild: the
+            # fresh socket becomes visible to _request_once NOW, but the
+            # session is not healthy until the replay lands (see the
+            # _request docstring note) — reopened in the finally below
+            # whatever the outcome.
+            self._ready.clear()
             # shutdown-then-close: the old generation's reader may be
             # parked in recv on this socket (a writer detected the
             # death first) — wake it so it exits instead of holding
@@ -1161,6 +1173,12 @@ class NetBackend(Backend):
                 except OSError:
                     pass
                 return False
+            finally:
+                # Reopen the ready gate WHATEVER the outcome: success
+                # lets waiters proceed on the healthy session; failure
+                # lets them observe the dead one and drive their own
+                # reconnect instead of parking forever.
+                self._ready.set()
             return True
 
     def _replay_session(self) -> None:
@@ -1262,6 +1280,19 @@ class NetBackend(Backend):
         deadline = time.monotonic() + self.timeout
         np_retries = 0
         while True:
+            # Half-rebuilt sessions are poison for CALLERS too: a
+            # reconnect swaps the fresh socket in before replaying
+            # leased keys/watches, and a request slipping through on it
+            # (a ping served by a still-replicating follower) reports
+            # the session healthy while the replay is still owed — a
+            # caller that then close()s aborts the replay and strands
+            # its leased keys as unowned ghosts on the follower,
+            # unrevokable forever.  Wait out the rebuild (bounded by
+            # this request's own deadline; the reconnect path sets the
+            # gate in a finally, so a failed rebuild releases waiters
+            # to observe the dead session and retry themselves).
+            if not self._ready.wait(max(deadline - time.monotonic(), 0.0)):
+                raise KvstoreError("kvstore session rebuild timed out")
             gen = self._generation
             try:
                 return self._request_once(req, timeout)
@@ -1546,6 +1577,10 @@ class NetBackend(Backend):
         if self._closed:
             return
         self._closed = True
+        # Release any request parked on the session-rebuild gate: the
+        # client is terminal, so waiters must fail fast (_request_once
+        # raises on _closed) instead of waiting out their deadline.
+        self._ready.set()
         # shutdown() first: close() alone does not send FIN while the
         # reader thread is blocked in recv on the same fd, so the server
         # would never see the session die (and leases would leak).

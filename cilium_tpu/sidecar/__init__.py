@@ -20,6 +20,10 @@ This package is the TPU-native equivalent of that seam:
                  OnIO byte-accounting contract) used by tests and benches
 - ``trace``    — verdict-path latency decomposition: per-round stage
                  histograms, sampled spans, slow-verdict exemplars
+- ``shm``      — lock-free SPSC shared-memory rings (the zero-copy data
+                 fast path between shim and service)
+- ``transport``— the transport seam: socket control channel + shm data
+                 rung, fallback reasons, per-session telemetry
 
 The native C++ shim implementing the same client contract lives in
 ``native/`` (built to ``libcilium_tpu_shim.so``).
@@ -29,16 +33,25 @@ from .client import ShimConnection, SidecarClient, SidecarUnavailable
 from .dispatch import BatchDispatcher
 from .guard import DeviceGuard, DeviceStall
 from .service import VerdictService
+from .shm import RingError, ShmRing, TornSlot
 from .trace import RoundTrace, VerdictTracer
+from .transport import TRANSPORT_SHM, TRANSPORT_SOCKET, ShmPeer, ShmSession
 
 __all__ = [
     "BatchDispatcher",
     "DeviceGuard",
     "DeviceStall",
+    "RingError",
     "RoundTrace",
     "ShimConnection",
+    "ShmPeer",
+    "ShmRing",
+    "ShmSession",
     "SidecarClient",
     "SidecarUnavailable",
+    "TornSlot",
+    "TRANSPORT_SHM",
+    "TRANSPORT_SOCKET",
     "VerdictService",
     "VerdictTracer",
 ]

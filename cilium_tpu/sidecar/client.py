@@ -48,8 +48,27 @@ from ..utils import metrics
 from ..utils.backoff import Exponential
 from ..utils.sockutil import shutdown_close as _teardown
 from . import wire
+from .shm import RingError
+from .transport import (
+    CREDIT_FLAG_QUARANTINED,
+    REASON_ATTACH_REJECTED,
+    REASON_OVERSIZE,
+    REASON_RING_FULL,
+    REASON_TORN_SLOT,
+    TRANSPORT_SHM,
+    TRANSPORT_SOCKET,
+    ShmSession,
+)
 
 log = logging.getLogger(__name__)
+
+
+def _join(payload) -> bytes:
+    """Materialize a scatter-gather payload for the socket path (the
+    ring path writes the parts into the slot without this copy)."""
+    if isinstance(payload, (list, tuple)):
+        return b"".join(payload)
+    return payload
 
 
 class SidecarUnavailable(wire.WireError):
@@ -190,11 +209,25 @@ class SidecarClient:
     (see module docstring)."""
 
     def __init__(self, socket_path: str, timeout: float = 10.0,
-                 deadline_ms: float = 0.0, auto_reconnect: bool = False):
+                 deadline_ms: float = 0.0, auto_reconnect: bool = False,
+                 transport: str = TRANSPORT_SOCKET,
+                 shm_data_slots: int = 64, shm_slot_bytes: int = 1 << 20,
+                 shm_verdict_slots: int = 64,
+                 shm_verdict_slot_bytes: int = 1 << 18):
         self.socket_path = socket_path
         self.timeout = timeout
         self.deadline_ms = deadline_ms
         self.auto_reconnect = auto_reconnect
+        # Transport preference: "shm" negotiates a pair of lock-free
+        # shared-memory rings at session setup (and again after every
+        # auto_reconnect replay); ANY negotiation or ring fault falls
+        # back to the socket rung typed — the session always serves.
+        self.transport_pref = transport
+        self._shm_cfg = (shm_data_slots, shm_slot_bytes,
+                         shm_verdict_slots, shm_verdict_slot_bytes)
+        self._shm: ShmSession | None = None
+        self._shm_generation = 0
+        self.transport_fallbacks: dict[str, int] = {}
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(socket_path)
         self._seq = itertools.count(1)
@@ -232,6 +265,8 @@ class SidecarClient:
         )
         self._reader.start()
         self.verdict_callback = None  # async mode: called with VerdictBatch
+        if transport == TRANSPORT_SHM:
+            self._shm_negotiate()
 
     # -- plumbing ---------------------------------------------------------
 
@@ -249,23 +284,12 @@ class SidecarClient:
             while True:
                 msg_type, payload = reader.recv_msg()
                 if msg_type == wire.MSG_VERDICT_BATCH:
-                    vb = wire.unpack_verdict_batch(payload)
-                    cb = self.verdict_callback
-                    evt = self._pending.pop(vb.seq, None)
-                    if evt is not None:
-                        self._verdicts[vb.seq] = vb
-                        evt.set()
-                    elif cb is not None:
-                        cb(vb)
+                    self._deliver_verdict(wire.unpack_verdict_batch(payload))
                 elif msg_type == wire.MSG_VERDICT_MULTI:
-                    cb = self.verdict_callback
                     for vb in wire.unpack_verdict_multi(payload):
-                        evt = self._pending.pop(vb.seq, None)
-                        if evt is not None:
-                            self._verdicts[vb.seq] = vb
-                            evt.set()
-                        elif cb is not None:
-                            cb(vb)
+                        self._deliver_verdict(vb)
+                elif msg_type == wire.MSG_SHM_CREDIT:
+                    self._on_shm_credit(payload)
                 else:
                     self._control.append((msg_type, payload))
                     self._control_evt.set()
@@ -296,6 +320,14 @@ class SidecarClient:
             self._down_handled = True
             self._alive = False
         self._reconnected.clear()
+        # The shm session dies with the socket (a fresh one is
+        # negotiated after replay): deactivate FIRST so no new pushes
+        # land, then wake the waiters — ring in-flight RPCs share the
+        # same _pending sweep and fail typed like socket in-flights.
+        sess = self._shm
+        if sess is not None:
+            self._shm = None
+            sess.active = False
         # Wake data waiters WITHOUT a verdict: they observe the missing
         # entry and raise SidecarUnavailable instead of sleeping out
         # their full RPC timeout.
@@ -303,6 +335,11 @@ class SidecarClient:
             self._pending.pop(seq, None)
             evt.set()
         self._control_evt.set()
+        if sess is not None:
+            try:
+                sess.destroy()
+            except Exception:  # noqa: BLE001 — release is best-effort
+                log.exception("shm teardown on disconnect failed")
         if self.auto_reconnect and not self._closed:
             with self._down_once:
                 if self._reconnect_active:
@@ -353,6 +390,316 @@ class SidecarClient:
                 if sock is self.sock:
                     _teardown(sock)  # force the reader out of recv
                 raise SidecarUnavailable(str(e)) from e
+
+    # -- shm transport (sidecar/shm.py, sidecar/transport.py) -------------
+
+    @property
+    def transport_mode(self) -> str:
+        sess = self._shm
+        return (
+            TRANSPORT_SHM if sess is not None and sess.active
+            else TRANSPORT_SOCKET
+        )
+
+    def _transport_fallback(self, reason: str, n: int = 1) -> None:
+        self.transport_fallbacks[reason] = (
+            self.transport_fallbacks.get(reason, 0) + n
+        )
+        metrics.SidecarTransportFallback.inc(reason, amount=n)
+
+    def transport_status(self) -> dict:
+        """Client-side transport telemetry (the shim half of
+        `cilium sidecar status`'s transport section)."""
+        sess = self._shm
+        out = {
+            "mode": self.transport_mode,
+            "preference": self.transport_pref,
+            "fallbacks": dict(self.transport_fallbacks),
+        }
+        if sess is not None:
+            out["session"] = sess.status()
+        return out
+
+    def _shm_negotiate(self) -> bool:
+        """Create a fresh ring pair and offer it to the service
+        (MSG_SHM_ATTACH).  Every failure is contained: the session
+        stays on the socket rung, typed and counted — never raises."""
+        self._shm_generation += 1
+        ds, db, vs, vb = self._shm_cfg
+        try:
+            sess = ShmSession.create(self._shm_generation, ds, db, vs, vb)
+        except Exception:  # noqa: BLE001 — no /dev/shm, quota, ...
+            log.exception("shm ring creation failed; socket transport")
+            self._transport_fallback(REASON_ATTACH_REJECTED)
+            return False
+        req = sess.attach_request()
+        try:
+            got = self._control_rpc(
+                lambda: (wire.MSG_SHM_ATTACH, json.dumps(req).encode()),
+                wire.MSG_SHM_ATTACH_REPLY,
+                retry=False,
+            )
+            rep = json.loads(got.decode())
+            status = int(rep.get("status", -1))
+            if status != int(FilterResult.OK):
+                raise wire.WireError(
+                    rep.get("error") or f"attach status {status}"
+                )
+        except Exception:  # noqa: BLE001 — old service, reject, timeout
+            log.warning(
+                "shm attach rejected; serving on the socket transport",
+                exc_info=True,
+            )
+            sess.destroy()
+            self._transport_fallback(REASON_ATTACH_REJECTED)
+            return False
+        self._shm = sess
+        log.info(
+            "shm transport attached (generation %s, %dx%dB data slots)",
+            rep.get("generation"), ds, db,
+        )
+        return True
+
+    def detach_shm(self) -> None:
+        """Gracefully return the session to the socket transport (call
+        when quiescent: in-flight ring verdicts should have drained).
+        Fault paths demote without this round trip."""
+        sess = self._shm
+        if sess is None:
+            return
+        with self._wlock:
+            if self._shm is not sess:
+                return
+            sess.active = False
+            self._shm = None
+        try:
+            self._control_rpc(
+                lambda: (
+                    wire.MSG_SHM_DETACH,
+                    wire.pack_shm_detach(sess.generation),
+                ),
+                wire.MSG_ACK,
+                retry=False,
+            )
+        except (SidecarUnavailable, TimeoutError, wire.WireError):
+            pass  # socket teardown releases the mappings anyway
+        try:
+            sess.destroy()
+        except Exception:  # noqa: BLE001
+            log.exception("shm teardown on detach failed")
+
+    def _transport_send(self, msg_type: int, payload,
+                        seq: int | None = None, conn_ids=None) -> None:
+        """Data-plane send: ride the shm data ring when attached (one
+        scatter-gather slot write + at most one doorbell frame), fall
+        back to a full socket frame per-batch when the ring is full or
+        the frame oversized — never blocks on ring space, never spins.
+
+        ``payload`` may be a list of buffers: the ring path writes them
+        straight into the slot (the bulk rows/blob part is never
+        re-materialized); only the socket fallback joins them."""
+        sess = self._shm
+        if sess is None or not sess.active:
+            self._send(msg_type, _join(payload))
+            return
+        if not self._alive:
+            raise SidecarUnavailable(
+                f"verdict service at {self.socket_path} is down"
+            )
+        nbytes = (
+            sum(len(p) for p in payload)
+            if isinstance(payload, (list, tuple)) else len(payload)
+        )
+        reason = None
+        with self._wlock:
+            if sess.active and self._shm is sess:
+                if not sess.data.fits(nbytes):
+                    reason = REASON_OVERSIZE
+                else:
+                    pos = sess.data.tail
+                    if sess.data.try_push(msg_type, payload,
+                                          sess.credit_head):
+                        if seq is not None:
+                            sess.inflight[seq] = (pos, conn_ids)
+                        sess.counters.data_frames += 1
+                        # lint: disable=R2 -- the doorbell frame must publish under the same lock as the ring push (SPSC + ordering); SO_SNDTIMEO/_teardown bound a wedged peer exactly as in _send
+                        self._shm_doorbell_locked(sess)
+                        return
+                    reason = REASON_RING_FULL
+        if reason is not None:
+            self._transport_fallback(reason)
+        self._send(msg_type, _join(payload))
+
+    def _shm_doorbell_locked(self, sess: ShmSession) -> None:
+        """Doorbell (write lock held): ring the bell for any un-belled
+        tail.  The service also rechecks the ring's tail mirror after
+        every drain, so a doorbell is a wakeup, never load-bearing —
+        under backlog many frames coalesce into one drain (the batched
+        half), while an idle service is woken immediately (suppressing
+        the bell until the next credit measured ~1ms of p99 bubble at
+        100k/s)."""
+        tail = sess.data.tail
+        if tail <= sess.db_tail:
+            return
+        self._doorbell_send(sess, tail)
+
+    def _doorbell_send(self, sess: ShmSession, tail: int) -> None:
+        payload = wire.pack_shm_doorbell(
+            sess.generation, tail, sess.v_head
+        )
+        sess.counters.doorbell(tail - sess.db_tail)
+        sess.db_tail = tail
+        sess.v_head_sent = sess.v_head
+        sock = self.sock
+        try:
+            wire.send_msg(sock, wire.MSG_SHM_DOORBELL, payload)
+        except OSError as e:
+            # Same teardown contract as _send: only kill the socket we
+            # wrote to, and force the reader out of recv.
+            if sock is self.sock:
+                _teardown(sock)
+            raise SidecarUnavailable(str(e)) from e
+
+    def _deliver_verdict(self, vb: wire.VerdictBatch) -> None:
+        """Route one verdict batch (socket frame, verdict ring, or a
+        demotion-synthesized SHED) to its waiter or the async
+        callback — THE one delivery path for every transport."""
+        sess = self._shm
+        if sess is not None:
+            sess.inflight.pop(vb.seq, None)
+        cb = self.verdict_callback
+        evt = self._pending.pop(vb.seq, None)
+        if evt is not None:
+            self._verdicts[vb.seq] = vb
+            evt.set()
+        elif cb is not None:
+            cb(vb)
+
+    def _shm_forget(self, seq: int) -> None:
+        sess = self._shm
+        if sess is not None:
+            sess.inflight.pop(seq, None)
+
+    @staticmethod
+    def _shed_batch(seq: int, conn_ids) -> wire.VerdictBatch:
+        """A synthesized typed-SHED verdict batch — byte-for-byte the
+        entry shape the service's shed path produces, used when ring
+        frames the service never admitted must be answered locally
+        (zero silent loss on demotion)."""
+        cids = np.ascontiguousarray(
+            conn_ids if conn_ids is not None else [], "<u8"
+        )
+        n = len(cids)
+        zeros = np.zeros(n, "<u4")
+        return wire.VerdictBatch(
+            seq,
+            cids,
+            np.full(n, int(FilterResult.SHED), "<u4"),
+            zeros,
+            zeros,
+            zeros,
+            np.zeros(0, wire.FILTER_OP),
+            b"",
+        )
+
+    def _on_shm_credit(self, payload: bytes) -> None:
+        """Reader-thread half of the shm protocol: drain the verdict
+        ring through the credited tail, absorb data-ring credit, honor
+        a quarantine demotion, and re-bell coalesced pushes."""
+        sess = self._shm
+        if sess is None:
+            return
+        generation, flags, data_head, v_tail = wire.unpack_shm_credit(
+            payload
+        )
+        if generation != sess.generation:
+            return  # stale credit from a superseded session
+        sess.counters.credits += 1
+        try:
+            while sess.v_head < v_tail:
+                msg_type, frame, _t = sess.verdict.read(sess.v_head)
+                sess.v_head += 1
+                sess.verdict.set_head(sess.v_head)
+                sess.counters.verdict_frames += 1
+                if msg_type == wire.MSG_VERDICT_BATCH:
+                    self._deliver_verdict(wire.unpack_verdict_batch(frame))
+                elif msg_type == wire.MSG_VERDICT_MULTI:
+                    for vb in wire.unpack_verdict_multi(frame):
+                        self._deliver_verdict(vb)
+                else:
+                    raise RingError(
+                        f"unexpected verdict-ring frame type {msg_type}"
+                    )
+        except RingError:
+            log.exception("verdict ring corrupt; demoting to socket")
+            self._demote_shm(REASON_TORN_SLOT, served_through=data_head)
+            return
+        sess.credit_head = data_head
+        if flags & CREDIT_FLAG_QUARANTINED:
+            self._demote_shm(REASON_TORN_SLOT, served_through=data_head)
+            return
+        with self._wlock:
+            if sess.active and self._shm is sess:
+                if sess.data.tail > sess.db_tail:
+                    # Pushes landed while the service drained: re-bell.
+                    # lint: disable=R2 -- the re-bell must pair with the cursor state it publishes under this lock; SO_SNDTIMEO bounds a wedge (same contract as _send)
+                    self._doorbell_send(sess, sess.data.tail)
+                elif (
+                    sess.v_head - sess.v_head_sent
+                    >= sess.verdict.slots // 2
+                ):
+                    # Refresh the service's verdict-ring credit before
+                    # its producer view saturates.
+                    # lint: disable=R2 -- see the re-bell above; a pure credit refresh rides the same bounded doorbell write
+                    self._doorbell_send(sess, sess.db_tail)
+
+    def _demote_shm(self, reason: str,
+                    served_through: int | None = None) -> None:
+        """Demote the session to the socket transport, typed: ring
+        frames the service never admitted (position >=
+        ``served_through``) are answered here with synthesized SHED
+        batches — zero silent loss; admitted frames keep their real
+        verdicts, which now arrive as socket frames."""
+        sess = self._shm
+        if sess is None:
+            return
+        with self._wlock:
+            if self._shm is not sess:
+                return
+            sess.active = False
+            self._shm = None
+            # Tell the service to latch off the rings NOW (fire-and-
+            # forget: this runs on the reader thread, which cannot wait
+            # a control round trip, hence the no-ack flag).  Without
+            # it, a CLIENT-detected fault (torn verdict slot) leaves
+            # the service's peer active, writing verdicts into a ring
+            # nobody drains — every admitted in-flight RPC would time
+            # out instead of getting its promised socket verdict.
+            try:
+                # lint: disable=R2 -- one bounded fire-and-forget frame under the write lock, same contract as the doorbell sends
+                wire.send_msg(
+                    self.sock, wire.MSG_SHM_DETACH,
+                    wire.pack_shm_detach(
+                        sess.generation, wire.DETACH_FLAG_NO_ACK
+                    ),
+                )
+            except OSError:
+                pass  # socket death tears the mappings down anyway
+        self._transport_fallback(reason)
+        log.warning(
+            "shm transport demoted to socket (%s); %d ring frames "
+            "in flight", reason, len(sess.inflight),
+        )
+        pending = sorted(sess.inflight.items())
+        sess.inflight.clear()
+        for seq, (pos, cids) in pending:
+            if served_through is not None and pos < served_through:
+                continue  # admitted: its verdict arrives on the socket
+            self._deliver_verdict(self._shed_batch(seq, cids))
+        try:
+            sess.destroy()
+        except Exception:  # noqa: BLE001 — release is best-effort
+            log.exception("shm teardown on demotion failed")
 
     # -- reconnect --------------------------------------------------------
 
@@ -475,12 +822,21 @@ class SidecarClient:
             # stuck peer, the very thing close() must break.)
             _teardown(self.sock)
             raise wire.WireError("client closed during reconnect")
+        if self.transport_pref == TRANSPORT_SHM:
+            # Fresh rings for the fresh session: the restarted service
+            # has no memory of the old segments (and must never attach
+            # a stale one — generation bumps every negotiation).  A
+            # failed negotiation leaves the session serving on the
+            # socket rung; _shm_negotiate never raises.
+            self._shm_negotiate()
         self.reconnects += 1
         metrics.SidecarClientReconnects.inc()
         self._reconnected.set()
         log.info(
-            "sidecar client reconnected to %s (%d modules, %d conns)",
+            "sidecar client reconnected to %s (%d modules, %d conns, "
+            "transport=%s)",
             self.socket_path, len(modules), len(conn_args),
+            self.transport_mode,
         )
 
     def _wire_mod(self, module_id: int) -> int:
@@ -695,6 +1051,14 @@ class SidecarClient:
         # (_resume checks _closed after the swap and tears the fresh
         # socket down the same way.)
         _teardown(self.sock)
+        sess = self._shm
+        self._shm = None
+        if sess is not None:
+            sess.active = False
+            try:
+                sess.destroy()
+            except Exception:  # noqa: BLE001 — release is best-effort
+                log.exception("shm teardown on close failed")
 
     # -- data plane -------------------------------------------------------
 
@@ -722,12 +1086,17 @@ class SidecarClient:
             )
             msg = wire.MSG_DATA_BATCH
         try:
-            self._send(msg, payload)
+            self._transport_send(
+                msg, payload, seq=seq,
+                conn_ids=np.asarray([conn_id], np.uint64),
+            )
         except SidecarUnavailable:
             self._pending.pop(seq, None)
+            self._shm_forget(seq)
             raise
         if not evt.wait(self.timeout):
             self._pending.pop(seq, None)
+            self._shm_forget(seq)
             raise TimeoutError("no verdict reply")
         vb = self._verdicts.pop(seq, None)
         if vb is None:
@@ -740,8 +1109,11 @@ class SidecarClient:
     def send_batch(self, seq: int, conn_ids, flags, lengths, blob: bytes) -> None:
         """Async batched mode (latency bench): fire a DATA batch; replies
         arrive on verdict_callback."""
-        payload = wire.pack_data_batch(seq, conn_ids, flags, lengths, blob)
-        self._send(wire.MSG_DATA_BATCH, payload)
+        ids = np.ascontiguousarray(conn_ids, "<u8")
+        parts = wire.pack_data_batch_parts(seq, ids, flags, lengths, blob)
+        self._transport_send(
+            wire.MSG_DATA_BATCH, parts, seq=seq, conn_ids=ids,
+        )
 
     def send_matrix(self, seq: int, width: int, conn_ids, lengths,
                     rows_bytes: bytes, complete: bool = False) -> None:
@@ -749,18 +1121,29 @@ class SidecarClient:
         reshapes straight into the device layout.  ``complete=True``
         declares every row is exactly one whole frame (the edge owns
         framing), letting the service skip its per-row content scan."""
-        payload = wire.pack_data_matrix(
-            seq, width, conn_ids, lengths, rows_bytes,
+        ids = np.ascontiguousarray(conn_ids, "<u8")
+        # Scatter-gather parts (wire.py owns the layout): the rows
+        # buffer (the bulk) goes into the ring slot (or one sendall)
+        # without an intermediate join.
+        parts = wire.pack_data_matrix_parts(
+            seq, width, ids, lengths, rows_bytes,
             wire.MAT_FLAG_COMPLETE if complete else 0,
         )
-        self._send(wire.MSG_DATA_MATRIX, payload)
+        self._transport_send(
+            wire.MSG_DATA_MATRIX, parts, seq=seq, conn_ids=ids,
+        )
 
     def send_blob(self, seq: int, conn_ids, lengths, blob: bytes) -> None:
         """Compact request-direction batch: exact payload bytes only
         (the service builds the device row view with an on-device
         gather).  Preferred over send_matrix when the device link is
         bandwidth-limited — the wire and uplink carry no padding."""
-        payload = wire.pack_data_batch(
-            seq, conn_ids, [0] * len(conn_ids), lengths, blob
+        ids = np.ascontiguousarray(conn_ids, "<u8")
+        # Scatter-gather parts (wire.py owns the layout — see
+        # send_matrix): the blob rides into the slot without a join.
+        parts = wire.pack_data_batch_parts(
+            seq, ids, np.zeros(len(ids), np.uint8), lengths, blob
         )
-        self._send(wire.MSG_DATA_BATCH, payload)
+        self._transport_send(
+            wire.MSG_DATA_BATCH, parts, seq=seq, conn_ids=ids,
+        )

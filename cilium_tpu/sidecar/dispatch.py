@@ -189,6 +189,36 @@ class BatchDispatcher:
             self._cond.notify()
         return True
 
+    def submit_many(self, items: list[tuple[Any, int]],
+                    force: bool = False) -> list[Any]:
+        """Queue a pre-formed run of ``(item, weight)`` pairs under ONE
+        lock trip — the shared-memory doorbell drain's admission path
+        (a deep doorbell must not pay a lock round trip per frame).
+        Admission is per item: the cap can refuse a suffix while
+        admitting the prefix; refused items are RETURNED and the caller
+        owes each a typed SHED response (exactly submit()'s contract)."""
+        refused: list[Any] = []
+        with self._cond:
+            admitted = False
+            for item, weight in items:
+                if (
+                    not force
+                    and self.max_pending
+                    and self._pending_weight + weight > self.max_pending
+                ):
+                    self.shed_submits += 1
+                    self.shed_weight += weight
+                    refused.append(item)
+                    continue
+                if not self._pending:
+                    self._oldest_ts = time.perf_counter()
+                self._pending.append(item)
+                self._pending_weight += weight
+                admitted = True
+            if admitted:
+                self._cond.notify()
+        return refused
+
     @property
     def pending_weight(self) -> int:
         return self._pending_weight

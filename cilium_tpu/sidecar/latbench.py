@@ -141,6 +141,15 @@ class NullVerdictServer:
                     )
                 elif msg_type == wire.MSG_STATUS:
                     wire.send_msg(sock, wire.MSG_STATUS_REPLY, b"{}")
+                elif msg_type == wire.MSG_SHM_ATTACH:
+                    # The null control is socket-only by design: reject
+                    # typed so a shm-preferring client falls back fast
+                    # instead of timing out its attach RPC.
+                    wire.send_msg(
+                        sock, wire.MSG_SHM_ATTACH_REPLY,
+                        b'{"status": 7, "generation": 0,'
+                        b' "error": "null server: socket only"}',
+                    )
                 # MSG_CLOSE and anything else: ignored
         except (wire.ConnectionClosed, OSError):
             pass
@@ -212,6 +221,7 @@ class LatencyBench:
         seam_probe: bool = False,
         wire_mode: str = "matrix",  # matrix (pre-padded) | blob (compact)
         null_seam: bool = False,
+        transport: str = "socket",  # socket | shm (client-side rings)
     ):
         from cilium_tpu.proxylib import (
             NetworkPolicy,
@@ -254,7 +264,14 @@ class LatencyBench:
             self.service = VerdictService(socket_path, cfg).start()
         # First new_connection triggers engine build + per-bucket XLA
         # compiles (slow through the TPU tunnel) — generous timeout.
-        self.client = SidecarClient(socket_path, timeout=600.0)
+        # transport="shm" negotiates the shared-memory rings; slots are
+        # sized so a full client_batch matrix (2048 x 64B rows + the
+        # columnar headers) fits one slot with headroom.
+        self.client = SidecarClient(
+            socket_path, timeout=600.0, transport=transport,
+            shm_data_slots=64, shm_slot_bytes=1 << 20,
+            shm_verdict_slots=64, shm_verdict_slot_bytes=1 << 19,
+        )
         self.module = self.client.open_module([])
         assert self.module != 0
         assert self.client.policy_update(self.module, [self.policy]) == int(
@@ -467,7 +484,8 @@ class LatencyBench:
 
 
 def run_paired_colocated(
-    socket_path: str, n_requests: int = 100_000, reps: int = 9, **kw
+    socket_path: str, n_requests: int = 100_000, reps: int = 9,
+    transport: str = "socket", **kw
 ) -> dict:
     """The colocated latency experiment with its control, PAIRED: each
     seam run executes adjacent in time to a null-seam run, and the
@@ -478,6 +496,12 @@ def run_paired_colocated(
     apart); pairing cancels the drift the way the null server cancels
     the constant floor."""
     seam_kw = dict(kw)
+    # ``transport`` applies to the SEAM client only; the null control
+    # stays on the socket (same framing floor for every config), so
+    # (seam − null) deltas are comparable between the socket and shm
+    # configs and the difference between the two IS the copy
+    # elimination.
+    seam_kw["transport"] = transport
     seam_kw.setdefault("verdict_device", "cpu")
     seam_kw.setdefault("seam_probe", True)
     seam_kw.setdefault("batch_timeout_ms", 0.0)
@@ -507,6 +531,8 @@ def run_paired_colocated(
         n1 = min(n_requests, 500_000)
         r1m_null = null.run_rate(1_000_000, n1, seed=11)
         r1m_seam = seam.run_rate(1_000_000, n1, seed=11)
+        # Captured BEFORE close (close releases the ring session).
+        transport_stats = seam.client.transport_status()
     finally:
         seam.close()
         null.close()
@@ -524,6 +550,10 @@ def run_paired_colocated(
         "oracle_p99_ms": oracle_p99,
         "os_noise": os_noise,
         "dispatch_mode": seam.service.dispatch_mode_chosen,
+        # What the seam client actually rode (mode + ring/doorbell/
+        # fallback counters) — a result claiming "shm" with a session
+        # that silently demoted to the socket must be readable as such.
+        "seam_transport": transport_stats,
         "seam_100k": seam_med,
         "null_100k": null_med,
         "pair_deltas_ms": [round(d, 3) for d in deltas],

@@ -45,6 +45,7 @@ PATH_SHED = "shed"        # typed SHED (queue_full / deadline / stall)
 
 # Stage names, in pipeline order.  Each is the duration between two
 # consecutive stamp boundaries of a round.
+STAGE_RING = "ring"                # shm slot commit -> doorbell drain
 STAGE_QUEUE = "queue"              # admit (wire ingress) -> queue pop
 STAGE_FORM = "batch_form"          # pop -> device batch assembled
 STAGE_SUBMIT = "device_submit"     # assembled -> device calls issued
@@ -52,7 +53,7 @@ STAGE_DEVICE = "device"            # issued -> fenced readback complete
 STAGE_DRAIN = "drain"              # complete -> responses built
 STAGE_SEND = "send"                # built -> verdict frames written
 
-STAGES = (STAGE_QUEUE, STAGE_FORM, STAGE_SUBMIT,
+STAGES = (STAGE_RING, STAGE_QUEUE, STAGE_FORM, STAGE_SUBMIT,
           STAGE_DEVICE, STAGE_DRAIN, STAGE_SEND)
 
 
@@ -66,9 +67,10 @@ class RoundTrace:
     """
 
     __slots__ = ("path", "n", "t_admit", "t_pop", "t_form", "t_submit",
-                 "t_complete", "t_drain", "t_send")
+                 "t_complete", "t_drain", "t_send", "ring_s")
 
-    def __init__(self, path: str, n: int, t_admit: float, t_pop: float):
+    def __init__(self, path: str, n: int, t_admit: float, t_pop: float,
+                 ring_s: float = 0.0):
         self.path = path
         self.n = n
         # t_admit is the OLDEST covered wire batch's ingress stamp, so
@@ -80,6 +82,11 @@ class RoundTrace:
         self.t_complete = 0.0
         self.t_drain = 0.0
         self.t_send = 0.0
+        # Shared-memory transport: worst slot-commit → doorbell-drain
+        # wait across the round's batches.  Carved OUT of the queue
+        # stage (arrival is the slot-commit stamp for ring batches) so
+        # the decomposition shows what the copy elimination bought.
+        self.ring_s = ring_s
 
     def formed(self) -> None:
         if not self.t_form:
@@ -106,8 +113,11 @@ class RoundTrace:
         t_complete = self.t_complete or t_submit
         t_drain = self.t_drain or t_complete
         t_send = self.t_send or t_drain
+        wait = max(t_pop - self.t_admit, 0.0)
+        ring = min(max(self.ring_s, 0.0), wait)
         return {
-            STAGE_QUEUE: max(t_pop - self.t_admit, 0.0),
+            STAGE_RING: ring,
+            STAGE_QUEUE: wait - ring,
             STAGE_FORM: max(t_form - t_pop, 0.0),
             STAGE_SUBMIT: max(t_submit - t_form, 0.0),
             STAGE_DEVICE: max(t_complete - t_submit, 0.0),
@@ -158,8 +168,10 @@ class VerdictTracer:
     # -- round lifecycle --------------------------------------------------
 
     def begin_round(self, path: str, n: int, t_admit: float,
-                    t_pop: float | None = None) -> RoundTrace:
-        return RoundTrace(path, n, t_admit, t_pop or time.monotonic())
+                    t_pop: float | None = None,
+                    ring_s: float = 0.0) -> RoundTrace:
+        return RoundTrace(path, n, t_admit, t_pop or time.monotonic(),
+                          ring_s)
 
     def finish_round(self, rt: RoundTrace, batches=()) -> None:
         """Close a round: observe each stage once, the e2e histogram
@@ -174,6 +186,10 @@ class VerdictTracer:
         path = rt.path
         if self.stage_metrics:
             h = metrics.VerdictStageSeconds
+            if stages[STAGE_RING]:
+                # Socket rounds have no ring stage; observing a
+                # permanent zero would just pad the histogram.
+                h.observe(stages[STAGE_RING], STAGE_RING, path)
             h.observe(stages[STAGE_QUEUE], STAGE_QUEUE, path)
             h.observe(stages[STAGE_FORM], STAGE_FORM, path)
             h.observe(stages[STAGE_SUBMIT], STAGE_SUBMIT, path)

@@ -1,0 +1,204 @@
+"""Transport seam abstraction for the shim ⇄ verdict-service boundary.
+
+Two rungs, selected per session and re-negotiated on every reconnect:
+
+- ``socket`` — the original unix-socket byte path.  Always attached;
+  carries ALL control traffic in both modes and is the fail-closed
+  fallback rung for the data plane.
+- ``shm``    — the zero-copy fast path (:mod:`sidecar.shm`): data
+  batches ride a shared-memory ring shim→service, verdict frames ride
+  a second ring back, and the socket carries only batched
+  ``MSG_SHM_DOORBELL``/``MSG_SHM_CREDIT`` nudges.
+
+This module owns the shared session-state shapes so both ends count
+and report the SAME degradation ladder dimension
+(``transport=shm|socket``): a ring fault is typed, counted under one
+of the ``REASON_*`` constants below, demotes the session to the socket
+rung, and shows up identically in ``cilium sidecar status`` and the
+``sidecar_transport_fallback_total{reason}`` metric — never a hang,
+never silent loss.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils import metrics
+from .shm import ShmRing
+
+TRANSPORT_SOCKET = "socket"
+TRANSPORT_SHM = "shm"
+
+# Degradation/fallback reasons (the label set of
+# sidecar_transport_fallback_total).  Per-batch reasons route ONE batch
+# to the socket; session reasons demote the whole session.
+REASON_RING_FULL = "ring_full"            # per-batch: data ring full
+REASON_OVERSIZE = "oversize"              # per-batch: frame > slot
+REASON_VERDICT_RING_FULL = "verdict_ring_full"  # per-frame, service side
+REASON_TORN_SLOT = "torn_slot"            # session: quarantined ring
+REASON_GENERATION = "generation_mismatch"  # session: stale segment
+REASON_ATTACH_REJECTED = "attach_rejected"  # session: negotiation failed
+REASON_DISABLED = "disabled"              # session: service knob off
+REASON_PEER_DEATH = "peer_death"          # session: peer vanished
+
+# MSG_SHM_CREDIT flag bits.
+CREDIT_FLAG_QUARANTINED = 1
+
+
+class _Counters:
+    """Fallback/doorbell accounting shared by both ends (one lock-free
+    integer bump per event; reads are status-path only)."""
+
+    def __init__(self) -> None:
+        self.fallbacks: dict[str, int] = {}
+        self.doorbells = 0
+        self.doorbell_items = 0
+        self.credits = 0
+        self.data_frames = 0
+        self.verdict_frames = 0
+
+    def fallback(self, reason: str, n: int = 1) -> None:
+        self.fallbacks[reason] = self.fallbacks.get(reason, 0) + n
+        metrics.SidecarTransportFallback.inc(reason, amount=n)
+
+    def doorbell(self, items: int) -> None:
+        self.doorbells += 1
+        self.doorbell_items += items
+
+    def status(self) -> dict:
+        return {
+            "fallbacks": dict(self.fallbacks),
+            "doorbells": self.doorbells,
+            "doorbell_batch_mean": round(
+                self.doorbell_items / self.doorbells, 2
+            ) if self.doorbells else 0.0,
+            "credits": self.credits,
+            "data_frames": self.data_frames,
+            "verdict_frames": self.verdict_frames,
+        }
+
+
+class ShmSession:
+    """Client-side shm session: data-ring producer + verdict-ring
+    consumer, plus the doorbell/credit state machine.
+
+    Push/doorbell calls are serialized by the client's write lock (the
+    SPSC producer guarantee); the credit/drain side runs on the
+    client's reader thread (the SPSC consumer guarantee)."""
+
+    def __init__(self, data: ShmRing, verdict: ShmRing, generation: int):
+        self.data = data
+        self.verdict = verdict
+        self.generation = generation
+        self.active = True
+        self.counters = _Counters()
+        # Producer-side doorbell state (under the client write lock):
+        # last doorbelled data tail, and the service's last credited
+        # consume head (slots below it are free).
+        self.db_tail = 0
+        self.credit_head = 0
+        # Verdict-ring consumer cursor (reader thread) and the head
+        # value last piggybacked to the service.
+        self.v_head = 0
+        self.v_head_sent = 0
+        # Ring in-flight bookkeeping for zero-silent-loss demotion:
+        # seq -> (ring position, conn_ids) for every data frame pushed
+        # to the ring whose verdict has not come back.  GIL-atomic
+        # per-key dict ops; writer = producer, eraser = reader thread.
+        self.inflight: dict[int, tuple[int, object]] = {}
+
+    @classmethod
+    def create(cls, generation: int, data_slots: int, data_slot_bytes: int,
+               verdict_slots: int, verdict_slot_bytes: int) -> "ShmSession":
+        data = ShmRing.create("data", generation, data_slots,
+                              data_slot_bytes)
+        try:
+            verdict = ShmRing.create("verdict", generation, verdict_slots,
+                                     verdict_slot_bytes)
+        except Exception:
+            data.close()
+            data.unlink()
+            raise
+        return cls(data, verdict, generation)
+
+    def attach_request(self) -> dict:
+        """The MSG_SHM_ATTACH JSON payload."""
+        return {
+            "generation": self.generation,
+            "data": self.data.seg.name,
+            "verdict": self.verdict.seg.name,
+        }
+
+    def destroy(self) -> None:
+        self.active = False
+        for ring in (self.data, self.verdict):
+            ring.close()
+            ring.unlink()
+
+    def status(self) -> dict:
+        return {
+            "mode": TRANSPORT_SHM if self.active else TRANSPORT_SOCKET,
+            "generation": self.generation,
+            "data": self.data.status(),
+            "verdict": self.verdict.status(),
+            "inflight": len(self.inflight),
+            **self.counters.status(),
+        }
+
+
+class ShmPeer:
+    """Service-side shm session: data-ring consumer + verdict-ring
+    producer for one client handler.
+
+    Drains run on the handler's reader thread (SPSC consumer); verdict
+    pushes are serialized under the handler's write lock (SPSC
+    producer).  ``_state_lock`` only guards the active/demotion latch —
+    never held across blocking work."""
+
+    def __init__(self, data: ShmRing, verdict: ShmRing, generation: int):
+        self.data = data
+        self.verdict = verdict
+        self.generation = generation
+        self.active = True
+        self.counters = _Counters()
+        self.head = 0            # data-ring consume cursor (reader)
+        self.v_credit_head = 0   # client's last piggybacked verdict head
+        self._state_lock = threading.Lock()
+        self.quarantine_reason: str | None = None
+
+    @classmethod
+    def attach(cls, req: dict) -> "ShmPeer":
+        generation = int(req["generation"])
+        data = ShmRing.attach(str(req["data"]), generation)
+        try:
+            verdict = ShmRing.attach(str(req["verdict"]), generation)
+        except Exception:
+            data.close()
+            raise
+        return cls(data, verdict, generation)
+
+    def quarantine(self, reason: str) -> bool:
+        """Latch the session off the shm rung (idempotent); True only
+        for the transition so exactly one quarantined credit is sent."""
+        with self._state_lock:
+            if not self.active:
+                return False
+            self.active = False
+            self.quarantine_reason = reason
+        self.counters.fallback(reason)
+        return True
+
+    def close(self) -> None:
+        self.active = False
+        self.data.close()
+        self.verdict.close()
+
+    def status(self) -> dict:
+        return {
+            "mode": TRANSPORT_SHM if self.active else TRANSPORT_SOCKET,
+            "generation": self.generation,
+            "quarantine_reason": self.quarantine_reason,
+            "data": self.data.status(),
+            "verdict": self.verdict.status(),
+            **self.counters.status(),
+        }

@@ -82,6 +82,21 @@ MSG_TRACE_REPLY = 16
 # log (flowlog/ring.py) — the wire surface behind `cilium observe`.
 MSG_OBSERVE = 17
 MSG_OBSERVE_REPLY = 18
+# Shared-memory transport negotiation + notification (sidecar/shm.py).
+# ATTACH carries JSON ``{"generation": u32, "data": <segment name>,
+# "verdict": <segment name>}``; the service validates magic/generation
+# against the segment headers and replies ATTACH_REPLY JSON
+# ``{"status": FilterResult, "generation": u32, "error": str}``.  The
+# socket remains the control channel and fail-closed fallback rung;
+# after a successful attach, data batches ride the data ring and
+# verdict frames the verdict ring, with DOORBELL (shim→service) and
+# CREDIT (service→shim) frames batching the wakeups.  A CREDIT with
+# the quarantined flag demotes the session to the socket transport.
+MSG_SHM_ATTACH = 19
+MSG_SHM_ATTACH_REPLY = 20
+MSG_SHM_DOORBELL = 21
+MSG_SHM_CREDIT = 22
+MSG_SHM_DETACH = 23  # -> MSG_ACK; client tears its rings down after
 
 # OnIO op capacity per verdict entry (reference: cilium_proxylib.cc:199).
 MAX_OPS_PER_ENTRY = 16
@@ -298,6 +313,10 @@ class DataBatch(_AnsweredCell):
     # the queue-age watermark, and the _AnsweredCell answered flag.
     deadline: float | None = None
     arrival: float = 0.0
+    # Shared-memory transport bookkeeping: seconds between slot commit
+    # and doorbell drain (0 for socket-delivered batches) — the
+    # tracer's STAGE_RING input.
+    ring_wait: float = 0.0
     _acell: list = field(default_factory=lambda: [False])
 
     @property
@@ -324,6 +343,24 @@ class DataBatch(_AnsweredCell):
         )
 
 
+def pack_data_batch_parts(seq: int, conn_ids, flags, lengths,
+                          blob: bytes) -> list[bytes]:
+    """The DATA_BATCH frame as scatter-gather parts — THE one
+    definition of the layout, shared by the joined socket frame below
+    and the shm ring's slot writer (which copies the parts straight
+    into the slot, bulk blob last, no intermediate join)."""
+    conn_ids = np.ascontiguousarray(conn_ids, "<u8")
+    flags = np.ascontiguousarray(flags, "u1")
+    lengths = np.ascontiguousarray(lengths, "<u4")
+    return [
+        struct.pack("<QI", seq, len(conn_ids)),
+        conn_ids.tobytes(),
+        flags.tobytes(),
+        lengths.tobytes(),
+        blob,
+    ]
+
+
 def pack_data_batch(
     seq: int,
     conn_ids,
@@ -331,19 +368,8 @@ def pack_data_batch(
     lengths,
     blob: bytes,
 ) -> bytes:
-    conn_ids = np.ascontiguousarray(conn_ids, "<u8")
-    flags = np.ascontiguousarray(flags, "u1")
-    lengths = np.ascontiguousarray(lengths, "<u4")
-    n = len(conn_ids)
-    return b"".join(
-        (
-            struct.pack("<QI", seq, n),
-            conn_ids.tobytes(),
-            flags.tobytes(),
-            lengths.tobytes(),
-            blob,
-        )
-    )
+    return b"".join(pack_data_batch_parts(seq, conn_ids, flags,
+                                          lengths, blob))
 
 
 def unpack_data_batch(payload: bytes) -> DataBatch:
@@ -388,9 +414,10 @@ class MatrixBatch(_AnsweredCell):
     rows: np.ndarray  # u8[n, width], zero-padded past lengths
     flags: int = 0  # MAT_FLAG_* bits
     # Containment bookkeeping (service-side, never serialized):
-    # deadline/arrival as in DataBatch, plus the _AnsweredCell flag.
+    # deadline/arrival/ring_wait as in DataBatch, plus _AnsweredCell.
     deadline: float | None = None
     arrival: float = 0.0
+    ring_wait: float = 0.0
     _acell: list = field(default_factory=lambda: [False])
 
     @property
@@ -398,19 +425,26 @@ class MatrixBatch(_AnsweredCell):
         return len(self.conn_ids)
 
 
-def pack_data_matrix(seq: int, width: int, conn_ids, lengths,
-                     rows_bytes: bytes, flags: int = 0) -> bytes:
+def pack_data_matrix_parts(seq: int, width: int, conn_ids, lengths,
+                           rows_bytes: bytes,
+                           flags: int = 0) -> list[bytes]:
+    """DATA_MATRIX as scatter-gather parts (see
+    pack_data_batch_parts: one layout definition for both the socket
+    join and the shm slot writer)."""
     conn_ids = np.ascontiguousarray(conn_ids, "<u8")
     lengths = np.ascontiguousarray(lengths, "<u4")
-    n = len(conn_ids)
-    return b"".join(
-        (
-            struct.pack("<QIIB", seq, n, width, flags),
-            conn_ids.tobytes(),
-            lengths.tobytes(),
-            rows_bytes,
-        )
-    )
+    return [
+        struct.pack("<QIIB", seq, len(conn_ids), width, flags),
+        conn_ids.tobytes(),
+        lengths.tobytes(),
+        rows_bytes,
+    ]
+
+
+def pack_data_matrix(seq: int, width: int, conn_ids, lengths,
+                     rows_bytes: bytes, flags: int = 0) -> bytes:
+    return b"".join(pack_data_matrix_parts(seq, width, conn_ids,
+                                           lengths, rows_bytes, flags))
 
 
 def unpack_data_matrix(payload: bytes) -> MatrixBatch:
@@ -621,6 +655,50 @@ def unpack_verdict_multi(payload: bytes) -> list[VerdictBatch]:
         )
         a = b
     return out
+
+
+# --- SHM doorbell / credit ----------------------------------------------
+
+def pack_shm_doorbell(generation: int, data_tail: int,
+                      verdict_head: int) -> bytes:
+    """Shim→service: data ring published through ``data_tail``; the
+    shim's verdict-ring consume cursor is ``verdict_head`` (credit for
+    the service's verdict producer)."""
+    return struct.pack("<IQQ", generation, data_tail, verdict_head)
+
+
+def unpack_shm_doorbell(payload: bytes) -> tuple[int, int, int]:
+    return struct.unpack_from("<IQQ", payload, 0)
+
+
+def pack_shm_credit(generation: int, flags: int, data_head: int,
+                    verdict_tail: int) -> bytes:
+    """Service→shim: data ring consumed through ``data_head`` (slots
+    below it are free), verdict ring published through
+    ``verdict_tail``.  ``flags`` carries the quarantine bit (see
+    transport.CREDIT_FLAG_QUARANTINED): the session is demoted to the
+    socket transport and ring positions >= ``data_head`` were never
+    admitted — the shim answers them typed itself (zero silent loss)."""
+    return struct.pack("<IIQQ", generation, flags, data_head, verdict_tail)
+
+
+def unpack_shm_credit(payload: bytes) -> tuple[int, int, int, int]:
+    return struct.unpack_from("<IIQQ", payload, 0)
+
+
+# MSG_SHM_DETACH flag: fire-and-forget (no MSG_ACK reply).  Fault-path
+# demotions send this from the shim's reader thread, which cannot wait
+# a control round trip — and a stray unsolicited ACK would desync the
+# control-reply pairing of the next real RPC.
+DETACH_FLAG_NO_ACK = 1
+
+
+def pack_shm_detach(generation: int, flags: int = 0) -> bytes:
+    return struct.pack("<II", generation, flags)
+
+
+def unpack_shm_detach(payload: bytes) -> tuple[int, int]:
+    return struct.unpack_from("<II", payload, 0)
 
 
 # --- CLOSE / POLICY_UPDATE / ACK ----------------------------------------

@@ -304,6 +304,14 @@ SidecarClientReconnects = registry.counter(
     "sidecar_client_reconnects_total",
     "Successful shim-client reconnects to the verdict service",
 )
+SidecarTransportFallback = registry.counter(
+    "sidecar_transport_fallback_total",
+    "Shared-memory transport work served on the socket rung instead "
+    "(per-batch: ring_full | oversize | verdict_ring_full; session "
+    "demotions: torn_slot | generation_mismatch | attach_rejected | "
+    "disabled | peer_death)",
+    ("reason",),
+)
 FlowBufferOverflows = registry.counter(
     "flow_buffer_overflow_total",
     "Flows dropped for exceeding the retained-bytes cap without a "

@@ -186,6 +186,13 @@ class DaemonConfig:
     # a frame boundary gets a typed protocol-error DROP and is closed,
     # matching the reference's bounded retained-data contract.
     max_flow_buffer: int = 1 << 20
+    # Shared-memory transport (sidecar/shm.py): whether the service
+    # accepts MSG_SHM_ATTACH ring negotiation.  False rejects attaches
+    # typed — every session serves on the socket rung (the client's
+    # transport preference degrades, it never fails).  Ring geometry is
+    # client-owned (SidecarClient shm_* kwargs): the shim creates the
+    # segments and the service only maps what was negotiated.
+    shm_transport: bool = True
 
     # Verdict-path latency decomposition (sidecar/trace.py).
     # Always-on per-round stage histograms + occupancy/busy gauges

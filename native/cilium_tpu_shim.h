@@ -14,6 +14,14 @@
  * layout-identical to the reference ABI (reference:
  * proxylib/proxylib/types.h) so a consumer written against that contract
  * can link against this shim unchanged.
+ *
+ * Transport: this shim speaks the SOCKET rung of the transport seam
+ * (cilium_tpu/sidecar/transport.py).  The service also offers a
+ * shared-memory fast path (MSG_SHM_* 19-23: ring attach/doorbell/
+ * credit), negotiated per session and never required — a client that
+ * does not attach rings is served on the socket exactly as before, and
+ * unknown frame types are skipped by this shim's recv loops, so both
+ * client kinds coexist on one service.
  */
 
 #ifndef CILIUM_TPU_SHIM_H

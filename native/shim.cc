@@ -39,6 +39,17 @@ constexpr uint16_t kMsgVerdictBatch = 6;
 constexpr uint16_t kMsgClose = 7;
 constexpr uint16_t kMsgPolicyUpdate = 8;
 constexpr uint16_t kMsgAck = 9;
+// Shared-memory transport negotiation/notification (sidecar/shm.py).
+// This shim stays on the socket transport: it never sends kMsgShmAttach,
+// so the service never emits kMsgShmCredit to it, and the recv loops'
+// skip-unknown-frames discipline (`if (got != kMsg...) continue;`)
+// keeps it forward-compatible with shm-speaking peers on the same
+// service.  Listed here so the constant space stays in one place.
+[[maybe_unused]] constexpr uint16_t kMsgShmAttach = 19;
+[[maybe_unused]] constexpr uint16_t kMsgShmAttachReply = 20;
+[[maybe_unused]] constexpr uint16_t kMsgShmDoorbell = 21;
+[[maybe_unused]] constexpr uint16_t kMsgShmCredit = 22;
+[[maybe_unused]] constexpr uint16_t kMsgShmDetach = 23;
 
 struct Direction {
   std::string buffer;       // retained, not-yet-verdicted input

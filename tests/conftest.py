@@ -101,6 +101,83 @@ def _no_leaked_listening_sockets():
     )
 
 
+# --- shared-memory segment leak guard --------------------------------------
+#
+# The shm transport (sidecar/shm.py) creates /dev/shm segments per
+# session.  A test that forgets close()/unlink() leaks a mapping (and a
+# backing file) for the rest of the run — invisible until /dev/shm
+# fills or the resource tracker spams at exit.  Weakref-track every
+# SharedMemory create/attach; at module teardown, any handle opened
+# during the module that is still mapped — or a segment created during
+# the module and never unlinked — fails the module, named.
+
+from multiprocessing import shared_memory as _shared_memory
+
+_shm_handles: "weakref.WeakSet" = weakref.WeakSet()
+_shm_created: dict[str, bool] = {}  # name -> unlinked yet?
+
+_orig_shm_init = _shared_memory.SharedMemory.__init__
+_orig_shm_unlink = _shared_memory.SharedMemory.unlink
+
+
+def _tracking_shm_init(self, *args, **kwargs):
+    _orig_shm_init(self, *args, **kwargs)
+    _shm_handles.add(self)
+    created = kwargs.get("create", args[1] if len(args) > 1 else False)
+    if created:
+        _shm_created[self.name] = False
+
+
+def _tracking_shm_unlink(self):
+    _shm_created[self.name] = True
+    return _orig_shm_unlink(self)
+
+
+_shared_memory.SharedMemory.__init__ = _tracking_shm_init
+_shared_memory.SharedMemory.unlink = _tracking_shm_unlink
+
+
+def _open_shm_handles():
+    out = []
+    for s in list(_shm_handles):
+        if getattr(s, "_buf", None) is not None:  # not yet close()d
+            out.append(s)
+    return out
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_leaked_shm_segments():
+    baseline_handles = set(_open_shm_handles())
+    baseline_names = set(_shm_created)
+    yield
+    import time as _time
+
+    def _leaks():
+        handles = [
+            s for s in _open_shm_handles() if s not in baseline_handles
+        ]
+        names = [
+            n for n, unlinked in _shm_created.items()
+            if n not in baseline_names and not unlinked
+        ]
+        return handles, names
+
+    deadline = _time.monotonic() + 2.0
+    handles, names = _leaks()
+    while (handles or names) and _time.monotonic() < deadline:
+        _time.sleep(0.05)  # teardown threads may still be releasing
+        handles, names = _leaks()
+    assert not handles, (
+        "leaked SharedMemory handle(s) survived the module (a ring/"
+        "segment was not close()d): "
+        f"{sorted({s.name for s in handles})}"
+    )
+    assert not names, (
+        "SharedMemory segment(s) created during the module were never "
+        f"unlink()ed (backing /dev/shm files leak): {sorted(names)}"
+    )
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _no_leaked_threads():
     baseline = set(threading.enumerate())

@@ -1,0 +1,20 @@
+"""KNOWN-BAD corpus (R2.2): unbounded spin-waits polling a shared
+slot — no backoff, no blocking call, no deadline.  Under the GIL the
+spinning consumer starves the very producer it waits on."""
+
+
+class RingConsumer:
+    def __init__(self, commit, slots):
+        self.commit = commit  # shared u64 array, written by the peer
+        self.slots = slots
+
+    def wait_for_slot(self, pos):
+        while self.commit[pos % len(self.commit)] != pos + 1:  # EXPECT[R2]
+            pass
+        return self.slots[pos % len(self.slots)]
+
+    def wait_for_slot_true_loop(self, pos):
+        while True:  # EXPECT[R2]
+            if self.commit[pos % len(self.commit)] == pos + 1:
+                break
+        return self.slots[pos % len(self.slots)]

@@ -1,0 +1,26 @@
+"""KNOWN-GOOD corpus (R2.2): the sanctioned wait shapes — a
+backoff+deadline poll (bounded, yielding) and a loop whose own body
+mutates the polled buffer (it makes its own progress; nothing to wait
+on)."""
+
+import time
+
+
+class RingConsumer:
+    def __init__(self, commit, slots):
+        self.commit = commit
+        self.slots = slots
+
+    def wait_for_slot(self, pos, timeout_s=1.0):
+        deadline = time.monotonic() + timeout_s
+        while self.commit[pos % len(self.commit)] != pos + 1:
+            if time.monotonic() > deadline:
+                raise TimeoutError("slot never committed")
+            time.sleep(0.0005)
+        return self.slots[pos % len(self.slots)]
+
+    def grow_buckets(self, cap):
+        out = [32]
+        while out[-1] < cap:  # grows its own list: not a shared poll
+            out.append(out[-1] * 2)
+        return out

@@ -1,0 +1,9 @@
+import wire
+
+
+def handle(msg_type, payload):
+    if msg_type == wire.MSG_DOORBELL:
+        return wire.unpack_doorbell(payload)
+    if msg_type == wire.MSG_CREDIT:
+        return wire.unpack_credit(payload)
+    return None

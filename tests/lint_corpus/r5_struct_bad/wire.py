@@ -1,0 +1,25 @@
+"""KNOWN-BAD corpus (R5 struct symmetry, with siblings): the doorbell
+pack writes three fields (<IQQ) but its unpack reads two (<IQ) — the
+dropped cursor silently desynchronizes the ring protocol with no parse
+error anywhere."""
+
+import struct
+
+MSG_DOORBELL = 1
+MSG_CREDIT = 2
+
+
+def pack_doorbell(generation, tail, verdict_head):  # EXPECT[R5]
+    return struct.pack("<IQQ", generation, tail, verdict_head)
+
+
+def unpack_doorbell(payload):
+    return struct.unpack_from("<IQ", payload, 0)
+
+
+def pack_credit(generation, head):
+    return struct.pack("<IQ", generation, head)
+
+
+def unpack_credit(payload):
+    return struct.unpack_from("<IQ", payload, 0)

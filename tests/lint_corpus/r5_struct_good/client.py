@@ -1,0 +1,17 @@
+import wire
+
+
+def ring_doorbell(sock, generation, tail, verdict_head):
+    sock.sendall(wire.pack_doorbell(generation, tail, verdict_head))
+
+
+def send_credit(sock, generation, flags, head):
+    sock.sendall(wire.pack_credit(generation, flags, head))
+
+
+def route(msg_type, payload):
+    if msg_type == wire.MSG_CREDIT:
+        return wire.unpack_credit(payload)
+    if msg_type == wire.MSG_DOORBELL:
+        return wire.unpack_doorbell(payload)
+    return None

@@ -1,0 +1,23 @@
+"""KNOWN-GOOD corpus (R5 struct symmetry, with siblings): every
+pack_/unpack_ pair reads exactly the format its twin writes."""
+
+import struct
+
+MSG_DOORBELL = 1
+MSG_CREDIT = 2
+
+
+def pack_doorbell(generation, tail, verdict_head):
+    return struct.pack("<IQQ", generation, tail, verdict_head)
+
+
+def unpack_doorbell(payload):
+    return struct.unpack_from("<IQQ", payload, 0)
+
+
+def pack_credit(generation, flags, head):
+    return struct.pack("<IIQ", generation, flags, head)
+
+
+def unpack_credit(payload):
+    return struct.unpack_from("<IIQ", payload, 0)

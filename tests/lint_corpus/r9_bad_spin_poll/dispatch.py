@@ -1,0 +1,13 @@
+"""KNOWN-BAD corpus (R9, hot-path module name): spin-polling device
+future readiness in the dispatch loop — a core burned per outstanding
+round, invisible to the stage histograms."""
+
+
+class Completer:
+    def finish(self, futures):
+        out = []
+        for fut in futures:
+            while not fut.is_ready():  # EXPECT[R9]
+                pass
+            out.append(fut)
+        return out

@@ -153,9 +153,13 @@ def test_leased_keys_reclaimed_and_revoked_after_failover(pair):
         )
         assert observer.get("lease/me") == b"val"
         # The owner's death must now revoke the key ON THE FOLLOWER.
+        # Generous deadline: revocation rides session-death detection,
+        # whose timers stretch under CI load (observed >5s on a busy
+        # host while passing comfortably when idle).
         client.close()
         wait_for(
             lambda: observer.get("lease/me") is None,
+            timeout=20.0,
             msg="lease revoked on follower",
         )
     finally:

@@ -304,10 +304,18 @@ def test_round_trace_stage_decomposition():
     rt.drained()
     stages = rt.stages()
     assert set(stages) == {
-        "queue", "batch_form", "device_submit", "device", "drain", "send"
+        "ring", "queue", "batch_form", "device_submit", "device",
+        "drain", "send",
     }
+    assert stages["ring"] == 0.0  # socket-delivered round: no ring wait
     assert 0.009 <= stages["queue"] <= 0.5
     assert all(v >= 0 for v in stages.values())
+    # A shm-delivered round carves the ring wait OUT of the queue wait
+    # (their sum is the admit->pop span either way).
+    rt2 = tr.begin_round("vec", 10, t0 - 0.010, t0, ring_s=0.004)
+    s2 = rt2.stages()
+    assert abs(s2["ring"] - 0.004) < 1e-9
+    assert abs((s2["ring"] + s2["queue"]) - stages["queue"]) < 1e-3
     tr.finish_round(rt, [(1, 10, t0 - 0.010, 42)])
     st = tr.status()
     assert st["rounds"] == 1 and st["entries"] == 10

@@ -103,17 +103,20 @@ _CORPUS_CASES = [
     "r1_bad_crossmodule",
     "r2_bad_blocking.py",
     "r2_bad_helper_chain",
+    "r2_bad_spinwait.py",
     "r3_bad_bare_close.py",
     "r4_bad_impure_jit.py",
     "r5_bad",
     "r5_bad_verdict_dispatch.py",
     "r5_field_bad",
+    "r5_struct_bad",
     "r6_bad_thread.py",
     "r7_bad_dead_metric",
     "r7_bad_hot_observe",
     "r8_bad_recompile.py",
     "r9_bad_host_transfer.py",
     "r9_bad_hot_sync",
+    "r9_bad_spin_poll",
     "r10_bad_specs.py",
     "r11_bad_second_pass.py",
 ]
@@ -124,17 +127,20 @@ _CORPUS_CLEAN = [
     "r1_good_paired.py",
     "r1_good_lock_order.py",
     "r2_good_blocking.py",
+    "r2_good_spinwait.py",
     "r3_good_shutdown_close.py",
     "r4_good_pure_jit.py",
     "r5_good",
     "r5_good_verdict_gate.py",
     "r5_field_good",
+    "r5_struct_good",
     "r6_good_thread.py",
     "r7_good_metrics",
     "r7_good_hot_observe",
     "r8_good_stable.py",
     "r9_good_fenced.py",
     "r9_good_hot_sync",
+    "r9_good_spin_poll",
     "r10_good_specs.py",
     "r11_good_fused.py",
 ]
