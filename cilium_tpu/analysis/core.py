@@ -67,6 +67,10 @@ RULE_DOCS = {
            "must consume ONE shared hit-matrix pass — the attr twin "
            "calling the plain twin (or a diverged hits helper) is a "
            "second device pass",
+    "R12": "compile-on-dispatch-path: jit/trace/build/prewarm calls "
+           "reachable from the dispatch/service hot loops, or made "
+           "under a held lock in a hot module — recompiles belong on "
+           "the policy builder thread behind a pointer-flip swap",
 }
 
 # ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
@@ -374,6 +378,7 @@ def _collect_py(paths) -> list[str]:
 
 def all_rules():
     from . import (
+        rules_compile,
         rules_device,
         rules_jit,
         rules_locks,
@@ -394,6 +399,7 @@ def all_rules():
         rules_device.check_r9,
         rules_device.check_r10,
         rules_device.check_r11,
+        rules_compile.check_r12,
     ]
 
 

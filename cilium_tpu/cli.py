@@ -459,6 +459,17 @@ def cmd_sidecar_status(args):
           f"fallback={cont.get('fallback_entries', 0)} "
           f"stalls={cont.get('stalls', 0)} "
           f"quarantine_events={cont.get('quarantine_events', 0)}")
+    pol = st.get("policy") or {}
+    if pol:
+        fails = " ".join(
+            f"{k}={v}"
+            for k, v in sorted((pol.get("swap_failures") or {}).items())
+        )
+        print(f"policy: epoch={pol.get('epoch', 0)} "
+              f"swaps={pol.get('swaps', 0)} "
+              f"last_swap={pol.get('last_swap_ms', 0)}ms "
+              f"pending_builds={pol.get('pending_builds', 0)}"
+              + (f" failures: {fails}" if fails else ""))
     tr = st.get("transport") or {}
     if tr:
         rejects = " ".join(
@@ -567,6 +578,8 @@ def _format_flow_record(rec: dict) -> str:
         f" rule={rule} ({rec.get('match_kind') or '?'})"
         if rule >= 0 else ""
     )
+    if rec.get("epoch") is not None:
+        attr += f" epoch={rec['epoch']}"
     reason = f" reason={rec['reason']}" if rec.get("reason") else ""
     return (
         f"{ts} [{rec.get('path', '?')}] {rec.get('verdict', '?').upper()}: "
@@ -589,7 +602,7 @@ def cmd_observe(args):
         return 1
     filters = dict(
         verdict=args.verdict, path=args.path,
-        rule=args.rule, conn=args.conn,
+        rule=args.rule, conn=args.conn, epoch=args.epoch,
     )
     try:
         if not args.follow:
@@ -845,6 +858,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deciding rule row filter")
     x.add_argument("--conn", type=int, default=None,
                    help="connection id filter")
+    x.add_argument("--epoch", type=int, default=None,
+                   help="policy-table epoch filter (the epoch the "
+                        "verdict was decided against)")
     x.add_argument("--follow", "-f", action="store_true",
                    help="stream new records (poll with a seq cursor)")
     x.add_argument("--interval", type=float, default=0.5,

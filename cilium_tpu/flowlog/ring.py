@@ -48,10 +48,10 @@ class _RoundBatch:
     """One round's worth of flow records, columnar."""
 
     __slots__ = ("seq0", "ts", "path", "conn_ids", "codes", "rules",
-                 "kinds", "reason", "cols")
+                 "kinds", "reason", "cols", "epoch")
 
     def __init__(self, seq0, ts, path, conn_ids, codes, rules, kinds,
-                 reason, cols):
+                 reason, cols, epoch=-1):
         self.seq0 = seq0
         self.ts = ts
         self.path = path
@@ -61,6 +61,11 @@ class _RoundBatch:
         self.kinds = kinds          # tuple[str, ...] per-rule legend
         self.reason = reason
         self.cols = cols            # extra columnar fields or None
+        # Policy-table epoch the round's verdicts were decided against
+        # (-1 = pre-epoch layer).  Round-wide: one serving model per
+        # round batch; entrywise rounds carry a per-entry "epoch" col
+        # instead, which overrides at materialize time.
+        self.epoch = epoch
 
     @property
     def count(self) -> int:
@@ -121,12 +126,16 @@ class FlowLog:
 
     def add_round(self, path: str, conn_ids, codes, rules=None,
                   kinds: tuple = (), reason: str = "",
-                  cols: dict | None = None) -> None:
+                  cols: dict | None = None, epoch: int = -1) -> None:
         """Record one round's decisions.  ``conn_ids``/``codes`` are
         parallel arrays; ``rules`` the per-entry deciding-rule row
         (-1 = unattributed) and ``kinds`` the per-RULE match-kind
         legend of the serving model.  ``cols`` carries optional extra
-        columnar fields (datapath identity/ct columns)."""
+        columnar fields (datapath identity/ct columns).  ``epoch`` is
+        the policy-table epoch the round's verdicts were decided
+        against — captured WITH the kinds legend at decision time, so a
+        rule id is never resolved against a table it did not index
+        (per-entry cols["epoch"] overrides for mixed rounds)."""
         conn_ids = np.asarray(conn_ids, np.int64)
         n = len(conn_ids)
         if n == 0:
@@ -139,7 +148,7 @@ class FlowLog:
         ts = time.time()
         batch = _RoundBatch(
             0, ts, path, conn_ids, codes, rules, tuple(kinds), reason,
-            cols,
+            cols, epoch=int(epoch),
         )
         self._count_metrics(path, codes, rules, batch.kinds, cols)
         with self._lock:
@@ -251,6 +260,7 @@ class FlowLog:
             b.kinds[rule] if 0 <= rule < len(b.kinds) else MATCH_NONE
         )
         extra = None
+        epoch = b.epoch
         if b.cols:
             extra = {}
             for name, col in b.cols.items():
@@ -261,6 +271,10 @@ class FlowLog:
                     v = v.item()
                 extra[name] = v
             kind = extra.pop("match_kind", kind)
+            epoch = int(extra.pop("epoch", epoch))
+        if epoch >= 0:
+            extra = dict(extra or {})
+            extra["epoch"] = epoch
         return materialize(
             b.seq0 + i, b.ts, b.path, b.conn_ids[i], int(b.codes[i]),
             rule, kind, self._meta_for(int(b.conn_ids[i])),
@@ -269,7 +283,8 @@ class FlowLog:
 
     def query(self, n: int = 100, verdict: str | None = None,
               path: str | None = None, rule: int | None = None,
-              conn: int | None = None, since: int | None = None) -> list[dict]:
+              conn: int | None = None, since: int | None = None,
+              epoch: int | None = None) -> list[dict]:
         """Filtered record dicts.  Without ``since``: the newest ``n``
         matches, newest first.  With ``since``: records with
         seq > since in ASCENDING order (the `--follow` cursor
@@ -293,6 +308,13 @@ class FlowLog:
             if path is not None and b.path != path:
                 continue
             sel = np.arange(b.count)
+            if epoch is not None:
+                if b.cols is not None and "epoch" in b.cols:
+                    sel = sel[
+                        np.asarray(b.cols["epoch"])[sel] == epoch
+                    ]
+                elif b.epoch != epoch:
+                    continue
             if want_code is not None:
                 sel = sel[b.codes[sel] == want_code]
             if rule is not None:

@@ -46,26 +46,40 @@ class R2d2BatchModel(VerdictModel):
     cmd_any: jax.Array  # [R] bool
     remote_ids: jax.Array  # [R, MAX_REMOTES] int32
     any_remote: jax.Array  # [R] bool
-    # Per-row compiled match kind (literal|regex|nfa) — static aux used
-    # for rule attribution labels, never device data.
+    # Per-row compiled match kind (literal|regex|nfa) — attribution
+    # labeling only, never device data.  Deliberately EXCLUDED from the
+    # pytree aux: aux keys the jit trace cache, and kinds churn (a
+    # policy update relabeling same-shaped tables) must hit the
+    # existing executable — the traced computation never reads kinds,
+    # and nothing host-side consumes a round-tripped pytree's labels.
     match_kinds: tuple = ()
 
     def tree_flatten(self):
         return (
             (self.nfa, self.cmd_needle, self.cmd_len, self.cmd_any,
              self.remote_ids, self.any_remote),
-            (self.match_kinds,),
+            (),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves, match_kinds=aux[0] if aux else ())
+        return cls(*leaves)
 
     def __call__(self, data, lengths, remotes):
         return r2d2_verdicts(self, data, lengths, remotes)
 
     def verdicts_attr(self, data, lengths, remotes):
         return r2d2_verdicts_attr(self, data, lengths, remotes)
+
+    def dispatch_bare(self) -> "R2d2BatchModel":
+        """Capability marker for the service's shape-keyed dispatch
+        cache: models exposing this are passed as jit ARGUMENTS, so two
+        models compiled from DIFFERENT policies but the same bucketed
+        table shapes share one pytree structure and hit the same
+        compiled executable — policy churn re-uploads arrays instead of
+        retracing.  (match_kinds is already outside the pytree aux, so
+        the model itself is its own bare form.)"""
+        return self
 
 
 def _collect_rows(rules: CompiledPortRules):
@@ -109,35 +123,68 @@ def collect_policy_rows(
     return rows
 
 
+# Rule-row bucket floor for churned rebuilds (build_r2d2_model pads the
+# flattened row count up to the next power of two ≥ this): combined with
+# the service's shape-keyed dispatch cache, a policy update that stays
+# within the bucket reuses the compiled executable — the recompile cost
+# of churn collapses to an array upload.
+MIN_RULE_BUCKET = 8
+
+
+def _rule_bucket(n: int) -> int:
+    b = MIN_RULE_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
 def build_r2d2_model(
     policy: PolicyInstance | None, ingress: bool, port: int
 ) -> ConstVerdict | R2d2BatchModel:
     """Compile the effective rule set for (policy, direction, port) into a
-    batch model."""
+    batch model.  Rule rows are padded to the shape bucket so repeat
+    policy churn hits the executable cache (see MIN_RULE_BUCKET)."""
     rows = collect_policy_rows(policy, ingress, port)
     if isinstance(rows, ConstVerdict):
         return rows
-    return build_r2d2_model_from_rows(rows)
+    return build_r2d2_model_from_rows(rows, bucket=True)
 
 
 def build_r2d2_model_from_rows(
     rows: list[tuple[frozenset, str, str]],
+    bucket: bool = False,
 ) -> R2d2BatchModel:
-    """Compile (remote_set, cmd, file_regex) rows into device arrays."""
+    """Compile (remote_set, cmd, file_regex) rows into device arrays.
+
+    ``bucket=True`` pads the row axis to the next power-of-two bucket
+    with rows that can never match (remote set {-1}: identities are
+    non-negative, so rem_ok is identically False and a padding row can
+    never win the first-match argmax either).  ``match_kinds`` covers
+    REAL rows only — an attributed rule id never points at padding."""
     remote_sets = [r[0] for r in rows]
     packed_ids, any_remote = pack_remote_sets(remote_sets)
 
     n = len(rows)
-    cmd_needle = np.zeros((n, MAX_CMD), dtype=np.uint8)
-    cmd_len = np.zeros((n,), dtype=np.int32)
-    cmd_any = np.zeros((n,), dtype=bool)
+    n_pad = _rule_bucket(n) if bucket else n
+    cmd_needle = np.zeros((n_pad, MAX_CMD), dtype=np.uint8)
+    cmd_len = np.zeros((n_pad,), dtype=np.int32)
+    cmd_any = np.zeros((n_pad,), dtype=bool)
     for i, (_, cmd, _f) in enumerate(rows):
         b = cmd.encode()
         cmd_needle[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
         cmd_len[i] = len(b)
         cmd_any[i] = len(b) == 0
+    if n_pad > n:
+        ids = np.full((n_pad, packed_ids.shape[1]), -1, dtype=np.int32)
+        ids[:n] = packed_ids
+        packed_ids = ids
+        ar = np.zeros((n_pad,), dtype=bool)
+        ar[:n] = any_remote
+        any_remote = ar
 
-    nfa = compile_automaton([r[2] for r in rows])
+    nfa = compile_automaton(
+        [r[2] for r in rows] + [""] * (n_pad - n)
+    )
     kinds = tuple(
         "literal" if not file_rx
         else ("nfa" if isinstance(nfa, DeviceNfa) else "regex")

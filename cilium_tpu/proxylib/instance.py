@@ -55,10 +55,13 @@ class Instance:
     def policy_map(self) -> PolicyMap:
         return self._policy_map
 
-    def policy_update(self, configs: list[NetworkPolicy]) -> None:
-        """Atomically replace the policy map; an error while compiling any
-        policy leaves the active map untouched (reference: instance.go:168-219).
-        Unchanged policies are re-used from the old map."""
+    def policy_prepare(self, configs: list[NetworkPolicy]) -> PolicyMap:
+        """Compile a STAGED policy map without publishing it: the active
+        map keeps serving while compilation runs, and a compile error
+        leaves nothing half-applied (the staged map is simply dropped).
+        Unchanged policies are re-used from the old map.  The sidecar's
+        epoch swap builds device tables against the staged map and
+        publishes both in one pointer flip (policy_commit)."""
         old = self._policy_map
         new: PolicyMap = {}
         for config in configs:
@@ -67,7 +70,17 @@ class Instance:
                 new[config.name] = existing
                 continue
             new[config.name] = compile_policy(config)  # may raise
-        self._policy_map = new  # atomic swap (plain store; never mutated)
+        return new
+
+    def policy_commit(self, new: PolicyMap) -> None:
+        """Publish a staged map (atomic plain store; maps are never
+        mutated after construction)."""
+        self._policy_map = new
+
+    def policy_update(self, configs: list[NetworkPolicy]) -> None:
+        """Atomically replace the policy map; an error while compiling any
+        policy leaves the active map untouched (reference: instance.go:168-219)."""
+        self.policy_commit(self.policy_prepare(configs))
 
     def log(self, entry) -> None:
         if self.access_logger is not None:

@@ -321,3 +321,10 @@ class R2d2BatchEngine:
         st.ops = []
         st.reply_inject = bytearray()
         return ops, inject
+
+    def close_flow(self, flow_id: int) -> None:
+        """Drop a closed connection's flow state (same contract as the
+        l7/device-assisted engines — close_connection calls this on
+        whichever engine is bound, and a conn churned onto an r2d2
+        engine must not crash the round that closes it)."""
+        self.flows.pop(flow_id, None)

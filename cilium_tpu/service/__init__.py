@@ -328,6 +328,36 @@ class ServiceManager:
         else:
             self.lb_map.delete_service6(frontend.ip_int, frontend.port)
 
+    def resync(self, desired: list[tuple[L3n4Addr, list[L3n4Addr]]]) -> dict:
+        """Converge the LB maps onto the FULL desired frontend set —
+        the k8s relist path under churn (reference: the watcher's
+        replaceCiliumService resync after an apiserver reconnect).
+        Upserts every desired service and prunes frontends that
+        vanished from the desired set, so a burst of missed
+        add/update/delete events cannot leave stale map slots serving
+        dead backends.  Returns {"upserted", "created", "pruned"}."""
+        created = 0
+        keep: set[str] = set()
+        for frontend, backends in desired:
+            keep.add(frontend.key())
+            _, was_created = self.upsert(frontend, backends)
+            if was_created:
+                created += 1
+        pruned = 0
+        with self._mutex:
+            stale = [
+                svc.frontend for svc in self._services.values()
+                if svc.frontend.key() not in keep
+            ]
+            for frontend in stale:
+                if self.delete_by_frontend(frontend):
+                    pruned += 1
+        return {
+            "upserted": len(desired),
+            "created": created,
+            "pruned": pruned,
+        }
+
     # -- queries (reference: GET /service, GET /service/{id}) -------------
 
     def get(self, id_: int) -> LBService | None:

@@ -260,6 +260,11 @@ class SidecarClient:
         self._mod_map: dict[int, int] = {}
         self._conn_args: dict[int, tuple] = {}
         self._shims: dict[int, ShimConnection] = {}
+        # Policy-table epoch from the most recent policy_update ack
+        # (-1 before the first update / against a pre-epoch service):
+        # the control-plane's handle for "which table generation my
+        # rules are serving on" — flowlog records carry the same epoch.
+        self.last_policy_epoch = -1
         self._reader = threading.Thread(
             target=self._read_loop, args=(self.sock,), daemon=True
         )
@@ -935,11 +940,13 @@ class SidecarClient:
     def observe(self, n: int = 100, verdict: str | None = None,
                 path: str | None = None, rule: int | None = None,
                 conn: int | None = None,
-                since: int | None = None) -> dict:
+                since: int | None = None,
+                epoch: int | None = None) -> dict:
         """Flow-record query (MSG_OBSERVE round trip): the service's
         per-flow verdict records with device-side rule attribution —
         the `cilium observe` surface.  ``since`` is the follow cursor
-        (records with seq > since, ascending)."""
+        (records with seq > since, ascending); ``epoch`` filters on the
+        policy-table epoch the verdict was decided against."""
         req: dict = {"n": int(n)}
         if verdict is not None:
             req["verdict"] = verdict
@@ -951,6 +958,8 @@ class SidecarClient:
             req["conn"] = int(conn)
         if since is not None:
             req["since"] = int(since)
+        if epoch is not None:
+            req["epoch"] = int(epoch)
         got = self._control_rpc(
             lambda: (wire.MSG_OBSERVE, json.dumps(req).encode()),
             wire.MSG_OBSERVE_REPLY,
@@ -966,7 +975,10 @@ class SidecarClient:
             wire.MSG_ACK,
             retry=False,
         )
-        return wire.unpack_ack(got)
+        status, epoch = wire.unpack_ack_epoch(got)
+        if status == int(FilterResult.OK) and epoch >= 0:
+            self.last_policy_epoch = epoch
+        return status
 
     def policy_update(self, module_id: int, policies) -> int:
         payload = json.dumps([asdict(p) for p in policies]).encode()
@@ -977,8 +989,10 @@ class SidecarClient:
             ),
             wire.MSG_ACK,
         )
-        status = wire.unpack_ack(got)
+        status, epoch = wire.unpack_ack_epoch(got)
         if status == int(FilterResult.OK):
+            if epoch >= 0:
+                self.last_policy_epoch = epoch
             with self._session_lock:
                 if module_id in self._modules:
                     self._modules[module_id]["policies"] = payload

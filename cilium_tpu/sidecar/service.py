@@ -30,6 +30,7 @@ per-request critical path.
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import os
@@ -98,6 +99,18 @@ def _gather_model(model, blob, offs, lens, remotes, width: int,
     return model(rows, lens, remotes)
 
 
+def _call_model(model, data, lens, remotes):
+    """Model-as-argument trace twin of ``model(...)`` for the shape-
+    keyed dispatch cache: the model's tables are jit INPUTS, so same-
+    shaped rebuilds (policy churn) share one executable."""
+    return model(data, lens, remotes)
+
+
+def _call_model_attr(model, data, lens, remotes):
+    """Model-as-argument trace twin of ``model.verdicts_attr``."""
+    return model.verdicts_attr(data, lens, remotes)
+
+
 class _SidecarConn:
     """Service-side state for one datapath connection."""
 
@@ -125,6 +138,24 @@ class _SidecarConn:
         self.demoted_mod = None
 
 
+class EpochParityError(AssertionError):
+    """A staged epoch's device tables disagreed with the host oracle —
+    the swap is rejected and the old epoch keeps serving."""
+
+
+class _SwapJob:
+    """One staged policy-table swap riding the builder queue."""
+
+    __slots__ = ("module_id", "staged_map", "done", "status", "epoch")
+
+    def __init__(self, module_id: int, staged_map):
+        self.module_id = module_id
+        self.staged_map = staged_map
+        self.done = threading.Event()
+        self.status = int(FilterResult.UNKNOWN_ERROR)
+        self.epoch = -1
+
+
 class _TabSnap:
     """One-round consistent view of the vectorized-path conn tables,
     taken under the registry lock at the start of each dispatch round so
@@ -135,7 +166,8 @@ class _TabSnap:
     O(round conns), not O(table size).  Out-of-range ids materialize as
     engine=-1 / dirty=1 so they fail vec eligibility naturally."""
 
-    __slots__ = ("ids", "engine", "src", "dirty", "objs", "single")
+    __slots__ = ("ids", "engine", "src", "dirty", "objs", "single",
+                 "swap_s")
 
     def __init__(self, ids, engine, src, dirty, objs, single=False):
         self.ids = ids
@@ -146,6 +178,9 @@ class _TabSnap:
         # True when the snapshot rows are exactly one item's conn_ids in
         # arrival order — lookups are then the identity (no search).
         self.single = single
+        # Time this snapshot's lock acquisition spent blocked behind an
+        # epoch-swap pointer flip (the round books it as table_swap).
+        self.swap_s = 0.0
 
     def lookup(self, cids: np.ndarray) -> np.ndarray:
         """Positions of cids in the snapshot rows (every data-item conn
@@ -269,6 +304,10 @@ class VerdictService:
         self._jit_cache: dict[int, tuple] = {}
         self._jit_gather: dict[int, tuple] = {}
         self._jit_attr: dict[int, tuple] = {}
+        # Shape signatures prewarm has fully warmed (every bucket, both
+        # row and gather paths): a churn rebuild whose tables land in
+        # the same buckets skips its warm launches entirely.
+        self._prewarmed_shapes: dict = {}
         # Dispatch mode: 'eager'/'jit' honored as configured; 'auto' is
         # resolved by measurement at the first engine prewarm (guarded
         # by _dispatch_lock: concurrent first binds must not measure
@@ -316,6 +355,39 @@ class VerdictService:
         # session ring/fallback state lives on each _ClientHandler.
         self.transport_rejects: dict[str, int] = {}
         self.shm_entries = 0
+        # Policy-table epochs (guarded by _lock where noted).  Every
+        # committed rule-table generation gets a monotonic epoch:
+        # engines are stamped with the epoch they were compiled under,
+        # in-flight rounds finish on the epoch their snapshot captured,
+        # and flow records carry the epoch so a rule id is never
+        # resolved against a table it did not index.
+        self.policy_epoch = 0
+        # Staged compile-then-swap runs on ONE builder thread so the
+        # dispatch path never pays an XLA compile: the handler stages
+        # the host-compiled policy map, the builder rebuilds device
+        # engines + asserts per-epoch parity OFF-PATH, and the commit
+        # is a pointer flip under _lock (bounded; surfaced as the
+        # round decomposition's table_swap stage).
+        self._build_queue: "queue.Queue" = queue.Queue()
+        self._builder_thread: threading.Thread | None = None
+        # Conn ids with an in-flight builder rebind (quarantine-heal
+        # path) so the dispatch loop never compiles and never
+        # double-submits; guarded by _lock.
+        self._rebind_inflight: set[int] = set()
+        # Conns a swap could not rebind (in-flight deferred round /
+        # undrained engine ops at flip time): they finish on their
+        # captured engine, and the entrywise path catches them up to
+        # the current epoch — migrating the retained buffer — once the
+        # round drains.  Guarded by _lock; read lock-free (set
+        # membership) on the dispatch path.
+        self._stale_conns: set[int] = set()
+        # Most recent swap's lock-hold window (monotonic start, end):
+        # rounds whose snapshot acquisition overlapped it book the
+        # overlap as their table_swap stage.
+        self._swap_window = (0.0, 0.0)
+        self.policy_swaps = 0
+        self.policy_swap_failures: dict[str, int] = {}
+        self.last_swap_ms = 0.0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -350,6 +422,11 @@ class VerdictService:
             target=self._send_loop, name="verdict-send", daemon=True
         )
         self._send_thread.start()
+        self._builder_thread = threading.Thread(
+            target=self._policy_builder_loop, name="policy-builder",
+            daemon=True,
+        )
+        self._builder_thread.start()
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -377,6 +454,9 @@ class VerdictService:
         for client in clients:
             shutdown_close(client.sock)
         self.dispatcher.stop()
+        if self._builder_thread is not None:
+            self._build_queue.put(None)
+            self._builder_thread.join(timeout=5)
         if self._completion_thread is not None:
             self._completion_put(("stop",))
             self._completion_thread.join(timeout=5)
@@ -433,6 +513,16 @@ class VerdictService:
                 "shm_entries": self.shm_entries,
             },
             "dispatch_mode": self.dispatch_mode_chosen,
+            # Policy-table epoch churn: the committed epoch, swap
+            # counters, and typed fail-closed rejections (the old
+            # epoch kept serving through every one of them).
+            "policy": {
+                "epoch": self.policy_epoch,
+                "swaps": self.policy_swaps,
+                "swap_failures": dict(self.policy_swap_failures),
+                "pending_builds": self._build_queue.qsize(),
+                "last_swap_ms": self.last_swap_ms,
+            },
             "requests": self.fast_log.requests,
             "denied": self.fast_log.denied,
             "vec_batches": self.vec_batches,
@@ -480,47 +570,399 @@ class VerdictService:
     def close_module(self, module_id: int) -> None:
         pl.close_module(module_id)
 
-    def policy_update(self, module_id: int, policies_json: bytes) -> int:
+    def policy_update(self, module_id: int,
+                      policies_json: bytes) -> tuple[int, int]:
+        """Non-stop policy churn entry: stage, build off-path, swap.
+
+        Parse + host policy compile run here (fast, and a failure NACKs
+        with the active policy untouched — the old contract).  The
+        expensive half — device table rebuild + jit prewarm + per-epoch
+        parity — runs on the builder thread so no dispatch round ever
+        pays a compile; the commit is one pointer flip under _lock.
+        Returns (status, committed epoch): OK means the new epoch IS
+        serving; any failure is fail-closed — the previous epoch keeps
+        serving bit-identically and the failure is typed
+        (policy_swap_failures_total{reason})."""
         ins = pl.find_instance(module_id)
         if ins is None:
-            return int(FilterResult.INVALID_INSTANCE)
+            return int(FilterResult.INVALID_INSTANCE), self.policy_epoch
         try:
             configs = [policy_from_dict(d) for d in json.loads(policies_json)]
-            ins.policy_update(configs)
         except Exception:  # noqa: BLE001 — NACK, active policy untouched
-            log.exception("policy update rejected")
-            return int(FilterResult.POLICY_DROP)
+            log.exception("policy update rejected (parse)")
+            self._swap_failed("parse")
+            return int(FilterResult.POLICY_DROP), self.policy_epoch
+        try:
+            staged_map = ins.policy_prepare(configs)
+        except Exception:  # noqa: BLE001 — NACK, active policy untouched
+            log.exception("policy update rejected (host compile)")
+            self._swap_failed("host-compile")
+            return int(FilterResult.POLICY_DROP), self.policy_epoch
+        job = _SwapJob(module_id, staged_map)
+        self._build_queue.put(("swap", job))
+        if not job.done.wait(self.config.policy_swap_timeout_s):
+            # The build keeps running and will still swap when it
+            # lands; only the CONFIRMATION timed out.  Typed so the
+            # caller can re-poll status()["policy"]["epoch"].
+            self._swap_failed("ack-timeout")
+            return int(FilterResult.UNKNOWN_ERROR), self.policy_epoch
+        return job.status, job.epoch
+
+    # -- policy epoch builder (one thread; the only epoch incrementer) -----
+
+    def _swap_failed(self, reason: str) -> None:
+        self.policy_swap_failures[reason] = (
+            self.policy_swap_failures.get(reason, 0) + 1
+        )
+        metrics.PolicySwapFailures.inc(reason)
+
+    def _policy_builder_loop(self) -> None:
+        while True:
+            item = self._build_queue.get()
+            if item is None:
+                # Drain: pending jobs fail typed instead of stranding
+                # their handlers until the ack timeout.
+                while True:
+                    try:
+                        kind, job = self._build_queue.get_nowait()
+                    except queue.Empty:
+                        return
+                    if kind == "swap":
+                        self._swap_failed("shutdown")
+                        job.status = int(FilterResult.UNKNOWN_ERROR)
+                        job.epoch = self.policy_epoch
+                        job.done.set()
+            kind, job = item
+            try:
+                if kind == "swap":
+                    self._run_swap(job)
+                elif kind == "rebind":
+                    self._run_rebind(*job)
+            except Exception:  # noqa: BLE001 — builder must survive
+                log.exception("policy builder job failed")
+                if kind == "swap":
+                    self._swap_failed("device-build")
+                    job.status = int(FilterResult.POLICY_DROP)
+                    job.epoch = self.policy_epoch
+                    job.done.set()
+
+    def _engine_key_for(self, module_id: int, conn) -> tuple:
+        return (module_id, conn.policy_name, conn.ingress, conn.port,
+                conn.parser_name)
+
+    def _run_swap(self, job: "_SwapJob") -> None:
+        """Builder-thread half of one epoch: rebuild every live engine
+        for the module against the STAGED policy map (prewarm included
+        — shape-bucketed, so repeat churn hits the executable cache),
+        re-assert device/host bit-identity, then commit with one
+        pointer flip.  Any failure before the flip leaves the live
+        tables untouched: the old epoch keeps serving."""
+        module_id = job.module_id
+        ins = pl.find_instance(module_id)
+        if ins is None:
+            job.status = int(FilterResult.INVALID_INSTANCE)
+            job.epoch = self.policy_epoch
+            job.done.set()
+            return
+        epoch = self.policy_epoch + 1  # sole incrementer: this thread
+        # Modules are refcounted onto instances: every module id bound
+        # to THIS instance serves the swapped map, so all their engines
+        # rebuild with the epoch (a conn opened under a sibling module
+        # id must not keep a superseded table).
+        mods = {module_id}
         with self._lock:
-            # Drop engines compiled against the old policy map and free
-            # their table slots.
+            for sc in self._conns.values():
+                if sc.conn.instance is ins:
+                    mods.add(sc.module_id)
+            keys = {k for k in self._engines if k[0] in mods}
+            for sc in self._conns.values():
+                if sc.conn.instance is ins and sc.conn.parser_name in (
+                    "r2d2", "cassandra", "memcache", "http"
+                ):
+                    keys.add(self._engine_key_for(sc.module_id, sc.conn))
+        new_engines: dict[tuple, object] = {}
+        try:
+            for key in sorted(keys, key=repr):
+                _mod, policy_name, ingress, port, proto = key
+                policy = job.staged_map.get(policy_name)
+                with self._device_ctx():
+                    eng = self._make_engine(
+                        ins, policy, policy_name, ingress, port, proto
+                    )
+                if (
+                    self.config.policy_epoch_parity
+                    and proto == "r2d2"
+                    and not self.config.seam_probe
+                ):
+                    self._assert_epoch_parity(
+                        eng, policy, ingress, port
+                    )
+                eng.epoch = epoch
+                new_engines[key] = eng
+        except EpochParityError:
+            log.exception("policy swap rejected (epoch parity)")
+            self._swap_failed("parity")
+            job.status = int(FilterResult.POLICY_DROP)
+            job.epoch = self.policy_epoch
+            job.done.set()
+            return
+        except Exception:  # noqa: BLE001 — fail closed, old epoch serves
+            log.exception("policy swap rejected (device build)")
+            self._swap_failed("device-build")
+            job.status = int(FilterResult.POLICY_DROP)
+            job.epoch = self.policy_epoch
+            job.done.set()
+            return
+        self._commit_epoch(ins, mods, job.staged_map, new_engines,
+                           epoch)
+        job.status = int(FilterResult.OK)
+        job.epoch = epoch
+        job.done.set()
+
+    def _commit_epoch(self, ins, mods: set, staged_map,
+                      new_engines: dict, epoch: int) -> None:
+        """The pointer flip: publish the staged host map and the staged
+        engine table, rebind live conns, and migrate engine-retained
+        flow bytes — all under _lock, bounded-time (no compile, no
+        I/O).  Rounds blocked behind this hold book the overlap as
+        their table_swap stage."""
+        t0 = time.monotonic()
+        with self._lock:
+            ins.policy_commit(staged_map)
+            # Re-resolve sibling modules AT COMMIT TIME: a module
+            # bound to this instance during the (slow) staged build is
+            # not in the pre-build ``mods`` snapshot, and leaving its
+            # engines in place would keep a superseded table alive for
+            # a later rebind to find.
+            for k in self._engines:
+                if k[0] not in mods and pl.find_instance(k[0]) is ins:
+                    mods.add(k[0])
             dropped = [
-                v for k, v in self._engines.items() if k[0] == module_id
+                v for k, v in self._engines.items() if k[0] in mods
             ]
             self._engines = {
-                k: v for k, v in self._engines.items() if k[0] != module_id
+                k: v for k, v in self._engines.items()
+                if k[0] not in mods
             }
+            self._engines.update(new_engines)
             self._release_engines(dropped)
             for eng in dropped:
+                # Id-keyed jit entries die with their model; the
+                # shape-keyed entries are the churn executable cache
+                # and deliberately survive the swap.
                 mid = id(getattr(eng, "model", None))
                 self._jit_cache.pop(mid, None)
                 self._jit_gather.pop(mid, None)
                 self._jit_attr.pop(mid, None)
-            affected = [
-                sc for sc in self._conns.values() if sc.conn.instance is ins
-            ]
-            for sc in affected:
-                sc.engine = None
-                sc.fast_ok = False
-                cid = sc.conn.conn_id
-                if cid < self._tab_size:
-                    self._tab_engine[cid] = -1  # no vec until rebound
-        for sc in affected:
-            self._bind_engine(module_id, sc)
-            with self._lock:
-                self._tab_set_engine(
-                    sc.conn.conn_id, sc.engine if sc.fast_ok else None
+            async_pending = set(self._async_pending)
+            rebinds = []
+            for cid, sc in self._conns.items():
+                if sc.conn.instance is not ins:
+                    continue
+                old_eng = sc.engine
+                engine_proto = sc.conn.parser_name in (
+                    "r2d2", "cassandra", "memcache", "http"
                 )
-        return int(FilterResult.OK)
+                if old_eng is None and engine_proto and (
+                    sc.bufs[False] or sc.skip[False]
+                ):
+                    # Demoted conn with undrained oracle-mirror
+                    # request residue: binding an engine NOW would
+                    # strand those bytes (engine entries never consume
+                    # sc.bufs) — keep the oracle serving and let
+                    # _maybe_rebind bind after the residue drains
+                    # (pointer reads only; the engines now exist).
+                    sc.demoted_mod = sc.module_id
+                    self._tab_set_engine(cid, None)
+                    continue
+                if old_eng is not None and (
+                    cid in async_pending
+                    or not self._flow_migratable(old_eng, cid)
+                ):
+                    # In-flight deferred round (or undrained engine
+                    # ops): the conn finishes on the epoch it
+                    # snapshotted — its state stays on the OLD engine
+                    # and the stale-epoch catch-up on the dispatch
+                    # path rebinds (and migrates the buffer) once the
+                    # round drains.  The freed slot keeps it off the
+                    # vec path meanwhile.
+                    self._stale_conns.add(cid)
+                    self._tab_set_engine(cid, None)
+                    continue
+                eng = new_engines.get(
+                    self._engine_key_for(sc.module_id, sc.conn)
+                )
+                if eng is not None and old_eng is not None \
+                        and eng is not old_eng:
+                    self._migrate_flow(old_eng, eng, cid, sc)
+                sc.engine = eng
+                sc.fast_ok = (
+                    eng is not None and sc.conn.parser_name == "r2d2"
+                )
+                sc.demoted_mod = None
+                self._tab_set_engine(cid, eng)
+                if (
+                    eng is None
+                    and engine_proto
+                    and cid not in self._rebind_inflight
+                ):
+                    # Opened mid-build under a key the staged set did
+                    # not cover: rebuild off-path (oracle serves until
+                    # the bind lands) — never leave an engine-capable
+                    # conn stranded on the slow path.
+                    self._rebind_inflight.add(cid)
+                    rebinds.append((sc.module_id, cid))
+            self.policy_epoch = epoch
+            t1 = time.monotonic()
+            self._swap_window = (t0, t1)
+        for job in rebinds:
+            self._build_queue.put(("rebind", job))
+        hold = t1 - t0
+        self.policy_swaps += 1
+        self.last_swap_ms = round(hold * 1e3, 3)
+        metrics.PolicySwapsTotal.inc()
+        metrics.PolicySwapSeconds.observe(hold)
+        metrics.PolicyEpochGauge.set(float(epoch))
+        log.info(
+            "policy epoch %d committed for module(s) %s (%d engine(s), "
+            "flip %.2fms)", epoch, sorted(mods), len(new_engines),
+            hold * 1e3,
+        )
+
+    @staticmethod
+    def _flow_migratable(old_eng, conn_id: int) -> bool:
+        """True when the conn can adopt a new epoch's engine NOW.
+        Two flow shapes:
+
+        - r2d2 ``FlowState``: ops is a LIST, reply_inject a bytearray —
+          migratable once both are drained (the byte buffer itself
+          moves in _migrate_flow);
+        - l7 ``_EngineFlow``: ops/bufs/skip are per-direction dicts,
+          and the parser state behind a buffered partial frame is not
+          portable across policy objects — the conn stays on its
+          captured epoch until the frame drains at a boundary (a frame
+          judged half-old-half-new would be worse than a briefly-stale
+          conn; the stale-conn catch-up retries per entry)."""
+        fl = old_eng.flows.get(conn_id) if hasattr(old_eng, "flows") \
+            else None
+        if fl is None:
+            return True
+        ops = getattr(fl, "ops", None)
+        if isinstance(ops, dict):  # l7 _EngineFlow
+            if any(ops.values()):
+                return False
+            return not (
+                fl.bufs[False] or fl.bufs[True]
+                or fl.skip[False] or fl.skip[True]
+            )
+        return not (ops or getattr(fl, "reply_inject", None))
+
+    @staticmethod
+    def _migrate_flow(old_eng, new_eng, conn_id: int, sc) -> None:
+        """Carry a conn's engine-retained request bytes across the
+        epoch swap so no byte is lost or replayed.  Callers gate on
+        _flow_migratable / async-pending first — a conn whose state is
+        still owed to an in-flight round (or holds an unportable l7
+        partial frame) is deferred to the stale-conn catch-up
+        instead."""
+        fl = old_eng.flows.get(conn_id) if hasattr(old_eng, "flows") \
+            else None
+        if fl is None:
+            return
+        buf = getattr(fl, "buffer", None)
+        if buf is None:
+            # l7 _EngineFlow: gated EMPTY by _flow_migratable — nothing
+            # to move; the inert flow dies with the released engine and
+            # the new engine builds a fresh one on first feed.
+            return
+        if buf:
+            conn = sc.conn
+            nf = new_eng.flow(
+                conn_id, remote_id=fl.remote_id,
+                policy_name=conn.policy_name, ingress=conn.ingress,
+                dst_id=conn.dst_id, src_addr=conn.src_addr,
+                dst_addr=conn.dst_addr,
+            )
+            nf.buffer += bytes(buf)
+            buf.clear()
+        old_eng.flows.pop(conn_id, None)
+
+    def _run_rebind(self, module_id: int, conn_id: int) -> None:
+        """Builder-thread engine (re)bind for a conn whose key had no
+        live engine (quarantine heal): the compile happens HERE, never
+        on the dispatch path.  The conn keeps serving on the oracle
+        until the bind lands.  A device that re-quarantined before this
+        job ran is handled by _bind_engine itself — it re-demotes
+        (sets demoted_mod) so the heal path retries, never a silent
+        drop."""
+        with self._lock:
+            sc = self._conns.get(conn_id)
+        try:
+            if sc is not None and sc.engine is None:
+                self._bind_engine(module_id, sc)
+                with self._lock:
+                    if self._conns.get(conn_id) is sc:
+                        self._tab_set_engine(
+                            conn_id, sc.engine if sc.fast_ok else None
+                        )
+        finally:
+            with self._lock:
+                self._rebind_inflight.discard(conn_id)
+
+    # Deterministic per-epoch parity probe: every valid command crossed
+    # with distinctive files; remotes are drawn from the candidate
+    # model's own remote table plus never-allowed sentinels.
+    _PARITY_PROBES = (
+        ("READ", "/public/app"), ("READ", "/etc/shadow"), ("READ", ""),
+        ("WRITE", "/public/app"), ("WRITE", "/data/x"),
+        ("HALT", ""), ("RESET", ""),
+    )
+
+    def _assert_epoch_parity(self, engine, policy, ingress: bool,
+                             port: int) -> None:
+        """Re-assert device-model vs host-oracle bit-identity for a
+        staged engine before its epoch can commit: one prewarmed-shape
+        device batch over the probe grid, compared against the staged
+        policy's host walk.  A mismatch raises EpochParityError and
+        fails the swap typed — a miscompiled table can never serve."""
+        model = engine.model
+        if isinstance(model, ConstVerdict):
+            return
+        from ..proxylib.parsers.r2d2 import R2d2RequestData
+
+        rem_tab = np.asarray(model.remote_ids).ravel()
+        remotes = sorted(set(int(r) for r in rem_tab if r > 0))[:4]
+        remotes += [1, 999983]  # a common id + a never-allocated one
+        cases = [
+            (cmd, f, rem)
+            for cmd, f in self._PARITY_PROBES
+            for rem in remotes
+        ]
+        b = self._min_bucket
+        while b < len(cases):
+            b *= 2
+        width = self.config.batch_width
+        data = np.zeros((b, width), np.uint8)
+        lens = np.zeros(b, np.int32)
+        rems = np.zeros(b, np.int32)
+        for i, (cmd, f, rem) in enumerate(cases):
+            frame = (f"{cmd} {f}\r\n" if f else f"{cmd}\r\n").encode()
+            row = np.frombuffer(frame, np.uint8)
+            data[i, : len(row)] = row
+            lens[i] = len(row)
+            rems[i] = rem
+        out = self._model_call(model, data, lens, rems)
+        allow = np.asarray(out[-1])[: len(cases)]
+        for i, (cmd, f, rem) in enumerate(cases):
+            host = policy is not None and policy.matches(
+                ingress, port, rem, R2d2RequestData(cmd, f)
+            )
+            if bool(allow[i]) != bool(host):
+                raise EpochParityError(
+                    f"epoch parity violation: probe "
+                    f"(cmd={cmd!r} file={f!r} remote={rem}) device="
+                    f"{bool(allow[i])} host={host}"
+                )
 
     def new_connection(self, module_id, conn_id, ingress, src_id, dst_id,
                        proto, src_addr, dst_addr, policy_name, client) -> int:
@@ -532,12 +974,35 @@ class VerdictService:
             return int(res)
         sc = _SidecarConn(conn, client, None, module_id=module_id)
         self._bind_engine(module_id, sc)
+        rebind = False
         with self._lock:
+            # Re-resolve against the CURRENT epoch's table: an epoch
+            # swap may have committed between the bind above and this
+            # registration, and the conn must never enter the registry
+            # holding a superseded engine (it would serve the old
+            # policy until the next swap touched it).
+            if sc.engine is not None:
+                cur = self._engines.get(
+                    self._engine_key_for(module_id, conn)
+                )
+                if cur is not None and cur is not sc.engine:
+                    sc.engine = cur
+                elif cur is None:
+                    # The key vanished under a racing swap (our freshly
+                    # built engine was dropped with the old epoch):
+                    # serve on the oracle and rebuild off-path.
+                    sc.engine = None
+                    sc.fast_ok = False
+                    if conn_id not in self._rebind_inflight:
+                        self._rebind_inflight.add(conn_id)
+                        rebind = True
             self._conns[conn_id] = sc
             if self._tab_ensure(conn_id):
                 self._tab_src[conn_id] = conn.src_id
                 self._tab_dirty[conn_id] = 0
             self._tab_set_engine(conn_id, sc.engine if sc.fast_ok else None)
+        if rebind:
+            self._build_queue.put(("rebind", (module_id, conn_id)))
         if self.flowlog is not None:
             # Connection metadata registered ONCE here (and dropped at
             # close) so per-round record emission stores bare arrays —
@@ -647,7 +1112,11 @@ class VerdictService:
 
     def _bind_engine(self, module_id: int, sc: _SidecarConn) -> None:
         """Attach the device batch engine for this connection's
-        (policy, direction, port, proto), building the model on first use."""
+        (policy, direction, port, proto), building the model on first
+        use.  Epoch-safe: the build reads the policy map of ONE epoch;
+        if a swap commits while the build runs, the stale engine is
+        discarded and the bind retries against the new epoch (never
+        inserted — a swap must not be undone by a racing first-bind)."""
         conn = sc.conn
         proto = conn.parser_name
         if proto not in ("r2d2", "cassandra", "memcache", "http"):
@@ -659,25 +1128,44 @@ class VerdictService:
             sc.demoted_mod = module_id
             return
         key = (module_id, conn.policy_name, conn.ingress, conn.port, proto)
-        with self._lock:
-            eng = self._engines.get(key)
-        if eng is None:
+        for _attempt in range(4):
+            with self._lock:
+                eng = self._engines.get(key)
+                epoch0 = self.policy_epoch
+            if eng is not None:
+                break
             # Build and prewarm OUTSIDE the registry lock: XLA compiles
             # are slow and must not stall unrelated control/data traffic.
             # Built under the configured verdict device so the model's
-            # tables are colocated with its dispatch.
+            # tables are colocated with its dispatch.  This is the
+            # first-bind cold path (once per key); churn rebuilds ride
+            # the async builder instead.
+            ins = pl.find_instance(module_id)
+            policy = ins.policy_map().get(conn.policy_name)
             with self._device_ctx():
-                eng = self._build_engine(module_id, conn, proto)
+                # lint: disable=R12 -- first-bind cold path off the dispatch loop (reader/builder thread, once per engine key); churn recompiles ride the policy builder
+                built = self._make_engine(
+                    ins, policy, conn.policy_name, conn.ingress,
+                    conn.port, proto,
+                )
+            built.epoch = epoch0
             with self._lock:
+                if self.policy_epoch != epoch0:
+                    continue  # epoch moved under the build: retry
                 # Double-checked insert: a racing binder may have won.
-                eng = self._engines.setdefault(key, eng)
+                eng = self._engines.setdefault(key, built)
+            break
+        if eng is None:
+            return  # persistent epoch churn: serve on the oracle path
         sc.engine = eng
         # Only the r2d2 engine is vectorized-path capable.
         sc.fast_ok = proto == "r2d2"
 
-    def _build_engine(self, module_id: int, conn, proto: str):
-        ins = pl.find_instance(module_id)
-        policy = ins.policy_map().get(conn.policy_name)
+    def _make_engine(self, ins, policy, policy_name: str, ingress: bool,
+                     port: int, proto: str):
+        """Compile one engine from an EXPLICIT policy object — shared
+        by the first-bind path (live map) and the epoch builder
+        (staged map), so the two can never drift."""
         if proto == "r2d2":
             from ..models.r2d2 import build_r2d2_model
 
@@ -686,7 +1174,7 @@ class VerdictService:
 
                 model = SeamProbe()
             else:
-                model = build_r2d2_model(policy, conn.ingress, conn.port)
+                model = build_r2d2_model(policy, ingress, port)
             eng = R2d2BatchEngine(
                 model,
                 capacity=self.config.batch_flows,
@@ -706,20 +1194,20 @@ class VerdictService:
         if proto == "cassandra":
             from ..models.cassandra import build_cassandra_model
 
-            model = build_cassandra_model(policy, conn.ingress, conn.port)
+            model = build_cassandra_model(policy, ingress, port)
             cls = CassandraBatchEngine
         elif proto == "http":
             from ..models.http import build_http_model_for_port
 
-            model = build_http_model_for_port(policy, conn.ingress, conn.port)
+            model = build_http_model_for_port(policy, ingress, port)
             cls = HttpSidecarEngine
         else:
             from ..models.memcached import build_memcache_model
 
-            model = build_memcache_model(policy, conn.ingress, conn.port)
+            model = build_memcache_model(policy, ingress, port)
             cls = MemcacheBatchEngine
         eng = cls(
-            policy, conn.ingress, conn.port, model,
+            policy, ingress, port, model,
             logger=ins.access_logger,
             capacity=self.config.batch_flows,
             max_buffer=self.config.max_flow_buffer,
@@ -745,6 +1233,8 @@ class VerdictService:
             if sc is None or (expect is not None and sc is not expect):
                 return
             del self._conns[conn_id]
+            self._stale_conns.discard(conn_id)
+            self._rebind_inflight.discard(conn_id)
             if conn_id < self._tab_size:
                 self._tab_engine[conn_id] = -1
                 self._tab_dirty[conn_id] = 0
@@ -915,7 +1405,9 @@ class VerdictService:
             return False
         idx = ids.astype(np.int64)
         mark("concat")
+        t_before = time.monotonic()
         with self._lock:
+            swap_s = self._swap_overlap(t_before)
             if self._tab_size == 0 or int(idx.max()) >= self._tab_size:
                 return False
             eng_idx = self._tab_engine[idx]
@@ -933,7 +1425,7 @@ class VerdictService:
         mark("eligibility")
         rt = self.tracer.begin_round(
             PATH_VEC, n, self._oldest_arrival(items), t_pop,
-            ring_s=self._ring_wait(items),
+            ring_s=self._ring_wait(items), swap_s=swap_s,
         )
         rt.formed()
         # Issue device chunks with the precomputed remotes, then one
@@ -1055,7 +1547,10 @@ class VerdictService:
     def _record_vec_round(self, engine, conn_ids, allow, rules) -> None:
         """One flow-record batch for a vec/matrix round: columnar
         arrays straight from the readback, ONE ring append (R7: no
-        per-entry work on the hot path)."""
+        per-entry work on the hot path).  Epoch and kinds legend both
+        come from the CAPTURED engine — the tables the rule ids
+        actually index — never from a re-read that churn could have
+        rebound."""
         if self.flowlog is None:
             return
         self.flowlog.add_round(
@@ -1064,6 +1559,7 @@ class VerdictService:
             np.where(allow, CODE_FORWARDED, CODE_DENIED).astype(np.int8),
             rules,
             kinds=getattr(engine.model, "match_kinds", ()),
+            epoch=getattr(engine, "epoch", 0),
         )
 
     @staticmethod
@@ -1090,12 +1586,38 @@ class VerdictService:
         kinds = getattr(model, "match_kinds", ()) if model is not None else ()
         return kinds[rule] if 0 <= rule < len(kinds) else ""
 
+    def _engine_rule_kind(self, engine, conn_id: int,
+                          sc=None) -> tuple[int, str, int]:
+        """(rule, kind, epoch) for an entry decided by a CAPTURED
+        engine — the slot-reuse-safe attribution: churn may free and
+        reuse the engine's table slot (or rebind sc.engine) before the
+        record is emitted, so the rule id must resolve against the
+        engine that judged it, stamped with that engine's epoch."""
+        fl = engine.flows.get(conn_id)
+        if fl is not None:
+            conn = getattr(fl, "conn", None)
+            rule = (
+                conn.last_rule_id if conn is not None
+                else getattr(fl, "last_rule_id", -1)
+            )
+            return (
+                int(rule),
+                self._kind_for(engine.model, int(rule)),
+                getattr(engine, "epoch", 0),
+            )
+        if sc is not None:
+            return int(sc.conn.last_rule_id), "", self.policy_epoch
+        return -1, "", -1
+
     def _entry_rule_kind(self, sc, conn_id: int) -> tuple[int, str]:
         """Rule attribution for an entrywise entry decided inside an
         engine pump or the oracle parser: the device-assisted engines
         and the oracle stamp Connection.last_rule_id (via matches_at /
         the precomputed-verdict queue), the r2d2 pump stamps
-        FlowState.last_rule_id."""
+        FlowState.last_rule_id.  EMISSION-time fallback only — decision
+        layers capture via _engine_rule_kind instead wherever the
+        engine is snapshotted (rules_out), so churn cannot rebind
+        sc.engine between decision and record."""
         if sc is None:
             return -1, ""
         eng = sc.engine
@@ -1125,6 +1647,7 @@ class VerdictService:
         codes: list[int] = []
         rules: list[int] = []
         kinds: list[str] = []
+        epochs: list[int] = []
         for item in items:
             resp = responses.get(id(item))
             if resp is None:
@@ -1143,27 +1666,36 @@ class VerdictService:
                     rules_out.get((id(item), i)) if rules_out else None
                 )
                 if judged is not None:
-                    rule, kind = judged  # captured at judge time
+                    rule, kind, ep = judged  # captured at judge time
+                    if code != CODE_FORWARDED:
+                        # A non-forwarded entry must not borrow a
+                        # stale allowing rule (see the else arm).
+                        rule, kind = -1, ""
                 elif code == CODE_FORWARDED:
                     rule, kind = self._entry_rule_kind(sc, conn_id)
+                    ep = self.policy_epoch
                 else:
                     # last_rule_id is the LAST decision's rule; a
                     # non-forwarded entry (its first DROP decided) must
                     # not borrow a later allowing frame's rule —
                     # denied/shed/error records are unattributed, like
                     # the vec path's deny rows.
-                    rule, kind = -1, ""
+                    rule, kind, ep = -1, "", -1
                 conn_ids.append(conn_id)
                 codes.append(code)
                 rules.append(rule)
                 kinds.append(kind)
+                epochs.append(ep)
         if conn_ids:
             self.flowlog.add_round(
                 path,
                 np.asarray(conn_ids, np.int64),
                 np.asarray(codes, np.int8),
                 np.asarray(rules, np.int32),
-                cols={"match_kind": kinds},
+                cols={
+                    "match_kind": kinds,
+                    "epoch": np.asarray(epochs, np.int64),
+                },
             )
 
     def observe_dump(self, req: dict) -> dict:
@@ -1177,6 +1709,7 @@ class VerdictService:
             rule=req.get("rule"),
             conn=req.get("conn"),
             since=req.get("since"),
+            epoch=req.get("epoch"),
         )
         return {"records": records, "stats": self.flowlog.stats()}
 
@@ -1415,9 +1948,12 @@ class VerdictService:
                 self._tab_dirty[conn_id] = 1
 
     def _maybe_rebind(self, conn_id: int, sc: "_SidecarConn") -> None:
-        """Un-demote after the device heals: once the oracle residue has
-        drained, bind the engine back so the conn resumes the device
-        path."""
+        """Un-demote after the device heals: once the oracle residue
+        has drained, bind the engine back so the conn resumes the
+        device path.  Runs on the DISPATCH path, so it never compiles:
+        an existing engine for the key binds inline (pointer reads
+        only); a missing one is built by the policy builder thread
+        while the conn keeps serving on the oracle."""
         if (
             sc.demoted_mod is None
             or sc.bufs[False]
@@ -1427,18 +1963,22 @@ class VerdictService:
         ):
             return
         mod = sc.demoted_mod
-        sc.demoted_mod = None
-        try:
-            self._bind_engine(mod, sc)
-        except Exception:  # noqa: BLE001 — stay on the oracle path
-            log.exception("engine rebind after heal failed")
-            sc.engine = None
-            sc.fast_ok = False
-            return
+        key = self._engine_key_for(mod, sc.conn)
         with self._lock:
-            self._tab_set_engine(
-                conn_id, sc.engine if sc.fast_ok else None
-            )
+            eng = self._engines.get(key)
+            if eng is not None:
+                sc.demoted_mod = None
+                sc.engine = eng
+                sc.fast_ok = sc.conn.parser_name == "r2d2"
+                self._tab_set_engine(
+                    conn_id, eng if sc.fast_ok else None
+                )
+                return
+            if conn_id in self._rebind_inflight:
+                return
+            self._rebind_inflight.add(conn_id)
+            sc.demoted_mod = None
+        self._build_queue.put(("rebind", (mod, conn_id)))
 
     def _process(self, items: list) -> None:
         """Dispatcher entry: triage aggregated items.
@@ -1523,12 +2063,25 @@ class VerdictService:
         if vec:
             self._run_vec([(it, eng) for _, it, eng in vec], snap, t_pop)
         if general:
-            self._process_entrywise([it for _, it in general], t_pop)
+            self._process_entrywise(
+                [it for _, it in general], t_pop,
+                swap_s=snap.swap_s if snap is not None else 0.0,
+            )
         for close_args in closes:
             self.close_connection(*close_args)
         # The round completed without raising — reset the poisoned-
         # engine crash streak.
         self._round_record_ok()
+
+    def _swap_overlap(self, t_before: float) -> float:
+        """Portion of a just-finished _lock acquisition that was spent
+        blocked behind the epoch-swap pointer flip: the overlap of
+        [t_before, now] with the last swap's lock-hold window.  Zero
+        for every round that did not actually contend with a swap."""
+        w0, w1 = self._swap_window
+        if not w1:
+            return 0.0
+        return max(0.0, min(w1, time.monotonic()) - max(w0, t_before))
 
     def _round_thread_suppressed(self) -> bool:
         """True on a thread whose guard bookkeeping must be dropped —
@@ -1576,9 +2129,11 @@ class VerdictService:
                     [it[2].conn_ids for it in data_items]
                 ).astype(np.int64)
             )
+        t_before = time.monotonic()
         with self._lock:
+            swap_s = self._swap_overlap(t_before)
             if self._tab_size == 0:
-                return _TabSnap(
+                snap = _TabSnap(
                     ids,
                     np.full(len(ids), -1, np.int32),
                     np.zeros(len(ids), np.int32),
@@ -1586,13 +2141,15 @@ class VerdictService:
                     (),
                     single,
                 )
+                snap.swap_s = swap_s
+                return snap
             objs = self._objs_cache
             if objs is None:
                 objs = self._objs_cache = tuple(self._engine_objs)
             if len(ids) and int(ids[-1]) < self._tab_size:
                 # All in range (ids sorted): three plain gathers — the
                 # fancy index copies, which IS the snapshot.
-                return _TabSnap(
+                snap = _TabSnap(
                     ids,
                     self._tab_engine[ids],
                     self._tab_src[ids],
@@ -1600,6 +2157,8 @@ class VerdictService:
                     objs,
                     single,
                 )
+                snap.swap_s = swap_s
+                return snap
             in_range = ids < self._tab_size
             clipped = np.where(in_range, ids, 0)
             engine = np.where(
@@ -1609,7 +2168,9 @@ class VerdictService:
             dirty = np.where(
                 in_range, self._tab_dirty[clipped], 1
             ).astype(np.uint8)
-        return _TabSnap(ids, engine, src, dirty, objs, single)
+        snap = _TabSnap(ids, engine, src, dirty, objs, single)
+        snap.swap_s = swap_s
+        return snap
 
     def _matrix_eligible(self, mb: wire.MatrixBatch, snap: "_TabSnap"):
         """Engine for a fixed-width matrix batch, or None to fall back."""
@@ -1710,19 +2271,61 @@ class VerdictService:
 
         return jax.default_device(self._exec_device)
 
-    def _jit_for(self, cache: dict, model, trace_fn):
-        """id(model)-keyed jit cache; the stored model reference pins
+    def _jit_for(self, cache: dict, model, trace_fn, arg_fn=None):
+        """Jit-dispatch cache, two keying modes.
+
+        **Shape-keyed** (models exposing ``dispatch_bare()``, the r2d2
+        path): the executable takes the model as a pytree ARGUMENT, so
+        the cache key is the model's tree structure + leaf
+        shapes/dtypes — NOT its identity.  Policy churn that rebuilds
+        same-bucketed tables (models/r2d2.py pads rule rows to power-
+        of-two buckets) then reuses the compiled executable and only
+        uploads fresh arrays; these entries deliberately survive epoch
+        swaps.  ``arg_fn(model, *args)`` is the trace function.
+
+        **Id-keyed** (everything else): the stored model reference pins
         the id so a gc'd model can never alias an entry.  (Binding the
         device via in_shardings instead of the default-device ctx was
         tried and reverted: 15µs/call isolated but ~400µs of spinning
         thread-CPU under multi-thread contention on a small host.)"""
+        key = self._model_shape_key(model) if arg_fn is not None else None
+        if key is not None:
+            fn = cache.get(key)
+            if fn is None:
+                import jax
+
+                self._evict_shape_entries(cache)
+                # lint: disable=R12 -- cache-miss only: every serving shape is prewarmed off-path at engine build/swap; a miss here is the documented lazy greedy-mode gather compile (local, cheap)
+                fn = jax.jit(arg_fn)
+                cache[key] = fn
+            return functools.partial(fn, model.dispatch_bare())
         ent = cache.get(id(model))
         if ent is None:
             import jax
 
+            # lint: disable=R12 -- cache-miss only: prewarm traces every bucket shape at engine build (builder/reader thread); dispatch rounds only ever hit this dict
             ent = (model, jax.jit(trace_fn))
             cache[id(model)] = ent
         return ent[1]
+
+    # Distinct table-shape signatures a shape-keyed cache may hold
+    # before the oldest are evicted: bounds executable memory on a
+    # long-running service under regex-vocabulary churn (each new
+    # automaton state count is a new shape).  Well above any
+    # steady-state working set — eviction is the runaway backstop, not
+    # a tuning knob.
+    SHAPE_CACHE_MAX = 64
+
+    def _evict_shape_entries(self, cache: dict) -> None:
+        """Evict the oldest shape-keyed entries once the cache holds
+        SHAPE_CACHE_MAX distinct shapes (dict order = insertion order;
+        id-keyed entries are untouched — their lifecycle is the engine
+        drop at swap)."""
+        shape_keys = [k for k in cache if isinstance(k, tuple)]
+        while len(shape_keys) >= self.SHAPE_CACHE_MAX:
+            victim = shape_keys.pop(0)
+            cache.pop(victim, None)
+            self._prewarmed_shapes.pop(victim, None)
 
     def _model_call(self, model, data, lens, remotes, use_jit=None):
         """One device dispatch per batch.  The mode is a MEASURED
@@ -1735,7 +2338,10 @@ class VerdictService:
         uj = self._use_jit if use_jit is None else use_jit
         with self._device_ctx():
             if uj and not isinstance(model, ConstVerdict):
-                fn = self._jit_for(self._jit_cache, model, model.__call__)
+                fn = self._jit_for(
+                    self._jit_cache, model, model.__call__,
+                    arg_fn=_call_model,
+                )
                 return fn(data, lens, remotes)
             return model(data, lens, remotes)
 
@@ -1759,6 +2365,7 @@ class VerdictService:
                 jfn = self._jit_for(
                     self._jit_attr, model,
                     lambda d, ln, r: model.verdicts_attr(d, ln, r),
+                    arg_fn=_call_model_attr,
                 )
                 return jfn(data, lens, remotes)
             return fn(data, lens, remotes)
@@ -1804,16 +2411,66 @@ class VerdictService:
             t_eager * 1e3, t_jit * 1e3, self.dispatch_mode_chosen,
         )
 
+    def _model_shape_key(self, model):
+        """Hashable shape signature for a shape-cacheable model, or
+        None — THE one key derivation shared by the shape-keyed jit
+        caches and the prewarm-skip check (a second copy could drift
+        and silently unpair them).  Memoized on the model: tables are
+        immutable after build, and the flatten would otherwise run per
+        dispatch."""
+        key = getattr(model, "_shape_key_memo", None)
+        if key is not None:
+            return key
+        bare_fn = getattr(model, "dispatch_bare", None)
+        if bare_fn is None:
+            return None
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(bare_fn())
+        key = (
+            treedef,
+            tuple((tuple(lf.shape), str(lf.dtype)) for lf in leaves),
+        )
+        try:
+            model._shape_key_memo = key
+        except Exception:  # noqa: BLE001 — slots/frozen models: skip memo
+            pass
+        return key
+
+    def _shape_key_cached(self, cache: dict, model) -> bool:
+        """True when the model's shape-keyed executables were already
+        warmed — a churn rebuild of a same-bucketed table then skips
+        the warm launches entirely (the whole point of the bucketed
+        shapes: repeat churn costs an array upload, not a trace, and
+        not even a warm launch)."""
+        key = self._model_shape_key(model)
+        return key is not None and key in cache
+
+    def _mark_shape_prewarmed(self, model) -> None:
+        key = self._model_shape_key(model)
+        if key is None:
+            return
+        while len(self._prewarmed_shapes) >= self.SHAPE_CACHE_MAX:
+            self._prewarmed_shapes.pop(
+                next(iter(self._prewarmed_shapes))
+            )
+        self._prewarmed_shapes[key] = True
+
     def prewarm(self, engine) -> None:
         """Compile the engine model for every bucket shape up front so
-        the first real batch never pays a compile."""
+        the first real batch never pays a compile.  Shape-cached models
+        (r2d2) whose executable already exists — churn rebuilding a
+        same-bucketed table — skip the warm launches entirely."""
         if isinstance(engine.model, ConstVerdict):
             return
         if not self._dispatch_resolved:
             with self._dispatch_lock:
                 if not self._dispatch_resolved:
+                    # lint: disable=R12 -- one-time dispatch-mode probe at the FIRST prewarm ever (double-checked): the lock exists precisely to run this measurement once; prewarm runs on reader/builder threads, never dispatch
                     self._measure_dispatch_mode(engine)
                     self._dispatch_resolved = True
+        if self._shape_key_cached(self._prewarmed_shapes, engine.model):
+            return
         width = self.config.batch_width
         for b in self._buckets():
             # The attributed variant is the serving-path call when flow
@@ -1842,6 +2499,7 @@ class VerdictService:
                     np.zeros(b, np.int32),
                 )
                 np.asarray(allow)
+        self._mark_shape_prewarmed(engine.model)
 
     def _run_vec(self, vec_items: list, snap: "_TabSnap",
                  t_pop: float) -> None:
@@ -1850,6 +2508,10 @@ class VerdictService:
         groups: dict[int, list] = {}
         for it, eng in vec_items:
             groups.setdefault(id(eng), []).append((it, eng))
+        # The snapshot's swap wait is booked on the round's FIRST trace
+        # only (one blocked acquisition, however many path groups).
+        swap_s = snap.swap_s
+        snap.swap_s = 0.0
         for group in groups.values():
             engine = group[0][1]
             mats = [it for it, _ in group if it[0] == "mat"]
@@ -1861,8 +2523,9 @@ class VerdictService:
                 rt = self.tracer.begin_round(
                     PATH_VEC, sum(it[2].count for it in mats),
                     self._oldest_arrival(mats), t_pop,
-                    ring_s=self._ring_wait(mats),
+                    ring_s=self._ring_wait(mats), swap_s=swap_s,
                 )
+                swap_s = 0.0
                 if len(mats) == 1:
                     m_rows = mats[0][2].rows
                     m_lens = mats[0][2].lengths.astype(np.int32)
@@ -1894,8 +2557,9 @@ class VerdictService:
             rt = self.tracer.begin_round(
                 PATH_VEC, sum(it[2].count for it in datas),
                 self._oldest_arrival(datas), t_pop,
-                ring_s=self._ring_wait(datas),
+                ring_s=self._ring_wait(datas), swap_s=swap_s,
             )
+            swap_s = 0.0
             batches = [it[2] for it in datas]
             conn_ids = np.concatenate([b.conn_ids for b in batches])
             lengths = np.concatenate(
@@ -2039,6 +2703,9 @@ class VerdictService:
                 model,
                 lambda bl, o, ln, r: _gather_model(
                     model, bl, o, ln, r, width, attr
+                ),
+                arg_fn=lambda m, bl, o, ln, r: _gather_model(
+                    m, bl, o, ln, r, width, attr
                 ),
             )
             out = fn(blob_dev, offs, lens, remotes)
@@ -2357,7 +3024,40 @@ class VerdictService:
             conn_ids, lengths, allow
         )
 
-    def _process_entrywise(self, items: list, t_pop: float = 0.0) -> None:
+    def _catch_up_epoch(self, conn_id: int, sc: "_SidecarConn") -> None:
+        """Stale-conn epoch catch-up: a swap left this conn on its
+        captured engine because an in-flight round still owed state
+        against it.  Once that round drained (no async-pending
+        refcount, ops empty), adopt the current epoch's engine and
+        migrate the retained buffer — pointer reads only, no
+        compile."""
+        with self._lock:
+            if conn_id in self._async_pending:
+                return  # round still in flight: retry on a later entry
+            old_eng = sc.engine
+            if old_eng is not None and not self._flow_migratable(
+                old_eng, conn_id
+            ):
+                return
+            eng = self._engines.get(
+                self._engine_key_for(sc.module_id, sc.conn)
+            )
+            if eng is not None and old_eng is not None \
+                    and eng is not old_eng:
+                self._migrate_flow(old_eng, eng, conn_id, sc)
+            if eng is not None:
+                sc.engine = eng
+                sc.fast_ok = sc.conn.parser_name == "r2d2"
+            else:
+                sc.engine = None
+                sc.fast_ok = False
+            self._tab_set_engine(
+                conn_id, eng if sc.fast_ok else None
+            )
+            self._stale_conns.discard(conn_id)
+
+    def _process_entrywise(self, items: list, t_pop: float = 0.0,
+                           swap_s: float = 0.0) -> None:
         # Per-entry path, preserving per-connection order: an entry is
         # fast only if nothing earlier in this round put its connection
         # on the slow path.
@@ -2376,6 +3076,7 @@ class VerdictService:
             self._oldest_arrival(items),
             t_pop or None,
             ring_s=self._ring_wait(items),
+            swap_s=swap_s,
         )
         for item in items:
             _, client, batch = item
@@ -2408,6 +3109,11 @@ class VerdictService:
                     metrics.SidecarFallbackVerdicts.inc()
                 elif sc.demoted_mod is not None:
                     self._maybe_rebind(conn_id, sc)
+                elif conn_id in self._stale_conns:
+                    # A swap deferred this conn's rebind behind an
+                    # in-flight round; catch it up to the current
+                    # epoch before this entry routes.
+                    self._catch_up_epoch(conn_id, sc)
                 if sc.skip[reply]:
                     take = min(sc.skip[reply], len(data))
                     sc.skip[reply] -= take
@@ -2452,8 +3158,14 @@ class VerdictService:
         # path's millions (see BENCH_NOTES round 5).
         if not self._inline_complete and self._slow_async_eligible(slow):
             rt.formed()
+            # Attribution captures for the whole round, keyed
+            # (item_key, entry_idx) — filled at DECISION time (issue /
+            # finish halves) against the engines captured there.
+            rules_out: dict = {}
             fast_issued = self._issue_fast(fast) if fast else []
-            buckets, plan = self._issue_slow_async(slow, responses)
+            buckets, plan = self._issue_slow_async(
+                slow, responses, rules_out
+            )
             rt.submitted()
             # Per group/bucket: the allow future, then (attribution on)
             # the rule future — _finish_fast/_finish_slow_async consume
@@ -2483,7 +3195,6 @@ class VerdictService:
                     # The completion loop's batched device_get (or the
                     # inline np.asarray fallback) fenced this round.
                     rt.completed()
-                    rules_out: dict = {}
                     self._finish_fast(
                         fast_issued, responses,
                         vals=(
@@ -2498,6 +3209,7 @@ class VerdictService:
                             vals[n_fast_futs:] if vals is not None
                             else [None] * (len(futs) - n_fast_futs)
                         ),
+                        rules_out=rules_out,
                     )
                     rt.drained()
                     for item in items:
@@ -2551,7 +3263,7 @@ class VerdictService:
             rules_out: dict = {}
             if fast:
                 self._run_fast(fast, responses, rules_out)
-            self._run_slow_batched(slow, responses)
+            self._run_slow_batched(slow, responses, rules_out)
             # Sync paths read back inside the engine pump/fast finish:
             # submit/complete collapse onto this boundary and the work
             # shows up in the drain stage (still fenced — the pump's
@@ -2611,7 +3323,8 @@ class VerdictService:
             return False  # engine pump path would read back synchronously
         return True
 
-    def _issue_slow_async(self, slow: list, responses: dict):
+    def _issue_slow_async(self, slow: list, responses: dict,
+                          rules_out: dict | None = None):
         """Issue half of the async slow path: feed every extractable
         entry, collect its completed frames, batch ALL frames into one
         model call per (engine, width) bucket — futures only.  Oracle
@@ -2638,6 +3351,20 @@ class VerdictService:
                 responses[key][i] = self._run_slow_safe(
                     sc, conn_id, reply, end_stream, data
                 )
+                if rules_out is not None:
+                    if engine is not None and (
+                        getattr(engine, "handles_reply", False)
+                        or not reply
+                    ):
+                        # Same routing as _run_slow: the engine decided.
+                        rules_out[(key, i)] = self._engine_rule_kind(
+                            engine, conn_id, sc
+                        )
+                    else:
+                        rules_out[(key, i)] = (
+                            int(sc.conn.last_rule_id), "",
+                            self.policy_epoch,
+                        )
                 oracle_marks.append((conn_id, sc))
                 continue
             conn = sc.conn
@@ -2704,7 +3431,8 @@ class VerdictService:
         return buckets, plan
 
     def _finish_slow_async(self, buckets: list, plan: list,
-                           responses: dict, vals: list) -> None:
+                           responses: dict, vals: list,
+                           rules_out: dict | None = None) -> None:
         """Finish half: one readback per bucket (batched by the
         completion loop via ``vals`` — allow then, with attribution on,
         rule per bucket), then per-entry op emission in arrival order —
@@ -2769,6 +3497,13 @@ class VerdictService:
             responses[key][i] = self._entry_response(
                 conn_id, ops, b"", inject
             )
+            if rules_out is not None:
+                # Captured against the PLAN's engine (snapshotted at
+                # issue time), never a re-read sc.engine: this finish
+                # may run after a swap already rebound the conn.
+                rules_out[(key, i)] = self._engine_rule_kind(
+                    engine, conn_id, sc
+                )
 
     def _issue_fast(self, fast: list) -> list:
         """Vectorized single-frame path, issue half: entries grouped
@@ -2856,7 +3591,8 @@ class VerdictService:
                 if rules_out is not None:
                     r_i = int(rules[i]) if rules is not None else -1
                     rules_out[(key, idx)] = (
-                        r_i, self._kind_for(engine.model, r_i)
+                        r_i, self._kind_for(engine.model, r_i),
+                        getattr(engine, "epoch", 0),
                     )
                 responses[key][idx] = (
                     conn_id,
@@ -2872,7 +3608,8 @@ class VerdictService:
         self._finish_fast(self._issue_fast(fast), responses,
                           rules_out=rules_out)
 
-    def _run_slow_batched(self, slow: list, responses: dict) -> None:
+    def _run_slow_batched(self, slow: list, responses: dict,
+                          rules_out: dict | None = None) -> None:
         """Engine-backed slow entries are processed in WAVES: the nth
         entry of every connection is fed together and each engine is
         pumped ONCE per wave — a round's worth of frames (http/
@@ -2935,12 +3672,24 @@ class VerdictService:
                     responses[key][i] = self._take_engine(
                         engine, conn_id, reply
                     )
+                    if rules_out is not None:
+                        # Attribution captured NOW, against the engine
+                        # that judged the wave: churn may rebind
+                        # sc.engine (and reuse its table slot) before
+                        # record emission runs.
+                        rules_out[(key, i)] = self._engine_rule_kind(
+                            engine, conn_id, sc
+                        )
                 self._tab_mark(conn_id, sc)
         for rec in leftovers:
             key, i, sc, conn_id, reply, end_stream, data = rec
             responses[key][i] = self._run_slow_safe(
                 sc, conn_id, reply, end_stream, data
             )
+            if rules_out is not None:
+                rules_out[(key, i)] = (
+                    int(sc.conn.last_rule_id), "", self.policy_epoch,
+                )
             self._tab_mark(conn_id, sc)
 
     @staticmethod
@@ -3598,8 +4347,12 @@ class _ClientHandler:
                     )
                 elif msg_type == wire.MSG_POLICY_UPDATE:
                     module_id, pj = wire.unpack_policy_update(payload)
-                    status = self.service.policy_update(module_id, pj)
-                    self.send(wire.MSG_ACK, wire.pack_ack(status))
+                    status, epoch = self.service.policy_update(
+                        module_id, pj
+                    )
+                    self.send(
+                        wire.MSG_ACK, wire.pack_ack_epoch(status, epoch)
+                    )
                 elif msg_type == wire.MSG_STATUS:
                     self.send(
                         wire.MSG_STATUS_REPLY,

@@ -47,13 +47,14 @@ PATH_SHED = "shed"        # typed SHED (queue_full / deadline / stall)
 # consecutive stamp boundaries of a round.
 STAGE_RING = "ring"                # shm slot commit -> doorbell drain
 STAGE_QUEUE = "queue"              # admit (wire ingress) -> queue pop
+STAGE_SWAP = "table_swap"          # round blocked behind an epoch swap
 STAGE_FORM = "batch_form"          # pop -> device batch assembled
 STAGE_SUBMIT = "device_submit"     # assembled -> device calls issued
 STAGE_DEVICE = "device"            # issued -> fenced readback complete
 STAGE_DRAIN = "drain"              # complete -> responses built
 STAGE_SEND = "send"                # built -> verdict frames written
 
-STAGES = (STAGE_RING, STAGE_QUEUE, STAGE_FORM, STAGE_SUBMIT,
+STAGES = (STAGE_RING, STAGE_QUEUE, STAGE_SWAP, STAGE_FORM, STAGE_SUBMIT,
           STAGE_DEVICE, STAGE_DRAIN, STAGE_SEND)
 
 
@@ -67,10 +68,10 @@ class RoundTrace:
     """
 
     __slots__ = ("path", "n", "t_admit", "t_pop", "t_form", "t_submit",
-                 "t_complete", "t_drain", "t_send", "ring_s")
+                 "t_complete", "t_drain", "t_send", "ring_s", "swap_s")
 
     def __init__(self, path: str, n: int, t_admit: float, t_pop: float,
-                 ring_s: float = 0.0):
+                 ring_s: float = 0.0, swap_s: float = 0.0):
         self.path = path
         self.n = n
         # t_admit is the OLDEST covered wire batch's ingress stamp, so
@@ -87,6 +88,11 @@ class RoundTrace:
         # stage (arrival is the slot-commit stamp for ring batches) so
         # the decomposition shows what the copy elimination bought.
         self.ring_s = ring_s
+        # Time this round spent blocked behind a policy-epoch table
+        # swap (the pointer flip holds the round-snapshot lock).
+        # Carved OUT of batch_form so a swap stall is visible as its
+        # own stage instead of reading as batch-assembly cost.
+        self.swap_s = swap_s
 
     def formed(self) -> None:
         if not self.t_form:
@@ -115,10 +121,13 @@ class RoundTrace:
         t_send = self.t_send or t_drain
         wait = max(t_pop - self.t_admit, 0.0)
         ring = min(max(self.ring_s, 0.0), wait)
+        form = max(t_form - t_pop, 0.0)
+        swap = min(max(self.swap_s, 0.0), form)
         return {
             STAGE_RING: ring,
             STAGE_QUEUE: wait - ring,
-            STAGE_FORM: max(t_form - t_pop, 0.0),
+            STAGE_SWAP: swap,
+            STAGE_FORM: form - swap,
             STAGE_SUBMIT: max(t_submit - t_form, 0.0),
             STAGE_DEVICE: max(t_complete - t_submit, 0.0),
             STAGE_DRAIN: max(t_drain - t_complete, 0.0),
@@ -169,9 +178,10 @@ class VerdictTracer:
 
     def begin_round(self, path: str, n: int, t_admit: float,
                     t_pop: float | None = None,
-                    ring_s: float = 0.0) -> RoundTrace:
+                    ring_s: float = 0.0,
+                    swap_s: float = 0.0) -> RoundTrace:
         return RoundTrace(path, n, t_admit, t_pop or time.monotonic(),
-                          ring_s)
+                          ring_s, swap_s)
 
     def finish_round(self, rt: RoundTrace, batches=()) -> None:
         """Close a round: observe each stage once, the e2e histogram
@@ -190,6 +200,10 @@ class VerdictTracer:
                 # Socket rounds have no ring stage; observing a
                 # permanent zero would just pad the histogram.
                 h.observe(stages[STAGE_RING], STAGE_RING, path)
+            if stages[STAGE_SWAP]:
+                # Only rounds that actually blocked behind an epoch
+                # swap carry the stage (same rationale as ring).
+                h.observe(stages[STAGE_SWAP], STAGE_SWAP, path)
             h.observe(stages[STAGE_QUEUE], STAGE_QUEUE, path)
             h.observe(stages[STAGE_FORM], STAGE_FORM, path)
             h.observe(stages[STAGE_SUBMIT], STAGE_SUBMIT, path)

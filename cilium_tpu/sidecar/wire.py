@@ -726,3 +726,17 @@ def pack_ack(status: int) -> bytes:
 
 def unpack_ack(payload: bytes) -> int:
     return struct.unpack_from("<I", payload, 0)[0]
+
+
+def pack_ack_epoch(status: int, epoch: int) -> bytes:
+    """MSG_ACK payload for policy updates: status + the committed
+    policy-table epoch.  A plain 4-byte ack (pack_ack) remains valid —
+    unpack_ack reads the status prefix of either form, and
+    unpack_ack_epoch degrades the short form to epoch -1."""
+    return struct.pack("<Iq", status, epoch)
+
+
+def unpack_ack_epoch(payload: bytes) -> tuple[int, int]:
+    if len(payload) < 12:
+        return unpack_ack(payload), -1
+    return struct.unpack_from("<Iq", payload, 0)

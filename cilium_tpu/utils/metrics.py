@@ -312,6 +312,32 @@ SidecarTransportFallback = registry.counter(
     "disabled | peer_death)",
     ("reason",),
 )
+# Policy-table epoch churn (sidecar/service.py): each successful
+# compile-then-swap bumps the epoch gauge; failures are typed and the
+# OLD epoch keeps serving (fail-closed — a failed recompile is never a
+# policy outage).
+PolicySwapsTotal = registry.counter(
+    "policy_swaps_total",
+    "Successful policy-table epoch swaps (staged build committed by "
+    "one pointer flip under the round-snapshot lock)",
+)
+PolicySwapFailures = registry.counter(
+    "policy_swap_failures_total",
+    "Policy updates rejected fail-closed with the old epoch still "
+    "serving (parse | host-compile | device-build | parity | "
+    "ack-timeout | shutdown)",
+    ("reason",),
+)
+PolicySwapSeconds = registry.histogram(
+    "policy_swap_seconds",
+    "Duration of the swap pointer flip (lock hold; the off-path "
+    "staged build is NOT included)",
+    buckets=MICRO_BUCKETS,
+)
+PolicyEpochGauge = registry.gauge(
+    "policy_table_epoch",
+    "Committed policy-table epoch (monotonic; bumped per swap)",
+)
 FlowBufferOverflows = registry.counter(
     "flow_buffer_overflow_total",
     "Flows dropped for exceeding the retained-bytes cap without a "

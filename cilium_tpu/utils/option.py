@@ -194,6 +194,19 @@ class DaemonConfig:
     # segments and the service only maps what was negotiated.
     shm_transport: bool = True
 
+    # Policy churn (sidecar/service.py epoch swap).  How long a
+    # MSG_POLICY_UPDATE handler waits for the builder thread's staged
+    # compile-then-swap to commit before acking UNKNOWN_ERROR (the
+    # build keeps running and swaps when done; the old epoch serves
+    # throughout).  Must comfortably exceed worst-case XLA compile
+    # times on the deployment's device link.
+    policy_swap_timeout_s: float = 120.0
+    # Re-assert device-model vs host-oracle bit-identity on every new
+    # epoch before it is committed (a small deterministic probe batch
+    # per rebuilt engine; a mismatch fails the swap typed and the old
+    # epoch keeps serving).
+    policy_epoch_parity: bool = True
+
     # Verdict-path latency decomposition (sidecar/trace.py).
     # Always-on per-round stage histograms + occupancy/busy gauges
     # (False removes the metric observes; the bench's instrumentation-

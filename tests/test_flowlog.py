@@ -441,7 +441,9 @@ def test_observe_fault_ladder_rule_identity(tmp_path):
         assert svc.guard.quarantined
         shim.on_io(False, b"HALT\r\n")
         shim.on_io(False, b"READ /secret\r\n")
-        out = _wait_records(client, 1, path="host")
+        # The two frames land in separate rounds: wait for BOTH host
+        # records before asserting on them.
+        out = _wait_records(client, 2, path="host")
         host = out["records"]
         h_allow = [r for r in host if r["verdict"] == "Forwarded"]
         h_deny = [r for r in host if r["verdict"] == "Denied"]

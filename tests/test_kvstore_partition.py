@@ -401,3 +401,155 @@ def test_chaos_soak_partition_heal_cycles():
     follower.close()
     chaos.close()
     primary.close()
+
+
+# --- identity allocate/release storm across failover (PR 9) ---------------
+
+
+def _identity_storm(duration_s: float, n_workers: int = 4,
+                    n_keys: int = 24):
+    """Allocate/release storm through the fencing-hardened kvstore
+    WHILE a failover is injected (chaos proxy).  Asserts, after the
+    storm settles:
+
+    - **no duplicate identities** — distinct keys never share a
+      numeric ID on the surviving authority, and no key was ever
+      acknowledged two different IDs;
+    - **no leaked leases** — once every reference is released (with
+      pending unrefs flushed and GC run), the authority holds zero
+      value refs and zero master keys under the storm prefix;
+    - **degraded-mode serving** — during the partition window, cached
+      identities keep serving via retain_cached with zero kvstore I/O.
+    """
+    primary = KvstoreServer()
+    chaos = ChaosProxy(primary.address)
+    follower = KvstoreFollower(
+        chaos.address, repl_timeout=1.0, failover_grace=0.1
+    )
+    assert follower.synced.wait(5.0)
+    client = NetBackend(
+        f"{chaos.address},{follower.address}", timeout=15.0
+    )
+    alloc = Allocator(client, "storm/ids", "storm-node",
+                      min_id=256, max_id=65535)
+    stop = threading.Event()
+    partitioned = threading.Event()
+    errors: list[str] = []
+    acked: dict[str, int] = {}
+    acked_lock = threading.Lock()
+    degraded_serves = [0]
+
+    def worker(w: int) -> None:
+        n = 0
+        while not stop.is_set():
+            key = f"labels;storm;{(w + n) % n_keys}"
+            try:
+                id_, _ = alloc.allocate(key)
+            except Exception:  # noqa: BLE001 — failover window
+                # Degraded mode: a cached identity keeps serving with
+                # zero kvstore I/O; the release balances locally.
+                cached = alloc.retain_cached(key)
+                if cached is not None:
+                    if partitioned.is_set():
+                        degraded_serves[0] += 1
+                    with acked_lock:
+                        prev = acked.get(key)
+                    if prev is not None and prev != cached:
+                        errors.append(
+                            f"degraded id moved: {key} {prev} -> "
+                            f"{cached}"
+                        )
+                        return
+                    try:
+                        alloc.release(key)
+                    except Exception:  # noqa: BLE001 — pended unref
+                        pass
+                n += 1
+                continue
+            with acked_lock:
+                prev = acked.setdefault(key, id_)
+            if prev != id_:
+                errors.append(f"acked two IDs: {key} {prev} vs {id_}")
+                return
+            try:
+                alloc.release(key)
+            except Exception:  # noqa: BLE001 — pended unref, GC'd later
+                pass
+            n += 1
+            time.sleep(0.001)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(n_workers)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(duration_s * 0.35)
+        chaos.partition(reset_existing=True)
+        partitioned.set()
+        time.sleep(duration_s * 0.3)
+        chaos.heal()
+        partitioned.clear()
+        time.sleep(duration_s * 0.35)
+        stop.set()
+        for t in threads:
+            t.join(timeout=20.0)
+        assert not errors, errors[:5]
+        assert acked, "storm made no progress"
+        assert follower.promoted.is_set()
+
+        # No duplicate identities on the surviving authority.
+        authority = follower.backend
+        by_id: dict[int, str] = {}
+        for k, v in authority.list_prefix("storm/ids/id/").items():
+            id_ = int(k.rsplit("/", 1)[1])
+            assert id_ not in by_id, (
+                f"store holds two keys for ID {id_}"
+            )
+            by_id[id_] = v.decode()
+        with acked_lock:
+            ids = list(acked.values())
+        assert len(set(ids)) == len(ids), "duplicate acked IDs"
+
+        # No leaked leases: drain every remaining local ref, flush the
+        # unrefs that failed during the outage, GC — the storm prefix
+        # must come back empty (value refs AND master keys).
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            for key in list(acked):
+                while alloc.release(key):
+                    pass
+            alloc.flush_pending_refs()
+            alloc.flush_pending_unrefs()
+            alloc.run_gc()
+            leases = {
+                k for k in authority.list_prefix("storm/ids/value/")
+            }
+            masters = {
+                k for k in authority.list_prefix("storm/ids/id/")
+            }
+            if not leases and not masters:
+                break
+            time.sleep(0.2)
+        assert not leases, f"leaked value refs: {sorted(leases)[:5]}"
+        assert not masters, f"unreaped ids: {sorted(masters)[:5]}"
+    finally:
+        stop.set()
+        client.close()
+        follower.close()
+        chaos.close()
+        primary.close()
+
+
+def test_identity_storm_across_failover_fast():
+    """Tier-1 variant: seconds-scale storm with one injected
+    failover."""
+    _identity_storm(duration_s=4.0)
+
+
+@pytest.mark.slow
+def test_identity_storm_across_failover_soak():
+    """60s slow-marked storm: the full lease-leak/duplicate-identity
+    soak across a failover under sustained churn."""
+    _identity_storm(duration_s=60.0, n_workers=8, n_keys=64)

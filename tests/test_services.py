@@ -130,6 +130,44 @@ def test_service_manager_programs_lbmap():
     assert not mgr.delete_by_id(svc_id)
 
 
+def test_service_manager_resync_converges_under_churn():
+    """k8s→lbmap resync (PR 9): after a burst of missed add/update/
+    delete events, resync with the full desired set converges the maps
+    — stale frontends pruned, surviving IDs stable, new ones
+    programmed."""
+    lb = LbMap()
+    mgr = ServiceManager(lb, LocalBackend())
+    fes = [L3n4Addr(f"172.16.0.{i}", 80) for i in range(1, 6)]
+    ids = {}
+    for fe in fes:
+        ids[fe.key()], _ = mgr.upsert(fe, [L3n4Addr("10.0.0.1", 8080)])
+    # Churn the apiserver's world while this agent missed the events:
+    # fe[0], fe[1] deleted; fe[2] rebackended; a new fe appears.
+    new_fe = L3n4Addr("172.16.0.9", 443)
+    desired = [
+        (fes[2], [L3n4Addr("10.0.9.9", 9999)]),
+        (fes[3], [L3n4Addr("10.0.0.1", 8080)]),
+        (fes[4], [L3n4Addr("10.0.0.1", 8080)]),
+        (new_fe, [L3n4Addr("10.0.4.4", 8443)]),
+    ]
+    out = mgr.resync(desired)
+    assert out["pruned"] == 2 and out["created"] == 1
+    assert out["upserted"] == 4
+    # Stale frontends gone from manager AND map.
+    assert mgr.get_by_frontend(fes[0]) is None
+    assert LbKey(ip4("172.16.0.1"), 80, 0) not in lb.services
+    # Survivors keep their service IDs (RevNAT stability under churn).
+    assert mgr.get_by_frontend(fes[3]).id == ids[fes[3].key()]
+    # Rebackended service reprogrammed.
+    assert mgr.get_by_frontend(fes[2]).backends[0].port == 9999
+    # New service programmed.
+    assert mgr.get_by_frontend(new_fe) is not None
+    assert len(mgr) == 4
+    # Idempotent: a second resync with the same desired set is a no-op.
+    out2 = mgr.resync(desired)
+    assert out2["pruned"] == 0 and out2["created"] == 0
+
+
 def test_service_manager_rejects_protocol_only_collision():
     """The LB map key is (vip, port) without protocol (reference:
     bpf lb4_key) — a second service differing only in protocol would

@@ -119,6 +119,7 @@ _CORPUS_CASES = [
     "r9_bad_spin_poll",
     "r10_bad_specs.py",
     "r11_bad_second_pass.py",
+    "r12_bad_compile_hot",
 ]
 
 _CORPUS_CLEAN = [
@@ -143,6 +144,7 @@ _CORPUS_CLEAN = [
     "r9_good_spin_poll",
     "r10_good_specs.py",
     "r11_good_fused.py",
+    "r12_good_prebuilt",
 ]
 
 
@@ -384,7 +386,7 @@ def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in ("R1", "R2", "R3", "R4", "R5", "R6", "R7",
-                 "R8", "R9", "R10", "R11"):
+                 "R8", "R9", "R10", "R11", "R12"):
         assert f"{rule} " in out
 
 
