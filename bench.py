@@ -1257,14 +1257,25 @@ def bench_mixed():
     print(
         f"bench mixed: {out['verdicts_per_sec']:,.0f}/s "
         f"(slow_fraction={out['slow_fraction']:.2f}, "
+        f"reasm_rounds={out['reasm_rounds']}, "
         f"in-process oracle={out['oracle_per_sec']:,.0f}/s)",
         file=sys.stderr,
     )
-    # Floor at the measured r05 level (122k): a regression of the slow
-    # paths must fail the bench outright, not hide under a floor set
-    # 2.4x below what the path actually does (the 10% --check guard
-    # handles drift on top).
-    assert out["verdicts_per_sec"] >= 110_000, out["verdicts_per_sec"]
+    # Floors (r06, columnar reassembler): 250k/s on a real accelerator
+    # — the ISSUE-10 target is ≥4x the r05 chip reading of 122k/s, and
+    # the 10% --check guard owns drift on top.  A chipless container
+    # floors at the CPU-smoke level instead (the r06 CPU readings were
+    # ~24k columnar vs ~13k scalar — both compute-bound on the host
+    # backend, see BENCH_NOTES r06), so the config still proves the
+    # lane works where there is no chip.  Either way the reassembler
+    # must actually have ENGAGED: a silent fallback to the scalar rung
+    # cannot hide behind the vec-path headline.
+    import jax
+
+    on_chip = any(d.platform != "cpu" for d in jax.devices())
+    floor = 250_000 if on_chip else 15_000
+    assert out["verdicts_per_sec"] >= floor, out["verdicts_per_sec"]
+    assert out["reasm_rounds"] > 0, "columnar reassembler never engaged"
     return out
 
 
@@ -2147,6 +2158,8 @@ def run_one(which: str) -> None:
             "verdicts/s", out["verdicts_per_sec"] / 1_000_000,
             slow_fraction=round(out["slow_fraction"], 3),
             split=out["split"],
+            reasm_rounds=out["reasm_rounds"],
+            reasm_frames=out["reasm_frames"],
             in_process_oracle_per_sec=round(out["oracle_per_sec"]),
             vs_in_process=round(
                 out["verdicts_per_sec"] / max(out["oracle_per_sec"], 1), 2

@@ -23,6 +23,16 @@ Two halves:
   (``add_round``/``add_entries`` — the hot loop builds a plain list,
   the lock is taken once); a per-entry append must be sample-guarded
   or carry a justified pragma.
+- **Hot-loop engine feeds (the columnar-reassembly contract).**  In
+  the dispatch hot-path modules plus the columnar modules
+  (``reasm.py``, ``mixbench.py``), a per-entry engine call —
+  ``.feed(...)`` / ``.feed_extract(...)`` / ``.settle_entry(...)`` /
+  ``.take_ops(...)`` — inside a loop is exactly the ~25µs/entry slow
+  lane the columnar reassembler exists to replace (BENCH_NOTES r5);
+  the surviving scalar-rung loops carry justified pragmas.  In the
+  columnar modules themselves ANY ``.append(...)`` in a loop is
+  flagged too: per-entry list building there means the columnar
+  contract regressed to the shape it was built to kill.
 """
 
 from __future__ import annotations
@@ -34,6 +44,11 @@ from .core import Finding, call_func_name, unparse
 
 _REG_CTORS = {"counter", "gauge", "histogram"}
 _HOT_BASENAMES = {"dispatch.py", "service.py"}
+# Columnar-contract modules: code whose reason to exist is replacing
+# per-entry Python with array passes (sidecar/reasm.py and the mixed
+# bench's round builder).
+_COLUMNAR_BASENAMES = {"reasm.py", "mixbench.py"}
+_FEED_ATTRS = {"feed", "feed_extract", "settle_entry", "take_ops"}
 
 
 def _registrations(sf):
@@ -97,7 +112,9 @@ def _is_sample_guard(test: ast.AST) -> bool:
 
 def _check_hot_loop_observes(files):
     for path, sf in sorted(files.items()):
-        if os.path.basename(path) not in _HOT_BASENAMES:
+        if os.path.basename(path) not in (
+            _HOT_BASENAMES | _COLUMNAR_BASENAMES
+        ):
             continue
 
         findings = []
@@ -162,6 +179,71 @@ def _check_hot_loop_observes(files):
         yield from findings
 
 
+def _check_hot_loop_feeds(files):
+    """Per-entry engine feed/settle calls (and, in the columnar
+    modules, ANY ``.append``) inside loops — the scalar slow-lane
+    shape the columnar reassembler replaces.  Surviving scalar-rung
+    loops carry justified pragmas; everything else is a regression."""
+    for path, sf in sorted(files.items()):
+        base = os.path.basename(path)
+        hot = base in _HOT_BASENAMES
+        columnar = base in _COLUMNAR_BASENAMES
+        if not (hot or columnar):
+            continue
+
+        findings = []
+
+        def visit(node, loop_depth, guarded):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and loop_depth > 0
+                and not guarded
+            ):
+                attr = node.func.attr
+                if attr in _FEED_ATTRS:
+                    findings.append(
+                        Finding(
+                            "R7", path, node.lineno, node.col_offset,
+                            f"per-entry engine .{attr}() inside a "
+                            "hot loop — the ~25µs/entry slow-lane "
+                            "shape the columnar reassembler "
+                            "(sidecar/reasm.py) replaces; batch the "
+                            "round columnar, or justify the scalar "
+                            "rung with a pragma",
+                        )
+                    )
+                elif columnar and attr == "append":
+                    findings.append(
+                        Finding(
+                            "R7", path, node.lineno, node.col_offset,
+                            "per-entry .append() in a columnar "
+                            "module loop — reasm/mixbench exist to "
+                            "replace per-entry list building with "
+                            "array passes; vectorize it or justify "
+                            "with a pragma",
+                        )
+                    )
+            if isinstance(node, ast.If) and _is_sample_guard(node.test):
+                for child in node.body:
+                    visit(child, loop_depth, True)
+                for child in node.orelse:
+                    visit(child, loop_depth, guarded)
+                for child in (node.test,):
+                    visit(child, loop_depth, guarded)
+                return
+            if isinstance(node, (ast.For, ast.While)):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, loop_depth + 1, False)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, loop_depth, guarded)
+
+        visit(sf.tree, 0, False)
+        yield from findings
+
+
 def check_r7(files):
     yield from _check_dead_metrics(files)
     yield from _check_hot_loop_observes(files)
+    yield from _check_hot_loop_feeds(files)

@@ -498,6 +498,22 @@ def cmd_sidecar_status(args):
                 f"credits={sess.get('credits', 0)}"
                 + (f" fallbacks: {fb}" if fb else "")
             )
+    rs = st.get("reasm") or {}
+    if rs:
+        arena = rs.get("arena") or {}
+        fb = " ".join(
+            f"{k}={v}"
+            for k, v in sorted((rs.get("fallbacks") or {}).items())
+        )
+        print(f"reasm: rounds={rs.get('rounds', 0)} "
+              f"entries={rs.get('entries', 0)} "
+              f"frames={rs.get('frames', 0)} "
+              f"overflows={rs.get('overflows', 0)} "
+              f"arena={arena.get('live_bytes', 0)}B/"
+              f"{arena.get('capacity', 0)}B "
+              f"({arena.get('slots', 0)} conns, "
+              f"{arena.get('compactions', 0)} compactions)"
+              + (f" fallbacks: {fb}" if fb else ""))
     if cont.get("quarantined"):
         print(f"quarantine: {cont.get('reason', '')} "
               f"for {cont.get('quarantined_for_s', 0)}s "

@@ -62,6 +62,19 @@ class FlowState:
 class R2d2BatchEngine:
     """Batch engine for the r2d2 model (the flagship end-to-end slice)."""
 
+    # Columnar feed contract (sidecar/reasm.py): the service's
+    # reassembler may own this engine's carry state in its byte arena
+    # and judge whole rounds of frames columnar — the scalar
+    # feed/feed_extract/settle_entry path below stays the oracle/
+    # fallback rung and must never drift from it.
+    reasm_columnar = True
+
+    @staticmethod
+    def reasm_spec() -> str:
+        """Framing kind of the columnar feed contract (reasm.FRAMING_*):
+        r2d2 frames on the first CRLF."""
+        return "crlf"
+
     def __init__(self, model, capacity: int = 2048, width: int = 256,
                  logger=None, max_buffer: int = 1 << 20,
                  attr_enabled: bool = True):
@@ -170,6 +183,19 @@ class R2d2BatchEngine:
             frames.append((bytes(st.buffer[:idx]), msg_len))
             del st.buffer[:msg_len]
         return frames
+
+    def adopt_residue(self, flow_id: int, data: bytes, overflowed: bool,
+                      remote_id: int = 0, policy_name: str = "",
+                      **flow_kwargs) -> None:
+        """Lane-exit half of the columnar feed contract: the service's
+        reassembler hands back a conn's arena carry (and its
+        dead/overflowed latch) when the conn leaves the columnar lane,
+        so the scalar feed/pump path resumes from exactly the retained
+        bytes — no byte lost or replayed across the transition."""
+        st = self.flow(flow_id, remote_id, policy_name, **flow_kwargs)
+        if data:
+            st.buffer = bytearray(data) + st.buffer
+        st.overflowed = st.overflowed or overflowed
 
     def settle_entry(self, flow_id: int, frames: list, more: bool):
         """The finish half of one async entry in ONE dict lookup (the

@@ -353,6 +353,18 @@ class DeviceAssistedEngine:
 class CassandraBatchEngine(DeviceAssistedEngine):
     proto = "cassandra"
 
+    @staticmethod
+    def reasm_spec() -> str:
+        """Columnar feed contract framing kind (sidecar/reasm.py):
+        cassandra frames are length-prefixed — a 9-byte v3/v4 header
+        with the u32 body length at offset 5
+        (reasm.scan_length_prefixed / length_prefix_reader(9, 5)).
+        Declared for the columnar lane's engine inventory; the service
+        serves this engine scalar until the length-prefix lane lands
+        (reasm_columnar stays unset — the per-direction parser state
+        here is not arena-portable yet)."""
+        return "length_prefix"
+
     def _make_parser(self, conn):
         return CassandraParser(conn)
 
@@ -422,6 +434,17 @@ class _NullConn:
 
 class MemcacheBatchEngine(DeviceAssistedEngine):
     proto = "memcache"
+
+    @staticmethod
+    def reasm_spec() -> str:
+        """Columnar feed contract framing kind (sidecar/reasm.py):
+        memcached is SNIFFED per conn — text frames on CRLF, binary
+        frames length-prefixed — so the kind is deliberately NOT
+        "crlf": the service's CRLF lane gate (reasm_spec() must equal
+        FRAMING_CRLF) would otherwise CRLF-scan binary conns into
+        garbage frames the moment this engine grew reasm_columnar.
+        A future lane must split on the sniffed protocol first."""
+        return "crlf_or_length_prefix"
 
     def _make_parser(self, conn):
         return MemcacheParser(conn)

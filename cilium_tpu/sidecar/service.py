@@ -62,6 +62,7 @@ from ..utils.sockutil import shutdown_close
 from . import wire
 from .dispatch import BatchDispatcher
 from .guard import DeviceGuard
+from .reasm import FRAMING_CRLF, ByteArena, Reassembler, gather_segments
 from .shm import GenerationMismatch, RingError
 from .trace import PATH_HOST, PATH_ORACLE, PATH_SHED, PATH_VEC, VerdictTracer
 from .transport import (
@@ -285,6 +286,12 @@ class VerdictService:
         self._tab_engine = np.empty(0, np.int32)  # engine idx, -1 = none
         self._tab_src = np.empty(0, np.int32)  # remote identity (src_id)
         self._tab_dirty = np.empty(0, np.uint8)  # 1 = residual state
+        # In-flight columnar-round refcount per conn (guarded by _lock,
+        # bulk np.add.at updates): the array twin of _async_pending for
+        # the reassembler lane, consulted by the sync-round deferral,
+        # the epoch flip and the stale-conn catch-up so a later round
+        # can never overtake an issued-not-finished columnar round.
+        self._tab_async = np.empty(0, np.uint32)
         self._engine_objs: list[object] = []
         self._engine_idx: dict[int, int] = {}  # id(engine) -> table idx
         self._engine_free: list[int] = []
@@ -346,6 +353,22 @@ class VerdictService:
         # (refcounts; guarded by _lock).  Sync rounds touching them are
         # deferred to the send thread — see _process_entrywise.
         self._async_pending: dict[int, int] = {}
+        # Columnar reassembly engine (sidecar/reasm.py): the mixed-path
+        # slow lane's carry buffers, frame splitting and op assembly as
+        # array passes per ROUND.  Pipelined mode only (greedy rounds
+        # are 1-2 small messages — the columnar fixed cost loses); the
+        # scalar engine path survives as the oracle/fallback rung.
+        self._reasm = (
+            Reassembler(
+                cap_per_conn=self.config.max_flow_buffer,
+                arena_capacity=self.config.reasm_arena_bytes,
+            )
+            if self.config.reasm and not self._inline_complete
+            else None
+        )
+        # Columnar rounds that bailed back to the scalar rung, by
+        # reason (status surface: a silent fallback must be visible).
+        self.reasm_fallbacks: dict[str, int] = {}
         # Cut-through telemetry (greedy mode): rounds processed directly
         # on the shim reader thread, skipping the dispatcher handoff.
         self.inline_batches = 0
@@ -547,6 +570,16 @@ class VerdictService:
             # Flow-record ring occupancy (flowlog/): None = disabled.
             "flowlog": (
                 self.flowlog.stats() if self.flowlog is not None else None
+            ),
+            # Columnar reassembly engine (sidecar/reasm.py): round/
+            # frame counters + arena occupancy; None = disabled (greedy
+            # mode or reasm=False).  The tier-1 mixed smoke asserts
+            # rounds > 0 so a silent fallback to the scalar rung can
+            # never go green.
+            "reasm": (
+                {**self._reasm.status(),
+                 "fallbacks": dict(self.reasm_fallbacks)}
+                if self._reasm is not None else None
             ),
             # Degradation ladder: device -> quarantine -> host fallback
             # -> shed.  Every rung typed and counted.
@@ -777,6 +810,7 @@ class VerdictService:
                     continue
                 if old_eng is not None and (
                     cid in async_pending
+                    or (cid < self._tab_size and self._tab_async[cid])
                     or not self._flow_migratable(old_eng, cid)
                 ):
                     # In-flight deferred round (or undrained engine
@@ -1027,6 +1061,7 @@ class VerdictService:
                 ("_tab_engine", -1, np.int32),
                 ("_tab_src", 0, np.int32),
                 ("_tab_dirty", 0, np.uint8),
+                ("_tab_async", 0, np.uint32),
             ):
                 arr = np.full(new_size, fill, dt)
                 arr[: self._tab_size] = getattr(self, name)
@@ -1062,10 +1097,15 @@ class VerdictService:
                 self._engine_free.append(idx)
                 self._objs_cache = None
 
-    @staticmethod
-    def _conn_residual_dirty(conn_id: int, sc: "_SidecarConn") -> bool:
+    def _conn_residual_dirty(self, conn_id: int, sc: "_SidecarConn") -> bool:
         """The single definition of 'this conn holds residual state':
-        engine flow buffer(s), oracle buffers, or skip counts."""
+        engine flow buffer(s), oracle buffers, skip counts, or a
+        columnar-arena carry (the reassembler's per-conn residue lives
+        OUTSIDE the engine flow — see sidecar/reasm.py)."""
+        if self._reasm is not None and self._reasm.arena.has_residue(
+            conn_id
+        ):
+            return True
         flow = sc.engine.flows.get(conn_id) if sc.engine is not None else None
         buffered = False
         if flow is not None:
@@ -1240,6 +1280,8 @@ class VerdictService:
                 self._tab_dirty[conn_id] = 0
         if sc.engine is not None:
             sc.engine.close_flow(conn_id)
+        if self._reasm is not None:
+            self._reasm.arena.drop(conn_id)
         pl.close_connection(conn_id)
         if self.flowlog is not None:
             self.flowlog.forget_conn(conn_id)
@@ -1934,6 +1976,14 @@ class VerdictService:
         engine = sc.engine
         if engine is None:
             return
+        if self._reasm is not None:
+            # Columnar-arena carry precedes the engine flow buffer (an
+            # arena conn holds its residue THERE, never in the flow);
+            # the dead/overflowed latch is dropped exactly like the
+            # popped flow's below — the oracle serves fresh.
+            residue, _dead = self._reasm.arena.release(conn_id)
+            if residue:
+                sc.bufs[False] = bytearray(residue) + sc.bufs[False]
         flow = engine.flows.pop(conn_id, None)
         if flow is not None and getattr(flow, "buffer", None):
             # Engine-retained request bytes precede anything the oracle
@@ -3032,7 +3082,9 @@ class VerdictService:
         migrate the retained buffer — pointer reads only, no
         compile."""
         with self._lock:
-            if conn_id in self._async_pending:
+            if conn_id in self._async_pending or (
+                conn_id < self._tab_size and self._tab_async[conn_id]
+            ):
                 return  # round still in flight: retry on a later entry
             old_eng = sc.engine
             if old_eng is not None and not self._flow_migratable(
@@ -3056,8 +3108,90 @@ class VerdictService:
             )
             self._stale_conns.discard(conn_id)
 
+    def _classify_entry(self, item, i: int, conns_snapshot: dict,
+                        quarantined: bool, responses: dict,
+                        fast: list, slow: list,
+                        slow_conns: set) -> None:
+        """Route ONE entry onto the fast/slow/oracle lanes — THE shared
+        per-entry classifier of the scalar entrywise path, also used by
+        the columnar round for its residual (non-columnar) minority so
+        the two rounds can never drift."""
+        _, client, batch = item
+        key = id(item)
+        conn_id, reply, end_stream, data = batch.entry(i)
+        sc = conns_snapshot.get(conn_id)
+        if sc is None:
+            responses[key][i] = (
+                conn_id,
+                int(FilterResult.UNKNOWN_CONNECTION),
+                [],
+                b"",
+                b"",
+            )
+            return
+        if quarantined:
+            # Pure-device engines (no oracle inside) fall back
+            # to the in-process oracle; device-assisted engines
+            # keep their engine (the device_gate makes their
+            # judge step a host policy.matches, bit-identical).
+            if sc.engine is not None and not getattr(
+                sc.engine, "handles_reply", False
+            ):
+                self._demote_to_oracle(conn_id, sc)
+            self.fallback_entries += 1
+            metrics.SidecarFallbackVerdicts.inc()
+        elif sc.demoted_mod is not None:
+            self._maybe_rebind(conn_id, sc)
+        elif conn_id in self._stale_conns:
+            # A swap deferred this conn's rebind behind an
+            # in-flight round; catch it up to the current
+            # epoch before this entry routes.
+            self._catch_up_epoch(conn_id, sc)
+        if sc.skip[reply]:
+            take = min(sc.skip[reply], len(data))
+            sc.skip[reply] -= take
+            data = data[take:]
+            if not data:
+                self._tab_mark(conn_id, sc)
+                responses[key][i] = (
+                    conn_id, int(FilterResult.OK), [], b"", b"",
+                )
+                return
+        eng_flow = (
+            sc.engine.flows.get(conn_id) if sc.engine is not None else None
+        )
+        if (
+            sc.fast_ok
+            and not reply
+            and conn_id not in slow_conns
+            and not sc.bufs[False]
+            and (
+                eng_flow is None
+                or not (eng_flow.buffer or eng_flow.overflowed)
+            )
+            and not isinstance(sc.engine.model, ConstVerdict)
+            and len(data) >= 2
+            and data.endswith(b"\r\n")
+            and data.find(b"\r\n") == len(data) - 2
+            and len(data) <= self.config.batch_width
+        ):
+            fast.append((key, i, sc, conn_id, data))
+        else:
+            slow_conns.add(conn_id)
+            slow.append((key, i, sc, conn_id, reply, end_stream, data))
+
     def _process_entrywise(self, items: list, t_pop: float = 0.0,
                            swap_s: float = 0.0) -> None:
+        # Columnar reassembly lane first (sidecar/reasm.py): the CRLF
+        # slow lane as array passes per ROUND.  Quarantined rounds are
+        # the host rung; greedy mode keeps the scalar path (1-2 entry
+        # rounds lose on the columnar fixed cost).
+        if (
+            self._reasm is not None
+            and not self.guard.quarantined
+            and self._process_columnar(items, t_pop, swap_s)
+        ):
+            return
         # Per-entry path, preserving per-connection order: an entry is
         # fast only if nothing earlier in this round put its connection
         # on the slow path.
@@ -3080,72 +3214,13 @@ class VerdictService:
         )
         for item in items:
             _, client, batch = item
-            key = id(item)
-            responses[key] = [None] * batch.count
+            responses[id(item)] = [None] * batch.count
             with self._lock:
                 conns_snapshot = self._conns
             for i in range(batch.count):
-                conn_id, reply, end_stream, data = batch.entry(i)
-                sc = conns_snapshot.get(conn_id)
-                if sc is None:
-                    responses[key][i] = (
-                        conn_id,
-                        int(FilterResult.UNKNOWN_CONNECTION),
-                        [],
-                        b"",
-                        b"",
-                    )
-                    continue
-                if quarantined:
-                    # Pure-device engines (no oracle inside) fall back
-                    # to the in-process oracle; device-assisted engines
-                    # keep their engine (the device_gate makes their
-                    # judge step a host policy.matches, bit-identical).
-                    if sc.engine is not None and not getattr(
-                        sc.engine, "handles_reply", False
-                    ):
-                        self._demote_to_oracle(conn_id, sc)
-                    self.fallback_entries += 1
-                    metrics.SidecarFallbackVerdicts.inc()
-                elif sc.demoted_mod is not None:
-                    self._maybe_rebind(conn_id, sc)
-                elif conn_id in self._stale_conns:
-                    # A swap deferred this conn's rebind behind an
-                    # in-flight round; catch it up to the current
-                    # epoch before this entry routes.
-                    self._catch_up_epoch(conn_id, sc)
-                if sc.skip[reply]:
-                    take = min(sc.skip[reply], len(data))
-                    sc.skip[reply] -= take
-                    data = data[take:]
-                    if not data:
-                        self._tab_mark(conn_id, sc)
-                        responses[key][i] = (
-                            conn_id, int(FilterResult.OK), [], b"", b"",
-                        )
-                        continue
-                eng_flow = (
-                    sc.engine.flows.get(conn_id) if sc.engine is not None else None
-                )
-                if (
-                    sc.fast_ok
-                    and not reply
-                    and conn_id not in slow_conns
-                    and not sc.bufs[False]
-                    and (
-                        eng_flow is None
-                        or not (eng_flow.buffer or eng_flow.overflowed)
-                    )
-                    and not isinstance(sc.engine.model, ConstVerdict)
-                    and len(data) >= 2
-                    and data.endswith(b"\r\n")
-                    and data.find(b"\r\n") == len(data) - 2
-                    and len(data) <= self.config.batch_width
-                ):
-                    fast.append((key, i, sc, conn_id, data))
-                else:
-                    slow_conns.add(conn_id)
-                    slow.append((key, i, sc, conn_id, reply, end_stream, data))
+                self._classify_entry(item, i, conns_snapshot,
+                                     quarantined, responses, fast,
+                                     slow, slow_conns)
 
         # Async round (completion-pipeline mode): when every slow entry
         # is either CRLF-extractable (engine exposes feed_extract) or
@@ -3250,13 +3325,29 @@ class VerdictService:
         # thread strictly AFTER the pending finish, preserving both
         # state exclusivity and per-conn response order.
         deferred = False
-        if not self._inline_complete and self._async_pending:
+        if not self._inline_complete and (
+            self._async_pending or self._reasm is not None
+        ):
             with self._lock:
                 pending_now = set(self._async_pending)
+            round_conns = {rec[3] for rec in slow}
+            round_conns.update(rec[3] for rec in fast)
             if pending_now:
-                round_conns = {rec[3] for rec in slow}
-                round_conns.update(rec[3] for rec in fast)
                 deferred = bool(round_conns & pending_now)
+            if not deferred and self._reasm is not None and round_conns:
+                # The reassembler lane tracks its in-flight conns in
+                # the _tab_async array (bulk updates): a sync round
+                # touching one must queue behind its finish too.
+                # (Filtered in Python first: a u64 wire id >= 2^63
+                # would overflow np.fromiter's int64.)
+                small = [c for c in round_conns
+                         if 0 <= c < self._TAB_MAX]
+                rc = np.fromiter(small, np.int64, count=len(small))
+                with self._lock:
+                    rc = rc[rc < self._tab_size]
+                    deferred = bool(len(rc)) and bool(
+                        self._tab_async[rc].any()
+                    )
 
         def run_sync_and_respond(_vals: list | None = None) -> None:
             rt.formed()
@@ -3303,6 +3394,543 @@ class VerdictService:
             self._completion_put(("entry2", [], run_sync_and_respond))
         else:
             run_sync_and_respond()
+
+    # -- columnar reassembly lane (sidecar/reasm.py) ----------------------
+
+    def _reasm_fallback(self, reason: str) -> None:
+        self.reasm_fallbacks[reason] = (
+            self.reasm_fallbacks.get(reason, 0) + 1
+        )
+
+    def _reasm_bail(self, conn_ids: np.ndarray,
+                    reason: str | None) -> bool:
+        """Whole-round fallback to the scalar rung.  Any round conn
+        still holding columnar carry state must exit the lane FIRST:
+        the scalar classifier reads engine/oracle buffers, not the
+        arena, and serving it with the carry invisible would judge
+        frames without their carried prefix — wrong op byte counts on
+        the wire and bytes stranded in the arena.  Returns False for
+        the caller's tail call.  ``reason`` None skips the fallback
+        counter (a round with nothing lane-eligible is ordinary scalar
+        traffic, not a reassembler fallback)."""
+        if reason is not None:
+            self._reasm_fallback(reason)
+        rc = np.unique(conn_ids)
+        for cid in rc[self._reasm.arena.has_slot(rc)]:
+            self._reasm_release_to_scalar(int(cid))
+        return False
+
+    def _reasm_release_to_scalar(self, conn_id: int) -> None:
+        """Pull one conn's carry out of the columnar arena and hand it
+        to the scalar side (engine flow buffer via adopt_residue, or
+        the oracle mirror when no engine is bound) — the lane-exit
+        transition.  Runs on the dispatcher thread BEFORE the conn's
+        entries are classified scalar, so the shared residual-dirty
+        predicate sees the bytes in their scalar home."""
+        data, dead = self._reasm.arena.release(conn_id)
+        sc = self._conns.get(conn_id)
+        if sc is None:
+            return
+        engine = sc.engine
+        if engine is not None and hasattr(engine, "adopt_residue"):
+            conn = sc.conn
+            engine.adopt_residue(
+                conn_id, data, dead,
+                remote_id=conn.src_id, policy_name=conn.policy_name,
+                ingress=conn.ingress, dst_id=conn.dst_id,
+                src_addr=conn.src_addr, dst_addr=conn.dst_addr,
+            )
+        elif data:
+            sc.bufs[False] = bytearray(data) + sc.bufs[False]
+        self._tab_mark(conn_id, sc)
+
+    def _process_columnar(self, items: list, t_pop: float,
+                          swap_s: float) -> bool:
+        """Serve one entrywise round through the columnar reassembler:
+        carry append, frame splitting and op/inject/record assembly as
+        array passes per ROUND instead of feed/settle Python per ENTRY.
+
+        Phase A is side-effect-free eligibility: anything the lane
+        cannot prove safe (reply/end_stream flags, non-CRLF or
+        ConstVerdict engines, demoted/stale/transitional conns,
+        duplicate conns in one round, too few eligible entries, a
+        leftover entry that would force a synchronous engine pump)
+        either taints its conn to the scalar minority or bails the
+        whole round back to the scalar path — which remains the
+        oracle rung, byte-identical by the parity tests.  Phase B
+        ingests into the arena, issues ONE model call per
+        (engine, width) bucket without reading back, and queues a
+        finish that renders verdict frames columnar."""
+        reasm = self._reasm
+        batches = [it[2] for it in items]
+        counts = [b.count for b in batches]
+        n_round = int(sum(counts))
+        if n_round == 0:
+            return False
+        # --- Phase A: columnar view + eligibility (no side effects) ---
+        if len(batches) == 1:
+            b0 = batches[0]
+            conn_ids_u = b0.conn_ids
+            flags = b0.flags
+            lengths = b0.lengths.astype(np.int64)
+            blob_b = b0.blob
+            ends = b0.offsets[1:].astype(np.int64)
+        else:
+            conn_ids_u = np.concatenate([b.conn_ids for b in batches])
+            flags = np.concatenate([b.flags for b in batches])
+            lengths = np.concatenate(
+                [b.lengths for b in batches]
+            ).astype(np.int64)
+            blob_b = b"".join(b.blob for b in batches)
+            ends = np.cumsum(lengths)
+        starts = ends - lengths
+        # Range-check the RAW u64 ids before any int64 view: a wire id
+        # >= 2^63 would wrap negative and fancy-index the wrong rows
+        # in the conn tables / arena map.
+        conn_ids = conn_ids_u.astype(np.int64)
+        if len(conn_ids_u) and int(conn_ids_u.max()) >= ByteArena.MAP_MAX:
+            return self._reasm_bail(conn_ids, "conn_id_range")
+        blob = np.frombuffer(blob_b, np.uint8)
+        if len(blob) != int(lengths.sum()):
+            return self._reasm_bail(conn_ids, "blob_shape")
+        snap = self._tab_snapshot(items)
+        pos = snap.lookup(conn_ids)
+        eng_idx = snap.engine[pos]
+        elig = (flags == 0) & (eng_idx >= 0)
+        dirty = snap.dirty[pos].astype(bool)
+        has_slot = reasm.arena.has_slot(conn_ids)
+        # A dirty conn is lane-eligible only when its residue IS the
+        # arena carry (the lane's own state); scalar residue anywhere
+        # keeps the conn on the scalar rung until it drains.
+        elig &= (~dirty) | has_slot
+        if elig.any():
+            for e in np.unique(eng_idx[elig]):
+                engine = snap.objs[int(e)]
+                spec = getattr(engine, "reasm_spec", None)
+                if (
+                    engine is None
+                    or not getattr(engine, "reasm_columnar", False)
+                    # The lane's scanner is CRLF: an engine declaring
+                    # any other framing (the length-prefix class) must
+                    # never be CRLF-scanned into garbage frames, even
+                    # if it grows reasm_columnar before its lane lands.
+                    or spec is None or spec() != FRAMING_CRLF
+                    or isinstance(engine.model, ConstVerdict)
+                ):
+                    elig &= eng_idx != e
+        with self._lock:
+            stale = (
+                np.fromiter(self._stale_conns, np.int64,
+                            count=len(self._stale_conns))
+                if self._stale_conns else None
+            )
+        if stale is not None and len(stale):
+            elig &= ~np.isin(conn_ids, stale)
+        # Duplicate conns in one round have a sequential carry
+        # dependency (entry k+1's stream starts from entry k's
+        # residue): route them scalar, whole-conn, preserving order.
+        order = np.argsort(conn_ids, kind="stable")
+        so = conn_ids[order]
+        if len(so) > 1:
+            dup = so[1:] == so[:-1]
+            if dup.any():
+                elig &= ~np.isin(conn_ids, np.unique(so[1:][dup]))
+        n_elig = int(elig.sum())
+        if n_elig < max(int(self.config.reasm_min_entries), 1):
+            return self._reasm_bail(
+                conn_ids, "round_too_small" if n_elig else None
+            )
+        # Leftover-minority soundness: the round issues async; any
+        # entry that would need a synchronous engine pump (or a
+        # transitional rebind/catch-up that could create one) forfeits
+        # the lane — the scalar round owns those shapes.
+        rest = np.flatnonzero(~elig)
+        conns = self._conns
+        for k in rest:
+            cid = int(conn_ids[k])
+            fl = int(flags[k])
+            sc = conns.get(cid)
+            if sc is None:
+                continue  # UNKNOWN_CONNECTION: typed inline, async-safe
+            if sc.demoted_mod is not None or cid in self._stale_conns:
+                return self._reasm_bail(conn_ids, "transitional_conn")
+            engine = sc.engine
+            if engine is None or isinstance(engine.model, ConstVerdict):
+                continue  # host-only work
+            if fl & wire.FLAG_END_STREAM:
+                return self._reasm_bail(conn_ids, "end_stream")
+            if fl & wire.FLAG_REPLY:
+                if getattr(engine, "handles_reply", False):
+                    return self._reasm_bail(conn_ids, "engine_reply")
+                continue  # oracle host-only reply
+            if not hasattr(engine, "feed_extract"):
+                return self._reasm_bail(conn_ids, "engine_pump")
+        # --- Phase B: committed ---------------------------------------
+        # Lane-exit for tainted conns still holding arena state: their
+        # residue moves to the scalar side before classification (the
+        # one release definition — _reasm_bail with no fallback count).
+        if len(rest):
+            self._reasm_bail(conn_ids[rest], None)
+        rt = self.tracer.begin_round(
+            PATH_ORACLE, n_round, self._oldest_arrival(items), t_pop,
+            ring_s=self._ring_wait(items), swap_s=swap_s,
+        )
+        responses: dict[int, list] = {
+            id(item): [None] * item[2].count for item in items
+        }
+        base = np.concatenate(([0], np.cumsum(counts))).astype(np.int64)
+        fast: list = []
+        slow: list = []
+        slow_conns: set = set()
+        if len(rest):
+            with self._lock:
+                conns_snapshot = self._conns
+            for k in rest:
+                bi = int(np.searchsorted(base, k, side="right")) - 1
+                self._classify_entry(
+                    items[bi], int(k - base[bi]), conns_snapshot,
+                    False, responses, fast, slow, slow_conns,
+                )
+        # Ingest + pack (the `reasm` stage of the decomposition).
+        t_r0 = time.monotonic()
+        e_live = np.flatnonzero(elig)
+        groups: list = []
+        for e in np.unique(eng_idx[e_live]):
+            sel = e_live[eng_idx[e_live] == e]
+            engine = snap.objs[int(e)]
+            rnd = reasm.ingest(
+                conn_ids[sel], starts[sel], lengths[sel], blob
+            )
+            if rnd.over.any():
+                # Same accounting as the scalar engine rung's
+                # _overflow (the oracle path owns the global metric).
+                engine.buffer_overflows += int(rnd.over.sum())
+            buckets = reasm.pack_buckets(
+                rnd, self.config.batch_width, self._min_bucket,
+                snap.src[pos[sel]],
+            )
+            groups.append([sel, engine, rnd, buckets, None])
+        # Dirty flags written NOW, before the next round classifies
+        # (same contract as the scalar lane's _tab_mark_many): residue
+        # or a dead latch keeps the conn off the vec path.
+        with self._lock:
+            for sel, engine, rnd, _bk, _is in groups:
+                cids = rnd.conn_ids
+                ok = cids < self._tab_size
+                dirty_new = (
+                    (rnd.res_len > 0) | rnd.dead | rnd.over
+                ).astype(np.uint8)
+                self._tab_dirty[cids[ok]] = dirty_new[ok]
+        rt.reasm_s = time.monotonic() - t_r0
+        rt.formed()
+        # Issue: legacy minority first (host-only work inline, device
+        # futures kept), then one model call per columnar bucket.
+        rules_out: dict = {}
+        fast_issued = self._issue_fast(fast) if fast else []
+        sbuckets, plan = self._issue_slow_async(slow, responses,
+                                               rules_out)
+        for grp in groups:
+            _sel, engine, _rnd, buckets, _ = grp
+            issued = []
+            for fi, data_m, lens_b, rem in buckets:
+                _c, _m, allow, rule = self._model_call_attr(
+                    engine.model, data_m, lens_b, rem
+                )
+                issued.append((fi, allow, rule))
+            grp[4] = issued
+        rt.submitted()
+        futs: list = []
+        for g in fast_issued:
+            futs.append(g[0])
+            if g[1] is not None:
+                futs.append(g[1])
+        n_fast_futs = len(futs)
+        for bk in sbuckets:
+            futs.append(bk[0])
+            if bk[1] is not None:
+                futs.append(bk[1])
+        n_legacy_futs = len(futs)
+        for _sel, _eng, _rnd, _bk, issued in groups:
+            for _fi, allow, rule in issued:
+                futs.append(allow)
+                if rule is not None:
+                    futs.append(rule)
+        # In-flight registration: dict refcounts for the legacy plan
+        # conns, one bulk array add for the columnar conns.
+        pend = {cid for _k, _i, _sc, cid, *_ in plan}
+        reasm_cids = conn_ids[e_live]
+        with self._lock:
+            for cid in pend:
+                self._async_pending[cid] = (
+                    self._async_pending.get(cid, 0) + 1
+                )
+            in_rng = reasm_cids[reasm_cids < self._tab_size]
+            np.add.at(self._tab_async, in_rng, 1)
+
+        def finish(vals: list | None) -> None:
+            try:
+                rt.completed()
+                self._finish_fast(
+                    fast_issued, responses,
+                    vals=(
+                        vals[:n_fast_futs] if vals is not None
+                        else [None] * n_fast_futs
+                    ),
+                    rules_out=rules_out,
+                )
+                self._finish_slow_async(
+                    sbuckets, plan, responses,
+                    vals=(
+                        vals[n_fast_futs:n_legacy_futs]
+                        if vals is not None
+                        else [None] * (n_legacy_futs - n_fast_futs)
+                    ),
+                    rules_out=rules_out,
+                )
+                try:
+                    self._finish_columnar(
+                        items, base, responses, groups, rest,
+                        vals[n_legacy_futs:] if vals is not None
+                        else [None] * (len(futs) - n_legacy_futs),
+                        rt, rules_out,
+                    )
+                except Exception:  # noqa: BLE001 — fail closed, typed
+                    # The shim is owed exactly one reply per seq and
+                    # nothing downstream will answer it: a columnar
+                    # finish crash answers every covered batch typed
+                    # (send() stands down per batch if a racing reply
+                    # already landed).
+                    log.exception(
+                        "columnar finish failed; answering typed"
+                    )
+                    for item in items:
+                        _, cl_, batch = item
+                        try:
+                            if cl_.send_verdicts(
+                                batch.seq,
+                                self._typed_entries(
+                                    batch, FilterResult.UNKNOWN_ERROR
+                                ),
+                                batch=batch,
+                            ):
+                                self.error_entries += batch.count
+                        except Exception:  # noqa: BLE001
+                            log.exception("typed error send failed")
+            finally:
+                with self._lock:
+                    for cid in pend:
+                        n = self._async_pending.get(cid, 1) - 1
+                        if n <= 0:
+                            self._async_pending.pop(cid, None)
+                        else:
+                            self._async_pending[cid] = n
+                    in_r = reasm_cids[reasm_cids < self._tab_size]
+                    dec = self._tab_async[in_r]
+                    self._tab_async[in_r] = np.where(dec > 0, dec - 1, 0)
+
+        self._completion_put(("entry2", futs, finish))
+        return True
+
+    def _finish_columnar(self, items: list, base: np.ndarray,
+                         responses: dict, groups: list, rest,
+                         vals: list, rt, rules_out: dict) -> None:
+        """Finish half of the columnar round: materialize the bucket
+        readbacks, render per-entry ops/injects as array scatters,
+        merge the scalar minority's tuples in entry order, and emit one
+        verdict frame per wire batch — plus the round's columnar flow
+        records with engine-captured epoch/kind attribution."""
+        reasm = self._reasm
+        n_round = int(base[-1])
+        vi = 0
+        finished = []  # (sel, engine, rnd, allow_f, rule_f, assembled)
+        for sel, engine, rnd, _buckets, issued in groups:
+            nf = rnd.frame_count()
+            allow_f = np.zeros(nf, bool)
+            rule_f = np.full(nf, -1, np.int32)
+            for fi, allow_dev, rule_dev in issued:
+                v = vals[vi] if vi < len(vals) else None
+                vi += 1
+                rv = None
+                if rule_dev is not None:
+                    rv = vals[vi] if vi < len(vals) else None
+                    vi += 1
+                if v is None:
+                    try:
+                        a = np.asarray(allow_dev)
+                    except Exception:  # noqa: BLE001 — deny on error
+                        log.exception("device readback failed")
+                        a = None
+                else:
+                    a = np.asarray(v)
+                if a is None:
+                    continue  # frames stay denied + unattributed
+                allow_f[fi] = a[: len(fi)]
+                if rv is not None:
+                    rule_f[fi] = np.asarray(rv)[: len(fi)]
+                elif rule_dev is not None:
+                    try:
+                        rule_f[fi] = np.asarray(rule_dev)[: len(fi)]
+                    except Exception:  # noqa: BLE001 — unattribute only
+                        log.exception("rule readback failed")
+            assembled = reasm.assemble(rnd, allow_f)
+            finished.append((sel, engine, rnd, allow_f, rule_f,
+                             assembled))
+            self.fast_log.log_batch(
+                "r2d2", nf, int(nf - int(allow_f.sum()))
+            )
+        # Round-wide merge: per-entry counts first, then one scatter
+        # pass for ops and injects (scalar minority written per entry).
+        oc_full = np.zeros(n_round, np.int64)
+        res_full = np.full(n_round, int(FilterResult.OK), np.uint32)
+        injo_full = np.zeros(n_round, np.int64)
+        injr_full = np.zeros(n_round, np.int64)
+        for sel, _eng, _rnd, _af, _rf, (op_counts, _ops, inj_len,
+                                        _blob, _nd) in finished:
+            oc_full[sel] = op_counts
+            injr_full[sel] = inj_len
+        rest_resp = []  # (round_idx, response tuple)
+        for k in rest:
+            bi = int(np.searchsorted(base, k, side="right")) - 1
+            item = items[bi]
+            r = responses[id(item)][int(k - base[bi])]
+            if r is None:  # defensive: a lane bug must fail typed
+                r = (int(item[2].conn_ids[int(k - base[bi])]),
+                     int(FilterResult.UNKNOWN_ERROR), [], b"", b"")
+            rest_resp.append((int(k), r))
+            oc_full[k] = len(r[2])
+            res_full[k] = r[1]
+            injo_full[k] = len(r[3])
+            injr_full[k] = len(r[4])
+        op_dst = np.concatenate(
+            ([0], np.cumsum(oc_full))
+        ).astype(np.int64)
+        inj_tot = injo_full + injr_full
+        inj_dst = np.concatenate(
+            ([0], np.cumsum(inj_tot))
+        ).astype(np.int64)
+        ops_round = np.zeros(int(op_dst[-1]), wire.FILTER_OP)
+        inj_round = np.zeros(int(inj_dst[-1]), np.uint8)
+        for sel, _eng, _rnd, _af, _rf, (op_counts, ops_g, inj_len,
+                                        inj_blob, _nd) in finished:
+            g_off = np.concatenate(
+                ([0], np.cumsum(op_counts))
+            )[:-1].astype(np.int64)
+            gather_segments(ops_g, g_off, op_counts, out=ops_round,
+                            dst_starts=op_dst[sel])
+            gi_off = np.concatenate(
+                ([0], np.cumsum(inj_len))
+            )[:-1].astype(np.int64)
+            gather_segments(inj_blob, gi_off, inj_len, out=inj_round,
+                            dst_starts=inj_dst[sel])
+        for k, r in rest_resp:
+            off = int(op_dst[k])
+            for j, (op, nb) in enumerate(r[2]):
+                ops_round[off + j] = (int(op), int(nb))
+            d = int(inj_dst[k])
+            if r[3]:
+                io = np.frombuffer(r[3], np.uint8)
+                inj_round[d : d + len(io)] = io
+                d += len(io)
+            if r[4]:
+                ir = np.frombuffer(r[4], np.uint8)
+                inj_round[d : d + len(ir)] = ir
+        rt.drained()
+        # One verdict frame per wire batch, sliced from the round
+        # arrays; entries whose op list exceeds the ABI capacity route
+        # the whole item through the splitting tuple path.
+        for bi, item in enumerate(items):
+            _, client, batch = item
+            a, b = int(base[bi]), int(base[bi + 1])
+            try:
+                if bool((oc_full[a:b] > wire.MAX_OPS_PER_ENTRY).any()):
+                    entries = self._columnar_item_tuples(
+                        batch, a, b, oc_full, op_dst, ops_round,
+                        injo_full, injr_full, inj_dst, inj_round,
+                        res_full, rest_resp,
+                    )
+                    client.send_verdicts(batch.seq, entries,
+                                         batch=batch)
+                    continue
+                payload = wire.pack_verdict_batch(
+                    batch.seq,
+                    batch.conn_ids,
+                    res_full[a:b],
+                    oc_full[a:b].astype(np.uint32),
+                    injo_full[a:b].astype(np.uint32),
+                    injr_full[a:b].astype(np.uint32),
+                    ops_round[op_dst[a] : op_dst[b]],
+                    inj_round[inj_dst[a] : inj_dst[b]].tobytes(),
+                )
+                client.send(wire.MSG_VERDICT_BATCH, payload,
+                            batches=[batch])
+            except Exception:  # noqa: BLE001 — client may be gone
+                log.exception("columnar verdict send failed")
+        if self._round_thread_suppressed():
+            return
+        self.tracer.finish_round(
+            rt, [self._batch_desc(it[2]) for it in items]
+        )
+        # Scalar-minority records ride the shared entrywise emitter
+        # (columnar entries hold None responses and are skipped);
+        # columnar records are one add_round per engine group with the
+        # CAPTURED engine's kinds legend + epoch — slot-reuse-safe
+        # exactly like the vec rounds.
+        self._record_entrywise(rt.path, items, responses, rules_out)
+        if self.flowlog is None:
+            return
+        for _sel, engine, rnd, allow_f, rule_f, (own_oc, _ops, _il,
+                                                 _ib, n_den) in finished:
+            has_frames = rnd.n_frames > 0
+            forwarded = rnd.live & has_frames & (n_den == 0)
+            denied = (rnd.live & has_frames & (n_den > 0)) | rnd.over
+            errorc = rnd.dead
+            rec = forwarded | denied | errorc
+            if not rec.any():
+                continue
+            codes = np.where(
+                forwarded, CODE_FORWARDED,
+                np.where(errorc, CODE_ERROR, CODE_DENIED),
+            ).astype(np.int8)
+            rules = np.where(
+                forwarded, reasm.last_rules(rnd, rule_f), -1
+            ).astype(np.int32)
+            self.flowlog.add_round(
+                rt.path,
+                rnd.conn_ids[rec],
+                codes[rec],
+                rules[rec],
+                kinds=getattr(engine.model, "match_kinds", ()),
+                epoch=getattr(engine, "epoch", 0),
+            )
+
+    def _columnar_item_tuples(self, batch, a: int, b: int, oc_full,
+                              op_dst, ops_round, injo_full, injr_full,
+                              inj_dst, inj_round, res_full,
+                              rest_resp) -> list:
+        """Materialize one item's entries as scalar response tuples —
+        the op-capacity-splitting fallback (send_verdicts owns the
+        continuation-entry split; >16-op entries are rare)."""
+        scalar = {k: r for k, r in rest_resp}
+        entries = []
+        for k in range(a, b):
+            r = scalar.get(k)
+            if r is not None:
+                entries.append(r)
+                continue
+            off = int(op_dst[k])
+            cnt = int(oc_full[k])
+            d = int(inj_dst[k])
+            io = int(injo_full[k])
+            ir = int(injr_full[k])
+            entries.append((
+                int(batch.conn_ids[k - a]),
+                int(res_full[k]),
+                [(int(o["op"]), int(o["n_bytes"]))
+                 for o in ops_round[off : off + cnt]],
+                inj_round[d : d + io].tobytes(),
+                inj_round[d + io : d + io + ir].tobytes(),
+            ))
+        return entries
 
     @staticmethod
     def _slow_async_eligible(slow: list) -> bool:
@@ -3368,6 +3996,7 @@ class VerdictService:
                 oracle_marks.append((conn_id, sc))
                 continue
             conn = sc.conn
+            # lint: disable=R7 -- the scalar oracle/fallback rung beside the columnar lane (reasm-ineligible minorities, greedy mode, parity oracle); the columnar path serves the volume
             frames = engine.feed_extract(
                 conn_id, data, remote_id=conn.src_id,
                 policy_name=conn.policy_name, ingress=conn.ingress,
@@ -3469,6 +4098,7 @@ class VerdictService:
                 ruless.append(np.full(len(metas), -1, np.int32))
         for key, i, sc, conn_id, engine, more, slots in plan:
             try:
+                # lint: disable=R7 -- scalar rung finish half (see _issue_slow_async): per-entry settle survives as the oracle beside the columnar lane
                 ops, inject = engine.settle_entry(
                     conn_id,
                     [

@@ -48,14 +48,17 @@ PATH_SHED = "shed"        # typed SHED (queue_full / deadline / stall)
 STAGE_RING = "ring"                # shm slot commit -> doorbell drain
 STAGE_QUEUE = "queue"              # admit (wire ingress) -> queue pop
 STAGE_SWAP = "table_swap"          # round blocked behind an epoch swap
+STAGE_REASM = "reasm"              # columnar reassembly (arena ingest +
+#                                    frame scan + bucket pack) — carved
+#                                    out of batch_form like table_swap
 STAGE_FORM = "batch_form"          # pop -> device batch assembled
 STAGE_SUBMIT = "device_submit"     # assembled -> device calls issued
 STAGE_DEVICE = "device"            # issued -> fenced readback complete
 STAGE_DRAIN = "drain"              # complete -> responses built
 STAGE_SEND = "send"                # built -> verdict frames written
 
-STAGES = (STAGE_RING, STAGE_QUEUE, STAGE_SWAP, STAGE_FORM, STAGE_SUBMIT,
-          STAGE_DEVICE, STAGE_DRAIN, STAGE_SEND)
+STAGES = (STAGE_RING, STAGE_QUEUE, STAGE_SWAP, STAGE_REASM, STAGE_FORM,
+          STAGE_SUBMIT, STAGE_DEVICE, STAGE_DRAIN, STAGE_SEND)
 
 
 class RoundTrace:
@@ -68,7 +71,8 @@ class RoundTrace:
     """
 
     __slots__ = ("path", "n", "t_admit", "t_pop", "t_form", "t_submit",
-                 "t_complete", "t_drain", "t_send", "ring_s", "swap_s")
+                 "t_complete", "t_drain", "t_send", "ring_s", "swap_s",
+                 "reasm_s")
 
     def __init__(self, path: str, n: int, t_admit: float, t_pop: float,
                  ring_s: float = 0.0, swap_s: float = 0.0):
@@ -93,6 +97,11 @@ class RoundTrace:
         # Carved OUT of batch_form so a swap stall is visible as its
         # own stage instead of reading as batch-assembly cost.
         self.swap_s = swap_s
+        # Columnar-reassembly work (arena ingest + frame scan + bucket
+        # pack, sidecar/reasm.py) — carved out of batch_form the same
+        # way, so the mixed-path decomposition names the reassembler's
+        # cost instead of folding it into batch assembly.
+        self.reasm_s = 0.0
 
     def formed(self) -> None:
         if not self.t_form:
@@ -123,11 +132,13 @@ class RoundTrace:
         ring = min(max(self.ring_s, 0.0), wait)
         form = max(t_form - t_pop, 0.0)
         swap = min(max(self.swap_s, 0.0), form)
+        reasm = min(max(self.reasm_s, 0.0), form - swap)
         return {
             STAGE_RING: ring,
             STAGE_QUEUE: wait - ring,
             STAGE_SWAP: swap,
-            STAGE_FORM: form - swap,
+            STAGE_REASM: reasm,
+            STAGE_FORM: form - swap - reasm,
             STAGE_SUBMIT: max(t_submit - t_form, 0.0),
             STAGE_DEVICE: max(t_complete - t_submit, 0.0),
             STAGE_DRAIN: max(t_drain - t_complete, 0.0),
@@ -204,6 +215,10 @@ class VerdictTracer:
                 # Only rounds that actually blocked behind an epoch
                 # swap carry the stage (same rationale as ring).
                 h.observe(stages[STAGE_SWAP], STAGE_SWAP, path)
+            if stages[STAGE_REASM]:
+                # Only columnar-reassembly rounds carry the stage
+                # (same rationale as ring/table_swap).
+                h.observe(stages[STAGE_REASM], STAGE_REASM, path)
             h.observe(stages[STAGE_QUEUE], STAGE_QUEUE, path)
             h.observe(stages[STAGE_FORM], STAGE_FORM, path)
             h.observe(stages[STAGE_SUBMIT], STAGE_SUBMIT, path)

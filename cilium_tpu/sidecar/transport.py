@@ -56,6 +56,13 @@ class _Counters:
         self.credits = 0
         self.data_frames = 0
         self.verdict_frames = 0
+        # Credit-piggybacked verdict polling (client side): drains of
+        # the verdict ring driven by the post-commit tail MIRROR at a
+        # natural boundary (a data push) instead of by a credit frame —
+        # the elided doorbell RTTs.  Never a spin: polls happen only on
+        # events the client was already performing.
+        self.mirror_drains = 0
+        self.mirror_frames = 0
 
     def fallback(self, reason: str, n: int = 1) -> None:
         self.fallbacks[reason] = self.fallbacks.get(reason, 0) + n
@@ -75,6 +82,8 @@ class _Counters:
             "credits": self.credits,
             "data_frames": self.data_frames,
             "verdict_frames": self.verdict_frames,
+            "mirror_drains": self.mirror_drains,
+            "mirror_frames": self.mirror_frames,
         }
 
 
@@ -97,10 +106,16 @@ class ShmSession:
         # consume head (slots below it are free).
         self.db_tail = 0
         self.credit_head = 0
-        # Verdict-ring consumer cursor (reader thread) and the head
-        # value last piggybacked to the service.
+        # Verdict-ring consumer cursor and the head value last
+        # piggybacked to the service.  Historically reader-thread-only
+        # (SPSC); the mirror-poll path (client.poll_shm_verdicts) makes
+        # the logical consumer a LOCK-SERIALIZED pair of threads —
+        # every drain runs under drain_lock, so slot reads, v_head
+        # advances and set_head stores never interleave.  RLock: a
+        # verdict callback may push (and therefore poll) reentrantly.
         self.v_head = 0
         self.v_head_sent = 0
+        self.drain_lock = threading.RLock()
         # Ring in-flight bookkeeping for zero-silent-loss demotion:
         # seq -> (ring position, conn_ids) for every data frame pushed
         # to the ring whose verdict has not come back.  GIL-atomic

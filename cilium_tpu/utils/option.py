@@ -181,11 +181,25 @@ class DaemonConfig:
     # which takes precedence over the age watermark.
     shed_queue_entries: int = 1 << 17
     shed_queue_age_ms: float = 5000.0
-    # Per-flow retained-bytes cap (engine flow buffers and the service's
-    # oracle buffer mirror): a flow that buffers more than this without
-    # a frame boundary gets a typed protocol-error DROP and is closed,
-    # matching the reference's bounded retained-data contract.
+    # Per-flow retained-bytes cap (engine flow buffers, the columnar
+    # reassembly arena, and the service's oracle buffer mirror): a flow
+    # that buffers more than this without a frame boundary gets a typed
+    # protocol-error DROP and is closed, matching the reference's
+    # bounded retained-data contract.
     max_flow_buffer: int = 1 << 20
+    # Columnar reassembly lane (sidecar/reasm.py): serve the CRLF slow
+    # lane with array passes per ROUND instead of feed/settle Python
+    # per ENTRY.  Pipelined (batch_timeout_ms > 0) services only —
+    # greedy rounds are 1-2 small messages and the columnar fixed cost
+    # loses.  False keeps every round on the scalar engine/oracle rung.
+    reasm: bool = True
+    # Rounds with fewer lane-eligible entries than this fall back to
+    # the scalar path (below it the per-round numpy fixed cost exceeds
+    # the per-entry Python it replaces).
+    reasm_min_entries: int = 4
+    # Initial byte-arena capacity (grows geometrically; per-conn totals
+    # stay bounded by max_flow_buffer regardless).
+    reasm_arena_bytes: int = 1 << 20
     # Shared-memory transport (sidecar/shm.py): whether the service
     # accepts MSG_SHM_ATTACH ring negotiation.  False rejects attaches
     # typed — every session serves on the socket rung (the client's

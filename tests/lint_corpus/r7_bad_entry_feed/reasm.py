@@ -1,0 +1,13 @@
+"""KNOWN-BAD corpus (R7): per-entry list building inside a columnar
+module — reasm/mixbench exist to replace exactly this with array
+passes, so a ``.append`` loop here means the columnar contract
+regressed to the per-entry shape it was built to kill."""
+
+
+def build_round(entries):
+    conn_ids = []
+    chunks = []
+    for conn_id, payload in entries:
+        conn_ids.append(conn_id)  # EXPECT[R7]
+        chunks.append(payload)  # EXPECT[R7]
+    return conn_ids, b"".join(chunks)

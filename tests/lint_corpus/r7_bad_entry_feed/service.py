@@ -1,0 +1,30 @@
+"""KNOWN-BAD corpus (R7): per-entry engine feed/settle calls inside a
+dispatch hot loop — the ~25µs/entry slow-lane shape BENCH_NOTES r5
+measured and the columnar reassembler (sidecar/reasm.py) exists to
+replace.  Includes the guard-dodging outer-guard shape (a guard outside
+the loop does not rate-limit the per-entry calls inside it)."""
+
+
+def issue_round(entries, engine):
+    for conn_id, data in entries:
+        engine.feed(conn_id, data)  # EXPECT[R7]
+
+
+def extract_round(entries, engine):
+    frames = []
+    for conn_id, data in entries:
+        frames += engine.feed_extract(conn_id, data)  # EXPECT[R7]
+    return frames
+
+
+def finish_round(plan, engine, slow):
+    if slow:
+        for conn_id, judged, more in plan:
+            engine.settle_entry(conn_id, judged, more)  # EXPECT[R7]
+
+
+def drain_round(entries, engine):
+    out = []
+    for conn_id in entries:
+        out.append(engine.take_ops(conn_id))  # EXPECT[R7]
+    return out

@@ -1,0 +1,19 @@
+"""KNOWN-GOOD corpus (R7 feed/append twin): the columnar contract —
+one vectorized ingest per ROUND (segment arrays + a ragged gather),
+ops emitted from verdict arrays; the only surviving per-entry call is
+sample-guarded, and per-bucket accumulation is not per-entry work."""
+
+import numpy as np
+
+
+def build_round(conn_ids, lengths, blob, gather_segments):
+    offs = np.concatenate(([0], np.cumsum(lengths)))[:-1]
+    out = np.empty(int(lengths.sum()), np.uint8)
+    gather_segments(blob, offs, lengths, out=out)
+    return conn_ids, out
+
+
+def debug_round(entries, engine, sample_every, counter):
+    for conn_id, data in entries:
+        if counter % sample_every == 0:
+            engine.feed(conn_id, data)  # sample-guarded: allowed

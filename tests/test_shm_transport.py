@@ -561,3 +561,65 @@ def test_graceful_detach_returns_to_socket(tmp_path):
         client.close()
         svc.stop()
         inst.reset_module_registry()
+
+
+def test_credit_piggybacked_verdict_polling(tmp_path):
+    """ISSUE-10 satellite (ROADMAP item 3 remainder): verdicts already
+    COMMITTED to the verdict ring are consumable without the credit
+    socket frame — the next data push piggybacks a mirror drain, and
+    poll_shm_verdicts() exposes the same sweep explicitly.  Proven by
+    DROPPING the service's credit frames entirely: verdicts still
+    arrive, through the mirror, with zero spinning (every drain rides
+    an event the client performed anyway)."""
+    svc = _service(tmp_path, "piggy")
+    client = SidecarClient(svc.socket_path, timeout=30.0, **SHM_KW)
+    got: dict = {}
+    evt = threading.Event()
+    try:
+        assert client.transport_mode == TRANSPORT_SHM
+        handler = svc._clients[0]
+        assert handler.shm is not None
+
+        def cb(vb):
+            got[vb.seq] = vb
+            evt.set()
+
+        client.verdict_callback = cb
+        # Kill the credit channel: verdict frames land in the ring but
+        # the socket never tells the client.
+        handler._send_credit_locked = lambda flags=0: None
+        ids = np.array([990001], np.uint64)
+        fl = np.zeros(1, np.uint8)
+        lens = np.array([3], np.uint32)
+        client.send_batch(1, ids, fl, lens, b"x\r\n")
+        time.sleep(1.0)
+        assert 1 not in got, "no credit frame should mean no delivery"
+        # A second push piggybacks the drain — no explicit poll, no
+        # credit frame, the verdict for seq 1 arrives anyway.
+        deadline = time.monotonic() + 10
+        seq = 2
+        while 1 not in got and time.monotonic() < deadline and seq < 8:
+            client.send_batch(seq, ids, fl, lens, b"x\r\n")
+            seq += 1
+            evt.wait(0.5)
+            evt.clear()
+        assert 1 in got, "push-time piggyback drain never delivered"
+        assert got[1].entry(0)[1] == int(FilterResult.UNKNOWN_CONNECTION)
+        # Explicit mirror polling drains the rest (bounded loop on the
+        # WALL CLOCK, not on the mirror — R2.2 stays clean).
+        deadline = time.monotonic() + 10
+        while len(got) < seq - 1 and time.monotonic() < deadline:
+            client.poll_shm_verdicts()
+            time.sleep(0.05)
+        assert len(got) == seq - 1, (sorted(got), seq)
+        sess = client.transport_status()["session"]
+        assert sess["mirror_drains"] > 0
+        assert sess["mirror_frames"] == seq - 1, (
+            "every verdict must have been consumed via the mirror"
+        )
+        assert client.transport_mode == TRANSPORT_SHM
+    finally:
+        client.verdict_callback = None
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
