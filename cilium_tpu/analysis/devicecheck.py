@@ -17,10 +17,13 @@ allocates no buffers, executes no model, and needs no TPU:
   smuggled ``pure_callback``/``device_put`` is a host round-trip the
   dispatch round would pay per batch.
 - **R10** — every sharded step in ``parallel/rulesharding.py`` traces
-  under a 1x1 (flows, rules) mesh built from the CPU device: shard_map
+  under 1x1, 1x2, 2x1 and 2x2 (flows, rules) CPU meshes: shard_map
   validates in_specs/out_specs against the function's actual arity and
   rank at trace time, so a drifted spec fails HERE instead of at first
-  trace on a real multi-chip mesh.
+  trace on a real multi-chip mesh.  The gate also pins stacked-leaf
+  shard arity (an unbalanced/unpadded shard stack), forbids transfer
+  primitives inside the stepped bodies, and requires trace determinism
+  per mesh plus a shard-count-independent primitive set.
 - **R11** — ``verdicts_attr``'s jaxpr is the verdict jaxpr plus a
   bounded attribution epilogue: output arity 4 with an int32 rule
   row, and an equation count within ``ATTR_EXTRA_EQNS`` of the plain
@@ -187,51 +190,163 @@ def _check_model(name, path, model):
     return findings
 
 
-def _check_sharded():
-    """R10: the sharded steps trace under a 1x1 (flows, rules) CPU
-    mesh — shard_map validates specs against real arity/rank at trace
-    time, so in_specs/out_specs drift fails here, not on a multi-chip
-    mesh in production."""
+# Mesh aspect ratios the R10 gate traces every sharded step under —
+# both axes exercised alone and together so a spec that only works
+# when an axis is trivial cannot pass.
+_SHARD_MESHES = ((1, 1), (1, 2), (2, 1), (2, 2))
+
+_SHARD_PATH = "cilium_tpu/parallel/rulesharding.py"
+
+
+def check_stacked_model(stacked, mesh) -> list[str]:
+    """R10 structural half: every leaf of a stacked shard model must
+    lead with a shard dim equal to the mesh's RULE_AXIS extent — the
+    split-balanced + pad_tables contract.  A builder that skipped the
+    cross-shard padding (or stacked for the wrong shard count) shows
+    up here before shard_map ever traces.  Returns problem strings."""
     import jax
 
-    from ..models.r2d2 import build_r2d2_model_from_rows, r2d2_verdicts
+    from ..parallel.mesh import RULE_AXIS
+
+    n = mesh.shape[RULE_AXIS]
+    probs = []
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(stacked)):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if not shape or shape[0] != n:
+            probs.append(
+                f"stacked leaf {i} shape {shape} does not lead with "
+                f"the RULE_AXIS shard dim {n} (unbalanced/unpadded "
+                f"shard stack)"
+            )
+    return probs
+
+
+def _step_jaxpr_findings(name: str, jx, fail) -> None:
+    """Shared per-step jaxpr checks: no host-transfer primitives
+    anywhere inside the stepped body, and a second trace must be
+    byte-identical (trace-time nondeterminism would recompile per
+    shard-count/mesh in production)."""
+    for eqn in _iter_eqns(jx.jaxpr):
+        pname = eqn.primitive.name
+        if any(s in pname for s in _FORBIDDEN_PRIM_SUBSTRINGS):
+            fail(f"[device-contract:{name}] stepped body contains "
+                 f"host round-trip primitive {pname!r} — a device->"
+                 f"host sync inside the mesh round")
+
+
+def _check_sharded():
+    """R10: every sharded step in ``parallel/rulesharding.py`` traces
+    under 1x1, 1x2, 2x1 AND 2x2 (flows, rules) CPU meshes — shard_map
+    validates in_specs/out_specs against the step functions' actual
+    arity and rank at trace time, so a drifted spec fails HERE instead
+    of at first trace on a real multi-chip mesh.  On top of the trace:
+    stacked-leaf shard arity (the unbalanced-pad pin), no transfer
+    primitives inside the stepped bodies, repeat-trace jaxpr
+    determinism per mesh, and a shard-count-independent primitive set
+    (the computation's SHAPE may change with the mesh; its structure
+    must not).  Meshes the local device count cannot fill are skipped
+    (the 1x1 floor always runs)."""
+    import jax
+    import numpy as np
+
+    from ..kafka.request import RequestMessage
+    from ..models.kafka import build_kafka_model, encode_requests
+    from ..models.r2d2 import (
+        build_r2d2_model_from_rows,
+        r2d2_verdicts,
+        r2d2_verdicts_attr,
+    )
     from ..parallel import rulesharding
     from ..parallel.mesh import flow_mesh
+    from ..policy.api import PortRuleKafka
 
-    path = "cilium_tpu/parallel/rulesharding.py"
     findings = []
-    try:
-        mesh = flow_mesh(n_flow=1, n_rule=1,
-                         devices=jax.devices()[:1])
-    except Exception as e:  # noqa: BLE001
-        findings.append(Finding(
-            "R10", path, 0, 0,
-            f"[device-contract:mesh] cannot build the 1x1 CPU mesh "
-            f"for abstract sharding checks: {e!r}",
-        ))
-        return findings
+
+    def fail(msg):
+        findings.append(Finding("R10", _SHARD_PATH, 0, 0, msg))
+
     model = build_r2d2_model_from_rows([
         (frozenset(), "OPEN", "/etc/.*"),
         (frozenset({3}), "", "docs/[a-z]+"),
     ])
-    stacked = rulesharding._stack_models([model])
+    kr = PortRuleKafka(topic="orders")
+    kr.sanitize()
+    kmodel = build_kafka_model([(frozenset(), kr)])
+    kbatch = encode_requests(
+        [RequestMessage(0, 2, 1, "c", ["orders"], parsed=True)] * _BATCH
+    )
     data, lengths, remotes = _abstract_args()
-    try:
-        step = rulesharding.sharded_verdict_step(mesh, r2d2_verdicts)
-        out = jax.eval_shape(step, stacked, data, lengths, remotes)
-        if len(out) != 3:
-            findings.append(Finding(
-                "R10", path, 0, 0,
-                f"[device-contract:sharded_verdict_step] expected 3 "
-                f"outputs (complete, msg_len, allow), got {len(out)}",
-            ))
-    except Exception as e:  # noqa: BLE001
-        findings.append(Finding(
-            "R10", path, 0, 0,
-            f"[device-contract:sharded_verdict_step] failed to trace "
-            f"under the 1x1 mesh — in_specs/out_specs drifted from "
-            f"the step function's signature: {e!r}",
-        ))
+    devices = jax.devices()
+    prim_sets: dict[str, dict] = {}
+    traced_any = False
+    for n_flow, n_rule in _SHARD_MESHES:
+        if n_flow * n_rule > len(devices):
+            continue
+        try:
+            mesh = flow_mesh(n_flow=n_flow, n_rule=n_rule,
+                             devices=devices[: n_flow * n_rule])
+        except Exception as e:  # noqa: BLE001
+            fail(f"[device-contract:mesh] cannot build the "
+                 f"{n_flow}x{n_rule} CPU mesh: {e!r}")
+            continue
+        traced_any = True
+        stacked = rulesharding._stack_models([model] * n_rule)
+        for prob in check_stacked_model(stacked, mesh):
+            fail(f"[device-contract:stacked@{n_flow}x{n_rule}] {prob}")
+        offsets = rulesharding.shard_offsets(2, n_rule)
+        cases = (
+            ("sharded_verdict_step",
+             rulesharding.sharded_verdict_step(mesh, r2d2_verdicts),
+             (stacked, data, lengths, remotes), 3),
+            ("sharded_verdict_step_attr",
+             rulesharding.sharded_verdict_step_attr(
+                 mesh, r2d2_verdicts_attr),
+             (stacked, offsets, data, lengths, remotes), 4),
+            ("sharded_kafka_step",
+             rulesharding.sharded_kafka_step(mesh),
+             (rulesharding._stack_models([kmodel] * n_rule),
+              kbatch, np.ones(_BATCH, np.int32)), 1),
+        )
+        for name, step, args, n_out in cases:
+            tag = f"{name}@{n_flow}x{n_rule}"
+            try:
+                jx1 = jax.make_jaxpr(step)(*args)
+                jx2 = jax.make_jaxpr(step)(*args)
+            except Exception as e:  # noqa: BLE001
+                fail(f"[device-contract:{tag}] failed to trace — "
+                     f"in_specs/out_specs drifted from the step "
+                     f"function's signature or shard arity: {e!r}")
+                continue
+            outs = jx1.out_avals
+            if len(outs) != n_out:
+                fail(f"[device-contract:{tag}] expected {n_out} "
+                     f"outputs, got {len(outs)}")
+            if name == "sharded_verdict_step_attr" and len(outs) == 4 \
+                    and str(outs[3].dtype) != "int32":
+                fail(f"[device-contract:{tag}] global first-match "
+                     f"rule row dtype is {outs[3].dtype}, contract "
+                     f"is int32")
+            if str(jx1) != str(jx2):
+                fail(f"[device-contract:{tag}] two traces produced "
+                     f"DIFFERENT jaxprs — trace-time nondeterminism "
+                     f"recompiles per mesh in production")
+            _step_jaxpr_findings(tag, jx1, fail)
+            prims = frozenset(
+                eqn.primitive.name for eqn in _iter_eqns(jx1.jaxpr)
+            )
+            prev = prim_sets.setdefault(name, {})
+            for other, oprims in prev.items():
+                if prims != oprims:
+                    fail(f"[device-contract:{name}] primitive set "
+                         f"differs between meshes {other} and "
+                         f"{n_flow}x{n_rule}: "
+                         f"{sorted(prims ^ oprims)} — the stepped "
+                         f"computation's structure must not depend "
+                         f"on the shard count")
+            prev[f"{n_flow}x{n_rule}"] = prims
+    if not traced_any:
+        fail("[device-contract:mesh] no (flows, rules) mesh could be "
+             "built from the available devices")
     return findings
 
 
@@ -239,6 +354,8 @@ def check_device_contracts() -> list[Finding]:
     """Run every abstract device-contract check; returns findings
     (empty = all contracts hold).  Safe without a TPU: everything runs
     as abstract evaluation on the CPU backend."""
+    import os
+
     import jax
 
     try:
@@ -249,6 +366,18 @@ def check_device_contracts() -> list[Finding]:
         # waste.  No-op/raises harmlessly when a backend is already
         # initialized (pytest's conftest pins cpu anyway).
         jax.config.update("jax_platforms", "cpu")
+        if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""
+        ):
+            # The R10 gate traces real 2x2 meshes: ask the (not yet
+            # initialized) CPU backend for 4 virtual devices.  Read at
+            # backend init — harmless if the backend is already up
+            # (the multi-device meshes are then skipped, the 1x1
+            # floor still runs).
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=4"
+            )
     except Exception:  # noqa: BLE001 — backend already up; proceed
         pass
     findings: list[Finding] = []

@@ -470,6 +470,19 @@ def cmd_sidecar_status(args):
               f"last_swap={pol.get('last_swap_ms', 0)}ms "
               f"pending_builds={pol.get('pending_builds', 0)}"
               + (f" failures: {fails}" if fails else ""))
+    mesh = st.get("mesh") or {}
+    if mesh:
+        dem = " ".join(
+            f"{k}={v}"
+            for k, v in sorted((mesh.get("demotions") or {}).items())
+        )
+        print(f"mesh: devices={mesh.get('devices', 0)} "
+              f"(flows={mesh.get('flow_shards', 0)}, "
+              f"rules={mesh.get('rule_shards', 0)}) "
+              f"{'ACTIVE' if mesh.get('active') else 'DEMOTED'}"
+              + (f" reason={mesh.get('demoted')}" if mesh.get("demoted")
+                 else "")
+              + (f" demotions: {dem}" if dem else ""))
     tr = st.get("transport") or {}
     if tr:
         rejects = " ".join(

@@ -49,12 +49,15 @@ def _remote_rows(sel, identity_cache: dict) -> list[frozenset] | None:
     ]
 
 
-def build_model_for_filter(f: L4Filter, identity_cache: dict):
+def build_model_for_filter(f: L4Filter, identity_cache: dict, mesh=None):
     """Compile an L4Filter's L7 rules into a device batch model.
 
     Returns a model callable or ConstVerdict.  Generic (l7proto) rules are
     served by the proxylib parser pipeline instead (cilium_tpu.proxylib),
     mirroring the reference's dispatch (pkg/proxy/proxy.go:229-236).
+    With a (flows, rules) ``mesh``, rule rows shard across RULE_AXIS and
+    the returned model is the mesh-resident wrapper (same call contract,
+    single-chip fallback attached for the device-loss rung).
     """
     if f.l7_parser == PARSER_TYPE_HTTP:
         rows: list[tuple[frozenset, PortRuleHTTP]] = []
@@ -69,6 +72,10 @@ def build_model_for_filter(f: L4Filter, identity_cache: dict):
                     rows.append((remotes, PortRuleHTTP()))
                 for h in l7.http:
                     rows.append((remotes, h))
+        if mesh is not None and rows:
+            from ..parallel.rulesharding import mesh_http_model_from_rows
+
+            return mesh_http_model_from_rows(rows, mesh)
         return build_http_model(rows)
 
     if f.l7_parser == PARSER_TYPE_KAFKA:
@@ -84,6 +91,10 @@ def build_model_for_filter(f: L4Filter, identity_cache: dict):
                     krows.append((remotes, wildcard))
                 for k in l7.kafka:
                     krows.append((remotes, k))
+        if mesh is not None and krows:
+            from ..parallel.rulesharding import mesh_kafka_model
+
+            return mesh_kafka_model(krows, mesh)
         return build_kafka_model(krows)
 
     return ConstVerdict(True)  # no L7 restrictions at this layer

@@ -544,12 +544,13 @@ def _first_crlfcrlf(data: jax.Array, lengths: jax.Array) -> jax.Array:
     return jnp.min(jnp.where(hit, pos, lengths[:, None]), axis=1)
 
 
-def build_http_model_for_port(policy, ingress: bool, port: int,
-                              backend: str = "auto"):
-    """Compile the effective HTTP rule rows for (policy, direction,
-    port) from a proxylib PolicyInstance, applying the reference's port
-    cascade (exact port OR wildcard 0) — the HTTP twin of
-    models/r2d2.collect_policy_rows, used by the sidecar's engine bind."""
+def collect_http_rows(policy, ingress: bool, port: int):
+    """Resolve the effective (remote_set, PortRuleHTTP) rows for
+    (policy, direction, port), applying the reference's port cascade
+    (exact port OR wildcard 0) — the HTTP twin of
+    models/r2d2.collect_policy_rows.  Returns a ConstVerdict for the
+    degenerate cases; exposed so rule-axis sharding can split the rows
+    in the same flattened walk order the attribution contract names."""
     from ..proxylib.parsers.http import HttpRule
 
     if policy is None:
@@ -580,4 +581,15 @@ def build_http_model_for_port(policy, ingress: bool, port: int,
                     )
     if not rows:
         return ConstVerdict(False)
+    return rows
+
+
+def build_http_model_for_port(policy, ingress: bool, port: int,
+                              backend: str = "auto"):
+    """Compile the effective HTTP rule rows for (policy, direction,
+    port) from a proxylib PolicyInstance — used by the sidecar's
+    engine bind (see collect_http_rows for the cascade semantics)."""
+    rows = collect_http_rows(policy, ingress, port)
+    if isinstance(rows, ConstVerdict):
+        return rows
     return build_http_model(rows, backend=backend)

@@ -27,6 +27,33 @@ def flow_mesh(n_flow: int | None = None, n_rule: int = 1, devices=None) -> Mesh:
     return Mesh(devs, (FLOW_AXIS, RULE_AXIS))
 
 
+def serving_mesh(mode: str, rule_shards: int = 0, flow_shards: int = 0,
+                 devices=None, max_flow: int = 32) -> Mesh | None:
+    """Resolve a (flows, rules) SERVING mesh from the DaemonConfig
+    knobs (``mesh``/``mesh_rule_shards``/``mesh_flow_shards``), or
+    None when multi-chip serving is off — THE one resolution shared by
+    the sidecar service and the daemon-side engine factory.  'auto'
+    requires more than one REAL accelerator device (virtual CPU
+    devices share the host's cores — a collective there only adds
+    overhead); 'on' forces a mesh at any device count.  The flow
+    extent is floored to a power of two (every power-of-two dispatch
+    bucket then divides it) and capped at ``max_flow``."""
+    if mode == "off":
+        return None
+    if devices is None:
+        devices = jax.devices()
+    if mode != "on" and (
+        len(devices) < 2 or devices[0].platform == "cpu"
+    ):
+        return None
+    n_rule = max(rule_shards, 1)
+    n_flow = flow_shards or max(len(devices) // n_rule, 1)
+    n_flow = min(1 << (n_flow.bit_length() - 1), max_flow)
+    if n_flow * n_rule > len(devices):
+        return None
+    return flow_mesh(n_flow=n_flow, n_rule=n_rule, devices=devices)
+
+
 def flow_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (flow/batch) axis across the flow axis."""
     return NamedSharding(mesh, P(FLOW_AXIS))

@@ -152,6 +152,12 @@ class DeviceAssistedEngine:
         # poisoned-engine threshold.
         self.device_gate = None
         self.device_fail_hook = None
+        # Optional service-owned judge dispatch: (data, lengths,
+        # remotes) -> (complete, len, allow, rule-or-None) routed
+        # through the service's jit caches AND its mesh demotion rung
+        # — a raising sharded dispatch demotes to the single-chip
+        # fallback instead of host-judging every round forever.
+        self.judge_dispatch = None
 
     # -- flow management --------------------------------------------------
 
@@ -567,8 +573,6 @@ class HttpSidecarEngine(DeviceAssistedEngine):
         return descs
 
     def _judge(self, descs, remotes):
-        from ..models.http import http_verdicts, http_verdicts_attr
-
         n = len(descs)
         allow = np.zeros(n, bool)
         overflow = np.zeros(n, bool)
@@ -594,13 +598,22 @@ class HttpSidecarEngine(DeviceAssistedEngine):
                 data[j, : len(h)] = np.frombuffer(h, np.uint8)
                 lengths[j] = len(h)
                 rem[j] = remotes[i]
-            if self.attr_enabled:
-                _, _, a, r = http_verdicts_attr(
-                    self.model, data, lengths, rem
+            if self.judge_dispatch is not None:
+                # Service-owned dispatch: shared jit caches + the
+                # mesh demotion rung (a lost mesh device reissues on
+                # the single-chip fallback and demotes typed).
+                _, _, a, r = self.judge_dispatch(data, lengths, rem)
+                r = np.asarray(r) if r is not None else None
+            elif self.attr_enabled:
+                # Model-object dispatch so a mesh-resident sharded
+                # model (with its global-argmax attribution) serves
+                # this judge step transparently.
+                _, _, a, r = self.model.verdicts_attr(
+                    data, lengths, rem
                 )
                 r = np.asarray(r)
             else:
-                _, _, a = http_verdicts(self.model, data, lengths, rem)
+                _, _, a = self.model(data, lengths, rem)
                 r = None
             a = np.asarray(a)
             for j, i in enumerate(idxs):

@@ -331,6 +331,20 @@ class VerdictService:
             import jax
 
             self._exec_device = jax.devices("cpu")[0]
+        # Multi-chip sharded serving (parallel/rulesharding.py): the
+        # (flows, rules) mesh resolves lazily at the FIRST engine
+        # build (a service that never dispatches must not initialize a
+        # backend) and is guarded by _mesh_lock.  A lost/erroring mesh
+        # device demotes every sharded engine to its single-chip
+        # fallback executable in one pointer pass — typed, counted,
+        # status-surfaced, sticky until restart (the guard's
+        # quarantine/heal ladder keeps owning single-device health on
+        # the rung below).
+        self._mesh = None
+        self._mesh_resolved = False
+        self._mesh_lock = threading.Lock()
+        self._mesh_demoted: str | None = None
+        self.mesh_demotions: dict[str, int] = {}
         self.vec_batches = 0
         self.vec_entries = 0
         # Completion pipeline: the dispatcher issues device calls without
@@ -536,6 +550,9 @@ class VerdictService:
                 "shm_entries": self.shm_entries,
             },
             "dispatch_mode": self.dispatch_mode_chosen,
+            # Multi-chip mesh rung: layout + demotion state; None when
+            # multi-chip serving is off or no engine has resolved it.
+            "mesh": self._mesh_status(),
             # Policy-table epoch churn: the committed epoch, swap
             # counters, and typed fail-closed rejections (the old
             # epoch kept serving through every one of them).
@@ -1214,7 +1231,16 @@ class VerdictService:
 
                 model = SeamProbe()
             else:
-                model = build_r2d2_model(policy, ingress, port)
+                mesh = self._serving_mesh()
+                if mesh is not None:
+                    # Multi-chip build: rule rows split-balanced and
+                    # padded across RULE_AXIS, single-chip fallback
+                    # compiled alongside (the device-loss rung).
+                    from ..parallel.rulesharding import mesh_r2d2_model
+
+                    model = mesh_r2d2_model(policy, ingress, port, mesh)
+                else:
+                    model = build_r2d2_model(policy, ingress, port)
             eng = R2d2BatchEngine(
                 model,
                 capacity=self.config.batch_flows,
@@ -1239,7 +1265,13 @@ class VerdictService:
         elif proto == "http":
             from ..models.http import build_http_model_for_port
 
-            model = build_http_model_for_port(policy, ingress, port)
+            mesh = self._serving_mesh()
+            if mesh is not None:
+                from ..parallel.rulesharding import mesh_http_model
+
+                model = mesh_http_model(policy, ingress, port, mesh)
+            else:
+                model = build_http_model_for_port(policy, ingress, port)
             cls = HttpSidecarEngine
         else:
             from ..models.memcached import build_memcache_model
@@ -1260,7 +1292,20 @@ class VerdictService:
         eng.device_fail_hook = lambda exc: self._record_contained_failure(
             f"judge-crash: {type(exc).__name__}"
         )
+        # Judge dispatch through the service (shared jit caches + the
+        # mesh demotion rung): device loss on a sharded l7 model
+        # demotes to the single-chip fallback instead of host-judging
+        # every subsequent round through the crash containment.
+        eng.judge_dispatch = functools.partial(
+            self._engine_judge_dispatch, eng
+        )
         return eng
+
+    def _engine_judge_dispatch(self, eng, data, lengths, remotes):
+        """(complete, len, allow, rule-or-None) for an l7 engine's
+        judge step — reads eng.model at CALL time so a mesh demotion's
+        pointer flip (or an epoch swap) takes effect mid-stream."""
+        return self._model_call_attr(eng.model, data, lengths, remotes)
 
     def close_connection(self, conn_id: int, expect=None) -> None:
         # Routed through the dispatcher by the caller so in-flight data
@@ -1903,6 +1948,13 @@ class VerdictService:
         records it queued) are round-suppressed."""
         self.guard.record_stall("dispatch-stall")
         metrics.DeviceStalls.inc()
+        # A wedged round on a mesh is indistinguishable here from a
+        # lost mesh device: drop to the single-chip rung BEFORE the
+        # quarantine ladder re-probes, so the heal path resumes on an
+        # executable that cannot be waiting on a dead device's
+        # collective.
+        if self._mesh is not None and self._mesh_demoted is None:
+            self._demote_mesh("device-stall")
         for it in items:
             if it[0] == "close":
                 # Re-queue for the replacement worker; never lost.
@@ -2377,6 +2429,134 @@ class VerdictService:
             cache.pop(victim, None)
             self._prewarmed_shapes.pop(victim, None)
 
+    # -- multi-chip mesh rung ---------------------------------------------
+
+    def _resolve_mesh(self):
+        """The service's (flows, rules) device mesh, or None when
+        multi-chip serving is off.  'auto' requires more than one REAL
+        accelerator device (virtual CPU devices share the host's cores
+        — a collective there only adds overhead); 'on' forces a mesh
+        at any device count (the CPU-mesh tests and smoke benches).
+        The flow extent is floored to a power of two so every
+        power-of-two dispatch bucket divides it, and capped at the
+        smallest bucket."""
+        if self._mesh_resolved:
+            return self._mesh
+        with self._mesh_lock:
+            if self._mesh_resolved:
+                return self._mesh
+            mesh = None
+            if self.config.mesh != "off":
+                from ..parallel.mesh import FLOW_AXIS, RULE_AXIS, serving_mesh
+
+                with self._device_ctx():
+                    mesh = serving_mesh(
+                        self.config.mesh,
+                        self.config.mesh_rule_shards,
+                        self.config.mesh_flow_shards,
+                        max_flow=self.MIN_BUCKET_GREEDY,
+                    )
+                if mesh is not None:
+                    log.info(
+                        "mesh serving: %d device(s) as (flows=%d, "
+                        "rules=%d)", mesh.size,
+                        mesh.shape[FLOW_AXIS], mesh.shape[RULE_AXIS],
+                    )
+                elif self.config.mesh == "on":
+                    log.warning(
+                        "mesh=on but no (flows=%s, rules=%s) mesh "
+                        "fits the available devices; serving "
+                        "single-chip",
+                        self.config.mesh_flow_shards or "auto",
+                        max(self.config.mesh_rule_shards, 1),
+                    )
+            self._mesh = mesh
+            self._mesh_resolved = True
+            metrics.MeshActive.set(1.0 if mesh is not None else 0.0)
+        return mesh
+
+    def _serving_mesh(self):
+        """Mesh for NEW engine builds: None once demoted — every model
+        compiled after the demotion is single-chip."""
+        mesh = self._resolve_mesh()
+        return None if self._mesh_demoted is not None else mesh
+
+    def _live_model(self, model):
+        """Mesh-rung resolution for one dispatch: a demoted service
+        serves every sharded model's single-chip fallback executable
+        (bit-identical by the sharding parity contract)."""
+        fb = getattr(model, "fallback", None)
+        if fb is not None and self._mesh_demoted is not None:
+            return fb
+        return model
+
+    def _demote_mesh(self, reason: str) -> None:
+        """PR 2 ladder, mesh rung: a lost/erroring mesh device demotes
+        the whole service to the single-chip executables — one pointer
+        pass under _lock, typed (mesh_demotions_total{reason}) and
+        counted, never a wedged round.  Sticky until restart: the
+        quarantine/heal ladder below this rung re-probes SINGLE-device
+        health, and resuming collectives against a device that already
+        failed once is not a risk the dispatch path takes."""
+        with self._lock:
+            if self._mesh_demoted is not None:
+                return
+            self._mesh_demoted = reason
+            swapped = 0
+            for eng in self._engines.values():
+                m = getattr(eng, "model", None)
+                fb = getattr(m, "fallback", None)
+                if fb is not None:
+                    eng.model = fb
+                    # Sharded models are shape-keyed (dispatch_bare),
+                    # so no per-id cache entry exists to drop; the
+                    # compiled mesh executables stay in the shape
+                    # cache as inert entries (demoted dispatch
+                    # resolves through _live_model before any lookup).
+                    swapped += 1
+        self.mesh_demotions[reason] = (
+            self.mesh_demotions.get(reason, 0) + 1
+        )
+        metrics.MeshDemotions.inc(reason)
+        metrics.MeshActive.set(0.0)
+        log.error(
+            "mesh serving demoted to single-chip executables (%s): "
+            "%d engine(s) flipped", reason, swapped,
+        )
+
+    def _mesh_guarded(self, model, call):
+        """Issue one device dispatch; when a SHARDED dispatch raises
+        (lost mesh device, failed collective, transfer error), demote
+        the mesh rung typed and reissue on the single-chip fallback so
+        the round is answered instead of crashed."""
+        try:
+            return call(model)
+        except Exception:
+            fb = getattr(model, "fallback", None)
+            if fb is None:
+                raise
+            log.exception(
+                "sharded dispatch failed; demoting to single-chip"
+            )
+            self._demote_mesh("device-call")
+            return call(fb)
+
+    def _mesh_status(self) -> dict | None:
+        """Mesh-rung status surface: None while unresolved (no engine
+        built yet) or when multi-chip serving is off."""
+        if not self._mesh_resolved or self._mesh is None:
+            return None
+        from ..parallel.mesh import FLOW_AXIS, RULE_AXIS
+
+        return {
+            "devices": int(self._mesh.size),
+            "flow_shards": int(self._mesh.shape[FLOW_AXIS]),
+            "rule_shards": int(self._mesh.shape[RULE_AXIS]),
+            "active": self._mesh_demoted is None,
+            "demoted": self._mesh_demoted,
+            "demotions": dict(self.mesh_demotions),
+        }
+
     def _model_call(self, model, data, lens, remotes, use_jit=None):
         """One device dispatch per batch.  The mode is a MEASURED
         config (config.dispatch_mode): 'eager' pipelines per-op async
@@ -2386,22 +2566,29 @@ class VerdictService:
         overrides the resolved mode (used by the measurement itself so
         it never mutates shared state mid-flight)."""
         uj = self._use_jit if use_jit is None else use_jit
-        with self._device_ctx():
-            if uj and not isinstance(model, ConstVerdict):
-                fn = self._jit_for(
-                    self._jit_cache, model, model.__call__,
-                    arg_fn=_call_model,
-                )
-                return fn(data, lens, remotes)
-            return model(data, lens, remotes)
+
+        def call(m):
+            with self._device_ctx():
+                if uj and not isinstance(m, ConstVerdict):
+                    fn = self._jit_for(
+                        self._jit_cache, m, m.__call__,
+                        arg_fn=_call_model,
+                    )
+                    return fn(data, lens, remotes)
+                return m(data, lens, remotes)
+
+        return self._mesh_guarded(self._live_model(model), call)
 
     def _model_call_attr(self, model, data, lens, remotes):
         """_model_call plus device-side rule attribution: returns
         (complete, msg_len, allow, rule-or-None).  The rule index rides
         the SAME fused computation (an argmax over the hit matrix the
-        verdict reduction already builds — no extra device pass); when
-        flow observability is off or the model has no attributed
-        variant, this degrades to the plain call with rule None."""
+        verdict reduction already builds — no extra device pass; on a
+        mesh, the shard-local argmax plus the cross-shard min-index
+        reduction, still one device round); when flow observability is
+        off or the model has no attributed variant, this degrades to
+        the plain call with rule None."""
+        model = self._live_model(model)
         fn = (
             getattr(model, "verdicts_attr", None)
             if self._flow_observe else None
@@ -2410,15 +2597,19 @@ class VerdictService:
             c, m, a = self._model_call(model, data, lens, remotes)
             return c, m, a, None
         uj = self._use_jit
-        with self._device_ctx():
-            if uj and not isinstance(model, ConstVerdict):
-                jfn = self._jit_for(
-                    self._jit_attr, model,
-                    lambda d, ln, r: model.verdicts_attr(d, ln, r),
-                    arg_fn=_call_model_attr,
-                )
-                return jfn(data, lens, remotes)
-            return fn(data, lens, remotes)
+
+        def call(m):
+            with self._device_ctx():
+                if uj and not isinstance(m, ConstVerdict):
+                    jfn = self._jit_for(
+                        self._jit_attr, m,
+                        lambda d, ln, r: m.verdicts_attr(d, ln, r),
+                        arg_fn=_call_model_attr,
+                    )
+                    return jfn(data, lens, remotes)
+                return m.verdicts_attr(data, lens, remotes)
+
+        return self._mesh_guarded(model, call)
 
     def _measure_dispatch_mode(self, engine) -> None:
         """Resolve dispatch_mode='auto': time the service's ACTUAL
@@ -2519,7 +2710,16 @@ class VerdictService:
                     # lint: disable=R12 -- one-time dispatch-mode probe at the FIRST prewarm ever (double-checked): the lock exists precisely to run this measurement once; prewarm runs on reader/builder threads, never dispatch
                     self._measure_dispatch_mode(engine)
                     self._dispatch_resolved = True
-        if self._shape_key_cached(self._prewarmed_shapes, engine.model):
+        self._prewarm_model(engine.model)
+        fb = getattr(engine.model, "fallback", None)
+        if fb is not None:
+            # The demotion rung warms at build too: a device-loss flip
+            # must not pay its first single-chip compile on the
+            # dispatch path.
+            self._prewarm_model(fb)
+
+    def _prewarm_model(self, model) -> None:
+        if self._shape_key_cached(self._prewarmed_shapes, model):
             return
         width = self.config.batch_width
         for b in self._buckets():
@@ -2528,7 +2728,7 @@ class VerdictService:
             # None) otherwise — either way this warms the executable
             # real rounds will launch.
             out = self._model_call_attr(
-                engine.model,
+                model,
                 np.zeros((b, width), np.uint8),
                 np.zeros(b, np.int32),
                 np.zeros(b, np.int32),
@@ -2542,14 +2742,14 @@ class VerdictService:
                 # local and cheap, so first-use compiles lazily instead
                 # of doubling every engine build.
                 allow, _rule = self._gathered_call(
-                    engine.model,
+                    model,
                     np.zeros(self.BLOB_CHUNK, np.uint8),
                     np.zeros(b, np.int32),
                     np.zeros(b, np.int32),
                     np.zeros(b, np.int32),
                 )
                 np.asarray(allow)
-        self._mark_shape_prewarmed(engine.model)
+        self._mark_shape_prewarmed(model)
 
     def _run_vec(self, vec_items: list, snap: "_TabSnap",
                  t_pop: float) -> None:
@@ -2744,21 +2944,26 @@ class VerdictService:
         on and an attributed model, the rule argmax is fused into the
         same executable."""
         width = self.config.batch_width
+        model = self._live_model(model)
         attr = self._flow_observe and hasattr(model, "verdicts_attr")
+
+        def call(m):
+            with self._device_ctx():
+                fn = self._jit_for(
+                    self._jit_gather,
+                    m,
+                    lambda bl, o, ln, r: _gather_model(
+                        m, bl, o, ln, r, width, attr
+                    ),
+                    arg_fn=lambda mm, bl, o, ln, r: _gather_model(
+                        mm, bl, o, ln, r, width, attr
+                    ),
+                )
+                return fn(blob_dev, offs, lens, remotes)
+
         # ConstVerdict engines never reach here: vec eligibility
         # excludes them (their verdict needs no payload at all).
-        with self._device_ctx():
-            fn = self._jit_for(
-                self._jit_gather,
-                model,
-                lambda bl, o, ln, r: _gather_model(
-                    model, bl, o, ln, r, width, attr
-                ),
-                arg_fn=lambda m, bl, o, ln, r: _gather_model(
-                    m, bl, o, ln, r, width, attr
-                ),
-            )
-            out = fn(blob_dev, offs, lens, remotes)
+        out = self._mesh_guarded(model, call)
         if attr:
             return out[2], out[3]
         return out[-1], None
@@ -2942,6 +3147,11 @@ class VerdictService:
                 log.error("device readback stalled; quarantining")
                 self.guard.record_stall("readback-stall")
                 metrics.DeviceStalls.inc()
+                if self._mesh is not None and self._mesh_demoted is None:
+                    # Same reasoning as the dispatch-stall demotion: a
+                    # readback that never lands on a mesh means a
+                    # device dropped out of the collective.
+                    self._demote_mesh("device-stall")
                 vals = [None] * n_futs
             except Exception:  # noqa: BLE001
                 log.exception("device readback failed")

@@ -338,6 +338,22 @@ PolicyEpochGauge = registry.gauge(
     "policy_table_epoch",
     "Committed policy-table epoch (monotonic; bumped per swap)",
 )
+# Multi-chip sharded serving (parallel/rulesharding.py + sidecar
+# service mesh rung): a lost/erroring mesh device demotes the whole
+# service to the single-chip fallback executables — typed, counted,
+# and bit-identical by the sharding parity contract.
+MeshDemotions = registry.counter(
+    "mesh_demotions_total",
+    "Sharded (multi-chip) serving demoted to the single-chip fallback "
+    "executables (device-call | device-stall), typed by reason; the "
+    "service keeps serving, never a wedged round",
+    ("reason",),
+)
+MeshActive = registry.gauge(
+    "mesh_active",
+    "1 while the (flows, rules) device mesh serves verdicts, 0 when "
+    "off or demoted",
+)
 FlowBufferOverflows = registry.counter(
     "flow_buffer_overflow_total",
     "Flows dropped for exceeding the retained-bytes cap without a "

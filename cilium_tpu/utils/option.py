@@ -208,6 +208,24 @@ class DaemonConfig:
     # segments and the service only maps what was negotiated.
     shm_transport: bool = True
 
+    # Multi-chip sharded verdict serving (parallel/rulesharding.py).
+    # 'auto' builds a (flows, rules) device mesh at first engine bind
+    # when the backend has more than one REAL accelerator device
+    # (never on the CPU backend — virtual CPU devices share the same
+    # host cores and a collective only adds overhead); 'on' forces the
+    # mesh at any device count (how the CPU-mesh tests and smoke
+    # benches run); 'off' keeps the single-chip executables.
+    mesh: str = "auto"  # auto | on | off
+    # RULE_AXIS extent: rule tables split-balanced and padded across
+    # this many shards (HBM capacity for 100k+-rule tables; per-shard
+    # NFA delta shrinks ~quadratically).  0 = 1 (no rule sharding).
+    mesh_rule_shards: int = 0
+    # FLOW_AXIS extent: batch axes shard across this many devices for
+    # throughput.  0 = devices // rule_shards, floored to a power of
+    # two (so every power-of-two dispatch bucket divides it) and
+    # capped at the smallest bucket.
+    mesh_flow_shards: int = 0
+
     # Policy churn (sidecar/service.py epoch swap).  How long a
     # MSG_POLICY_UPDATE handler waits for the builder thread's staged
     # compile-then-swap to commit before acking UNKNOWN_ERROR (the
@@ -300,6 +318,10 @@ class DaemonConfig:
             )
         if self.flowlog_ring <= 0:
             raise ValueError("flowlog_ring must be positive")
+        if self.mesh not in ("auto", "on", "off"):
+            raise ValueError(f"invalid mesh {self.mesh!r}")
+        if self.mesh_rule_shards < 0 or self.mesh_flow_shards < 0:
+            raise ValueError("mesh shard counts must be non-negative")
 
 
 # Global config (reference: option.Config singleton).

@@ -136,7 +136,82 @@ def test_checker_catches_second_device_pass():
 
 
 def test_sharded_step_traces_on_cpu_mesh():
+    """R10, grown into the real mesh gate: every sharded step (plain,
+    attributed global-argmax, kafka) traces under 1x1, 1x2, 2x1 AND
+    2x2 (flows, rules) meshes on the conftest 8-device CPU backend —
+    no mesh is skipped — with spec arity, stacked-leaf shard dims,
+    no-transfer-primitive bodies, per-mesh trace determinism and a
+    shard-count-independent primitive set all holding."""
     assert _check_sharded() == []
+
+
+def test_checker_catches_unbalanced_shard_stack():
+    """The deliberately-broken unbalanced-pad shape: a 1-shard stack
+    offered to a 2-wide RULE_AXIS is caught structurally by
+    check_stacked_model AND fails the shard_map trace (it must never
+    reach a real mesh to fail)."""
+    from cilium_tpu.analysis.devicecheck import check_stacked_model
+    from cilium_tpu.models.r2d2 import (
+        build_r2d2_model_from_rows as build_rows,
+        r2d2_verdicts,
+    )
+    from cilium_tpu.parallel import rulesharding
+    from cilium_tpu.parallel.mesh import flow_mesh
+
+    model = build_rows([(frozenset(), "OPEN", "/x/.*")])
+    broken = rulesharding._stack_models([model])  # 1 shard, 2 wanted
+    mesh = flow_mesh(n_flow=1, n_rule=2, devices=jax.devices()[:2])
+    assert check_stacked_model(broken, mesh)
+    step = rulesharding.sharded_verdict_step(mesh, r2d2_verdicts)
+    args = (
+        jax.ShapeDtypeStruct((8, 128), jnp.uint8),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+    )
+    with pytest.raises(Exception):
+        jax.eval_shape(step, broken, *args)
+    # The well-formed 2-shard stack is clean on the same mesh.
+    good = rulesharding._stack_models([model, model])
+    assert check_stacked_model(good, mesh) == []
+    jax.eval_shape(step, good, *args)
+
+
+def test_sharded_attr_step_contract():
+    """The attributed mesh step is arity-4 with an int32 GLOBAL rule
+    row, and its jaxpr carries no host-transfer primitive — the
+    cross-shard min-index reduction rides the same device round."""
+    from cilium_tpu.analysis.devicecheck import (
+        _FORBIDDEN_PRIM_SUBSTRINGS,
+    )
+    from cilium_tpu.models.r2d2 import (
+        build_r2d2_model_from_rows as build_rows,
+        r2d2_verdicts_attr,
+    )
+    from cilium_tpu.parallel import rulesharding
+    from cilium_tpu.parallel.mesh import flow_mesh
+
+    model = build_rows([
+        (frozenset(), "OPEN", "/etc/.*"),
+        (frozenset({3}), "", "docs/[a-z]+"),
+    ])
+    mesh = flow_mesh(n_flow=2, n_rule=2, devices=jax.devices()[:4])
+    stacked = rulesharding._stack_models([model, model])
+    step = rulesharding.sharded_verdict_step_attr(
+        mesh, r2d2_verdicts_attr
+    )
+    jx = jax.make_jaxpr(step)(
+        stacked, rulesharding.shard_offsets(2, 2),
+        jax.ShapeDtypeStruct((8, 128), jnp.uint8),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+    )
+    assert len(jx.out_avals) == 4
+    assert str(jx.out_avals[3].dtype) == "int32"
+    for eqn in _iter_eqns(jx.jaxpr):
+        assert not any(
+            s in eqn.primitive.name
+            for s in _FORBIDDEN_PRIM_SUBSTRINGS
+        ), eqn.primitive.name
 
 
 # --- 3. CLI surface -------------------------------------------------------

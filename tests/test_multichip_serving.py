@@ -1,0 +1,517 @@
+"""Multi-chip sharded verdict serving on the LIVE dispatch path.
+
+The service builds mesh-resident models (parallel/rulesharding.py
+ShardedVerdictModel: rule rows split-balanced across RULE_AXIS, flow
+batches sharded across FLOW_AXIS) and serves every lane — vec, fast
+entry, columnar reassembly — through the sharded steps.  Contracts
+pinned here, on the conftest 8-device CPU mesh:
+
+- **Bit-identity.**  A mesh service answers byte-identically to the
+  single-chip service for the same traffic, including denials with
+  injected error replies, and its flow records carry the SAME global
+  rule ids and match kinds the host oracle walk names (shard-local
+  argmax + cross-shard min-index reduction).
+- **Columnar lane.**  The reassembler's bucket issue routes through
+  the sharded step with no new jit shapes (fixed power-of-two buckets
+  divide the flow axis by construction).
+- **Fail-closed degradation.**  A lost/erroring mesh device demotes
+  the service to the single-chip fallback executable — typed
+  (mesh_demotions_total{reason}), counted, status-surfaced — with
+  zero silent loss and bit-identical verdicts after the flip.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.parallel.rulesharding import ShardedVerdictModel
+from cilium_tpu.proxylib import (
+    FilterResult,
+    NetworkPolicy,
+    PortNetworkPolicy,
+    PortNetworkPolicyRule,
+)
+from cilium_tpu.proxylib import instance as inst
+from cilium_tpu.sidecar import SidecarClient, VerdictService
+from cilium_tpu.utils.option import DaemonConfig
+
+POLICY_RULES = [
+    {"cmd": "READ", "file": "/public/.*"},
+    {"cmd": "HALT"},
+    {"cmd": "WRITE", "file": "^/tmp/"},
+    {"file": "\\.txt$"},
+]
+
+
+def _policy(name="mesh-pol"):
+    return NetworkPolicy(
+        name=name,
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=80,
+                rules=[
+                    PortNetworkPolicyRule(
+                        remote_policies=[1, 3],
+                        l7_proto="r2d2",
+                        l7_rules=POLICY_RULES[:2],
+                    ),
+                    PortNetworkPolicyRule(
+                        l7_proto="r2d2", l7_rules=POLICY_RULES[2:]
+                    ),
+                ],
+            )
+        ],
+    )
+
+
+# (frame, remote) -> allowed under the policy above.
+TRAFFIC = [
+    (b"READ /public/a.txt\r\n", 1, True),
+    (b"READ /secret\r\n", 1, False),
+    (b"HALT\r\n", 3, True),
+    (b"HALT\r\n", 9, False),     # remote 9 not in [1, 3]
+    (b"WRITE /tmp/x\r\n", 9, True),
+    (b"WRITE /etc/x\r\n", 1, False),
+    (b"READ notes.txt\r\n", 5, True),
+]
+
+
+def _start(tmp_path, name, **cfg_kw):
+    defaults = dict(
+        batch_flows=64, dispatch_mode="jit",
+        mesh="on", mesh_rule_shards=2,
+        device_reprobe_interval_s=1e9,
+    )
+    defaults.update(cfg_kw)
+    cfg = DaemonConfig(**defaults)
+    svc = VerdictService(str(tmp_path / f"{name}.sock"), cfg).start()
+    client = SidecarClient(svc.socket_path, timeout=120.0)
+    mod = client.open_module([])
+    assert mod != 0
+    assert client.policy_update(mod, [_policy()]) == int(FilterResult.OK)
+    return svc, client, mod
+
+
+def _conn(client, mod, conn_id, remote):
+    res, shim = client.new_connection(
+        mod, "r2d2", conn_id, True, remote, 2,
+        f"1.1.1.{conn_id}:{1000 + conn_id}", "2.2.2.2:80", "mesh-pol",
+    )
+    assert res == int(FilterResult.OK)
+    return shim
+
+
+def _drive(client, mod, base_cid=1):
+    """Serve TRAFFIC one conn per (frame, remote); returns outputs."""
+    outs = []
+    for i, (frame, remote, _want) in enumerate(TRAFFIC):
+        shim = _conn(client, mod, base_cid + i, remote)
+        res, out = shim.on_io(False, frame)
+        assert res == int(FilterResult.OK)
+        outs.append(out)
+        shim.close()
+    return outs
+
+
+def test_mesh_service_serves_sharded_bit_identical(tmp_path):
+    """Greedy mesh service: engine model IS the sharded wrapper, the
+    status surface names the layout, and every verdict matches both
+    the policy truth and a single-chip control service byte-for-byte."""
+    inst.reset_module_registry()
+    svc = client = ctrl = cctl = None
+    try:
+        svc, client, mod = _start(tmp_path, "mesh",
+                                  batch_timeout_ms=0.0)
+        mesh_outs = _drive(client, mod)
+        eng = next(iter(svc._engines.values()))
+        assert isinstance(eng.model, ShardedVerdictModel)
+        assert eng.model.n_shards == 2
+        st = svc.status()["mesh"]
+        assert st == {
+            "devices": 8, "flow_shards": 4, "rule_shards": 2,
+            "active": True, "demoted": None, "demotions": {},
+        }
+        # Single-chip control, same traffic.
+        inst.reset_module_registry()
+        ctrl, cctl, cmod = _start(tmp_path, "ctrl",
+                                  batch_timeout_ms=0.0, mesh="off")
+        ctrl_outs = _drive(cctl, cmod)
+        assert ctrl.status()["mesh"] is None
+        assert mesh_outs == ctrl_outs
+        for out, (frame, _r, want) in zip(mesh_outs, TRAFFIC):
+            assert (out == frame) == want, (frame, out)
+    finally:
+        for c in (client, cctl):
+            if c is not None:
+                c.close()
+        for s in (svc, ctrl):
+            if s is not None:
+                s.stop()
+        inst.reset_module_registry()
+
+
+def test_mesh_columnar_lane_parity(tmp_path):
+    """Pipelined mesh service: split frames + multi-entry rounds ride
+    the columnar reassembly lane, whose bucket issue dispatches the
+    SHARDED step (fixed power-of-two buckets shard the batch axis with
+    no new jit shapes).  Verdicts match the policy truth and the lane
+    actually ran (rounds > 0 — a silent scalar fallback cannot pass)."""
+    inst.reset_module_registry()
+    svc = client = None
+    try:
+        svc, client, mod = _start(
+            tmp_path, "mesh-col", batch_timeout_ms=2.0,
+            batch_width=64, reasm_min_entries=1,
+        )
+        shims = {
+            cid: _conn(client, mod, cid, 1) for cid in (1, 2, 3, 4)
+        }
+        got: dict = {}
+        evt = threading.Event()
+
+        def cb(vb):
+            got[vb.seq] = [vb.entry(i) for i in range(vb.count)]
+            evt.set()
+
+        client.verdict_callback = cb
+
+        def send(seq, entries):
+            cids = np.array([e[0] for e in entries], np.uint64)
+            fl = np.array([e[1] for e in entries], np.uint8)
+            lens = np.array([len(e[2]) for e in entries], np.uint32)
+            client.send_batch(
+                seq, cids, fl, lens, b"".join(e[2] for e in entries)
+            )
+
+        def wait_for(seq):
+            deadline = time.monotonic() + 90
+            while seq not in got and time.monotonic() < deadline:
+                evt.wait(0.5)
+                evt.clear()
+            assert seq in got, sorted(got)
+
+        # Round 1: four split-frame heads (buffered, no verdict yet).
+        # Answered before round 2 is sent — two batches racing into
+        # ONE dispatcher round would make every conn a duplicate,
+        # which (correctly) routes the round scalar.
+        send(1, [(1, 0, b"READ /pub"), (2, 0, b"READ /sec"),
+                 (3, 0, b"HALT"), (4, 0, b"WRITE /tm")])
+        wait_for(1)
+        # Round 2: the tails complete all four frames.
+        send(2, [(1, 0, b"lic/a.txt\r\n"), (2, 0, b"ret\r\n"),
+                 (3, 0, b"\r\n"), (4, 0, b"p/x\r\n")])
+        wait_for(2)
+        # Tail round: PASS/DROP per conn in the oracle's op shapes.
+        by_cid = {e[0]: e for e in got[2]}
+        from cilium_tpu.proxylib.types import DROP, PASS
+
+        def first_op(cid):
+            return by_cid[cid][2][0][0]
+
+        assert first_op(1) == int(PASS)
+        assert first_op(2) == int(DROP)
+        assert first_op(3) == int(PASS)
+        assert first_op(4) == int(PASS)
+        st = svc.status()
+        assert st["reasm"] is not None and st["reasm"]["rounds"] > 0, (
+            st["reasm"]
+        )
+        assert st["mesh"]["active"]
+        eng = next(iter(svc._engines.values()))
+        assert isinstance(eng.model, ShardedVerdictModel)
+    finally:
+        if client is not None:
+            client.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()
+
+
+def test_mesh_flowlog_attribution_matches_host_walk(tmp_path):
+    """Flow records from the mesh path carry GLOBAL rule ids: each
+    allowed record's (rule_id, match_kind) equals the host oracle
+    walk's first match over the same frame."""
+    from cilium_tpu.proxylib.parsers.r2d2 import R2d2RequestData
+
+    inst.reset_module_registry()
+    svc = client = None
+    try:
+        svc, client, mod = _start(tmp_path, "mesh-attr",
+                                  batch_timeout_ms=0.0)
+        _drive(client, mod)
+        ins = inst.find_instance(mod)
+        pi = ins.policy_map()["mesh-pol"]
+        eng = next(iter(svc._engines.values()))
+        kinds = eng.model.match_kinds
+        # Record emission is asynchronous to the verdict reply; poll
+        # until the allowed rows all landed (bounded).
+        want_allowed = sum(1 for _f, _r, w in TRAFFIC if w)
+        deadline = time.monotonic() + 10
+        recs = []
+        while time.monotonic() < deadline:
+            recs = [
+                r for r in svc.flowlog.query(n=10000)
+                if r.get("rule_id", -1) >= 0
+            ]
+            if len(recs) >= want_allowed:
+                break
+            time.sleep(0.05)
+        assert len(recs) >= want_allowed, recs
+        frames = {
+            i + 1: (f, r) for i, (f, r, _w) in enumerate(TRAFFIC)
+        }
+        checked = 0
+        for rec in recs:
+            frame, remote = frames[rec["conn_id"]]
+            parts = frame[:-2].decode().split(" ")
+            l7 = R2d2RequestData(
+                parts[0], parts[1] if len(parts) > 1 else ""
+            )
+            hok, hrule = pi.matches_at(True, 80, remote, l7)
+            assert hok
+            assert rec["rule_id"] == hrule, (frame, rec, hrule)
+            assert rec["match_kind"] == kinds[hrule], (frame, rec)
+            checked += 1
+        assert checked >= want_allowed
+    finally:
+        if client is not None:
+            client.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()
+
+
+def test_http_sidecar_lane_serves_sharded_and_demotes(tmp_path):
+    """The l7 (HTTP) judge routes through the service's dispatch —
+    shared jit caches AND the mesh rung: a raising sharded dispatch
+    demotes typed and the round is answered from the single-chip
+    fallback, not host-judged forever through crash containment."""
+    inst.reset_module_registry()
+    svc = client = None
+    try:
+        pol = NetworkPolicy(
+            name="http-mesh", policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(port=80, rules=[
+                    PortNetworkPolicyRule(http_rules=[
+                        {"method": "GET", "path": "/public/.*"},
+                        {"method": "POST", "path": "/api/.*"},
+                    ])
+                ])
+            ],
+        )
+        cfg = DaemonConfig(
+            batch_flows=64, batch_timeout_ms=0.0, dispatch_mode="jit",
+            mesh="on", mesh_rule_shards=2,
+            device_reprobe_interval_s=1e9,
+        )
+        svc = VerdictService(
+            str(tmp_path / "http-mesh.sock"), cfg
+        ).start()
+        client = SidecarClient(svc.socket_path, timeout=120.0)
+        mod = client.open_module([])
+        assert client.policy_update(mod, [pol]) == int(FilterResult.OK)
+        res, shim = client.new_connection(
+            mod, "http", 9, True, 1, 2, "1.1.1.9:1009", "2.2.2.2:80",
+            "http-mesh",
+        )
+        assert res == int(FilterResult.OK)
+        ok_req = b"GET /public/a HTTP/1.1\r\n\r\n"
+        res, out = shim.on_io(False, ok_req)
+        assert res == int(FilterResult.OK) and out == ok_req
+        eng = next(
+            e for k, e in svc._engines.items() if k[4] == "http"
+        )
+        assert isinstance(eng.model, ShardedVerdictModel)
+
+        orig = svc._jit_for
+
+        def lost_device(cache, model, trace_fn, arg_fn=None):
+            if isinstance(model, ShardedVerdictModel):
+                def boom(*_a, **_k):
+                    raise RuntimeError("PJRT_Error: device lost")
+
+                return boom
+            return orig(cache, model, trace_fn, arg_fn)
+
+        svc._jit_for = lost_device
+        res, out = shim.on_io(False, b"POST /api/x HTTP/1.1\r\n\r\n")
+        assert res == int(FilterResult.OK)
+        assert out == b"POST /api/x HTTP/1.1\r\n\r\n"
+        st = svc.status()
+        assert st["mesh"]["demoted"] == "device-call"
+        assert not isinstance(eng.model, ShardedVerdictModel)
+        res, out = shim.on_io(False, b"DELETE /x HTTP/1.1\r\n\r\n")
+        assert out != b"DELETE /x HTTP/1.1\r\n\r\n"  # still denying
+        assert st["containment"]["batch_crashes"] == 0
+        assert svc.fallback_entries == 0  # never host-judged rounds
+    finally:
+        if client is not None:
+            client.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()
+
+
+def test_daemon_engine_factory_builds_sharded_and_demotes():
+    """The daemon-side factory path: build_model_for_filter with a
+    mesh returns the sharded wrappers (http + kafka), the runtime
+    engines serve them bit-identically, and the engine-level judge
+    rung demotes a dead sharded model to its fallback typed instead
+    of crashing the step."""
+    import jax
+
+    from cilium_tpu.labels import Labels
+    from cilium_tpu.models.builder import build_model_for_filter
+    from cilium_tpu.parallel.mesh import RULE_AXIS, serving_mesh
+    from cilium_tpu.parallel.rulesharding import ShardedKafkaModel
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        L7Rules,
+        PortRuleHTTP,
+        PortRuleKafka,
+    )
+    from cilium_tpu.policy.l4 import (
+        L4Filter,
+        L7DataMap,
+        PARSER_TYPE_HTTP,
+        PARSER_TYPE_KAFKA,
+    )
+    from cilium_tpu.proxylib.types import DROP, PASS
+    from cilium_tpu.runtime.engines import (
+        HttpBatchEngine,
+        KafkaBatchEngine,
+        _daemon_mesh,
+    )
+
+    mesh = serving_mesh("on", rule_shards=2, devices=jax.devices())
+    assert mesh is not None and mesh.shape[RULE_AXIS] == 2
+
+    # _daemon_mesh resolves from config once and caches on the daemon.
+    class _Daemon:
+        config = DaemonConfig(mesh="on", mesh_rule_shards=2)
+
+    d = _Daemon()
+    got = _daemon_mesh(d)
+    assert got is not None and got.shape[RULE_AXIS] == 2
+    assert d.verdict_mesh is got
+    assert _daemon_mesh(d) is got  # cached
+
+    identity_cache = {7: Labels.from_model(["k8s:app=web"])}
+    sel = EndpointSelector.from_dict({"k8s:app": "web"})
+    dm = L7DataMap()
+    dm[sel] = L7Rules(http=[PortRuleHTTP(method="GET", path="/ok/.*")])
+    f = L4Filter(port=80, protocol="TCP", l7_parser=PARSER_TYPE_HTTP,
+                 l7_rules_per_ep=dm)
+    model = build_model_for_filter(f, identity_cache, mesh=mesh)
+    assert isinstance(model, ShardedVerdictModel)
+    eng = HttpBatchEngine(model)
+    req = b"GET /ok/x HTTP/1.1\r\n\r\n"
+    eng.feed(1, req, remote_id=7)
+    eng.feed(2, req, remote_id=99)
+    eng.pump()
+    assert eng.take_ops(1)[0] == [(PASS, len(req))]
+    assert eng.take_ops(2)[0][0][0] == int(DROP)
+
+    # Engine-level mesh rung: a dead sharded model demotes in-step.
+    class _DeadSharded:
+        def __init__(self, fallback):
+            self.fallback = fallback
+
+        def __call__(self, *_a, **_k):
+            raise RuntimeError("PJRT_Error: device lost")
+
+        def verdicts_attr(self, *_a, **_k):
+            raise RuntimeError("PJRT_Error: device lost")
+
+    eng.model = _DeadSharded(model.fallback)
+    eng.feed(3, req, remote_id=7)
+    eng.pump()
+    assert eng.take_ops(3)[0] == [(PASS, len(req))]
+    assert eng.model is model.fallback  # demoted, typed, serving
+
+    # Kafka wrapper through the same factory.
+    kr = PortRuleKafka(topic="orders", role="produce")
+    kr.sanitize()
+    dmk = L7DataMap()
+    dmk[sel] = L7Rules(kafka=[kr])
+    fk = L4Filter(port=9092, protocol="TCP",
+                  l7_parser=PARSER_TYPE_KAFKA, l7_rules_per_ep=dmk)
+    kmodel = build_model_for_filter(fk, identity_cache, mesh=mesh)
+    assert isinstance(kmodel, ShardedKafkaModel)
+    from test_kafka import produce_request
+
+    keng = KafkaBatchEngine(kmodel)
+    ok = produce_request(["orders"])
+    bad = produce_request(["secret"])
+    keng.feed(1, ok, remote_id=7)
+    keng.feed(2, bad, remote_id=7)
+    keng.pump()
+    assert keng.take_ops(1)[0] == [(PASS, len(ok))]
+    assert keng.take_ops(2)[0][0][0] == int(DROP)
+
+
+def test_device_loss_demotes_typed_zero_silent_loss(tmp_path):
+    """Fault injection at the executable layer (how a lost mesh device
+    actually surfaces: the compiled sharded dispatch raises): the
+    in-flight round is answered from the single-chip fallback in the
+    SAME round, the demotion is typed and status-surfaced, subsequent
+    traffic serves bit-identically, and nothing is shed, crashed, or
+    left unanswered."""
+    inst.reset_module_registry()
+    svc = client = None
+    try:
+        svc, client, mod = _start(tmp_path, "mesh-loss",
+                                  batch_timeout_ms=0.0)
+        shim = _conn(client, mod, 50, 1)
+        res, out = shim.on_io(False, b"READ /public/a.txt\r\n")
+        assert out == b"READ /public/a.txt\r\n"
+
+        orig = svc._jit_for
+
+        def lost_device(cache, model, trace_fn, arg_fn=None):
+            if isinstance(model, ShardedVerdictModel):
+                def boom(*_a, **_k):
+                    raise RuntimeError("PJRT_Error: device lost")
+
+                return boom
+            return orig(cache, model, trace_fn, arg_fn)
+
+        svc._jit_for = lost_device
+        # The round that hits the dead mesh is still answered — with
+        # the CORRECT verdict, from the fallback executable.
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        st = svc.status()
+        assert st["mesh"]["demoted"] == "device-call"
+        assert st["mesh"]["demotions"] == {"device-call": 1}
+        assert st["mesh"]["active"] is False
+        # Engines flipped to the single-chip executable.
+        eng = next(iter(svc._engines.values()))
+        assert not isinstance(eng.model, ShardedVerdictModel)
+        # Still serving, still bit-identical, nothing lost.
+        for frame, remote, want in TRAFFIC:
+            s2 = _conn(client, mod, 60 + remote, remote)
+            res, out = s2.on_io(False, frame)
+            assert res == int(FilterResult.OK)
+            assert (out == frame) == want, (frame, out)
+            s2.close()
+        st = svc.status()
+        assert st["containment"]["shed_entries"] == 0
+        assert st["containment"]["batch_crashes"] == 0
+        assert st["containment"]["error_entries"] == 0
+        # Sticky: one demotion, not one per round.
+        assert st["mesh"]["demotions"] == {"device-call": 1}
+        # New engine builds while demoted are single-chip.
+        assert svc._serving_mesh() is None
+    finally:
+        if client is not None:
+            client.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()

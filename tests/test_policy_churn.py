@@ -434,14 +434,16 @@ def _expected_kinds(rules: list[dict]) -> tuple:
 
 
 def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
-                n_conns: int = 8):
+                n_conns: int = 8, **cfg_kw):
     """The acceptance scenario: continuous policy updates + endpoint
     regeneration + identity allocate/release across an injected
     kvstore failover, against live mixed traffic."""
     from cilium_tpu.kvstore import ChaosProxy, KvstoreFollower, KvstoreServer, NetBackend
     from cilium_tpu.kvstore.allocator import Allocator
 
-    svc, client, mod = _start(tmp_path, name=f"soak{duration_s:g}")
+    svc, client, mod = _start(
+        tmp_path, name=f"soak{duration_s:g}", **cfg_kw
+    )
     primary = KvstoreServer()
     chaos = ChaosProxy(primary.address)
     follower = KvstoreFollower(
@@ -609,6 +611,115 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
 def test_churn_soak_fast(tmp_path):
     """Tier-1 churn soak: seconds-scale, full scenario."""
     _churn_soak(tmp_path, duration_s=6.0, updates_per_s=4.0)
+
+
+def test_churn_soak_fast_mesh(tmp_path):
+    """The same churn soak with a SHARDED rule table (2 rule shards on
+    the CPU mesh): every epoch's builder rebuilds all shards before
+    the flip, records stay cross-epoch-attribution-clean, zero silent
+    loss — non-stop churn holds on the multi-chip path too."""
+    _churn_soak(tmp_path, duration_s=4.0, updates_per_s=4.0,
+                mesh="on", mesh_rule_shards=2)
+
+
+# --- epoch hot-swap × mesh -------------------------------------------------
+
+
+def test_mesh_swap_rebuilds_all_shards_before_flip(tmp_path):
+    """Sharded epoch swap: the builder rebuilds EVERY shard (stacked
+    tables + single-chip fallback) off-path, then commits with the one
+    pointer flip — the new epoch serves sharded, bit-identically with
+    the new policy, and the mesh stays active throughout."""
+    from cilium_tpu.parallel.rulesharding import ShardedVerdictModel
+
+    svc, client, mod = _start(tmp_path, name="mesh-swap", mesh="on",
+                              mesh_rule_shards=2)
+    try:
+        assert client.policy_update(mod, [_policy("pol", POLICY_A)]) \
+            == int(FilterResult.OK)
+        shim = _conn(client, mod, 1)
+        assert _verdict(shim, b"READ /public/a\r\n")[0]
+        assert not _verdict(shim, b"WRITE /tmp/x\r\n")[0]
+        eng0 = next(iter(svc._engines.values()))
+        assert isinstance(eng0.model, ShardedVerdictModel)
+        assert eng0.model.n_shards == 2
+        epoch0 = svc.policy_epoch
+        assert client.policy_update(mod, [_policy("pol", POLICY_B)]) \
+            == int(FilterResult.OK)
+        assert svc.policy_epoch == epoch0 + 1
+        eng1 = next(iter(svc._engines.values()))
+        assert eng1 is not eng0
+        assert isinstance(eng1.model, ShardedVerdictModel)
+        assert eng1.model.n_shards == 2
+        # POLICY_B semantics on the new sharded epoch.
+        assert not _verdict(shim, b"READ /public/a\r\n")[0]
+        assert _verdict(shim, b"WRITE /tmp/x\r\n")[0]
+        assert _verdict(shim, b"RESET\r\n")[0]
+        st = svc.status()
+        assert st["mesh"]["active"]
+        assert st["policy"]["swaps"] >= 1
+        assert st["policy"]["swap_failures"] == {}
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_mesh_mid_build_shard_failure_fails_closed(tmp_path):
+    """A staged device build that dies on shard k (k=1 of 2) is a
+    typed policy_swap_failures_total{device-build} NACK: the old
+    SHARDED epoch keeps serving bit-identically — a torn half-sharded
+    table can never be observed."""
+    from cilium_tpu.parallel import rulesharding
+    from cilium_tpu.parallel.rulesharding import ShardedVerdictModel
+
+    svc, client, mod = _start(tmp_path, name="mesh-fail", mesh="on",
+                              mesh_rule_shards=2)
+    try:
+        assert client.policy_update(mod, [_policy("pol", POLICY_A)]) \
+            == int(FilterResult.OK)
+        shim = _conn(client, mod, 1)
+        before = [
+            _verdict(shim, f)[0]
+            for f in (b"READ /public/a\r\n", b"WRITE /tmp/x\r\n",
+                      b"HALT\r\n")
+        ]
+        assert before == [True, False, True]
+        epoch0 = svc.policy_epoch
+        calls = [0]
+        orig = rulesharding.compile_patterns
+
+        def shard_k_dies(patterns):
+            calls[0] += 1
+            if calls[0] >= 2:  # shard k=1 of the staged 2-shard build
+                raise RuntimeError("injected shard-build failure")
+            return orig(patterns)
+
+        rulesharding.compile_patterns = shard_k_dies
+        try:
+            assert client.policy_update(
+                mod, [_policy("pol", POLICY_B)]
+            ) == int(FilterResult.POLICY_DROP)
+        finally:
+            rulesharding.compile_patterns = orig
+        assert calls[0] >= 2  # the failure really hit mid-build
+        assert svc.policy_epoch == epoch0
+        fails = svc.status()["policy"]["swap_failures"]
+        assert fails.get("device-build", 0) >= 1
+        # The old sharded epoch serves bit-identically, still meshed.
+        after = [
+            _verdict(shim, f)[0]
+            for f in (b"READ /public/a\r\n", b"WRITE /tmp/x\r\n",
+                      b"HALT\r\n")
+        ]
+        assert after == before
+        eng = next(iter(svc._engines.values()))
+        assert isinstance(eng.model, ShardedVerdictModel)
+        assert svc.status()["mesh"]["active"]
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
 
 
 @pytest.mark.slow

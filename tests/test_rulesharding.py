@@ -46,6 +46,63 @@ def test_split_balanced():
     assert split_balanced([], 2) == [[], []]
 
 
+def test_split_balanced_degenerate():
+    """The shapes the live integration hits: one rule, k=1, empty."""
+    assert split_balanced([1], 4) == [[1], [], [], []]
+    assert split_balanced([], 3) == [[], [], []]
+    assert split_balanced([1, 2, 3], 1) == [[1, 2, 3]]
+
+
+def test_shard_offsets_match_split():
+    from cilium_tpu.parallel.rulesharding import shard_offsets
+
+    assert np.asarray(shard_offsets(5, 2)).tolist() == [0, 3]
+    assert np.asarray(shard_offsets(1, 4)).tolist() == [0, 1, 1, 1]
+    assert np.asarray(shard_offsets(8, 4)).tolist() == [0, 2, 4, 6]
+
+
+def test_pad_tables_padding_is_dead():
+    """pad_tables grows (states, classes, patterns) with rows that can
+    never fire: padded pattern rows accept nothing, padded classes
+    have no transitions, matches_empty stays False."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.ops.nfa import device_nfa
+    from cilium_tpu.ops.rxsearch import automaton_search_spans
+    from cilium_tpu.parallel.rulesharding import pad_tables
+    from cilium_tpu.regex import compile_patterns
+
+    t = compile_patterns(["ab+c"])
+    p = pad_tables(t, t.n_states + 3, t.n_classes + 2, 5)
+    assert (p.n_states, p.n_classes, p.n_patterns) == (
+        t.n_states + 3, t.n_classes + 2, 5
+    )
+    assert not p.accept[1:].any()
+    assert not p.accept_final[1:].any()
+    assert not p.matches_empty[1:].any()
+    assert not p.delta[t.n_classes:].any()
+    nfa = device_nfa(p)
+    data = np.zeros((2, 8), np.uint8)
+    data[0, :4] = np.frombuffer(b"abbc", np.uint8)
+    starts = jnp.zeros(2, jnp.int32)
+    ends = jnp.asarray([4, 0], jnp.int32)
+    hits = np.asarray(
+        automaton_search_spans(nfa, jnp.asarray(data), starts, ends)
+    )
+    assert hits[0, 0]  # the real pattern still matches
+    assert not hits[:, 1:].any()  # padded pattern rows never fire
+
+
+def test_never_match_tables():
+    from cilium_tpu.parallel.rulesharding import _never_match_tables
+
+    t = _never_match_tables(3)
+    assert t.n_patterns == 3
+    assert not t.accept.any()
+    assert not t.accept_final.any()
+    assert not t.matches_empty.any()
+
+
 # --- r2d2 -----------------------------------------------------------------
 
 R2D2_RULES = [
@@ -232,6 +289,213 @@ def _kafka_rules():
         frozenset(), frozenset(),
     ]
     return list(zip(remote_sets, rules))
+
+
+# --- cross-shard attribution parity (extends the PR 5 parity suite) -------
+#
+# The sharded first-match rule id and match_kind must be bit-identical
+# to the HOST ORACLE walk (pi.matches_at) over a literal+regex+nfa
+# stress mix — including the wildcard-port cascade offsets — at 2 and
+# 4 rule shards.  The global id comes from the shard-local argmax +
+# cross-shard min-index reduction; the kinds legend is shared with the
+# single-chip fallback, so both rungs attribute identically.
+
+# A pattern whose determinization blows up — forces the NFA tier.
+_NFA_FILE = "/n/(a|b)*a" + "(a|b)" * 7 + "/x"
+
+
+@pytest.fixture
+def attr_policy():
+    reset_module_registry()
+    mod = open_module([], True)
+    ins = find_instance(mod)
+    ins.policy_update([
+        NetworkPolicy(
+            name="attr-pol",
+            policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(
+                    port=80,
+                    rules=[
+                        PortNetworkPolicyRule(
+                            remote_policies=[1, 3],
+                            l7_proto="r2d2",
+                            l7_rules=[
+                                {"cmd": "READ", "file": "/public/.*"},
+                                {"cmd": "HALT"},  # literal (no file)
+                            ],
+                        ),
+                        PortNetworkPolicyRule(
+                            l7_proto="r2d2",
+                            l7_rules=[
+                                {"cmd": "WRITE", "file": _NFA_FILE},
+                                {"file": "\\.txt$"},
+                                {"cmd": "READ", "file": "/d/[a-z]+"},
+                            ],
+                        ),
+                    ],
+                ),
+                PortNetworkPolicy(
+                    port=0,  # wildcard cascade: rows offset past port 80
+                    rules=[
+                        PortNetworkPolicyRule(
+                            l7_proto="r2d2",
+                            l7_rules=[{"cmd": "RESET"}],
+                        ),
+                    ],
+                ),
+            ],
+        )
+    ])
+    yield ins.policy_map()["attr-pol"]
+    reset_module_registry()
+
+
+_ATTR_MSGS = [
+    b"READ /public/a.txt\r\n",   # rules 0 AND 3 race: first match wins
+    b"HALT\r\n",
+    b"WRITE /n/ababaababababab/x\r\n",  # nfa tier
+    b"WRITE /n/bbbb/x\r\n",      # nfa non-match
+    b"READ notes.txt\r\n",       # regex $ anchor
+    b"READ /d/abc\r\n",
+    b"RESET\r\n",                # wildcard-port cascade row
+    b"FLY /public/a\r\n",        # deny
+    b"READ /secret\r\n",
+]
+
+
+def _attr_batch(f=32, width=64, seed=7):
+    rng = random.Random(seed)
+    data = np.zeros((f, width), np.uint8)
+    lengths = np.zeros((f,), np.int32)
+    remotes = np.zeros((f,), np.int32)
+    msgs = []
+    for i in range(f):
+        m = _ATTR_MSGS[rng.randrange(len(_ATTR_MSGS))]
+        r = rng.choice([1, 3, 9])
+        data[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lengths[i] = len(m)
+        remotes[i] = r
+        msgs.append((m, r))
+    return data, lengths, remotes, msgs
+
+
+@pytest.mark.parametrize("n_rule", [2, 4])
+def test_r2d2_cross_shard_attr_parity_vs_host(attr_policy, n_rule):
+    from cilium_tpu.parallel.rulesharding import mesh_r2d2_model
+    from cilium_tpu.proxylib.parsers.r2d2 import R2d2RequestData
+
+    mesh = flow_mesh(n_flow=8 // n_rule, n_rule=n_rule)
+    w = mesh_r2d2_model(attr_policy, True, 80, mesh)
+    assert w.n_shards == n_rule
+    data, lengths, remotes, msgs = _attr_batch()
+    _, _, allow, rule = w.verdicts_attr(data, lengths, remotes)
+    allow, rule = np.asarray(allow), np.asarray(rule)
+    fb = w.fallback
+    _, _, fa, fr = fb.verdicts_attr(data, lengths, remotes)
+    np.testing.assert_array_equal(allow, np.asarray(fa))
+    np.testing.assert_array_equal(rule, np.asarray(fr))
+    kinds = {"literal", "regex", "nfa"} & set(w.match_kinds)
+    assert len(kinds) >= 2, w.match_kinds  # the mix spans tiers
+    for i, (m, r) in enumerate(msgs):
+        parts = m[:-2].decode().split(" ")
+        l7 = R2d2RequestData(
+            parts[0], parts[1] if len(parts) > 1 else ""
+        )
+        hok, hrule = attr_policy.matches_at(True, 80, r, l7)
+        assert bool(allow[i]) == hok, (m, r)
+        assert int(rule[i]) == hrule, (m, r, int(rule[i]), hrule)
+        if hrule >= 0:
+            # match_kind resolves through the same legend on both
+            # rungs — a sharded rule id never points at a different
+            # tier than the host walk's row.
+            assert (
+                w.match_kinds[int(rule[i])] == fb.match_kinds[hrule]
+            )
+
+
+@pytest.mark.parametrize("n_rule", [2, 4])
+def test_http_cross_shard_attr_parity(n_rule):
+    from cilium_tpu.models.http import http_verdicts_attr
+    from cilium_tpu.parallel.rulesharding import (
+        ShardedVerdictModel,
+        shard_offsets,
+    )
+
+    ref_model = build_http_model(HTTP_RULES)
+    data, lengths, remotes = _http_batch(32)
+    _, _, want_a, want_r = http_verdicts_attr(
+        ref_model, data, lengths, remotes
+    )
+    mesh = flow_mesh(n_flow=8 // n_rule, n_rule=n_rule)
+    stacked = build_sharded_http_model(HTTP_RULES, n_rule)
+    w = ShardedVerdictModel(
+        stacked, shard_offsets(len(HTTP_RULES), n_rule), mesh, "http",
+        fallback=ref_model,
+        match_kinds=getattr(ref_model, "match_kinds", ()),
+    )
+    _, _, got_a, got_r = w.verdicts_attr(data, lengths, remotes)
+    np.testing.assert_array_equal(np.asarray(got_a), np.asarray(want_a))
+    np.testing.assert_array_equal(np.asarray(got_r), np.asarray(want_r))
+
+
+def test_r2d2_single_rule_many_shards():
+    """1 rule over 4 shards: three all-empty shards ride the
+    _never_match_tables padding inside the real builder and must stay
+    dead on BOTH reductions (OR-allow and min-index attribution)."""
+    from cilium_tpu.parallel.rulesharding import mesh_r2d2_model
+
+    reset_module_registry()
+    mod = open_module([], True)
+    ins = find_instance(mod)
+    ins.policy_update([
+        NetworkPolicy(
+            name="one", policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(port=80, rules=[
+                    PortNetworkPolicyRule(
+                        l7_proto="r2d2", l7_rules=[{"cmd": "HALT"}]
+                    )
+                ])
+            ],
+        )
+    ])
+    pi = ins.policy_map()["one"]
+    mesh = flow_mesh(n_flow=2, n_rule=4)
+    w = mesh_r2d2_model(pi, True, 80, mesh)
+    assert w.n_shards == 4
+    data = np.zeros((8, 32), np.uint8)
+    lengths = np.zeros(8, np.int32)
+    remotes = np.ones(8, np.int32)
+    for i, m in enumerate([b"HALT\r\n", b"READ /x\r\n"] * 4):
+        data[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lengths[i] = len(m)
+    _, _, a, r = w.verdicts_attr(data, lengths, remotes)
+    _, _, fa, fr = w.fallback.verdicts_attr(data, lengths, remotes)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(fa))
+    np.testing.assert_array_equal(np.asarray(r), np.asarray(fr))
+    assert np.asarray(a)[0] and not np.asarray(a)[1]
+    assert np.asarray(r)[0] == 0
+    reset_module_registry()
+
+
+def test_sharded_bucket_pads_rule_axis(r2d2_policy):
+    """bucket=True pads the per-shard rule axis to the power-of-two
+    bucket (churn executable reuse) without changing verdicts."""
+    from cilium_tpu.models.r2d2 import MIN_RULE_BUCKET
+
+    data, lengths, remotes = _r2d2_batch(16)
+    ref_model = build_r2d2_model(r2d2_policy, True, 80)
+    _, _, want = r2d2_verdicts(ref_model, data, lengths, remotes)
+    mesh = flow_mesh(n_flow=4, n_rule=2)
+    stacked = build_sharded_r2d2_model(
+        r2d2_policy, True, 80, 2, bucket=True
+    )
+    r_dim = stacked.cmd_len.shape[1]
+    assert r_dim >= MIN_RULE_BUCKET and (r_dim & (r_dim - 1)) == 0
+    step = sharded_verdict_step(mesh, r2d2_verdicts)
+    _, _, got = step(stacked, data, lengths, remotes)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 @pytest.mark.parametrize("n_rule", [2, 4])
