@@ -109,7 +109,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="cilium-lint",
         description="whole-program concurrency & device-contract "
-                    "invariant analyzer (rules R0-R11; see README "
+                    "invariant analyzer (rules R0-R13; see README "
                     "'Invariants & lint')",
     )
     p.add_argument("paths", nargs="*", default=["cilium_tpu"],

@@ -71,6 +71,11 @@ RULE_DOCS = {
            "reachable from the dispatch/service hot loops, or made "
            "under a held lock in a hot module — recompiles belong on "
            "the policy builder thread behind a pointer-flip swap",
+    "R13": "epoch-unkeyed cache in a hot module: a cache store whose "
+           "key carries no epoch/generation term (and no sibling "
+           "epoch store in the function), or a cache read with no "
+           "epoch check anywhere in the consumer — a policy "
+           "pointer-flip leaves such entries serving the old table",
 }
 
 # ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
@@ -378,6 +383,7 @@ def _collect_py(paths) -> list[str]:
 
 def all_rules():
     from . import (
+        rules_cache,
         rules_compile,
         rules_device,
         rules_jit,
@@ -400,6 +406,7 @@ def all_rules():
         rules_device.check_r10,
         rules_device.check_r11,
         rules_compile.check_r12,
+        rules_cache.check_r13,
     ]
 
 

@@ -482,7 +482,15 @@ def cmd_sidecar_status(args):
               f"{'ACTIVE' if mesh.get('active') else 'DEMOTED'}"
               + (f" reason={mesh.get('demoted')}" if mesh.get("demoted")
                  else "")
-              + (f" demotions: {dem}" if dem else ""))
+              + (f" demotions: {dem}" if dem else "")
+              + (f" repromotions={mesh.get('repromotions', 0)}"
+                 if mesh.get("repromotions") else ""))
+    fc = st.get("flow_cache") or {}
+    if fc:
+        print(f"flow_cache: armed={fc.get('armed', 0)} "
+              f"hits={fc.get('hits', 0)} "
+              f"misses={fc.get('misses', 0)} "
+              f"invalidations={fc.get('invalidations', 0)}")
     tr = st.get("transport") or {}
     if tr:
         rejects = " ".join(

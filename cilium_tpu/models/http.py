@@ -256,6 +256,10 @@ class HttpBatchModel:
     # Per-rule compiled match kind (literal|regex|nfa) — static aux for
     # rule attribution labels, never device data.
     match_kinds: tuple = ()
+    # Per-rule (remote_set_or_None, byte_free) reduction for the verdict
+    # cache's byte-invariance analysis (policy/invariance.py) — host
+    # aux like match_kinds, never device data, never a pytree leaf.
+    invariant_rows: tuple = ()
 
     def tree_flatten(self):
         return (
@@ -282,6 +286,12 @@ class HttpBatchModel:
 
     def verdicts_attr(self, data, lengths, remotes):
         return http_verdicts_attr(self, data, lengths, remotes)
+
+
+def _reduce_http_rows(rules_with_remotes) -> tuple:
+    from ..policy.invariance import reduce_http_rows
+
+    return reduce_http_rows(rules_with_remotes)
 
 
 def build_http_model(
@@ -357,6 +367,7 @@ def build_http_model(
         has_method_rx=any(s == 0 for s in line_slot),
         has_path_rx=any(s == 1 for s in line_slot),
         match_kinds=tuple(kinds),
+        invariant_rows=_reduce_http_rows(rules_with_remotes),
     )
 
 

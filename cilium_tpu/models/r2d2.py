@@ -53,6 +53,10 @@ class R2d2BatchModel(VerdictModel):
     # existing executable — the traced computation never reads kinds,
     # and nothing host-side consumes a round-tripped pytree's labels.
     match_kinds: tuple = ()
+    # Per-row (remote_set_or_None, byte_free) reduction for the verdict
+    # cache's byte-invariance analysis (policy/invariance.py) — host
+    # aux like match_kinds: outside the pytree, never device data.
+    invariant_rows: tuple = ()
 
     def tree_flatten(self):
         return (
@@ -190,6 +194,8 @@ def build_r2d2_model_from_rows(
         else ("nfa" if isinstance(nfa, DeviceNfa) else "regex")
         for _, _, file_rx in rows
     )
+    from ..policy.invariance import reduce_r2d2_rows
+
     return R2d2BatchModel(
         nfa=nfa,
         cmd_needle=jnp.asarray(cmd_needle),
@@ -198,6 +204,7 @@ def build_r2d2_model_from_rows(
         remote_ids=jnp.asarray(packed_ids),
         any_remote=jnp.asarray(any_remote),
         match_kinds=kinds,
+        invariant_rows=reduce_r2d2_rows(rows),
     )
 
 

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..models.base import ConstVerdict
+from ..policy.invariance import InvariantClaimEngine
 from ..proxylib.accesslog import EntryType, LogEntry
 from ..proxylib.types import DROP, ERROR, MORE, PASS, OpError, OpType
 from ..utils import flowdebug
@@ -59,7 +60,7 @@ class FlowState:
     last_rule_id: int = -1
 
 
-class R2d2BatchEngine:
+class R2d2BatchEngine(InvariantClaimEngine):
     """Batch engine for the r2d2 model (the flagship end-to-end slice)."""
 
     # Columnar feed contract (sidecar/reasm.py): the service's

@@ -27,6 +27,7 @@ from ..kafka.request import KafkaParseError, frame_length
 from ..models.base import ConstVerdict
 from ..models.builder import build_model_for_filter
 from ..models.kafka import encode_requests
+from ..policy.invariance import InvariantClaimEngine
 from ..policy.l4 import PARSER_TYPE_HTTP, PARSER_TYPE_KAFKA
 from ..proxylib.types import DROP, MORE, PASS, OpType
 from ..utils import flowdebug, metrics
@@ -57,7 +58,7 @@ class EngineFlow:
     closed: bool = False
 
 
-class BaseBatchEngine:
+class BaseBatchEngine(InvariantClaimEngine):
     """Shared flow/buffer management (the OnIO byte accounting)."""
 
     proto = ""
@@ -171,9 +172,14 @@ class HttpBatchEngine(BaseBatchEngine):
     MAX_WIDTH = 1 << 15  # heads beyond this are judged as DENY (absurd)
     MIN_ROWS = 64
 
-    def __init__(self, model, **kw):
+    def __init__(self, model, cache_enabled: bool = False, **kw):
         super().__init__(**kw)
         self.model = model
+        # Verdict-cache offload tier (gated — cache-off is the true
+        # baseline): heads of an identity whose claim is byte-invariant
+        # are judged host-side with the claimed rule row, never encoded
+        # into the device batch.
+        self.cache_enabled = cache_enabled
 
     def _width_bucket(self, head_len: int) -> int:
         w = self.MIN_WIDTH
@@ -224,12 +230,26 @@ class HttpBatchEngine(BaseBatchEngine):
         # Group flows into per-width buckets so one oversized head does
         # not force a wide (and freshly compiled) scan for everyone.
         buckets: dict[int, list[tuple[EngineFlow, int, int]]] = {}
+        cache_hits = 0
         for st, head_len, body_len in active:
             if head_len > self.MAX_WIDTH:
                 # Pathological request head: deny without a device pass.
                 self._emit_http(st, False, head_len, body_len)
                 recs.append((st.flow_id, False, -1))
                 continue
+            if self.cache_enabled:
+                claim = self.verdict_invariant(st.remote_id)
+                if claim is not None and claim[0]:
+                    # Byte-invariant allow: the verdict AND the
+                    # first-match row are independent of the head's
+                    # bytes — judged host-side, no device row (the
+                    # verdict-cache offload tier; deny claims keep the
+                    # normal path so per-frame 403 injection framing
+                    # is never skipped).
+                    self._emit_http(st, True, head_len, body_len)
+                    recs.append((st.flow_id, True, claim[1]))
+                    cache_hits += 1
+                    continue
             buckets.setdefault(
                 self._width_bucket(head_len), []
             ).append((st, head_len, body_len))
@@ -269,6 +289,8 @@ class HttpBatchEngine(BaseBatchEngine):
                     st.flow_id, bool(allow[i]),
                     int(rule[i]) if rule is not None else -1,
                 ))
+        if cache_hits:  # one batched inc per step, never per entry
+            metrics.VerdictCacheHits.inc("engine", amount=cache_hits)
         self._record_round(recs, getattr(self.model, "match_kinds", ()))
         return True
 
@@ -426,7 +448,13 @@ def create_engine_for_redirect(daemon, redirect):
         flowlog=getattr(daemon, "flowlog", None),
     )
     if f.l7_parser == PARSER_TYPE_HTTP:
-        return HttpBatchEngine(model, **common)
+        return HttpBatchEngine(
+            model,
+            cache_enabled=getattr(
+                getattr(daemon, "config", None), "flow_cache", False
+            ),
+            **common,
+        )
     if f.l7_parser == PARSER_TYPE_KAFKA:
         from .engines_util import kafka_host_rows
 

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..models.base import ConstVerdict
 from ..models.cassandra import cassandra_verdicts, encode_cassandra_batch
+from ..policy.invariance import InvariantClaimEngine
 from ..models.memcached import encode_memcache_batch, memcache_verdicts
 from ..proxylib.connection import Connection, InjectBuf
 from ..proxylib.parsers.cassandra import (
@@ -45,7 +46,7 @@ from ..proxylib.parsers.memcached import (
 import logging
 
 from ..proxylib.types import MORE, DROP, ERROR, PASS, FilterResult, OpError
-from ..utils import flowdebug
+from ..utils import flowdebug, metrics
 
 log = logging.getLogger(__name__)
 # Per-flow debug stream: every per-frame/per-op message in this module
@@ -109,7 +110,7 @@ class _EngineFlow:
         self.overflowed = False
 
 
-class DeviceAssistedEngine:
+class DeviceAssistedEngine(InvariantClaimEngine):
     """Common pump for peek/judge/drive engines.
 
     Subclasses implement ``_peek(flow, buf)`` returning the list of
@@ -128,6 +129,10 @@ class DeviceAssistedEngine:
         # judge on the plain verdict call — no argmax, no extra
         # readback.
         self.attr_enabled = attr_enabled
+        # Verdict-cache offload tier gate (service config flow_cache):
+        # when on, judge steps may answer byte-invariant identities
+        # host-side from the claim instead of encoding device rows.
+        self.cache_enabled = False
         self.ingress = ingress
         self.port = port
         self.model = model
@@ -578,14 +583,29 @@ class HttpSidecarEngine(DeviceAssistedEngine):
         overflow = np.zeros(n, bool)
         rules = np.full(n, -1, np.int32)
         buckets: dict[int, list[int]] = {}
+        cache_hits = 0
         for i, head in enumerate(descs):
             if len(head) > self.MAX_WIDTH:
                 overflow[i] = True
                 continue
+            if self.cache_enabled:
+                claim = self.verdict_invariant(int(remotes[i]))
+                if claim is not None and claim[0]:
+                    # Byte-invariant allow (the verdict-cache offload
+                    # tier): answer from the claim — verdict AND rule
+                    # row are bytes-independent — and keep the head out
+                    # of the device batch.  Deny claims stay on the
+                    # normal path (the oracle owns 403 framing).
+                    allow[i] = True
+                    rules[i] = claim[1]
+                    cache_hits += 1
+                    continue
             w = self.MIN_WIDTH
             while w < len(head):
                 w *= 2
             buckets.setdefault(w, []).append(i)
+        if cache_hits:  # one batched inc per judge step, never per frame
+            metrics.VerdictCacheHits.inc("engine", amount=cache_hits)
         for w, idxs in sorted(buckets.items()):
             f_pad = self.MIN_ROWS
             while f_pad < len(idxs):

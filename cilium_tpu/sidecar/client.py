@@ -39,6 +39,7 @@ import json
 import logging
 import socket
 import threading
+from collections import deque
 from dataclasses import asdict, dataclass, field
 
 import numpy as np
@@ -48,6 +49,7 @@ from ..utils import metrics
 from ..utils.backoff import Exponential
 from ..utils.sockutil import shutdown_close as _teardown
 from . import wire
+from .reasm import rows_end_crlf, segments_end_crlf
 from .shm import RingError
 from .transport import (
     CREDIT_FLAG_QUARANTINED,
@@ -116,6 +118,13 @@ class ShimConnection:
         d = self.dirs[reply]
         output = bytearray()
         incoming = bytes(data)
+        # Captured BEFORE any mutation below: the verdict cache only
+        # short-circuits a push that arrived on a fully clean
+        # direction (nothing retained, no overshoot counters) so the
+        # granted claim covers exactly this payload's whole frames.
+        clean_entry = (
+            not d.buffer and d.pass_bytes == 0 and d.drop_bytes == 0
+        )
 
         # Apply pre-pass / pre-drop from an earlier verdict that exceeded
         # the then-available input (reference: cilium_proxylib.cc:130-166).
@@ -136,6 +145,26 @@ class ShimConnection:
         if d.inject:
             output += d.inject
             d.inject.clear()
+
+        # Established-flow verdict cache: a granted conn's frame-
+        # aligned request push is answered HERE — the bytes never
+        # reach the transport (Libra-style: only bytes that NEED
+        # inspection cross the seam).  Strictly gated: the direction
+        # was fully clean at entry (clean_entry), request direction,
+        # and the payload ends at a frame boundary — so a revoke at
+        # any point leaves the stream parseable from a boundary.
+        if (
+            clean_entry
+            and not reply
+            and not end_stream
+            and incoming
+            and incoming.endswith(b"\r\n")
+            and self.client._grant_valid(self.conn_id)
+        ):
+            del d.buffer[:]  # holds exactly this push (clean_entry)
+            output += incoming
+            self.client._count_cache_hits(1, len(incoming))
+            return int(FilterResult.OK), bytes(output)
 
         try:
             result, entries = self.client._on_data_rpc(
@@ -213,11 +242,35 @@ class SidecarClient:
                  transport: str = TRANSPORT_SOCKET,
                  shm_data_slots: int = 64, shm_slot_bytes: int = 1 << 20,
                  shm_verdict_slots: int = 64,
-                 shm_verdict_slot_bytes: int = 1 << 18):
+                 shm_verdict_slot_bytes: int = 1 << 18,
+                 flow_cache: bool = True):
         self.socket_path = socket_path
         self.timeout = timeout
         self.deadline_ms = deadline_ms
         self.auto_reconnect = auto_reconnect
+        # Established-flow verdict cache, shim half: when True the
+        # client opts in (MSG_CACHE_ENABLE) and honors MSG_CACHE_GRANT
+        # frames — frame-aligned request pushes for granted conns are
+        # answered LOCALLY with the service's own all-allow verdict
+        # shape, so uninspected bytes never cross the ring or socket
+        # (Libra-style selective copying).  The service only sends
+        # grants with its own flow_cache knob on, so service-off is
+        # the true baseline regardless of this flag.
+        self.flow_cache = flow_cache
+        # Grant table: conn-id-indexed epoch/rule arrays (vectorized
+        # hit mask for batched sends; grown on demand, -1 = no grant).
+        # A grant is live iff its epoch equals the latest service
+        # epoch this client has seen (grant/revoke/policy-ack frames
+        # all advance it) — the structural invalidation's client half.
+        self._grant_epoch = np.empty(0, np.int64)
+        self._grant_rule = np.empty(0, np.int32)
+        self._service_epoch = 0
+        self.cache_hits = 0
+        self.cache_hit_bytes = 0
+        # Data-plane bytes actually pushed across the transport (ring
+        # or socket) — the flow_cache bench's byte-level proof that
+        # cached bytes never crossed the seam.
+        self.bytes_pushed = 0
         # Transport preference: "shm" negotiates a pair of lock-free
         # shared-memory rings at session setup (and again after every
         # auto_reconnect replay); ANY negotiation or ring fault falls
@@ -234,6 +287,17 @@ class SidecarClient:
         self._wlock = threading.Lock()
         self._pending: dict[int, threading.Event] = {}
         self._verdicts: dict[int, wire.VerdictBatch] = {}
+        # Async data rounds sent but not yet answered (seq set), plus
+        # the local-answer delivery FIFO: a synthesized cache verdict
+        # must never overtake an earlier in-flight round's verdicts
+        # for the same conns (the client-side twin of the service
+        # tier's completion-FIFO ordering rule).  The bytes still
+        # never cross the transport — only the DELIVERY of the local
+        # answer waits, queued behind the rounds that were in flight
+        # when it was synthesized.
+        self._rounds_out: set[int] = set()
+        self._local_q: deque[tuple[set, wire.VerdictBatch]] = deque()
+        self._localq_lock = threading.Lock()
         self._control: list[tuple[int, bytes]] = []
         self._control_evt = threading.Event()
         self._clock = threading.Lock()  # serialize control round trips
@@ -272,6 +336,8 @@ class SidecarClient:
         self.verdict_callback = None  # async mode: called with VerdictBatch
         if transport == TRANSPORT_SHM:
             self._shm_negotiate()
+        if flow_cache:
+            self._cache_enable()
 
     # -- plumbing ---------------------------------------------------------
 
@@ -295,6 +361,23 @@ class SidecarClient:
                         self._deliver_verdict(vb)
                 elif msg_type == wire.MSG_SHM_CREDIT:
                     self._on_shm_credit(payload)
+                elif msg_type == wire.MSG_CACHE_GRANT:
+                    self._on_cache_grant(payload)
+                elif msg_type == wire.MSG_CACHE_REVOKE:
+                    self._on_cache_revoke(payload)
+                elif msg_type == wire.MSG_CONN_RESULT:
+                    # Reader-ordered stale-grant retirement for conn-id
+                    # reuse: grants the service wrote BEFORE this
+                    # registration reply were applied above, and the
+                    # fresh registration grant is sent AFTER the reply
+                    # — dropping the row here (same thread, socket
+                    # order) retires exactly the stale ones.
+                    if len(payload) >= 8:
+                        self._grant_drop(
+                            int(np.frombuffer(payload[:8], "<u8", 1)[0])
+                        )
+                    self._control.append((msg_type, payload))
+                    self._control_evt.set()
                 else:
                     self._control.append((msg_type, payload))
                     self._control_evt.set()
@@ -325,6 +408,9 @@ class SidecarClient:
             self._down_handled = True
             self._alive = False
         self._reconnected.clear()
+        # Cache grants die with the session (the service they came
+        # from has no successor-memory of them).
+        self._reset_grants()
         # The shm session dies with the socket (a fresh one is
         # negotiated after replay): deactivate FIRST so no new pushes
         # land, then wake the waiters — ring in-flight RPCs share the
@@ -339,6 +425,17 @@ class SidecarClient:
         for seq, evt in list(self._pending.items()):
             self._pending.pop(seq, None)
             evt.set()
+        # Async rounds lost with the socket will never be answered —
+        # flush the ordering FIFO: queued local answers were decided
+        # under grants that were live at synthesis, and the rounds
+        # they waited on are dead, so they deliver now (after the
+        # waiter sweep, in synthesis order).
+        with self._localq_lock:
+            self._rounds_out.clear()
+            flushed = [lvb for _, lvb in self._local_q]
+            self._local_q.clear()
+        for lvb in flushed:
+            self._deliver_verdict(lvb)
         self._control_evt.set()
         if sess is not None:
             try:
@@ -420,6 +517,16 @@ class SidecarClient:
             "mode": self.transport_mode,
             "preference": self.transport_pref,
             "fallbacks": dict(self.transport_fallbacks),
+            "bytes_pushed": self.bytes_pushed,
+            # Shim half of the verdict cache: locally answered pushes
+            # and the bytes that never crossed the seam because of
+            # them.
+            "cache": {
+                "enabled": self.flow_cache,
+                "hits": self.cache_hits,
+                "hit_bytes": self.cache_hit_bytes,
+                "service_epoch": self._service_epoch,
+            },
         }
         if sess is not None:
             out["session"] = sess.status()
@@ -465,6 +572,155 @@ class SidecarClient:
         )
         return True
 
+    # -- verdict cache, shim half (policy/invariance.py contract) ----------
+
+    _GRANT_MAX = 1 << 22  # conn ids beyond this keep the normal path
+
+    def _cache_enable(self) -> None:
+        """One-time opt-in (fire-and-forget): tells the service this
+        shim understands MSG_CACHE_GRANT/REVOKE frames.  Best-effort —
+        a lost enable only costs the local short-circuit."""
+        try:
+            self._send(wire.MSG_CACHE_ENABLE, wire.pack_cache_enable())
+        except (SidecarUnavailable, OSError):
+            pass
+
+    def _grant_ensure(self, conn_id: int) -> bool:
+        if conn_id >= self._GRANT_MAX:
+            return False
+        n = len(self._grant_epoch)
+        if conn_id >= n:
+            new = max(4096, n)
+            while new <= conn_id:
+                new *= 2
+            ge = np.full(new, -1, np.int64)
+            ge[:n] = self._grant_epoch
+            gr = np.full(new, -1, np.int32)
+            gr[:n] = self._grant_rule
+            self._grant_epoch = ge
+            self._grant_rule = gr
+        return True
+
+    def _on_cache_grant(self, payload: bytes) -> None:
+        conn_id, epoch, rule, flags = wire.unpack_cache_grant(payload)
+        if not self.flow_cache or not flags & wire.CACHE_FLAG_ALLOW:
+            return
+        if epoch > self._service_epoch:
+            self._service_epoch = epoch
+        if self._grant_ensure(conn_id):
+            self._grant_epoch[conn_id] = epoch
+            self._grant_rule[conn_id] = rule
+
+    def _on_cache_revoke(self, payload: bytes) -> None:
+        epoch = wire.unpack_cache_revoke(payload)
+        if epoch > self._service_epoch:
+            # Every grant under an older epoch is now structurally
+            # dead (the hit mask compares equality) — no sweep needed.
+            self._service_epoch = epoch
+
+    def _grant_drop(self, conn_id: int) -> None:
+        if conn_id < len(self._grant_epoch):
+            self._grant_epoch[conn_id] = -1
+            self._grant_rule[conn_id] = -1
+
+    def _reset_grants(self) -> None:
+        """A (re)connected service has no memory of this session's
+        grants; drop them all (fail-safe: the normal path serves)."""
+        self._grant_epoch.fill(-1)
+        self._grant_rule.fill(-1)
+
+    def _count_cache_hits(self, n: int, nbytes: int) -> None:
+        self.cache_hits += n
+        self.cache_hit_bytes += nbytes
+        metrics.VerdictCacheHits.inc("shim", amount=n)
+
+    def _grant_valid(self, conn_id: int) -> bool:
+        return (
+            self.flow_cache
+            and conn_id < len(self._grant_epoch)
+            and self._grant_epoch[conn_id] == self._service_epoch
+            and self._service_epoch >= 0
+        )
+
+    def _cached_batch(self, seq: int, ids: np.ndarray,
+                      lengths) -> wire.VerdictBatch:
+        """A locally synthesized all-allow verdict batch — byte-for-
+        byte the service's `_verdict_body` shape for an all-allow
+        round: per entry (PASS frame_len, MORE 1), result OK, no
+        inject."""
+        n = len(ids)
+        ops = np.zeros(2 * n, wire.FILTER_OP)
+        ops["op"][0::2] = int(PASS)
+        ops["n_bytes"][0::2] = np.asarray(lengths, np.int64)
+        ops["op"][1::2] = int(MORE)
+        ops["n_bytes"][1::2] = 1
+        zeros = np.zeros(n, "<u4")
+        return wire.VerdictBatch(
+            seq,
+            np.ascontiguousarray(ids, "<u8"),
+            np.full(n, int(FilterResult.OK), "<u4"),
+            np.full(n, 2, "<u4"),
+            zeros,
+            zeros,
+            ops,
+            b"",
+        )
+
+    def _cache_try_local(self, seq: int, ids: np.ndarray, lengths,
+                         tail_ok) -> bool:
+        """Answer one whole batch locally when EVERY entry is granted
+        under the live epoch and frame-aligned — the bytes never cross
+        the transport.  Partial hits keep the normal path (the
+        service's Phase-A mask owns per-entry splitting).  ``tail_ok``
+        is a thunk returning the per-entry frame-alignment mask,
+        evaluated only after every cheap grant-table check has passed
+        — the common no-grants case (cache off service-side) never
+        pays the O(payload) CRLF scan."""
+        if not self.flow_cache or not len(ids):
+            return False
+        # Range-check the RAW u64 ids before the int64 view: a wire id
+        # >= 2^63 would wrap negative and fancy-index the wrong grant
+        # rows (same guard as the service's conn-table lanes).
+        if int(ids.max()) >= len(self._grant_epoch):
+            return False
+        cids = ids.astype(np.int64)
+        if not (self._grant_epoch[cids] == self._service_epoch).all():
+            return False
+        if not tail_ok().all():
+            return False
+        nbytes = int(np.asarray(lengths, np.int64).sum())
+        self._count_cache_hits(len(ids), nbytes)
+        vb = self._cached_batch(seq, ids, lengths)
+        # Ordering: a synthesized answer must never overtake a round
+        # still in flight (its verdicts could carry ops for the same
+        # conns).  The bytes never cross either way; when anything is
+        # outstanding — or the FIFO already holds an earlier local
+        # answer — the delivery queues behind it and _round_settled
+        # releases it in synthesis order.
+        with self._localq_lock:
+            waits = set(self._rounds_out)
+            waits.update(self._pending)
+            queued = bool(waits or self._local_q)
+            if queued:
+                self._local_q.append((waits, vb))
+        if not queued:
+            self._deliver_verdict(vb)
+        return True
+
+    @staticmethod
+    def _blob_tail_ok(blob: bytes, lens: np.ndarray) -> np.ndarray:
+        """Frame-alignment mask for a packed blob batch — the service's
+        `_cache_item_hits` gate: a blob inconsistent with its lengths
+        reads as a miss (never indexes past the buffer), else every
+        segment must be CRLF-terminated."""
+        if len(blob) != int(lens.sum()):
+            return np.zeros(len(lens), bool)
+        return segments_end_crlf(
+            np.frombuffer(blob, np.uint8),
+            np.concatenate(([0], np.cumsum(lens)))[:-1],
+            lens,
+        )
+
     def detach_shm(self) -> None:
         """Gracefully return the session to the socket transport (call
         when quiescent: in-flight ring verdicts should have drained).
@@ -503,6 +759,15 @@ class SidecarClient:
         ``payload`` may be a list of buffers: the ring path writes them
         straight into the slot (the bulk rows/blob part is never
         re-materialized); only the socket fallback joins them."""
+        nbytes = (
+            sum(len(p) for p in payload)
+            if isinstance(payload, (list, tuple)) else len(payload)
+        )
+        # Transport byte accounting (ring or socket, before any
+        # fallback split): the flow_cache bench's byte-level proof —
+        # a cache-on run must push strictly fewer bytes than its
+        # cache-off control.
+        self.bytes_pushed += nbytes
         sess = self._shm
         if sess is None or not sess.active:
             self._send(msg_type, _join(payload))
@@ -511,10 +776,6 @@ class SidecarClient:
             raise SidecarUnavailable(
                 f"verdict service at {self.socket_path} is down"
             )
-        nbytes = (
-            sum(len(p) for p in payload)
-            if isinstance(payload, (list, tuple)) else len(payload)
-        )
         reason = None
         pushed = False
         with self._wlock:
@@ -602,6 +863,26 @@ class SidecarClient:
             evt.set()
         elif cb is not None:
             cb(vb)
+        # AFTER this round's own delivery: release any queued local
+        # cache answers it was holding back (they were synthesized
+        # later, so they must land later).
+        self._round_settled(vb.seq)
+
+    def _round_settled(self, seq: int | None) -> None:
+        """One round stopped being in flight (verdict delivered, RPC
+        timeout, failed send).  Retire its seq from the ordering FIFO
+        and deliver — in synthesis order — any queued local cache
+        answers that no longer wait on anything."""
+        release: list[wire.VerdictBatch] = []
+        with self._localq_lock:
+            if seq is not None:
+                self._rounds_out.discard(seq)
+                for waits, _ in self._local_q:
+                    waits.discard(seq)
+            while self._local_q and not self._local_q[0][0]:
+                release.append(self._local_q.popleft()[1])
+        for lvb in release:
+            self._deliver_verdict(lvb)
 
     def _shm_forget(self, seq: int) -> None:
         sess = self._shm
@@ -901,6 +1182,11 @@ class SidecarClient:
             target=self._read_loop, args=(sock,), daemon=True
         )
         self._reader.start()
+        if self.flow_cache:
+            # Opt back in BEFORE the conn replay so the restarted
+            # service grants replayed conns as they register (old
+            # grants were dropped at disconnect).
+            self._cache_enable()
         with self._session_lock:
             modules = dict(self._modules)
             conn_args = dict(self._conn_args)
@@ -1084,6 +1370,8 @@ class SidecarClient:
         status, epoch = wire.unpack_ack_epoch(got)
         if status == int(FilterResult.OK) and epoch >= 0:
             self.last_policy_epoch = epoch
+            if epoch > self._service_epoch:
+                self._service_epoch = epoch
         return status
 
     def policy_update(self, module_id: int, policies) -> int:
@@ -1099,6 +1387,8 @@ class SidecarClient:
         if status == int(FilterResult.OK):
             if epoch >= 0:
                 self.last_policy_epoch = epoch
+                if epoch > self._service_epoch:
+                    self._service_epoch = epoch
             with self._session_lock:
                 if module_id in self._modules:
                     self._modules[module_id]["policies"] = payload
@@ -1157,6 +1447,7 @@ class SidecarClient:
         with self._session_lock:
             self._conn_args.pop(conn_id, None)
             self._shims.pop(conn_id, None)
+        self._grant_drop(conn_id)
         try:
             self._send(wire.MSG_CLOSE, wire.pack_close(conn_id))
         except SidecarUnavailable:
@@ -1213,10 +1504,14 @@ class SidecarClient:
         except SidecarUnavailable:
             self._pending.pop(seq, None)
             self._shm_forget(seq)
+            self._round_settled(seq)
             raise
         if not evt.wait(self.timeout):
             self._pending.pop(seq, None)
             self._shm_forget(seq)
+            # A timed-out RPC will never deliver: local answers queued
+            # behind it must not wait forever.
+            self._round_settled(seq)
             raise TimeoutError("no verdict reply")
         vb = self._verdicts.pop(seq, None)
         if vb is None:
@@ -1226,22 +1521,60 @@ class SidecarClient:
         result = entries[-1][1] if entries else int(FilterResult.OK)
         return result, entries
 
+    def _send_round(self, msg_type: int, parts, seq: int,
+                    ids: np.ndarray) -> None:
+        """Send one async data round with its seq registered in
+        ``_rounds_out`` BEFORE any bytes move — the cache tier's
+        ordering gate must see the round in flight from the instant it
+        can be answered.  A failed send retires the seq (no verdict
+        will ever come to retire it)."""
+        with self._localq_lock:
+            self._rounds_out.add(seq)
+        try:
+            self._transport_send(msg_type, parts, seq=seq, conn_ids=ids)
+        except BaseException:
+            self._round_settled(seq)
+            raise
+
     def send_batch(self, seq: int, conn_ids, flags, lengths, blob: bytes) -> None:
         """Async batched mode (latency bench): fire a DATA batch; replies
-        arrive on verdict_callback."""
+        arrive on verdict_callback.  A batch whose every entry is
+        request-direction, frame-aligned, and cache-granted is answered
+        locally — nothing crosses the transport."""
         ids = np.ascontiguousarray(conn_ids, "<u8")
+        if self.flow_cache:
+            fl = np.asarray(flags, np.uint8)
+            lens = np.asarray(lengths, np.int64)
+            if not fl.any() and self._cache_try_local(
+                seq, ids, lens, lambda: self._blob_tail_ok(blob, lens),
+            ):
+                return
         parts = wire.pack_data_batch_parts(seq, ids, flags, lengths, blob)
-        self._transport_send(
-            wire.MSG_DATA_BATCH, parts, seq=seq, conn_ids=ids,
-        )
+        self._send_round(wire.MSG_DATA_BATCH, parts, seq, ids)
 
     def send_matrix(self, seq: int, width: int, conn_ids, lengths,
                     rows_bytes: bytes, complete: bool = False) -> None:
         """Fixed-width pre-padded batch (request direction): the service
         reshapes straight into the device layout.  ``complete=True``
         declares every row is exactly one whole frame (the edge owns
-        framing), letting the service skip its per-row content scan."""
+        framing), letting the service skip its per-row content scan.
+        A fully cache-granted, frame-aligned matrix is answered
+        locally — the rows never cross the transport."""
         ids = np.ascontiguousarray(conn_ids, "<u8")
+        if self.flow_cache and len(ids):
+            li = np.asarray(lengths, np.int64)
+
+            def _tail_ok(n=len(ids)):
+                # rows_end_crlf owns the width bound (a malformed
+                # length reads as a miss); a rows buffer inconsistent
+                # with (n, width) reads as a miss too.
+                if width < 1 or len(rows_bytes) != n * width:
+                    return np.zeros(n, bool)
+                rows = np.frombuffer(rows_bytes, np.uint8).reshape(n, width)
+                return rows_end_crlf(rows, li)
+
+            if self._cache_try_local(seq, ids, li, _tail_ok):
+                return
         # Scatter-gather parts (wire.py owns the layout): the rows
         # buffer (the bulk) goes into the ring slot (or one sendall)
         # without an intermediate join.
@@ -1249,21 +1582,25 @@ class SidecarClient:
             seq, width, ids, lengths, rows_bytes,
             wire.MAT_FLAG_COMPLETE if complete else 0,
         )
-        self._transport_send(
-            wire.MSG_DATA_MATRIX, parts, seq=seq, conn_ids=ids,
-        )
+        self._send_round(wire.MSG_DATA_MATRIX, parts, seq, ids)
 
     def send_blob(self, seq: int, conn_ids, lengths, blob: bytes) -> None:
         """Compact request-direction batch: exact payload bytes only
         (the service builds the device row view with an on-device
         gather).  Preferred over send_matrix when the device link is
-        bandwidth-limited — the wire and uplink carry no padding."""
+        bandwidth-limited — the wire and uplink carry no padding.
+        Fully cache-granted frame-aligned batches are answered locally
+        (see send_batch)."""
         ids = np.ascontiguousarray(conn_ids, "<u8")
+        if self.flow_cache and len(ids):
+            lens = np.asarray(lengths, np.int64)
+            if self._cache_try_local(
+                seq, ids, lens, lambda: self._blob_tail_ok(blob, lens),
+            ):
+                return
         # Scatter-gather parts (wire.py owns the layout — see
         # send_matrix): the blob rides into the slot without a join.
         parts = wire.pack_data_batch_parts(
             seq, ids, np.zeros(len(ids), np.uint8), lengths, blob
         )
-        self._transport_send(
-            wire.MSG_DATA_BATCH, parts, seq=seq, conn_ids=ids,
-        )
+        self._send_round(wire.MSG_DATA_BATCH, parts, seq, ids)

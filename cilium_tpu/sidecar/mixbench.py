@@ -372,3 +372,207 @@ class MixBench:
     def close(self) -> None:
         self.client.close()
         self.service.stop()
+
+
+class FlowCacheBench:
+    """Long-lived-flow traffic shape for the established-flow verdict
+    cache (PR 12): a pool of conns that each ship one whole frame per
+    round for the run's whole duration — the steady state the cache is
+    built for.  ``cacheable_frac`` of the pool carries identity 1,
+    admitted by a byte-FREE rule row (pure "allow these peers" —
+    invariant-allow, armed at registration); the rest carry identity 2,
+    admitted only by byte-constrained rows (no claim — every frame
+    needs the device).  Each round ships the two groups as separate
+    complete-flag matrix batches so the shim's whole-batch tier can
+    answer the cacheable group locally (bytes never cross the
+    transport) while the control group exercises the full device path.
+
+    Run cache-on vs cache-off (both knobs) over identical traffic: the
+    delta IS the cache, and ``bytes_pushed`` proves the shim-side
+    short-circuit at the byte level."""
+
+    def __init__(
+        self,
+        socket_path: str,
+        pool: int = 4096,
+        cacheable_frac: float = 0.8,
+        flow_cache: bool = True,
+        batch_flows: int = 8192,
+        verdict_device: str = "default",
+    ) -> None:
+        from cilium_tpu.proxylib import (
+            NetworkPolicy,
+            PortNetworkPolicy,
+            PortNetworkPolicyRule,
+        )
+
+        self.pool = pool
+        self.n_cacheable = int(pool * cacheable_frac)
+        self.n_control = pool - self.n_cacheable
+        policy = NetworkPolicy(
+            name="flowcache",
+            policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(
+                    port=80,
+                    rules=[
+                        # Byte-free row: identity 1 is allowed whatever
+                        # it sends — the invariant-allow class (pure
+                        # L3/L4 admission expressed as an L7 rule set).
+                        PortNetworkPolicyRule(
+                            remote_policies=[1], l7_proto="r2d2",
+                            l7_rules=[{}],
+                        ),
+                        # Byte-constrained rows: identity 2 must be
+                        # inspected per frame.
+                        PortNetworkPolicyRule(
+                            remote_policies=[2], l7_proto="r2d2",
+                            l7_rules=[
+                                {"cmd": "READ", "file": "/public/.*"},
+                                {"cmd": "HALT"},
+                            ],
+                        ),
+                    ],
+                )
+            ],
+        )
+        cfg = DaemonConfig(
+            batch_flows=batch_flows,
+            batch_timeout_ms=0.25,
+            batch_width=64,
+            verdict_device=verdict_device,
+            flow_cache=flow_cache,
+        )
+        self.flow_cache = flow_cache
+        self.service = VerdictService(socket_path, cfg).start()
+        self.client = SidecarClient(
+            socket_path, timeout=600.0, flow_cache=flow_cache
+        )
+        self.module = self.client.open_module([])
+        assert self.client.policy_update(self.module, [policy]) == int(
+            FilterResult.OK
+        )
+        for cid in range(1, pool + 1):
+            remote = 1 if cid <= self.n_cacheable else 2
+            res, _ = self.client.new_connection(
+                self.module, "r2d2", cid, True, remote, 2,
+                "1.1.1.1:1", "2.2.2.2:80", "flowcache",
+            )
+            assert res == int(FilterResult.OK), res
+        # One whole frame per conn per round, pre-padded (columnar
+        # round build like MixBench — the bench measures the seam).
+        rng = np.random.default_rng(12)
+        self.pool_rows = np.zeros((pool, 64), np.uint8)
+        self.pool_lens = np.zeros((pool,), np.uint32)
+        for i in range(pool):
+            if i < self.n_cacheable:
+                f = f"READ /lived/f{i % 997}.txt\r\n".encode()
+            elif rng.random() < 0.6:
+                f = f"READ /public/f{i % 997}.txt\r\n".encode()
+            else:
+                f = b"HALT\r\n"
+            self.pool_rows[i, : len(f)] = np.frombuffer(f, np.uint8)
+            self.pool_lens[i] = len(f)
+        self._a_ids = np.arange(
+            1, self.n_cacheable + 1, dtype=np.uint64
+        )
+        self._b_ids = np.arange(
+            self.n_cacheable + 1, pool + 1, dtype=np.uint64
+        )
+
+    def _send_round(self, seq: int) -> int:
+        a, b = self.n_cacheable, self.n_control
+        if a:
+            self.client.send_matrix(
+                seq, 64, self._a_ids, self.pool_lens[:a],
+                self.pool_rows[:a].tobytes(), complete=True,
+            )
+        if b:
+            self.client.send_matrix(
+                seq + 1, 64, self._b_ids, self.pool_lens[a:],
+                self.pool_rows[a:].tobytes(), complete=True,
+            )
+        return a + b
+
+    def run(self, duration_s: float = 8.0, warmup_rounds: int = 3) -> dict:
+        recv: dict[int, float] = {}
+        evt = threading.Event()
+
+        def on_verdict(vb):
+            recv[vb.seq] = time.perf_counter()
+            evt.set()
+
+        self.client.verdict_callback = on_verdict
+
+        def expected(s: int) -> tuple:
+            # Only the seqs _send_round actually ships: an all-cacheable
+            # (or all-control) pool sends one batch per round, and
+            # waiting on the phantom twin would wedge the whole run.
+            return tuple(
+                x for x, n in ((s, self.n_cacheable),
+                               (s + 1, self.n_control)) if n
+            )
+
+        seq = 1
+        for _ in range(warmup_rounds):
+            self._send_round(seq)
+            deadline = time.monotonic() + 600
+            while (
+                any(s not in recv for s in expected(seq))
+                and time.monotonic() < deadline
+            ):
+                evt.wait(1.0)
+                evt.clear()
+            assert all(s in recv for s in expected(seq)), \
+                "warmup round lost"
+            seq += 2
+        bytes0 = self.client.bytes_pushed
+        hits0 = self.client.cache_hits
+        t0 = time.perf_counter()
+        frames_total = 0
+        inflight: dict[int, int] = {}
+        last_progress = time.monotonic()
+        while time.perf_counter() - t0 < duration_s or inflight:
+            while (
+                len(inflight) < 2
+                and time.perf_counter() - t0 < duration_s
+            ):
+                nf = self._send_round(seq)
+                inflight[seq] = nf
+                seq += 2
+            done = [
+                s for s in inflight
+                if all(x in recv for x in expected(s))
+            ]
+            for s in done:
+                frames_total += inflight.pop(s)
+                last_progress = time.monotonic()
+            if not done:
+                evt.wait(0.05)
+                evt.clear()
+                if time.monotonic() - last_progress > 120:
+                    raise TimeoutError(
+                        f"flow_cache bench stalled: {sorted(inflight)}"
+                    )
+        elapsed = time.perf_counter() - t0
+        self.client.verdict_callback = None
+        shim_hits = self.client.cache_hits - hits0
+        svc = self.service.status().get("flow_cache") or {}
+        svc_hits = int(svc.get("hits", 0))
+        svc_miss = int(svc.get("misses", 0))
+        hits = shim_hits + svc_hits
+        return {
+            "verdicts_per_sec": frames_total / elapsed,
+            "frames": frames_total,
+            "elapsed_s": elapsed,
+            "hit_rate": hits / max(hits + svc_miss, 1),
+            "shim_hits": shim_hits,
+            "service_hits": svc_hits,
+            "bytes_pushed": self.client.bytes_pushed - bytes0,
+            "armed": int(svc.get("armed", 0)),
+            "invalidations": int(svc.get("invalidations", 0)),
+        }
+
+    def close(self) -> None:
+        self.client.close()
+        self.service.stop()

@@ -98,6 +98,42 @@ def gather_segments(src, starts, lens, out=None, dst_starts=None):
 
 # --- frame-boundary scanners ---------------------------------------------
 
+def segments_end_crlf(blob: np.ndarray, starts: np.ndarray,
+                      lengths: np.ndarray) -> np.ndarray:
+    """[n] bool — each segment is >= 2 bytes and its LAST two bytes are
+    CRLF.  The verdict cache's frame-alignment gate (service Phase-A
+    mask and the shim's pre-push check): a short-circuit must only ever
+    cover whole frames, so an epoch flip or disarm at ANY point leaves
+    the flow parseable from a frame boundary.  Like rows_end_crlf, the
+    blob bound is part of the gate: a malformed start/length must read
+    as a miss, never fancy-index past the blob."""
+    n = len(lengths)
+    if n == 0 or len(blob) < 2:
+        return np.zeros(n, bool)
+    li = np.asarray(lengths, np.int64)
+    st = np.asarray(starts, np.int64)
+    ok = (li >= 2) & (st >= 0) & (st + li <= len(blob))
+    ends = np.where(ok, st + li, 2)
+    return ok & (blob[ends - 2] == 13) & (blob[ends - 1] == 10)
+
+
+def rows_end_crlf(rows: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """[n] bool — each padded row's payload is >= 2 bytes, fits the row
+    width, and ends with CRLF.  The matrix-batch twin of
+    segments_end_crlf, and like it THE frame-alignment gate definition
+    for the verdict cache: the width bound is part of the gate (a
+    malformed length must read as a miss, never fancy-index past the
+    row)."""
+    n = len(lengths)
+    if n == 0 or rows.shape[1] < 2:
+        return np.zeros(n, bool)
+    li = np.asarray(lengths, np.int64)
+    ok = (li >= 2) & (li <= rows.shape[1])
+    le = np.where(ok, li, 2)
+    ar = np.arange(n)
+    return ok & (rows[ar, le - 2] == 13) & (rows[ar, le - 1] == 10)
+
+
 def scan_crlf(stream: np.ndarray, ends: np.ndarray):
     """All CRLF positions ``p`` (``stream[p]==13 and stream[p+1]==10``)
     that lie wholly inside one entry.  Entries are contiguous:

@@ -62,9 +62,23 @@ from ..utils.sockutil import shutdown_close
 from . import wire
 from .dispatch import BatchDispatcher
 from .guard import DeviceGuard
-from .reasm import FRAMING_CRLF, ByteArena, Reassembler, gather_segments
+from .reasm import (
+    FRAMING_CRLF,
+    ByteArena,
+    Reassembler,
+    gather_segments,
+    rows_end_crlf,
+    segments_end_crlf,
+)
 from .shm import GenerationMismatch, RingError
-from .trace import PATH_HOST, PATH_ORACLE, PATH_SHED, PATH_VEC, VerdictTracer
+from .trace import (
+    PATH_CACHED,
+    PATH_HOST,
+    PATH_ORACLE,
+    PATH_SHED,
+    PATH_VEC,
+    VerdictTracer,
+)
 from .transport import (
     CREDIT_FLAG_QUARANTINED,
     REASON_ATTACH_REJECTED,
@@ -168,9 +182,11 @@ class _TabSnap:
     engine=-1 / dirty=1 so they fail vec eligibility naturally."""
 
     __slots__ = ("ids", "engine", "src", "dirty", "objs", "single",
-                 "swap_s")
+                 "swap_s", "cache", "cache_epoch", "cache_rule", "epoch")
 
-    def __init__(self, ids, engine, src, dirty, objs, single=False):
+    def __init__(self, ids, engine, src, dirty, objs, single=False,
+                 cache=None, cache_epoch=None, cache_rule=None,
+                 epoch=0):
         self.ids = ids
         self.engine = engine
         self.src = src
@@ -182,6 +198,25 @@ class _TabSnap:
         # Time this snapshot's lock acquisition spent blocked behind an
         # epoch-swap pointer flip (the round books it as table_swap).
         self.swap_s = 0.0
+        # Verdict-cache columns for the round's conns (armed state /
+        # claim epoch / claimed rule row) plus the policy epoch
+        # captured under the SAME lock — a hit requires the claim epoch
+        # to equal this captured epoch, so a round snapshotted before a
+        # flip serves the flip-preceding epoch consistently (exactly
+        # the in-flight-round contract engine rounds already follow).
+        n = len(ids)
+        self.cache = (
+            cache if cache is not None else np.zeros(n, np.uint8)
+        )
+        self.cache_epoch = (
+            cache_epoch if cache_epoch is not None
+            else np.full(n, -1, np.int64)
+        )
+        self.cache_rule = (
+            cache_rule if cache_rule is not None
+            else np.full(n, -1, np.int32)
+        )
+        self.epoch = epoch
 
     def lookup(self, cids: np.ndarray) -> np.ndarray:
         """Positions of cids in the snapshot rows (every data-item conn
@@ -292,6 +327,21 @@ class VerdictService:
         # the epoch flip and the stale-conn catch-up so a later round
         # can never overtake an issued-not-finished columnar round.
         self._tab_async = np.empty(0, np.uint32)
+        # Established-flow verdict cache (policy/invariance.py): per-
+        # conn byte-invariance claims as parallel arrays so the hit
+        # check is one vectorized mask per round.  State: 0 unchecked,
+        # 1 armed (invariant-allow), 2 checked-no-claim.  A hit
+        # additionally requires the claim epoch to equal the round's
+        # snapshot epoch — the structural invalidation: every pointer
+        # flip retires all armed rows without touching them.
+        self._flow_cache_on = self.config.flow_cache
+        self._tab_cache = np.empty(0, np.uint8)
+        self._tab_cache_epoch = np.empty(0, np.int64)
+        self._tab_cache_rule = np.empty(0, np.int32)
+        self._cache_armed = 0  # armed rows (flow_cache_entries cap)
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_invalidations = 0
         self._engine_objs: list[object] = []
         self._engine_idx: dict[int, int] = {}  # id(engine) -> table idx
         self._engine_free: list[int] = []
@@ -345,6 +395,15 @@ class VerdictService:
         self._mesh_lock = threading.Lock()
         self._mesh_demoted: str | None = None
         self.mesh_demotions: dict[str, int] = {}
+        # Guarded re-promotion (ROADMAP 1b): demotion is no longer
+        # sticky-until-restart — a timed re-probe (mirroring the
+        # DeviceGuard quarantine heal, but on the policy-builder
+        # thread) rebuilds one sharded executable off-path,
+        # parity-probes it against the single-chip fallback, and flips
+        # the retained sharded wrappers back in one pointer pass.
+        self._mesh_reprobe_last = 0.0
+        self._mesh_reprobe_inflight = False
+        self.mesh_repromotions = 0
         self.vec_batches = 0
         self.vec_entries = 0
         # Completion pipeline: the dispatcher issues device calls without
@@ -598,6 +657,18 @@ class VerdictService:
                  "fallbacks": dict(self.reasm_fallbacks)}
                 if self._reasm is not None else None
             ),
+            # Established-flow verdict cache: armed rows + hit/miss/
+            # invalidation counters; None = disabled (flow_cache off —
+            # the true baseline).
+            "flow_cache": (
+                {
+                    "armed": self._cache_armed,
+                    "hits": self.cache_hits,
+                    "misses": self.cache_misses,
+                    "invalidations": self.cache_invalidations,
+                }
+                if self._flow_cache_on else None
+            ),
             # Degradation ladder: device -> quarantine -> host fallback
             # -> shed.  Every rung typed and counted.
             "containment": {
@@ -688,6 +759,14 @@ class VerdictService:
                     self._run_swap(job)
                 elif kind == "rebind":
                     self._run_rebind(*job)
+                elif kind == "grants":
+                    # Grant delivery queued off the dispatcher: the
+                    # blocking client.send must never run inside the
+                    # per-entry classification loop (revalidation in
+                    # _send_cache_grants makes late delivery safe).
+                    self._send_cache_grants(job)
+                elif kind == "mesh_reprobe":
+                    self._run_mesh_reprobe()
             except Exception:  # noqa: BLE001 — builder must survive
                 log.exception("policy builder job failed")
                 if kind == "swap":
@@ -763,6 +842,12 @@ class VerdictService:
             job.epoch = self.policy_epoch
             job.done.set()
             return
+        # Revoke shim-side cache grants BEFORE the flip: a shim that
+        # processed the revoke cannot short-circuit on the superseded
+        # epoch once the new one serves (the service-side epoch key is
+        # structural regardless; this closes the client half to the
+        # revoke's delivery lag).
+        self._send_cache_revokes(epoch)
         self._commit_epoch(ins, mods, job.staged_map, new_engines,
                            epoch)
         job.status = int(FilterResult.OK)
@@ -805,6 +890,21 @@ class VerdictService:
                 self._jit_gather.pop(mid, None)
                 self._jit_attr.pop(mid, None)
             async_pending = set(self._async_pending)
+            # Verdict-cache invalidation is the epoch key itself (a
+            # stale hit is structurally impossible once policy_epoch
+            # moves below); this sweep just retires the rows so the
+            # armed count and the invalidation counter stay truthful,
+            # and re-arms rebound conns against the NEW tables.
+            invalidated = 0
+            grants: list = []
+            if self._flow_cache_on and self._tab_size:
+                armed = self._tab_cache == 1
+                invalidated = int(armed.sum())
+                self._tab_cache[self._tab_cache != 0] = 0
+                self._tab_cache_epoch[:] = -1
+                self._tab_cache_rule[:] = -1
+                self._cache_armed = 0
+                self.cache_invalidations += invalidated
             rebinds = []
             for cid, sc in self._conns.items():
                 if sc.conn.instance is not ins:
@@ -852,6 +952,9 @@ class VerdictService:
                 )
                 sc.demoted_mod = None
                 self._tab_set_engine(cid, eng)
+                g = self._arm_flow_cache(cid, sc)
+                if g is not None:
+                    grants.append(g)
                 if (
                     eng is None
                     and engine_proto
@@ -868,6 +971,15 @@ class VerdictService:
             self._swap_window = (t0, t1)
         for job in rebinds:
             self._build_queue.put(("rebind", job))
+        if invalidated:
+            metrics.VerdictCacheInvalidations.inc(
+                "epoch-flip", amount=invalidated
+            )
+        if grants:
+            # Fresh grants under the NEW epoch (after the flip, so a
+            # shim can never receive a grant it must immediately treat
+            # as stale).
+            self._send_cache_grants(grants)
         hold = t1 - t0
         self.policy_swaps += 1
         self.last_swap_ms = round(hold * 1e3, 3)
@@ -948,6 +1060,7 @@ class VerdictService:
         drop."""
         with self._lock:
             sc = self._conns.get(conn_id)
+        grant = None
         try:
             if sc is not None and sc.engine is None:
                 self._bind_engine(module_id, sc)
@@ -956,9 +1069,12 @@ class VerdictService:
                         self._tab_set_engine(
                             conn_id, sc.engine if sc.fast_ok else None
                         )
+                        grant = self._arm_flow_cache(conn_id, sc)
         finally:
             with self._lock:
                 self._rebind_inflight.discard(conn_id)
+        if grant is not None:
+            self._send_cache_grants([grant])
 
     # Deterministic per-epoch parity probe: every valid command crossed
     # with distinctive files; remotes are drawn from the candidate
@@ -1016,13 +1132,18 @@ class VerdictService:
                 )
 
     def new_connection(self, module_id, conn_id, ingress, src_id, dst_id,
-                       proto, src_addr, dst_addr, policy_name, client) -> int:
+                       proto, src_addr, dst_addr, policy_name, client):
+        """Returns ``(result, grant_or_None)``.  The registration grant
+        is NOT sent here: the caller delivers it AFTER the
+        MSG_CONN_RESULT reply, so the shim's post-RPC stale-grant drop
+        (conn-id reuse) is socket-ordered before the fresh grant and
+        can never erase it."""
         res, conn = pl.on_new_connection(
             module_id, proto, conn_id, ingress, src_id, dst_id,
             src_addr, dst_addr, policy_name,
         )
         if res != FilterResult.OK:
-            return int(res)
+            return int(res), None
         sc = _SidecarConn(conn, client, None, module_id=module_id)
         self._bind_engine(module_id, sc)
         rebind = False
@@ -1052,6 +1173,10 @@ class VerdictService:
                 self._tab_src[conn_id] = conn.src_id
                 self._tab_dirty[conn_id] = 0
             self._tab_set_engine(conn_id, sc.engine if sc.fast_ok else None)
+            # Verdict cache: the byte-invariance claim is per-epoch
+            # static, so a flow arms AT REGISTRATION — pure-L3/L4 and
+            # allow-all tables never pay a single device round.
+            grant = self._arm_flow_cache(conn_id, sc)
         if rebind:
             self._build_queue.put(("rebind", (module_id, conn_id)))
         if self.flowlog is not None:
@@ -1062,7 +1187,7 @@ class VerdictService:
                 conn_id, policy_name, ingress, src_id, dst_id,
                 src_addr, dst_addr, proto, conn.port,
             )
-        return int(res)
+        return int(res), grant
 
     _TAB_MAX = 1 << 22  # conns with larger ids use the entrywise path
 
@@ -1079,6 +1204,9 @@ class VerdictService:
                 ("_tab_src", 0, np.int32),
                 ("_tab_dirty", 0, np.uint8),
                 ("_tab_async", 0, np.uint32),
+                ("_tab_cache", 0, np.uint8),
+                ("_tab_cache_epoch", -1, np.int64),
+                ("_tab_cache_rule", -1, np.int32),
             ):
                 arr = np.full(new_size, fill, dt)
                 arr[: self._tab_size] = getattr(self, name)
@@ -1166,6 +1294,166 @@ class VerdictService:
         with self._lock:
             if conn_id < self._tab_size:
                 self._tab_dirty[conn_id] = 1 if dirty else 0
+
+    # -- established-flow verdict cache (policy/invariance.py) -------------
+
+    def _arm_flow_cache(self, conn_id: int, sc: "_SidecarConn"):
+        """Compute/refresh this conn's byte-invariance claim from its
+        bound engine (caller holds ``_lock``; the conn table row is
+        ensured).  Arms only CRLF-framed engines — the cache tiers'
+        frame-alignment gate is the CRLF tail check, so a non-CRLF
+        protocol must never be armed even if its table is invariant —
+        and only on ALLOW claims (denied frames carry per-frame inject
+        side effects the short-circuit would skip).  Returns the
+        ``(client, grant_payload)`` to send OUTSIDE the lock, or
+        None."""
+        if not self._flow_cache_on or conn_id >= self._tab_size:
+            return None
+        engine = sc.engine
+        claim = None
+        epoch = self.policy_epoch
+        if engine is not None:
+            spec = getattr(engine, "reasm_spec", None)
+            if (
+                spec is not None
+                and spec() == FRAMING_CRLF
+                and hasattr(engine, "verdict_invariant")
+            ):
+                claim = engine.verdict_invariant(sc.conn.src_id)
+                epoch = getattr(engine, "epoch", 0)
+        was_armed = self._tab_cache[conn_id] == 1
+        if claim is not None and claim[0] and (
+            was_armed
+            or self._cache_armed < self.config.flow_cache_entries
+        ):
+            rule = int(claim[1])
+            if not was_armed:
+                self._cache_armed += 1
+            self._tab_cache[conn_id] = 1
+            self._tab_cache_epoch[conn_id] = epoch
+            self._tab_cache_rule[conn_id] = rule
+            client = sc.client
+            if client is not None and getattr(client, "cache_ok", False):
+                return client, conn_id, epoch, rule
+            return None
+        if was_armed:
+            self._cache_armed -= 1
+            self.cache_invalidations += 1
+            # Mirror the status counter: an armed row losing its claim
+            # on re-arm is an invalidation in both surfaces.
+            metrics.VerdictCacheInvalidations.inc("re-arm")
+        self._tab_cache[conn_id] = 2
+        self._tab_cache_epoch[conn_id] = epoch
+        self._tab_cache_rule[conn_id] = -1
+        return None
+
+    def _disarm_flow_cache(self, conn_id: int, reason: str | None) -> None:
+        """Drop one conn's cache row (caller holds ``_lock``): lane
+        transitions (quarantine demotion) and close.  The claim itself
+        stays table-valid — the rebind path re-arms from the fallback
+        engine once the conn's residue drains."""
+        if conn_id >= self._tab_size:
+            return
+        if self._tab_cache[conn_id] == 1:
+            self._cache_armed -= 1
+            self.cache_invalidations += 1
+            if reason is not None:
+                metrics.VerdictCacheInvalidations.inc(reason)
+        self._tab_cache[conn_id] = 0
+        self._tab_cache_epoch[conn_id] = -1
+        self._tab_cache_rule[conn_id] = -1
+
+    def _send_cache_grants(self, grants: list) -> None:
+        """Deliver collected (client, conn_id, epoch, rule) grants.
+        Each is revalidated against the LIVE conn row under ``_lock``
+        right before packing — a conn that closed or was re-registered
+        since collection must never receive the stale grant (a reused
+        conn id would inherit the old identity's allow at the shim) —
+        then sent outside the lock (a grant is advisory: a lost frame
+        only costs the shim its local short-circuit, never
+        correctness).  Callers hold no ``_lock``."""
+        live: list = []
+        with self._lock:
+            for client, conn_id, epoch, rule in grants:
+                sc = self._conns.get(conn_id)
+                if (
+                    sc is not None
+                    and sc.client is client
+                    and conn_id < self._tab_size
+                    and self._tab_cache[conn_id] == 1
+                    and self._tab_cache_epoch[conn_id] == epoch
+                    and self._tab_cache_rule[conn_id] == rule
+                ):
+                    live.append(
+                        (client,
+                         wire.pack_cache_grant(conn_id, epoch, rule))
+                    )
+        for client, payload in live:
+            try:
+                client.send(wire.MSG_CACHE_GRANT, payload)
+            except Exception:  # noqa: BLE001 — client may be gone
+                log.exception("cache grant send failed")
+
+    def _send_cache_revokes(self, epoch: int) -> None:
+        """Pre-flip revocation: tell every opted-in shim the NEW epoch
+        so grants under older epochs die at the client BEFORE the
+        pointer flip commits.  Sent from the builder thread (bounded by
+        the handlers' SO_SNDTIMEO); the service-side epoch key stays
+        the structural guarantee regardless."""
+        if not self._flow_cache_on:
+            return
+        with self._lock:
+            clients = [
+                c for c in self._clients if getattr(c, "cache_ok", False)
+            ]
+        payload = wire.pack_cache_revoke(epoch)
+        for client in clients:
+            try:
+                client.send(wire.MSG_CACHE_REVOKE, payload)
+            except Exception:  # noqa: BLE001 — client may be gone
+                log.exception("cache revoke send failed")
+
+    def _record_cached_entries(self, hits: list) -> None:
+        """Cached-path flow records for scalar-tier hits: per-entry
+        (rule, kind, epoch) resolved against the engine CAPTURED at hit
+        time (slot-reuse-safe), one columnar add_round for the round."""
+        if self.flowlog is None or not hits:
+            return
+        n = len(hits)
+        conn_ids = np.fromiter(
+            (h[2] for h in hits), np.int64, count=n
+        )
+        rules = np.fromiter((h[3] for h in hits), np.int32, count=n)
+        kinds = [
+            self._kind_for(getattr(h[4], "model", None), h[3])
+            for h in hits
+        ]
+        epochs = np.fromiter(
+            (getattr(h[4], "epoch", 0) for h in hits), np.int64,
+            count=n,
+        )
+        self.flowlog.add_round(
+            PATH_CACHED,
+            conn_ids,
+            np.full(n, CODE_FORWARDED, np.int8),
+            rules,
+            cols={"match_kind": kinds, "epoch": epochs},
+        )
+
+    def _record_cached_round(self, conn_ids, rules, kinds, epoch) -> None:
+        """Flow records for one cached group: path ``cached``, the
+        ORIGINAL attributed rule rows, the claim epoch — one columnar
+        add_round, never per entry."""
+        if self.flowlog is None or not len(conn_ids):
+            return
+        self.flowlog.add_round(
+            PATH_CACHED,
+            np.asarray(conn_ids, np.int64),
+            np.full(len(conn_ids), CODE_FORWARDED, np.int8),
+            np.asarray(rules, np.int32),
+            kinds=kinds,
+            epoch=epoch,
+        )
 
     def _bind_engine(self, module_id: int, sc: _SidecarConn) -> None:
         """Attach the device batch engine for this connection's
@@ -1285,6 +1573,11 @@ class VerdictService:
             max_buffer=self.config.max_flow_buffer,
             attr_enabled=self._flow_observe,
         )
+        # Verdict-cache judge tier (flow_cache): byte-invariant
+        # identities are answered host-side from the claim instead of
+        # riding the device batch (cassandra/memcached make no claim,
+        # so the flag is inert there).
+        eng.cache_enabled = self._flow_cache_on
         # Containment hooks: the judge step is skipped while the device
         # is quarantined (host policy.matches fallback, bit-identical),
         # and judge crashes count toward the poisoned-engine threshold.
@@ -1323,6 +1616,7 @@ class VerdictService:
             if conn_id < self._tab_size:
                 self._tab_engine[conn_id] = -1
                 self._tab_dirty[conn_id] = 0
+            self._disarm_flow_cache(conn_id, "close")
         if sc.engine is not None:
             sc.engine.close_flow(conn_id)
         if self._reasm is not None:
@@ -1721,10 +2015,14 @@ class VerdictService:
         return int(sc.conn.last_rule_id), ""
 
     def _record_entrywise(self, path: str, items: list, responses: dict,
-                          rules_out: dict | None) -> None:
+                          rules_out: dict | None,
+                          cached: set | None = None) -> None:
         """One flow-record batch for an entrywise round: the hot loop
         builds plain lists; the ring lock is taken ONCE in add_round
-        (R7: per-round, never per-entry-under-the-lock)."""
+        (R7: per-round, never per-entry-under-the-lock).  ``cached``
+        holds (item_id, entry_idx) keys already recorded on the
+        `cached` path at decision time — skipped here so a hit is
+        never double-recorded under the wrong path label."""
         if self.flowlog is None:
             return
         # Plain reference: per-key dict reads are GIL-atomic, and a conn
@@ -1744,6 +2042,8 @@ class VerdictService:
                 r = resp[i]
                 if r is None:
                     continue
+                if cached is not None and (id(item), i) in cached:
+                    continue  # recorded on the `cached` path already
                 conn_id, result, ops = r[0], r[1], r[2]
                 code = self._entry_code(result, ops)
                 if code is None:
@@ -1998,6 +2298,7 @@ class VerdictService:
         deadline or queue age passed while queued, pace quarantine
         re-probes, and sample queue-depth telemetry."""
         self.guard.maybe_reprobe(self._device_probe)
+        self._maybe_mesh_reprobe()
         metrics.SidecarQueueDepth.set(float(self.dispatcher.pending_weight))
         now = time.monotonic()
         kept = []
@@ -2048,6 +2349,10 @@ class VerdictService:
             if conn_id < self._tab_size:
                 self._tab_engine[conn_id] = -1
                 self._tab_dirty[conn_id] = 1
+            # The claim stays table-valid, but this conn now carries
+            # migrated residue the cache's clean-flow gate must see;
+            # the heal rebind re-arms from the (fallback) engine.
+            self._disarm_flow_cache(conn_id, "demote")
 
     def _maybe_rebind(self, conn_id: int, sc: "_SidecarConn") -> None:
         """Un-demote after the device heals: once the oracle residue
@@ -2066,6 +2371,7 @@ class VerdictService:
             return
         mod = sc.demoted_mod
         key = self._engine_key_for(mod, sc.conn)
+        grant = None
         with self._lock:
             eng = self._engines.get(key)
             if eng is not None:
@@ -2075,12 +2381,19 @@ class VerdictService:
                 self._tab_set_engine(
                     conn_id, eng if sc.fast_ok else None
                 )
-                return
-            if conn_id in self._rebind_inflight:
-                return
-            self._rebind_inflight.add(conn_id)
-            sc.demoted_mod = None
-        self._build_queue.put(("rebind", (mod, conn_id)))
+                # Quarantine healed: re-arm the invariance claim from
+                # the rebound engine (the demotion disarmed it).
+                grant = self._arm_flow_cache(conn_id, sc)
+            elif conn_id not in self._rebind_inflight:
+                self._rebind_inflight.add(conn_id)
+                sc.demoted_mod = None
+                eng = False  # sentinel: queue the off-path rebuild
+        if eng is False:
+            self._build_queue.put(("rebind", (mod, conn_id)))
+        elif grant is not None:
+            # Dispatch path: hand the (blocking) grant send to the
+            # builder thread — advisory delivery, revalidated there.
+            self._build_queue.put(("grants", [grant]))
 
     def _process(self, items: list) -> None:
         """Dispatcher entry: triage aggregated items.
@@ -2104,6 +2417,36 @@ class VerdictService:
         # paths and renders through the host fallback (entrywise) —
         # bounded-latency degradation, never a hang.
         quarantined = self.guard.quarantined
+        # Established-flow verdict cache, whole-item tier: items whose
+        # EVERY entry hits (armed conn, matching epoch, clean, frame-
+        # aligned) are answered straight from the claim — no device
+        # round, no engine state, bytes already at the service but the
+        # (flows, rules) round never happens.  Offered BEFORE the
+        # mat-group fast path so the greedy whole-round shape (the
+        # hottest serving lane) also short-circuits; mixed items fall
+        # through to the columnar Phase-A per-entry mask.
+        # The _cache_armed read is racy-by-design: 0 skips the tier's
+        # snapshot + per-item masks entirely (cache-on but nothing
+        # armed must not tax the greedy fast path below, which runs
+        # snapshot-free), and a conn arming concurrently just waits
+        # one round for its first short-circuit.
+        snap = None
+        if (
+            self._flow_cache_on
+            and not quarantined
+            and data_items
+            and self._cache_armed > 0
+        ):
+            snap = self._tab_snapshot(data_items)
+            if snap is not None:
+                data_items = self._serve_cached_items(
+                    data_items, snap, t_pop
+                )
+                if not data_items:
+                    for close_args in closes:
+                        self.close_connection(*close_args)
+                    self._round_record_ok()
+                    return
         # Whole-round fast path (greedy mode): every data item a
         # complete-flag matrix batch of the configured width — one
         # grouped eligibility/dispatch/readback/response pass.
@@ -2119,6 +2462,13 @@ class VerdictService:
             )
             and self._run_mat_group(data_items, t_pop)
         ):
+            # Misses by definition: offered to the cache tier above
+            # and not served (or the tier skipped with zero armed
+            # rows — same thing).  No-op counter when the cache is
+            # off.
+            self._count_cache_misses(
+                sum(it[2].count for it in data_items)
+            )
             for close_args in closes:
                 self.close_connection(*close_args)
             self._round_record_ok()
@@ -2128,7 +2478,8 @@ class VerdictService:
         # dispatcher thread while policy_update/new_connection mutate
         # the tables (including _engine_objs slot reuse), so every read
         # in this round must come from one consistent view.
-        snap = self._tab_snapshot(data_items)
+        if snap is None:
+            snap = self._tab_snapshot(data_items)
         vec: list[tuple] = []  # (item, engine) — item kind "data" or "mat"
         general: list = []  # (arrival_idx, item)
         for k, it in enumerate(data_items):
@@ -2232,8 +2583,10 @@ class VerdictService:
                 ).astype(np.int64)
             )
         t_before = time.monotonic()
+        want_cache = self._flow_cache_on
         with self._lock:
             swap_s = self._swap_overlap(t_before)
+            epoch = self.policy_epoch
             if self._tab_size == 0:
                 snap = _TabSnap(
                     ids,
@@ -2242,6 +2595,7 @@ class VerdictService:
                     np.ones(len(ids), np.uint8),
                     (),
                     single,
+                    epoch=epoch,
                 )
                 snap.swap_s = swap_s
                 return snap
@@ -2249,8 +2603,8 @@ class VerdictService:
             if objs is None:
                 objs = self._objs_cache = tuple(self._engine_objs)
             if len(ids) and int(ids[-1]) < self._tab_size:
-                # All in range (ids sorted): three plain gathers — the
-                # fancy index copies, which IS the snapshot.
+                # All in range (ids sorted): plain gathers — the fancy
+                # index copies, which IS the snapshot.
                 snap = _TabSnap(
                     ids,
                     self._tab_engine[ids],
@@ -2258,6 +2612,18 @@ class VerdictService:
                     self._tab_dirty[ids],
                     objs,
                     single,
+                    cache=(
+                        self._tab_cache[ids] if want_cache else None
+                    ),
+                    cache_epoch=(
+                        self._tab_cache_epoch[ids] if want_cache
+                        else None
+                    ),
+                    cache_rule=(
+                        self._tab_cache_rule[ids] if want_cache
+                        else None
+                    ),
+                    epoch=epoch,
                 )
                 snap.swap_s = swap_s
                 return snap
@@ -2270,7 +2636,20 @@ class VerdictService:
             dirty = np.where(
                 in_range, self._tab_dirty[clipped], 1
             ).astype(np.uint8)
-        snap = _TabSnap(ids, engine, src, dirty, objs, single)
+            cache = cache_epoch = cache_rule = None
+            if want_cache:
+                cache = np.where(
+                    in_range, self._tab_cache[clipped], 0
+                ).astype(np.uint8)
+                cache_epoch = np.where(
+                    in_range, self._tab_cache_epoch[clipped], -1
+                ).astype(np.int64)
+                cache_rule = np.where(
+                    in_range, self._tab_cache_rule[clipped], -1
+                ).astype(np.int32)
+        snap = _TabSnap(ids, engine, src, dirty, objs, single,
+                        cache=cache, cache_epoch=cache_epoch,
+                        cache_rule=cache_rule, epoch=epoch)
         snap.swap_s = swap_s
         return snap
 
@@ -2392,22 +2771,22 @@ class VerdictService:
         thread-CPU under multi-thread contention on a small host.)"""
         key = self._model_shape_key(model) if arg_fn is not None else None
         if key is not None:
-            fn = cache.get(key)
+            fn = cache.get(key)  # lint: disable=R13 -- shape-keyed executable cache: keys are TABLE SHAPES, not table contents, so entries are epoch-independent by construction and deliberately survive swaps (the churn executable cache)
             if fn is None:
                 import jax
 
                 self._evict_shape_entries(cache)
                 # lint: disable=R12 -- cache-miss only: every serving shape is prewarmed off-path at engine build/swap; a miss here is the documented lazy greedy-mode gather compile (local, cheap)
                 fn = jax.jit(arg_fn)
-                cache[key] = fn
+                cache[key] = fn  # lint: disable=R13 -- shape-keyed by design (see the read above): same-bucketed churn MUST hit this entry across epochs
             return functools.partial(fn, model.dispatch_bare())
-        ent = cache.get(id(model))
+        ent = cache.get(id(model))  # lint: disable=R13 -- id-keyed entries die WITH their model: _commit_epoch pops them at the pointer flip, so no entry can outlive its epoch
         if ent is None:
             import jax
 
             # lint: disable=R12 -- cache-miss only: prewarm traces every bucket shape at engine build (builder/reader thread); dispatch rounds only ever hit this dict
             ent = (model, jax.jit(trace_fn))
-            cache[id(model)] = ent
+            cache[id(model)] = ent  # lint: disable=R13 -- id-keyed: popped by _commit_epoch at the flip (see the read above)
         return ent[1]
 
     # Distinct table-shape signatures a shape-keyed cache may hold
@@ -2494,19 +2873,33 @@ class VerdictService:
         """PR 2 ladder, mesh rung: a lost/erroring mesh device demotes
         the whole service to the single-chip executables — one pointer
         pass under _lock, typed (mesh_demotions_total{reason}) and
-        counted, never a wedged round.  Sticky until restart: the
-        quarantine/heal ladder below this rung re-probes SINGLE-device
-        health, and resuming collectives against a device that already
-        failed once is not a risk the dispatch path takes."""
+        counted, never a wedged round.  The dispatch path never
+        resumes collectives on its own: re-promotion happens only
+        through the timed OFF-PATH re-probe (_run_mesh_reprobe) after
+        a fresh sharded executable proves bit-identical to the
+        fallback; until then every dispatch serves single-chip.  With
+        mesh_reprobe_interval_s = 0 the pre-PR-12 sticky-until-restart
+        behavior holds."""
         with self._lock:
             if self._mesh_demoted is not None:
                 return
             self._mesh_demoted = reason
+            # Pace the first re-probe one full interval after the
+            # demotion (a device that just failed rarely heals
+            # instantly).
+            self._mesh_reprobe_last = time.monotonic()
             swapped = 0
             for eng in self._engines.values():
                 m = getattr(eng, "model", None)
                 fb = getattr(m, "fallback", None)
                 if fb is not None:
+                    # Retain the sharded wrapper for re-promotion: its
+                    # tables are host-rebuildable state, and a flip
+                    # back after a successful probe is one pointer
+                    # pass.  If the devices are still bad, the next
+                    # sharded dispatch demotes again, typed — never a
+                    # crashed round.
+                    eng._mesh_model = m
                     eng.model = fb
                     # Sharded models are shape-keyed (dispatch_bare),
                     # so no per-id cache entry exists to drop; the
@@ -2523,6 +2916,151 @@ class VerdictService:
             "mesh serving demoted to single-chip executables (%s): "
             "%d engine(s) flipped", reason, swapped,
         )
+
+    def _maybe_mesh_reprobe(self) -> None:
+        """Traffic-driven re-promotion pacing (called once per dispatch
+        round, like guard.maybe_reprobe): while demoted, queue at most
+        one off-path mesh re-probe per mesh_reprobe_interval_s onto the
+        policy-builder thread.  0 disables (sticky demotion)."""
+        interval = self.config.mesh_reprobe_interval_s
+        if self._mesh_demoted is None or not interval:
+            return
+        if self.guard.quarantined:
+            # Never queue a compile+dispatch against a quarantined
+            # device: a HUNG device (the case quarantine exists for)
+            # would wedge the builder thread — and with it every
+            # future swap/rebind — behind the probe.  The pacing
+            # clock retries after the guard's own re-probe heals.
+            return
+        now = time.monotonic()
+        with self._lock:
+            if self._mesh_reprobe_inflight:
+                return
+            if now - self._mesh_reprobe_last < interval:
+                return
+            self._mesh_reprobe_inflight = True
+            self._mesh_reprobe_last = now
+        self._build_queue.put(("mesh_reprobe", None))
+
+    # Probe rows for the re-promotion parity check: a remote-gated
+    # literal row, a regex row, and an always-match row — enough to
+    # exercise the stacked tables, the cross-shard attribution reduce,
+    # and the padding rows of an unbalanced split.
+    _MESH_PROBE_ROWS = (
+        (frozenset({7}), "READ", "/public/.*"),
+        (frozenset(), "HALT", ""),
+        (frozenset({9}), "", ""),
+    )
+
+    def _run_mesh_reprobe(self) -> None:
+        """Builder-thread half of the mesh heal: rebuild ONE sharded
+        executable from scratch against the live mesh, run it beside
+        its single-chip twin over a probe batch, and require
+        bit-identical (allow, rule) output.  Success re-promotes: every
+        engine's retained sharded wrapper flips back in one pointer
+        pass under _lock (typed, counted); engines built DURING the
+        demotion stay single-chip until the next epoch swap rebuilds
+        them.  Failure leaves the demotion in place and the pacing
+        clock owns the retry."""
+        try:
+            with self._lock:
+                if self._mesh_demoted is None:
+                    return
+            # Re-checked on the builder thread: quarantine may have
+            # latched between queueing and execution (same hung-device
+            # hazard _maybe_mesh_reprobe gates against).
+            if self.guard.quarantined:
+                return
+            mesh = self._mesh
+            if mesh is None:
+                return
+            from ..parallel.mesh import RULE_AXIS
+            from ..parallel.rulesharding import (
+                ShardedVerdictModel,
+                build_sharded_r2d2_from_rows,
+                shard_offsets,
+            )
+            from ..models.r2d2 import build_r2d2_model_from_rows
+
+            rows = list(self._MESH_PROBE_ROWS)
+            n_shards = mesh.shape[RULE_AXIS]
+            with self._device_ctx():
+                probe = ShardedVerdictModel(
+                    build_sharded_r2d2_from_rows(
+                        rows, n_shards, bucket=True
+                    ),
+                    shard_offsets(len(rows), n_shards),
+                    mesh, "r2d2",
+                    fallback=build_r2d2_model_from_rows(
+                        rows, bucket=True
+                    ),
+                )
+            b = self.MIN_BUCKET_GREEDY
+            width = self.config.batch_width
+            data = np.zeros((b, width), np.uint8)
+            lens = np.zeros(b, np.int32)
+            rems = np.zeros(b, np.int32)
+            cases = [
+                (b"READ /public/app\r\n", 7),
+                (b"READ /public/app\r\n", 8),
+                (b"HALT\r\n", 3),
+                (b"WRITE /x\r\n", 9),
+                (b"RESET\r\n", 9),
+            ]
+            for i, (frame, rem) in enumerate(cases):
+                row = np.frombuffer(frame, np.uint8)
+                data[i, : len(row)] = row
+                lens[i] = len(row)
+                rems[i] = rem
+            fb = probe.fallback
+            with self._device_ctx():
+                _, _, a_s, r_s = probe.verdicts_attr(data, lens, rems)
+                _, _, a_f, r_f = fb.verdicts_attr(data, lens, rems)
+            if not (
+                np.array_equal(np.asarray(a_s), np.asarray(a_f))
+                and np.array_equal(np.asarray(r_s), np.asarray(r_f))
+            ):
+                log.warning(
+                    "mesh re-probe parity mismatch; demotion holds"
+                )
+                return
+            # Probe one RETAINED wrapper too: its device buffers must
+            # still answer (a restarted device may have dropped them —
+            # then the flip-back would only re-demote, typed, so this
+            # probe keeps that churn off the dispatch path).
+            with self._lock:
+                retained = [
+                    getattr(e, "_mesh_model", None)
+                    for e in self._engines.values()
+                ]
+            retained = [m for m in retained if m is not None]
+            if retained:
+                with self._device_ctx():
+                    out = retained[0](data, lens, rems)
+                    np.asarray(out[-1])
+            promoted = 0
+            with self._lock:
+                if self._mesh_demoted is None:
+                    return  # raced a concurrent heal
+                for eng in self._engines.values():
+                    mm = getattr(eng, "_mesh_model", None)
+                    if mm is not None:
+                        eng.model = mm
+                        eng._mesh_model = None
+                        promoted += 1
+                self._mesh_demoted = None
+            self.mesh_repromotions += 1
+            metrics.MeshRepromotions.inc()
+            metrics.MeshActive.set(1.0)
+            log.info(
+                "mesh serving re-promoted after off-path parity probe "
+                "(%d engine(s) flipped back)", promoted,
+            )
+        except Exception:  # noqa: BLE001 — demotion holds, retry paced
+            log.exception("mesh re-probe failed; demotion holds")
+        finally:
+            with self._lock:
+                self._mesh_reprobe_inflight = False
 
     def _mesh_guarded(self, model, call):
         """Issue one device dispatch; when a SHARDED dispatch raises
@@ -2555,6 +3093,7 @@ class VerdictService:
             "active": self._mesh_demoted is None,
             "demoted": self._mesh_demoted,
             "demotions": dict(self.mesh_demotions),
+            "repromotions": self.mesh_repromotions,
         }
 
     def _model_call(self, model, data, lens, remotes, use_jit=None):
@@ -2751,10 +3290,160 @@ class VerdictService:
                 np.asarray(allow)
         self._mark_shape_prewarmed(model)
 
+    def _cache_item_hits(self, it, snap: "_TabSnap"):
+        """Per-entry verdict-cache hit mask for one data/mat item, or
+        None when nothing hits.  A hit requires: armed row, claim epoch
+        == the snapshot's policy epoch (the structural invalidation),
+        no residual state (clean dirty bit), request direction, and a
+        frame-aligned payload (ends with CRLF) so an invalidation at
+        any later point leaves the flow parseable from a boundary."""
+        kind, _client, b = it
+        n = b.count
+        if n == 0:
+            return None
+        pos = snap.lookup(b.conn_ids)
+        hit = (
+            (snap.cache[pos] == 1)
+            & (snap.cache_epoch[pos] == snap.epoch)
+            & (snap.dirty[pos] == 0)
+        )
+        if not hit.any():
+            return None
+        if kind == "mat":
+            hit &= rows_end_crlf(b.rows, b.lengths)
+        else:
+            hit &= b.flags == 0
+            blob = np.frombuffer(b.blob, np.uint8)
+            lengths = b.lengths.astype(np.int64)
+            if len(blob) != int(lengths.sum()):
+                return None
+            hit &= segments_end_crlf(
+                blob, b.offsets[:-1].astype(np.int64), lengths
+            )
+        return hit if hit.any() else None
+
+    def _count_cache_hits(self, n: int) -> None:
+        self.cache_hits += n
+        metrics.VerdictCacheHits.inc("service", amount=n)
+
+    def _count_cache_misses(self, n: int) -> None:
+        if self._flow_cache_on and n:
+            self.cache_misses += n
+            metrics.VerdictCacheMisses.inc(amount=n)
+
+    def _flowlog_cached(self, snap: "_TabSnap", conn_ids: np.ndarray,
+                        pos: np.ndarray) -> None:
+        """Cached-path flow records for one hit group, one add_round
+        per engine (the kinds legend the claimed rule rows index)."""
+        if self.flowlog is None or not len(conn_ids):
+            return
+        eng_idx = snap.engine[pos]
+        rules = snap.cache_rule[pos]
+        for e in np.unique(eng_idx):
+            selm = eng_idx == e
+            engine = snap.objs[int(e)] if e >= 0 else None
+            self._record_cached_round(
+                conn_ids[selm].astype(np.int64),
+                rules[selm],
+                getattr(getattr(engine, "model", None),
+                        "match_kinds", ()),
+                snap.epoch,
+            )
+
+    def _serve_cached_items(self, items: list, snap: "_TabSnap",
+                            t_pop: float) -> list:
+        """Whole-item tier of the verdict cache: answer every item
+        whose entries ALL hit with one `_verdict_body`-shaped all-allow
+        frame (bit-identical to a recomputed all-allow vec round) and
+        return the rest for the normal paths.  Per-conn FIFO holds: an
+        item sharing a conn with a non-cached item in this round keeps
+        the normal path, and pipelined-mode sends ride the completion
+        FIFO so they can never overtake an in-flight earlier round."""
+        t_c0 = time.monotonic()
+        masks = [self._cache_item_hits(it, snap) for it in items]
+        full = [m is not None and bool(m.all()) for m in masks]
+        if not any(full):
+            return items
+        rest_items = [it for it, f in zip(items, full) if not f]
+        rest_conns = None
+        if rest_items:
+            rest_conns = np.unique(np.concatenate(
+                [it[2].conn_ids for it in rest_items]
+            ))
+        kept: list = []
+        served: list = []
+        for it, f in zip(items, full):
+            if f and (
+                rest_conns is None
+                or not np.isin(it[2].conn_ids, rest_conns).any()
+            ):
+                served.append(it)
+            else:
+                kept.append(it)
+        if not served:
+            return items
+        cache_s = time.monotonic() - t_c0
+        swap_s = snap.swap_s
+        snap.swap_s = 0.0
+        for it in served:
+            _kind, client, b = it
+            n = b.count
+            rt = self.tracer.begin_round(
+                PATH_CACHED, n, self._oldest_arrival([it]), t_pop,
+                ring_s=self._ring_wait([it]), swap_s=swap_s,
+            )
+            swap_s = 0.0
+            rt.cache_s = cache_s
+            cache_s = 0.0  # the mask cost books on the first round only
+            rt.formed()
+            rt.submitted()
+            rt.completed()
+            try:
+                frame = self._verdict_frame(
+                    b.seq, b.conn_ids, b.lengths,
+                    np.ones(n, bool),
+                )
+            except Exception:  # noqa: BLE001 — fail closed, typed
+                log.exception("cached verdict frame build failed")
+                try:
+                    if client.send_verdicts(
+                        b.seq,
+                        self._typed_entries(
+                            b, FilterResult.UNKNOWN_ERROR
+                        ),
+                        batch=b,
+                    ):
+                        self.error_entries += n
+                except Exception:  # noqa: BLE001
+                    log.exception("typed error send failed")
+                continue
+            rt.drained()
+            rtd = (rt, [self._batch_desc(b)])
+            if self._inline_complete:
+                try:
+                    client.send(wire.MSG_VERDICT_BATCH, frame,
+                                batches=[b])
+                except Exception:  # noqa: BLE001 — client may be gone
+                    log.exception("cached verdict send failed")
+                if not self._round_thread_suppressed():
+                    self.tracer.finish_round(rt, [self._batch_desc(b)])
+            else:
+                self._completion_put(("frame", client, frame, b, rtd))
+            if not self._round_thread_suppressed():
+                self._count_cache_hits(n)
+                self._flowlog_cached(
+                    snap, b.conn_ids.astype(np.int64),
+                    snap.lookup(b.conn_ids),
+                )
+        return kept
+
     def _run_vec(self, vec_items: list, snap: "_TabSnap",
                  t_pop: float) -> None:
         """One device call per engine chunk over the concatenated
         batches, ops emitted columnar straight from the verdict arrays."""
+        self._count_cache_misses(
+            sum(it[2].count for it, _ in vec_items)
+        )
         groups: dict[int, list] = {}
         for it, eng in vec_items:
             groups.setdefault(id(eng), []).append((it, eng))
@@ -3244,6 +3933,20 @@ class VerdictService:
                         if rtd is not None and not deposed:
                             rt, descs = rtd
                             self.tracer.finish_round(rt, descs)
+                    elif r[0] == "frame":
+                        # Verdict-cache whole-item round: the frame was
+                        # prebuilt at decision time; it rides this FIFO
+                        # so a cached answer can never overtake an
+                        # earlier in-flight round's verdicts for the
+                        # same conn.
+                        _, client, frame, batch, rtd = r
+                        client.send(
+                            wire.MSG_VERDICT_BATCH, frame,
+                            batches=[batch],
+                        )
+                        if rtd is not None and not deposed:
+                            rt, descs = rtd
+                            self.tracer.finish_round(rt, descs)
                 except Exception:  # noqa: BLE001 — worker must survive
                     log.exception("completion failed")
                 finally:
@@ -3317,11 +4020,22 @@ class VerdictService:
                 conn_id, eng if sc.fast_ok else None
             )
             self._stale_conns.discard(conn_id)
+            # Caught up to the current epoch: refresh the invariance
+            # claim against the adopted engine's table.
+            grant = self._arm_flow_cache(conn_id, sc)
+        if grant is not None:
+            # Dispatch path (per-entry classifier): never send inline
+            # — after a swap EVERY stale conn funnels through here in
+            # one round, and a blocked shim socket would serialize
+            # thousands of sends on the dispatcher.  The builder
+            # thread delivers; revalidation makes late delivery safe.
+            self._build_queue.put(("grants", [grant]))
 
     def _classify_entry(self, item, i: int, conns_snapshot: dict,
                         quarantined: bool, responses: dict,
                         fast: list, slow: list,
-                        slow_conns: set) -> None:
+                        slow_conns: set, cache_hits: list | None = None,
+                        ) -> None:
         """Route ONE entry onto the fast/slow/oracle lanes — THE shared
         per-entry classifier of the scalar entrywise path, also used by
         the columnar round for its residual (non-columnar) minority so
@@ -3370,6 +4084,39 @@ class VerdictService:
         eng_flow = (
             sc.engine.flows.get(conn_id) if sc.engine is not None else None
         )
+        # Verdict-cache hit, scalar tier (the greedy-mode and minority-
+        # entry twin of the columnar Phase-A mask): armed conn, claim
+        # epoch current, no residue anywhere, frame-aligned payload.
+        # The cache arrays are read lock-free like conns_snapshot —
+        # bounded round-grain staleness; a stale read only costs a
+        # miss (arming is monotone within an epoch, and disarms flip
+        # the state before any residue can exist).
+        if (
+            cache_hits is not None
+            and not reply
+            and not end_stream
+            and conn_id not in slow_conns
+            and len(data) >= 2
+            and data.endswith(b"\r\n")
+            and not sc.bufs[False]
+            and conn_id < self._tab_size
+            and self._tab_cache[conn_id] == 1
+            and self._tab_cache_epoch[conn_id] == self.policy_epoch
+            and (
+                eng_flow is None
+                or not (
+                    getattr(eng_flow, "buffer", None)
+                    or getattr(eng_flow, "overflowed", False)
+                )
+            )
+        ):
+            rule = int(self._tab_cache_rule[conn_id])
+            responses[key][i] = (
+                conn_id, int(FilterResult.OK),
+                [(int(PASS), len(data)), (int(MORE), 1)], b"", b"",
+            )
+            cache_hits.append((key, i, conn_id, rule, sc.engine))
+            return
         if (
             sc.fast_ok
             and not reply
@@ -3422,6 +4169,7 @@ class VerdictService:
             ring_s=self._ring_wait(items),
             swap_s=swap_s,
         )
+        cache_hits: list | None = [] if self._flow_cache_on else None
         for item in items:
             _, client, batch = item
             responses[id(item)] = [None] * batch.count
@@ -3430,7 +4178,23 @@ class VerdictService:
             for i in range(batch.count):
                 self._classify_entry(item, i, conns_snapshot,
                                      quarantined, responses, fast,
-                                     slow, slow_conns)
+                                     slow, slow_conns,
+                                     cache_hits=cache_hits)
+        cached_keys: set | None = None
+        if cache_hits:
+            cached_keys = {(k, i) for k, i, *_ in cache_hits}
+            if not self._round_thread_suppressed():
+                self._count_cache_hits(len(cache_hits))
+                self._record_cached_entries(cache_hits)
+        if self._flow_cache_on:
+            # Misses are REQUEST-direction entries only (the metric's
+            # definition, and the columnar tier's n_elig): replies and
+            # end-stream entries are never cache candidates, so they
+            # must not deflate a hit rate derived from the counters.
+            self._count_cache_misses(
+                len(fast)
+                + sum(1 for s in slow if not s[4] and not s[5])
+            )
 
         # Async round (completion-pipeline mode): when every slow entry
         # is either CRLF-extractable (engine exposes feed_extract) or
@@ -3512,7 +4276,8 @@ class VerdictService:
                             [self._batch_desc(it[2]) for it in items],
                         )
                         self._record_entrywise(
-                            rt.path, items, responses, rules_out
+                            rt.path, items, responses, rules_out,
+                            cached=cached_keys,
                         )
                 finally:
                     if pend:
@@ -3598,7 +4363,8 @@ class VerdictService:
             # Record emission at decision time (the pipelined sends are
             # already queued in FIFO order behind this round).
             if not self._round_thread_suppressed():
-                self._record_entrywise(rt.path, items, responses, rules_out)
+                self._record_entrywise(rt.path, items, responses,
+                                       rules_out, cached=cached_keys)
 
         if deferred:
             self._completion_put(("entry2", [], run_sync_and_respond))
@@ -3741,12 +4507,46 @@ class VerdictService:
         # residue): route them scalar, whole-conn, preserving order.
         order = np.argsort(conn_ids, kind="stable")
         so = conn_ids[order]
+        dup_mask = None
         if len(so) > 1:
             dup = so[1:] == so[:-1]
             if dup.any():
-                elig &= ~np.isin(conn_ids, np.unique(so[1:][dup]))
+                dup_mask = np.isin(conn_ids, np.unique(so[1:][dup]))
+                elig &= ~dup_mask
+        # Verdict-cache hit lane (Phase A, still side-effect-free):
+        # armed conns whose claim epoch matches the snapshot epoch,
+        # with no residue and a frame-aligned payload, are filtered
+        # out of the device round in this one vectorized mask — they
+        # are answered from the claim in Phase B, before ingest or
+        # bucket issue ever sees them.  Duplicate conns stay out: an
+        # earlier entry this round may leave residue the hit's clean
+        # check cannot see yet.
+        hit = None
+        t_c0 = time.monotonic()
+        if self._flow_cache_on:
+            hit = (
+                (flags == 0)
+                & (snap.cache[pos] == 1)
+                & (snap.cache_epoch[pos] == snap.epoch)
+                & (~dirty)
+                & segments_end_crlf(blob, starts, lengths)
+            )
+            if dup_mask is not None:
+                hit &= ~dup_mask
+            if hit.any():
+                elig &= ~hit
+            else:
+                hit = None
+        cache_s = (time.monotonic() - t_c0) if hit is not None else 0.0
+        n_hit = int(hit.sum()) if hit is not None else 0
         n_elig = int(elig.sum())
-        if n_elig < max(int(self.config.reasm_min_entries), 1):
+        if n_elig < max(int(self.config.reasm_min_entries), 1) and not (
+            n_hit and n_elig == 0
+        ):
+            # Too small for the columnar fixed cost (cache hits pay
+            # almost none, so an all-hit round proceeds regardless);
+            # the scalar rung serves everything, hits included
+            # (_classify_entry has the same hit check).
             return self._reasm_bail(
                 conn_ids, "round_too_small" if n_elig else None
             )
@@ -3757,6 +4557,8 @@ class VerdictService:
         rest = np.flatnonzero(~elig)
         conns = self._conns
         for k in rest:
+            if hit is not None and hit[k]:
+                continue  # answered from the claim in Phase B
             cid = int(conn_ids[k])
             fl = int(flags[k])
             sc = conns.get(cid)
@@ -3779,8 +4581,11 @@ class VerdictService:
         # Lane-exit for tainted conns still holding arena state: their
         # residue moves to the scalar side before classification (the
         # one release definition — _reasm_bail with no fallback count).
-        if len(rest):
-            self._reasm_bail(conn_ids[rest], None)
+        # Cache hits are not tainted — they hold no carry by the hit
+        # mask's clean check.
+        lane_exit = rest if hit is None else rest[~hit[rest]]
+        if len(lane_exit):
+            self._reasm_bail(conn_ids[lane_exit], None)
         rt = self.tracer.begin_round(
             PATH_ORACLE, n_round, self._oldest_arrival(items), t_pop,
             ring_s=self._ring_wait(items), swap_s=swap_s,
@@ -3789,6 +4594,30 @@ class VerdictService:
             id(item): [None] * item[2].count for item in items
         }
         base = np.concatenate(([0], np.cumsum(counts))).astype(np.int64)
+        # Cache-hit entries answered from the claim: `_verdict_body`'s
+        # (PASS frame, MORE 1) shape, original rule attributed on the
+        # `cached` path, device round never issued.
+        cached_keys: set | None = None
+        if n_hit:
+            hit_idx = np.flatnonzero(hit)
+            cached_keys = set()
+            for k in hit_idx:
+                bi = int(np.searchsorted(base, k, side="right")) - 1
+                item = items[bi]
+                ei = int(k - base[bi])
+                responses[id(item)][ei] = (
+                    int(conn_ids[k]), int(FilterResult.OK),
+                    [(int(PASS), int(lengths[k])), (int(MORE), 1)],
+                    b"", b"",
+                )
+                cached_keys.add((id(item), ei))
+            rt.cache_s = cache_s
+            if not self._round_thread_suppressed():
+                self._count_cache_hits(n_hit)
+                self._flowlog_cached(
+                    snap, conn_ids[hit_idx], pos[hit_idx]
+                )
+        self._count_cache_misses(n_elig)
         fast: list = []
         slow: list = []
         slow_conns: set = set()
@@ -3796,6 +4625,8 @@ class VerdictService:
             with self._lock:
                 conns_snapshot = self._conns
             for k in rest:
+                if cached_keys is not None and hit[k]:
+                    continue  # already answered from the claim
                 bi = int(np.searchsorted(base, k, side="right")) - 1
                 self._classify_entry(
                     items[bi], int(k - base[bi]), conns_snapshot,
@@ -3902,7 +4733,7 @@ class VerdictService:
                         items, base, responses, groups, rest,
                         vals[n_legacy_futs:] if vals is not None
                         else [None] * (len(futs) - n_legacy_futs),
-                        rt, rules_out,
+                        rt, rules_out, cached=cached_keys,
                     )
                 except Exception:  # noqa: BLE001 — fail closed, typed
                     # The shim is owed exactly one reply per seq and
@@ -3943,7 +4774,8 @@ class VerdictService:
 
     def _finish_columnar(self, items: list, base: np.ndarray,
                          responses: dict, groups: list, rest,
-                         vals: list, rt, rules_out: dict) -> None:
+                         vals: list, rt, rules_out: dict,
+                         cached: set | None = None) -> None:
         """Finish half of the columnar round: materialize the bucket
         readbacks, render per-entry ops/injects as array scatters,
         merge the scalar minority's tuples in entry order, and emit one
@@ -4081,11 +4913,13 @@ class VerdictService:
             rt, [self._batch_desc(it[2]) for it in items]
         )
         # Scalar-minority records ride the shared entrywise emitter
-        # (columnar entries hold None responses and are skipped);
-        # columnar records are one add_round per engine group with the
-        # CAPTURED engine's kinds legend + epoch — slot-reuse-safe
-        # exactly like the vec rounds.
-        self._record_entrywise(rt.path, items, responses, rules_out)
+        # (columnar entries hold None responses and are skipped, and
+        # cache-hit entries were already recorded on the `cached` path
+        # at decision time); columnar records are one add_round per
+        # engine group with the CAPTURED engine's kinds legend + epoch
+        # — slot-reuse-safe exactly like the vec rounds.
+        self._record_entrywise(rt.path, items, responses, rules_out,
+                               cached=cached)
         if self.flowlog is None:
             return
         for _sel, engine, rnd, allow_f, rule_f, (own_oc, _ops, _il,
@@ -4726,6 +5560,11 @@ class _ClientHandler:
         # reason outlive the rings (operators read them AFTER a fault).
         self.shm: ShmPeer | None = None
         self.shm_detached: ShmPeer | None = None
+        # Verdict-cache opt-in (MSG_CACHE_ENABLE): the service never
+        # sends MSG_CACHE_GRANT/REVOKE frames to a shim that did not
+        # announce support — the native shim's dispatch table stays
+        # untouched.
+        self.cache_ok = False
         # Kernel send timeout (send only — settimeout would also bound
         # the reader's recv): a shim that stopped READING wedges
         # sendall while this handler's _wlock is held, and every later
@@ -5168,16 +6007,26 @@ class _ClientHandler:
                             wire.MSG_ACK,
                             wire.pack_ack(int(FilterResult.OK)),
                         )
+                elif msg_type == wire.MSG_CACHE_ENABLE:
+                    # Fire-and-forget opt-in; grants start flowing for
+                    # conns registered from here on.
+                    self.cache_ok = True
                 elif msg_type == wire.MSG_CLOSE:
                     self.service.submit_close(wire.unpack_close(payload))
                 elif msg_type == wire.MSG_NEW_CONNECTION:
                     args = wire.unpack_new_connection(payload)
-                    res = self.service.new_connection(*args, client=self)
+                    res, grant = self.service.new_connection(
+                        *args, client=self
+                    )
                     self.send(
                         wire.MSG_CONN_RESULT,
                         np.array([args[1]], "<u8").tobytes()
                         + np.array([res], "<u4").tobytes(),
                     )
+                    if grant is not None:
+                        # After the reply: the shim's post-RPC stale-
+                        # grant drop is ordered BEFORE this frame.
+                        self.service._send_cache_grants([grant])
                 elif msg_type == wire.MSG_OPEN_MODULE:
                     params, debug = wire.unpack_open_module(payload)
                     self.module_id = self.service.open_module(params, debug)

@@ -38,6 +38,7 @@ from collections import deque
 from ..utils import metrics
 
 # Serving-path labels (the degradation ladder, fastest first).
+PATH_CACHED = "cached"    # established-flow verdict cache (no device)
 PATH_VEC = "vec"          # vectorized device path (matrix/vec rounds)
 PATH_ORACLE = "oracle"    # entrywise slow path (engines + parsers)
 PATH_HOST = "host"        # quarantine host-fallback rounds
@@ -51,14 +52,19 @@ STAGE_SWAP = "table_swap"          # round blocked behind an epoch swap
 STAGE_REASM = "reasm"              # columnar reassembly (arena ingest +
 #                                    frame scan + bucket pack) — carved
 #                                    out of batch_form like table_swap
+STAGE_CACHE = "cache"              # verdict-cache mask + hit rendering
+#                                    (established-flow short-circuit) —
+#                                    carved out of batch_form the same
+#                                    way; a cached round's only real
+#                                    work shows up here
 STAGE_FORM = "batch_form"          # pop -> device batch assembled
 STAGE_SUBMIT = "device_submit"     # assembled -> device calls issued
 STAGE_DEVICE = "device"            # issued -> fenced readback complete
 STAGE_DRAIN = "drain"              # complete -> responses built
 STAGE_SEND = "send"                # built -> verdict frames written
 
-STAGES = (STAGE_RING, STAGE_QUEUE, STAGE_SWAP, STAGE_REASM, STAGE_FORM,
-          STAGE_SUBMIT, STAGE_DEVICE, STAGE_DRAIN, STAGE_SEND)
+STAGES = (STAGE_RING, STAGE_QUEUE, STAGE_SWAP, STAGE_REASM, STAGE_CACHE,
+          STAGE_FORM, STAGE_SUBMIT, STAGE_DEVICE, STAGE_DRAIN, STAGE_SEND)
 
 
 class RoundTrace:
@@ -72,7 +78,7 @@ class RoundTrace:
 
     __slots__ = ("path", "n", "t_admit", "t_pop", "t_form", "t_submit",
                  "t_complete", "t_drain", "t_send", "ring_s", "swap_s",
-                 "reasm_s")
+                 "reasm_s", "cache_s")
 
     def __init__(self, path: str, n: int, t_admit: float, t_pop: float,
                  ring_s: float = 0.0, swap_s: float = 0.0):
@@ -102,6 +108,10 @@ class RoundTrace:
         # way, so the mixed-path decomposition names the reassembler's
         # cost instead of folding it into batch assembly.
         self.reasm_s = 0.0
+        # Verdict-cache work (vectorized hit mask + cached-frame
+        # rendering) — carved out of batch_form like reasm; for a
+        # fully-cached round this IS the round's host cost.
+        self.cache_s = 0.0
 
     def formed(self) -> None:
         if not self.t_form:
@@ -133,12 +143,14 @@ class RoundTrace:
         form = max(t_form - t_pop, 0.0)
         swap = min(max(self.swap_s, 0.0), form)
         reasm = min(max(self.reasm_s, 0.0), form - swap)
+        cache = min(max(self.cache_s, 0.0), form - swap - reasm)
         return {
             STAGE_RING: ring,
             STAGE_QUEUE: wait - ring,
             STAGE_SWAP: swap,
             STAGE_REASM: reasm,
-            STAGE_FORM: form - swap - reasm,
+            STAGE_CACHE: cache,
+            STAGE_FORM: form - swap - reasm - cache,
             STAGE_SUBMIT: max(t_submit - t_form, 0.0),
             STAGE_DEVICE: max(t_complete - t_submit, 0.0),
             STAGE_DRAIN: max(t_drain - t_complete, 0.0),

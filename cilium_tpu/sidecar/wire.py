@@ -97,6 +97,19 @@ MSG_SHM_ATTACH_REPLY = 20
 MSG_SHM_DOORBELL = 21
 MSG_SHM_CREDIT = 22
 MSG_SHM_DETACH = 23  # -> MSG_ACK; client tears its rings down after
+# Established-flow verdict cache (service <-> shim).  ENABLE is the
+# client's one-time opt-in (fire-and-forget, no reply): a service never
+# sends cache frames to a shim that did not announce support, so the
+# native shim's fixed dispatch table stays untouched.  GRANT
+# (service→shim) arms one conn: the claimed verdict/rule is
+# byte-invariant for the flow's remainder under the carried epoch, and
+# the shim may short-circuit frame-aligned request pushes locally
+# (bytes never cross the transport).  REVOKE (service→shim) carries the
+# NEW committed epoch: every grant under an older epoch is dead (sent
+# to each opted-in session BEFORE the epoch pointer-flip commits).
+MSG_CACHE_ENABLE = 24
+MSG_CACHE_GRANT = 25
+MSG_CACHE_REVOKE = 26
 
 # OnIO op capacity per verdict entry (reference: cilium_proxylib.cc:199).
 MAX_OPS_PER_ENTRY = 16
@@ -699,6 +712,39 @@ def pack_shm_detach(generation: int, flags: int = 0) -> bytes:
 
 def unpack_shm_detach(payload: bytes) -> tuple[int, int]:
     return struct.unpack_from("<II", payload, 0)
+
+
+# --- verdict cache (MSG_CACHE_*) -----------------------------------------
+
+# GRANT flag: the claimed verdict is allow (the only claim the cache
+# tiers arm on today; a deny claim is never granted — denied frames
+# carry per-frame inject side effects the short-circuit would skip).
+CACHE_FLAG_ALLOW = 1
+
+
+def pack_cache_enable() -> bytes:
+    """Client opt-in (fire-and-forget, no reply)."""
+    return b""
+
+
+def pack_cache_grant(conn_id: int, epoch: int, rule: int,
+                     flags: int = CACHE_FLAG_ALLOW) -> bytes:
+    """Arm one conn: byte-invariant (verdict, rule row) under epoch."""
+    return struct.pack("<QqiI", conn_id, epoch, rule, flags)
+
+
+def unpack_cache_grant(payload: bytes) -> tuple[int, int, int, int]:
+    return struct.unpack_from("<QqiI", payload, 0)
+
+
+def pack_cache_revoke(epoch: int) -> bytes:
+    """Epoch pointer-flip notification: grants under any OLDER epoch
+    are dead (the structural epoch key, client half)."""
+    return struct.pack("<q", epoch)
+
+
+def unpack_cache_revoke(payload: bytes) -> int:
+    return struct.unpack_from("<q", payload, 0)[0]
 
 
 # --- CLOSE / POLICY_UPDATE / ACK ----------------------------------------

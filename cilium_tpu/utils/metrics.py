@@ -354,6 +354,38 @@ MeshActive = registry.gauge(
     "1 while the (flows, rules) device mesh serves verdicts, 0 when "
     "off or demoted",
 )
+MeshRepromotions = registry.counter(
+    "mesh_repromotions_total",
+    "Demoted sharded serving re-promoted after a timed off-path "
+    "re-probe (one sharded executable rebuilt, parity-probed against "
+    "the single-chip fallback, then one pointer flip back)",
+)
+# Established-flow verdict cache (sidecar service Phase-A mask +
+# _classify_entry, shim client pre-push short-circuit, engine judge
+# steps).  Every hit is a device round, a wire round-trip, and a
+# reassembly pass that never happens; every cached verdict is
+# attributed to the ORIGINAL rule row under the epoch it was derived
+# at (flowlog path label "cached").
+VerdictCacheHits = registry.counter(
+    "verdict_cache_hits_total",
+    "Frames short-circuited by the established-flow verdict cache, by "
+    "site (shim = bytes never pushed across the transport, service = "
+    "sidecar Phase-A/entry mask, engine = judge-step host answer)",
+    ("site",),
+)
+VerdictCacheMisses = registry.counter(
+    "verdict_cache_misses_total",
+    "Request-direction entries that reached the device path with the "
+    "verdict cache enabled (no byte-invariance claim, stale epoch, or "
+    "residue kept the flow off the cache tier)",
+)
+VerdictCacheInvalidations = registry.counter(
+    "verdict_cache_invalidations_total",
+    "Cache rows killed wholesale: epoch pointer-flips (the epoch key "
+    "makes stale hits structurally impossible; this counts the armed "
+    "rows each flip retired) and quarantine/close disarms",
+    ("reason",),
+)
 FlowBufferOverflows = registry.counter(
     "flow_buffer_overflow_total",
     "Flows dropped for exceeding the retained-bytes cap without a "

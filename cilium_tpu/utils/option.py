@@ -225,6 +225,27 @@ class DaemonConfig:
     # two (so every power-of-two dispatch bucket divides it) and
     # capped at the smallest bucket.
     mesh_flow_shards: int = 0
+    # Guarded mesh re-promotion: after a mesh demotion, the policy
+    # builder thread re-probes the mesh off-path at most once per this
+    # interval (rebuild one sharded executable, parity-probe it against
+    # the single-chip fallback, re-promote typed on success).  0 keeps
+    # the pre-PR-12 behavior: demotion sticky until restart.
+    mesh_reprobe_interval_s: float = 5.0
+
+    # Established-flow verdict cache (sidecar/service.py + client.py +
+    # policy/invariance.py): per-flow decisions keyed (conn, direction,
+    # policy epoch) that short-circuit byte-invariant flows — in the
+    # shim before bytes cross the transport, and in the sidecar's
+    # vectorized eligibility mask before any device round.  OFF by
+    # default: the cache coalesces per-frame ops into stream-level
+    # PASS ops (byte-equivalent forwarded output, not op-identical),
+    # so the strict op-parity suites run against the true baseline;
+    # every short-circuit site is gated on this knob (like
+    # flow_observe).
+    flow_cache: bool = False
+    # Cap on service-side armed cache rows (beyond it, new flows stop
+    # arming but existing rows keep serving).
+    flow_cache_entries: int = 1 << 20
 
     # Policy churn (sidecar/service.py epoch swap).  How long a
     # MSG_POLICY_UPDATE handler waits for the builder thread's staged
@@ -322,6 +343,10 @@ class DaemonConfig:
             raise ValueError(f"invalid mesh {self.mesh!r}")
         if self.mesh_rule_shards < 0 or self.mesh_flow_shards < 0:
             raise ValueError("mesh shard counts must be non-negative")
+        if self.mesh_reprobe_interval_s < 0:
+            raise ValueError("mesh_reprobe_interval_s must be >= 0")
+        if self.flow_cache_entries < 0:
+            raise ValueError("flow_cache_entries must be >= 0")
 
 
 # Global config (reference: option.Config singleton).

@@ -50,6 +50,13 @@ constexpr uint16_t kMsgAck = 9;
 [[maybe_unused]] constexpr uint16_t kMsgShmDoorbell = 21;
 [[maybe_unused]] constexpr uint16_t kMsgShmCredit = 22;
 [[maybe_unused]] constexpr uint16_t kMsgShmDetach = 23;
+// Established-flow verdict cache (sidecar/wire.py).  Same coexistence
+// contract as shm: this shim never sends kMsgCacheEnable, so the
+// service never emits grant/revoke frames to it — the opt-in is the
+// compatibility gate, every frame stays on the byte-accounting path.
+[[maybe_unused]] constexpr uint16_t kMsgCacheEnable = 24;
+[[maybe_unused]] constexpr uint16_t kMsgCacheGrant = 25;
+[[maybe_unused]] constexpr uint16_t kMsgCacheRevoke = 26;
 
 struct Direction {
   std::string buffer;       // retained, not-yet-verdicted input

@@ -1,0 +1,29 @@
+"""R13 bad corpus: a hot-module verdict cache keyed by conn only.
+
+The store has no epoch/generation term in its key and the function
+maintains no sibling epoch store; the reader checks nothing either —
+after a policy pointer-flip both keep serving the OLD table's verdict.
+"""
+
+
+class Service:
+    def __init__(self):
+        self._verdict_cache = {}
+        self.policy_table = {}
+
+    def arm(self, conn_id, verdict):
+        self._verdict_cache[conn_id] = verdict  # EXPECT[R13]
+
+    def serve(self, conn_id):
+        hit = self._verdict_cache.get(conn_id)  # EXPECT[R13]
+        if hit is not None:
+            return hit
+        return self.policy_table[conn_id % 4]
+
+    def arm_deferred(self, conn_id, verdict):
+        # A store inside a closure is the CLOSURE's finding (one
+        # report): the parent's walk prunes nested bodies.
+        def commit():
+            self._verdict_cache[conn_id] = verdict  # EXPECT[R13]
+
+        return commit

@@ -1,0 +1,25 @@
+"""R13 good corpus: the sanctioned shapes.
+
+``arm``/``serve`` pair every cache row with a sibling epoch store and
+validate it on read (the conn-table columns pattern); ``arm_tuple``
+carries the epoch inside the key itself.  No findings."""
+
+
+class Service:
+    def __init__(self):
+        self._verdict_cache = {}
+        self._verdict_cache_epoch = {}
+        self._tuple_cache = {}
+        self.policy_epoch = 0
+
+    def arm(self, conn_id, verdict):
+        self._verdict_cache[conn_id] = verdict
+        self._verdict_cache_epoch[conn_id] = self.policy_epoch
+
+    def serve(self, conn_id):
+        if self._verdict_cache_epoch.get(conn_id) != self.policy_epoch:
+            return None  # stale generation: structural miss
+        return self._verdict_cache.get(conn_id)
+
+    def arm_tuple(self, conn_id, epoch, verdict):
+        self._tuple_cache[(conn_id, epoch)] = verdict

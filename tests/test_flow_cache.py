@@ -1,0 +1,574 @@
+"""Established-flow verdict cache (PR 12): the byte-invariance offload
+tier that short-circuits the device round.
+
+Contracts pinned here:
+
+- **Invariance analysis** (policy/invariance.py): the claim is the
+  FIRST-match walk's — invariant-allow only when the first row
+  admitting the identity is byte-free (verdict AND attribution
+  byte-independent), invariant-deny when no row admits it, no claim the
+  moment the first admitting row inspects bytes.
+- **Structural epoch key**: a cached verdict can never outlive its
+  epoch — service rows compare their claim epoch against the snapshot
+  epoch, shim grants against the latest revoke — and demotion disarms
+  with re-arm on heal.
+- **Byte-level shim short-circuit**: granted frame-aligned pushes are
+  answered locally; the bytes never cross the transport (counted).
+- **Parity**: cache-on forwarded output is byte-identical to the
+  cache-off oracle service at EVERY split offset of a pipelined
+  multi-frame stream (the test_reasm harness style), and cached flow
+  records carry the ORIGINAL rule row under the `cached` path label.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.models.base import ConstVerdict
+from cilium_tpu.policy.invariance import (
+    invariant_verdict,
+    reduce_http_rows,
+    reduce_r2d2_rows,
+)
+from cilium_tpu.proxylib import (
+    FilterResult,
+    NetworkPolicy,
+    PortNetworkPolicy,
+    PortNetworkPolicyRule,
+)
+from cilium_tpu.proxylib import instance as inst
+from cilium_tpu.sidecar import SidecarClient, VerdictService
+from cilium_tpu.utils.option import DaemonConfig
+
+
+def _policy(name="fcpol"):
+    """Remote 1: admitted by a byte-FREE row (invariant allow, rule 0).
+    Remote 2: admitted only by byte-constrained rows (no claim).
+    Remote 9: admitted by nothing (invariant deny)."""
+    return NetworkPolicy(
+        name=name,
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=80,
+                rules=[
+                    PortNetworkPolicyRule(
+                        remote_policies=[1], l7_proto="r2d2",
+                        l7_rules=[{}],
+                    ),
+                    PortNetworkPolicyRule(
+                        remote_policies=[2], l7_proto="r2d2",
+                        l7_rules=[
+                            {"cmd": "READ", "file": "/public/.*"},
+                            {"cmd": "HALT"},
+                        ],
+                    ),
+                ],
+            )
+        ],
+    )
+
+
+def _start(tmp_path, name, flow_cache=True, client_cache=True,
+           **cfg_kw):
+    inst.reset_module_registry()
+    cfg = DaemonConfig(
+        batch_flows=64, batch_width=64, dispatch_mode="eager",
+        flow_cache=flow_cache, **cfg_kw,
+    )
+    svc = VerdictService(str(tmp_path / f"{name}.sock"), cfg).start()
+    client = SidecarClient(
+        svc.socket_path, timeout=120.0, flow_cache=client_cache
+    )
+    mod = client.open_module([])
+    assert client.policy_update(mod, [_policy()]) == int(FilterResult.OK)
+    return svc, client, mod
+
+
+def _conn(client, mod, conn_id, remote=1):
+    res, shim = client.new_connection(
+        mod, "r2d2", conn_id, True, remote, 2,
+        f"1.1.1.{conn_id % 250 + 1}:1", "2.2.2.2:80", "fcpol",
+    )
+    assert res == int(FilterResult.OK)
+    return shim
+
+
+# --- invariance analysis ---------------------------------------------------
+
+
+def test_invariant_verdict_first_match_semantics():
+    free = (frozenset({1, 3}), True)
+    gated = (frozenset({1}), False)
+    anyone_free = (None, True)
+    # First admitting row byte-free -> invariant allow at THAT row.
+    assert invariant_verdict((free, gated), 1) == (True, 0)
+    # First admitting row byte-constrained -> no claim, even with a
+    # byte-free row behind it (attribution would flip per frame).
+    assert invariant_verdict((gated, free), 1) is None
+    # Identity admitted by nothing -> invariant deny.
+    assert invariant_verdict((free, gated), 9) == (False, -1)
+    # Remote-gated rows are transparent to other identities: identity 3
+    # skips the byte row it cannot match and lands on the free row.
+    assert invariant_verdict((gated, anyone_free), 3) == (True, 1)
+
+
+def test_reduce_rows_r2d2_and_http():
+    rows = [
+        (frozenset({1}), "", ""),          # always-match (no matchers)
+        (frozenset({2}), "READ", ""),      # cmd-constrained
+        (frozenset(), "", "/public/.*"),   # file-constrained, any remote
+    ]
+    red = reduce_r2d2_rows(rows)
+    assert red == (
+        (frozenset({1}), True), (frozenset({2}), False), (None, False),
+    )
+
+    class _HttpRule:
+        def __init__(self, **kw):
+            self.method = kw.get("method", "")
+            self.path = kw.get("path", "")
+            self.host = kw.get("host", "")
+            self.headers = kw.get("headers", [])
+
+    hred = reduce_http_rows([
+        (frozenset({1}), _HttpRule()),
+        (frozenset({2}), _HttpRule(path="/admin/.*")),
+    ])
+    assert hred == ((frozenset({1}), True), (frozenset({2}), False))
+
+
+def test_engine_contract_r2d2_and_const():
+    from cilium_tpu.models.r2d2 import build_r2d2_model_from_rows
+    from cilium_tpu.runtime.batch import R2d2BatchEngine
+
+    model = build_r2d2_model_from_rows(
+        [(frozenset({1}), "", ""), (frozenset({2}), "READ", "")]
+    )
+    eng = R2d2BatchEngine(model)
+    assert eng.verdict_invariant(1) == (True, 0)
+    assert eng.verdict_invariant(2) is None
+    assert eng.verdict_invariant(9) == (False, -1)
+    # Memoized (same object back).
+    assert eng.verdict_invariant(1) == (True, 0)
+    const = R2d2BatchEngine(ConstVerdict(True))
+    assert const.verdict_invariant(42) == (True, -1)
+
+
+def test_engine_contract_l7_no_claim_for_stateful():
+    """Cassandra/memcached make NO claim (reply-intent queues make
+    per-frame framing load-bearing); the HTTP judge path does."""
+    from cilium_tpu.models.http import build_http_model
+    from cilium_tpu.policy.api import PortRuleHTTP
+    from cilium_tpu.runtime.l7engine import (
+        CassandraBatchEngine,
+        HttpSidecarEngine,
+    )
+
+    class _FakeModel:  # no invariant_rows attr
+        pass
+
+    cass = CassandraBatchEngine(None, True, 9042, _FakeModel())
+    assert cass.verdict_invariant(1) is None
+
+    hmodel = build_http_model([
+        (frozenset({1}), PortRuleHTTP()),
+        (frozenset({2}), PortRuleHTTP(path="/admin/.*")),
+    ])
+    http = HttpSidecarEngine(None, True, 80, hmodel)
+    assert http.verdict_invariant(1) == (True, 0)
+    assert http.verdict_invariant(2) is None
+
+
+def test_http_judge_short_circuit_skips_device():
+    """HttpBatchEngine with the cache enabled answers byte-invariant
+    identities host-side: the device model is never invoked for them,
+    and the flow record carries the claimed rule row."""
+    from cilium_tpu.models.http import build_http_model
+    from cilium_tpu.policy.api import PortRuleHTTP
+    from cilium_tpu.runtime.engines import HttpBatchEngine
+
+    model = build_http_model([
+        (frozenset({1}), PortRuleHTTP()),
+        (frozenset({2}), PortRuleHTTP(method="GET")),
+    ])
+    calls = [0]
+
+    class _Spy:
+        match_kinds = model.match_kinds
+        invariant_rows = model.invariant_rows
+
+        def __call__(self, *a, **k):
+            calls[0] += 1
+            return model(*a, **k)
+
+        def verdicts_attr(self, *a, **k):
+            calls[0] += 1
+            return model.verdicts_attr(*a, **k)
+
+    class _Log:
+        def __init__(self):
+            self.rounds = []
+
+        def add_entries(self, path, entries, kinds=(), reason=""):
+            self.rounds.append((path, entries, kinds))
+
+    log = _Log()
+    eng = HttpBatchEngine(_Spy(), cache_enabled=True, flowlog=log)
+    head = b"GET /x HTTP/1.1\r\nHost: a\r\n\r\n"
+    eng.feed(1, head, remote_id=1)
+    eng.pump()
+    ops, _ = eng.take_ops(1)
+    assert ops[0][0].name == "PASS" if hasattr(ops[0][0], "name") \
+        else ops[0][0] == 1
+    assert calls[0] == 0, "invariant identity must skip the device"
+    # Attribution: the claim's rule row rode the record.
+    (_path, entries, _kinds) = log.rounds[-1]
+    assert entries == [(1, 0, 0)]  # (conn, CODE_FORWARDED, rule)
+    # A byte-constrained identity still judges on device.
+    eng.feed(2, head, remote_id=2)
+    eng.pump()
+    assert calls[0] == 1
+    # Cache off: nobody short-circuits.
+    calls[0] = 0
+    eng2 = HttpBatchEngine(_Spy(), cache_enabled=False, flowlog=log)
+    eng2.feed(1, head, remote_id=1)
+    eng2.pump()
+    assert calls[0] == 1
+
+
+# --- service tiers ---------------------------------------------------------
+
+
+def test_every_offset_cache_vs_oracle_parity(tmp_path):
+    """The reasm-style parity gate: a pipelined multi-frame stream cut
+    at EVERY byte offset, served by a cache-armed service and by the
+    cache-off oracle service — forwarded output must be byte-identical
+    at every offset, for the cacheable AND the control identity."""
+    svc_a, cl_a, mod_a = _start(tmp_path, "par-on", flow_cache=True)
+    svc_b, cl_b, mod_b = _start(tmp_path, "par-off", flow_cache=False,
+                                client_cache=False)
+    try:
+        stream = (b"READ /public/a\r\nHALT\r\nREAD /secret\r\n"
+                  b"WRITE /x\r\nHALT\r\n")
+        cid = [100]
+        for remote in (1, 2):
+            for cut in range(len(stream) + 1):
+                outs = []
+                for cl, mod in ((cl_a, mod_a), (cl_b, mod_b)):
+                    shim = _conn(cl, mod, cid[0], remote)
+                    got = b""
+                    for part in (stream[:cut], stream[cut:]):
+                        res, out = shim.on_io(False, part)
+                        assert res == int(FilterResult.OK)
+                        got += out
+                    outs.append(got)
+                    shim.close()
+                cid[0] += 1
+                assert outs[0] == outs[1], (
+                    f"remote {remote} cut {cut}: cached {outs[0]!r} "
+                    f"!= oracle {outs[1]!r}"
+                )
+        # The cache actually engaged for the cacheable identity.
+        assert cl_a.cache_hits > 0
+    finally:
+        cl_a.close()
+        svc_a.stop()
+        cl_b.close()
+        svc_b.stop()
+        inst.reset_module_registry()
+
+
+def test_shim_short_circuit_is_byte_level(tmp_path):
+    """Granted frame-aligned pushes never cross the transport: the
+    client's pushed-byte counter is unchanged by a hit, and partial
+    frames still ship (and serve) normally."""
+    svc, client, mod = _start(tmp_path, "bytes")
+    try:
+        shim = _conn(client, mod, 1, remote=1)
+        time.sleep(0.2)  # grant frame delivery
+        b0 = client.bytes_pushed
+        res, out = shim.on_io(False, b"READ /anything\r\n")
+        assert res == int(FilterResult.OK)
+        assert out == b"READ /anything\r\n"
+        assert client.bytes_pushed == b0, "cached bytes crossed the seam"
+        assert client.cache_hits == 1
+        # Partial frame: not frame-aligned -> pushed and served.
+        res, out1 = shim.on_io(False, b"READ /sp")
+        res, out2 = shim.on_io(False, b"lit\r\n")
+        assert out1 + out2 == b"READ /split\r\n"
+        assert client.bytes_pushed > b0
+        # The un-granted identity always pushes.
+        shim2 = _conn(client, mod, 2, remote=2)
+        b1 = client.bytes_pushed
+        res, out = shim2.on_io(False, b"HALT\r\n")
+        assert out == b"HALT\r\n"
+        assert client.bytes_pushed > b1
+        assert client.cache_hits == 1
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_client_local_answer_defers_behind_inflight_round(tmp_path):
+    """Client ordering FIFO: a synthesized local verdict never
+    overtakes a round still in flight — its DELIVERY queues until the
+    earlier round settles — but the bytes still never cross the
+    transport, and the queue flushes on disconnect-style settle paths
+    (timeout / failed send share _round_settled)."""
+    svc, client, mod = _start(tmp_path, "fifo")
+    try:
+        _conn(client, mod, 1, remote=1)
+        time.sleep(0.2)  # grant frame delivery
+        got: list[int] = []
+        client.verdict_callback = lambda vb: got.append(vb.seq)
+        b0 = client.bytes_pushed
+        with client._localq_lock:
+            client._rounds_out.add(7_777)  # an unanswered earlier round
+        client.send_batch(
+            41, np.array([1], np.uint64), np.zeros(1, np.uint8),
+            np.array([9], np.uint32), b"READ /g\r\n",
+        )
+        time.sleep(0.1)
+        assert client.bytes_pushed == b0, "queued local answer pushed"
+        assert client.cache_hits == 1 and got == [], got
+        # A second granted batch queues BEHIND the first (FIFO even
+        # with an empty wait set).
+        client.send_batch(
+            42, np.array([1], np.uint64), np.zeros(1, np.uint8),
+            np.array([9], np.uint32), b"READ /h\r\n",
+        )
+        assert got == [] and client.bytes_pushed == b0
+        client._round_settled(7_777)  # the earlier round completes
+        assert got == [41, 42], got
+        # Quiescent pipeline: local answers deliver synchronously.
+        client.send_batch(
+            43, np.array([1], np.uint64), np.zeros(1, np.uint8),
+            np.array([9], np.uint32), b"READ /i\r\n",
+        )
+        assert got == [41, 42, 43] and client.bytes_pushed == b0
+        assert client.cache_hits == 3
+    finally:
+        client.verdict_callback = None
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_service_tier_hits_attribute_cached_path(tmp_path):
+    """With the shim half disabled, the sidecar's own tiers serve the
+    armed conns (whole-item mask / Phase-A / scalar classify) and every
+    cached record carries the ORIGINAL rule row, the claim epoch, and
+    the `cached` path label — queryable via MSG_OBSERVE."""
+    svc, client, mod = _start(tmp_path, "svc-tier", client_cache=False)
+    try:
+        for cid in (1, 2, 3):
+            _conn(client, mod, cid, remote=1)
+        shim = _conn(client, mod, 4, remote=2)
+        import threading
+
+        evt = threading.Event()
+        client.verdict_callback = lambda vb: evt.set()
+        ids = np.array([1, 2, 3], np.uint64)
+        lens = np.array([6, 6, 6], np.uint32)
+        client.send_batch(
+            11, ids, np.zeros(3, np.uint8), lens, b"HALT\r\n" * 3
+        )
+        assert evt.wait(60)
+        # The verdict frame is sent BEFORE the service books counters
+        # and flow records (latency-first) — poll the bookkeeping in.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = svc.status()["flow_cache"]
+            if st["hits"] >= 3 and len(
+                client.observe(n=100, path="cached")["records"]
+            ) >= 3:
+                break
+            time.sleep(0.02)
+        st = svc.status()["flow_cache"]
+        assert st["armed"] == 3, st
+        assert st["hits"] == 3, st
+        recs = client.observe(n=100, path="cached")["records"]
+        assert len(recs) == 3
+        for r in recs:
+            assert r["verdict"] == "Forwarded"
+            assert r["rule_id"] == 0
+            assert r["epoch"] == svc.policy_epoch
+            assert r["match_kind"] == "literal"
+        # Control identity misses (device path) and is NOT cached.
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert out == b"HALT\r\n"
+        assert svc.status()["flow_cache"]["hits"] == 3
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_epoch_flip_structurally_invalidates(tmp_path):
+    """A policy flip retires every armed row wholesale (epoch in the
+    key): the next frame is judged by the NEW table, the invalidation
+    is counted, and re-arming under the new epoch only happens when
+    the new table still carries an invariant claim."""
+    svc, client, mod = _start(tmp_path, "flip")
+    try:
+        shim = _conn(client, mod, 1, remote=1)
+        time.sleep(0.2)
+        assert shim.on_io(False, b"WRITE /x\r\n")[1] == b"WRITE /x\r\n"
+        assert client.cache_hits == 1
+        # New epoch: remote 1 now byte-constrained (READ only).
+        pol = NetworkPolicy(
+            name="fcpol", policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(port=80, rules=[
+                    PortNetworkPolicyRule(
+                        remote_policies=[1], l7_proto="r2d2",
+                        l7_rules=[{"cmd": "READ"}],
+                    ),
+                ]),
+            ],
+        )
+        assert client.policy_update(mod, [pol]) == int(FilterResult.OK)
+        # The stale grant is structurally dead: WRITE must now DENY.
+        res, out = shim.on_io(False, b"WRITE /x\r\n")
+        assert res == int(FilterResult.OK)
+        assert out == b"", "stale cached verdict served after the flip"
+        st = svc.status()["flow_cache"]
+        assert st["invalidations"] >= 1
+        assert st["armed"] == 0  # READ-only table: no claim to re-arm
+        assert client.cache_hits == 1  # no further shim hits
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_quarantine_demotion_disarms_and_heal_rearms(tmp_path):
+    """The demotion path re-arms invariance from the rebound engine:
+    a conn demoted to the oracle loses its cache row (its residue
+    lives outside the claim's clean-flow gate), and the heal rebind
+    re-arms it under the same epoch."""
+    svc, client, mod = _start(tmp_path, "demote")
+    try:
+        _conn(client, mod, 1, remote=1)
+        assert svc._tab_cache[1] == 1
+        with svc._lock:
+            sc = svc._conns[1]
+        svc._demote_to_oracle(1, sc)
+        assert svc._tab_cache[1] == 0, "demotion must disarm"
+        assert sc.demoted_mod is not None
+        inv0 = svc.cache_invalidations
+        assert inv0 >= 1
+        # Heal: residue drained (none was created), rebind re-arms.
+        svc._maybe_rebind(1, sc)
+        assert sc.engine is not None
+        assert svc._tab_cache[1] == 1, "heal rebind must re-arm"
+        assert svc._tab_cache_epoch[1] == svc.policy_epoch
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_conn_id_reuse_retires_stale_grant(tmp_path):
+    """A stale grant frame landing after close must not let a REUSED
+    conn id inherit the old identity's allow: the reader retires the
+    row when it processes the reuse's MSG_CONN_RESULT (socket-ordered
+    before the new conn's own grant, after any stale one), and the
+    service revalidates rows at send time."""
+    from cilium_tpu.sidecar import wire
+
+    svc, client, mod = _start(tmp_path, "reuse")
+    try:
+        shim = _conn(client, mod, 7, remote=1)
+        time.sleep(0.2)
+        b0 = client.bytes_pushed
+        res, out = shim.on_io(False, b"READ /a\r\n")
+        assert out == b"READ /a\r\n" and client.bytes_pushed == b0
+        client.close_connection(7)
+        # Simulate an in-flight stale grant applied AFTER the close
+        # (the close's client-side drop already ran).
+        client._on_cache_grant(wire.pack_cache_grant(
+            7, int(client._service_epoch), 0,
+        ))
+        assert client._grant_valid(7), "stale grant must be armed"
+        # Reuse the id for a byte-CONSTRAINED identity: registration
+        # must retire the stale row, so the denied frame is judged by
+        # the device walk, never locally allowed.
+        shim2 = _conn(client, mod, 7, remote=2)
+        assert not client._grant_valid(7), (
+            "reuse registration must retire the stale grant"
+        )
+        b1 = client.bytes_pushed
+        res, out = shim2.on_io(False, b"READ /secret\r\n")
+        assert out == b"", "byte-constrained identity locally allowed"
+        assert client.bytes_pushed > b1, "denied frame never crossed"
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_cache_off_is_true_baseline(tmp_path):
+    """flow_cache=False gates EVERY short-circuit site: no grants, no
+    arming, no cached records, counters absent from status."""
+    svc, client, mod = _start(tmp_path, "off", flow_cache=False)
+    try:
+        shim = _conn(client, mod, 1, remote=1)
+        time.sleep(0.2)
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert out == b"HALT\r\n"
+        assert client.cache_hits == 0
+        assert svc.status()["flow_cache"] is None
+        assert int(svc._tab_cache[1]) == 0
+        recs = client.observe(n=100, path="cached")["records"]
+        assert recs == []
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()
+
+
+def test_pipelined_whole_item_tier_rides_completion_fifo(tmp_path):
+    """Pipelined (completion-pipeline) mode: a fully-hit matrix batch
+    is answered through the send FIFO with the vec path's exact
+    all-allow frame shape — (PASS n, MORE 1) per entry."""
+    from cilium_tpu.proxylib.types import MORE, PASS
+
+    svc, client, mod = _start(
+        tmp_path, "pipe", client_cache=False, batch_timeout_ms=0.25,
+    )
+    try:
+        for cid in (1, 2):
+            _conn(client, mod, cid, remote=1)
+        import threading
+
+        got = {}
+        evt = threading.Event()
+        client.verdict_callback = (
+            lambda vb: (got.__setitem__(vb.seq, vb), evt.set())
+        )
+        rows = np.zeros((2, 64), np.uint8)
+        f = b"READ /a\r\n"
+        rows[:, : len(f)] = np.frombuffer(f, np.uint8)
+        client.send_matrix(
+            7, 64, np.array([1, 2], np.uint64),
+            np.full(2, len(f), np.uint32), rows.tobytes(),
+            complete=True,
+        )
+        assert evt.wait(60)
+        vb = got[7]
+        for i in range(vb.count):
+            _cid, res, ops, io_, ir = vb.entry(i)
+            assert res == int(FilterResult.OK)
+            assert ops == [(int(PASS), len(f)), (int(MORE), 1)]
+            assert io_ == b"" and ir == b""
+        assert svc.status()["flow_cache"]["hits"] == 2
+    finally:
+        client.close()
+        svc.stop()
+        inst.reset_module_registry()

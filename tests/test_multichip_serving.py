@@ -135,6 +135,7 @@ def test_mesh_service_serves_sharded_bit_identical(tmp_path):
         assert st == {
             "devices": 8, "flow_shards": 4, "rule_shards": 2,
             "active": True, "demoted": None, "demotions": {},
+            "repromotions": 0,
         }
         # Single-chip control, same traffic.
         inst.reset_module_registry()
@@ -509,6 +510,82 @@ def test_device_loss_demotes_typed_zero_silent_loss(tmp_path):
         assert st["mesh"]["demotions"] == {"device-call": 1}
         # New engine builds while demoted are single-chip.
         assert svc._serving_mesh() is None
+    finally:
+        if client is not None:
+            client.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()
+
+
+def test_mesh_repromotes_after_heal_bit_identical(tmp_path):
+    """Guarded re-promotion (ROADMAP 1b): after a demotion, the timed
+    off-path re-probe rebuilds a sharded executable, parity-probes it
+    against the single-chip fallback, and flips the retained sharded
+    wrappers back — typed (mesh_repromotions_total), traffic-driven
+    pacing, and the healed mesh serves bit-identically."""
+    inst.reset_module_registry()
+    svc = client = None
+    try:
+        svc, client, mod = _start(
+            tmp_path, "mesh-heal", batch_timeout_ms=0.0,
+            mesh_reprobe_interval_s=0.05,
+        )
+        shim = _conn(client, mod, 50, 1)
+        res, out = shim.on_io(False, b"READ /public/a.txt\r\n")
+        assert out == b"READ /public/a.txt\r\n"
+
+        orig = svc._jit_for
+
+        def lost_device(cache, model, trace_fn, arg_fn=None):
+            if isinstance(model, ShardedVerdictModel):
+                def boom(*_a, **_k):
+                    raise RuntimeError("PJRT_Error: device lost")
+
+                return boom
+            return orig(cache, model, trace_fn, arg_fn)
+
+        svc._jit_for = lost_device
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        assert svc.status()["mesh"]["demoted"] == "device-call"
+        # Device heals: the fault injection is removed.  The next
+        # paced re-probe (traffic-driven, like the quarantine heal)
+        # must rebuild + parity-probe off-path and flip back.
+        svc._jit_for = orig
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            res, out = shim.on_io(False, b"HALT\r\n")
+            assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+            if svc.status()["mesh"]["active"]:
+                break
+            time.sleep(0.05)
+        st = svc.status()
+        assert st["mesh"]["active"] is True, st["mesh"]
+        assert st["mesh"]["demoted"] is None
+        assert st["mesh"]["repromotions"] == 1
+        # Engines flipped BACK to the sharded wrappers.
+        eng = next(iter(svc._engines.values()))
+        assert isinstance(eng.model, ShardedVerdictModel)
+        # New builds shard again.
+        assert svc._serving_mesh() is not None
+        # Bit-identical service on the re-promoted mesh, nothing lost.
+        for i, (frame, remote, want) in enumerate(TRAFFIC):
+            s2 = _conn(client, mod, 70 + i, remote)
+            res, out = s2.on_io(False, frame)
+            assert res == int(FilterResult.OK)
+            assert (out == frame) == want, (frame, out)
+            s2.close()
+        st = svc.status()
+        assert st["containment"]["shed_entries"] == 0
+        assert st["containment"]["batch_crashes"] == 0
+        assert st["containment"]["error_entries"] == 0
+        # A second loss after the heal demotes AGAIN, typed — the
+        # rung stays re-entrant, never a crashed round.
+        svc._jit_for = lost_device
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        assert svc.status()["mesh"]["demotions"]["device-call"] == 2
     finally:
         if client is not None:
             client.close()

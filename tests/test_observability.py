@@ -304,12 +304,13 @@ def test_round_trace_stage_decomposition():
     rt.drained()
     stages = rt.stages()
     assert set(stages) == {
-        "ring", "queue", "table_swap", "reasm", "batch_form",
+        "ring", "queue", "table_swap", "reasm", "cache", "batch_form",
         "device_submit", "device", "drain", "send",
     }
     assert stages["ring"] == 0.0  # socket-delivered round: no ring wait
     assert stages["table_swap"] == 0.0  # no epoch swap blocked this round
     assert stages["reasm"] == 0.0  # scalar round: no columnar reassembly
+    assert stages["cache"] == 0.0  # no verdict-cache work this round
     assert 0.009 <= stages["queue"] <= 0.5
     assert all(v >= 0 for v in stages.values())
     # A shm-delivered round carves the ring wait OUT of the queue wait

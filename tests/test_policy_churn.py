@@ -65,6 +65,11 @@ def _policy(name: str, rules: list[dict], remotes=(1, 3)) -> NetworkPolicy:
 POLICY_A = [{"cmd": "READ", "file": "/public/.*"}, {"cmd": "HALT"}]
 POLICY_B = [{"cmd": "HALT"}, {"cmd": "WRITE", "file": "/tmp/.*"},
             {"cmd": "RESET"}]
+# Byte-FREE first row (a blank matcher admits everything): identities
+# it admits get an invariant-allow verdict-cache claim at rule 0 —
+# the flow-cache soak alternates this with POLICY_B so every flip
+# drives arm -> wholesale invalidation -> no-claim re-check.
+POLICY_CACHEABLE = [{}, {"cmd": "HALT"}]
 
 
 def _start(tmp_path, greedy=True, name="churn", **cfg_kw):
@@ -434,13 +439,18 @@ def _expected_kinds(rules: list[dict]) -> tuple:
 
 
 def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
-                n_conns: int = 8, **cfg_kw):
+                n_conns: int = 8, policy_pair=None, **cfg_kw):
     """The acceptance scenario: continuous policy updates + endpoint
     regeneration + identity allocate/release across an injected
-    kvstore failover, against live mixed traffic."""
+    kvstore failover, against live mixed traffic.  ``policy_pair``
+    overrides the two alternating rule generations (the flow-cache
+    soak alternates a byte-free table — armed cache — with a
+    byte-constrained one, so every flip exercises arm → invalidate →
+    re-check)."""
     from cilium_tpu.kvstore import ChaosProxy, KvstoreFollower, KvstoreServer, NetBackend
     from cilium_tpu.kvstore.allocator import Allocator
 
+    pol_even, pol_odd = policy_pair or (POLICY_A, POLICY_B)
     svc, client, mod = _start(
         tmp_path, name=f"soak{duration_s:g}", **cfg_kw
     )
@@ -459,12 +469,26 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
     id_by_key: dict[str, int] = {}
 
     try:
-        assert client.policy_update(mod, [_policy("pol", POLICY_A)]) == int(
+        assert client.policy_update(mod, [_policy("pol", pol_even)]) == int(
             FilterResult.OK
         )
-        epoch_rules[client.last_policy_epoch] = _expected_kinds(POLICY_A)
+        epoch_rules[client.last_policy_epoch] = _expected_kinds(pol_even)
+        epoch_rule_dicts = {client.last_policy_epoch: pol_even}
 
         shims = {i: _conn(client, mod, i) for i in range(1, n_conns + 1)}
+        # Warm BOTH alternating generations' engine compiles before the
+        # timed window (engines rebuild per flip only for BOUND conns,
+        # so this must come after the conns): the first cold build of a
+        # new automaton shape costs seconds on the CPU backend, and a
+        # soak whose entire window is one cold compile churns nothing.
+        for warm_rules in (pol_odd, pol_even):
+            assert client.policy_update(
+                mod, [_policy("pol", warm_rules)]
+            ) == int(FilterResult.OK)
+            epoch_rules[client.last_policy_epoch] = (
+                _expected_kinds(warm_rules)
+            )
+            epoch_rule_dicts[client.last_policy_epoch] = warm_rules
         next_cid = [n_conns + 1]
         frames = [b"READ /public/a\r\n", b"READ /secret\r\n", b"HALT\r\n",
                   b"WRITE /tmp/x\r\n", b"RESET\r\n"]
@@ -472,6 +496,14 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
         def traffic():
             i = 0
             while not stop.is_set():
+                # Pace each sweep: with the verdict cache armed the
+                # shim answers locally and this loop would become a
+                # pure-CPU GIL spin that starves the builder thread's
+                # off-path compiles (observed: one 0.2ms flip serialized
+                # behind ~6s of starved XLA build).  Real datapaths are
+                # I/O-paced; a sub-ms yield keeps the soak honest
+                # without changing its load shape.
+                time.sleep(0.0005)
                 for cid, shim in list(shims.items()):
                     try:
                         res, _ = shim.on_io(False, frames[i % len(frames)])
@@ -497,12 +529,13 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
             gen = 0
             while not stop.is_set():
                 gen += 1
-                rules = POLICY_B if gen % 2 else POLICY_A
+                rules = pol_odd if gen % 2 else pol_even
                 st = client.policy_update(mod, [_policy("pol", rules)])
                 if st == int(FilterResult.OK):
                     epoch_rules[client.last_policy_epoch] = (
                         _expected_kinds(rules)
                     )
+                    epoch_rule_dicts[client.last_policy_epoch] = rules
                 else:
                     errors.append(f"policy_update status {st}")
                     return
@@ -592,6 +625,52 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
             )
             checked += 1
         assert checked > 0
+        # Verdict-cache parity gate (PR 12): with the cache armed,
+        # every cached record's (verdict, rule id, epoch) is
+        # re-validated against a COLD recompute of that epoch's table —
+        # the invariance claim itself plus a per-frame host walk over
+        # the traffic corpus.  Stale epochs are structurally impossible
+        # (asserted: no cached record under a byte-constrained epoch).
+        if cfg_kw.get("flow_cache"):
+            from cilium_tpu.models.r2d2 import collect_policy_rows
+            from cilium_tpu.policy.invariance import (
+                invariant_verdict,
+                reduce_r2d2_rows,
+            )
+            from cilium_tpu.proxylib.parsers.r2d2 import R2d2RequestData
+            from cilium_tpu.proxylib.policy import compile_policy
+
+            fc = st["flow_cache"]
+            total_hits = client.cache_hits + fc["hits"]
+            assert total_hits > 0, (client.cache_hits, fc)
+            assert fc["invalidations"] > 0, fc  # flips retired rows
+            cached_recs = [r for r in recs if r.get("path") == "cached"]
+            for rec in cached_recs:
+                ep = rec["epoch"]
+                assert ep in epoch_rule_dicts, rec
+                pol_obj = compile_policy(
+                    _policy("pol", epoch_rule_dicts[ep])
+                )
+                rows = collect_policy_rows(pol_obj, True, 80)
+                assert isinstance(rows, list), rows
+                inv = invariant_verdict(reduce_r2d2_rows(rows), 1)
+                # The cache only arms invariant-ALLOW claims, and the
+                # record must name the claim's exact first-match row.
+                assert inv is not None and inv[0] is True, (
+                    f"cached record under a non-invariant epoch: {rec}"
+                )
+                assert rec["verdict"] == "Forwarded", rec
+                assert rec["rule_id"] == inv[1], (rec, inv)
+                # Per-frame cold recompute over the corpus: every
+                # frame's host walk agrees with the cached claim.
+                for f in frames:
+                    parts = f[:-2].decode().split(" ")
+                    cmd = parts[0]
+                    file_ = parts[1] if len(parts) > 1 else ""
+                    host = pol_obj.matches_at(
+                        True, 80, 1, R2d2RequestData(cmd, file_)
+                    )
+                    assert host == (True, inv[1]), (f, host, inv, rec)
         # Identity churn stayed sane across the failover.
         assert follower.promoted.is_set()
         assert len(set(id_by_key.values())) == len(id_by_key), (
@@ -611,6 +690,21 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
 def test_churn_soak_fast(tmp_path):
     """Tier-1 churn soak: seconds-scale, full scenario."""
     _churn_soak(tmp_path, duration_s=6.0, updates_per_s=4.0)
+
+
+def test_churn_soak_fast_flow_cache(tmp_path):
+    """The churn soak with the verdict cache ARMED: one alternating
+    generation is a byte-free table (every conn's claim arms at bind),
+    the other is byte-constrained (no claim) — so every flip drives
+    arm → wholesale epoch invalidation → re-check.  On top of the
+    standard zero-loss / cross-epoch-attribution gates, every cached
+    record is re-validated against a cold recompute of its epoch's
+    table (the cached == recomputed parity gate)."""
+    _churn_soak(
+        tmp_path, duration_s=5.0, updates_per_s=4.0,
+        policy_pair=(POLICY_CACHEABLE, POLICY_B),
+        flow_cache=True,
+    )
 
 
 def test_churn_soak_fast_mesh(tmp_path):

@@ -120,6 +120,7 @@ _CORPUS_CASES = [
     "r10_bad_specs.py",
     "r11_bad_second_pass.py",
     "r12_bad_compile_hot",
+    "r13_bad_unkeyed_cache",
 ]
 
 _CORPUS_CLEAN = [
@@ -145,6 +146,7 @@ _CORPUS_CLEAN = [
     "r10_good_specs.py",
     "r11_good_fused.py",
     "r12_good_prebuilt",
+    "r13_good_epoch_keyed",
 ]
 
 
@@ -191,6 +193,21 @@ def test_catches_inverted_lock_order():
     active, _ = split_findings(analyze_paths([path]))
     assert any("lock-order inversion" in f.message for f in active)
     assert any("self-deadlock" in f.message for f in active)
+
+
+def test_r13_nested_closure_reported_exactly_once():
+    """A cache store inside a closure is the CLOSURE's finding only:
+    the parent function's walk prunes nested bodies (ast.walk would
+    re-yield the same Assign under both, double-reporting every
+    closure cache site and inflating the suppression ratchet).  The
+    corpus gate's marker SET cannot see multiplicity — pin it here."""
+    path = os.path.join(CORPUS, "r13_bad_unkeyed_cache")
+    active, _ = split_findings(analyze_paths([path]))
+    lines = [f.line for f in active if f.rule == "R13"]
+    assert len(lines) == len(set(lines)), (
+        f"duplicate R13 findings at lines {sorted(lines)}"
+    )
+    assert any(f.symbol == "commit" for f in active if f.rule == "R13")
 
 
 def test_catches_dead_metric_and_hot_loop_observe():
