@@ -534,6 +534,204 @@ def bench_memcached():
     return rate, cpu_rate
 
 
+# --- config: DNS name-policy engine ---------------------------------------
+
+def bench_dns():
+    """DNS name-policy engine (ISSUE 13): model-level verdicts/s with a
+    fenced per-call p99, an in-process CPU-oracle cross-check, and a
+    service-level segment of split/pipelined DNS-over-TCP frames that
+    must ENGAGE the columnar length-prefixed lane —
+    ``status()["reasm"]["rounds_by_framing"]["dns"] > 0`` is asserted,
+    so a silent fallback to the scalar rung cannot pass."""
+    import threading
+
+    import jax
+
+    from cilium_tpu.models.dns import build_dns_model
+    from cilium_tpu.proxylib import (
+        FilterResult,
+        NetworkPolicy,
+        PASS,
+        PortNetworkPolicy,
+        PortNetworkPolicyRule,
+        find_instance,
+        open_module,
+        reset_module_registry,
+    )
+    from cilium_tpu.proxylib.instance import on_new_connection
+    from cilium_tpu.proxylib.parsers.dns import encode_dns_query
+
+    policy_cfg = NetworkPolicy(
+        name="bench",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=53,
+                rules=[
+                    PortNetworkPolicyRule(
+                        l7_proto="dns",
+                        l7_rules=[
+                            {"matchName": "api.example.com"},
+                            {"matchPattern": "*.svc.cluster.local"},
+                            {"matchRegex": "^cdn[0-9]+[.]edge[.]net$"},
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+    reset_module_registry()
+    mod = open_module([], True)
+    ins = find_instance(mod)
+    ins.policy_update([policy_cfg])
+    model = build_dns_model(ins.policy_map()["bench"], ingress=True, port=53)
+
+    rng = random.Random(13)
+    msgs = []
+    for _ in range(1024):
+        roll = rng.random()
+        if roll < 0.3:
+            msgs.append(encode_dns_query("api.example.com"))
+        elif roll < 0.55:
+            msgs.append(encode_dns_query(
+                f"pod{rng.randrange(1000)}.svc.cluster.local"
+            ))
+        elif roll < 0.7:
+            msgs.append(encode_dns_query(
+                f"cdn{rng.randrange(100)}.edge.net"
+            ))
+        else:
+            msgs.append(encode_dns_query(
+                f"evil{rng.randrange(1000)}.test"
+            ))
+
+    F, L = 65536, 64
+    data = np.zeros((F, L), np.uint8)
+    lengths = np.zeros((F,), np.int32)
+    for i in range(F):
+        m = msgs[i % len(msgs)]
+        data[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lengths[i] = len(m)
+    remotes = np.ones((F,), np.int32)
+
+    fn = type(model).__call__
+    rate = _pipelined_rate(fn, (model, data, lengths, remotes), F)
+
+    # Fenced per-call p99: each call's np.asarray readback IS the
+    # fence, so the distribution is whole-batch wall time, not launch
+    # time.
+    d_dev = jax.device_put(data)
+    l_dev = jax.device_put(lengths)
+    r_dev = jax.device_put(remotes)
+    jfn = jax.jit(fn)
+    _fence(jfn(model, d_dev, l_dev, r_dev))
+    lats = []
+    for _ in range(12):
+        t0 = time.perf_counter()
+        _fence(jfn(model, d_dev, l_dev, r_dev))
+        lats.append(time.perf_counter() - t0)
+    lats.sort()
+    p99_ms = lats[min(int(len(lats) * 0.99), len(lats) - 1)] * 1e3
+
+    # CPU oracle (full in-process proxylib parse+match) + cross-check.
+    n_cpu = 2000
+    res, conn = on_new_connection(
+        mod, "dns", 1, True, 1, 2, "1.1.1.1:1", "2.2.2.2:53", "bench"
+    )
+    assert res == FilterResult.OK
+    t0 = time.perf_counter()
+    oracle_allows = []
+    for i in range(n_cpu):
+        ops = []
+        conn.on_data(False, False, [msgs[i % len(msgs)]], ops)
+        oracle_allows.append(ops[0][0] == PASS)
+        conn.reply_buf.take()
+    cpu_rate = n_cpu / (time.perf_counter() - t0)
+    dev_allow = np.asarray(fn(model, data, lengths, remotes)[2])
+    mism = sum(
+        1 for i in range(min(n_cpu, F))
+        if bool(dev_allow[i]) != oracle_allows[i % len(oracle_allows)]
+    )
+    assert mism == 0, f"dns device verdicts diverge from oracle ({mism})"
+
+    # --- service-level segment: the columnar length-prefixed lane ----
+    from cilium_tpu.proxylib import instance as inst
+    from cilium_tpu.sidecar.client import SidecarClient
+    from cilium_tpu.sidecar.service import VerdictService
+    from cilium_tpu.utils.option import DaemonConfig
+
+    inst.reset_module_registry()
+    path = "/tmp/cilium_tpu_bench_dns.sock"
+    svc = VerdictService(path, DaemonConfig(
+        batch_flows=256, batch_timeout_ms=0.25, batch_width=64,
+        reasm=True, reasm_min_entries=1,
+    )).start()
+    try:
+        cl = SidecarClient(path, timeout=120.0)
+        smod = cl.open_module([])
+        assert cl.policy_update(smod, [policy_cfg]) == int(FilterResult.OK)
+        got, evt = {}, threading.Event()
+
+        def cb(vb):
+            got[vb.seq] = vb.count
+            evt.set()
+
+        cl.verdict_callback = cb
+        n_conns = 32
+        for cid in range(1, n_conns + 1):
+            r, _ = cl.new_connection(
+                smod, "dns", cid, True, 1, 2, "1.1.1.1:1",
+                "2.2.2.2:53", "bench",
+            )
+            assert r == int(FilterResult.OK)
+        seq = 0
+        n_rounds = 24
+        for rnd in range(n_rounds):
+            entries = []
+            for cid in range(1, n_conns + 1):
+                f = msgs[(cid + rnd) % len(msgs)]
+                if cid % 3 == 0:  # split mid-QNAME across round pairs
+                    # Same frame on both halves (rnd//2 anchors the
+                    # pick), so the carry really reassembles.
+                    fs = msgs[(cid + rnd // 2) % len(msgs)]
+                    half = len(fs) // 2
+                    entries.append(
+                        (cid, fs[:half] if rnd % 2 == 0 else fs[half:])
+                    )
+                elif cid % 3 == 1:  # pipelined pair
+                    entries.append((cid, f + msgs[(cid + rnd + 1) % len(msgs)]))
+                else:  # whole frame
+                    entries.append((cid, f))
+            seq += 1
+            cids = np.array([e[0] for e in entries], np.uint64)
+            fl = np.zeros(len(entries), np.uint8)
+            lens = np.array([len(e[1]) for e in entries], np.uint32)
+            cl.send_batch(seq, cids, fl, lens, b"".join(e[1] for e in entries))
+            deadline = time.monotonic() + 60
+            while seq not in got and time.monotonic() < deadline:
+                evt.wait(0.2)
+                evt.clear()
+            assert seq in got, f"dns bench round {seq} unanswered"
+        st = svc.status()["reasm"]
+        dns_rounds = (st or {}).get("rounds_by_framing", {}).get("dns", 0)
+        assert dns_rounds > 0, (
+            "dns columnar lane never engaged (silent scalar fallback): "
+            f"{st}"
+        )
+        cl.close()
+    finally:
+        svc.stop()
+        inst.reset_module_registry()
+
+    print(
+        f"bench dns: tpu={rate:,.0f}/s fenced_p99={p99_ms:.2f}ms "
+        f"cpu={cpu_rate:,.0f}/s reasm_dns_rounds={dns_rounds} "
+        f"mismatches=0/{n_cpu}",
+        file=sys.stderr,
+    )
+    return rate, p99_ms, cpu_rate, dns_rounds
+
+
 def bench_kvstore_failover(cycles: int = 5):
     """Failover cost of the fenced cluster-state plane, measured
     through the chaos proxy: steady client write rate, then a full
@@ -640,6 +838,13 @@ STRESS_KAFKA_POLICIES = 50
 STRESS_KAFKA_RULES = 100
 STRESS_CASS_POLICIES = 50
 STRESS_CASS_RULES = 40
+# DNS slice (ISSUE 13): 16 exact-name rules per policy (needle tier) +
+# 4 wildcard patterns with policy-independent TEXT (shared automaton
+# shape, same stacking constraint as the http regex tier).
+STRESS_DNS_POLICIES = 50
+STRESS_DNS_EXACT_RULES = 16
+STRESS_DNS_PATTERN_RULES = 4
+STRESS_DNS_FLOWS = 100_000
 STRESS_FLOWS = 1_000_000
 
 
@@ -672,6 +877,17 @@ def _stress_nfa_path(j: int) -> str:
     # genuine NFA-tier load, not DFA load under another name.
     tail = "(a|b)" * 7
     return f"/n{j:02d}/(a|b)*a{tail}/x"
+
+
+def _stress_dns_pattern(j: int) -> str:
+    # Policy-independent pattern text (same reason as
+    # _stress_regex_path: identical automaton shapes stack into one
+    # [P, ...] pytree).
+    return f"*.w{j:02d}.svc.local"
+
+
+def _stress_dns_name(p: int, j: int) -> str:
+    return f"s{j:02d}.p{p:03d}.svc.local"
 
 
 def _stress_http_models():
@@ -736,6 +952,17 @@ def bench_stress():
     )
     from cilium_tpu.policy.api import PortRuleKafka
 
+    from cilium_tpu.models.dns import (
+        build_dns_model_from_rows,
+        dns_verdicts,
+    )
+    from cilium_tpu.proxylib.parsers.dns import (
+        DNS_QNAME_OFF,
+        DnsRequestData,
+        DnsRule,
+        encode_dns_query,
+        parse_dns_query,
+    )
     from cilium_tpu.models.cassandra import (
         build_cassandra_model,
         cassandra_verdicts,
@@ -752,9 +979,11 @@ def bench_stress():
     n_http_flows = STRESS_FLOWS // 2
     n_cass_flows = STRESS_FLOWS // 5
     n_kafka_flows = STRESS_FLOWS - n_http_flows - n_cass_flows
+    n_dns_flows = STRESS_DNS_FLOWS
     per_http = n_http_flows // STRESS_HTTP_POLICIES
     per_kafka = n_kafka_flows // STRESS_KAFKA_POLICIES
     per_cass = n_cass_flows // STRESS_CASS_POLICIES
+    per_dns = n_dns_flows // STRESS_DNS_POLICIES
 
     t_build0 = time.perf_counter()
     http_models, http_rx_model, http_nfa_model, (http_tier, _) = (
@@ -945,6 +1174,63 @@ def bench_stress():
         np.stack([part[k] for part in cass_parts]) for k in range(4)
     )
 
+    # DNS policies: per-policy exact names (needle tier) + shared-text
+    # wildcard patterns (automaton tier) — ISSUE 13's stress slice.
+    dns_rule_objs = []
+    dns_models = []
+    for p in range(STRESS_DNS_POLICIES):
+        rules = [
+            DnsRule(name=_stress_dns_name(p, j))
+            for j in range(STRESS_DNS_EXACT_RULES)
+        ] + [
+            DnsRule(pattern=_stress_dns_pattern(j))
+            for j in range(STRESS_DNS_PATTERN_RULES)
+        ]
+        dns_rule_objs.append(rules)
+        dns_models.append(
+            build_dns_model_from_rows([(frozenset(), r) for r in rules])
+        )
+    L_DNS = 64
+    dns_data = np.zeros((STRESS_DNS_POLICIES, per_dns, L_DNS), np.uint8)
+    dns_len = np.zeros((STRESS_DNS_POLICIES, per_dns), np.int32)
+    dns_labels = np.zeros((STRESS_DNS_POLICIES, per_dns), bool)
+    dns_samples = []  # (frame, policy, ok) for the oracle spot-check
+    for p in range(STRESS_DNS_POLICIES):
+        for i in range(per_dns):
+            roll = rng.random()
+            if roll < 0.30:  # exact-name hit
+                j = rng.randrange(STRESS_DNS_EXACT_RULES)
+                frame, ok = encode_dns_query(_stress_dns_name(p, j)), True
+            elif roll < 0.42:  # exact hit, mixed case (0x20 folding)
+                j = rng.randrange(STRESS_DNS_EXACT_RULES)
+                frame, ok = (
+                    encode_dns_query(_stress_dns_name(p, j).upper()), True,
+                )
+            elif roll < 0.62:  # wildcard hit: one+ leading labels
+                j = rng.randrange(STRESS_DNS_PATTERN_RULES)
+                depth = "a.b." if rng.random() < 0.3 else f"h{i % 7}."
+                frame, ok = (
+                    encode_dns_query(f"{depth}w{j:02d}.svc.local"), True,
+                )
+            elif roll < 0.72:  # wildcard miss: zero leading labels
+                j = rng.randrange(STRESS_DNS_PATTERN_RULES)
+                frame, ok = encode_dns_query(f"w{j:02d}.svc.local"), False
+            elif roll < 0.92:  # unknown name
+                frame, ok = (
+                    encode_dns_query(f"x{rng.randrange(100)}.other.local"),
+                    False,
+                )
+            else:  # structurally invalid QNAME (compression pointer)
+                bad = bytearray(encode_dns_query("bad.svc.local"))
+                bad[DNS_QNAME_OFF] = 0xC0
+                frame, ok = bytes(bad), False
+            row = np.frombuffer(frame, np.uint8)
+            dns_data[p, i, : len(row)] = row
+            dns_len[p, i] = len(row)
+            dns_labels[p, i] = ok
+            if len(dns_samples) < 300 and i < 6:
+                dns_samples.append((frame, p, ok))
+
     # Stack per-policy models into [P, ...] pytrees (shared shapes).
     import jax.numpy as jnp
 
@@ -954,6 +1240,10 @@ def bench_stress():
     kafka_stack = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *kafka_models
     )
+    dns_stack = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *dns_models
+    )
+    rem_dns = np.ones((STRESS_DNS_POLICIES, per_dns), np.int32)
     rem_http = np.ones((STRESS_HTTP_POLICIES, per_http), np.int32)
     rem_kafka = np.ones((STRESS_KAFKA_POLICIES, per_kafka), np.int32)
     rem_cass = np.ones((STRESS_CASS_POLICIES, per_cass), np.int32)
@@ -981,6 +1271,11 @@ def bench_stress():
         lambda ms, bs, rms: jax.lax.map(
             lambda args: kafka_verdicts(args[0], args[1], args[2]),
             (ms, bs, rms),
+        )
+    )
+    dns_replay = jax.jit(
+        lambda ms, ds, lns, rms: jax.lax.map(
+            lambda args: dns_verdicts(*args)[2], (ms, ds, lns, rms)
         )
     )
     # One SHARED cassandra model over the flattened flow batch (the
@@ -1012,9 +1307,13 @@ def bench_stress():
         for x in cass_stacked
     )
     cr = jax.device_put(rem_cass.reshape(CASS_CHUNKS, -1))
+    dd = jax.device_put(dns_data)
+    dl = jax.device_put(dns_len)
+    dr = jax.device_put(rem_dns)
 
     # --- warm (compile) the executables, then the timed replay
     np.asarray(http_replay(http_stack, hd, hl, hr))
+    np.asarray(dns_replay(dns_stack, dd, dl, dr))
     np.asarray(http_rx_replay(http_rx_model, hd_flat, hl_flat, hr_flat))
     np.asarray(http_rx_replay(http_nfa_model, hd_flat, hl_flat, hr_flat))
     np.asarray(kafka_replay(kafka_stack, kb, kr))
@@ -1030,6 +1329,7 @@ def bench_stress():
     )
     kafka_allow = kafka_replay(kafka_stack, kb, kr)
     cass_allow = cass_replay(cass_model, *cb, cr)
+    dns_allow = dns_replay(dns_stack, dd, dl, dr)
     http_allow = (
         np.asarray(http_allow)
         | np.asarray(http_rx_allow).reshape(
@@ -1043,8 +1343,9 @@ def bench_stress():
     cass_allow = np.asarray(cass_allow).reshape(
         STRESS_CASS_POLICIES, per_cass
     )
+    dns_allow = np.asarray(dns_allow)
     dt = time.perf_counter() - t0
-    n_total = n_http_flows + n_kafka_flows + n_cass_flows
+    n_total = n_http_flows + n_kafka_flows + n_cass_flows + n_dns_flows
     rate = n_total / dt
 
     # --- bit-check every verdict against the generation labels
@@ -1052,6 +1353,7 @@ def bench_stress():
         int((http_allow != http_labels).sum())
         + int((kafka_allow != kafka_labels).sum())
         + int((cass_allow != cass_labels).sum())
+        + int((dns_allow != dns_labels).sum())
     )
     assert mism == 0, f"stress verdicts diverge from labels ({mism})"
 
@@ -1074,6 +1376,14 @@ def bench_stress():
             assert want == kafka_labels[p, i], (
                 f"kafka label oracle mismatch: {r!r}"
             )
+    for frame, p, ok in dns_samples[:200]:
+        name = parse_dns_query(frame)
+        req = DnsRequestData(
+            name=name if name is not None else "",
+            valid=name is not None,
+        )
+        want = any(r.matches(req) for r in dns_rule_objs[p])
+        assert want == ok, f"dns label oracle mismatch: {frame!r}"
     for action, table, ok in cass_samples[:200]:
         want = any(
             (_cass_rule(j)["query_action"] == action)
@@ -1086,6 +1396,8 @@ def bench_stress():
         STRESS_HTTP_POLICIES * STRESS_HTTP_RULES
         + STRESS_KAFKA_POLICIES * STRESS_KAFKA_RULES
         + STRESS_CASS_POLICIES * STRESS_CASS_RULES
+        + STRESS_DNS_POLICIES
+        * (STRESS_DNS_EXACT_RULES + STRESS_DNS_PATTERN_RULES)
     )
     print(
         f"bench stress: {n_total:,} flows / {n_rules:,} rules in {dt:.2f}s "
@@ -1093,7 +1405,8 @@ def bench_stress():
         f"{STRESS_HTTP_POLICIES} policies incl {STRESS_HTTP_REGEX_RULES} "
         f"{http_tier} + {STRESS_HTTP_NFA_RULES} DeviceNfa regex rules, "
         f"kafka {n_kafka_flows:,} @ {STRESS_KAFKA_POLICIES}, cassandra-"
-        f"regex {n_cass_flows:,} @ {STRESS_CASS_POLICIES}), mismatches=0",
+        f"regex {n_cass_flows:,} @ {STRESS_CASS_POLICIES}, dns "
+        f"{n_dns_flows:,} @ {STRESS_DNS_POLICIES}), mismatches=0",
         file=sys.stderr,
     )
     return rate, dt, http_tier
@@ -2499,8 +2812,12 @@ def run_one(which: str) -> None:
             "verdicts/s", rate / 1_000_000,
             rules=STRESS_HTTP_POLICIES * STRESS_HTTP_RULES
             + STRESS_KAFKA_POLICIES * STRESS_KAFKA_RULES
-            + STRESS_CASS_POLICIES * STRESS_CASS_RULES,
-            flows=STRESS_FLOWS, replay_seconds=round(dt, 2),
+            + STRESS_CASS_POLICIES * STRESS_CASS_RULES
+            + STRESS_DNS_POLICIES
+            * (STRESS_DNS_EXACT_RULES + STRESS_DNS_PATTERN_RULES),
+            flows=STRESS_FLOWS + STRESS_DNS_FLOWS,
+            replay_seconds=round(dt, 2),
+            dns_policies=STRESS_DNS_POLICIES,
             http_tier_mix={
                 "literal_rules_per_policy": STRESS_HTTP_RULES
                 - STRESS_HTTP_REGEX_RULES - STRESS_HTTP_NFA_RULES,
@@ -2543,6 +2860,17 @@ def run_one(which: str) -> None:
             budget_ms=out["budget_ms"],
             assertion_armed=out["on_chip"],
         )
+    elif which == "dns":
+        rate, p99_ms, cpu, dns_rounds = bench_dns()
+        _emit("dns_l7_verdicts_per_sec_per_chip", rate, "verdicts/s",
+              rate / 1_000_000,
+              fenced_p99_ms=round(p99_ms, 3),
+              cpu_oracle_per_sec=round(cpu),
+              reasm_dns_rounds=dns_rounds,
+              method="model-level pipelined rate + fenced per-call "
+                     "p99; service segment with split/pipelined "
+                     "frames asserts rounds_by_framing['dns'] > 0 "
+                     "(silent scalar fallback cannot pass)")
     elif which == "r2d2":
         rate, cpu = bench_r2d2()
         _emit("r2d2_l7_verdicts_per_sec_per_chip", rate, "verdicts/s",
@@ -2553,7 +2881,7 @@ def run_one(which: str) -> None:
 
 # Headline (r2d2) runs LAST so its JSON line is the final stdout line.
 CONFIGS = (
-    "http", "kafka", "cassandra", "memcached", "latency",
+    "http", "kafka", "cassandra", "memcached", "dns", "latency",
     "latency_colocated", "shm_transport", "mixed", "flow_cache",
     "datapath", "stress",
     "kvstore_failover", "verdict_overload", "verdict_trace_overhead",

@@ -80,9 +80,11 @@ def _model_cases():
     """Tiny-but-representative models per engine family, each touching
     every tier the builders have (literal, prefix, regex, header)."""
     from ..models.base import SeamProbe
+    from ..models.dns import build_dns_model_from_rows
     from ..models.http import build_http_model
     from ..models.r2d2 import build_r2d2_model_from_rows
     from ..policy.api import PortRuleHTTP
+    from ..proxylib.parsers.dns import DnsRule
 
     http = build_http_model([
         (frozenset(), PortRuleHTTP(method="GET", path="/api/v1/.*")),
@@ -96,9 +98,16 @@ def _model_cases():
         (frozenset({3}), "", "docs/[a-z]+[.]txt"),
         (frozenset({3, 9}), "RETR", ""),
     ])
+    dns = build_dns_model_from_rows([
+        (frozenset(), DnsRule(name="www.example.com")),
+        (frozenset({3}), DnsRule(pattern="*.svc.cluster.local")),
+        (frozenset({3, 9}), DnsRule(regex="internal[.](a|b)")),
+        (frozenset({7}), None),
+    ])
     return [
         ("http", "cilium_tpu/models/http.py", http),
         ("r2d2", "cilium_tpu/models/r2d2.py", r2d2),
+        ("dns", "cilium_tpu/models/dns.py", dns),
         ("seam_probe", "cilium_tpu/models/base.py", SeamProbe()),
     ]
 
@@ -250,12 +259,18 @@ def _check_sharded():
     import numpy as np
 
     from ..kafka.request import RequestMessage
+    from ..models.dns import (
+        build_dns_model_from_rows,
+        dns_verdicts,
+        dns_verdicts_attr,
+    )
     from ..models.kafka import build_kafka_model, encode_requests
     from ..models.r2d2 import (
         build_r2d2_model_from_rows,
         r2d2_verdicts,
         r2d2_verdicts_attr,
     )
+    from ..proxylib.parsers.dns import DnsRule
     from ..parallel import rulesharding
     from ..parallel.mesh import flow_mesh
     from ..policy.api import PortRuleKafka
@@ -268,6 +283,10 @@ def _check_sharded():
     model = build_r2d2_model_from_rows([
         (frozenset(), "OPEN", "/etc/.*"),
         (frozenset({3}), "", "docs/[a-z]+"),
+    ])
+    dmodel = build_dns_model_from_rows([
+        (frozenset(), DnsRule(name="www.example.com")),
+        (frozenset({3}), DnsRule(pattern="*.example.com")),
     ])
     kr = PortRuleKafka(topic="orders")
     kr.sanitize()
@@ -293,6 +312,10 @@ def _check_sharded():
         stacked = rulesharding._stack_models([model] * n_rule)
         for prob in check_stacked_model(stacked, mesh):
             fail(f"[device-contract:stacked@{n_flow}x{n_rule}] {prob}")
+        dstacked = rulesharding._stack_models([dmodel] * n_rule)
+        for prob in check_stacked_model(dstacked, mesh):
+            fail(f"[device-contract:dns-stacked@{n_flow}x{n_rule}] "
+                 f"{prob}")
         offsets = rulesharding.shard_offsets(2, n_rule)
         cases = (
             ("sharded_verdict_step",
@@ -302,6 +325,13 @@ def _check_sharded():
              rulesharding.sharded_verdict_step_attr(
                  mesh, r2d2_verdicts_attr),
              (stacked, offsets, data, lengths, remotes), 4),
+            ("sharded_dns_step",
+             rulesharding.sharded_verdict_step(mesh, dns_verdicts),
+             (dstacked, data, lengths, remotes), 3),
+            ("sharded_dns_step_attr",
+             rulesharding.sharded_verdict_step_attr(
+                 mesh, dns_verdicts_attr),
+             (dstacked, offsets, data, lengths, remotes), 4),
             ("sharded_kafka_step",
              rulesharding.sharded_kafka_step(mesh),
              (rulesharding._stack_models([kmodel] * n_rule),

@@ -36,7 +36,7 @@ from .core import Finding
 
 _HOT_BASENAMES = {
     "service.py", "dispatch.py", "client.py", "reasm.py", "shm.py",
-    "transport.py", "wire.py",
+    "transport.py", "wire.py", "dnsengine.py",
 }
 
 _CACHE_TOKENS = ("cache", "memo")

@@ -37,7 +37,8 @@ import re
 from .callgraph import get_graph
 from .core import Finding, call_func_name
 
-_HOT_BASENAMES = {"dispatch.py", "service.py", "shm.py"}
+_HOT_BASENAMES = {"dispatch.py", "service.py", "shm.py",
+                  "dnsengine.py"}
 
 # Functions that ARE the dispatch path in the hot modules: the round
 # entry + everything a round runs through, the pipeline loops, and the

@@ -47,7 +47,7 @@ _HOT_BASENAMES = {"dispatch.py", "service.py"}
 # Columnar-contract modules: code whose reason to exist is replacing
 # per-entry Python with array passes (sidecar/reasm.py and the mixed
 # bench's round builder).
-_COLUMNAR_BASENAMES = {"reasm.py", "mixbench.py"}
+_COLUMNAR_BASENAMES = {"reasm.py", "mixbench.py", "dnsengine.py"}
 _FEED_ATTRS = {"feed", "feed_extract", "settle_entry", "take_ops"}
 
 

@@ -484,13 +484,17 @@ def cmd_sidecar_status(args):
                  else "")
               + (f" demotions: {dem}" if dem else "")
               + (f" repromotions={mesh.get('repromotions', 0)}"
-                 if mesh.get("repromotions") else ""))
+                 if mesh.get("repromotions") else "")
+              + (f" rebind_rebuilds={mesh.get('rebind_rebuilds', 0)}"
+                 if mesh.get("rebind_rebuilds") else ""))
     fc = st.get("flow_cache") or {}
     if fc:
-        print(f"flow_cache: armed={fc.get('armed', 0)} "
+        print(f"flow_cache: armed={fc.get('armed', 0)}/"
+              f"{fc.get('cap', 0)} "
               f"hits={fc.get('hits', 0)} "
               f"misses={fc.get('misses', 0)} "
-              f"invalidations={fc.get('invalidations', 0)}")
+              f"invalidations={fc.get('invalidations', 0)} "
+              f"evictions={fc.get('evictions', 0)}")
     tr = st.get("transport") or {}
     if tr:
         rejects = " ".join(
@@ -526,7 +530,12 @@ def cmd_sidecar_status(args):
             f"{k}={v}"
             for k, v in sorted((rs.get("fallbacks") or {}).items())
         )
-        print(f"reasm: rounds={rs.get('rounds', 0)} "
+        by_f = " ".join(
+            f"{k}={v}"
+            for k, v in sorted((rs.get("rounds_by_framing") or {}).items())
+        )
+        print(f"reasm: rounds={rs.get('rounds', 0)}"
+              + (f" ({by_f})" if by_f else "") + " "
               f"entries={rs.get('entries', 0)} "
               f"frames={rs.get('frames', 0)} "
               f"overflows={rs.get('overflows', 0)} "

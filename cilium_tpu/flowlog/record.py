@@ -38,8 +38,10 @@ PATH_XDP = "xdp"            # prefilter (XDP analog) source drops
 PATH_ENGINE = "engine"      # daemon-side L7 batch engines (runtime/)
 
 # Match kinds: how the DECIDING rule was compiled.  literal/regex/nfa
-# are the device model tiers; l3/l4 mark packet-layer decisions where
-# no L7 rule row exists.
+# are the device model tiers (dns maps matchName/always rows to
+# literal and matchPattern/matchRegex rows to the automaton kind, so
+# the legend is uniform across engine families); l3/l4 mark
+# packet-layer decisions where no L7 rule row exists.
 MATCH_LITERAL = "literal"
 MATCH_REGEX = "regex"
 MATCH_NFA = "nfa"

@@ -10,6 +10,9 @@ per-request parse+match:
 - ``kafka``     — Kafka request ACLs (reference: pkg/kafka/policy.go)
 - ``cassandra`` — CQL query filtering (reference: proxylib/cassandra)
 - ``memcached`` — memcache command/key rules (reference: proxylib/memcached)
+- ``dns``       — DNS-over-TCP name policy: exact/wildcard/regex name
+                  rules, 0x20-folded, first length-prefixed family
+                  (reference: pkg/fqdn + the dnsproxy name walk)
 
 Every model is validated bit-identical against the streaming oracle in
 ``cilium_tpu.proxylib`` — the same strategy as the reference's op/byte-exact

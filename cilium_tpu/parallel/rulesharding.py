@@ -54,6 +54,14 @@ from ..models.kafka import (
     kafka_combine,
     kafka_rule_hits,
 )
+from ..models.dns import (
+    DnsBatchModel,
+    build_dns_model_from_rows,
+    collect_dns_policy_rows,
+    dns_row_arrays,
+    dns_verdicts,
+    dns_verdicts_attr,
+)
 from ..models.r2d2 import (
     MAX_CMD,
     R2d2BatchModel,
@@ -223,6 +231,75 @@ def build_sharded_r2d2_from_rows(
             )
         )
     return _stack_models(models)
+
+
+# --- dns ------------------------------------------------------------------
+
+def build_sharded_dns_from_rows(
+    rows: list, n_shards: int, bucket: bool = False
+) -> DnsBatchModel:
+    """Shard (remote_set, DnsRule|None) rows across n_shards stacked
+    models.  Aux dims unify across shards (needle width, NFA
+    states/classes/patterns) so the stacked treedef is uniform;
+    padding rows are dead (needle_len -1, never-accepting automaton
+    slots, remote set {-1}) exactly like the single-chip padding."""
+    shards = split_balanced(list(rows), n_shards)
+    r_max = max(len(s) for s in shards)
+    if bucket:
+        r_max = _rule_bucket(r_max)
+    # One needle width across shards so stacked leaves share shapes.
+    width = max(
+        (len(r.name.encode("latin-1", "replace"))
+         for s in shards for _, r in s
+         if r is not None and r.name),
+        default=0,
+    )
+    width = max(8, (width + 7) // 8 * 8)
+    per_shard = [
+        dns_row_arrays(s, r_max, width=width) for s in shards
+    ]
+    tables = [
+        compile_patterns(arr[6]) if any(arr[6]) else
+        _never_match_tables(max(len(arr[6]), 1))
+        for arr in per_shard
+    ]
+    s_max = max(t.n_states for t in tables)
+    c_max = max(t.n_classes for t in tables)
+    p_max = max(t.n_patterns for t in tables)
+    models = []
+    for arr, t in zip(per_shard, tables):
+        needle, n_len, n_any, use_rx, packed, any_remote, _pats = arr
+        models.append(
+            DnsBatchModel(
+                nfa=device_nfa(pad_tables(t, s_max, c_max, p_max)),
+                name_needle=jnp.asarray(needle),
+                name_len=jnp.asarray(n_len),
+                name_any=jnp.asarray(n_any),
+                use_rx=jnp.asarray(use_rx),
+                remote_ids=jnp.asarray(packed),
+                any_remote=jnp.asarray(any_remote),
+            )
+        )
+    return _stack_models(models)
+
+
+def mesh_dns_model(policy, ingress: bool, port: int, mesh):
+    """Mesh-resident DNS name-policy model for the live serving path —
+    the sharded twin of models/dns.build_dns_model: same port cascade,
+    same flattened row order, single-chip fallback compiled alongside
+    (the device-loss rung), ``match_kinds``/``invariant_rows`` from the
+    fallback so attribution and the verdict-cache claim are identical
+    on both rungs."""
+    rows = collect_dns_policy_rows(policy, ingress, port)
+    if isinstance(rows, ConstVerdict):
+        return rows
+    n_shards = mesh.shape[RULE_AXIS]
+    fallback = build_dns_model_from_rows(rows, bucket=True)
+    stacked = build_sharded_dns_from_rows(rows, n_shards, bucket=True)
+    return ShardedVerdictModel(
+        stacked, shard_offsets(len(rows), n_shards), mesh, "dns",
+        fallback=fallback, match_kinds=fallback.match_kinds,
+    )
 
 
 # --- http -----------------------------------------------------------------
@@ -499,6 +576,7 @@ def sharded_verdict_step_attr(mesh, attr_fn):
 _FAMILY_FNS = {
     "r2d2": (r2d2_verdicts, r2d2_verdicts_attr),
     "http": (http_verdicts, http_verdicts_attr),
+    "dns": (dns_verdicts, dns_verdicts_attr),
 }
 _STEP_CACHE: dict = {}
 

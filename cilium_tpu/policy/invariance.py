@@ -50,6 +50,28 @@ def reduce_r2d2_rows(rows) -> tuple:
     )
 
 
+def reduce_dns_rows(rows) -> tuple:
+    """(remote_set_or_None, byte_free) per flattened DNS row.  A row is
+    byte-free iff it carries no name constraint (the matcherless
+    always-match shape, or a DnsRule with none of matchName/
+    matchPattern/matchRegex set).  This is SOUND only because the DNS
+    engine's always-match rows admit any complete frame — the QNAME
+    validity gate masks name-CONSTRAINED rows only (a malformed
+    question can never satisfy a name rule, but a byte-free "allow
+    these peers' DNS" row passes it, host and device alike) — so the
+    verdict and the attributed first-match row really are independent
+    of the frame's bytes, and a cached whole-frame short-circuit is
+    exactly what a cold recompute would produce."""
+    return tuple(
+        (
+            remotes if remotes else None,
+            rule is None
+            or not (rule.name or rule.pattern or rule.regex),
+        )
+        for remotes, rule in rows
+    )
+
+
 def reduce_http_rows(rows) -> tuple:
     """(remote_set_or_None, byte_free) per flattened HTTP row.  A row is
     byte-free iff the PortRuleHTTP carries no method/path/host/header

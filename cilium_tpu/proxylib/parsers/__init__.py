@@ -9,3 +9,4 @@ from . import r2d2  # noqa: F401
 from . import cassandra  # noqa: F401
 from . import memcached  # noqa: F401
 from . import http  # noqa: F401
+from . import dns  # noqa: F401

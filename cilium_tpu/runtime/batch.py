@@ -61,7 +61,21 @@ class FlowState:
 
 
 class R2d2BatchEngine(InvariantClaimEngine):
-    """Batch engine for the r2d2 model (the flagship end-to-end slice)."""
+    """Batch engine for the r2d2 model (the flagship end-to-end slice).
+
+    Framing is parameterized through four class hooks so length-
+    prefixed families (runtime/dnsengine.DnsBatchEngine) reuse the
+    whole feed/feed_extract/settle_entry/pump machinery:
+    ``_frame_split`` (first complete frame length), ``_frame_msg``
+    (the judged/logged message slice), ``frame_row`` (the device-row
+    bytes the async slow path reconstructs from a settled message),
+    and ``DENY_INJECT`` (the per-denied-frame reply inject)."""
+
+    proto = "r2d2"
+
+    # Reply bytes injected per denied frame (byte-exact with the
+    # streaming oracle; reference: r2d2parser.go:211).
+    DENY_INJECT = b"ERROR\r\n"
 
     # Columnar feed contract (sidecar/reasm.py): the service's
     # reassembler may own this engine's carry state in its byte arena
@@ -72,9 +86,28 @@ class R2d2BatchEngine(InvariantClaimEngine):
 
     @staticmethod
     def reasm_spec() -> str:
-        """Framing kind of the columnar feed contract (reasm.FRAMING_*):
-        r2d2 frames on the first CRLF."""
+        """Framing kind of the columnar feed contract
+        (reasm.FRAMINGS): r2d2 frames on the first CRLF."""
         return "crlf"
+
+    @staticmethod
+    def _frame_split(buf) -> int:
+        """Length of the first COMPLETE frame in ``buf`` (delimiter/
+        header included), or -1."""
+        idx = buf.find(b"\r\n")
+        return -1 if idx < 0 else idx + 2
+
+    @staticmethod
+    def _frame_msg(buf, msg_len: int) -> bytes:
+        """The message slice judged/logged for one complete frame
+        (r2d2: the line without its CRLF)."""
+        return bytes(buf[: msg_len - 2])
+
+    @staticmethod
+    def frame_row(msg: bytes) -> bytes:
+        """Reconstruct the device-row bytes from a ``feed_extract``
+        message (the async slow path packs judged frames from these)."""
+        return msg + b"\r\n"
 
     def __init__(self, model, capacity: int = 2048, width: int = 256,
                  logger=None, max_buffer: int = 1 << 20,
@@ -177,11 +210,10 @@ class R2d2BatchEngine(InvariantClaimEngine):
         st.buffer += data
         frames: list[tuple[bytes, int]] = []
         while True:
-            idx = st.buffer.find(b"\r\n")
-            if idx < 0:
+            msg_len = self._frame_split(st.buffer)
+            if msg_len < 0:
                 break
-            msg_len = idx + 2
-            frames.append((bytes(st.buffer[:idx]), msg_len))
+            frames.append((self._frame_msg(st.buffer, msg_len), msg_len))
             del st.buffer[:msg_len]
         return frames
 
@@ -247,11 +279,11 @@ class R2d2BatchEngine(InvariantClaimEngine):
         # (reference: r2d2parser.go:154 joins all buffered data).
         buckets: dict[int, list[FlowState]] = {}
         for st in self.flows.values():
-            idx = st.buffer.find(b"\r\n")
-            if idx < 0:
+            msg_len = self._frame_split(st.buffer)
+            if msg_len < 0:
                 continue
             w = self.width
-            while idx + 2 > w:
+            while msg_len > w:
                 w *= 2
             buckets.setdefault(w, []).append(st)
         if not buckets:
@@ -268,9 +300,11 @@ class R2d2BatchEngine(InvariantClaimEngine):
         f = len(chunk)
         if isinstance(self.model, ConstVerdict):
             for st in chunk:
-                idx = bytes(st.buffer).find(b"\r\n")
-                msg_len = idx + 2
-                self._emit(st, bytes(st.buffer[:idx]), bool(self.model.allow), msg_len)
+                msg_len = self._frame_split(st.buffer)
+                self._emit(
+                    st, self._frame_msg(st.buffer, msg_len),
+                    bool(self.model.allow), msg_len,
+                )
             return True
 
         # Pad the flow axis to a power of two so the jitted model sees a
@@ -307,37 +341,42 @@ class R2d2BatchEngine(InvariantClaimEngine):
                 continue
             n = int(msg_len[i])
             st.last_rule_id = int(rule[i]) if rule is not None else -1
-            self._emit(st, bytes(st.buffer[: n - 2]), bool(allow[i]), n)
+            self._emit(st, self._frame_msg(st.buffer, n), bool(allow[i]), n)
         return True
+
+    def _log_frame(self, st: FlowState, msg: bytes, allow: bool) -> None:
+        """Access-log hook for one judged frame (protocol-specific
+        field extraction; overridden by non-r2d2 subclasses)."""
+        fields = msg.decode("utf-8", "surrogateescape").split(" ")
+        file_ = fields[1] if len(fields) == 2 else ""
+        self.logger.log(
+            LogEntry(
+                is_ingress=st.ingress,
+                entry_type=EntryType.Request if allow else EntryType.Denied,
+                policy_name=st.policy_name,
+                source_security_id=st.remote_id,
+                destination_security_id=st.dst_id,
+                source_address=st.src_addr,
+                destination_address=st.dst_addr,
+                proto=self.proto,
+                fields={"cmd": fields[0] if fields else "", "file": file_},
+            )
+        )
 
     def _emit(self, st: FlowState, msg: bytes, allow: bool, msg_len: int,
               drain: bool = True) -> None:
         flowdebug.log(
-            _flow_log, "flow %d r2d2 %s n=%d rule=%d",
-            st.flow_id, "PASS" if allow else "DROP", msg_len,
+            _flow_log, "flow %d %s %s n=%d rule=%d",
+            st.flow_id, self.proto, "PASS" if allow else "DROP", msg_len,
             st.last_rule_id,
         )
         if self.logger is not None:
-            fields = msg.decode("utf-8", "surrogateescape").split(" ")
-            file_ = fields[1] if len(fields) == 2 else ""
-            self.logger.log(
-                LogEntry(
-                    is_ingress=st.ingress,
-                    entry_type=EntryType.Request if allow else EntryType.Denied,
-                    policy_name=st.policy_name,
-                    source_security_id=st.remote_id,
-                    destination_security_id=st.dst_id,
-                    source_address=st.src_addr,
-                    destination_address=st.dst_addr,
-                    proto="r2d2",
-                    fields={"cmd": fields[0] if fields else "", "file": file_},
-                )
-            )
+            self._log_frame(st, msg, allow)
         if allow:
             st.ops.append((PASS, msg_len))
         else:
             room = st.inject_capacity - len(st.reply_inject)
-            st.reply_inject += b"ERROR\r\n"[: max(room, 0)]
+            st.reply_inject += self.DENY_INJECT[: max(room, 0)]
             st.ops.append((DROP, msg_len))
         if drain:
             del st.buffer[:msg_len]

@@ -370,10 +370,12 @@ class CassandraBatchEngine(DeviceAssistedEngine):
         cassandra frames are length-prefixed — a 9-byte v3/v4 header
         with the u32 body length at offset 5
         (reasm.scan_length_prefixed / length_prefix_reader(9, 5)).
-        Declared for the columnar lane's engine inventory; the service
-        serves this engine scalar until the length-prefix lane lands
-        (reasm_columnar stays unset — the per-direction parser state
-        here is not arena-portable yet)."""
+        Declared for the columnar lane's engine inventory; the kind
+        has no reasm.FRAMINGS entry yet (and reasm_columnar stays
+        unset — the per-direction parser state here is not
+        arena-portable), so the per-framing dispatch serves this
+        engine scalar.  Registering the Framing is ROADMAP item 2's
+        remaining half; the DNS engine is the template."""
         return "length_prefix"
 
     def _make_parser(self, conn):
@@ -451,8 +453,8 @@ class MemcacheBatchEngine(DeviceAssistedEngine):
         """Columnar feed contract framing kind (sidecar/reasm.py):
         memcached is SNIFFED per conn — text frames on CRLF, binary
         frames length-prefixed — so the kind is deliberately NOT
-        "crlf": the service's CRLF lane gate (reasm_spec() must equal
-        FRAMING_CRLF) would otherwise CRLF-scan binary conns into
+        "crlf": the per-framing dispatch (reasm.FRAMINGS has no entry
+        for this kind) would otherwise CRLF-scan binary conns into
         garbage frames the moment this engine grew reasm_columnar.
         A future lane must split on the sniffed protocol first."""
         return "crlf_or_length_prefix"

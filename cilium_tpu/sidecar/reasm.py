@@ -50,7 +50,10 @@ OP_ERROR = int(ERROR)
 ERR_FRAME_LEN = int(OpError.ERROR_INVALID_FRAME_LENGTH)
 
 # Framing kinds of the columnar feed contract (engine.reasm_spec()).
+# Kinds with a registered Framing in ``FRAMINGS`` (bottom of module)
+# ride the columnar lane; anything else serves scalar.
 FRAMING_CRLF = "crlf"
+FRAMING_DNS = "dns"
 FRAMING_LENGTH_PREFIX = "length_prefix"
 
 
@@ -218,6 +221,219 @@ def length_prefix_reader(header_bytes: int, length_offset: int,
         return out
 
     return fn
+
+
+# --- per-framing dispatch -------------------------------------------------
+#
+# PR 10 gated the columnar lane on ``reasm_spec() == "crlf"``; this
+# table lifts that gate into a per-framing dispatch: each Framing packs
+# the scanner (ingest), the whole-frame alignment gates (the verdict
+# cache's frame-boundary contract, per tier: round segments, matrix
+# rows, single host payloads) and the per-denied-frame reply inject.
+# Every future length-prefixed engine (HTTP/2-gRPC 9-byte headers,
+# cassandra, kafka) lands by registering a Framing here and declaring
+# its kind from ``reasm_spec()`` — no new service code.
+
+class Framing:
+    """One framing kind of the columnar feed contract."""
+
+    kind = ""
+    err_inject = b""  # reply bytes per denied frame (engine DENY_INJECT)
+
+    def scan(self, stream, offs, ends):
+        """Complete frames wholly inside entries: ``(f_entry, f_start,
+        f_len)`` sorted by entry then stream order, frames contiguous
+        from each entry's offset."""
+        raise NotImplementedError
+
+    def segments_aligned(self, blob, starts, lengths):
+        """[n] bool — each segment is >= 1 whole frame ending exactly
+        at the segment end (the cache tiers' frame-alignment gate: a
+        short-circuit must only ever cover whole frames)."""
+        raise NotImplementedError
+
+    def rows_aligned(self, rows, lengths):
+        """Matrix-row twin of segments_aligned (width bound included:
+        a malformed length reads as a miss, never out of the row)."""
+        n, w = rows.shape
+        li = np.asarray(lengths, np.int64)
+        ok = (li >= 1) & (li <= w)
+        starts = np.arange(n, dtype=np.int64) * w
+        return ok & self.segments_aligned(
+            rows.reshape(-1), starts, np.where(ok, li, 0)
+        )
+
+    def payload_aligned(self, data: bytes) -> bool:
+        """Single-payload (host bytes) twin of segments_aligned — the
+        scalar classifier's per-entry cache gate."""
+        raise NotImplementedError
+
+    def payload_single_frame(self, data: bytes) -> bool:
+        """Exactly ONE whole frame — the vectorized fast lane's
+        per-entry gate."""
+        raise NotImplementedError
+
+    def segments_single_frame(self, blob, offs, lengths):
+        """[n] bool — each segment is exactly one whole frame (the
+        whole-batch vec-eligibility gate)."""
+        raise NotImplementedError
+
+    def rows_single_frame(self, rows, lengths):
+        """Matrix-row twin of segments_single_frame."""
+        n, w = rows.shape
+        li = np.asarray(lengths, np.int64)
+        ok = (li >= 1) & (li <= w)
+        starts = np.arange(n, dtype=np.int64) * w
+        return ok & self.segments_single_frame(
+            rows.reshape(-1), starts, np.where(ok, li, 0)
+        )
+
+
+class CrlfFraming(Framing):
+    """CRLF-delimited lines (r2d2, text memcached class)."""
+
+    kind = FRAMING_CRLF
+    err_inject = b"ERROR\r\n"
+
+    def scan(self, stream, offs, ends):
+        offs = np.asarray(offs, np.int64)
+        hits, e_of = scan_crlf(stream, ends)
+        nf = len(hits)
+        first = np.ones(nf, bool)
+        prev = np.zeros(nf, np.int64)
+        if nf:
+            first[1:] = e_of[1:] != e_of[:-1]
+            prev[1:] = hits[:-1]
+        f_start = np.where(first, offs[e_of], prev + 2)
+        return e_of, f_start, hits + 2 - f_start
+
+    def segments_aligned(self, blob, starts, lengths):
+        return segments_end_crlf(blob, starts, lengths)
+
+    def rows_aligned(self, rows, lengths):
+        return rows_end_crlf(rows, lengths)
+
+    def payload_aligned(self, data: bytes) -> bool:
+        return len(data) >= 2 and data.endswith(b"\r\n")
+
+    def payload_single_frame(self, data: bytes) -> bool:
+        return (
+            len(data) >= 2
+            and data.endswith(b"\r\n")
+            and data.find(b"\r\n") == len(data) - 2
+        )
+
+    def segments_single_frame(self, blob, offs, lengths):
+        offs = np.asarray(offs, np.int64)
+        li = np.asarray(lengths, np.int64)
+        aligned = segments_end_crlf(blob, offs, li)
+        if not aligned.any():
+            return aligned
+        # Exactly one CR per entry => exactly one frame, ending at the
+        # entry boundary.
+        ends = offs + li
+        crs = np.add.reduceat(
+            (blob == 13).astype(np.int32), offs,
+        ) if len(blob) else np.zeros(len(offs), np.int32)
+        # reduceat sums to the NEXT start; the last segment sums to the
+        # blob end — only exact for contiguous segments, so recompute
+        # defensively for non-contiguous callers.
+        contiguous = bool(
+            len(offs) and (offs[1:] == ends[:-1]).all()
+            and ends[-1] == len(blob) and offs[0] == 0
+        )
+        if not contiguous:
+            crs = np.array(
+                [int((blob[o : o + n] == 13).sum())
+                 for o, n in zip(offs, li)],
+                np.int32,
+            )
+        return aligned & (crs == 1)
+
+    def rows_single_frame(self, rows, lengths):
+        ok = rows_end_crlf(rows, lengths)
+        return ok & ((rows == 13).sum(axis=1) == 1)
+
+
+class LengthPrefixFraming(Framing):
+    """Length-prefixed frames: total length = header + the integer at
+    ``length_offset`` (+ extra).  DNS-over-TCP is header_bytes=2 with a
+    2-byte big-endian prefix at offset 0; the cassandra v3/v4 frame is
+    (9, 5) and kafka (4, 0) — registered once their engines' parser
+    state goes arena-portable."""
+
+    def __init__(self, kind: str, header_bytes: int, length_offset: int,
+                 length_size: int = 4, big_endian: bool = True,
+                 extra: int = 0, err_inject: bytes = b""):
+        self.kind = kind
+        self.header = int(header_bytes)
+        self.err_inject = err_inject
+        self._lo, self._ls = int(length_offset), int(length_size)
+        self._be, self._extra = bool(big_endian), int(extra)
+        self._reader = length_prefix_reader(
+            header_bytes, length_offset, length_size, big_endian, extra
+        )
+
+    def frame_len_of(self, buf) -> int:
+        """First frame's total length from host bytes, or -1 while the
+        header is incomplete."""
+        if len(buf) < self.header:
+            return -1
+        val = 0
+        for k in range(self._ls):
+            shift = (self._ls - 1 - k if self._be else k) * 8
+            val |= buf[self._lo + k] << shift
+        return self.header + val + self._extra
+
+    def scan(self, stream, offs, ends):
+        return scan_length_prefixed(stream, offs, ends, self._reader)
+
+    def segments_aligned(self, blob, starts, lengths):
+        starts = np.asarray(starts, np.int64)
+        li = np.asarray(lengths, np.int64)
+        n = len(li)
+        ok = (li > 0) & (starts >= 0) & (starts + li <= len(blob))
+        fe, _fs, fl = self.scan(
+            blob, starts, starts + np.where(ok, li, 0)
+        )
+        consumed = np.zeros(n, np.int64)
+        np.add.at(consumed, fe, fl)
+        return ok & (consumed == li)
+
+    def payload_aligned(self, data: bytes) -> bool:
+        pos, n = 0, len(data)
+        while pos < n:
+            fl = self.frame_len_of(
+                memoryview(data)[pos : pos + self.header]
+            )
+            if fl < 0 or pos + fl > n:
+                return False
+            pos += fl
+        return n > 0
+
+    def payload_single_frame(self, data: bytes) -> bool:
+        return len(data) >= self.header and (
+            self.frame_len_of(data) == len(data)
+        )
+
+    def segments_single_frame(self, blob, offs, lengths):
+        offs = np.asarray(offs, np.int64)
+        li = np.asarray(lengths, np.int64)
+        ok = (li >= self.header) & (offs >= 0) & (offs + li <= len(blob))
+        fl = self._reader(blob, np.where(ok, offs, 0),
+                          np.where(ok, li, 0))
+        return ok & (fl == li)
+
+
+# The columnar lane's framing registry (see module docstring): kinds an
+# engine may declare from ``reasm_spec()`` and actually ride the lane.
+FRAMINGS: dict[str, Framing] = {
+    FRAMING_CRLF: CrlfFraming(),
+    FRAMING_DNS: LengthPrefixFraming(
+        FRAMING_DNS, header_bytes=2, length_offset=0, length_size=2,
+        err_inject=b"",  # DNS denies DROP with no inject
+    ),
+}
 
 
 # --- the byte arena ------------------------------------------------------
@@ -435,15 +651,18 @@ class ReasmRound:
     __slots__ = ("n", "conn_ids", "slots", "dead", "over", "live",
                  "over_total", "stream", "entry_off", "entry_end",
                  "f_entry", "f_start", "f_len", "n_frames", "res_len",
-                 "more", "_gb", "_ge")
+                 "more", "framing", "_gb", "_ge")
 
     def frame_count(self) -> int:
         return len(self.f_entry)
 
 
 class Reassembler:
-    """Round-scale reassembly over a :class:`ByteArena` (CRLF framing —
-    the r2d2/memcached class the service's columnar lane serves)."""
+    """Round-scale reassembly over a :class:`ByteArena`, one framing
+    per round group (``FRAMINGS``): CRLF for the r2d2/text-memcached
+    class, length-prefixed for the DNS class — the service groups each
+    round's entries by engine and hands every group its engine's
+    declared framing."""
 
     def __init__(self, cap_per_conn: int = 1 << 20,
                  err_inject: bytes = b"ERROR\r\n",
@@ -451,24 +670,41 @@ class Reassembler:
                  arena_capacity: int = 1 << 20):
         self.arena = ByteArena(arena_capacity)
         self.cap = int(cap_per_conn)
-        self.err = np.frombuffer(err_inject, np.uint8)
+        # Per-framing deny injects (``err_inject`` keeps the historic
+        # ctor override for the CRLF lane's template).
+        self._err = {k: f.err_inject for k, f in FRAMINGS.items()}
+        self._err[FRAMING_CRLF] = bytes(err_inject)
         self.inject_capacity = int(inject_capacity)
-        # Truncation template: enough repeats to cover the per-entry
-        # inject cap, sliced per entry (matches the scalar engine's
-        # byte-exact mid-pattern truncation at the capacity).
-        reps = self.inject_capacity // max(len(self.err), 1) + 1
-        self._err_tpl = np.tile(self.err, max(reps, 1))
+        # Truncation templates per framing: enough repeats to cover the
+        # per-entry inject cap, sliced per entry (matches the scalar
+        # engine's byte-exact mid-pattern truncation at the capacity).
+        self._err_tpls: dict[str, np.ndarray] = {}
         self.rounds = 0
         self.entries = 0
         self.frames = 0
         self.overflows = 0
+        # Lane engagement per framing kind — the status surface the
+        # non-CRLF smoke gates on (a silent scalar fallback reads 0).
+        self.rounds_by_framing: dict[str, int] = {}
+
+    def _tpl_for(self, kind: str) -> np.ndarray:
+        tpl = self._err_tpls.get(kind)
+        if tpl is None:
+            err = np.frombuffer(self._err.get(kind, b""), np.uint8)
+            reps = self.inject_capacity // max(len(err), 1) + 1
+            tpl = np.tile(err, max(reps, 1)) if len(err) else err
+            self._err_tpls[kind] = tpl
+        return tpl
 
     def ingest(self, conn_ids, data_starts, data_lens,
-               blob: np.ndarray) -> ReasmRound:
+               blob: np.ndarray,
+               framing: Framing | None = None) -> ReasmRound:
         """Append one round's payloads to their conns' carries, find
-        every completed CRLF frame, and persist the residues — all as
-        array passes.  ``conn_ids`` must be unique within the round
-        (the service taints duplicate conns to the scalar lane)."""
+        every completed frame under ``framing`` (default CRLF), and
+        persist the residues — all as array passes.  ``conn_ids`` must
+        be unique within the round (the service taints duplicate conns
+        to the scalar lane)."""
+        framing = framing or FRAMINGS[FRAMING_CRLF]
         conn_ids = np.asarray(conn_ids, np.int64)
         data_starts = np.asarray(data_starts, np.int64)
         data_lens = np.asarray(data_lens, np.int64)
@@ -509,18 +745,19 @@ class Reassembler:
         rnd.stream = stream
         rnd.entry_off = entry_off
         rnd.entry_end = entry_end
-        # Frame boundaries + per-entry residue, columnar.
-        hits, e_of = scan_crlf(stream, entry_end)
-        nf = len(hits)
+        rnd.framing = framing
+        # Frame boundaries + per-entry residue, columnar.  The framing
+        # contract (Framing.scan): frames sorted by entry then stream
+        # order and contiguous from each entry's offset, so the residue
+        # of a framed entry starts where its LAST frame ends.
+        e_of, f_start, f_len = framing.scan(stream, entry_off, entry_end)
+        nf = len(e_of)
         first = np.ones(nf, bool)
-        prev = np.zeros(nf, np.int64)
         if nf:
             first[1:] = e_of[1:] != e_of[:-1]
-            prev[1:] = hits[:-1]
-        f_start = np.where(first, entry_off[e_of], prev + 2)
         rnd.f_entry = e_of
         rnd.f_start = f_start
-        rnd.f_len = hits + 2 - f_start
+        rnd.f_len = f_len
         rnd.n_frames = np.bincount(e_of, minlength=n).astype(np.int64)
         res_start = entry_off.copy()
         gb = np.flatnonzero(first)
@@ -528,7 +765,7 @@ class Reassembler:
         rnd._gb = gb
         rnd._ge = ge
         if nf:
-            res_start[e_of[gb]] = hits[ge] + 2
+            res_start[e_of[gb]] = f_start[ge] + f_len[ge]
         res_len = entry_end - res_start
         rnd.res_len = res_len
         rnd.more = (rnd.n_frames > 0) | (res_len > 0)
@@ -536,6 +773,9 @@ class Reassembler:
         self.rounds += 1
         self.entries += n
         self.frames += nf
+        self.rounds_by_framing[framing.kind] = (
+            self.rounds_by_framing.get(framing.kind, 0) + 1
+        )
         return rnd
 
     # -- device-batch packing ---------------------------------------------
@@ -580,7 +820,8 @@ class Reassembler:
         ``settle_entry``/``_overflow`` contract:
 
         - judged frame → ``(PASS msg_len)`` or ``(DROP msg_len)`` with
-          ``ERROR\\r\\n`` appended to the reply inject (truncated at the
+          the framing's deny inject (``ERROR\\r\\n`` for CRLF, nothing
+          for DNS) appended to the reply inject (truncated at the
           per-entry inject capacity);
         - trailing ``(MORE 1)`` when the entry completed frames or left
           residue;
@@ -628,20 +869,22 @@ class Reassembler:
         if len(d_idx):
             ops["op"][op_off[d_idx]] = OP_ERROR
             ops["n_bytes"][op_off[d_idx]] = ERR_FRAME_LEN
-        # Reply injects: one ERROR\r\n per denied frame, byte-exact
-        # truncation at the per-entry capacity.
+        # Reply injects: one framing deny-inject per denied frame,
+        # byte-exact truncation at the per-entry capacity.
         n_denied = np.bincount(
             rnd.f_entry[~allow_frame] if nf else np.empty(0, np.int64),
             minlength=n,
         ).astype(np.int64)
-        inj_len = np.minimum(n_denied * len(self.err),
-                             self.inject_capacity)
+        kind = getattr(rnd.framing, "kind", FRAMING_CRLF)
+        err_tpl = self._tpl_for(kind)
+        err_n = len(self._err.get(kind, b""))
+        inj_len = np.minimum(n_denied * err_n, self.inject_capacity)
         total_inj = int(inj_len.sum())
         inj_blob = np.empty(total_inj, np.uint8)
         inj_off = np.concatenate(
             ([0], np.cumsum(inj_len))
         )[:-1].astype(np.int64)
-        gather_segments(self._err_tpl, np.zeros(n, np.int64), inj_len,
+        gather_segments(err_tpl, np.zeros(n, np.int64), inj_len,
                         out=inj_blob, dst_starts=inj_off)
         return op_counts, ops, inj_len, inj_blob, n_denied
 
@@ -673,6 +916,7 @@ class Reassembler:
     def status(self) -> dict:
         return {
             "rounds": self.rounds,
+            "rounds_by_framing": dict(self.rounds_by_framing),
             "entries": self.entries,
             "frames": self.frames,
             "overflows": self.overflows,

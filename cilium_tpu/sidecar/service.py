@@ -64,11 +64,10 @@ from .dispatch import BatchDispatcher
 from .guard import DeviceGuard
 from .reasm import (
     FRAMING_CRLF,
+    FRAMINGS,
     ByteArena,
     Reassembler,
     gather_segments,
-    rows_end_crlf,
-    segments_end_crlf,
 )
 from .shm import GenerationMismatch, RingError
 from .trace import (
@@ -95,6 +94,28 @@ from .transport import (
 log = logging.getLogger(__name__)
 # Per-flow debug stream, flowdebug-gated (one boolean when disabled).
 _flow_log = logging.getLogger("cilium_tpu.sidecar.flow")
+
+# Protocols served by a device batch engine (everything else rides the
+# in-process oracle), and the subset whose single-frame payloads may
+# take the vectorized fast path (engines framing whole requests the
+# model can judge from one row: r2d2 on CRLF, DNS on its length
+# prefix — the per-framing gate is reasm.FRAMINGS).
+ENGINE_PROTOS = ("r2d2", "cassandra", "memcache", "http", "dns")
+FAST_PROTOS = ("r2d2", "dns")
+
+
+def _engine_framing(engine):
+    """The reasm Framing an engine's declared ``reasm_spec`` resolves
+    to, or None when the engine (or its framing) is not columnar-
+    capable — THE per-framing dispatch gate (ISSUE 13): the columnar
+    lane, the vec/matrix whole-frame checks and the verdict-cache
+    alignment tiers all route through this one lookup."""
+    if engine is None or not getattr(engine, "reasm_columnar", False):
+        return None
+    spec = getattr(engine, "reasm_spec", None)
+    if spec is None:
+        return None
+    return FRAMINGS.get(spec())
 
 
 def _gather_model(model, blob, offs, lens, remotes, width: int,
@@ -338,10 +359,16 @@ class VerdictService:
         self._tab_cache = np.empty(0, np.uint8)
         self._tab_cache_epoch = np.empty(0, np.int64)
         self._tab_cache_rule = np.empty(0, np.int32)
+        # Last-HIT recency stamp per armed row: at the
+        # flow_cache_entries cap the least-recently-hit row is evicted
+        # (LRU) instead of new flows silently never arming.
+        self._tab_seen_tick = np.empty(0, np.int64)
+        self._cache_tick = 0
         self._cache_armed = 0  # armed rows (flow_cache_entries cap)
         self.cache_hits = 0
         self.cache_misses = 0
         self.cache_invalidations = 0
+        self.cache_evictions = 0
         self._engine_objs: list[object] = []
         self._engine_idx: dict[int, int] = {}  # id(engine) -> table idx
         self._engine_free: list[int] = []
@@ -404,6 +431,9 @@ class VerdictService:
         self._mesh_reprobe_last = 0.0
         self._mesh_reprobe_inflight = False
         self.mesh_repromotions = 0
+        # ROADMAP 1c: demotion-era engines re-sharded by the heal's
+        # queued rebinds (status surface; see _run_mesh_rebuild).
+        self.mesh_rebind_rebuilds = 0
         self.vec_batches = 0
         self.vec_entries = 0
         # Completion pipeline: the dispatcher issues device calls without
@@ -663,9 +693,11 @@ class VerdictService:
             "flow_cache": (
                 {
                     "armed": self._cache_armed,
+                    "cap": self.config.flow_cache_entries,
                     "hits": self.cache_hits,
                     "misses": self.cache_misses,
                     "invalidations": self.cache_invalidations,
+                    "evictions": self.cache_evictions,
                 }
                 if self._flow_cache_on else None
             ),
@@ -767,6 +799,8 @@ class VerdictService:
                     self._send_cache_grants(job)
                 elif kind == "mesh_reprobe":
                     self._run_mesh_reprobe()
+                elif kind == "mesh_rebuild":
+                    self._run_mesh_rebuild(*job)
             except Exception:  # noqa: BLE001 — builder must survive
                 log.exception("policy builder job failed")
                 if kind == "swap":
@@ -806,7 +840,7 @@ class VerdictService:
             keys = {k for k in self._engines if k[0] in mods}
             for sc in self._conns.values():
                 if sc.conn.instance is ins and sc.conn.parser_name in (
-                    "r2d2", "cassandra", "memcache", "http"
+                    ENGINE_PROTOS
                 ):
                     keys.add(self._engine_key_for(sc.module_id, sc.conn))
         new_engines: dict[tuple, object] = {}
@@ -820,12 +854,16 @@ class VerdictService:
                     )
                 if (
                     self.config.policy_epoch_parity
-                    and proto == "r2d2"
                     and not self.config.seam_probe
                 ):
-                    self._assert_epoch_parity(
-                        eng, policy, ingress, port
-                    )
+                    if proto == "r2d2":
+                        self._assert_epoch_parity(
+                            eng, policy, ingress, port
+                        )
+                    elif proto == "dns":
+                        self._assert_epoch_parity_dns(
+                            eng, policy, ingress, port
+                        )
                 eng.epoch = epoch
                 new_engines[key] = eng
         except EpochParityError:
@@ -910,9 +948,7 @@ class VerdictService:
                 if sc.conn.instance is not ins:
                     continue
                 old_eng = sc.engine
-                engine_proto = sc.conn.parser_name in (
-                    "r2d2", "cassandra", "memcache", "http"
-                )
+                engine_proto = sc.conn.parser_name in ENGINE_PROTOS
                 if old_eng is None and engine_proto and (
                     sc.bufs[False] or sc.skip[False]
                 ):
@@ -948,7 +984,7 @@ class VerdictService:
                     self._migrate_flow(old_eng, eng, cid, sc)
                 sc.engine = eng
                 sc.fast_ok = (
-                    eng is not None and sc.conn.parser_name == "r2d2"
+                    eng is not None and sc.conn.parser_name in FAST_PROTOS
                 )
                 sc.demoted_mod = None
                 self._tab_set_engine(cid, eng)
@@ -1131,6 +1167,75 @@ class VerdictService:
                     f"{bool(allow[i])} host={host}"
                 )
 
+    # DNS probe names: an exact candidate, a subdomain (wildcard tier),
+    # an unrelated name, the root, and a structurally invalid query —
+    # enough to exercise the needle, automaton, byte-free and validity
+    # tiers of a staged DNS table.
+    _DNS_PARITY_NAMES = (
+        "www.example.com", "api.internal.example.com", "evil.test",
+        "example.com", "",
+    )
+
+    def _assert_epoch_parity_dns(self, engine, policy, ingress: bool,
+                                 port: int) -> None:
+        """DNS twin of _assert_epoch_parity: staged device table vs
+        the staged policy's host walk over a probe grid of query
+        frames (the invalid-QNAME probe included — the validity gate
+        is part of the contract)."""
+        model = engine.model
+        if isinstance(model, ConstVerdict):
+            return
+        from ..proxylib.parsers.dns import (
+            DNS_QNAME_OFF,
+            DnsRequestData,
+            encode_dns_query,
+            parse_dns_query,
+        )
+
+        rem_tab = np.asarray(model.remote_ids).ravel()
+        remotes = sorted(set(int(r) for r in rem_tab if r > 0))[:4]
+        remotes += [1, 999983]  # a common id + a never-allocated one
+        frames = [
+            encode_dns_query(n) for n in self._DNS_PARITY_NAMES
+        ]
+        # Invalid probe: a compression pointer where a label length
+        # belongs (denied by every name-constrained row on both rungs).
+        bad = bytearray(encode_dns_query("bad.example.com"))
+        bad[DNS_QNAME_OFF] = 0xC0
+        frames.append(bytes(bad))
+        cases = [(f, rem) for f in frames for rem in remotes]
+        b = self._min_bucket
+        while b < len(cases):
+            b *= 2
+        width = self.config.batch_width
+        while width < max(len(f) for f in frames):
+            width *= 2
+        data = np.zeros((b, width), np.uint8)
+        lens = np.zeros(b, np.int32)
+        rems = np.zeros(b, np.int32)
+        for i, (frame, rem) in enumerate(cases):
+            row = np.frombuffer(frame, np.uint8)
+            data[i, : len(row)] = row
+            lens[i] = len(row)
+            rems[i] = rem
+        out = self._model_call(model, data, lens, rems)
+        allow = np.asarray(out[-1])[: len(cases)]
+        for i, (frame, rem) in enumerate(cases):
+            name = parse_dns_query(frame)
+            req = DnsRequestData(
+                name=name if name is not None else "",
+                valid=name is not None,
+            )
+            host = policy is not None and policy.matches(
+                ingress, port, rem, req
+            )
+            if bool(allow[i]) != bool(host):
+                raise EpochParityError(
+                    f"epoch parity violation: dns probe "
+                    f"(name={req.name!r} valid={req.valid} "
+                    f"remote={rem}) device={bool(allow[i])} host={host}"
+                )
+
     def new_connection(self, module_id, conn_id, ingress, src_id, dst_id,
                        proto, src_addr, dst_addr, policy_name, client):
         """Returns ``(result, grant_or_None)``.  The registration grant
@@ -1207,6 +1312,7 @@ class VerdictService:
                 ("_tab_cache", 0, np.uint8),
                 ("_tab_cache_epoch", -1, np.int64),
                 ("_tab_cache_rule", -1, np.int32),
+                ("_tab_seen_tick", 0, np.int64),
             ):
                 arr = np.full(new_size, fill, dt)
                 arr[: self._tab_size] = getattr(self, name)
@@ -1300,42 +1406,55 @@ class VerdictService:
     def _arm_flow_cache(self, conn_id: int, sc: "_SidecarConn"):
         """Compute/refresh this conn's byte-invariance claim from its
         bound engine (caller holds ``_lock``; the conn table row is
-        ensured).  Arms only CRLF-framed engines — the cache tiers'
-        frame-alignment gate is the CRLF tail check, so a non-CRLF
-        protocol must never be armed even if its table is invariant —
-        and only on ALLOW claims (denied frames carry per-frame inject
-        side effects the short-circuit would skip).  Returns the
-        ``(client, grant_payload)`` to send OUTSIDE the lock, or
-        None."""
+        ensured).  Arms engines whose framing is registered in
+        reasm.FRAMINGS — the cache tiers' frame-alignment gate is that
+        framing's whole-frame check (CRLF tail for r2d2, the
+        length-prefix walk for DNS) — and only on ALLOW claims (denied
+        frames carry per-frame inject side effects the short-circuit
+        would skip).  At the ``flow_cache_entries`` cap the least-
+        recently-HIT armed row is evicted to make room
+        (verdict_cache_evictions_total) — eviction is capacity
+        management, not invalidation: the victim's claim stays true
+        for its epoch, so an already-delivered shim grant needs no
+        revoke.  Returns the ``(client, grant_payload)`` to send
+        OUTSIDE the lock, or None; shim-local grants stay CRLF-only
+        (the shim's pre-push alignment check is the CRLF tail — see
+        client.py; teaching it per-conn framings is ROADMAP 3c's
+        remaining half)."""
         if not self._flow_cache_on or conn_id >= self._tab_size:
             return None
         engine = sc.engine
+        framing = _engine_framing(engine)
         claim = None
         epoch = self.policy_epoch
-        if engine is not None:
-            spec = getattr(engine, "reasm_spec", None)
-            if (
-                spec is not None
-                and spec() == FRAMING_CRLF
-                and hasattr(engine, "verdict_invariant")
-            ):
-                claim = engine.verdict_invariant(sc.conn.src_id)
-                epoch = getattr(engine, "epoch", 0)
+        if framing is not None and hasattr(engine, "verdict_invariant"):
+            claim = engine.verdict_invariant(sc.conn.src_id)
+            epoch = getattr(engine, "epoch", 0)
         was_armed = self._tab_cache[conn_id] == 1
-        if claim is not None and claim[0] and (
-            was_armed
-            or self._cache_armed < self.config.flow_cache_entries
-        ):
-            rule = int(claim[1])
-            if not was_armed:
-                self._cache_armed += 1
-            self._tab_cache[conn_id] = 1
-            self._tab_cache_epoch[conn_id] = epoch
-            self._tab_cache_rule[conn_id] = rule
-            client = sc.client
-            if client is not None and getattr(client, "cache_ok", False):
-                return client, conn_id, epoch, rule
-            return None
+        if claim is not None and claim[0]:
+            if (
+                not was_armed
+                and self._cache_armed >= self.config.flow_cache_entries
+            ):
+                self._evict_flow_cache_lru()
+            if was_armed or (
+                self._cache_armed < self.config.flow_cache_entries
+            ):
+                rule = int(claim[1])
+                if not was_armed:
+                    self._cache_armed += 1
+                self._tab_cache[conn_id] = 1
+                self._tab_cache_epoch[conn_id] = epoch
+                self._tab_cache_rule[conn_id] = rule
+                self._tab_seen_tick[conn_id] = self._next_cache_tick()
+                client = sc.client
+                if (
+                    client is not None
+                    and getattr(client, "cache_ok", False)
+                    and framing.kind == FRAMING_CRLF
+                ):
+                    return client, conn_id, epoch, rule
+                return None
         if was_armed:
             self._cache_armed -= 1
             self.cache_invalidations += 1
@@ -1346,6 +1465,38 @@ class VerdictService:
         self._tab_cache_epoch[conn_id] = epoch
         self._tab_cache_rule[conn_id] = -1
         return None
+
+    def _next_cache_tick(self) -> int:
+        """Monotonic recency stamp for the armed-row LRU (round-grain:
+        one tick per touch event, bulk touches share a tick)."""
+        self._cache_tick += 1
+        return self._cache_tick
+
+    def _touch_cache_rows(self, conn_ids) -> None:
+        """Refresh the last-HIT stamp of armed rows after a cache-hit
+        group (one vectorized store per round, never per entry)."""
+        ids = np.asarray(conn_ids, np.int64)
+        ids = ids[(ids >= 0) & (ids < self._tab_size)]
+        if len(ids):
+            self._tab_seen_tick[ids] = self._next_cache_tick()
+
+    def _evict_flow_cache_lru(self) -> None:
+        """Drop the least-recently-hit armed row to make room at the
+        ``flow_cache_entries`` cap (caller holds ``_lock``).  Counted
+        separately from invalidations: the victim's claim is still
+        TRUE for its epoch — this is capacity management, so the
+        (advisory) shim grant, if any, keeps its local short-circuit
+        and stays correct."""
+        armed = np.flatnonzero(self._tab_cache[: self._tab_size] == 1)
+        if not len(armed):
+            return
+        victim = int(armed[np.argmin(self._tab_seen_tick[armed])])
+        self._tab_cache[victim] = 0  # unchecked: re-armable later
+        self._tab_cache_epoch[victim] = -1
+        self._tab_cache_rule[victim] = -1
+        self._cache_armed -= 1
+        self.cache_evictions += 1
+        metrics.VerdictCacheEvictions.inc()
 
     def _disarm_flow_cache(self, conn_id: int, reason: str | None) -> None:
         """Drop one conn's cache row (caller holds ``_lock``): lane
@@ -1464,7 +1615,7 @@ class VerdictService:
         inserted — a swap must not be undone by a racing first-bind)."""
         conn = sc.conn
         proto = conn.parser_name
-        if proto not in ("r2d2", "cassandra", "memcache", "http"):
+        if proto not in ENGINE_PROTOS:
             return  # other protocols: oracle path
         if self.guard.quarantined:
             # Never build/prewarm against a quarantined device (the
@@ -1503,8 +1654,8 @@ class VerdictService:
         if eng is None:
             return  # persistent epoch churn: serve on the oracle path
         sc.engine = eng
-        # Only the r2d2 engine is vectorized-path capable.
-        sc.fast_ok = proto == "r2d2"
+        # Whole-frame engines (r2d2, dns) are vectorized-path capable.
+        sc.fast_ok = proto in FAST_PROTOS
 
     def _make_engine(self, ins, policy, policy_name: str, ingress: bool,
                      port: int, proto: str):
@@ -1530,6 +1681,31 @@ class VerdictService:
                 else:
                     model = build_r2d2_model(policy, ingress, port)
             eng = R2d2BatchEngine(
+                model,
+                capacity=self.config.batch_flows,
+                width=self.config.batch_width,
+                logger=ins.access_logger,
+                max_buffer=self.config.max_flow_buffer,
+                attr_enabled=self._flow_observe,
+            )
+            self.prewarm(eng)
+            return eng
+        if proto == "dns":
+            # The DNS engine rung: same scalar contract as r2d2 (the
+            # flagship FlowState machinery, subclassed with the
+            # length-prefix framing hooks), mesh-aware build with the
+            # single-chip fallback compiled alongside.
+            from ..models.dns import build_dns_model
+            from ..runtime.dnsengine import DnsBatchEngine
+
+            mesh = self._serving_mesh()
+            if mesh is not None:
+                from ..parallel.rulesharding import mesh_dns_model
+
+                model = mesh_dns_model(policy, ingress, port, mesh)
+            else:
+                model = build_dns_model(policy, ingress, port)
+            eng = DnsBatchEngine(
                 model,
                 capacity=self.config.batch_flows,
                 width=self.config.batch_width,
@@ -1841,7 +2017,9 @@ class VerdictService:
         # — block_until_ready can return pre-execution on the tunneled
         # transport and would book device time into the send stage.
         rt.completed()
-        self.fast_log.log_batch("r2d2", n, int(n - allow.sum()))
+        self.fast_log.log_batch(
+            getattr(engine, "proto", "r2d2"), n, int(n - allow.sum())
+        )
         self.vec_batches += 1
         self.vec_entries += n
         metrics.ProxyBatches.inc()
@@ -1869,7 +2047,8 @@ class VerdictService:
                     client.send(
                         wire.MSG_VERDICT_BATCH,
                         self._verdict_frame(
-                            seqs[0], ids[a:b], lengths[a:b], allow[a:b]
+                            seqs[0], ids[a:b], lengths[a:b], allow[a:b],
+                            getattr(engine, "DENY_INJECT", None),
                         ),
                         batches=mbs,
                     )
@@ -1884,7 +2063,10 @@ class VerdictService:
                         [np.arange(a, b) for a, b in spans]
                     )
                     c_ids, c_lens, c_allow = ids[sel], lengths[sel], allow[sel]
-                body = self._verdict_body(c_ids, c_lens, c_allow)
+                body = self._verdict_body(
+                    c_ids, c_lens, c_allow,
+                    getattr(engine, "DENY_INJECT", None),
+                )
                 client.send(
                     wire.MSG_VERDICT_MULTI,
                     wire.pack_verdict_multi(seqs, counts, len(c_ids), body),
@@ -2377,7 +2559,7 @@ class VerdictService:
             if eng is not None:
                 sc.demoted_mod = None
                 sc.engine = eng
-                sc.fast_ok = sc.conn.parser_name == "r2d2"
+                sc.fast_ok = sc.conn.parser_name in FAST_PROTOS
                 self._tab_set_engine(
                     conn_id, eng if sc.fast_ok else None
                 )
@@ -2671,18 +2853,14 @@ class VerdictService:
         engine = snap.objs[e0]
         if engine is None or isinstance(engine.model, ConstVerdict):
             return None
+        framing = _engine_framing(engine)
+        if framing is None:
+            return None
         if mb.flags & wire.MAT_FLAG_COMPLETE:
             # The edge declared whole-frame rows (it owns framing);
             # skip the per-row content scan.
             return engine
-        rows = mb.rows
-        li = lengths.astype(np.int64)
-        ar = np.arange(n)
-        if not (
-            (rows[ar, li - 2] == 13) & (rows[ar, li - 1] == 10)
-        ).all():
-            return None
-        if ((rows == 13).sum(axis=1) != 1).any():
+        if not framing.rows_single_frame(mb.rows, lengths).all():
             return None
         return engine
 
@@ -2707,17 +2885,20 @@ class VerdictService:
         engine = snap.objs[e0]
         if engine is None or isinstance(engine.model, ConstVerdict):
             return None
+        framing = _engine_framing(engine)
+        if framing is None:
+            return None
         blob = np.frombuffer(batch.blob, np.uint8)
         if len(blob) != int(lengths.sum()):
             return None
-        offs = batch.offsets
-        ends = offs[1:]
-        if not ((blob[ends - 2] == 13) & (blob[ends - 1] == 10)).all():
-            return None
-        # Exactly one CR per entry => exactly one frame, ending at the
-        # entry boundary (r2d2 frames on the first CRLF).
-        crs = np.add.reduceat((blob == 13).astype(np.int32), offs[:-1])
-        if (crs != 1).any():
+        # Exactly one whole frame per entry, ending at the entry
+        # boundary — the engine's declared framing owns the check
+        # (CRLF tail + single CR for r2d2, the length-prefix walk for
+        # DNS).
+        if not framing.segments_single_frame(
+            blob, batch.offsets[:-1].astype(np.int64),
+            lengths.astype(np.int64),
+        ).all():
             return None
         return engine
 
@@ -3039,6 +3220,7 @@ class VerdictService:
                     out = retained[0](data, lens, rems)
                     np.asarray(out[-1])
             promoted = 0
+            rebuilds: list = []
             with self._lock:
                 if self._mesh_demoted is None:
                     return  # raced a concurrent heal
@@ -3049,6 +3231,27 @@ class VerdictService:
                         eng._mesh_model = None
                         promoted += 1
                 self._mesh_demoted = None
+                # ROADMAP 1c: engines BUILT while demoted hold plain
+                # single-chip models (no retained wrapper, no
+                # fallback attr) — queue their sharded rebuilds so
+                # they heal too instead of waiting for the next epoch
+                # swap.  (Re-promoted engines above now expose
+                # .fallback and drop out of this scan.)
+                if not self.config.seam_probe:
+                    for key, eng in self._engines.items():
+                        m = getattr(eng, "model", None)
+                        if (
+                            key[4] in ("r2d2", "http", "dns")
+                            and getattr(eng, "_mesh_model", None) is None
+                            and m is not None
+                            and not isinstance(m, ConstVerdict)
+                            and getattr(m, "fallback", None) is None
+                        ):
+                            rebuilds.append(
+                                (key, getattr(eng, "epoch", 0))
+                            )
+            for job in rebuilds:
+                self._build_queue.put(("mesh_rebuild", job))
             self.mesh_repromotions += 1
             metrics.MeshRepromotions.inc()
             metrics.MeshActive.set(1.0)
@@ -3061,6 +3264,84 @@ class VerdictService:
         finally:
             with self._lock:
                 self._mesh_reprobe_inflight = False
+
+    def _run_mesh_rebuild(self, key: tuple, epoch0: int) -> None:
+        """Builder-thread half of the ROADMAP 1c heal: rebuild ONE
+        demotion-era engine's model against the live mesh and flip the
+        pointer in — only if the engine is still registered under the
+        same key, its epoch has not moved (a swap would have rebuilt
+        it sharded already), and the mesh is still promoted.  Verdicts
+        are bit-identical across the flip by the sharding parity
+        contract (same policy rows, same flattened order), so a
+        mid-round flip is as safe as the demotion flip itself."""
+        with self._lock:
+            eng = self._engines.get(key)
+        if (
+            eng is None
+            or self._mesh_demoted is not None
+            or self.guard.quarantined
+            or getattr(eng, "epoch", 0) != epoch0
+        ):
+            return
+        model = getattr(eng, "model", None)
+        if (
+            model is None
+            or isinstance(model, ConstVerdict)
+            or getattr(model, "fallback", None) is not None
+        ):
+            return  # already sharded (or nothing to shard)
+        mesh = self._resolve_mesh()
+        if mesh is None:
+            return
+        module_id, policy_name, ingress, port, proto = key
+        ins = pl.find_instance(module_id)
+        if ins is None:
+            return
+        policy = ins.policy_map().get(policy_name)
+        try:
+            with self._device_ctx():
+                # lint: disable=R12 -- off-path builder-thread rebuild (the mesh-heal rung), never the dispatch loop
+                if proto == "r2d2":
+                    from ..parallel.rulesharding import mesh_r2d2_model
+
+                    built = mesh_r2d2_model(policy, ingress, port, mesh)
+                elif proto == "dns":
+                    from ..parallel.rulesharding import mesh_dns_model
+
+                    built = mesh_dns_model(policy, ingress, port, mesh)
+                else:
+                    from ..parallel.rulesharding import mesh_http_model
+
+                    built = mesh_http_model(policy, ingress, port, mesh)
+                if getattr(built, "fallback", None) is None:
+                    return  # folded to a constant: nothing to flip
+                # Materialize one probe call so a broken mesh fails
+                # HERE (typed, demotion path) and not on dispatch.
+                w = self.config.batch_width
+                out = built(
+                    np.zeros((self.MIN_BUCKET_GREEDY, w), np.uint8),
+                    np.zeros(self.MIN_BUCKET_GREEDY, np.int32),
+                    np.zeros(self.MIN_BUCKET_GREEDY, np.int32),
+                )
+                np.asarray(out[-1])
+        except Exception:  # noqa: BLE001 — engine keeps single-chip
+            log.exception("mesh rebind rebuild failed; engine stays "
+                          "single-chip")
+            return
+        with self._lock:
+            if (
+                self._engines.get(key) is eng
+                and self._mesh_demoted is None
+                and getattr(eng, "epoch", 0) == epoch0
+                and eng.model is model
+            ):
+                eng.model = built
+                self.mesh_rebind_rebuilds += 1
+                metrics.MeshRebindRebuilds.inc()
+                log.info(
+                    "mesh rebind: demotion-era engine %r re-serving "
+                    "sharded", key,
+                )
 
     def _mesh_guarded(self, model, call):
         """Issue one device dispatch; when a SHARDED dispatch raises
@@ -3094,6 +3375,7 @@ class VerdictService:
             "demoted": self._mesh_demoted,
             "demotions": dict(self.mesh_demotions),
             "repromotions": self.mesh_repromotions,
+            "rebind_rebuilds": self.mesh_rebind_rebuilds,
         }
 
     def _model_call(self, model, data, lens, remotes, use_jit=None):
@@ -3290,6 +3572,24 @@ class VerdictService:
                 np.asarray(allow)
         self._mark_shape_prewarmed(model)
 
+    @staticmethod
+    def _framing_alignment_mask(snap, eng_idx, cand, aligner):
+        """THE per-engine frame-alignment mask of the verdict-cache
+        tiers (whole-item and columnar Phase-A share it so the two can
+        never drift): for every engine among the candidate rows,
+        resolve its framing (CRLF fallback for conns without a
+        table-resident engine — the http judge tier and other
+        non-vectorized engines keep the historic PR 12 CRLF tail
+        gate) and apply ``aligner(framing, row_mask)``."""
+        aligned = np.zeros(len(cand), bool)
+        for e in np.unique(eng_idx[cand]):
+            framing = (
+                _engine_framing(snap.objs[int(e)]) if e >= 0 else None
+            ) or FRAMINGS[FRAMING_CRLF]
+            selm = cand & (eng_idx == e)
+            aligned[selm] = aligner(framing, selm)
+        return aligned
+
     def _cache_item_hits(self, it, snap: "_TabSnap"):
         """Per-entry verdict-cache hit mask for one data/mat item, or
         None when nothing hits.  A hit requires: armed row, claim epoch
@@ -3309,17 +3609,27 @@ class VerdictService:
         )
         if not hit.any():
             return None
-        if kind == "mat":
-            hit &= rows_end_crlf(b.rows, b.lengths)
-        else:
+        if kind != "mat":
             hit &= b.flags == 0
             blob = np.frombuffer(b.blob, np.uint8)
             lengths = b.lengths.astype(np.int64)
+            starts = b.offsets[:-1].astype(np.int64)
             if len(blob) != int(lengths.sum()):
                 return None
-            hit &= segments_end_crlf(
-                blob, b.offsets[:-1].astype(np.int64), lengths
-            )
+        # Frame alignment per the hitting conns' ENGINE framing: a
+        # short-circuit must only ever cover whole frames of that
+        # framing (_framing_alignment_mask is the one definition).
+        if kind == "mat":
+            def aligner(framing, selm):
+                return framing.rows_aligned(b.rows[selm], b.lengths[selm])
+        else:
+            def aligner(framing, selm):
+                return framing.segments_aligned(
+                    blob, starts[selm], lengths[selm]
+                )
+        hit &= self._framing_alignment_mask(
+            snap, snap.engine[pos], hit, aligner
+        )
         return hit if hit.any() else None
 
     def _count_cache_hits(self, n: int) -> None:
@@ -3431,6 +3741,10 @@ class VerdictService:
                 self._completion_put(("frame", client, frame, b, rtd))
             if not self._round_thread_suppressed():
                 self._count_cache_hits(n)
+                # LRU recency: one bulk stamp per served item (lock-
+                # free like the hit mask itself; a racing table grow
+                # only costs a stale stamp, never correctness).
+                self._touch_cache_rows(b.conn_ids.astype(np.int64))
                 self._flowlog_cached(
                     snap, b.conn_ids.astype(np.int64),
                     snap.lookup(b.conn_ids),
@@ -3681,11 +3995,15 @@ class VerdictService:
         allow, rules = self._readback_chunks(issued, n)
         if rt is not None:
             rt.completed()  # fenced: np.asarray above IS the readback
-        self.fast_log.log_batch("r2d2", n, int(n - allow.sum()))
+        self.fast_log.log_batch(
+            getattr(engine, "proto", "r2d2"), n, int(n - allow.sum())
+        )
         self.vec_batches += 1
         self.vec_entries += n
         metrics.ProxyBatches.inc()
-        self._send_vec_frames(sends, allow)
+        self._send_vec_frames(
+            sends, allow, getattr(engine, "DENY_INJECT", None)
+        )
         if not self._round_thread_suppressed():
             if rt is not None:
                 self.tracer.finish_round(
@@ -3698,7 +4016,8 @@ class VerdictService:
                     allow, rules,
                 )
 
-    def _send_vec_frames(self, sends, allow) -> None:
+    def _send_vec_frames(self, sends, allow,
+                         deny_inject: bytes | None = None) -> None:
         """Emit a vec round's verdicts: one VERDICT_BATCH frame per
         original message, coalesced into one sendall (+ one writer-lock
         trip) per client — the dominant per-item cost in aggregated
@@ -3709,7 +4028,9 @@ class VerdictService:
         per_client: dict[int, tuple] = {}
         for client, seq, ids, lens, a, b, batch in sends:
             try:
-                frame = self._verdict_frame(seq, ids, lens, allow[a:b])
+                frame = self._verdict_frame(
+                    seq, ids, lens, allow[a:b], deny_inject
+                )
             except Exception:  # noqa: BLE001
                 log.exception("verdict frame build failed")
                 # Fail closed, never silent: the shim is owed exactly
@@ -3894,12 +4215,16 @@ class VerdictService:
                                     rules[a:b] = np.asarray(rv)[:cn]
                         rt.drained()
                         self.fast_log.log_batch(
-                            "r2d2", n, int(n - allow.sum())
+                            getattr(engine, "proto", "r2d2"), n,
+                            int(n - allow.sum()),
                         )
                         self.vec_batches += 1
                         self.vec_entries += n
                         metrics.ProxyBatches.inc()
-                        self._send_vec_frames(sends, allow)
+                        self._send_vec_frames(
+                            sends, allow,
+                            getattr(engine, "DENY_INJECT", None),
+                        )
                         self.tracer.finish_round(
                             rt, [self._batch_desc(s[6]) for s in sends]
                         )
@@ -3954,10 +4279,14 @@ class VerdictService:
 
     _ERR_ROW = np.frombuffer(b"ERROR\r\n", np.uint8)
 
-    def _verdict_body(self, conn_ids, lengths, allow) -> bytes:
+    def _verdict_body(self, conn_ids, lengths, allow,
+                      deny_inject: bytes | None = None) -> bytes:
         """Columnar op assembly: every entry is (PASS|DROP frame, MORE 1)
         — identical to the streaming oracle's op sequence for one
-        complete frame (reference: r2d2parser.go:158-213)."""
+        complete frame (reference: r2d2parser.go:158-213).
+        ``deny_inject`` is the serving engine's per-denied-frame reply
+        bytes (None = the historic r2d2 ``ERROR\r\n``; DNS injects
+        nothing)."""
         n = len(conn_ids)
         tpl = self._frame_tpl.get(n)
         if tpl is None:
@@ -3971,10 +4300,16 @@ class VerdictService:
         ops = ops0.copy()
         ops["op"][0::2] = np.where(allow, int(PASS), int(DROP))
         ops["n_bytes"][0::2] = lengths
+        err_row = (
+            self._ERR_ROW if deny_inject is None
+            else np.frombuffer(deny_inject, np.uint8)
+        )
         nd = n - int(allow.sum())
-        if nd:
-            inj_blob = np.broadcast_to(self._ERR_ROW, (nd, 7)).tobytes()
-            inj_reply = np.where(allow, 0, 7).astype(np.uint32)
+        if nd and len(err_row):
+            inj_blob = np.broadcast_to(
+                err_row, (nd, len(err_row))
+            ).tobytes()
+            inj_reply = np.where(allow, 0, len(err_row)).astype(np.uint32)
         else:
             inj_blob = b""
             inj_reply = zeros_u32
@@ -3982,9 +4317,10 @@ class VerdictService:
             conn_ids, zeros_u32, twos_u32, zeros_u32, inj_reply, ops, inj_blob
         )
 
-    def _verdict_frame(self, seq, conn_ids, lengths, allow) -> bytes:
+    def _verdict_frame(self, seq, conn_ids, lengths, allow,
+                       deny_inject: bytes | None = None) -> bytes:
         return struct.pack("<QI", seq, len(conn_ids)) + self._verdict_body(
-            conn_ids, lengths, allow
+            conn_ids, lengths, allow, deny_inject
         )
 
     def _catch_up_epoch(self, conn_id: int, sc: "_SidecarConn") -> None:
@@ -4012,7 +4348,7 @@ class VerdictService:
                 self._migrate_flow(old_eng, eng, conn_id, sc)
             if eng is not None:
                 sc.engine = eng
-                sc.fast_ok = sc.conn.parser_name == "r2d2"
+                sc.fast_ok = sc.conn.parser_name in FAST_PROTOS
             else:
                 sc.engine = None
                 sc.fast_ok = False
@@ -4084,6 +4420,7 @@ class VerdictService:
         eng_flow = (
             sc.engine.flows.get(conn_id) if sc.engine is not None else None
         )
+        framing = _engine_framing(sc.engine)
         # Verdict-cache hit, scalar tier (the greedy-mode and minority-
         # entry twin of the columnar Phase-A mask): armed conn, claim
         # epoch current, no residue anywhere, frame-aligned payload.
@@ -4096,8 +4433,8 @@ class VerdictService:
             and not reply
             and not end_stream
             and conn_id not in slow_conns
-            and len(data) >= 2
-            and data.endswith(b"\r\n")
+            and framing is not None
+            and framing.payload_aligned(data)
             and not sc.bufs[False]
             and conn_id < self._tab_size
             and self._tab_cache[conn_id] == 1
@@ -4115,6 +4452,7 @@ class VerdictService:
                 conn_id, int(FilterResult.OK),
                 [(int(PASS), len(data)), (int(MORE), 1)], b"", b"",
             )
+            self._touch_cache_rows(np.array([conn_id], np.int64))
             cache_hits.append((key, i, conn_id, rule, sc.engine))
             return
         if (
@@ -4127,9 +4465,8 @@ class VerdictService:
                 or not (eng_flow.buffer or eng_flow.overflowed)
             )
             and not isinstance(sc.engine.model, ConstVerdict)
-            and len(data) >= 2
-            and data.endswith(b"\r\n")
-            and data.find(b"\r\n") == len(data) - 2
+            and framing is not None
+            and framing.payload_single_frame(data)
             and len(data) <= self.config.batch_width
         ):
             fast.append((key, i, sc, conn_id, data))
@@ -4482,15 +4819,16 @@ class VerdictService:
         if elig.any():
             for e in np.unique(eng_idx[elig]):
                 engine = snap.objs[int(e)]
-                spec = getattr(engine, "reasm_spec", None)
+                # Per-framing dispatch (reasm.FRAMINGS): an engine
+                # rides the lane iff its declared framing has a
+                # registered scanner — CRLF (r2d2 class) and the DNS
+                # length prefix today; an engine declaring anything
+                # else (cassandra/kafka until their parser state goes
+                # arena-portable) must never be scanned with the wrong
+                # framing into garbage frames.
                 if (
                     engine is None
-                    or not getattr(engine, "reasm_columnar", False)
-                    # The lane's scanner is CRLF: an engine declaring
-                    # any other framing (the length-prefix class) must
-                    # never be CRLF-scanned into garbage frames, even
-                    # if it grows reasm_columnar before its lane lands.
-                    or spec is None or spec() != FRAMING_CRLF
+                    or _engine_framing(engine) is None
                     or isinstance(engine.model, ConstVerdict)
                 ):
                     elig &= eng_idx != e
@@ -4529,8 +4867,14 @@ class VerdictService:
                 & (snap.cache[pos] == 1)
                 & (snap.cache_epoch[pos] == snap.epoch)
                 & (~dirty)
-                & segments_end_crlf(blob, starts, lengths)
             )
+            if hit.any():
+                hit &= self._framing_alignment_mask(
+                    snap, eng_idx, hit,
+                    lambda framing, selm: framing.segments_aligned(
+                        blob, starts[selm], lengths[selm]
+                    ),
+                )
             if dup_mask is not None:
                 hit &= ~dup_mask
             if hit.any():
@@ -4614,6 +4958,7 @@ class VerdictService:
             rt.cache_s = cache_s
             if not self._round_thread_suppressed():
                 self._count_cache_hits(n_hit)
+                self._touch_cache_rows(conn_ids[hit_idx])
                 self._flowlog_cached(
                     snap, conn_ids[hit_idx], pos[hit_idx]
                 )
@@ -4640,7 +4985,8 @@ class VerdictService:
             sel = e_live[eng_idx[e_live] == e]
             engine = snap.objs[int(e)]
             rnd = reasm.ingest(
-                conn_ids[sel], starts[sel], lengths[sel], blob
+                conn_ids[sel], starts[sel], lengths[sel], blob,
+                framing=_engine_framing(engine),
             )
             if rnd.over.any():
                 # Same accounting as the scalar engine rung's
@@ -4818,7 +5164,8 @@ class VerdictService:
             finished.append((sel, engine, rnd, allow_f, rule_f,
                              assembled))
             self.fast_log.log_batch(
-                "r2d2", nf, int(nf - int(allow_f.sum()))
+                getattr(engine, "proto", "r2d2"), nf,
+                int(nf - int(allow_f.sum())),
             )
         # Round-wide merge: per-entry counts first, then one scatter
         # pass for ops and injects (scalar minority written per entry).
@@ -5078,7 +5425,7 @@ class VerdictService:
             lengths = np.zeros((f_pad,), np.int32)
             remotes = np.zeros((f_pad,), np.int32)
             for j, (rec, msg, msg_len) in enumerate(metas):
-                row = np.frombuffer(msg + b"\r\n", np.uint8)
+                row = np.frombuffer(engine.frame_row(msg), np.uint8)
                 data_m[j, : len(row)] = row
                 lengths[j] = msg_len
                 remotes[j] = rec[2].conn.src_id
@@ -5254,14 +5601,16 @@ class VerdictService:
                     allow = np.zeros(n, bool)
                     rules = None
             denied = int(n - allow.sum())
-            self.fast_log.log_batch("r2d2", n, denied)
+            self.fast_log.log_batch(
+                getattr(engine, "proto", "r2d2"), n, denied
+            )
             for i, (key, idx, sc, conn_id, payload) in enumerate(recs):
                 if allow[i]:
                     ops = [(int(PASS), len(payload)), (int(MORE), 1)]
                     inj = b""
                 else:
                     ops = [(int(DROP), len(payload)), (int(MORE), 1)]
-                    inj = b"ERROR\r\n"
+                    inj = getattr(engine, "DENY_INJECT", b"ERROR\r\n")
                 if rules_out is not None:
                     r_i = int(rules[i]) if rules is not None else -1
                     rules_out[(key, idx)] = (

@@ -354,6 +354,13 @@ MeshActive = registry.gauge(
     "1 while the (flows, rules) device mesh serves verdicts, 0 when "
     "off or demoted",
 )
+MeshRebindRebuilds = registry.counter(
+    "mesh_rebind_rebuilds_total",
+    "Demotion-era engines (built single-chip while the mesh rung was "
+    "demoted) re-sharded by the heal's queued off-path rebuilds "
+    "(ROADMAP 1c: the re-promotion flip queues a rebind per stranded "
+    "engine instead of waiting for the next epoch swap)",
+)
 MeshRepromotions = registry.counter(
     "mesh_repromotions_total",
     "Demoted sharded serving re-promoted after a timed off-path "
@@ -385,6 +392,13 @@ VerdictCacheInvalidations = registry.counter(
     "makes stale hits structurally impossible; this counts the armed "
     "rows each flip retired) and quarantine/close disarms",
     ("reason",),
+)
+VerdictCacheEvictions = registry.counter(
+    "verdict_cache_evictions_total",
+    "Armed rows evicted LRU-by-last-hit at the flow_cache_entries "
+    "cap (capacity management, not invalidation: the victim's claim "
+    "stays true for its epoch, so delivered shim grants need no "
+    "revoke)",
 )
 FlowBufferOverflows = registry.counter(
     "flow_buffer_overflow_total",

@@ -1,0 +1,372 @@
+"""DNS name-policy model (models/dns.py) vs the streaming oracle
+(proxylib/parsers/dns.py) — wire-format fuzz parity, pattern semantics,
+0x20 case folding, structural-validity edges, first-match attribution,
+the byte-invariance claim, and the rule-axis sharded build."""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from cilium_tpu.models.base import ConstVerdict
+from cilium_tpu.models.dns import (
+    DNS_MIN_FRAME,
+    build_dns_model_from_rows,
+    collect_dns_policy_rows,
+    dns_verdicts,
+    dns_verdicts_attr,
+)
+from cilium_tpu.policy.invariance import invariant_verdict
+from cilium_tpu.proxylib import (
+    NetworkPolicy,
+    PortNetworkPolicy,
+    PortNetworkPolicyRule,
+)
+from cilium_tpu.proxylib.parsers.dns import (
+    DNS_QNAME_OFF,
+    DnsParser,
+    DnsRequestData,
+    DnsRule,
+    MAX_LABELS,
+    encode_dns_query,
+    parse_dns_query,
+    pattern_to_regex,
+)
+from cilium_tpu.proxylib.policy import compile_policy
+from cilium_tpu.proxylib.types import DROP, MORE, PASS
+
+
+def _batch(frames, remotes, width=None):
+    width = width or max(8, max((len(f) for f in frames), default=8))
+    n = len(frames)
+    data = np.zeros((n, width), np.uint8)
+    lens = np.zeros(n, np.int32)
+    for i, f in enumerate(frames):
+        row = np.frombuffer(f, np.uint8)
+        data[i, : len(row)] = row
+        lens[i] = len(row)
+    return data, lens, np.asarray(remotes, np.int32)
+
+
+def _host_walk(rows, frame, remote):
+    """The oracle's first-match walk over flattened rows — the
+    attribution ground truth."""
+    name = parse_dns_query(frame)
+    req = DnsRequestData(
+        name=name if name is not None else "", valid=name is not None
+    )
+    for j, (rs, rule) in enumerate(rows):
+        if rs and remote not in rs:
+            continue
+        if rule is None or rule.matches(req):
+            return True, j
+    return False, -1
+
+
+# --- wire parsing ----------------------------------------------------------
+
+def test_parse_dns_query_shapes():
+    assert parse_dns_query(encode_dns_query("www.Example.COM")) \
+        == "www.example.com"
+    assert parse_dns_query(encode_dns_query("")) == ""
+    # trailing structural requirements
+    f = encode_dns_query("a.b")
+    assert parse_dns_query(f[:-1]) is None  # QCLASS truncated
+    assert parse_dns_query(encode_dns_query("x", qdcount=0)) is None
+    # compression pointer in a query QNAME: invalid
+    bad = bytearray(encode_dns_query("ptr.example.com"))
+    bad[DNS_QNAME_OFF] = 0xC0
+    assert parse_dns_query(bytes(bad)) is None
+    # label > 63
+    assert parse_dns_query(encode_dns_query("y" * 64)) is None
+    # label-count bound is shared with the device walk
+    deep_ok = ".".join("a" * 1 for _ in range(MAX_LABELS))
+    deep_bad = ".".join("a" * 1 for _ in range(MAX_LABELS + 1))
+    assert parse_dns_query(encode_dns_query(deep_ok)) == deep_ok
+    assert parse_dns_query(encode_dns_query(deep_bad)) is None
+
+
+def test_pattern_lowering_semantics():
+    # Leading *. = one or MORE whole labels; inner * = non-dot run.
+    assert pattern_to_regex("*.example.com") \
+        == "^([^.]+[.])+example\\.com$"
+    r = DnsRule(pattern="*.example.com")
+    assert r.matches(DnsRequestData("www.example.com"))
+    assert r.matches(DnsRequestData("a.b.example.com"))
+    assert not r.matches(DnsRequestData("example.com"))
+    assert not r.matches(DnsRequestData("wexample.com"))
+    inner = DnsRule(pattern="www.*.com")
+    assert inner.matches(DnsRequestData("www.example.com"))
+    assert inner.matches(DnsRequestData("www..com".replace("..", ".x.")))
+    assert not inner.matches(DnsRequestData("www.a.b.com"))
+    # trailing dots normalize; matchName folds case
+    assert DnsRule(name="WWW.Example.Com.").matches(
+        DnsRequestData("www.example.com")
+    )
+    # constrained rules never match an invalid query; byte-free does
+    invalid = DnsRequestData("", valid=False)
+    assert not DnsRule(name="x.y").matches(invalid)
+    assert not DnsRule(pattern="*.y").matches(invalid)
+    assert not DnsRule(regex=".*").matches(invalid)
+    assert DnsRule().matches(invalid)
+
+
+# --- model vs oracle fuzz --------------------------------------------------
+
+def _fuzz_rows():
+    return [
+        (frozenset({7}), DnsRule(name="www.example.com")),
+        (frozenset(), DnsRule(pattern="*.svc.cluster.local")),
+        (frozenset({7, 9}), DnsRule(regex="^cdn[0-9]+[.]edge[.]net$")),
+        (frozenset({3}), None),
+        (frozenset(), DnsRule(name="api.internal")),
+    ]
+
+
+def _fuzz_frames(rng):
+    names = [
+        "www.example.com", "WWW.EXAMPLE.COM", "example.com",
+        "a.svc.cluster.local", "x.y.svc.cluster.local",
+        "svc.cluster.local", "cdn42.edge.net", "cdnx.edge.net",
+        "api.internal", "api.internal2", "", "a" * 63,
+    ]
+    frames = []
+    for _ in range(200):
+        roll = rng.random()
+        if roll < 0.7:
+            frames.append(encode_dns_query(rng.choice(names)))
+        elif roll < 0.8:  # compression pointer / oversized label
+            bad = bytearray(encode_dns_query(rng.choice(names) or "x"))
+            bad[DNS_QNAME_OFF] = rng.choice([0xC0, 64, 255])
+            frames.append(bytes(bad))
+        elif roll < 0.9:  # qdcount 0
+            frames.append(
+                encode_dns_query(rng.choice(names), qdcount=0)
+            )
+        else:  # random garbage message with a coherent prefix
+            body = bytes(
+                rng.randrange(256) for _ in range(rng.randrange(13, 40))
+            )
+            frames.append(len(body).to_bytes(2, "big") + body)
+    return frames
+
+
+def test_model_matches_oracle_fuzz():
+    rng = random.Random(29)
+    rows = _fuzz_rows()
+    model = build_dns_model_from_rows(rows, bucket=True)
+    frames = _fuzz_frames(rng)
+    remotes = [rng.choice([1, 3, 7, 9]) for _ in frames]
+    data, lens, rems = _batch(frames, remotes)
+    c, ml, allow, rule = (
+        np.asarray(x) for x in dns_verdicts_attr(model, data, lens, rems)
+    )
+    for i, f in enumerate(frames):
+        assert bool(c[i])
+        assert int(ml[i]) == len(f)
+        want_allow, want_rule = _host_walk(rows, f, remotes[i])
+        assert bool(allow[i]) == want_allow, (i, f, remotes[i])
+        assert int(rule[i]) == want_rule, (i, f, remotes[i])
+    # plain call agrees with the attributed call
+    c2, ml2, allow2 = (
+        np.asarray(x) for x in dns_verdicts(model, data, lens, rems)
+    )
+    assert (allow2 == allow).all() and (ml2 == ml).all()
+
+
+def test_incomplete_and_pipelined_rows():
+    rows = [(frozenset(), None)]
+    model = build_dns_model_from_rows(rows)
+    f1 = encode_dns_query("a.b")
+    f2 = encode_dns_query("c.d")
+    frames = [f1[:1], f1[:-3], f1 + f2, f1]
+    data, lens, rems = _batch(frames, [1] * len(frames))
+    c, ml, allow = (
+        np.asarray(x) for x in dns_verdicts(model, data, lens, rems)
+    )
+    assert not c[0] and not c[1]  # prefix-incomplete frames
+    assert c[2] and int(ml[2]) == len(f1)  # first frame only
+    assert c[3] and int(ml[3]) == len(f1)
+    assert bool(allow[2]) and bool(allow[3])
+
+
+def test_min_frame_and_root():
+    rows = [(frozenset(), DnsRule(name="a"))]
+    model = build_dns_model_from_rows(rows)
+    tiny = (3).to_bytes(2, "big") + b"xyz"  # complete, < DNS_MIN_FRAME
+    root = encode_dns_query("")
+    data, lens, rems = _batch([tiny, root], [1, 1], width=32)
+    c, ml, allow = (
+        np.asarray(x) for x in dns_verdicts(model, data, lens, rems)
+    )
+    assert c[0] and not bool(allow[0])  # invalid: name rule can't match
+    assert c[1] and not bool(allow[1])  # root != "a"
+    assert len(tiny) < DNS_MIN_FRAME
+
+
+def test_long_exact_name_never_prefix_matches():
+    """Review-hardening regression (confirmed bug shape): an exact
+    name longer than any fixed needle ceiling must still compare in
+    FULL on the device — truncation would turn the exact compare into
+    a prefix compare and over-allow queries sharing the first bytes
+    (a device/host parity break the host oracle never produces).
+    Also pins the sharded build to the same (unclamped) width."""
+    import jax
+
+    from cilium_tpu.parallel.rulesharding import (
+        build_sharded_dns_from_rows,
+    )
+
+    long_name = ".".join(["a" * 60] * 5)  # 304 chars, walk-legal
+    imposter = long_name[:-1] + "b"
+    rows = [(frozenset(), DnsRule(name=long_name))]
+    model = build_dns_model_from_rows(rows)
+    frames = [encode_dns_query(long_name), encode_dns_query(imposter)]
+    data, lens, rems = _batch(frames, [1, 1])
+    _, _, allow = (
+        np.asarray(x) for x in dns_verdicts(model, data, lens, rems)
+    )
+    assert bool(allow[0]) and not bool(allow[1]), allow.tolist()
+    for i, f in enumerate(frames):
+        want, _ = _host_walk(rows, f, 1)
+        assert bool(allow[i]) == want
+    stacked = build_sharded_dns_from_rows(rows, 2)
+    sh_allow = np.zeros(2, bool)
+    for k in range(2):
+        local = jax.tree_util.tree_map(lambda x: x[k], stacked)
+        sh_allow |= np.asarray(
+            dns_verdicts(local, data, lens, rems)[2]
+        )
+    assert sh_allow.tolist() == allow.tolist()
+
+
+# --- policy cascade + invariance ------------------------------------------
+
+def _dns_policy(rules, port=53, name="dnsm"):
+    return compile_policy(NetworkPolicy(
+        name=name,
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=port,
+                rules=[
+                    PortNetworkPolicyRule(l7_proto="dns", l7_rules=rules)
+                ],
+            )
+        ],
+    ))
+
+
+def test_collect_rows_and_const_folds():
+    pol = _dns_policy([{"matchName": "a.b"}])
+    rows = collect_dns_policy_rows(pol, True, 53)
+    assert len(rows) == 1 and rows[0][1].name == "a.b"
+    assert isinstance(
+        collect_dns_policy_rows(pol, True, 99), ConstVerdict
+    )
+    assert isinstance(
+        collect_dns_policy_rows(None, True, 53), ConstVerdict
+    )
+
+
+def test_invariance_claim():
+    rows = [
+        (frozenset({5}), DnsRule(name="a.b")),
+        (frozenset({3}), None),  # byte-free
+        (frozenset(), DnsRule(pattern="*.x")),
+    ]
+    model = build_dns_model_from_rows(rows)
+    inv = model.invariant_rows
+    # identity 3: first admitting row is byte-free -> invariant allow
+    assert invariant_verdict(inv, 3) == (True, 1)
+    # identity 5: first admitting row inspects bytes -> no claim
+    assert invariant_verdict(inv, 5) is None
+    # the claim is honest: identity 3 is allowed for ANY whole frame,
+    # including a structurally invalid one, at rule row 1
+    bad = bytearray(encode_dns_query("z.q"))
+    bad[DNS_QNAME_OFF] = 0xC0
+    data, lens, rems = _batch(
+        [bytes(bad), encode_dns_query("weird.name")], [3, 3]
+    )
+    c, ml, allow, rule = (
+        np.asarray(x)
+        for x in dns_verdicts_attr(model, data, lens, rems)
+    )
+    assert bool(allow[0]) and int(rule[0]) == 1
+    assert bool(allow[1]) and int(rule[1]) == 1
+
+
+# --- streaming parser op contract -----------------------------------------
+
+class _Conn:
+    def __init__(self, rules, remote=1):
+        self.rules = rules
+        self.remote = remote
+        self.logged = []
+
+    def matches(self, req):
+        return any(
+            (r is None or r.matches(req))
+            for rs, r in self.rules
+            if not rs or self.remote in rs
+        )
+
+    def log(self, entry_type, **kw):
+        self.logged.append((entry_type, kw))
+
+
+def test_parser_op_sequence():
+    rules = [(frozenset(), DnsRule(name="ok.com"))]
+    p = DnsParser(_Conn(rules))
+    f_ok = encode_dns_query("OK.com")
+    f_bad = encode_dns_query("no.com")
+    assert p.on_data(False, False, [f_ok[:1]]) == (MORE, 1)
+    assert p.on_data(False, False, [f_ok[:7]]) == (MORE, 1)
+    assert p.on_data(False, False, [f_ok]) == (PASS, len(f_ok))
+    op, n = p.on_data(False, False, [f_bad + f_ok])
+    assert (op, n) == (DROP, len(f_bad))  # first frame only, no inject
+    assert p.on_data(True, False, [f_bad]) == (PASS, len(f_bad))
+
+
+# --- sharded build ---------------------------------------------------------
+
+@pytest.mark.parametrize("n_shards", [2, 3])
+def test_sharded_rows_match_single_chip(n_shards):
+    """The stacked shard build evaluated shard-by-shard (host-side,
+    no mesh needed) reproduces the single-chip model: OR of per-shard
+    allows, min of biased per-shard first-match rows."""
+    import jax
+
+    from cilium_tpu.parallel.rulesharding import (
+        build_sharded_dns_from_rows,
+        shard_offsets,
+    )
+
+    rng = random.Random(31)
+    rows = _fuzz_rows()
+    single = build_dns_model_from_rows(rows)
+    stacked = build_sharded_dns_from_rows(rows, n_shards)
+    offsets = np.asarray(shard_offsets(len(rows), n_shards))
+    frames = _fuzz_frames(rng)[:60]
+    remotes = [rng.choice([1, 3, 7, 9]) for _ in frames]
+    data, lens, rems = _batch(frames, remotes)
+    _, _, want_allow, want_rule = (
+        np.asarray(x)
+        for x in dns_verdicts_attr(single, data, lens, rems)
+    )
+    allow = np.zeros(len(frames), bool)
+    best = np.full(len(frames), np.iinfo(np.int32).max, np.int64)
+    for k in range(n_shards):
+        local = jax.tree_util.tree_map(lambda x: x[k], stacked)
+        _, _, a, r = (
+            np.asarray(x)
+            for x in dns_verdicts_attr(local, data, lens, rems)
+        )
+        allow |= a
+        cand = np.where(r >= 0, r + offsets[k], np.iinfo(np.int32).max)
+        best = np.minimum(best, cand)
+    rule = np.where(allow, best, -1)
+    assert (allow == want_allow).all()
+    assert (rule == want_rule).all()

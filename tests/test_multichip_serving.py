@@ -135,7 +135,7 @@ def test_mesh_service_serves_sharded_bit_identical(tmp_path):
         assert st == {
             "devices": 8, "flow_shards": 4, "rule_shards": 2,
             "active": True, "demoted": None, "demotions": {},
-            "repromotions": 0,
+            "repromotions": 0, "rebind_rebuilds": 0,
         }
         # Single-chip control, same traffic.
         inst.reset_module_registry()
