@@ -27,6 +27,15 @@ linter):
       block_until_ready on the dispatch hot path)
   R10 shard_map/pjit in_specs/out_specs vs function arity
   R11 fused-attribution integrity (one shared hit-matrix pass)
+  R12 compile-on-dispatch-path (recompiles ride the builder thread)
+  R13 epoch-unkeyed caches in hot modules
+  R14 exactly-once verdict accounting (admit paths reach an answer
+      site or typed hand-off; answer sites are exclusivity-guarded)
+  R15 exception containment (no raise out of a per-entry hot loop
+      without a typed outcome; interprocedural raise-taint)
+  R16 jit shape-closure (dispatch axes drawn from the declared
+      power-of-two bucket universe; abstract twin audits the real
+      serving surface end to end)
   R0  lint pragma hygiene (malformed / unjustified suppressions)
 
 Layer 1 is the interprocedural engine (``callgraph.py``): a project-
@@ -39,7 +48,10 @@ under JAX_PLATFORMS=cpu — no device, zero runtime cost).
 
 Run ``bin/cilium-lint cilium_tpu/`` (see README "Invariants & lint");
 ``--ratchet`` gates the suppression count one-way downward,
-``--device-contracts`` adds the abstract-trace layer.
+``--device-contracts`` adds the abstract-trace layer (R8-R11 plus the
+R16 shape-closure audit), ``--diff <rev>`` scans changed files only
+(warm pre-commit mode, fail-closed on a bad rev) and ``--sarif``
+emits SARIF 2.1.0 for CI annotation.
 Suppress a false positive on its line with a JUSTIFIED pragma::
 
     risky_call()  # lint: disable=R2 -- why this is safe here

@@ -17,7 +17,25 @@ the gate cannot be disarmed by deleting the number.
 ``--device-contracts`` additionally runs the abstract-trace layer
 (``analysis/devicecheck.py``): the real verdict models are traced
 under ``JAX_PLATFORMS=cpu`` (eval_shape/make_jaxpr — no device, no
-execution) and the R8-R11 contracts verified on the jaxprs themselves.
+execution) and the R8-R11 contracts plus the R16 shape-closure audit
+verified on the jaxprs themselves.
+
+``--diff <rev>`` reports only findings in files changed since ``rev``
+(plus untracked files) — the warm-cache pre-commit mode.  The
+ANALYSIS still covers the full scan target: the interprocedural rules
+are whole-program (R5's seam symmetry, R7's cross-file metric
+references, R14's answer fixpoint), so scanning only the changed
+files would both invent findings (half a seam looks broken) and miss
+real ones; the content-hash parse/graph cache is what makes the full
+pass cheap on a warm tree.  The rev is validated through git and a
+failure is rc 2 (fail closed, like a typo'd path); zero CHANGED
+Python files is a legitimate no-op (rc 0), unlike a zero-file scan
+target, which stays rc 2.
+
+``--sarif`` emits a SARIF 2.1.0 report on stdout for CI annotation
+(one result per ACTIVE finding; pragma/baseline suppressions are
+recorded as inSource/external suppressions so code-scanning UIs show
+them resolved).
 """
 
 from __future__ import annotations
@@ -25,6 +43,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from .core import (
@@ -105,11 +124,94 @@ def _ratchet(args, baseline_path, baseline_full, muted) -> int | None:
     return None
 
 
+def _changed_files(rev: str) -> set[str] | None:
+    """Absolute paths changed since ``rev`` plus untracked files, or
+    None when git cannot answer (bad rev / not a repo) — the caller
+    fails CLOSED on None: a silent empty diff would green-light
+    anything."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", rev],
+            capture_output=True, text=True, timeout=60, check=True,
+            cwd=top,
+        ).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, timeout=60, check=True,
+            cwd=top,
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    # realpath on BOTH sides of the membership test: git reports
+    # physical paths, and a symlinked scan path (macOS /tmp) abspath'd
+    # naively would intersect to nothing — a silent empty diff in an
+    # explicitly fail-closed gate.
+    return {
+        os.path.realpath(os.path.join(top, line.strip()))
+        for line in (diff + untracked).splitlines()
+        if line.strip()
+    }
+
+
+def _sarif_report(findings) -> dict:
+    """SARIF 2.1.0 for CI annotation: active findings as results,
+    suppressed ones carried with their suppression kind so the
+    code-scanning UI shows them resolved instead of re-opening them."""
+    from .core import RULE_DOCS
+
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/"),
+                    },
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": max(f.col + 1, 1),
+                    },
+                },
+            }],
+        }
+        if f.suppressed or f.baselined:
+            res["suppressions"] = [{
+                "kind": "inSource" if f.suppressed else "external",
+                "justification": f.justification,
+            }]
+        results.append(res)
+    return {
+        "$schema": ("https://json.schemastore.org/sarif-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                # No informationUri: SARIF 2.1.0 requires an absolute
+                # URI there and this repo has no canonical public URL
+                # — strict consumers reject a relative reference.
+                "name": "cilium-lint",
+                "rules": [
+                    {"id": rule,
+                     "shortDescription": {"text": doc}}
+                    for rule, doc in sorted(RULE_DOCS.items())
+                ],
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="cilium-lint",
         description="whole-program concurrency & device-contract "
-                    "invariant analyzer (rules R0-R13; see README "
+                    "invariant analyzer (rules R0-R16; see README "
                     "'Invariants & lint')",
     )
     p.add_argument("paths", nargs="*", default=["cilium_tpu"],
@@ -135,9 +237,20 @@ def main(argv=None) -> int:
                    help="with --ratchet: record the current (lower) "
                         "suppressed count into the baseline file")
     p.add_argument("--device-contracts", action="store_true",
-                   help="also verify R8-R11 on the real verdict "
-                        "models by abstract tracing (JAX_PLATFORMS="
-                        "cpu; no device, no model execution)")
+                   help="also verify R8-R11 and the R16 shape-closure "
+                        "audit on the real verdict models by abstract "
+                        "tracing (JAX_PLATFORMS=cpu; no device, no "
+                        "model execution)")
+    p.add_argument("--diff", default=None, metavar="REV",
+                   help="report only findings in files changed since "
+                        "REV (plus untracked files); the whole-"
+                        "program analysis still covers the full scan "
+                        "target (warm-cache pre-commit mode) — a bad "
+                        "rev fails closed (rc 2), zero changed "
+                        "Python files is a no-op (rc 0)")
+    p.add_argument("--sarif", action="store_true",
+                   help="emit a SARIF 2.1.0 report on stdout for CI "
+                        "annotation (mutually exclusive with --json)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule set and exit")
     args = p.parse_args(argv)
@@ -146,6 +259,11 @@ def main(argv=None) -> int:
         for rule, doc in sorted(RULE_DOCS.items()):
             print(f"{rule}  {doc}")
         return 0
+
+    if args.as_json and args.sarif:
+        print("cilium-lint: --json and --sarif are mutually "
+              "exclusive", file=sys.stderr)
+        return 2
 
     # The gate must fail CLOSED on a misconfigured invocation: a
     # typo'd path (or a CI job run from the wrong cwd) scanning zero
@@ -159,6 +277,28 @@ def main(argv=None) -> int:
         print("cilium-lint: no Python files found under "
               + " ".join(args.paths), file=sys.stderr)
         return 2
+    diff_filter: set[str] | None = None
+    if args.diff is not None:
+        changed = _changed_files(args.diff)
+        if changed is None:
+            # A bad rev (or no git) must not masquerade as a clean
+            # scan — same fail-closed stance as a typo'd path.
+            print(f"cilium-lint: --diff {args.diff}: git could not "
+                  f"resolve the diff; fix the rev or drop --diff",
+                  file=sys.stderr)
+            return 2
+        diff_filter = {
+            os.path.realpath(f) for f in _collect_py(args.paths)
+            if os.path.realpath(f) in changed
+        }
+        if not diff_filter:
+            # The rev resolved and nothing under the scan paths
+            # changed: a legitimate no-op (the pre-commit fast path),
+            # NOT the misconfigured-scan case above.
+            print(f"cilium-lint: no Python files under "
+                  f"{' '.join(args.paths)} changed since "
+                  f"{args.diff}; nothing to scan", file=sys.stderr)
+            return 0
 
     baseline = None
     baseline_path = None
@@ -174,6 +314,10 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 return 2
 
+    # The analysis ALWAYS sees the full scan target — the
+    # interprocedural rules need both halves of every seam; --diff
+    # only narrows the REPORT (the warm content-hash cache is what
+    # makes the full pass cheap pre-commit).
     findings = analyze_paths(args.paths, baseline=baseline)
     if args.device_contracts:
         from . import devicecheck
@@ -189,15 +333,33 @@ def main(argv=None) -> int:
                 if any(_baseline_matches(e, f) for e in baseline):
                     f.baselined = True
         findings.extend(extra)
-    active, muted = split_findings(findings)
 
     if args.ratchet:
-        rc = _ratchet(args, baseline_path, baseline_full, muted)
+        # The ratchet counts the FULL (pre-filter) view: it gates the
+        # tree-wide suppression total, and letting a --diff run record
+        # a changed-files-only count would corrupt the baseline for
+        # every full run after it.
+        _, full_muted = split_findings(findings)
+        rc = _ratchet(args, baseline_path, baseline_full, full_muted)
         if rc is not None:
             return rc
 
+    # The report filter runs LAST — after the device-contract extend —
+    # so diff mode never reports (or fails on) a finding in a file the
+    # rev did not touch.
+    if diff_filter is not None:
+        findings = [
+            f for f in findings
+            if os.path.realpath(f.path) in diff_filter
+        ]
+    active, muted = split_findings(findings)
+
     if args.as_json:
         print(json.dumps(findings_to_json(findings), indent=2))
+        return 1 if active else 0
+
+    if args.sarif:
+        print(json.dumps(_sarif_report(findings), indent=2))
         return 1 if active else 0
 
     for f in active:

@@ -76,6 +76,22 @@ RULE_DOCS = {
            "epoch store in the function), or a cache read with no "
            "epoch check anywhere in the consumer — a policy "
            "pointer-flip leaves such entries serving the old table",
+    "R14": "exactly-once verdict accounting: an admit root that can "
+           "bare-return without reaching an answer site or typed "
+           "hand-off (silent loss), or two answer sites reachable "
+           "for the same entry with no dominating exclusivity guard "
+           "(answered cell / thread_round_is_shed / drain-lock pop) "
+           "— the deposed-round double-reply class",
+    "R15": "exception containment: a call chain that can raise out "
+           "of a per-entry/per-round hot loop (dispatch/service/"
+           "reasm roots) with no enclosing handler that produces a "
+           "typed outcome (UNKNOWN_ERROR/SHED/demotion) — one bad "
+           "entry aborts the drain and the rest leak unanswered",
+    "R16": "jit shape-closure: a dispatch batch axis drawn from raw "
+           "len()/.count/.shape instead of the declared power-of-two "
+           "bucket universe keys a new executable per size — the "
+           "abstract-trace twin (--device-contracts) audits the real "
+           "serving surface against the enumerated closure",
 }
 
 # ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
@@ -383,8 +399,10 @@ def _collect_py(paths) -> list[str]:
 
 def all_rules():
     from . import (
+        rules_answers,
         rules_cache,
         rules_compile,
+        rules_contain,
         rules_device,
         rules_jit,
         rules_locks,
@@ -407,6 +425,9 @@ def all_rules():
         rules_device.check_r11,
         rules_compile.check_r12,
         rules_cache.check_r13,
+        rules_answers.check_r14,
+        rules_contain.check_r15,
+        rules_device.check_r16,
     ]
 
 

@@ -414,4 +414,324 @@ def check_device_contracts() -> list[Finding]:
     for name, path, model in _model_cases():
         findings.extend(_check_model(name, path, model))
     findings.extend(_check_sharded())
+    findings.extend(check_shape_closure())
+    return findings
+
+
+# --- R16: shape-closure audit ---------------------------------------------
+#
+# "No new jit shapes" was prose until now.  This half makes it a gate:
+# enumerate the DECLARED executable-shape universe (the service's
+# MIN_BUCKET pow2 ladder, pack_buckets' width ladder, the
+# MIN_RULE_BUCKET churn buckets, the mesh shard extents, bounded by
+# SHAPE_CACHE_MAX), trace the full serving surface abstractly
+# (eval_shape — no device, no execution), and assert the traced
+# executable set is CLOSED under that universe.  A future engine that
+# ships an unbucketed axis — one raw batch size, one unpadded rule
+# table — fails HERE, as a tier-1 gate, instead of silently
+# re-tracing per shape on the hot path.
+
+_AXIS_CAP = 1 << 22
+
+
+def _pow2_set(floor: int, cap: int = _AXIS_CAP) -> frozenset:
+    out = set()
+    v = int(floor)
+    while v <= cap:
+        out.add(v)
+        v *= 2
+    return frozenset(out)
+
+
+def enumerate_shape_universe() -> dict:
+    """The statically-declared executable-shape universe, resolved
+    from the SAME constants the serving path derives its shapes from
+    (a second copy could drift and silently unpair the gate)."""
+    from ..models.r2d2 import MIN_RULE_BUCKET
+    from ..sidecar.service import VerdictService
+    from ..utils import defaults
+
+    return {
+        # Dispatch batch (flow) axis: pow2 from the greedy floor; the
+        # remote floor (MIN_BUCKET) and every pack_buckets f_pad are
+        # members by construction.
+        "flows": _pow2_set(VerdictService.MIN_BUCKET_GREEDY),
+        # Row width axis: pack_buckets widens base_width << k.
+        "widths": _pow2_set(defaults.BATCH_WIDTH),
+        # Rule-table churn buckets (models/r2d2.MIN_RULE_BUCKET).
+        "rules": _pow2_set(MIN_RULE_BUCKET),
+        # Mesh shard extents: pow2, flow extent capped at the
+        # smallest dispatch bucket so every bucket divides it.
+        "mesh": _pow2_set(1, VerdictService.MIN_BUCKET_GREEDY),
+        "cache_max": VerdictService.SHAPE_CACHE_MAX,
+    }
+
+
+_R16_PATH = "cilium_tpu/sidecar/service.py"
+
+
+def audit_traced_shapes(traced, universe) -> list[Finding]:
+    """R16 closure primitive: every traced executable's (flows, width)
+    axes must be members of the enumerated universe.  ``traced`` is an
+    iterable of (tag, path, n_flows_or_None, width_or_None)."""
+    findings = []
+    for tag, path, n_flows, width in traced:
+        if n_flows is not None and n_flows not in universe["flows"]:
+            findings.append(Finding(
+                "R16", path, 0, 0,
+                f"[shape-closure:{tag}] traced executable batch axis "
+                f"{n_flows} is OUTSIDE the declared bucket universe "
+                f"(pow2 ladder from MIN_BUCKET_GREEDY): this shape "
+                f"re-traces every time it recurs on the hot path",
+                symbol=tag,
+            ))
+        if width is not None and width not in universe["widths"]:
+            findings.append(Finding(
+                "R16", path, 0, 0,
+                f"[shape-closure:{tag}] traced executable row width "
+                f"{width} is OUTSIDE the declared width ladder "
+                f"(batch_width << k): an unbucketed width axis keys a "
+                f"new executable per frame size",
+                symbol=tag,
+            ))
+    return findings
+
+
+def _bare_shape_key(model):
+    """The churn cache's key derivation, locally: treedef + leaf
+    shapes/dtypes of the model's bare dispatch pytree (None when the
+    model is not shape-keyed)."""
+    import jax
+
+    bare_fn = getattr(model, "dispatch_bare", None)
+    if bare_fn is None:
+        return None
+    leaves, treedef = jax.tree_util.tree_flatten(bare_fn())
+    return (
+        str(treedef),
+        tuple((tuple(lf.shape), str(lf.dtype)) for lf in leaves),
+    )
+
+
+def audit_rule_axis(tag: str, path: str, build) -> list[Finding]:
+    """Rule-axis churn closure: same-bucket rebuilds must key the SAME
+    executable.  ``build(n)`` compiles an n-rule model; 2 and 3 rules
+    share the MIN_RULE_BUCKET bucket, so their shape keys must be
+    identical — an unbucketed builder keys a new executable per rule
+    count, i.e. a full re-trace on every policy churn."""
+    k2 = _bare_shape_key(build(2))
+    k3 = _bare_shape_key(build(3))
+    if k2 is None or k3 is None:
+        return [Finding(
+            "R16", path, 0, 0,
+            f"[shape-closure:{tag}] model exposes no dispatch_bare "
+            f"shape key — the shape-keyed churn cache cannot cover it",
+            symbol=tag,
+        )]
+    if k2 != k3:
+        return [Finding(
+            "R16", path, 0, 0,
+            f"[shape-closure:{tag}] rule axis is UNBUCKETED: a 2-rule "
+            f"and a 3-rule table key DIFFERENT executables — every "
+            f"policy churn re-traces instead of hitting the "
+            f"shape-keyed cache; pad the row axis to the "
+            f"MIN_RULE_BUCKET power-of-two ladder",
+            symbol=tag,
+        )]
+    return []
+
+
+def check_shape_closure() -> list[Finding]:
+    """R16 abstract-trace half: trace the full serving surface — all
+    four engine families (r2d2/http/kafka/dns), single-chip + sharded,
+    attr + plain — via eval_shape, plus the real pack_buckets packer
+    over adversarial frame lengths, and assert every traced executable
+    shape is a member of the enumerated universe, the distinct-
+    executable count fits SHAPE_CACHE_MAX, and the shape-keyed rule
+    axes are churn-closed."""
+    import jax
+    import numpy as np
+
+    from ..kafka.request import RequestMessage
+    from ..models.dns import (
+        build_dns_model_from_rows,
+        dns_verdicts,
+        dns_verdicts_attr,
+    )
+    from ..models.http import build_http_model
+    from ..models.kafka import (
+        build_kafka_model,
+        encode_requests,
+        kafka_verdicts,
+    )
+    from ..models.r2d2 import (
+        build_r2d2_model_from_rows,
+        r2d2_verdicts,
+        r2d2_verdicts_attr,
+    )
+    from ..parallel import rulesharding
+    from ..parallel.mesh import FLOW_AXIS, RULE_AXIS, flow_mesh
+    from ..policy.api import PortRuleHTTP, PortRuleKafka
+    from ..proxylib.parsers.dns import DnsRule
+    from ..sidecar.reasm import Reassembler
+    from ..sidecar.service import VerdictService
+    from ..utils import defaults
+
+    universe = enumerate_shape_universe()
+    findings: list[Finding] = []
+    traced: list[tuple] = []
+    exes: set = set()
+
+    # Rule-axis probes hold the regex VOCABULARY fixed across n: the
+    # automaton state/class axes legitimately scale with the compiled
+    # pattern set (bucketing them is the open ROADMAP churn-cache
+    # extension), so only the row axis may vary here — that is the
+    # axis MIN_RULE_BUCKET declares closed.
+    def rows_r2d2(n):
+        return [(frozenset({i}), "", "/p/.*") for i in range(n)]
+
+    def rows_dns(n):
+        return [
+            (frozenset({i}), DnsRule(name="w.example.com"))
+            for i in range(n)
+        ]
+
+    r2 = build_r2d2_model_from_rows(rows_r2d2(2), bucket=True)
+    dn = build_dns_model_from_rows(rows_dns(2), bucket=True)
+    ht = build_http_model([
+        (frozenset(), PortRuleHTTP(method="GET", path="/api/.*")),
+        (frozenset({3}), PortRuleHTTP()),
+    ])
+    kr = PortRuleKafka(topic="orders")
+    kr.sanitize()
+    km = build_kafka_model([(frozenset(), kr)])
+    mods = {
+        "r2d2": "cilium_tpu/models/r2d2.py",
+        "dns": "cilium_tpu/models/dns.py",
+        "http": "cilium_tpu/models/http.py",
+        "kafka": "cilium_tpu/models/kafka.py",
+    }
+
+    def trace(tag, path, fn, args, flows, width):
+        try:
+            jax.eval_shape(fn, *args)
+        except Exception as e:  # noqa: BLE001 — any trace failure gates
+            findings.append(Finding(
+                "R16", path, 0, 0,
+                f"[shape-closure:{tag}] serving-surface trace "
+                f"failed: {e!r}",
+                symbol=tag,
+            ))
+            return
+        traced.append((tag, path, flows, width))
+        exes.add(tag)
+
+    # Single-chip surface, attr + plain, over the two smallest flow
+    # buckets x two widths (membership, not exhaustiveness: the
+    # universe is infinite pow2; the serving path can only DERIVE
+    # members, which the AST half of R16 pins).
+    b0 = VerdictService.MIN_BUCKET_GREEDY
+    w0 = defaults.BATCH_WIDTH
+    for b in (b0, 2 * b0):
+        for w in (w0, 2 * w0):
+            args = (
+                jax.ShapeDtypeStruct((b, w), np.uint8),
+                jax.ShapeDtypeStruct((b,), np.int32),
+                jax.ShapeDtypeStruct((b,), np.int32),
+            )
+            for name, model in (("r2d2", r2), ("dns", dn),
+                                ("http", ht)):
+                trace(f"{name}.plain@{b}x{w}", mods[name],
+                      model.__call__, args, b, w)
+                attr = getattr(model, "verdicts_attr", None)
+                if attr is not None:
+                    trace(f"{name}.attr@{b}x{w}", mods[name],
+                          attr, args, b, w)
+    kbatch = encode_requests(
+        [RequestMessage(0, 2, 1, "c", ["orders"], parsed=True)] * b0
+    )
+    trace(f"kafka.plain@{b0}", mods["kafka"], kafka_verdicts,
+          (km, kbatch, np.ones(b0, np.int32)), b0, None)
+
+    # Sharded surface: every mesh the local device count can fill;
+    # shard extents must be universe members, and the stepped
+    # executables trace at a bucketed global shape.
+    devices = jax.devices()
+    for n_flow, n_rule in ((1, 2), (2, 1), (2, 2)):
+        if n_flow * n_rule > len(devices):
+            continue
+        mesh = flow_mesh(n_flow=n_flow, n_rule=n_rule,
+                         devices=devices[: n_flow * n_rule])
+        for axis, extent in (("flows", mesh.shape[FLOW_AXIS]),
+                             ("rules", mesh.shape[RULE_AXIS])):
+            if extent not in universe["mesh"]:
+                findings.append(Finding(
+                    "R16", _SHARD_PATH, 0, 0,
+                    f"[shape-closure:mesh@{n_flow}x{n_rule}] {axis} "
+                    f"shard extent {extent} is outside the declared "
+                    f"mesh universe (pow2, flow extent <= the "
+                    f"smallest dispatch bucket)",
+                ))
+        args = (
+            jax.ShapeDtypeStruct((b0, w0), np.uint8),
+            jax.ShapeDtypeStruct((b0,), np.int32),
+            jax.ShapeDtypeStruct((b0,), np.int32),
+        )
+        offsets = rulesharding.shard_offsets(2, n_rule)
+        for name, model, vfn, afn in (
+            ("r2d2", r2, r2d2_verdicts, r2d2_verdicts_attr),
+            ("dns", dn, dns_verdicts, dns_verdicts_attr),
+        ):
+            stacked = rulesharding._stack_models([model] * n_rule)
+            trace(f"{name}.sharded@{n_flow}x{n_rule}", _SHARD_PATH,
+                  rulesharding.sharded_verdict_step(mesh, vfn),
+                  (stacked,) + args, b0, w0)
+            trace(f"{name}.sharded_attr@{n_flow}x{n_rule}",
+                  _SHARD_PATH,
+                  rulesharding.sharded_verdict_step_attr(mesh, afn),
+                  (stacked, offsets) + args, b0, w0)
+        trace(f"kafka.sharded@{n_flow}x{n_rule}", _SHARD_PATH,
+              rulesharding.sharded_kafka_step(mesh),
+              (rulesharding._stack_models([km] * n_rule), kbatch,
+               np.ones(b0, np.int32)), b0, None)
+
+    # The real packer's output shapes over adversarial frame lengths
+    # (minimal, exact-width, width+1, a multi-bucket jump) must land
+    # in the same universe the dispatch caches enumerate.
+    reasm = Reassembler()
+    frame_lens = [2, w0, w0 + 1, 4 * w0 + 5, 17]
+    payloads = [b"x" * (fl - 2) + b"\r\n" for fl in frame_lens]
+    lens = np.array([len(p) for p in payloads], np.int64)
+    ends = np.cumsum(lens)
+    rnd = reasm.ingest(
+        np.arange(1, len(payloads) + 1, dtype=np.int64),
+        ends - lens, lens,
+        np.frombuffer(b"".join(payloads), np.uint8),
+    )
+    for _fi, data, _lengths, _rem in reasm.pack_buckets(
+        rnd, w0, b0, np.zeros(len(payloads), np.int32)
+    ):
+        f_pad, wv = data.shape
+        traced.append((f"pack_buckets@{f_pad}x{wv}",
+                       "cilium_tpu/sidecar/reasm.py", int(f_pad),
+                       int(wv)))
+
+    findings.extend(audit_traced_shapes(traced, universe))
+    if len(exes) > universe["cache_max"]:
+        findings.append(Finding(
+            "R16", _R16_PATH, 0, 0,
+            f"[shape-closure] {len(exes)} distinct serving-surface "
+            f"executables exceed SHAPE_CACHE_MAX="
+            f"{universe['cache_max']} — the executable cache would "
+            f"thrash-evict on the hot path",
+        ))
+    findings.extend(audit_rule_axis(
+        "r2d2.rule-axis", mods["r2d2"],
+        lambda n: build_r2d2_model_from_rows(rows_r2d2(n),
+                                             bucket=True),
+    ))
+    findings.extend(audit_rule_axis(
+        "dns.rule-axis", mods["dns"],
+        lambda n: build_dns_model_from_rows(rows_dns(n), bucket=True),
+    ))
     return findings

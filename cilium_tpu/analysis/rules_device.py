@@ -35,6 +35,15 @@ abstract-tracing the real verdict models under ``JAX_PLATFORMS=cpu``
   attr twin that calls the plain twin (or re-runs the hits helper)
   is a second device pass — double hot-path cost that no parity test
   notices because the RESULTS are identical.
+- **R16 shape-closure (AST half).**  Every jit dispatch must draw its
+  batch axis from the declared bucket universe (``MIN_BUCKET`` pow2
+  round-up, ``pack_buckets`` widths, ``MIN_RULE_BUCKET`` tables): an
+  allocation whose leading dim comes straight from ``len()`` /
+  ``.count`` / ``.shape[0]`` keys a NEW executable per distinct batch
+  size — a silent re-trace on the hot path that "no new jit shapes"
+  prose cannot prevent.  The abstract-trace twin
+  (``devicecheck.check_shape_closure``) proves the same closure on the
+  real serving surface.
 """
 
 from __future__ import annotations
@@ -559,3 +568,158 @@ def check_r11(files):
                             f"both reductions",
                             symbol=name,
                         )
+
+# --- R16 ------------------------------------------------------------------
+
+# Dispatch boundaries whose array arguments must carry bucketed batch
+# axes (the service's jit seams).
+_DISPATCH_NAMES = {"_model_call", "_model_call_attr", "_gathered_call"}
+_JIT_WRAPPERS = {"jit", "pjit", "_jit_for"}
+
+_ALLOC_NAMES = {"zeros", "empty", "ones", "full"}
+
+_BUCKET_TEXT = ("bucket", "BUCKET", "pow2", "next_pow")
+
+
+def _doubled_in_while(fn, name: str) -> bool:
+    """True when ``name`` is the target of the pow2 round-up idiom:
+    ``while name < n: name *= 2`` (or ``<<=``) anywhere in fn."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.While):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.AugAssign)
+                    and isinstance(sub.target, ast.Name)
+                    and sub.target.id == name
+                    and isinstance(sub.op, (ast.Mult, ast.LShift))):
+                return True
+    return False
+
+
+def _dim_class(expr, assigns, fn, depth: int = 0):
+    """'bucket' | 'raw' | None for a batch-dim expression: bucket-
+    derived dims come from the MIN_BUCKET family / pow2 round-ups /
+    shifts; raw dims come straight from len()/.count/.shape[0]/sum().
+    Anything unprovable stays None (precision over recall)."""
+    if depth > 6:
+        return None
+    if isinstance(expr, ast.Constant):
+        return "bucket" if isinstance(expr.value, int) else None
+    if isinstance(expr, ast.Name):
+        if _doubled_in_while(fn, expr.id):
+            return "bucket"
+        rhs = assigns.get(expr.id)
+        if rhs is not None and rhs is not expr:
+            return _dim_class(rhs, assigns, fn, depth + 1)
+        return None
+    if isinstance(expr, ast.Attribute):
+        if any(t in expr.attr for t in _BUCKET_TEXT):
+            return "bucket"
+        if expr.attr == "count":
+            return "raw"
+        return None
+    if isinstance(expr, ast.Subscript):
+        v = expr.value
+        if isinstance(v, ast.Attribute) and v.attr == "shape":
+            return "raw"
+        return None
+    if isinstance(expr, ast.Call):
+        name = call_func_name(expr)
+        if any(t in name for t in _BUCKET_TEXT):
+            return "bucket"
+        if name in ("len", "sum"):
+            return "raw"
+        if name in ("int", "max", "min"):
+            for a in expr.args:
+                got = _dim_class(a, assigns, fn, depth + 1)
+                if got is not None:
+                    return got
+        return None
+    if isinstance(expr, ast.BinOp):
+        if isinstance(expr.op, ast.LShift):
+            return "bucket"
+        left = _dim_class(expr.left, assigns, fn, depth + 1)
+        right = _dim_class(expr.right, assigns, fn, depth + 1)
+        if "raw" in (left, right):
+            return "raw"
+        if "bucket" in (left, right):
+            return "bucket"
+        return None
+    return None
+
+
+def _r16_fn(sf, fn, qual):
+    from .core import local_assignments
+
+    assigns = local_assignments(fn)
+    # Names bound to jit-wrapped callables: fn = jax.jit(f)
+    jit_names = {
+        name for name, rhs in assigns.items()
+        if isinstance(rhs, ast.Call)
+        and call_func_name(rhs) in _JIT_WRAPPERS
+    }
+    # Allocations by local name: data = np.zeros((X, W), ...)
+    allocs: dict[str, ast.Call] = {}
+    for name, rhs in assigns.items():
+        if (isinstance(rhs, ast.Call)
+                and call_func_name(rhs) in _ALLOC_NAMES
+                and rhs.args
+                and isinstance(rhs.args[0], ast.Tuple)
+                and rhs.args[0].elts):
+            allocs[name] = rhs
+
+    def dispatch_call(node: ast.Call) -> bool:
+        name = call_func_name(node)
+        if name in _DISPATCH_NAMES:
+            return True
+        if isinstance(node.func, ast.Name) and node.func.id in jit_names:
+            return True
+        # jit(f)(...) inline
+        if isinstance(node.func, ast.Call) and call_func_name(
+                node.func) in _JIT_WRAPPERS:
+            return True
+        return False
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                continue
+        if not isinstance(node, ast.Call) or not dispatch_call(node):
+            continue
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            alloc = None
+            if isinstance(a, ast.Name) and a.id in allocs:
+                alloc = allocs[a.id]
+            elif (isinstance(a, ast.Call)
+                    and call_func_name(a) in _ALLOC_NAMES
+                    and a.args and isinstance(a.args[0], ast.Tuple)
+                    and a.args[0].elts):
+                alloc = a
+            if alloc is None:
+                continue
+            dim0 = alloc.args[0].elts[0]
+            if _dim_class(dim0, assigns, fn) == "raw":
+                yield Finding(
+                    "R16", sf.path, alloc.lineno, alloc.col_offset,
+                    f"unbucketed batch axis ({unparse(dim0)}) feeds "
+                    f"the jit dispatch {call_func_name(node)}(): "
+                    f"every distinct batch size keys a NEW compiled "
+                    f"executable — a silent re-trace per size on the "
+                    f"hot path, outside the declared shape-closure "
+                    f"universe; round the axis up to the power-of-two "
+                    f"bucket (MIN_BUCKET floor, pack_buckets widths)",
+                    symbol=qual,
+                )
+
+
+def check_r16(files):
+    from .core import walk_functions
+
+    emitted: set = set()
+    for path, sf in sorted(files.items()):
+        for fn, qual, _cls in walk_functions(sf.tree):
+            for f in _r16_fn(sf, fn, qual):
+                key = (f.path, f.line, f.col)
+                if key not in emitted:
+                    emitted.add(key)
+                    yield f

@@ -175,12 +175,17 @@ def scan_length_prefixed(stream, offs, ends, frame_len_fn):
     while len(alive):
         avail = ends[alive] - pos[alive]
         fl = np.asarray(frame_len_fn(stream, pos[alive], avail), np.int64)
-        done = (fl >= 0) & (fl <= avail)
+        # A non-positive frame length (a malformed header a custom
+        # reader maps to <= 0 — attacker-controllable bytes) means no
+        # forward progress is possible for that entry: it simply stops
+        # completing frames this round and its bytes stay as residue,
+        # where the per-conn cap turns a wedged stream into the typed
+        # overflow DROP+ERROR.  The scanner itself must stay TOTAL —
+        # an exception here would abort the whole columnar round and
+        # leak every other entry's answer (lint R15).
+        done = (fl > 0) & (fl <= avail)
         if not done.any():
             break
-        if (fl[done] <= 0).any():
-            raise ValueError("frame_len_fn returned a non-positive "
-                             "frame length (no progress possible)")
         idx = alive[done]
         out_e.append(idx)  # lint: disable=R7 -- per frame-RANK (max frames per entry), never per entry: each pass is one vectorized step over every active entry
         out_s.append(pos[idx].copy())  # lint: disable=R7 -- see above: per-pass accumulator, not per-entry work
@@ -741,7 +746,6 @@ class Reassembler:
                         dst_starts=entry_off)
         gather_segments(blob, data_starts, l_dl, out=stream,
                         dst_starts=entry_off + l_cl)
-        arena.consume(slots[live])
         rnd.stream = stream
         rnd.entry_off = entry_off
         rnd.entry_end = entry_end
@@ -769,6 +773,14 @@ class Reassembler:
         res_len = entry_end - res_start
         rnd.res_len = res_len
         rnd.more = (rnd.n_frames > 0) | (res_len > 0)
+        # TRANSACTIONAL commit point: everything above (including the
+        # framing scan, the raise-capable pluggable hook) ran on the
+        # round-local stream without touching the live carries, so a
+        # scan crash leaves every carry intact and the service can
+        # exit the whole group to the scalar rung with zero byte
+        # loss.  Only now are the consumed carries retired and the
+        # residues stored back.
+        arena.consume(slots[live])
         arena.store(slots[live], stream, res_start[live], res_len[live])
         self.rounds += 1
         self.entries += n

@@ -151,7 +151,7 @@ class _SidecarConn:
     """Service-side state for one datapath connection."""
 
     __slots__ = ("conn", "client", "bufs", "engine", "fast_ok", "skip",
-                 "module_id", "demoted_mod")
+                 "module_id", "demoted_mod", "columnar_dead")
 
     def __init__(self, conn, client, engine, module_id: int = 0):
         self.conn = conn  # in-process oracle Connection
@@ -172,6 +172,13 @@ class _SidecarConn:
         # engine can be rebound once the device heals and the oracle
         # residue drains.
         self.demoted_mod = None
+        # Columnar lane-exit dead latch: the arena's overflow latch
+        # when the conn left the lane with NO engine to adopt it (the
+        # scalar twin of FlowState.overflowed).  The overflowed bytes
+        # are gone, so every further request entry must answer a typed
+        # protocol error — resuming the parse mid-stream would emit
+        # wrong op byte counts on the wire.
+        self.columnar_dead = False
 
 
 class EpochParityError(AssertionError):
@@ -4389,6 +4396,18 @@ class VerdictService:
                 b"",
             )
             return
+        if sc.columnar_dead and not reply:
+            # Lane-exit dead latch (columnar overflow with no engine
+            # adopter): the overflowed bytes are gone, so the scalar
+            # twin of FlowState.overflowed applies — every further
+            # request entry answers a typed protocol error, never a
+            # mid-stream resume over the dropped bytes.
+            responses[key][i] = (
+                conn_id, int(FilterResult.OK),
+                [(int(ERROR), int(OpError.ERROR_INVALID_FRAME_LENGTH))],
+                b"", b"",
+            )
+            return
         if quarantined:
             # Pure-device engines (no oracle inside) fall back
             # to the in-process oracle; device-assisted engines
@@ -4739,11 +4758,22 @@ class VerdictService:
         the oracle mirror when no engine is bound) — the lane-exit
         transition.  Runs on the dispatcher thread BEFORE the conn's
         entries are classified scalar, so the shared residual-dirty
-        predicate sees the bytes in their scalar home."""
-        data, dead = self._reasm.arena.release(conn_id)
+        predicate sees the bytes in their scalar home.
+
+        Every byte (and the dead/overflow latch) released here must
+        land in an accountable home — the R14 lane-exit contract: a
+        closed conn's slot is dropped EXPLICITLY (never pulled out
+        first and leaked), and a dead latch with no engine adopter
+        transfers to the conn's own ``columnar_dead`` so further
+        entries answer a typed protocol error instead of resuming the
+        parse over the dropped bytes (the PR 10 silent-loss class)."""
         sc = self._conns.get(conn_id)
         if sc is None:
+            # Conn already closed: no peer awaits these bytes; the
+            # explicit drop is close_connection's own arena contract.
+            self._reasm.arena.drop(conn_id)
             return
+        data, dead = self._reasm.arena.release(conn_id)
         engine = sc.engine
         if engine is not None and hasattr(engine, "adopt_residue"):
             conn = sc.conn
@@ -4753,8 +4783,11 @@ class VerdictService:
                 ingress=conn.ingress, dst_id=conn.dst_id,
                 src_addr=conn.src_addr, dst_addr=conn.dst_addr,
             )
-        elif data:
-            sc.bufs[False] = bytearray(data) + sc.bufs[False]
+        else:
+            if dead:
+                sc.columnar_dead = True
+            if data:
+                sc.bufs[False] = bytearray(data) + sc.bufs[False]
         self._tab_mark(conn_id, sc)
 
     def _process_columnar(self, items: list, t_pop: float,
@@ -4981,13 +5014,34 @@ class VerdictService:
         t_r0 = time.monotonic()
         e_live = np.flatnonzero(elig)
         groups: list = []
+        crash_sel: list = []
         for e in np.unique(eng_idx[e_live]):
             sel = e_live[eng_idx[e_live] == e]
             engine = snap.objs[int(e)]
-            rnd = reasm.ingest(
-                conn_ids[sel], starts[sel], lengths[sel], blob,
-                framing=_engine_framing(engine),
-            )
+            try:
+                rnd = reasm.ingest(
+                    conn_ids[sel], starts[sel], lengths[sel], blob,
+                    framing=_engine_framing(engine),
+                )
+            except Exception:  # noqa: BLE001 — framing hooks are pluggable
+                # A raise-capable per-framing hook (reasm.FRAMINGS
+                # scan/reader callbacks) crashed for THIS engine's
+                # group.  Ingest commits transactionally (the scan
+                # runs before any carry mutation), so the arena still
+                # holds every group conn's carry intact: the group
+                # exits the lane typed and serves through the scalar
+                # oracle rung THIS round — real verdicts, zero byte
+                # loss — while the other groups keep their columnar
+                # service (lint R15's per-entry containment shape;
+                # round-level _on_batch_error would instead answer
+                # the whole round UNKNOWN_ERROR).
+                log.exception("columnar ingest failed; engine group "
+                              "falls back to the scalar rung")
+                self._reasm_fallback("framing_crash")
+                self._record_contained_failure("framing-crash")
+                self._reasm_bail(conn_ids[sel], None)
+                crash_sel.append(sel)
+                continue
             if rnd.over.any():
                 # Same accounting as the scalar engine rung's
                 # _overflow (the oracle path owns the global metric).
@@ -4997,6 +5051,24 @@ class VerdictService:
                 snap.src[pos[sel]],
             )
             groups.append([sel, engine, rnd, buckets, None])
+        if crash_sel:
+            # Crashed groups ride the round's scalar minority: carries
+            # were released to the engines above, so the shared
+            # classifier routes every entry slow and the finish merge
+            # (rest) emits their tuples in entry order.
+            crashed = np.concatenate(crash_sel)
+            with self._lock:
+                conns_crash = self._conns
+            for k in crashed:
+                bi = int(np.searchsorted(base, k, side="right")) - 1
+                self._classify_entry(
+                    items[bi], int(k - base[bi]), conns_crash,
+                    False, responses, fast, slow, slow_conns,
+                )
+            rest = (
+                np.concatenate((rest, crashed)) if len(rest) else crashed
+            )
+            e_live = e_live[~np.isin(e_live, crashed)]
         # Dirty flags written NOW, before the next round classifies
         # (same contract as the scalar lane's _tab_mark_many): residue
         # or a dead latch keeps the conn off the vec path.
@@ -5020,6 +5092,7 @@ class VerdictService:
             _sel, engine, _rnd, buckets, _ = grp
             issued = []
             for fi, data_m, lens_b, rem in buckets:
+                # lint: disable=R15 -- device faults ARE typed here: _mesh_guarded demotes and retries single-chip, and a still-raising round reaches the dispatcher's _on_batch_error, which answers every entry UNKNOWN_ERROR (the round-level containment backstop)
                 _c, _m, allow, rule = self._model_call_attr(
                     engine.model, data_m, lens_b, rem
                 )
@@ -6024,12 +6097,14 @@ class _ClientHandler:
         demotes the session — typed, never a hang, never silent."""
         shm = self.shm
         if shm is None:
+            # lint: disable=R14 -- a doorbell is a wakeup, not an entry: with no attached session nothing was admitted here, and detach/quarantine sweeps already answered any ring frames typed on the shim side
             return
         generation, data_tail, verdict_head = wire.unpack_shm_doorbell(
             payload
         )
         if generation != shm.generation:
-            return  # stale doorbell from a superseded session
+            # lint: disable=R14 -- stale doorbell from a superseded session: its ring is destroyed and the shim's demotion sweep answered every undelivered seq typed before re-attaching; nothing is admitted on this path
+            return
         if verdict_head > shm.v_credit_head:
             shm.v_credit_head = verdict_head
         target = data_tail
@@ -6044,6 +6119,7 @@ class _ClientHandler:
                         wire.MSG_DATA_BATCH_DL,
                         wire.MSG_DATA_MATRIX,
                     ):
+                        # lint: disable=R15 -- this raise IS the drain's typed exit: the RingError handler latches fault, frames drained before it are still submitted, and _shm_quarantine answers with a quarantined credit (the shim sheds never-admitted frames typed itself)
                         raise RingError(
                             f"unexpected data-ring frame type {msg_type}"
                         )

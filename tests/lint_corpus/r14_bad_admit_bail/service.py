@@ -1,0 +1,23 @@
+"""R14.1 bad twin: an admit root with a bail path that answers no one.
+
+The stale-check return drops an admitted entry on the floor — no SHED,
+no error verdict, no hand-off — and the shim blocks on the seq until
+its own timeout.
+"""
+
+
+class Service:
+    def __init__(self, dispatcher, client):
+        self.dispatcher = dispatcher
+        self.client = client
+
+    def submit_data(self, client, batch):
+        if batch.stale:
+            return  # EXPECT[R14]
+        if not self.dispatcher.submit(batch):
+            self._shed_item(batch, "queue_full")
+
+    def _shed_item(self, item, reason):
+        if item.answered:
+            return
+        self.client.send_verdicts(item.seq, [], batch=item)

@@ -1,0 +1,23 @@
+"""The historical PR 2 deposed-round double-reply shape: the round's
+real verdicts go out, then the crash sweep answers the SAME batch
+again — no answered cell, no thread_round_is_shed check, nothing
+anywhere on the path stands the second reply down.  A packed reply
+stream answering one seq twice desyncs the shim."""
+
+
+class Worker:
+    def __init__(self, client, process):
+        self.client = client
+        self.process = process
+
+    def _run_round(self, batch):
+        try:
+            out = self.process(batch)
+            self.client.send_verdicts(batch.seq, out, batch=batch)
+        except Exception:
+            self.client.send_verdicts(  # EXPECT[R14]
+                batch.seq, self._typed(batch), batch=batch
+            )
+
+    def _typed(self, batch):
+        return [(cid, 7, [], b"", b"") for cid in batch.conn_ids]

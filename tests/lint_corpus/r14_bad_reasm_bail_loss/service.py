@@ -1,0 +1,18 @@
+"""The historical PR 10 silent-byte-loss shape at a columnar lane
+exit: the release pulls the conn's carry out of the arena FIRST, then
+discovers the conn is gone and bails with the bytes in hand — never
+adopted, never explicitly dropped, never answered.  The stream resumes
+mid-frame and every later verdict's op byte counts are wrong."""
+
+
+class Service:
+    def __init__(self, arena, conns):
+        self.arena = arena
+        self.conns = conns
+
+    def _reasm_release_to_scalar(self, conn_id):
+        data, dead = self.arena.release(conn_id)
+        sc = self.conns.get(conn_id)
+        if sc is None:
+            return  # EXPECT[R14]
+        sc.bufs[False] = bytearray(data) + sc.bufs[False]

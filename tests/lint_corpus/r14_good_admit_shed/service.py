@@ -1,0 +1,20 @@
+"""R14.1 good twin: every bail path out of the admit root answers
+typed (SHED) or hands the entry off to the dispatcher queue."""
+
+
+class Service:
+    def __init__(self, dispatcher, client):
+        self.dispatcher = dispatcher
+        self.client = client
+
+    def submit_data(self, client, batch):
+        if batch.stale:
+            self._shed_item(batch, "stale")
+            return
+        if not self.dispatcher.submit(batch):
+            self._shed_item(batch, "queue_full")
+
+    def _shed_item(self, item, reason):
+        if item.answered:
+            return
+        self.client.send_verdicts(item.seq, [], batch=item)

@@ -1,0 +1,23 @@
+"""R14.2 good twin: the crash sweep's second reply is dominated by the
+answered-cell exclusivity guard — whoever marks first answers, exactly
+once."""
+
+
+class Worker:
+    def __init__(self, client, process):
+        self.client = client
+        self.process = process
+
+    def _run_round(self, batch):
+        try:
+            out = self.process(batch)
+            self.client.send_verdicts(batch.seq, out, batch=batch)
+        except Exception:
+            if batch.answered:
+                return
+            self.client.send_verdicts(
+                batch.seq, self._typed(batch), batch=batch
+            )
+
+    def _typed(self, batch):
+        return [(cid, 7, [], b"", b"") for cid in batch.conn_ids]

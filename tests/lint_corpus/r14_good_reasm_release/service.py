@@ -1,0 +1,18 @@
+"""R14.1 good twin for the lane exit: the conn is resolved BEFORE any
+bytes leave the arena — a closed conn's slot is dropped explicitly,
+and a live conn's carry is adopted by its engine (the accountability
+hand-offs of the columnar lane-exit contract)."""
+
+
+class Service:
+    def __init__(self, arena, conns):
+        self.arena = arena
+        self.conns = conns
+
+    def _reasm_release_to_scalar(self, conn_id):
+        sc = self.conns.get(conn_id)
+        if sc is None:
+            self.arena.drop(conn_id)
+            return
+        data, dead = self.arena.release(conn_id)
+        sc.engine.adopt_residue(conn_id, data, dead)

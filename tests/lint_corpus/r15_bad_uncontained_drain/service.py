@@ -1,0 +1,28 @@
+"""R15 bad twin: the PR 2 finding (14) class — one entry's crash
+aborts the whole batch drain.  ``settle`` reaches ``parse_frame``'s
+raise through an import-resolved chain, the per-entry loop has no try,
+and nothing around the loop produces a typed outcome: every other
+entry in the round leaks unanswered."""
+
+
+def parse_frame(buf):
+    if not buf:
+        raise ValueError("empty frame")
+    return buf[0]
+
+
+def settle(entry):
+    return parse_frame(entry.buf)
+
+
+class Service:
+    def _process(self, items):
+        out = []
+        for entry in items:
+            out.append(settle(entry))  # EXPECT[R15]
+        return out
+
+    def _process_entrywise(self, items):
+        for entry in items:
+            if entry.bad:
+                raise RuntimeError("abort round")  # EXPECT[R15]

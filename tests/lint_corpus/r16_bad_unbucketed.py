@@ -1,0 +1,19 @@
+"""R16 bad twin: a raw batch size feeds the jit dispatch — every
+distinct round size keys (and silently re-traces) a new executable,
+outside the declared power-of-two bucket universe."""
+
+import jax
+import numpy as np
+
+
+def model(data, lens, rems):
+    return data.sum(axis=1), lens, rems
+
+
+def dispatch(items, width):
+    fn = jax.jit(model)
+    n = len(items)
+    data = np.zeros((n, width), np.uint8)  # EXPECT[R16]
+    lens = np.zeros(n, np.int32)
+    rems = np.zeros(n, np.int32)
+    return fn(data, lens, rems)

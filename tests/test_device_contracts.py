@@ -256,3 +256,93 @@ def test_device_contract_findings_are_baselinable(
     assert lint_main(["--device-contracts", "--baseline",
                       str(baseline), target]) == 0
     capsys.readouterr()
+
+
+# --- 4. R16 shape-closure gate --------------------------------------------
+
+def test_shape_universe_is_the_declared_ladder():
+    """The enumerated universe comes from the SAME constants the
+    serving path derives shapes from: greedy-floor pow2 flows, width
+    ladder, MIN_RULE_BUCKET rules, bucket-capped mesh extents."""
+    from cilium_tpu.analysis.devicecheck import enumerate_shape_universe
+    from cilium_tpu.models.r2d2 import MIN_RULE_BUCKET
+    from cilium_tpu.sidecar.service import VerdictService
+    from cilium_tpu.utils import defaults
+
+    u = enumerate_shape_universe()
+    g = VerdictService.MIN_BUCKET_GREEDY
+    assert {g, 2 * g, VerdictService.MIN_BUCKET} <= u["flows"]
+    assert g - 1 not in u["flows"] and 3 * g not in u["flows"]
+    w = defaults.BATCH_WIDTH
+    assert {w, 2 * w, 8 * w} <= u["widths"] and w + 1 not in u["widths"]
+    assert MIN_RULE_BUCKET in u["rules"]
+    assert max(u["mesh"]) == g  # flow shards cap at the smallest bucket
+    assert u["cache_max"] == VerdictService.SHAPE_CACHE_MAX
+
+
+def test_shape_closure_gate_is_clean():
+    """The acceptance pin: the traced executable set over the full
+    serving surface (all four engine families, sharded + single-chip,
+    attr + plain, plus the real pack_buckets packer) equals the
+    statically enumerated closure — zero findings."""
+    from cilium_tpu.analysis.devicecheck import check_shape_closure
+
+    findings = check_shape_closure()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_closure_catches_unbucketed_traced_shape():
+    """Sensitivity: an executable whose batch axis (or width) is not a
+    universe member must be a finding — a silent re-trace per size."""
+    from cilium_tpu.analysis.devicecheck import (
+        audit_traced_shapes,
+        enumerate_shape_universe,
+    )
+
+    u = enumerate_shape_universe()
+    got = audit_traced_shapes(
+        [("bad-flows", "x.py", 19, 256), ("bad-width", "x.py", 32, 300),
+         ("good", "x.py", 32, 256)], u,
+    )
+    assert len(got) == 2
+    assert all(f.rule == "R16" for f in got)
+    assert any("batch axis 19" in f.message for f in got)
+    assert any("row width 300" in f.message for f in got)
+
+
+def test_closure_catches_deliberately_unbucketed_model():
+    """The acceptance pin's second half: a builder that skips the
+    MIN_RULE_BUCKET pad keys a new executable per rule count — R16
+    catches it; the bucketed builder on the same rows is clean."""
+    from cilium_tpu.analysis.devicecheck import audit_rule_axis
+    from cilium_tpu.models.dns import build_dns_model_from_rows
+    from cilium_tpu.proxylib.parsers.dns import DnsRule
+
+    def rows(n):
+        return [(frozenset({i}), DnsRule(name="w.example.com"))
+                for i in range(n)]
+
+    bad = audit_rule_axis(
+        "dns-unbucketed", "x.py",
+        lambda n: build_dns_model_from_rows(rows(n), bucket=False),
+    )
+    assert len(bad) == 1 and bad[0].rule == "R16"
+    assert "UNBUCKETED" in bad[0].message
+    good = audit_rule_axis(
+        "dns-bucketed", "x.py",
+        lambda n: build_dns_model_from_rows(rows(n), bucket=True),
+    )
+    assert good == []
+
+
+def test_closure_model_without_shape_key_is_flagged():
+    """A model that exposes no dispatch_bare cannot ride the
+    shape-keyed churn cache — the audit says so instead of silently
+    skipping it."""
+    from cilium_tpu.analysis.devicecheck import audit_rule_axis
+
+    class _Opaque:
+        pass
+
+    got = audit_rule_axis("opaque", "x.py", lambda n: _Opaque())
+    assert len(got) == 1 and "dispatch_bare" in got[0].message

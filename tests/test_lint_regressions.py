@@ -255,3 +255,142 @@ def test_accesslog_server_survives_same_path_restart(tmp_path):
         cli.close()
     finally:
         srv2.close()
+
+
+# PR 14 (cilium-lint v3 — R14 answer accounting / R15 exception
+# containment) triage fixes:
+#
+# - R15 @ sidecar/service.py `_process_columnar` ingest loop: a
+#   raise-capable per-framing hook (reasm.FRAMINGS scan callbacks)
+#   crashing used to abort the WHOLE round into the dispatcher's
+#   round-level crash containment — every entry answered
+#   UNKNOWN_ERROR.  Ingest is now transactional (the scan runs before
+#   any carry mutation) and the service contains the crash per engine
+#   group: the group exits the lane typed (`framing_crash` fallback)
+#   and serves REAL verdicts through the scalar oracle rung.
+# - R14 @ sidecar/service.py `_reasm_release_to_scalar`: the columnar
+#   lane exit used to pull the carry out of the arena BEFORE checking
+#   the conn, and dropped the arena's dead/overflow latch when the
+#   conn had no engine adopter — the flow then resumed parsing
+#   mid-stream over the dropped bytes (wrong op byte counts on the
+#   wire, the PR 10 silent-loss class).  The conn is resolved first
+#   (a closed conn's slot is dropped explicitly) and the latch
+#   transfers to `columnar_dead`, which answers every further request
+#   entry with a typed protocol error.
+
+
+def test_columnar_framing_crash_serves_scalar_typed():
+    import numpy as np
+
+    from cilium_tpu.proxylib.types import FilterResult
+    from cilium_tpu.proxylib import instance as inst
+    from cilium_tpu.sidecar.reasm import FRAMINGS
+    from test_reasm import _Svc
+
+    import tempfile, os as _os
+    inst.reset_module_registry()
+    d = tempfile.mkdtemp()
+    s = _Svc(_os.path.join(d, "svc.sock"), reasm_on=True)
+    crlf = FRAMINGS["crlf"]
+    orig_scan = crlf.scan
+    try:
+        s.conns(4)
+        # Frame + partial-frame payloads are never vec-eligible, so
+        # the round takes the entrywise path and its columnar lane.
+        payloads = [
+            b"READ /public/a.txt\r\nREA",
+            b"READ /public/b.txt\r\nREA",
+        ]
+        got = s.send_round([
+            (1, 0, payloads[0]),
+            (2, 0, payloads[1]),
+        ])
+        baseline_ops = [e[2] for e in got]
+        assert s.svc._reasm.rounds_by_framing.get("crlf", 0) >= 1, (
+            "warm round never engaged the columnar lane — the test "
+            "payloads stopped exercising the crash path"
+        )
+
+        def boom(stream, offs, ends):
+            raise RuntimeError("framing hook crash")
+
+        crlf.scan = boom
+        got = s.send_round([
+            (3, 0, payloads[0]),
+            (4, 0, payloads[1]),
+        ])
+        # REAL verdicts via the scalar rung — not UNKNOWN_ERROR, not a
+        # shed, byte-identical ops to the columnar baseline.
+        assert [e[1] for e in got] == [int(FilterResult.OK)] * 2
+        assert [e[2] for e in got] == baseline_ops
+        assert s.svc.reasm_fallbacks.get("framing_crash", 0) >= 1
+        # Contained per GROUP, not via the round-level crash backstop.
+        assert s.svc.batch_crashes == 0
+    finally:
+        crlf.scan = orig_scan
+        s.close()
+    # The scanner itself is TOTAL now: a reader mapping a malformed
+    # header to a non-positive frame length stalls that entry (residue)
+    # instead of raising through the round.
+    from cilium_tpu.sidecar.reasm import scan_length_prefixed
+
+    stream = np.frombuffer(b"\x00\x00rest", np.uint8)
+    fe, fs, fl = scan_length_prefixed(
+        stream, np.array([0]), np.array([len(stream)]),
+        lambda st, pos, avail: np.zeros(len(pos), np.int64),
+    )
+    assert len(fe) == 0  # no frames, no raise
+
+
+def test_lane_exit_dead_latch_answers_typed(tmp_path):
+    import numpy as np
+
+    from cilium_tpu.proxylib.types import FilterResult
+    from cilium_tpu.proxylib.types import OpError as _OpError
+    from cilium_tpu.sidecar import wire as _wire
+    from cilium_tpu.proxylib import instance as inst
+    from test_reasm import _Svc
+
+    inst.reset_module_registry()
+    s = _Svc(str(tmp_path / "svc.sock"), reasm_on=True)
+    try:
+        s.conns(1)
+        svc = s.svc
+        # Arrange the PR 10 shape directly: the conn holds the arena's
+        # dead/overflow latch and its engine is gone (the post-swap
+        # no-engine epoch), then the lane exit releases it.
+        arena = svc._reasm.arena
+        slots = arena.ensure_slots(np.array([1], np.int64))
+        arena.mark_dead(slots)
+        sc = svc._conns[1]
+        sc.engine = None
+        svc._reasm_release_to_scalar(1)
+        assert sc.columnar_dead, "dead latch lost at the lane exit"
+        # Every further request entry answers a TYPED protocol error —
+        # never a mid-stream resume over the dropped bytes.
+        batch = _wire.DataBatch(
+            77, np.array([1], np.uint64), np.zeros(1, np.uint8),
+            np.array([4], np.uint32), b"GET\n",
+        )
+        item = ("data", None, batch)
+        responses = {id(item): [None]}
+        svc._classify_entry(item, 0, {1: sc}, False, responses,
+                            [], [], set())
+        got = responses[id(item)][0]
+        assert got is not None, "dead-flow entry left unanswered"
+        conn_id, result, ops, inj_o, inj_r = got
+        assert conn_id == 1 and result == int(FilterResult.OK)
+        assert ops == [(
+            int(5), int(_OpError.ERROR_INVALID_FRAME_LENGTH),
+        )] or (len(ops) == 1 and ops[0][1] == int(
+            _OpError.ERROR_INVALID_FRAME_LENGTH))
+        # A closed conn's release drops the slot explicitly instead of
+        # leaking pulled-out bytes.
+        slots = arena.ensure_slots(np.array([9], np.int64))
+        arena.store(slots, np.frombuffer(b"zz", np.uint8),
+                    np.array([0]), np.array([2]))
+        assert arena.has_slot(np.array([9]))[0]
+        svc._reasm_release_to_scalar(9)  # conn 9 was never registered
+        assert not arena.has_slot(np.array([9]))[0]
+    finally:
+        s.close()

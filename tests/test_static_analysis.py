@@ -121,6 +121,11 @@ _CORPUS_CASES = [
     "r11_bad_second_pass.py",
     "r12_bad_compile_hot",
     "r13_bad_unkeyed_cache",
+    "r14_bad_admit_bail",
+    "r14_bad_deposed_double_reply",
+    "r14_bad_reasm_bail_loss",
+    "r15_bad_uncontained_drain",
+    "r16_bad_unbucketed.py",
 ]
 
 _CORPUS_CLEAN = [
@@ -147,6 +152,11 @@ _CORPUS_CLEAN = [
     "r11_good_fused.py",
     "r12_good_prebuilt",
     "r13_good_epoch_keyed",
+    "r14_good_admit_shed",
+    "r14_good_guarded_reply",
+    "r14_good_reasm_release",
+    "r15_good_per_entry_try",
+    "r16_good_bucketed.py",
 ]
 
 
@@ -335,6 +345,75 @@ def test_unjustified_pragma_is_unsuppressable():
     assert r0 and not any(f.suppressed for f in r0)
 
 
+def test_r14_deposed_double_reply_pinned_exactly_once():
+    """The historical PR 2 deposed-round double reply, pinned by name
+    AND by multiplicity: a crash sweep re-answering a batch with no
+    exclusivity guard fires R14 exactly ONCE (the EXPECT-marker set
+    cannot see a duplicate at the same line)."""
+    path = os.path.join(CORPUS, "r14_bad_deposed_double_reply")
+    active, _ = split_findings(analyze_paths([path]))
+    r14 = [f for f in active if f.rule == "R14"]
+    assert len(r14) == 1, [f.render() for f in active]
+    assert "second answer site" in r14[0].message
+    assert "exclusivity guard" in r14[0].message
+    assert "deposed-round" in r14[0].message
+
+
+def test_r14_reasm_bail_silent_loss_pinned_exactly_once():
+    """The historical PR 10 columnar lane-exit byte loss, pinned by
+    name: a release that bails with the carry in hand answers no one
+    — exactly one R14 finding at the bare return."""
+    path = os.path.join(CORPUS, "r14_bad_reasm_bail_loss")
+    active, _ = split_findings(analyze_paths([path]))
+    r14 = [f for f in active if f.rule == "R14"]
+    assert len(r14) == 1, [f.render() for f in active]
+    assert "silent-loss" in r14[0].message
+    assert r14[0].symbol.endswith("_reasm_release_to_scalar")
+
+
+def test_r15_uncontained_chain_names_the_chain():
+    """R15's interprocedural half, pinned: the finding at the loop
+    call site names the settle -> parse_frame chain and the raise —
+    and each bad shape fires exactly once."""
+    path = os.path.join(CORPUS, "r15_bad_uncontained_drain")
+    active, _ = split_findings(analyze_paths([path]))
+    r15 = [f for f in active if f.rule == "R15"]
+    assert len(r15) == 2, [f.render() for f in active]
+    msgs = " | ".join(f.message for f in r15)
+    assert "settle -> parse_frame" in msgs
+    assert "ValueError" in msgs
+    assert "typed outcome" in msgs
+
+
+def test_r16_unbucketed_axis_pinned_exactly_once():
+    path = os.path.join(CORPUS, "r16_bad_unbucketed.py")
+    active, _ = split_findings(analyze_paths([path]))
+    r16 = [f for f in active if f.rule == "R16"]
+    assert len(r16) == 1, [f.render() for f in active]
+    assert "unbucketed batch axis" in r16[0].message
+    assert "executable" in r16[0].message
+
+
+def test_r14_r15_fixed_tree_sites_stay_fixed():
+    """The two production fixes this rule generation landed must stay
+    fixed: the columnar ingest loop is contained per engine group
+    (R15) and the lane-exit release resolves the conn BEFORE pulling
+    bytes out of the arena (R14) — a revert re-fires the rules on the
+    real tree and fails the tree gate, but pin the sites by name here
+    so the failure is legible."""
+    import cilium_tpu
+
+    pkg = os.path.dirname(os.path.abspath(cilium_tpu.__file__))
+    svc = os.path.join(pkg, "sidecar", "service.py")
+    with open(svc, "r", encoding="utf-8") as f:
+        src = f.read()
+    # R15 fix: per-group typed containment around reasm.ingest.
+    assert "framing_crash" in src
+    # R14 fix: the conn lookup precedes the arena release, and the
+    # dead latch transfers to the scalar side.
+    assert "columnar_dead" in src
+
+
 # --- 3. CLI contract ------------------------------------------------------
 
 def test_cli_clean_file_exits_zero(capsys):
@@ -403,8 +482,200 @@ def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in ("R1", "R2", "R3", "R4", "R5", "R6", "R7",
-                 "R8", "R9", "R10", "R11", "R12"):
+                 "R8", "R9", "R10", "R11", "R12", "R13", "R14",
+                 "R15", "R16"):
         assert f"{rule} " in out
+
+
+# --- 3b. --diff / --sarif -------------------------------------------------
+
+def _git(repo, *args):
+    subprocess.run(
+        ["git", *args], cwd=repo, check=True, capture_output=True,
+        env={**os.environ,
+             "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+             "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+    )
+
+
+@pytest.fixture()
+def diff_repo(tmp_path):
+    """A tiny git repo: one clean committed file, then a bad file
+    added after the commit (both changed-tracked and untracked cases
+    are exercised)."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    clean = repo / "clean.py"
+    clean.write_text("x = 1\n")
+    _git(repo, "add", "clean.py")
+    _git(repo, "commit", "-qm", "seed")
+    with open(os.path.join(CORPUS, "r6_bad_thread.py"),
+              encoding="utf-8") as f:
+        (repo / "bad.py").write_text(f.read())
+    return repo
+
+
+def test_cli_diff_reports_only_changed_files(diff_repo, capsys,
+                                             monkeypatch):
+    monkeypatch.chdir(diff_repo)
+    # The untracked bad file is in the diff set: reported, fails.
+    rc = lint_main(["--diff", "HEAD", "--no-baseline", "."])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "bad.py" in out and "clean.py" not in out
+
+
+def test_cli_diff_analysis_stays_whole_program(tmp_path, capsys,
+                                               monkeypatch):
+    """--diff narrows the REPORT, not the analysis: a finding in a
+    changed file whose other half lives in an UNCHANGED committed
+    file (R2's helper-chain taint through sockhelpers.py) must still
+    fire — a changed-files-only scan would see half the seam and go
+    silent (or invent dead-metric noise)."""
+    repo = tmp_path / "xrepo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    src = os.path.join(CORPUS, "r2_bad_helper_chain")
+    with open(os.path.join(src, "sockhelpers.py"),
+              encoding="utf-8") as f:
+        (repo / "sockhelpers.py").write_text(f.read())
+    _git(repo, "add", "sockhelpers.py")
+    _git(repo, "commit", "-qm", "seed helpers")
+    with open(os.path.join(src, "pump.py"), encoding="utf-8") as f:
+        (repo / "pump.py").write_text(f.read())
+    monkeypatch.chdir(repo)
+    rc = lint_main(["--diff", "HEAD", "--no-baseline", "."])
+    out = capsys.readouterr().out
+    assert rc == 1
+    # The interprocedural finding lands in the changed file, names
+    # the chain through the unchanged one, and the unchanged file
+    # itself is not reported.
+    assert "pump.py" in out and "_write_frame" in out
+    assert not any(
+        line.startswith("sockhelpers.py")
+        for line in out.splitlines()
+    )
+
+
+def test_cli_diff_clean_noop_exits_zero(diff_repo, capsys,
+                                        monkeypatch):
+    monkeypatch.chdir(diff_repo)
+    _git(diff_repo, "add", "bad.py")
+    _git(diff_repo, "commit", "-qm", "bad in history")
+    rc = lint_main(["--diff", "HEAD", "--no-baseline", "."])
+    err = capsys.readouterr().err
+    # Nothing changed since HEAD: legitimate pre-commit no-op.
+    assert rc == 0
+    assert "nothing to scan" in err
+
+
+def test_cli_diff_bad_rev_fails_closed(diff_repo, capsys, monkeypatch):
+    monkeypatch.chdir(diff_repo)
+    rc = lint_main(["--diff", "no_such_rev_xyz", "--no-baseline", "."])
+    assert rc == 2
+    assert "could not resolve" in capsys.readouterr().err
+
+
+def test_cli_diff_preserves_scan_fail_closed(diff_repo, capsys,
+                                             monkeypatch):
+    """--diff must not weaken the existing fail-closed behaviors: a
+    typo'd scan path and a zero-Python-file target stay rc 2."""
+    monkeypatch.chdir(diff_repo)
+    assert lint_main(["--diff", "HEAD", "no_such_dir_xyz/"]) == 2
+    capsys.readouterr()
+    empty = diff_repo / "empty"
+    empty.mkdir()
+    (empty / "README.txt").write_text("not python")
+    assert lint_main(["--diff", "HEAD", str(empty)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_diff_ratchet_counts_full_view(diff_repo, capsys,
+                                           monkeypatch, tmp_path):
+    """--diff narrows the report AFTER the ratchet: a changed-files
+    run must never record the changed-files-only suppressed count
+    into the baseline (it would ratchet-violate every full run)."""
+    # Two committed files each carrying one justified suppression;
+    # one uncommitted clean change.
+    src = os.path.join(CORPUS, "r0_good_pragma.py")
+    with open(src, encoding="utf-8") as f:
+        body = f.read()
+    (diff_repo / "sup_a.py").write_text(body)
+    (diff_repo / "sup_b.py").write_text(body)
+    _git(diff_repo, "add", "sup_a.py", "sup_b.py")
+    _git(diff_repo, "commit", "-qm", "suppressed pair")
+    (diff_repo / "bad.py").unlink()
+    (diff_repo / "fresh.py").write_text("x = 1\n")
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        {"accepted": [], "max_suppressed": 2}
+    ))
+    monkeypatch.chdir(diff_repo)
+    rc = lint_main(["--diff", "HEAD", "--ratchet", "--ratchet-update",
+                    "--baseline", str(baseline), "."])
+    capsys.readouterr()
+    assert rc == 0
+    # The full view still has 2 suppressions; the changed subset has
+    # 0 — the recorded count must stay 2.
+    assert json.loads(baseline.read_text())["max_suppressed"] == 2
+
+
+def test_cli_diff_filters_device_contract_findings(diff_repo, capsys,
+                                                   monkeypatch):
+    """--device-contracts findings in files the rev did not touch are
+    filtered out of a --diff report like any other finding."""
+    from cilium_tpu.analysis import devicecheck
+    from cilium_tpu.analysis.core import Finding
+
+    fake = Finding("R11", "cilium_tpu/models/r2d2.py", 0, 0,
+                   "[device-contract:r2d2] pretend drift",
+                   symbol="r2d2")
+    monkeypatch.setattr(devicecheck, "check_device_contracts",
+                        lambda: [fake])
+    monkeypatch.chdir(diff_repo)
+    (diff_repo / "bad.py").unlink()
+    (diff_repo / "fresh.py").write_text("x = 1\n")
+    rc = lint_main(["--diff", "HEAD", "--device-contracts",
+                    "--no-baseline", "."])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "pretend drift" not in out
+
+
+def test_cli_sarif_report(capsys):
+    rc = lint_main(["--sarif", "--no-baseline",
+                    os.path.join(CORPUS, "r6_bad_thread.py")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    report = json.loads(out)
+    assert report["version"] == "2.1.0"
+    run = report["runs"][0]
+    assert run["tool"]["driver"]["name"] == "cilium-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"R14", "R15", "R16"} <= rule_ids
+    results = run["results"]
+    assert len(results) == 1 and results[0]["ruleId"] == "R6"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("r6_bad_thread.py")
+    assert loc["region"]["startLine"] >= 1
+
+
+def test_cli_sarif_clean_exits_zero_and_carries_suppressions(capsys):
+    rc = lint_main(["--sarif", "--no-baseline",
+                    os.path.join(CORPUS, "r0_good_pragma.py")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    report = json.loads(out)
+    sup = [r for r in report["runs"][0]["results"]
+           if r.get("suppressions")]
+    assert sup and sup[0]["suppressions"][0]["kind"] == "inSource"
+
+
+def test_cli_sarif_json_mutually_exclusive(capsys):
+    assert lint_main(["--sarif", "--json",
+                      os.path.join(CORPUS, "r0_good_pragma.py")]) == 2
+    assert "mutually exclusive" in capsys.readouterr().err
 
 
 # --- 4. ratchet -----------------------------------------------------------
@@ -560,7 +831,12 @@ def test_tree_lint_wall_clock_budget():
     """The tier-1 gate must stay fast as the tree grows: one COLD
     full-tree pass within budget, and the content-hash cache makes a
     WARM pass near-free (this is what keeps the dozens of
-    analyze_paths calls in this file cheap)."""
+    analyze_paths calls in this file cheap).  The pass includes the
+    v3 interprocedural rules (R14 answer accounting, R15 raise-taint,
+    R16 shape closure) — their whole-program summaries ride the same
+    memoized graph, so the budget numbers are unchanged by design and
+    this test is what catches a summary pass that starts rebuilding
+    per rule."""
     import time
 
     from cilium_tpu.analysis.callgraph import _GRAPH_CACHE
