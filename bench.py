@@ -1651,6 +1651,266 @@ def bench_flow_cache():
     return cached
 
 
+def bench_fanin_concurrent(n_sessions: int = 16):
+    """Multi-tenant fan-in (ISSUE 15): N independent shim sessions —
+    one SidecarClient each, identity-named, disjoint conns — feeding
+    ONE dispatcher, offered 2x the single-session capacity in
+    aggregate.  Reports aggregate verdicts/s and per-session served
+    p99 against the single-session number, and ASSERTS the fan-in
+    contract in-bench: zero silent loss (every seq from every session
+    answered exactly once, served OK or typed SHED) and zero
+    cross-session reply misrouting (each client's verdicts name only
+    conns it registered)."""
+    import threading
+
+    from cilium_tpu.proxylib import (
+        NetworkPolicy, PortNetworkPolicy, PortNetworkPolicyRule,
+        FilterResult,
+    )
+    from cilium_tpu.proxylib import instance as inst_mod
+    from cilium_tpu.sidecar import SidecarClient, VerdictService
+    from cilium_tpu.utils.option import DaemonConfig
+
+    policy = NetworkPolicy(
+        name="bench-fanin",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=80,
+                rules=[
+                    PortNetworkPolicyRule(
+                        remote_policies=[1],
+                        l7_proto="r2d2",
+                        l7_rules=[{"cmd": "READ", "file": "/public/.*"}],
+                    )
+                ],
+            )
+        ],
+    )
+    QUEUE_AGE_MS = 25.0
+    inst_mod.reset_module_registry()
+    cfg = DaemonConfig(
+        batch_timeout_ms=0.0, batch_flows=512,
+        shed_queue_entries=2048, shed_queue_age_ms=QUEUE_AGE_MS,
+    )
+    svc = VerdictService("/tmp/cilium_tpu_bench_fanin.sock", cfg).start()
+    msg = b"READ /public/bench.txt\r\n"
+    conns_per = 16
+    clients: list = []
+    try:
+        # --- per-session plumbing ----------------------------------------
+        metas: list[dict] = []
+        for s in range(n_sessions):
+            cl = SidecarClient(
+                svc.socket_path, timeout=60.0, identity=f"bench-pod-{s}"
+            )
+            clients.append(cl)
+            mod = cl.open_module([])
+            assert cl.policy_update(mod, [policy]) == int(FilterResult.OK)
+            base = 1000 * (s + 1)
+            for k in range(conns_per):
+                res, _ = cl.new_connection(
+                    mod, "r2d2", base + k, True, 1, 2,
+                    f"1.1.1.{s + 1}:{k + 1}", "2.2.2.2:80", "bench-fanin",
+                )
+                assert res == int(FilterResult.OK)
+            ids = np.arange(base, base + conns_per, dtype=np.uint64)
+            lens = np.full(conns_per, len(msg), np.uint32)
+            lock = threading.Lock()
+            answered: dict[int, tuple[float, bool]] = {}
+            sent_ts: dict[int, float] = {}
+
+            def cb(vb, _answered=answered, _lock=lock):
+                now = time.perf_counter()
+                ok = bool(vb.count) and int(vb.results[0]) == int(
+                    FilterResult.OK
+                )
+                with _lock:
+                    _answered[vb.seq] = (now, ok)
+
+            cl.verdict_callback = cb
+            metas.append({
+                "client": cl, "ids": ids, "lens": lens,
+                "answered": answered, "sent": sent_ts, "lock": lock,
+                "blob": msg * conns_per,
+            })
+
+        def fire(m, seq):
+            m["sent"][seq] = time.perf_counter()
+            m["client"].send_batch(
+                seq, m["ids"], [0] * conns_per, m["lens"], m["blob"]
+            )
+
+        def drain(m, upto, timeout_s):
+            deadline = time.perf_counter() + timeout_s
+            while time.perf_counter() < deadline:
+                with m["lock"]:
+                    if len(m["answered"]) >= upto:
+                        return True
+                time.sleep(0.002)
+            return False
+
+        # --- single-session baseline: closed-loop capacity + p99 ---------
+        m0 = metas[0]
+        warm = 20
+        for s in range(1, warm + 1):
+            fire(m0, s)
+            assert drain(m0, s, 60.0), "warmup stalled"
+        with m0["lock"]:
+            m0["answered"].clear()
+        m0["sent"].clear()
+        t0 = time.perf_counter()
+        n_cap = 200
+        for s in range(100, 100 + n_cap):
+            fire(m0, s)
+            assert drain(m0, s - 99, 60.0), "capacity phase stalled"
+        single_dt = time.perf_counter() - t0
+        single_rate = n_cap * conns_per / single_dt
+        with m0["lock"]:
+            base_lat = sorted(
+                (m0["answered"][s][0] - m0["sent"][s]) * 1e3
+                for s in m0["sent"] if s in m0["answered"]
+            )
+        single_p99 = base_lat[min(int(len(base_lat) * 0.99),
+                                  len(base_lat) - 1)]
+        with m0["lock"]:
+            m0["answered"].clear()
+        m0["sent"].clear()
+
+        # --- 16-session fan-in at 2x aggregate capacity -------------------
+        offered = 2.0 * single_rate
+        interval = conns_per / (offered / n_sessions)
+        window = 128  # per-session un-answered batches in flight
+
+        def open_loop(m, seq0, duration, t_start):
+            seq = seq0
+            next_fire = t_start
+            while time.perf_counter() - t_start < duration:
+                now = time.perf_counter()
+                if now < next_fire:
+                    time.sleep(min(next_fire - now, 0.001))
+                    continue
+                with m["lock"]:
+                    outstanding = len(m["sent"]) - len(m["answered"])
+                if outstanding >= window:
+                    time.sleep(0.001)
+                    continue
+                seq += 1
+                fire(m, seq)
+                next_fire += interval
+
+        def run_phase(duration, phase):
+            # Phase-disjoint seq ranges: a late prime-phase verdict
+            # must never collide with (and pre-answer) a measured-phase
+            # seq — that would mask a genuinely lost measured batch
+            # behind a stale answer stamped before its own fire().
+            t_start = time.perf_counter() + 0.1
+            threads = [
+                threading.Thread(
+                    target=open_loop,
+                    args=(
+                        m,
+                        10_000_000 * phase + 100_000 * (i + 1),
+                        duration, t_start,
+                    ),
+                    daemon=True,
+                )
+                for i, m in enumerate(metas)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(duration + 30)
+
+        def quiesce(timeout_s):
+            # Membership-based (every SENT seq answered): stale answers
+            # from a prior phase can never satisfy it early.
+            deadline = time.perf_counter() + timeout_s
+            while time.perf_counter() < deadline:
+                if all(
+                    all(s in m["answered"] for s in m["sent"])
+                    for m in metas
+                ):
+                    return
+                time.sleep(0.01)
+
+        # Prime (bucket compiles for the aggregated round shapes land
+        # here, not in the measured window), then reset and measure.
+        run_phase(2.0, phase=1)
+        quiesce(30.0)
+        for m in metas:
+            with m["lock"]:
+                m["answered"].clear()
+            m["sent"].clear()
+        duration = 4.0
+        run_phase(duration, phase=2)
+        quiesce(30.0)
+
+        # --- the fan-in contract, asserted --------------------------------
+        silent_loss = 0
+        served_total = 0
+        shed_total = 0
+        per_session_p99: list[float] = []
+        for m in metas:
+            with m["lock"]:
+                done = dict(m["answered"])
+            silent_loss += sum(1 for s in m["sent"] if s not in done)
+            lats = sorted(
+                (done[s][0] - m["sent"][s]) * 1e3
+                for s in m["sent"] if s in done and done[s][1]
+            )
+            served_total += len(lats) * conns_per
+            shed_total += sum(
+                conns_per for s in m["sent"]
+                if s in done and not done[s][1]
+            )
+            if lats:
+                per_session_p99.append(
+                    lats[min(int(len(lats) * 0.99), len(lats) - 1)]
+                )
+        assert silent_loss == 0, (
+            f"{silent_loss} batches never answered (silent loss)"
+        )
+        misroutes = sum(c.misrouted_verdicts for c in clients)
+        assert misroutes == 0, (
+            f"{misroutes} cross-session verdict misroutes"
+        )
+        assert len(per_session_p99) == n_sessions, (
+            "a session served nothing"
+        )
+        aggregate_rate = served_total / duration
+        st = svc.status()
+        rows = st["sessions"]["live"]
+        for row in rows:
+            assert row["submitted"] == row["answered"], row
+        session_shed = {
+            r["identity"]: r["shed"] for r in rows if r["shed"]
+        }
+        return {
+            "single_rate": single_rate,
+            "single_p99_ms": single_p99,
+            "aggregate_rate": aggregate_rate,
+            "offered": offered,
+            "per_session_p99_ms": [round(p, 3) for p in per_session_p99],
+            "p99_worst_ms": max(per_session_p99),
+            "p99_median_ms": sorted(per_session_p99)[n_sessions // 2],
+            "served_entries": served_total,
+            "shed_entries": shed_total,
+            "session_shed": session_shed,
+            "fair_share": st["sessions"]["fair_share"],
+            "n_sessions": n_sessions,
+        }
+    finally:
+        for cl in clients:
+            cl.verdict_callback = None
+            try:
+                cl.close()
+            except Exception:
+                pass
+        svc.stop()
+        inst_mod.reset_module_registry()
+
+
 def bench_verdict_overload():
     """Fail-closed overload behavior at 2x capacity (the robustness
     contract): capacity is measured closed-loop, then an open-loop
@@ -2695,6 +2955,46 @@ def run_one(which: str) -> None:
             seam_minus_null_p99_ms=round(
                 max(r1m.p99_ms - n1m.p99_ms, 0.0), 3),
         )
+    elif which == "fanin_concurrent":
+        out = bench_fanin_concurrent()
+        print(
+            f"bench fanin_concurrent: {out['n_sessions']} sessions "
+            f"aggregate={out['aggregate_rate']:,.0f}/s "
+            f"(single-session {out['single_rate']:,.0f}/s) "
+            f"p99 worst={out['p99_worst_ms']:.2f}ms "
+            f"median={out['p99_median_ms']:.2f}ms "
+            f"(single {out['single_p99_ms']:.2f}ms) "
+            f"shed={out['shed_entries']} silent_loss=0 misroutes=0",
+            file=sys.stderr,
+        )
+        # Aggregate throughput under 16-way fan-in at 2x offered load
+        # (bigger better, scored vs the single-session rate: >=1 means
+        # fan-in costs nothing; the contract asserts are in-bench).
+        _emit(
+            "fanin_aggregate_verdicts_per_s", out["aggregate_rate"],
+            "verdicts/s",
+            out["aggregate_rate"] / max(out["single_rate"], 1.0),
+            single_session_rate=round(out["single_rate"]),
+            offered=round(out["offered"]),
+            n_sessions=out["n_sessions"],
+            served_entries=out["served_entries"],
+            shed_entries=out["shed_entries"],
+            session_shed=out["session_shed"],
+            silent_loss=0,
+            cross_session_misroutes=0,
+            fair_share=out["fair_share"],
+        )
+        # Worst per-session served p99 under fan-in (smaller better;
+        # the denominator floors at the single-session p99 so a
+        # sub-baseline reading cannot score as infinitely good).
+        _emit(
+            "fanin_p99_ms_at_16", out["p99_worst_ms"], "ms",
+            max(out["single_p99_ms"], 0.5)
+            / max(out["p99_worst_ms"], 0.5),
+            per_session_p99_ms=out["per_session_p99_ms"],
+            p99_median_ms=round(out["p99_median_ms"], 3),
+            single_session_p99_ms=round(out["single_p99_ms"], 3),
+        )
     elif which == "verdict_overload":
         out = bench_verdict_overload()
         # Smaller is better (a served-verdict p99 under 2x-capacity
@@ -2884,7 +3184,8 @@ CONFIGS = (
     "http", "kafka", "cassandra", "memcached", "dns", "latency",
     "latency_colocated", "shm_transport", "mixed", "flow_cache",
     "datapath", "stress",
-    "kvstore_failover", "verdict_overload", "verdict_trace_overhead",
+    "kvstore_failover", "verdict_overload", "fanin_concurrent",
+    "verdict_trace_overhead",
     "flow_observe_overhead", "policy_churn",
     "multichip_scaling", "rules_100k",
     "r2d2",

@@ -77,9 +77,17 @@ _ADMIT_EXACT = {"_shm_doorbell", "_shm_submit_records"}
 def _is_admit_root(name: str) -> bool:
     # ``_reasm*``: the columnar lane-exit plumbing — a bail/release
     # that bare-returns without handing the carry anywhere is the
-    # PR 10 silent-byte-loss shape.
+    # PR 10 silent-byte-loss shape.  ``_fanin*``: the multi-session
+    # coalescer seam — an admission gate or a coalesced round's
+    # per-session slice fan-out that bare-returns (a quarantined
+    # session's batch dropped unanswered, or a dead session's slice
+    # aborting the remaining sessions' sends) is the same silent-loss
+    # class, now scoped to a tenant.  (Value-carrying returns stay the
+    # bail protocol: the fan-in admission gate returns its shed reason
+    # and the CALLER owes the typed answer.)
     return (name.startswith("submit_") or name.startswith("_process")
-            or name.startswith("_reasm") or name in _ADMIT_EXACT)
+            or name.startswith("_reasm") or name.startswith("_fanin")
+            or name in _ADMIT_EXACT)
 
 
 def _has_guard_text(node: ast.AST) -> bool:
@@ -239,6 +247,17 @@ def _stmt_events(node: ast.AST, state: _AnswerState) -> list:
     return [ev for _l, _c, ev in found]
 
 
+def _terminates(stmts) -> bool:
+    """A statement list that cannot fall through to the code after its
+    If: the function RETURNS before any later answer site runs, so
+    answer events inside it can never pair with one below — the fan-in
+    admission gates' shed-then-return shape.  A trailing ``raise`` is
+    deliberately NOT terminating: it can land in a same-function
+    except handler, which is exactly the PR 2 double-reply window the
+    Try model pairs body sends with handler sends across."""
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
 def _body_events(stmts, state: _AnswerState) -> list:
     out: list = []
     for stmt in stmts:
@@ -248,8 +267,10 @@ def _body_events(stmts, state: _AnswerState) -> list:
         if isinstance(stmt, ast.If):
             out.extend(_stmt_events(stmt.test, state))
             out.append((_ALT, [
-                _body_events(stmt.body, state),
-                _body_events(stmt.orelse, state),
+                (_body_events(stmt.body, state),
+                 _terminates(stmt.body)),
+                (_body_events(stmt.orelse, state),
+                 _terminates(stmt.orelse)),
             ]))
         elif isinstance(stmt, (ast.For, ast.AsyncFor)):
             out.extend(_stmt_events(stmt.iter, state))
@@ -267,8 +288,9 @@ def _body_events(stmts, state: _AnswerState) -> list:
             # window.
             if stmt.handlers:
                 out.append((_ALT, [
-                    _body_events(h.body, state) for h in stmt.handlers
-                ] + [[]]))
+                    (_body_events(h.body, state), _terminates(h.body))
+                    for h in stmt.handlers
+                ] + [([], False)]))
             out.extend(_body_events(stmt.orelse, state))
             out.extend(_body_events(stmt.finalbody, state))
         elif isinstance(stmt, (ast.With, ast.AsyncWith)):
@@ -289,9 +311,17 @@ def _walk_pairs(events, opens, state: _AnswerState, findings: list):
             opens.clear()
         elif ev[0] == _ALT:
             merged: list = []
-            for branch in ev[1]:
+            for branch, terminated in ev[1]:
                 branch_opens = list(opens)
                 _walk_pairs(branch, branch_opens, state, findings)
+                if terminated:
+                    # The branch returns out of the function: its open
+                    # answer events can never meet an answer site below
+                    # the If — the admission gates' shed-then-return
+                    # bail shape is exclusive by control flow, not by
+                    # guard.  (Raise-ending branches are NOT pruned:
+                    # they can resume in a same-function handler.)
+                    continue
                 merged.extend(
                     e for e in branch_opens if e not in merged
                 )

@@ -495,17 +495,55 @@ def cmd_sidecar_status(args):
               f"misses={fc.get('misses', 0)} "
               f"invalidations={fc.get('invalidations', 0)} "
               f"evictions={fc.get('evictions', 0)}")
+    def _fmt_shed(row):
+        return " ".join(
+            f"{k}={v}"
+            for k, v in sorted((row.get("shed") or {}).items())
+        )
+
+    sessions = st.get("sessions") or {}
+    if sessions:
+        print(f"sessions: {len(sessions.get('live', []))} live, "
+              f"{len(sessions.get('dead', []))} recently dead "
+              f"(fair_share={sessions.get('fair_share', 0)})")
+        for row in sessions.get("live", []):
+            shed = _fmt_shed(row)
+            q = ""
+            if row.get("state") == "quarantined":
+                q = (f" QUARANTINED({row.get('quarantine_reason')}, "
+                     f"{row.get('quarantine_remaining_s', 0)}s left)")
+            print(
+                f"  [{row.get('session')}] {row.get('identity')} "
+                f"{row.get('state')}{q} "
+                f"submitted={row.get('submitted', 0)} "
+                f"answered={row.get('answered', 0)} "
+                f"served={row.get('served', 0)} "
+                f"q={row.get('q_weight', 0)}"
+                + (f" shed: {shed}" if shed else "")
+            )
+        for row in sessions.get("dead", []):
+            shed = _fmt_shed(row)
+            print(
+                f"  [{row.get('session')}] {row.get('identity')} "
+                f"dead({row.get('death_reason', '?')}) "
+                f"submitted={row.get('submitted', 0)} "
+                f"answered={row.get('answered', 0)}"
+                + (f" shed: {shed}" if shed else "")
+            )
     tr = st.get("transport") or {}
     if tr:
         rejects = " ".join(
             f"{k}={v}" for k, v in sorted((tr.get("rejects") or {}).items())
         )
-        print(f"transport: shm_entries={tr.get('shm_entries', 0)}"
+        print(f"transport: shm_entries={tr.get('shm_entries', 0)} "
+              f"shm_reclaims={tr.get('shm_reclaims', 0)}"
               + (f" rejects: {rejects}" if rejects else ""))
         for sess in tr.get("sessions", []):
             mode = sess.get("mode", "socket")
+            tag = (f"session={sess.get('session', '?')} "
+                   f"{sess.get('identity', '')}")
             if mode != "shm" and not sess.get("fallbacks"):
-                print(f"  [session] mode={mode}")
+                print(f"  [{tag}] mode={mode}")
                 continue
             data = sess.get("data") or {}
             verdict = sess.get("verdict") or {}
@@ -514,7 +552,7 @@ def cmd_sidecar_status(args):
                 for k, v in sorted((sess.get("fallbacks") or {}).items())
             )
             print(
-                f"  [session] mode={mode} gen={sess.get('generation')} "
+                f"  [{tag}] mode={mode} gen={sess.get('generation')} "
                 f"data={data.get('occupancy', 0)}/{data.get('slots', 0)} "
                 f"verdict={verdict.get('occupancy', 0)}"
                 f"/{verdict.get('slots', 0)} "
@@ -579,7 +617,7 @@ def cmd_sidecar_trace(args):
               file=sys.stderr)
         return 1
     try:
-        out = cl.trace(n=args.n, kind=args.kind)
+        out = cl.trace(n=args.n, kind=args.kind, session=args.session)
     except (SidecarUnavailable, TimeoutError) as e:
         print(f"Error: verdict service at {args.address}: {e}",
               file=sys.stderr)
@@ -600,9 +638,10 @@ def cmd_sidecar_trace(args):
     for s in spans:
         stages = format_stages_us(s.get("stages_us", {}))
         reason = f" reason={s['reason']}" if s.get("reason") else ""
+        sess = f" session={s['session']}" if s.get("session") else ""
         print(f"  {s['kind']:<6} path={s['path']:<6} seq={s['seq']:<8} "
               f"conn={s['conn_id']:<6} n={s['entries']:<5} "
-              f"e2e={s['e2e_us'] / 1e3:.3f}ms{reason} {stages}")
+              f"e2e={s['e2e_us'] / 1e3:.3f}ms{sess}{reason} {stages}")
     return 0
 
 
@@ -626,6 +665,8 @@ def _format_flow_record(rec: dict) -> str:
     )
     if rec.get("epoch") is not None:
         attr += f" epoch={rec['epoch']}"
+    if rec.get("session"):
+        attr += f" session={rec['session']}"
     reason = f" reason={rec['reason']}" if rec.get("reason") else ""
     return (
         f"{ts} [{rec.get('path', '?')}] {rec.get('verdict', '?').upper()}: "
@@ -649,6 +690,7 @@ def cmd_observe(args):
     filters = dict(
         verdict=args.verdict, path=args.path,
         rule=args.rule, conn=args.conn, epoch=args.epoch,
+        session=args.session,
     )
     try:
         if not args.follow:
@@ -883,6 +925,9 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("-n", type=int, default=50, help="max spans")
     x.add_argument("--kind", choices=["sample", "slow", "shed"],
                    default=None, help="only spans of this kind")
+    x.add_argument("--session", type=int, default=None,
+                   help="only spans attributed to this fan-in session "
+                        "id (see `cilium sidecar status` sessions)")
     x.add_argument("--json", action="store_true")
     x.set_defaults(fn=cmd_sidecar_trace)
 
@@ -907,6 +952,9 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--epoch", type=int, default=None,
                    help="policy-table epoch filter (the epoch the "
                         "verdict was decided against)")
+    x.add_argument("--session", type=int, default=None,
+                   help="fan-in session filter (the shim session the "
+                        "conn registered through)")
     x.add_argument("--follow", "-f", action="store_true",
                    help="stream new records (poll with a seq cursor)")
     x.add_argument("--interval", type=float, default=0.5,

@@ -77,7 +77,8 @@ def materialize(
     the MSG_OBSERVE_REPLY payload, and the tests all read this shape).
     ``meta`` is the connection metadata tuple captured at registration:
     (policy_name, ingress, src_id, dst_id, src_addr, dst_addr, proto,
-    port)."""
+    port[, session]) — the optional trailing session id is the fan-in
+    shim session the conn registered through (0 = unknown/legacy)."""
     rec = {
         "seq": int(seq),
         "ts": ts,
@@ -89,7 +90,7 @@ def materialize(
     }
     if meta is not None:
         (policy_name, ingress, src_id, dst_id,
-         src_addr, dst_addr, proto, port) = meta
+         src_addr, dst_addr, proto, port) = meta[:8]
         rec.update(
             policy=policy_name,
             ingress=bool(ingress),
@@ -100,6 +101,8 @@ def materialize(
             proto=proto,
             dport=int(port),
         )
+        if len(meta) > 8 and meta[8]:
+            rec["session"] = int(meta[8])
     if reason:
         rec["reason"] = reason
     if extra:

@@ -103,11 +103,12 @@ class FlowLog:
 
     def register_conn(self, conn_id: int, policy_name: str, ingress: bool,
                       src_id: int, dst_id: int, src_addr: str,
-                      dst_addr: str, proto: str, port: int) -> None:
+                      dst_addr: str, proto: str, port: int,
+                      session: int = 0) -> None:
         with self._lock:
             self._meta[int(conn_id)] = (
                 policy_name, bool(ingress), int(src_id), int(dst_id),
-                src_addr, dst_addr, proto, int(port),
+                src_addr, dst_addr, proto, int(port), int(session),
             )
 
     def forget_conn(self, conn_id: int) -> None:
@@ -284,12 +285,18 @@ class FlowLog:
     def query(self, n: int = 100, verdict: str | None = None,
               path: str | None = None, rule: int | None = None,
               conn: int | None = None, since: int | None = None,
-              epoch: int | None = None) -> list[dict]:
+              epoch: int | None = None,
+              session: int | None = None) -> list[dict]:
         """Filtered record dicts.  Without ``since``: the newest ``n``
         matches, newest first.  With ``since``: records with
         seq > since in ASCENDING order (the `--follow` cursor
-        contract)."""
+        contract).  ``session`` filters on the fan-in shim session the
+        record's conn registered through (joined via the conn-metadata
+        registry at query time — the serving path stores bare conn
+        ids)."""
         n = max(int(n), 0)
+        if session is not None:
+            session = int(session)
         if verdict is not None and verdict not in CODE_NAMES:
             # Unknown verdict name (MSG_OBSERVE is raw JSON): nothing
             # can match — returning unfiltered records here would read
@@ -321,6 +328,18 @@ class FlowLog:
                 sel = sel[b.rules[sel] == rule]
             if conn is not None:
                 sel = sel[b.conn_ids[sel] == conn]
+            if session is not None and len(sel):
+                # Query-path-only join: resolve each candidate conn's
+                # registered session (cold path — the hot path never
+                # touches the meta registry).
+                keep = []
+                for i in sel:
+                    meta = self._meta_for(int(b.conn_ids[i]))
+                    sid = meta[8] if meta is not None and len(meta) > 8 \
+                        else 0
+                    if sid == session:
+                        keep.append(i)
+                sel = np.asarray(keep, sel.dtype)
             if since is not None:
                 sel = sel[b.seq0 + sel > since]
             idxs = sel if since is not None else sel[::-1]

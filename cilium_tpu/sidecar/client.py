@@ -55,6 +55,7 @@ from .transport import (
     CREDIT_FLAG_QUARANTINED,
     REASON_ATTACH_REJECTED,
     REASON_OVERSIZE,
+    REASON_OVERSIZE_SPREE,
     REASON_RING_FULL,
     REASON_TORN_SLOT,
     TRANSPORT_SHM,
@@ -243,11 +244,29 @@ class SidecarClient:
                  shm_data_slots: int = 64, shm_slot_bytes: int = 1 << 20,
                  shm_verdict_slots: int = 64,
                  shm_verdict_slot_bytes: int = 1 << 18,
-                 flow_cache: bool = True):
+                 flow_cache: bool = True,
+                 identity: str = "",
+                 shm_oversize_spree: int = 32):
         self.socket_path = socket_path
         self.timeout = timeout
         self.deadline_ms = deadline_ms
         self.auto_reconnect = auto_reconnect
+        # Fan-in session identity (MSG_SESSION_HELLO): the pod/workload
+        # name the service keys admission quotas and per-session
+        # shed/quarantine metrics on.  Empty = anonymous (the service
+        # quotas under a synthetic per-session name; crash-loop
+        # detection needs a stable identity to see the loop).
+        self.identity = identity
+        # Consecutive data-ring oversize fallbacks before this client
+        # demotes its OWN shm rung typed (every frame missing the ring
+        # means the fit check is pure overhead).  0 disables.
+        self.shm_oversize_spree = shm_oversize_spree
+        # Cross-session misrouting tripwire: verdict entries delivered
+        # to this client for conn ids it NEVER registered.  Asserted 0
+        # by the fan-in bench/suites — a nonzero value means a
+        # coalesced round's completion fan-out crossed sessions.
+        self.misrouted_verdicts = 0
+        self._known_conns = np.zeros(0, bool)
         # Established-flow verdict cache, shim half: when True the
         # client opts in (MSG_CACHE_ENABLE) and honors MSG_CACHE_GRANT
         # frames — frame-aligned request pushes for granted conns are
@@ -334,10 +353,25 @@ class SidecarClient:
         )
         self._reader.start()
         self.verdict_callback = None  # async mode: called with VerdictBatch
+        self._send_hello()
         if transport == TRANSPORT_SHM:
             self._shm_negotiate()
         if flow_cache:
             self._cache_enable()
+
+    def _send_hello(self) -> None:
+        """Announce the session identity (fire-and-forget — a legacy
+        peer ignores the frame; losing it only costs named metrics and
+        crash-loop detection, never serving)."""
+        if not self.identity:
+            return
+        try:
+            self._send(
+                wire.MSG_SESSION_HELLO,
+                wire.pack_session_hello(self.identity),
+            )
+        except (SidecarUnavailable, OSError):
+            pass
 
     # -- plumbing ---------------------------------------------------------
 
@@ -566,9 +600,15 @@ class SidecarClient:
             self._transport_fallback(REASON_ATTACH_REJECTED)
             return False
         self._shm = sess
+        # Segment lease the service granted: after an abrupt death the
+        # survivor unlinks this session's segments once it expires.
+        try:
+            sess.lease_s = float(rep.get("lease_s") or 0.0)
+        except (TypeError, ValueError):
+            sess.lease_s = 0.0
         log.info(
-            "shm transport attached (generation %s, %dx%dB data slots)",
-            rep.get("generation"), ds, db,
+            "shm transport attached (generation %s, %dx%dB data slots, "
+            "lease %.1fs)", rep.get("generation"), ds, db, sess.lease_s,
         )
         return True
 
@@ -778,10 +818,16 @@ class SidecarClient:
             )
         reason = None
         pushed = False
+        spree = False
         with self._wlock:
             if sess.active and self._shm is sess:
                 if not sess.data.fits(nbytes):
                     reason = REASON_OVERSIZE
+                    sess.oversize_run += 1
+                    spree = bool(
+                        self.shm_oversize_spree
+                        and sess.oversize_run >= self.shm_oversize_spree
+                    )
                 else:
                     pos = sess.data.tail
                     if sess.data.try_push(msg_type, payload,
@@ -789,6 +835,7 @@ class SidecarClient:
                         if seq is not None:
                             sess.inflight[seq] = (pos, conn_ids)
                         sess.counters.data_frames += 1
+                        sess.oversize_run = 0
                         # lint: disable=R2 -- the doorbell frame must publish under the same lock as the ring push (SPSC + ordering); SO_SNDTIMEO/_teardown bound a wedged peer exactly as in _send
                         self._shm_doorbell_locked(sess)
                         pushed = True
@@ -810,6 +857,16 @@ class SidecarClient:
             return
         if reason is not None:
             self._transport_fallback(reason)
+        if spree:
+            # Every frame this session pushes misses the ring: stop
+            # paying the fit check and serve on the socket rung, typed.
+            # served_through uses the same freshest lower bound as the
+            # mirror-poll demotion (admitted frames keep their promised
+            # verdicts; never-admitted ones are answered typed SHED).
+            self._demote_shm(
+                REASON_OVERSIZE_SPREE,
+                served_through=max(sess.credit_head, sess.data.head),
+            )
         self._send(msg_type, _join(payload))
 
     def _shm_doorbell_locked(self, sess: ShmSession) -> None:
@@ -856,6 +913,7 @@ class SidecarClient:
             sess = self._shm
         if sess is not None:
             sess.inflight.pop(vb.seq, None)
+        self._check_misroute(vb)
         cb = self.verdict_callback
         evt = self._pending.pop(vb.seq, None)
         if evt is not None:
@@ -867,6 +925,52 @@ class SidecarClient:
         # cache answers it was holding back (they were synthesized
         # later, so they must land later).
         self._round_settled(vb.seq)
+
+    _KNOWN_MAX = 1 << 22  # tripwire coverage cap (mirrors _GRANT_MAX)
+
+    def _mark_known_conn(self, conn_id: int) -> None:
+        """Session-lifetime record of every conn id this client ever
+        registered — the cross-session misrouting tripwire's ground
+        truth (closed conns STAY marked so a verdict in flight at close
+        never reads as a misroute)."""
+        if conn_id >= self._KNOWN_MAX:
+            return
+        n = len(self._known_conns)
+        if conn_id >= n:
+            new = max(4096, n)
+            while new <= conn_id:
+                new *= 2
+            arr = np.zeros(new, bool)
+            arr[:n] = self._known_conns
+            self._known_conns = arr
+        self._known_conns[conn_id] = True
+
+    def _check_misroute(self, vb: wire.VerdictBatch) -> None:
+        """Count verdict entries for conn ids this session NEVER
+        registered: one vectorized mask per delivered batch.  Zero is
+        the fan-in contract (a coalesced device round's completion
+        fan-out must route every slice back to its own session);
+        asserted in-bench and by the fault suites.  A session with NO
+        registered conns still counts (a fully-misrouted slice to a
+        fresh session must not read as zero), and a shim that sends
+        data for conns it never registered trips this too — both sides
+        of the register-before-send contract are violations."""
+        if not vb.count:
+            return
+        kn = self._known_conns
+        ids = vb.conn_ids
+        small = ids[ids < self._KNOWN_MAX].astype(np.int64)
+        if not len(small):
+            return
+        oob = small >= len(kn)
+        bad = int(oob.sum()) + int((~kn[small[~oob]]).sum())
+        if bad:
+            self.misrouted_verdicts += bad
+            log.error(
+                "cross-session misroute: %d verdict entries for conn "
+                "ids this session never registered (seq %d)",
+                bad, vb.seq,
+            )
 
     def _round_settled(self, seq: int | None) -> None:
         """One round stopped being in flight (verdict delivered, RPC
@@ -1182,6 +1286,11 @@ class SidecarClient:
             target=self._read_loop, args=(sock,), daemon=True
         )
         self._reader.start()
+        # Re-announce identity FIRST: the replayed session's quotas,
+        # metrics and reconnect-storm accounting must key on the same
+        # pod name as the original (this hello is also what lets the
+        # service SEE a crash loop).
+        self._send_hello()
         if self.flow_cache:
             # Opt back in BEFORE the conn replay so the restarted
             # service grants replayed conns as they register (old
@@ -1316,13 +1425,17 @@ class SidecarClient:
         )
         return json.loads(got.decode())
 
-    def trace(self, n: int = 100, kind: str | None = None) -> dict:
+    def trace(self, n: int = 100, kind: str | None = None,
+              session: int | None = None) -> dict:
         """Latency-trace dump (MSG_TRACE round trip): the service's
         most recent sampled spans / slow exemplars plus its per-stage
-        latency aggregate — the `cilium sidecar trace` surface."""
+        latency aggregate — the `cilium sidecar trace` surface.
+        ``session`` filters spans to one fan-in session."""
         req: dict = {"n": int(n)}
         if kind:
             req["kind"] = kind
+        if session is not None:
+            req["session"] = int(session)
         got = self._control_rpc(
             lambda: (wire.MSG_TRACE, json.dumps(req).encode()),
             wire.MSG_TRACE_REPLY,
@@ -1333,12 +1446,14 @@ class SidecarClient:
                 path: str | None = None, rule: int | None = None,
                 conn: int | None = None,
                 since: int | None = None,
-                epoch: int | None = None) -> dict:
+                epoch: int | None = None,
+                session: int | None = None) -> dict:
         """Flow-record query (MSG_OBSERVE round trip): the service's
         per-flow verdict records with device-side rule attribution —
         the `cilium observe` surface.  ``since`` is the follow cursor
         (records with seq > since, ascending); ``epoch`` filters on the
-        policy-table epoch the verdict was decided against."""
+        policy-table epoch the verdict was decided against; ``session``
+        on the fan-in shim session the conn registered through."""
         req: dict = {"n": int(n)}
         if verdict is not None:
             req["verdict"] = verdict
@@ -1352,6 +1467,8 @@ class SidecarClient:
             req["since"] = int(since)
         if epoch is not None:
             req["epoch"] = int(epoch)
+        if session is not None:
+            req["session"] = int(session)
         got = self._control_rpc(
             lambda: (wire.MSG_OBSERVE, json.dumps(req).encode()),
             wire.MSG_OBSERVE_REPLY,
@@ -1441,6 +1558,7 @@ class SidecarClient:
         with self._session_lock:
             self._conn_args[conn_id] = args
             self._shims[conn_id] = shim
+        self._mark_known_conn(conn_id)
         return res, shim
 
     def close_connection(self, conn_id: int) -> None:

@@ -95,6 +95,14 @@ class BatchDispatcher:
         self._done = threading.Condition(self._lock)
         self._pending: list[Any] = []
         self._pending_weight = 0
+        # Per-session queued weight (fan-in DRR): sessions passed to
+        # submit/submit_many get their q_weight bumped under _cond and
+        # zeroed WHOLESALE at every pop — the pop takes the entire
+        # pending list, so every session's unused share replenishes at
+        # once, paced by service progress (deficit round robin over
+        # queue slots).  The set holds only sessions with weight in
+        # the CURRENT queue generation.
+        self._q_sessions: set[Any] = set()
         self._oldest_ts = 0.0
         self._stopped = False
         self._started = False
@@ -169,10 +177,14 @@ class BatchDispatcher:
 
     # -- admission --------------------------------------------------------
 
-    def submit(self, item: Any, weight: int = 1, force: bool = False) -> bool:
+    def submit(self, item: Any, weight: int = 1, force: bool = False,
+               session: Any = None) -> bool:
         """Queue one item; False means the admission cap refused it (the
         caller owes the peer a typed SHED response — weight-0/control
-        items pass ``force=True`` and are never refused)."""
+        items pass ``force=True`` and are never refused).  ``session``
+        (a transport.SessionState) charges the admitted weight to that
+        session's DRR queue share; the charge is released wholesale
+        when a round pops the queue."""
         with self._cond:
             if (
                 not force
@@ -186,17 +198,22 @@ class BatchDispatcher:
                 self._oldest_ts = time.perf_counter()
             self._pending.append(item)
             self._pending_weight += weight
+            if session is not None:
+                session.q_weight += weight
+                self._q_sessions.add(session)
             self._cond.notify()
         return True
 
     def submit_many(self, items: list[tuple[Any, int]],
-                    force: bool = False) -> list[Any]:
+                    force: bool = False, session: Any = None) -> list[Any]:
         """Queue a pre-formed run of ``(item, weight)`` pairs under ONE
         lock trip — the shared-memory doorbell drain's admission path
         (a deep doorbell must not pay a lock round trip per frame).
         Admission is per item: the cap can refuse a suffix while
         admitting the prefix; refused items are RETURNED and the caller
-        owes each a typed SHED response (exactly submit()'s contract)."""
+        owes each a typed SHED response (exactly submit()'s contract).
+        ``session`` charges admitted weight as in submit() — one drain
+        is one session's frames, so one charge target covers the run."""
         refused: list[Any] = []
         with self._cond:
             admitted = False
@@ -214,6 +231,9 @@ class BatchDispatcher:
                     self._oldest_ts = time.perf_counter()
                 self._pending.append(item)
                 self._pending_weight += weight
+                if session is not None:
+                    session.q_weight += weight
+                    self._q_sessions.add(session)
                 admitted = True
             if admitted:
                 self._cond.notify()
@@ -318,6 +338,11 @@ class BatchDispatcher:
         self._current_batch = batch
         self._pending = []
         self._pending_weight = 0
+        # The pop takes the WHOLE queue: every session's queued charge
+        # drains with it (DRR share replenished at service pace).
+        for sess in self._q_sessions:
+            sess.q_weight = 0
+        self._q_sessions.clear()
         return batch
 
     def _take(self, my_gen: int) -> tuple[list[Any] | None, bool]:

@@ -39,6 +39,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque as _deque
 from concurrent.futures import TimeoutError as _FuturesTimeout
 
 import numpy as np
@@ -80,14 +81,25 @@ from .trace import (
 )
 from .transport import (
     CREDIT_FLAG_QUARANTINED,
+    DEATH_ABRUPT,
+    DEATH_CLOSED,
+    DEATH_SEND_TIMEOUT,
+    DEATH_WRITE_FAILED,
+    QUARANTINE_FLOOD,
+    QUARANTINE_RECONNECT_STORM,
+    SESSION_DEAD,
     REASON_ATTACH_REJECTED,
     REASON_DISABLED,
     REASON_GENERATION,
     REASON_OVERSIZE,
+    REASON_OVERSIZE_SPREE,
     REASON_PEER_DEATH,
     REASON_TORN_SLOT,
     REASON_VERDICT_RING_FULL,
+    SHED_SESSION_QUARANTINED,
+    SHED_SESSION_QUOTA,
     TRANSPORT_SOCKET,
+    SessionState,
     ShmPeer,
 )
 
@@ -488,6 +500,29 @@ class VerdictService:
         # session ring/fallback state lives on each _ClientHandler.
         self.transport_rejects: dict[str, int] = {}
         self.shm_entries = 0
+        # Multi-tenant fan-in: one SessionState per accepted shim
+        # connection (transport.py).  _sess_lock guards the registry
+        # only — never held across blocking work.  Dead sessions are
+        # retained (bounded) so an operator can attribute a shed or
+        # quarantine to a pod AFTER it died.
+        self._sess_lock = threading.Lock()
+        self._sessions: dict[int, SessionState] = {}
+        self._dead_sessions: "deque[dict]" = _deque(maxlen=32)
+        self._session_seq = 0
+        # Reconnect-storm tracking per announced identity (bounded LRU
+        # — see _session_hello): monotonic connect stamps inside the
+        # rolling window.  _metric_idents is the bounded Prometheus
+        # label vocabulary for per-session metrics.
+        self._ident_connects: dict[str, "deque[float]"] = {}
+        self._metric_idents: set[str] = set()
+        # DRR admission fairness: the per-session credit window
+        # (outstanding entries), recomputed lazily at most every 50ms.
+        self._share_val = self.config.shed_queue_entries
+        self._share_ts = 0.0
+        # Segment-reclaim timers for sessions that died without
+        # MSG_SHM_DETACH (cancelled at stop()).
+        self._reclaim_timers: list[threading.Timer] = []
+        self.shm_reclaims = 0
         # Policy-table epochs (guarded by _lock where noted).  Every
         # committed rule-table generation gets a monotonic epoch:
         # engines are stamped with the epoch they were compiled under,
@@ -595,6 +630,13 @@ class VerdictService:
             self._completion_thread.join(timeout=5)
         if self._send_thread is not None:
             self._send_thread.join(timeout=5)
+        # Pending shm-segment reclaims die with the service (the lease
+        # contract is per-service-life; a replacement service cannot
+        # tell a leased orphan from a live session's rings anyway).
+        with self._sess_lock:
+            timers, self._reclaim_timers = self._reclaim_timers, []
+        for t in timers:
+            t.cancel()
         # (The socket path was unlinked up front — a second unlink here
         # could delete a RESTARTED service's fresh socket.)
         if self._prev_switch_interval is not None:
@@ -644,6 +686,20 @@ class VerdictService:
                 "sessions": [c.transport_status() for c in clients],
                 "rejects": dict(self.transport_rejects),
                 "shm_entries": self.shm_entries,
+                "shm_reclaims": self.shm_reclaims,
+            },
+            # Fan-in sessions: one row per live shim session (identity,
+            # state, exactly-once counters, per-reason sheds/
+            # quarantines) plus the bounded post-mortem ring — the
+            # operator's per-pod attribution surface.
+            "sessions": {
+                "live": [
+                    s.status() for s in sorted(
+                        self._session_rows(), key=lambda s: s.id
+                    )
+                ],
+                "dead": list(self._dead_sessions),
+                "fair_share": self._share_val,
             },
             "dispatch_mode": self.dispatch_mode_chosen,
             # Multi-chip mesh rung: layout + demotion state; None when
@@ -719,11 +775,17 @@ class VerdictService:
             },
         }
 
-    def trace_dump(self, n: int = 100, kind: str | None = None) -> dict:
+    def _session_rows(self) -> list:
+        with self._sess_lock:
+            return list(self._sessions.values())
+
+    def trace_dump(self, n: int = 100, kind: str | None = None,
+                   session: int | None = None) -> dict:
         """Span-ring snapshot + tracer status for `cilium sidecar
-        trace` (MSG_TRACE)."""
+        trace` (MSG_TRACE).  ``session`` filters spans to one fan-in
+        session so a shed/slow exemplar can be pinned to a pod."""
         return {
-            "spans": self.tracer.spans(n, kind),
+            "spans": self.tracer.spans(n, kind, session=session),
             "latency": self.tracer.status(),
         }
 
@@ -1294,10 +1356,14 @@ class VerdictService:
         if self.flowlog is not None:
             # Connection metadata registered ONCE here (and dropped at
             # close) so per-round record emission stores bare arrays —
-            # the query side joins against this registry.
+            # the query side joins against this registry.  The session
+            # id rides along so `cilium observe --session` can
+            # attribute records to one shim.
+            sess = getattr(client, "session", None)
             self.flowlog.register_conn(
                 conn_id, policy_name, ingress, src_id, dst_id,
                 src_addr, dst_addr, proto, conn.port,
+                session=sess.id if sess is not None else 0,
             )
         return int(res), grant
 
@@ -1808,26 +1874,262 @@ class VerdictService:
         if self.flowlog is not None:
             self.flowlog.forget_conn(conn_id)
 
+    # -- fan-in sessions (N shims, one dispatcher) ------------------------
+
+    def _new_session(self) -> SessionState:
+        with self._sess_lock:
+            self._session_seq += 1
+            sess = SessionState(self._session_seq)
+            self._sessions[sess.id] = sess
+            metrics.SidecarSessionsActive.set(float(len(self._sessions)))
+        return sess
+
+    def _session_dead(self, sess: SessionState, reason: str) -> None:
+        """Retire one session from the live registry; idempotent per
+        session.  Only DATA-PLANE sessions (named, or having submitted
+        work) enter the bounded post-mortem ring and the deaths
+        metric: a monitoring loop's control connections would
+        otherwise cycle the ring and bury the one dead row that
+        mattered (the pod that crashed)."""
+        relevant = sess.named or sess.submitted > 0
+        if relevant:
+            sess.mark_dead(sess.death_reason or reason)
+        else:
+            sess.state = SESSION_DEAD
+            sess.death_reason = sess.death_reason or reason
+        with self._sess_lock:
+            if self._sessions.pop(sess.id, None) is not None and relevant:
+                self._dead_sessions.append(sess.status())
+            metrics.SidecarSessionsActive.set(float(len(self._sessions)))
+
+    # Bounded label/storm-table vocabularies: identities are
+    # wire-supplied, so both the Prometheus label set and the
+    # reconnect-history table must be capped — a shim cycling pod
+    # names (or a crash-looping deployment renaming per restart) must
+    # not grow either without bound for the node's lifetime.
+    _METRIC_IDENT_CAP = 256
+    _STORM_TABLE_CAP = 1024
+
+    def _session_hello(self, sess: SessionState, identity: str) -> None:
+        """Identity announcement: name the session and run crash-loop
+        detection — an identity reconnecting faster than the storm
+        threshold starts this session QUARANTINED (typed), so a
+        crash-looping pod costs one latch check per flood frame instead
+        of full classification, and its neighbors nothing at all.  The
+        control plane (module/policy/conn replay) still serves, so a
+        healed pod exits the latch by simply staying up.  Only the
+        FIRST hello on a session is honored (set_identity), and the
+        metric label falls back to 'other' past the bounded identity
+        vocabulary — status rows always carry the full identity."""
+        if sess.named:
+            return  # one identity per session; later hellos ignored
+        sess.set_identity(identity)
+        if not identity:
+            return
+        identity = sess.identity  # length-capped form
+        storm_n = self.config.session_reconnect_storm
+        now = time.monotonic()
+        window = self.config.session_reconnect_window_s
+        with self._sess_lock:
+            if (
+                identity in self._metric_idents
+                or len(self._metric_idents) < self._METRIC_IDENT_CAP
+            ):
+                self._metric_idents.add(identity)
+                sess.metric_identity = identity
+            else:
+                sess.metric_identity = "other"
+            if not storm_n:
+                return
+            hist = self._ident_connects.get(identity)
+            if hist is None:
+                while len(self._ident_connects) >= self._STORM_TABLE_CAP:
+                    # Bounded LRU: evict the least-recently-connecting
+                    # identity (dict preserves insertion order; re-
+                    # inserting on every hello keeps it recency-ordered).
+                    self._ident_connects.pop(
+                        next(iter(self._ident_connects))
+                    )
+                # Sized from the configured threshold: a fixed cap
+                # below storm_n would silently disable detection
+                # (len(hist) could never exceed the threshold).
+                hist = _deque(maxlen=storm_n + 1)
+            else:
+                del self._ident_connects[identity]
+            self._ident_connects[identity] = hist
+            hist.append(now)
+            while hist and now - hist[0] > window:
+                hist.popleft()
+            storm = len(hist) > storm_n
+        if storm and not sess.quarantined_now():
+            log.warning(
+                "session %d (%s): reconnect storm (%d connects in "
+                "%.1fs); quarantining for %.1fs",
+                sess.id, identity, storm_n, window,
+                self.config.session_quarantine_s,
+            )
+            sess.quarantine(
+                QUARANTINE_RECONNECT_STORM,
+                self.config.session_quarantine_s,
+            )
+
+    def _drr_share(self) -> int:
+        """Per-session queue share: the admission queue split across
+        CONNECTED sessions plus one headroom slot, floored at
+        session_share_min.  Connected — not recently-active: an
+        activity-windowed count is unstable under the very starvation
+        it exists to prevent (a flooder's giant rounds slow its
+        neighbors until they look idle, which GROWS the flooder's
+        share — a feedback loop measured at 2s neighbor p99).  The +1
+        headroom slot is load-bearing too: splitting by sessions alone
+        hands a lone flooder the entire queue, and a neighbor's first
+        submission then meets the GLOBAL cap — a typed queue_full
+        shed, but still a denial of service.  Recomputed lazily
+        (≤ every 50ms) — the per-batch fast path pays one float
+        compare."""
+        now = time.monotonic()
+        if now - self._share_ts > 0.05:
+            with self._sess_lock:
+                # Data-plane sessions only: a control-plane connection
+                # (each `cilium sidecar status`/`trace` invocation is a
+                # short-lived unnamed session that never submits data)
+                # must not shrink every real pod's share.
+                n_sessions = sum(
+                    1 for x in self._sessions.values()
+                    if x.named or x.submitted
+                )
+            self._share_val = max(
+                self.config.shed_queue_entries // max(n_sessions + 1, 2),
+                self.config.session_share_min,
+            )
+            self._share_ts = now
+        return self._share_val
+
+    def _fanin_admit(self, sess, batch) -> str:
+        """Fan-in admission gate, run on the submitting session's own
+        reader thread before any queue/cut-through hand-off.  Returns
+        '' to admit, else the typed shed reason the caller owes the
+        batch (quarantine latch, then the DRR credit window).
+
+        The quota is a per-session OUTSTANDING window: credits are
+        entries, spent at admission and returned only when the entry's
+        typed answer is written (submitted − answered — the same
+        counters the exactly-once surface audits, so the window is
+        correct across the dispatcher queue AND the completion
+        pipeline; a queued-weight quota alone lets a flooder shift its
+        backlog into the issued-not-answered FIFO where neighbors
+        still queue behind it).  A session under its share is never
+        refused — work conserving — and a flood's buffering lands on
+        the flooder, typed, not on its neighbors' latency."""
+        if sess is None:
+            return ""
+        if sess.quarantined_now():
+            return SHED_SESSION_QUARANTINED
+        # Classic-DRR one-batch overshoot: the PRE-batch outstanding is
+        # compared against the share (``submitted`` already counts this
+        # batch — the caller bumps it before the gate — so subtract it
+        # back).  A session at or under its share is never refused, no
+        # matter the batch size: comparing post-batch outstanding would
+        # permanently shed (and eventually 'flood'-quarantine) an IDLE
+        # session whose single wire batch exceeds the share.  The
+        # window overshoot is bounded by one wire batch.
+        if (
+            sess.submitted - batch.count - sess.answered
+            > self._drr_share()
+        ):
+            # Over-quota strike: sustained flooding escalates to the
+            # session quarantine latch (cheaper than re-classifying
+            # every flood frame, and typed for the operator).  The
+            # clock is read HERE only — the under-share happy path
+            # stays at one subtraction and one compare.
+            strikes = self.config.session_flood_strikes
+            if strikes:
+                now = time.monotonic()
+                if now - sess.strike_window_start > (
+                    self.config.session_strike_window_s
+                ):
+                    sess.strike_window_start = now
+                    sess.strikes = 0
+                sess.strikes += 1
+                if sess.strikes >= strikes:
+                    sess.strikes = 0
+                    sess.quarantine(
+                        QUARANTINE_FLOOD,
+                        self.config.session_quarantine_s,
+                    )
+            return SHED_SESSION_QUOTA
+        return ""
+
+    def _schedule_shm_reclaim(self, peer: ShmPeer) -> None:
+        """A session died holding attached rings and never sent
+        MSG_SHM_DETACH: the creator (the dead shim) will never unlink
+        its segments, so the survivor must — after the attach lease
+        expires (a shim alive behind a half-open socket reconnects
+        with FRESH segments, so a post-lease unlink can never pull a
+        live ring out from under anyone)."""
+        t = threading.Timer(
+            max(self.config.shm_lease_s, 0.0),
+            self._reclaim_shm_segments, args=(peer,),
+        )
+        t.daemon = True
+        t.name = "shm-reclaim"
+        with self._sess_lock:
+            self._reclaim_timers = [
+                x for x in self._reclaim_timers if x.is_alive()
+            ]
+            self._reclaim_timers.append(t)
+        t.start()
+
+    def _reclaim_shm_segments(self, peer: ShmPeer) -> None:
+        if not peer.reclaim():
+            # Nothing to unlink: the creator beat us to it (e.g. a
+            # half-open-socket shim that reconnected and later closed
+            # orderly).  Counting this would make the leak-detection
+            # metric report phantom recoveries.
+            return
+        self.shm_reclaims += 1
+        metrics.SidecarShmReclaims.inc()
+        log.info(
+            "reclaimed orphaned shm segments (generation %d) after "
+            "lease expiry", peer.generation,
+        )
+
     # -- data plane (dispatcher worker thread only) -----------------------
 
     def submit_data(self, client, batch: wire.DataBatch,
                     backlogged: bool = False) -> None:
         if not batch.arrival:  # wire unpack stamps ingress; keep it
             batch.arrival = time.monotonic()
+        sess = getattr(client, "session", None)
+        if sess is not None:
+            sess.submitted += batch.count
         item = ("data", client, batch)
+        reason = self._fanin_admit(sess, batch)
+        if reason:
+            self._shed_item(item, reason)
+            return
         if not backlogged and self._try_cut_through(item):
             return
-        if not self.dispatcher.submit(item, weight=batch.count):
+        if not self.dispatcher.submit(item, weight=batch.count,
+                                      session=sess):
             self._shed_item(item, "queue_full")
 
     def submit_matrix(self, client, mb: wire.MatrixBatch,
                       backlogged: bool = False) -> None:
         if not mb.arrival:  # wire unpack stamps ingress; keep it
             mb.arrival = time.monotonic()
+        sess = getattr(client, "session", None)
+        if sess is not None:
+            sess.submitted += mb.count
         item = ("mat", client, mb)
+        reason = self._fanin_admit(sess, mb)
+        if reason:
+            self._shed_item(item, reason)
+            return
         if not backlogged and self._try_cut_through(item):
             return
-        if not self.dispatcher.submit(item, weight=mb.count):
+        if not self.dispatcher.submit(item, weight=mb.count,
+                                      session=sess):
             self._shed_item(item, "queue_full")
 
     def _try_cut_through(self, item) -> bool:
@@ -1911,12 +2213,16 @@ class VerdictService:
         return True
 
     @staticmethod
-    def _batch_desc(batch) -> tuple:
-        """(seq, n, arrival, first conn) — the tracer's per-wire-batch
-        descriptor for e2e observation and span naming."""
+    def _batch_desc(batch, client=None) -> tuple:
+        """(seq, n, arrival, first conn, session) — the tracer's
+        per-wire-batch descriptor for e2e observation and span naming.
+        The session id (0 = unknown) lets `cilium sidecar trace
+        --session` attribute an exemplar to one shim."""
+        sess = getattr(client, "session", None)
         return (
             batch.seq, batch.count, batch.arrival,
             int(batch.conn_ids[0]) if batch.count else 0,
+            sess.id if sess is not None else 0,
         )
 
     @staticmethod
@@ -2084,7 +2390,7 @@ class VerdictService:
         mark("respond")
         if not self._round_thread_suppressed():
             self.tracer.finish_round(
-                rt, [self._batch_desc(it[2]) for it in items]
+                rt, [self._batch_desc(it[2], it[1]) for it in items]
             )
             self._record_vec_round(engine, ids, allow, rules)
         return True
@@ -2286,6 +2592,7 @@ class VerdictService:
             conn=req.get("conn"),
             since=req.get("since"),
             epoch=req.get("epoch"),
+            session=req.get("session"),
         )
         return {"records": records, "stats": self.flowlog.stats()}
 
@@ -2296,25 +2603,33 @@ class VerdictService:
         survives the transport swap); a multi-record drain enqueues in
         ONE dispatcher lock trip (submit_many) so a deep doorbell does
         not pay a lock round trip per frame — the worker aggregates it
-        into one device round exactly like a socket backlog."""
-        for _kind, batch in records:
-            self.shm_entries += batch.count
+        into one device round exactly like a socket backlog.  Fan-in
+        fairness runs per frame here too: the ring IS the credit loop,
+        so an over-quota frame shed typed at this gate frees its slot
+        immediately (head already advanced at drain) — DRR credit
+        issuance, with the refusal accounted to the one session."""
         if len(records) == 1:
             kind, batch = records[0]
+            self.shm_entries += batch.count
             if kind == "data":
                 self.submit_data(client, batch, backlogged=reader_backlog)
             else:
                 self.submit_matrix(client, batch,
                                    backlogged=reader_backlog)
             return
-        items = [
-            (
-                (kind, client, batch),
-                batch.count,
-            )
-            for kind, batch in records
-        ]
-        for item in self.dispatcher.submit_many(items):
+        sess = getattr(client, "session", None)
+        items = []
+        for kind, batch in records:
+            self.shm_entries += batch.count
+            if sess is not None:
+                sess.submitted += batch.count
+            item = (kind, client, batch)
+            reason = self._fanin_admit(sess, batch)
+            if reason:
+                self._shed_item(item, reason)
+            else:
+                items.append((item, batch.count))
+        for item in self.dispatcher.submit_many(items, session=sess):
             self._shed_item(item, "queue_full")
 
     def submit_close(self, conn_id: int) -> None:
@@ -2373,9 +2688,15 @@ class VerdictService:
             # rate would over-report).
             self.shed_entries += n
             metrics.SidecarShedTotal.inc(reason, amount=n)
+            sess = getattr(client, "session", None)
+            if sess is not None:
+                # Session-scoped attribution (fan-in): the operator can
+                # pin a shed to the one pod that caused it.
+                sess.count_shed(reason, n)
             self.tracer.record_shed(
                 batch.seq, n, batch.arrival,
                 int(batch.conn_ids[0]) if n else 0, reason,
+                session=sess.id if sess is not None else 0,
             )
             if self.flowlog is not None:
                 # One columnar batch per shed wire batch (cold path).
@@ -2422,6 +2743,9 @@ class VerdictService:
                 continue
             if sent:  # see _shed_item: never double-book served entries
                 self.error_entries += batch.count
+                sess = getattr(client, "session", None)
+                if sess is not None:
+                    sess.count_shed("error", batch.count)
                 if self.flowlog is not None:
                     self.flowlog.add_round(
                         PATH_SHED,
@@ -3735,7 +4059,7 @@ class VerdictService:
                     log.exception("typed error send failed")
                 continue
             rt.drained()
-            rtd = (rt, [self._batch_desc(b)])
+            rtd = (rt, [self._batch_desc(b, client)])
             if self._inline_complete:
                 try:
                     client.send(wire.MSG_VERDICT_BATCH, frame,
@@ -3743,7 +4067,7 @@ class VerdictService:
                 except Exception:  # noqa: BLE001 — client may be gone
                     log.exception("cached verdict send failed")
                 if not self._round_thread_suppressed():
-                    self.tracer.finish_round(rt, [self._batch_desc(b)])
+                    self.tracer.finish_round(rt, [self._batch_desc(b, client)])
             else:
                 self._completion_put(("frame", client, frame, b, rtd))
             if not self._round_thread_suppressed():
@@ -4014,7 +4338,7 @@ class VerdictService:
         if not self._round_thread_suppressed():
             if rt is not None:
                 self.tracer.finish_round(
-                    rt, [self._batch_desc(s[6]) for s in sends]
+                    rt, [self._batch_desc(s[6], s[0]) for s in sends]
                 )
             if engine is not None and sends:
                 self._record_vec_round(
@@ -4233,7 +4557,7 @@ class VerdictService:
                             getattr(engine, "DENY_INJECT", None),
                         )
                         self.tracer.finish_round(
-                            rt, [self._batch_desc(s[6]) for s in sends]
+                            rt, [self._batch_desc(s[6], s[0]) for s in sends]
                         )
                         if engine is not None and sends:
                             self._record_vec_round(
@@ -4629,7 +4953,7 @@ class VerdictService:
                     if not self._round_thread_suppressed():
                         self.tracer.finish_round(
                             rt,
-                            [self._batch_desc(it[2]) for it in items],
+                            [self._batch_desc(it[2], it[1]) for it in items],
                         )
                         self._record_entrywise(
                             rt.path, items, responses, rules_out,
@@ -4708,13 +5032,13 @@ class VerdictService:
                     last = i_item == len(items) - 1
                     self._completion_put(
                         ("ready", client, batch, responses[id(item)],
-                         (rt, [self._batch_desc(it2[2]) for it2 in items])
+                         (rt, [self._batch_desc(it2[2], it2[1]) for it2 in items])
                          if last else None)
                     )
             if self._inline_complete or deferred:
                 if not self._round_thread_suppressed():
                     self.tracer.finish_round(
-                        rt, [self._batch_desc(it[2]) for it in items]
+                        rt, [self._batch_desc(it[2], it[1]) for it in items]
                     )
             # Record emission at decision time (the pipelined sends are
             # already queued in FIFO order behind this round).
@@ -5330,7 +5654,7 @@ class VerdictService:
         if self._round_thread_suppressed():
             return
         self.tracer.finish_round(
-            rt, [self._batch_desc(it[2]) for it in items]
+            rt, [self._batch_desc(it[2], it[1]) for it in items]
         )
         # Scalar-minority records ride the shared entrywise emitter
         # (columnar entries hold None responses and are skipped, and
@@ -5966,6 +6290,19 @@ def _matrix_to_batch(mb: wire.MatrixBatch) -> wire.DataBatch:
     return batch
 
 
+def _death_reason_for(e: OSError) -> str:
+    """Typed session-death reason for a failed reply write: a sendall
+    bounded by SO_SNDTIMEO surfaces EAGAIN (BlockingIOError) when the
+    peer stopped reading, or socket.timeout on some platforms — both
+    are the stalled-reader signature; anything else is a broken
+    stream.  One definition so every _kill site types identically."""
+    return (
+        DEATH_SEND_TIMEOUT
+        if isinstance(e, (socket.timeout, BlockingIOError))
+        else DEATH_WRITE_FAILED
+    )
+
+
 class _ClientHandler:
     """Reader thread + serialized writer for one shim socket."""
 
@@ -5974,6 +6311,10 @@ class _ClientHandler:
         self.sock = sock
         self._wlock = threading.Lock()
         self.module_id = 0
+        # Fan-in session state (transport.SessionState): the unit of
+        # fault isolation — admission quotas, quarantine latch, and
+        # the per-session exactly-once counters all live here.
+        self.session = service._new_session()
         # Shared-memory fast path for this session (transport.ShmPeer),
         # attached via MSG_SHM_ATTACH.  Data drains run on this
         # handler's reader thread (SPSC consumer); verdict pushes are
@@ -6006,12 +6347,18 @@ class _ClientHandler:
         except OSError:  # pragma: no cover — platform without SNDTIMEO
             pass
 
-    def _kill(self) -> None:
+    def _kill(self, reason: str = DEATH_WRITE_FAILED) -> None:
         """Tear the socket down after a failed/timed-out write: the
         frame may be half-written, so the stream is unusable — a peer
         still reading it would desync.  shutdown() wakes the reader
         thread (which owns the close) and makes every later write fail
-        fast; the shim sees EOF and fails over/reconnects."""
+        fast; the shim sees EOF and fails over/reconnects.  The kill
+        is typed on the session (send_timeout = the shim stopped
+        reading and SO_SNDTIMEO fired — ONE session's cost, never the
+        watchdog's): the reader's teardown path keeps the first
+        recorded reason."""
+        if self.session.death_reason is None:
+            self.session.death_reason = reason
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -6021,9 +6368,13 @@ class _ClientHandler:
 
     def transport_status(self) -> dict:
         shm = self.shm or self.shm_detached
+        base = {
+            "session": self.session.id,
+            "identity": self.session.identity,
+        }
         if shm is None:
-            return {"mode": TRANSPORT_SOCKET}
-        return shm.status()
+            return {**base, "mode": TRANSPORT_SOCKET}
+        return {**base, **shm.status()}
 
     def _transport_reject(self, reason: str) -> None:
         svc = self.service
@@ -6041,6 +6392,10 @@ class _ClientHandler:
             "status": int(FilterResult.OK),
             "generation": 0,
             "error": "",
+            # Segment lease: how long the service waits after this
+            # session dies WITHOUT an MSG_SHM_DETACH before unlinking
+            # the segments itself (the abrupt-death leak guard).
+            "lease_s": self.service.config.shm_lease_s,
         }
         if not self.service.config.shm_transport:
             rep["status"] = int(FilterResult.UNKNOWN_ERROR)
@@ -6185,12 +6540,13 @@ class _ClientHandler:
         if shm.counters.credits == credits_before:
             self._send_credit()
 
-    def _shm_quarantine(self) -> None:
-        """Ring fault containment: latch the session off the shm rung
+    def _shm_quarantine(self, reason: str = REASON_TORN_SLOT) -> None:
+        """Ring fault containment: latch THIS session off the shm rung
         and tell the shim with a quarantined credit.  The shim demotes
         to the socket transport and answers never-admitted ring frames
         typed itself (zero silent loss); this handler and all its
-        flows keep serving over the socket.
+        flows keep serving over the socket — no other session is
+        touched.
 
         Latch AND credit happen under _wlock: a verdict emitter is
         either fully done (its ring write is covered by this credit's
@@ -6203,13 +6559,13 @@ class _ClientHandler:
         if shm is None:
             return
         with self._wlock:
-            if not shm.quarantine(REASON_TORN_SLOT):
+            if not shm.quarantine(reason):
                 return
             try:
                 # lint: disable=R2 -- the quarantined credit must serialize with verdict-ring writes under this handler's write lock (see docstring); SO_SNDTIMEO bounds a wedge
                 self._send_credit_locked(CREDIT_FLAG_QUARANTINED)
-            except OSError:
-                self._kill()
+            except OSError as e:
+                self._kill(_death_reason_for(e))
 
     def _send_credit(self, flags: int = 0) -> None:
         with self._wlock:
@@ -6218,8 +6574,8 @@ class _ClientHandler:
             try:
                 # lint: disable=R2 -- credit frames must serialize with verdict-ring writes under this handler's write lock (same contract as send()); SO_SNDTIMEO bounds a wedged peer
                 self._send_credit_locked(flags)
-            except OSError:
-                self._kill()
+            except OSError as e:
+                self._kill(_death_reason_for(e))
 
     def _send_credit_locked(self, flags: int = 0) -> None:
         shm = self.shm
@@ -6252,16 +6608,31 @@ class _ClientHandler:
             for p in payloads:
                 if not shm.verdict.fits(len(p)):
                     shm.counters.fallback(REASON_OVERSIZE)
+                    shm.oversize_run += 1
                     rest.append(p)
                 elif shm.verdict.try_push(msg_type, p,
                                           shm.v_credit_head):
                     pushed += 1
+                    shm.oversize_run = 0
                 else:
                     shm.counters.fallback(REASON_VERDICT_RING_FULL)
                     rest.append(p)
             if pushed:
                 shm.counters.verdict_frames += pushed
                 self._send_credit_locked()
+            spree = self.service.config.shm_oversize_spree
+            if spree and shm.oversize_run >= spree and shm.active:
+                # Every frame this session produces misses the ring:
+                # the per-frame fit check is pure overhead.  Demote
+                # THIS session's shm rung typed (we already hold
+                # _wlock — same latch-and-credit ordering contract as
+                # _shm_quarantine).
+                if shm.quarantine(REASON_OVERSIZE_SPREE):
+                    try:
+                        # lint: disable=R2 -- quarantined credit under the held handler write lock, same contract as _shm_quarantine
+                        self._send_credit_locked(CREDIT_FLAG_QUARANTINED)
+                    except OSError as e:
+                        self._kill(_death_reason_for(e))
         if rest:
             self.sock.sendall(
                 b"".join(
@@ -6307,11 +6678,19 @@ class _ClientHandler:
                     return False  # a racing reply already answered
                 for b in batches:
                     b.answered = True
+                # THE per-session answered count: the marking site is
+                # the single point every typed reply (verdict, SHED,
+                # error; ring or socket) passes exactly once, so the
+                # fan-in exactly-once surface (submitted == answered
+                # after quiesce) is counted where it is enforced.
+                self.session.answered += sum(
+                    getattr(b, "count", 0) for b in batches
+                )
             try:
                 # lint: disable=R2 -- _wlock IS the sendall serializer (the answered-flag dance requires it); a wedged write trips the stall watchdog and _kill breaks the socket
                 self._emit_frames_locked(msg_type, [payload])
-            except OSError:
-                self._kill()
+            except OSError as e:
+                self._kill(_death_reason_for(e))
         return True
 
     def send_frames(self, msg_type: int, payloads: list[bytes],
@@ -6332,13 +6711,18 @@ class _ClientHandler:
                     return False  # every frame lost its race: stand down
                 for i in keep:
                     batches[i].answered = True
+                # Same per-session answered count as send(): only the
+                # frames THIS call actually answered.
+                self.session.answered += sum(
+                    getattr(batches[i], "count", 0) for i in keep
+                )
                 if len(keep) != len(payloads):
                     payloads = [payloads[i] for i in keep]
             try:
                 # lint: disable=R2 -- same contract as send(): _wlock serializes the one-sendall round write; watchdog+_kill bound a wedge
                 self._emit_frames_locked(msg_type, payloads)
-            except OSError:
-                self._kill()
+            except OSError as e:
+                self._kill(_death_reason_for(e))
         return True
 
     def send_verdicts(self, seq: int, entries: list, batch=None) -> bool:
@@ -6432,6 +6816,13 @@ class _ClientHandler:
                             wire.MSG_ACK,
                             wire.pack_ack(int(FilterResult.OK)),
                         )
+                elif msg_type == wire.MSG_SESSION_HELLO:
+                    # Fire-and-forget identity announcement: names the
+                    # session for quotas/metrics and runs crash-loop
+                    # (reconnect-storm) detection.
+                    svc._session_hello(
+                        self.session, wire.unpack_session_hello(payload)
+                    )
                 elif msg_type == wire.MSG_CACHE_ENABLE:
                     # Fire-and-forget opt-in; grants start flowing for
                     # conns registered from here on.
@@ -6483,13 +6874,18 @@ class _ClientHandler:
                         kind = req.get("kind")
                         if kind is not None:
                             kind = str(kind)
+                        session = req.get("session")
+                        if session is not None:
+                            session = int(session)
                     except (ValueError, TypeError, AttributeError,
                             UnicodeDecodeError):
-                        n, kind = 100, None
+                        n, kind, session = 100, None, None
                     self.send(
                         wire.MSG_TRACE_REPLY,
                         json.dumps(
-                            self.service.trace_dump(n, kind)
+                            self.service.trace_dump(
+                                n, kind, session=session
+                            )
                         ).encode(),
                     )
                 elif msg_type == wire.MSG_OBSERVE:
@@ -6524,13 +6920,33 @@ class _ClientHandler:
             # the segments; our views just unmap).  A session that died
             # holding an ACTIVE shm rung is counted — the operator-
             # visible difference between orderly detach and a vanished
-            # shim.
+            # shim — and its segments are leased for reclaim: the dead
+            # creator will never unlink them, so the survivor must
+            # (after lease expiry) or /dev/shm leaks one ring pair per
+            # crash.  In-flight rounds for this session need no sweep:
+            # their sends hit the dead socket and are counted answered
+            # (there is no one left to shed to), and the answered-cell
+            # marking still runs under _wlock so a late replier races
+            # exactly once.
+            abrupt = False
             shm = self.shm
             if shm is not None:
                 self.shm = None
                 if shm.active:
+                    abrupt = True
                     shm.counters.fallback(REASON_PEER_DEATH)
                 shm.close()
+                # No MSG_SHM_DETACH ever arrived for these rings —
+                # orderly clients detach (or demote, which detaches)
+                # before dying.  Schedule the survivor-side unlink.
+                self.service._schedule_shm_reclaim(shm)
+            # Retire the session typed: a kill path (_kill) recorded
+            # its reason first; otherwise EOF with a live shm rung is
+            # the abrupt-death signature and a plain EOF is orderly.
+            self.service._session_dead(
+                self.session,
+                DEATH_ABRUPT if abrupt else DEATH_CLOSED,
+            )
             # Prune this handler so reconnecting shims don't accumulate
             # dead entries for the service's lifetime.
             with self.service._lock:

@@ -169,6 +169,24 @@ class ShmRing:
             except FileNotFoundError:
                 pass
 
+    def force_unlink(self) -> bool:
+        """Survivor-side reclaim: unlink the backing segment REGARDLESS
+        of ownership.  Only for the abrupt-peer-death path — the
+        creator died without detaching and will never run its own
+        unlink, so the /dev/shm file would outlive every mapping.  Safe
+        against a creator that is actually alive (half-open socket):
+        its mappings stay valid and its own later unlink of this name
+        is an absorbed FileNotFoundError.  Returns True only when THIS
+        call removed the segment (the caller's reclaim accounting must
+        not count no-ops)."""
+        try:
+            self.seg.unlink()
+            return True
+        except FileNotFoundError:
+            return False  # the creator got there first (orderly teardown)
+        except OSError:
+            return False  # already reclaimed / platform refuses
+
     # -- cursors (informational mirrors) ----------------------------------
 
     @property

@@ -265,7 +265,12 @@ class VerdictTracer:
                 if self._sample_credit >= self.sample_every:
                     self._sample_credit %= self.sample_every
                     sample = True
-        for seq, n, arrival, conn0 in batches:
+        for desc in batches:
+            # Descs are (seq, n, arrival, conn0[, session]) — the
+            # session id rides along where the fan-in seam knows it, so
+            # an exemplar can be attributed to one shim (pod).
+            seq, n, arrival, conn0 = desc[0], desc[1], desc[2], desc[3]
+            session = desc[4] if len(desc) > 4 else 0
             e2e = max(rt.t_send - (arrival or rt.t_admit), 0.0)
             if self.stage_metrics:
                 metrics.VerdictE2ESeconds.observe(e2e, path)
@@ -273,12 +278,12 @@ class VerdictTracer:
             if sample or slow:
                 self._span(
                     "slow" if slow else "sample", path, seq, n, conn0,
-                    e2e, stages,
+                    e2e, stages, session=session,
                 )
                 sample = False  # one sampled span per round
 
     def record_shed(self, seq: int, n: int, arrival: float, conn0: int,
-                    reason: str) -> None:
+                    reason: str, session: int = 0) -> None:
         """A typed SHED answered this wire batch: record its e2e under
         the shed path (its only real stage is queue wait) and keep an
         exemplar — shed entries are the tail the decomposition exists
@@ -296,12 +301,13 @@ class VerdictTracer:
             rec[0] += 1
             rec[1] += e2e
         self._span("shed", PATH_SHED, seq, n, conn0, e2e,
-                   {STAGE_QUEUE: e2e}, reason=reason)
+                   {STAGE_QUEUE: e2e}, reason=reason, session=session)
 
     # -- spans / exemplars ------------------------------------------------
 
     def _span(self, kind: str, path: str, seq: int, n: int, conn0: int,
-              e2e: float, stages: dict, reason: str = "") -> None:
+              e2e: float, stages: dict, reason: str = "",
+              session: int = 0) -> None:
         span = {
             "kind": kind,
             "path": path,
@@ -314,6 +320,8 @@ class VerdictTracer:
             },
             "ts": time.time(),
         }
+        if session:
+            span["session"] = int(session)
         if reason:
             span["reason"] = reason
         self._ring.append(span)
@@ -351,10 +359,14 @@ class VerdictTracer:
             except Exception:  # noqa: BLE001
                 pass
 
-    def spans(self, n: int = 100, kind: str | None = None) -> list[dict]:
-        """Most-recent-first snapshot of the span ring."""
+    def spans(self, n: int = 100, kind: str | None = None,
+              session: int | None = None) -> list[dict]:
+        """Most-recent-first snapshot of the span ring.  ``session``
+        filters to spans attributed to one fan-in session (`cilium
+        sidecar trace --session`)."""
         out = [s for s in reversed(list(self._ring))
-               if kind is None or s["kind"] == kind]
+               if (kind is None or s["kind"] == kind)
+               and (session is None or s.get("session") == session)]
         return out[: max(int(n), 0)]
 
     # -- status -----------------------------------------------------------

@@ -22,6 +22,7 @@ never silent loss.
 from __future__ import annotations
 
 import threading
+import time
 
 from ..utils import metrics
 from .shm import ShmRing
@@ -40,9 +41,156 @@ REASON_GENERATION = "generation_mismatch"  # session: stale segment
 REASON_ATTACH_REJECTED = "attach_rejected"  # session: negotiation failed
 REASON_DISABLED = "disabled"              # session: service knob off
 REASON_PEER_DEATH = "peer_death"          # session: peer vanished
+REASON_OVERSIZE_SPREE = "oversize_spree"  # session: every frame oversized
 
 # MSG_SHM_CREDIT flag bits.
 CREDIT_FLAG_QUARANTINED = 1
+
+# --- fan-in session containment (N shims, one sidecar) ---------------------
+#
+# The unit of fault isolation on the fan-in seam is the SESSION (one
+# shim process's socket + optional ring pair).  Every containment
+# action is scoped to exactly one session and typed with one of the
+# reasons below — a misbehaving pod can be quarantined, demoted or
+# shed without its neighbors losing a byte.
+
+SESSION_ACTIVE = "active"
+SESSION_QUARANTINED = "quarantined"
+SESSION_DEAD = "dead"
+
+# Session-scoped shed reasons (sidecar_session_shed_total labels,
+# alongside the global queue_full/deadline/stall reasons).
+SHED_SESSION_QUOTA = "session_quota"          # DRR share exceeded
+SHED_SESSION_QUARANTINED = "session_quarantined"  # quarantine window
+
+# Session quarantine reasons (sidecar_session_quarantines_total).
+QUARANTINE_FLOOD = "flood"                    # sustained over-quota push
+QUARANTINE_RECONNECT_STORM = "reconnect_storm"  # crash-looping shim
+
+# Session death reasons (sidecar_session_deaths_total).
+DEATH_CLOSED = "closed"            # orderly EOF (shim closed/detached)
+DEATH_ABRUPT = "abrupt"            # EOF with the shm rung still live
+DEATH_SEND_TIMEOUT = "send_timeout"  # shim stopped reading; write killed
+DEATH_WRITE_FAILED = "write_failed"  # reply write failed mid-frame
+
+class SessionState:
+    """Per-shim-session admission, fairness and containment state —
+    one instance per accepted connection, owned by its handler.
+
+    Counter contract (the fan-in half of the exactly-once invariant):
+    ``submitted`` counts entries admitted off this session's socket or
+    rings; ``answered`` counts entries whose typed reply THIS session's
+    handler wrote (real verdicts, SHED and error verdicts alike — the
+    marking site under the handler write lock is the single counting
+    point, so a stood-down racing reply never double-books); ``shed``
+    breaks out the fail-closed subset by reason.  After a session
+    quiesces, submitted == answered — anything else is a lost or
+    double-answered entry.  All bumps are single integer ops on the
+    hot path (GIL-atomic; reads are status-only)."""
+
+    # Identities are wire-supplied: bound their length, and keep the
+    # PROMETHEUS label under a separate bounded vocabulary
+    # (metric_identity, assigned by the service's hello handler) so a
+    # shim cycling names cannot grow label cardinality without bound.
+    IDENTITY_MAX = 64
+
+    def __init__(self, session_id: int, identity: str = ""):
+        self.id = session_id
+        self.identity = (
+            identity[: self.IDENTITY_MAX] or f"sess-{session_id}"
+        )
+        self.named = bool(identity)
+        self.metric_identity = "unnamed"
+        self.born = time.monotonic()
+        self.state = SESSION_ACTIVE
+        self.death_reason: str | None = None
+        self.quarantine_reason: str | None = None
+        self.quarantined_until = 0.0
+        self.quarantines: dict[str, int] = {}
+        self.submitted = 0
+        self.answered = 0
+        self.shed: dict[str, int] = {}
+        # DRR queue share: weight currently queued in the dispatcher on
+        # this session's behalf.  Incremented at admission under the
+        # dispatcher condition, zeroed wholesale when a round pops the
+        # queue (the pop takes everything, so every session's unused
+        # share replenishes at once — deficit-round-robin over queue
+        # slots, paced by service progress).
+        self.q_weight = 0
+        # Flood strikes: over-quota sheds inside the strike window.
+        self.strikes = 0
+        self.strike_window_start = 0.0
+
+    # -- containment -------------------------------------------------------
+
+    def set_identity(self, identity: str) -> None:
+        """First hello wins: a later hello on the same session is
+        ignored — one connection must not cycle identities through the
+        quota/metric/storm tables."""
+        if identity and not self.named:
+            self.identity = identity[: self.IDENTITY_MAX]
+            self.named = True
+
+    def quarantine(self, reason: str, cooldown_s: float) -> None:
+        """Latch this session (and only this session) off the data
+        plane for ``cooldown_s``: its submissions are answered with
+        typed SHED immediately, its control plane keeps serving, and
+        the latch self-heals when the window passes."""
+        self.state = SESSION_QUARANTINED
+        self.quarantine_reason = reason
+        self.quarantined_until = time.monotonic() + cooldown_s
+        self.quarantines[reason] = self.quarantines.get(reason, 0) + 1
+        metrics.SidecarSessionQuarantines.inc(self.metric_identity, reason)
+
+    def quarantined_now(self) -> bool:
+        """Lazy-heal check: True while the quarantine window is open;
+        the first call past the deadline flips the session back to
+        active (no timer thread — traffic drives the heal, like the
+        DeviceGuard re-probe)."""
+        if self.state != SESSION_QUARANTINED:
+            return False
+        if time.monotonic() >= self.quarantined_until:
+            self.state = SESSION_ACTIVE
+            self.quarantine_reason = None
+            return False
+        return True
+
+    def mark_dead(self, reason: str) -> None:
+        if self.state != SESSION_DEAD:
+            self.state = SESSION_DEAD
+            self.death_reason = reason
+            metrics.SidecarSessionDeaths.inc(reason)
+
+    # -- accounting --------------------------------------------------------
+
+    def count_shed(self, reason: str, n: int) -> None:
+        self.shed[reason] = self.shed.get(reason, 0) + n
+        metrics.SidecarSessionShed.inc(
+            self.metric_identity, reason, amount=n
+        )
+
+    def status(self) -> dict:
+        shed_total = sum(self.shed.values())
+        out = {
+            "session": self.id,
+            "identity": self.identity,
+            "state": self.state,
+            "submitted": self.submitted,
+            "answered": self.answered,
+            "served": self.answered - shed_total,
+            "shed": dict(self.shed),
+            "q_weight": self.q_weight,
+        }
+        if self.state == SESSION_QUARANTINED:
+            out["quarantine_reason"] = self.quarantine_reason
+            out["quarantine_remaining_s"] = round(
+                max(self.quarantined_until - time.monotonic(), 0.0), 3
+            )
+        if self.quarantines:
+            out["quarantines"] = dict(self.quarantines)
+        if self.death_reason is not None:
+            out["death_reason"] = self.death_reason
+        return out
 
 
 class _Counters:
@@ -121,6 +269,12 @@ class ShmSession:
         # to the ring whose verdict has not come back.  GIL-atomic
         # per-key dict ops; writer = producer, eraser = reader thread.
         self.inflight: dict[int, tuple[int, object]] = {}
+        # Consecutive data-ring oversize fallbacks (client half of the
+        # oversize-spree demotion; reset on any successful push).
+        self.oversize_run = 0
+        # Lease granted by the service at attach (seconds a survivor
+        # waits after abrupt peer death before unlinking the segments).
+        self.lease_s = 0.0
 
     @classmethod
     def create(cls, generation: int, data_slots: int, data_slot_bytes: int,
@@ -180,6 +334,12 @@ class ShmPeer:
         self.v_credit_head = 0   # client's last piggybacked verdict head
         self._state_lock = threading.Lock()
         self.quarantine_reason: str | None = None
+        # Consecutive verdict-ring oversize fallbacks (reset on any
+        # successful ring push): a spree means every frame this session
+        # produces misses the ring and the per-frame fit check is pure
+        # overhead — the session is demoted typed instead.
+        self.oversize_run = 0
+        self.attached_at = time.monotonic()
 
     @classmethod
     def attach(cls, req: dict) -> "ShmPeer":
@@ -207,6 +367,20 @@ class ShmPeer:
         self.active = False
         self.data.close()
         self.verdict.close()
+
+    def reclaim(self) -> bool:
+        """Survivor-side segment release: unlink BOTH segments of a
+        session whose creator died without MSG_SHM_DETACH.  The creator
+        owns the unlink in every orderly path; after an abrupt shim
+        death nobody else ever will, and the /dev/shm files leak until
+        reboot.  Safe against a shim that is actually alive behind a
+        half-open socket: its own mappings stay valid (POSIX unlink
+        semantics) and it reconnects with FRESH segments (generation
+        bump) — its own later unlink of these names is a no-op.
+        Returns True when at least one segment was actually removed."""
+        a = self.data.force_unlink()
+        b = self.verdict.force_unlink()
+        return a or b
 
     def status(self) -> dict:
         return {

@@ -111,6 +111,14 @@ MSG_CACHE_ENABLE = 24
 MSG_CACHE_GRANT = 25
 MSG_CACHE_REVOKE = 26
 
+# Multi-tenant fan-in: the shim announces its session identity (the
+# pod/workload name admission quotas and shed/quarantine metrics key
+# on) right after connect and again on every reconnect replay.
+# Fire-and-forget (no reply) so a legacy peer — including the bench
+# null server — just ignores it; an unnamed session quotas under a
+# synthetic per-session identity.  JSON payload: {"identity": str}.
+MSG_SESSION_HELLO = 27
+
 # OnIO op capacity per verdict entry (reference: cilium_proxylib.cc:199).
 MAX_OPS_PER_ENTRY = 16
 
@@ -745,6 +753,28 @@ def pack_cache_revoke(epoch: int) -> bytes:
 
 def unpack_cache_revoke(payload: bytes) -> int:
     return struct.unpack_from("<q", payload, 0)[0]
+
+
+# --- session hello (MSG_SESSION_HELLO) -----------------------------------
+
+def pack_session_hello(identity: str) -> bytes:
+    """Shim identity announcement (fire-and-forget, no reply)."""
+    import json as _json
+
+    return _json.dumps({"identity": identity}).encode()
+
+
+def unpack_session_hello(payload: bytes) -> str:
+    """Returns the announced identity ('' on a malformed payload — a
+    broken hello must never kill the session's read loop; the session
+    just keeps its synthetic identity)."""
+    import json as _json
+
+    try:
+        req = _json.loads(payload.decode()) if payload else {}
+        return str(req.get("identity") or "")
+    except (ValueError, UnicodeDecodeError, AttributeError):
+        return ""
 
 
 # --- CLOSE / POLICY_UPDATE / ACK ----------------------------------------

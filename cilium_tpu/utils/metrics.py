@@ -270,7 +270,8 @@ KvstoreDegradedEvents = registry.counter(
 SidecarShedTotal = registry.counter(
     "sidecar_shed_total",
     "Verdict entries shed with a typed SHED response "
-    "(queue_full | deadline | stall)",
+    "(queue_full | deadline | stall | session_quota | "
+    "session_quarantined)",
     ("reason",),
 )
 SidecarBatchCrashes = registry.counter(
@@ -309,8 +310,46 @@ SidecarTransportFallback = registry.counter(
     "Shared-memory transport work served on the socket rung instead "
     "(per-batch: ring_full | oversize | verdict_ring_full; session "
     "demotions: torn_slot | generation_mismatch | attach_rejected | "
-    "disabled | peer_death)",
+    "disabled | peer_death | oversize_spree)",
     ("reason",),
+)
+# Multi-tenant fan-in (N shims, one sidecar): every containment action
+# is SESSION-scoped and typed — the operator can attribute a shed or a
+# quarantine to one pod.  The session label is the shim's announced
+# identity, stable across its reconnects, drawn from a BOUNDED
+# vocabulary (the service caps distinct label values; identities past
+# the cap report as 'other', unnamed sessions as 'unnamed' — the full
+# identity is always in status rows), so a shim cycling names cannot
+# grow cardinality without bound.
+SidecarSessionShed = registry.counter(
+    "sidecar_session_shed_total",
+    "Verdict entries shed with a typed response, attributed to the "
+    "session that submitted them (session_quota | session_quarantined "
+    "| queue_full | deadline | stall | error)",
+    ("session", "reason"),
+)
+SidecarSessionQuarantines = registry.counter(
+    "sidecar_session_quarantines_total",
+    "Session-scoped quarantine latches (flood | reconnect_storm): the "
+    "named session's data plane is answered typed-SHED for a cooldown "
+    "while every other session keeps serving",
+    ("session", "reason"),
+)
+SidecarSessionDeaths = registry.counter(
+    "sidecar_session_deaths_total",
+    "Shim sessions torn down, by how they died (closed | abrupt | "
+    "send_timeout | write_failed)",
+    ("reason",),
+)
+SidecarSessionsActive = registry.gauge(
+    "sidecar_sessions_active",
+    "Live shim sessions currently attached to the verdict service",
+)
+SidecarShmReclaims = registry.counter(
+    "sidecar_shm_segments_reclaimed_total",
+    "Orphaned shared-memory segments unlinked by the service after "
+    "lease expiry (a shim died without MSG_SHM_DETACH; the creator "
+    "would otherwise leak the /dev/shm files until reboot)",
 )
 # Policy-table epoch churn (sidecar/service.py): each successful
 # compile-then-swap bumps the epoch gauge; failures are typed and the

@@ -207,6 +207,44 @@ class DaemonConfig:
     # client-owned (SidecarClient shm_* kwargs): the shim creates the
     # segments and the service only maps what was negotiated.
     shm_transport: bool = True
+    # Ring-segment lease (seconds): how long the service waits after a
+    # session dies WITHOUT MSG_SHM_DETACH before unlinking its shared-
+    # memory segments.  The creator (shim) owns the unlink on every
+    # orderly path; after an abrupt shim death this lease is the only
+    # thing standing between the node and a /dev/shm leak per crash.
+    shm_lease_s: float = 30.0
+    # Verdict-ring oversize spree: this many CONSECUTIVE oversize
+    # fallbacks demote the session's shm rung typed (oversize_spree) —
+    # a session whose every frame misses the ring pays the fit check
+    # for nothing.  The same threshold drives the client-side data-ring
+    # spree.  0 disables.
+    shm_oversize_spree: int = 32
+
+    # Multi-tenant fan-in (N shim sessions, one dispatcher).  Deficit-
+    # round-robin credit windows: a session may hold at most
+    # max(shed_queue_entries / (sessions + 1), session_share_min)
+    # OUTSTANDING entries (submitted and not yet answered — the window
+    # covers the dispatcher queue AND the issued-not-answered
+    # completion pipeline); excess submissions are shed typed
+    # `session_quota` for THAT session only.  Credits return as
+    # answers are written, so a flood's buffering lands on the
+    # flooder while a session under its share is never refused.
+    session_share_min: int = 64
+    # Flood containment: this many over-quota sheds inside the strike
+    # window escalate to a session quarantine (typed `flood`) for
+    # session_quarantine_s — the flooding pod's data plane is answered
+    # typed-SHED immediately instead of being classified per batch.
+    # 0 disables escalation.
+    session_flood_strikes: int = 200
+    session_strike_window_s: float = 2.0
+    session_quarantine_s: float = 5.0
+    # Crash-loop containment: a shim identity that reconnects more
+    # than this many times inside the reconnect window starts its next
+    # session QUARANTINED (typed `reconnect_storm`) for
+    # session_quarantine_s — control plane (replay) still serves, so a
+    # healed pod exits the latch by just staying up.  0 disables.
+    session_reconnect_storm: int = 8
+    session_reconnect_window_s: float = 10.0
 
     # Multi-chip sharded verdict serving (parallel/rulesharding.py).
     # 'auto' builds a (flows, rules) device mesh at first engine bind
@@ -329,6 +367,20 @@ class DaemonConfig:
             or self.max_flow_buffer < 0
         ):
             raise ValueError("containment thresholds must be non-negative")
+        if (
+            self.session_share_min < 0
+            or self.session_flood_strikes < 0
+            or self.session_strike_window_s < 0
+            or self.session_quarantine_s < 0
+            or self.session_reconnect_storm < 0
+            or self.session_reconnect_window_s < 0
+            or self.shm_lease_s < 0
+            or self.shm_oversize_spree < 0
+        ):
+            raise ValueError(
+                "session fairness/containment thresholds must be "
+                "non-negative"
+            )
         if (
             self.trace_sample_every < 0
             or self.trace_slow_ms < 0

@@ -58,6 +58,12 @@ constexpr uint16_t kMsgAck = 9;
 [[maybe_unused]] constexpr uint16_t kMsgCacheGrant = 25;
 [[maybe_unused]] constexpr uint16_t kMsgCacheRevoke = 26;
 
+// Fan-in session hello (PR 15): fire-and-forget, no reply — a shim
+// that never announces an identity quotas under a synthetic
+// per-session name; nothing else about the protocol changes, so this
+// shim needs no new handling.
+[[maybe_unused]] constexpr uint16_t kMsgSessionHello = 27;
+
 struct Direction {
   std::string buffer;       // retained, not-yet-verdicted input
   int64_t pass_bytes = 0;   // verdicted PASS beyond buffered input

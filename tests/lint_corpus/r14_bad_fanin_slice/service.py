@@ -1,0 +1,33 @@
+"""R14.1 bad twin, fan-in coalescer: a coalesced round's per-session
+slice fan-out that BARE-returns when one session is dead/quarantined.
+
+Two silent-loss shapes, both scoped to a tenant seam: the admission
+gate drops a quarantined session's batch on the floor (no SHED, no
+hand-off — the pod's shim blocks until its own timeout), and the
+fan-out aborts mid-loop on a dead session, so every LATER session's
+slice of the same device round is never answered either — one dead
+pod stealing its neighbors' verdicts, exactly the cross-session
+containment bug class the fan-in seam exists to prevent.
+"""
+
+
+class Service:
+    def __init__(self, dispatcher):
+        self.dispatcher = dispatcher
+
+    def _fanin_submit(self, client, batch):
+        if client.session.quarantined:
+            return  # EXPECT[R14]
+        if not self.dispatcher.submit(batch):
+            self._shed_item(batch, "queue_full")
+
+    def _fanin_fanout(self, slices):
+        for client, payloads, batches in slices:
+            if not client.alive:
+                return  # EXPECT[R14]
+            client.send_frames(6, payloads, batches=batches)
+
+    def _shed_item(self, item, reason):
+        if item.answered:
+            return
+        item.client.send_verdicts(item.seq, [], batch=item)

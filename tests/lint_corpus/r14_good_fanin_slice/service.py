@@ -1,0 +1,29 @@
+"""R14.1 good twin, fan-in coalescer: every session's slice of a
+coalesced round is answered or handed off — a quarantined session's
+batch is shed TYPED (scoped to that session), and a dead session's
+slice failure is contained per session so the remaining sessions'
+slices still go out (the slice hand-off is an answer site)."""
+
+
+class Service:
+    def __init__(self, dispatcher):
+        self.dispatcher = dispatcher
+
+    def _fanin_submit(self, client, batch):
+        if client.session.quarantined:
+            self._shed_item(batch, "session_quarantined")
+            return
+        if not self.dispatcher.submit(batch):
+            self._shed_item(batch, "queue_full")
+
+    def _fanin_fanout(self, slices):
+        for client, payloads, batches in slices:
+            try:
+                client.send_frames(6, payloads, batches=batches)
+            except OSError:
+                continue  # dead session costs its own slice only
+
+    def _shed_item(self, item, reason):
+        if item.answered:
+            return
+        item.client.send_verdicts(item.seq, [], batch=item)

@@ -439,14 +439,21 @@ def _expected_kinds(rules: list[dict]) -> tuple:
 
 
 def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
-                n_conns: int = 8, policy_pair=None, **cfg_kw):
+                n_conns: int = 8, policy_pair=None, n_sessions: int = 1,
+                session_conns: int = 8, **cfg_kw):
     """The acceptance scenario: continuous policy updates + endpoint
     regeneration + identity allocate/release across an injected
     kvstore failover, against live mixed traffic.  ``policy_pair``
     overrides the two alternating rule generations (the flow-cache
     soak alternates a byte-free table — armed cache — with a
     byte-constrained one, so every flip exercises arm → invalidate →
-    re-check)."""
+    re-check).  ``n_sessions`` > 1 drives the soak through the fan-in
+    seam: that many extra concurrent shim sessions (own SidecarClient,
+    own module, ``session_conns`` conns each, identity-named) serve
+    live traffic while the churn thread flips EVERY module's table
+    each cycle — epoch flips, cache grants and revokes all land under
+    multi-session fan-in, and the per-session exactly-once counters
+    are asserted balanced at the end."""
     from cilium_tpu.kvstore import ChaosProxy, KvstoreFollower, KvstoreServer, NetBackend
     from cilium_tpu.kvstore.allocator import Allocator
 
@@ -467,6 +474,7 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
     epoch_rules: dict[int, tuple] = {}
     io_count = [0]
     id_by_key: dict[str, int] = {}
+    extra_sessions: list[tuple] = []  # (client, mod, shims) per session
 
     try:
         assert client.policy_update(mod, [_policy("pol", pol_even)]) == int(
@@ -492,6 +500,52 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
         next_cid = [n_conns + 1]
         frames = [b"READ /public/a\r\n", b"READ /secret\r\n", b"HALT\r\n",
                   b"WRITE /tmp/x\r\n", b"RESET\r\n"]
+
+        # Fan-in sessions: each an independent shim process stand-in
+        # (own socket, own module, own conns in a disjoint cid range).
+        # Their modules are pre-warmed with both generations so the
+        # churn window flips tables, not cold compiles (the
+        # shape-bucketed executable cache makes the extra modules'
+        # builds reuse the primary's compiled executables).
+        for k in range(1, n_sessions):
+            ec = SidecarClient(
+                svc.socket_path, timeout=60.0,
+                identity=f"soak-pod-{k}",
+            )
+            emod = ec.open_module([])
+            for warm_rules in (pol_even, pol_odd, pol_even):
+                assert ec.policy_update(
+                    emod, [_policy("pol", warm_rules)]
+                ) == int(FilterResult.OK)
+                epoch_rules[ec.last_policy_epoch] = (
+                    _expected_kinds(warm_rules)
+                )
+                epoch_rule_dicts[ec.last_policy_epoch] = warm_rules
+            eshims = {
+                100_000 * k + i: _conn(ec, emod, 100_000 * k + i)
+                for i in range(1, session_conns + 1)
+            }
+            extra_sessions.append((ec, emod, eshims))
+
+        def session_traffic(eshims):
+            i = 0
+            while not stop.is_set():
+                time.sleep(0.0005)
+                for cid, shim in list(eshims.items()):
+                    try:
+                        res, _ = shim.on_io(
+                            False, frames[i % len(frames)]
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append(f"fanin on_io raised: {exc!r}")
+                        return
+                    if res != int(FilterResult.OK):
+                        errors.append(
+                            f"fanin on_io result {res} (conn {cid})"
+                        )
+                        return
+                    io_count[0] += 1
+                    i += 1
 
         def traffic():
             i = 0
@@ -539,6 +593,18 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
                 else:
                     errors.append(f"policy_update status {st}")
                     return
+                # Fan-in: flip every extra session's table too (each
+                # commit is its own epoch; grants/revokes fan out to
+                # every opted-in session BEFORE the flip).
+                for ec, emod, _eshims in extra_sessions:
+                    est = ec.policy_update(emod, [_policy("pol", rules)])
+                    if est != int(FilterResult.OK):
+                        errors.append(f"fanin policy_update {est}")
+                        return
+                    epoch_rules[ec.last_policy_epoch] = (
+                        _expected_kinds(rules)
+                    )
+                    epoch_rule_dicts[ec.last_policy_epoch] = rules
                 # Endpoint regeneration: retire one conn, open another.
                 retire = min(shims)
                 shims.pop(retire).close()
@@ -577,6 +643,11 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
             threading.Thread(target=traffic, daemon=True),
             threading.Thread(target=churn, daemon=True),
             threading.Thread(target=identities, daemon=True),
+        ] + [
+            threading.Thread(
+                target=session_traffic, args=(eshims,), daemon=True
+            )
+            for _ec, _emod, eshims in extra_sessions
         ]
         for t in threads:
             t.start()
@@ -671,6 +742,22 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
                         True, 80, 1, R2d2RequestData(cmd, file_)
                     )
                     assert host == (True, inv[1]), (f, host, inv, rec)
+        # Fan-in exactly-once surface: every session's submitted ==
+        # answered (on_io is synchronous, so all sessions are quiesced
+        # once the threads joined), zero cross-session misrouting, one
+        # live row per session.
+        if extra_sessions:
+            rows = st["sessions"]["live"]
+            assert len(rows) == 1 + len(extra_sessions), rows
+            for row in rows:
+                assert row["submitted"] == row["answered"], row
+                assert row["state"] == "active", row
+            idents = {r["identity"] for r in rows}
+            for k in range(1, n_sessions):
+                assert f"soak-pod-{k}" in idents, rows
+            for ec, _emod, _eshims in extra_sessions:
+                assert ec.misrouted_verdicts == 0
+            assert client.misrouted_verdicts == 0
         # Identity churn stayed sane across the failover.
         assert follower.promoted.is_set()
         assert len(set(id_by_key.values())) == len(id_by_key), (
@@ -678,6 +765,11 @@ def _churn_soak(tmp_path, duration_s: float, updates_per_s: float,
         )
     finally:
         stop.set()
+        for ec, _emod, _eshims in extra_sessions:
+            try:
+                ec.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         client.close()
         svc.stop()
         kv.close()
@@ -702,6 +794,36 @@ def test_churn_soak_fast_flow_cache(tmp_path):
     table (the cached == recomputed parity gate)."""
     _churn_soak(
         tmp_path, duration_s=5.0, updates_per_s=4.0,
+        policy_pair=(POLICY_CACHEABLE, POLICY_B),
+        flow_cache=True,
+    )
+
+
+def test_churn_soak_fast_fanin(tmp_path):
+    """Tier-1 fan-in churn soak (the PR 9 leftover's fast shape, now
+    multi-session): 4 concurrent shim sessions — each its own client,
+    module and conns — serve live traffic while the churn thread flips
+    EVERY session's table each cycle and the verdict cache is armed,
+    so epoch flips, grants and revokes all land under fan-in.  On top
+    of the standard gates: per-session submitted == answered, zero
+    cross-session misrouting, one status row per session."""
+    _churn_soak(
+        tmp_path, duration_s=6.0, updates_per_s=2.0,
+        n_sessions=4, session_conns=6,
+        policy_pair=(POLICY_CACHEABLE, POLICY_B),
+        flow_cache=True,
+    )
+
+
+@pytest.mark.slow
+def test_churn_soak_fanin_thousands(tmp_path):
+    """Node-scale churn chaos soak (slow tier): thousands of endpoints
+    across 4 concurrent fan-in sessions under continuous policy flips,
+    identity churn and a kvstore failover — the ROADMAP item 5 scale
+    point (the fast twin above pins the same shape in tier-1)."""
+    _churn_soak(
+        tmp_path, duration_s=45.0, updates_per_s=2.0,
+        n_conns=512, n_sessions=4, session_conns=512,
         policy_pair=(POLICY_CACHEABLE, POLICY_B),
         flow_cache=True,
     )

@@ -122,6 +122,7 @@ _CORPUS_CASES = [
     "r12_bad_compile_hot",
     "r13_bad_unkeyed_cache",
     "r14_bad_admit_bail",
+    "r14_bad_fanin_slice",
     "r14_bad_deposed_double_reply",
     "r14_bad_reasm_bail_loss",
     "r15_bad_uncontained_drain",
@@ -153,6 +154,7 @@ _CORPUS_CLEAN = [
     "r12_good_prebuilt",
     "r13_good_epoch_keyed",
     "r14_good_admit_shed",
+    "r14_good_fanin_slice",
     "r14_good_guarded_reply",
     "r14_good_reasm_release",
     "r15_good_per_entry_try",
