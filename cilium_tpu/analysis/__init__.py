@@ -36,6 +36,9 @@ linter):
   R16 jit shape-closure (dispatch axes drawn from the declared
       power-of-two bucket universe; abstract twin audits the real
       serving surface end to end)
+  R17 snapshot round-trip symmetry (snapshot_*/restore_* pairs:
+      every written field consumed or versioned-out, no hard-
+      required field unwritten, no twin missing)
   R0  lint pragma hygiene (malformed / unjustified suppressions)
 
 Layer 1 is the interprocedural engine (``callgraph.py``): a project-

@@ -92,6 +92,12 @@ RULE_DOCS = {
            "bucket universe keys a new executable per size — the "
            "abstract-trace twin (--device-contracts) audits the real "
            "serving surface against the enumerated closure",
+    "R17": "snapshot round-trip symmetry: every top-level field a "
+           "snapshot_* half writes must be consumed by its same-module "
+           "restore_* twin (or named there as versioned-out), no "
+           "hard-required restore field may go unwritten, and no "
+           "snapshot half may ship without its twin — the "
+           "restart-handoff drift class",
 }
 
 # ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
@@ -404,6 +410,7 @@ def all_rules():
         rules_compile,
         rules_contain,
         rules_device,
+        rules_handoff,
         rules_jit,
         rules_locks,
         rules_metrics,
@@ -428,6 +435,7 @@ def all_rules():
         rules_answers.check_r14,
         rules_contain.check_r15,
         rules_device.check_r16,
+        rules_handoff.check_r17,
     ]
 
 

@@ -459,6 +459,26 @@ def cmd_sidecar_status(args):
           f"fallback={cont.get('fallback_entries', 0)} "
           f"stalls={cont.get('stalls', 0)} "
           f"quarantine_events={cont.get('quarantine_events', 0)}")
+    rst = st.get("restart") or {}
+    if rst:
+        refused = " ".join(
+            f"{k}={v}"
+            for k, v in sorted((rst.get("handoff_refused") or {}).items())
+        )
+        age = rst.get("handoff_age_s")
+        print(f"restart: generation={rst.get('generation', 1)}"
+              + (" FENCED(zombie predecessor)" if rst.get("fenced") else "")
+              + (f" handoff_age={age}s" if age is not None else "")
+              + f" restores: sessions={rst.get('session_restores', 0)}"
+              + f" conns={rst.get('conn_restores', 0)}"
+              + f" grants={rst.get('grant_restores', 0)}"
+              + f" residue={rst.get('residue_restores', 0)}"
+              + f" warm_shapes={rst.get('warm_shapes', 0)}"
+              + (f" fence_rejects={rst.get('fence_rejects', 0)}"
+                 if rst.get("fence_rejects") else "")
+              + (f" stale_segments_swept={rst.get('stale_segments_swept', 0)}"
+                 if rst.get("stale_segments_swept") else "")
+              + (f" refused: {refused}" if refused else ""))
     pol = st.get("policy") or {}
     if pol:
         fails = " ".join(

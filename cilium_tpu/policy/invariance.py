@@ -30,6 +30,15 @@ models/http.collect_http_rows, proxylib policy.matches_at):
 Rows are reduced to ``(remote_set_or_None, byte_free)`` pairs by the
 model builders (``invariant_rows`` on the batch models, host-side aux
 exactly like ``match_kinds`` — never device data, never pytree leaves).
+
+Cross-restart note: every grant is stamped with the policy epoch it was
+derived under, and the restart handoff snapshot carries that epoch
+forward — a successor re-derives grants from its own recompiled rows
+and confirms the (epoch, rule) pair matches before counting the grant
+restored, while the shim's survival window serves a grant only while
+its epoch equals the last service epoch the shim saw.  The invariance
+claim therefore never outlives the rows it was computed from, even
+across a kill -9 boundary.
 """
 
 from __future__ import annotations

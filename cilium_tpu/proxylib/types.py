@@ -51,3 +51,5 @@ class FilterResult(enum.IntEnum):
     # codes), so these stay fail-closed on old clients by construction.
     SHED = 8  # admission queue over capacity / entry deadline passed
     SERVICE_UNAVAILABLE = 9  # verdict service unreachable (client-side)
+    RESTARTING = 10  # sidecar restart window: queued-then-shed, typed
+    FENCED = 11  # fenced zombie predecessor rejected a late write

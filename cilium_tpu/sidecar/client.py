@@ -39,6 +39,7 @@ import json
 import logging
 import socket
 import threading
+import time
 from collections import deque
 from dataclasses import asdict, dataclass, field
 
@@ -49,7 +50,7 @@ from ..utils import metrics
 from ..utils.backoff import Exponential
 from ..utils.sockutil import shutdown_close as _teardown
 from . import wire
-from .reasm import rows_end_crlf, segments_end_crlf
+from .reasm import FRAMING_CRLF, FRAMINGS, rows_end_crlf, segments_end_crlf
 from .shm import RingError
 from .transport import (
     CREDIT_FLAG_QUARANTINED,
@@ -65,6 +66,15 @@ from .transport import (
 
 log = logging.getLogger(__name__)
 
+# Per-framing shim grants (ROADMAP 3c): the wire carries each grant's
+# framing KIND string; the hot path indexes these compact code tables.
+# Sorted so both ends derive the same coding independently of insertion
+# order; -1 in the per-conn code array means "no grant".
+_FRAMING_KINDS = sorted(FRAMINGS)
+_FRAMING_CODES = {k: i for i, k in enumerate(_FRAMING_KINDS)}
+_FRAMING_BY_CODE = [FRAMINGS[k] for k in _FRAMING_KINDS]
+_CODE_CRLF = _FRAMING_CODES[FRAMING_CRLF]
+
 
 def _join(payload) -> bytes:
     """Materialize a scatter-gather payload for the socket path (the
@@ -78,6 +88,13 @@ class SidecarUnavailable(wire.WireError):
     """The verdict service is unreachable (typed, raised immediately —
     callers decide between fail-closed verdicts and retry-after-
     reconnect; see the module docstring's classification)."""
+
+
+class SidecarRestarting(SidecarUnavailable):
+    """The service is down but this client's restart survival window
+    is open (``restart_grace_s``): granted flows keep serving locally,
+    and non-granted work is queued bounded or shed typed RESTARTING —
+    the bounded, typed flavor of unavailability."""
 
 
 @dataclass
@@ -99,6 +116,16 @@ class ShimConnection:
         self.conn_id = conn_id
         self.dirs = {False: _Direction(), True: _Direction()}
         self.closed = False
+        # True while the retained buffers provably mirror the
+        # service's per-conn parse state: every round so far answered
+        # OK (or was served by the grant tier, which keeps both sides
+        # empty).  Any typed failure, shed, deny or parser error
+        # breaks the mirror — the service consumed (or never saw)
+        # bytes this side still holds — and the restart replay must
+        # then NOT claim RETAINED for this conn.  _reset_fail_closed
+        # re-arms it: an emptied shim against a memoryless service is
+        # aligned again by construction.
+        self.mirror_ok = True
 
     def on_io(self, reply: bool, data: bytes, end_stream: bool = False,
               deadline_ms: float | None = None) -> tuple[int, bytes]:
@@ -152,15 +179,19 @@ class ShimConnection:
         # reach the transport (Libra-style: only bytes that NEED
         # inspection cross the seam).  Strictly gated: the direction
         # was fully clean at entry (clean_entry), request direction,
-        # and the payload ends at a frame boundary — so a revoke at
-        # any point leaves the stream parseable from a boundary.
+        # and the payload ends at a frame boundary per the GRANT'S OWN
+        # framing (CRLF tail, DNS length-prefix walk, ...) — so a
+        # revoke at any point leaves the stream parseable from a
+        # boundary.  This tier also serves through the restart
+        # survival window (the service is down; _grant_valid keeps
+        # grants live for restart_grace_s).
         if (
             clean_entry
             and not reply
             and not end_stream
             and incoming
-            and incoming.endswith(b"\r\n")
             and self.client._grant_valid(self.conn_id)
+            and self.client._grant_frame_aligned(self.conn_id, incoming)
         ):
             del d.buffer[:]  # holds exactly this push (clean_entry)
             output += incoming
@@ -172,12 +203,20 @@ class ShimConnection:
                 self.conn_id, reply, end_stream, incoming,
                 deadline_ms=deadline_ms,
             )
+        except SidecarRestarting:
+            # Fail-closed like SERVICE_UNAVAILABLE below, but typed to
+            # the survival window: the caller knows the blackout is
+            # bounded by restart_grace_s and retries are cheap.
+            d.buffer.clear()
+            self.mirror_ok = False
+            return int(FilterResult.RESTARTING), bytes(output)
         except (SidecarUnavailable, TimeoutError):
             # Fail-closed: nothing buffered may pass unverdicted while
             # the service is down OR unresponsive past the RPC timeout.
             # (Output assembled so far was authorized by earlier
             # verdicts and still goes out.)
             d.buffer.clear()
+            self.mirror_ok = False
             return int(FilterResult.SERVICE_UNAVAILABLE), bytes(output)
         # Queue every entry's ops and inject bytes BEFORE applying any op
         # (mirrors native/shim.cc on_data_rpc): the service splits >16-op
@@ -187,12 +226,14 @@ class ShimConnection:
         all_ops = []
         for _, res, ops, inj_orig, inj_reply in entries:
             if res != int(FilterResult.OK):
+                self.mirror_ok = False
                 return res, bytes(output)
             self.dirs[False].inject += inj_orig
             self.dirs[True].inject += inj_reply
             all_ops.extend(ops)
         for op, n in all_ops:
             if n <= 0 and op != MORE:
+                self.mirror_ok = False
                 return int(FilterResult.PARSER_ERROR), bytes(output)
             if op == MORE:
                 d.need_bytes = len(d.buffer) + n
@@ -209,11 +250,15 @@ class ShimConnection:
                     d.drop_bytes = n - take
             elif op == INJECT:
                 if n > len(d.inject):
+                    self.mirror_ok = False
                     return int(FilterResult.PARSER_ERROR), bytes(output)
                 output += d.inject[:n]
                 del d.inject[:n]
             elif op == ERROR:
+                self.mirror_ok = False
                 return int(FilterResult.PARSER_ERROR), bytes(output)
+        if result != int(FilterResult.OK):
+            self.mirror_ok = False
         return int(result), bytes(output)
 
     def _reset_fail_closed(self) -> None:
@@ -224,6 +269,9 @@ class ShimConnection:
             d.buffer.clear()
             d.inject.clear()
             d.pass_bytes = d.drop_bytes = d.need_bytes = 0
+        # Empty shim vs a service with no memory of the conn: the
+        # mirror holds again by construction.
+        self.mirror_ok = True
 
     def close(self) -> None:
         if not self.closed:
@@ -246,7 +294,9 @@ class SidecarClient:
                  shm_verdict_slot_bytes: int = 1 << 18,
                  flow_cache: bool = True,
                  identity: str = "",
-                 shm_oversize_spree: int = 32):
+                 shm_oversize_spree: int = 32,
+                 restart_grace_s: float = 0.0,
+                 restart_queue_frames: int = 0):
         self.socket_path = socket_path
         self.timeout = timeout
         self.deadline_ms = deadline_ms
@@ -261,6 +311,33 @@ class SidecarClient:
         # demotes its OWN shm rung typed (every frame missing the ring
         # means the fit check is pure overhead).  0 disables.
         self.shm_oversize_spree = shm_oversize_spree
+        # Restart survival window (the shim half of hitless restart):
+        # on disconnect, instead of tearing the grant table down,
+        # shim-local grants keep serving for up to restart_grace_s —
+        # the epoch stamp makes this safe (the reconnected service
+        # revalidates or revokes every grant during replay).  0 keeps
+        # the exact pre-restart behavior (grants die with the socket).
+        self.restart_grace_s = restart_grace_s
+        # Bound on NON-granted async frames held through the window to
+        # be resent (same seq) after replay; past it — or at 0 — such
+        # frames are answered immediately with typed RESTARTING sheds.
+        self.restart_queue_frames = restart_queue_frames
+        self._survival_until = 0.0  # monotonic deadline; 0 = closed
+        self.survival_windows = 0
+        # Granted-flow pushes answered locally WHILE the service was
+        # down — the bench/soak's "traffic served through the
+        # blackout" counter (strictly increasing during a restart).
+        self.survival_hits = 0
+        self.survival_hit_bytes = 0
+        self._restart_q: deque = deque()  # (msg_type, parts, seq, ids)
+        self._rq_frames = 0
+        self._rq_lock = threading.Lock()
+        self.restart_shed_frames = 0
+        # Cross-restart exactly-once tripwire: delivered-seq ring — a
+        # second delivery of a seq still in the ring is counted and
+        # SUPPRESSED (never reaches the waiter/callback twice).
+        self.double_replies = 0
+        self._answered_ring = np.full(1 << 16, -1, np.int64)
         # Cross-session misrouting tripwire: verdict entries delivered
         # to this client for conn ids it NEVER registered.  Asserted 0
         # by the fan-in bench/suites — a nonzero value means a
@@ -283,6 +360,10 @@ class SidecarClient:
         # all advance it) — the structural invalidation's client half.
         self._grant_epoch = np.empty(0, np.int64)
         self._grant_rule = np.empty(0, np.int32)
+        # Per-conn framing code (_FRAMING_CODES; -1 = none): keys the
+        # grant's frame-alignment check — CRLF tail vs length-prefix
+        # walk — so non-CRLF conns get the local tier too.
+        self._grant_framing = np.empty(0, np.int8)
         self._service_epoch = 0
         self.cache_hits = 0
         self.cache_hit_bytes = 0
@@ -314,7 +395,10 @@ class SidecarClient:
         # never cross the transport — only the DELIVERY of the local
         # answer waits, queued behind the rounds that were in flight
         # when it was synthesized.
-        self._rounds_out: set[int] = set()
+        # seq -> conn_ids: the value is what the disconnect sweep needs
+        # to answer a round that died in flight with a typed shed (the
+        # cross-restart exactly-once contract's "typed local SHED" arm).
+        self._rounds_out: dict[int, np.ndarray | None] = {}
         self._local_q: deque[tuple[set, wire.VerdictBatch]] = deque()
         self._localq_lock = threading.Lock()
         self._control: list[tuple[int, bytes]] = []
@@ -412,6 +496,18 @@ class SidecarClient:
                         )
                     self._control.append((msg_type, payload))
                     self._control_evt.set()
+                elif msg_type in (wire.MSG_HANDOFF,
+                                  wire.MSG_HANDOFF_REPLY):
+                    # Restart handoff is a service-to-service side
+                    # channel (a successor dials its predecessor); a
+                    # shim session must never see either half.  Dropped
+                    # typed here — routing one into the control slot
+                    # would hand an RPC waiter a reply it never asked
+                    # for.
+                    log.warning(
+                        "unexpected handoff frame %d on a shim "
+                        "session; dropped", msg_type,
+                    )
                 else:
                     self._control.append((msg_type, payload))
                     self._control_evt.set()
@@ -442,9 +538,32 @@ class SidecarClient:
             self._down_handled = True
             self._alive = False
         self._reconnected.clear()
-        # Cache grants die with the session (the service they came
-        # from has no successor-memory of them).
-        self._reset_grants()
+        # Restart survival window: with a grace budget and a reconnect
+        # loop to revalidate behind it, grants OUTLIVE the socket —
+        # granted flows keep serving locally through the blackout.
+        # The epoch stamp makes this safe: the reconnected service
+        # re-grants (or silently does not) every replayed conn, and
+        # the MSG_CONN_RESULT handler drops each conn's row at replay,
+        # so a stale grant can never outlive its revalidation.
+        # Without the window, grants die with the session exactly as
+        # before (the service has no successor-memory of them).
+        if self.restart_grace_s > 0 and self.auto_reconnect and (
+            not self._closed
+        ):
+            self._survival_until = (
+                time.monotonic() + self.restart_grace_s
+            )
+            self.survival_windows += 1
+        else:
+            self._reset_grants()
+        # Frames held for a resend die with this (second) disconnect:
+        # clear the queue FIRST — their seqs are still registered in
+        # _rounds_out and the sweep below answers each exactly once
+        # (typed); leaving them queued would resend them after a later
+        # replay and double-reply.
+        with self._rq_lock:
+            self._restart_q.clear()
+            self._rq_frames = 0
         # The shm session dies with the socket (a fresh one is
         # negotiated after replay): deactivate FIRST so no new pushes
         # land, then wake the waiters — ring in-flight RPCs share the
@@ -459,13 +578,21 @@ class SidecarClient:
         for seq, evt in list(self._pending.items()):
             self._pending.pop(seq, None)
             evt.set()
-        # Async rounds lost with the socket will never be answered —
-        # flush the ordering FIFO: queued local answers were decided
-        # under grants that were live at synthesis, and the rounds
-        # they waited on are dead, so they deliver now (after the
-        # waiter sweep, in synthesis order).
+        # Async rounds lost with the socket will never be answered by
+        # the service — answer each HERE with a typed SHED batch (the
+        # exactly-once contract: every seq in flight at death gets
+        # exactly one answer — old process, new process, or this typed
+        # local shed; silence is never an option).  Then flush the
+        # ordering FIFO: queued local answers were decided under
+        # grants that were live at synthesis, and the rounds they
+        # waited on are now answered, so they deliver in synthesis
+        # order.
         with self._localq_lock:
+            dead_rounds = sorted(self._rounds_out.items())
             self._rounds_out.clear()
+        for seq, cids in dead_rounds:
+            self._deliver_verdict(self._shed_batch(seq, cids))
+        with self._localq_lock:
             flushed = [lvb for _, lvb in self._local_q]
             self._local_q.clear()
         for lvb in flushed:
@@ -504,11 +631,20 @@ class SidecarClient:
                 with self._down_once:
                     self._reconnect_active = False
 
+    def _raise_down(self) -> None:
+        """Typed dead-service raise: RESTARTING while the survival
+        window is open (bounded blackout), plain unavailability else."""
+        if self._survival_open():
+            raise SidecarRestarting(
+                f"verdict service at {self.socket_path} is restarting"
+            )
+        raise SidecarUnavailable(
+            f"verdict service at {self.socket_path} is down"
+        )
+
     def _send(self, msg_type: int, payload: bytes) -> None:
         if not self._alive:
-            raise SidecarUnavailable(
-                f"verdict service at {self.socket_path} is down"
-            )
+            self._raise_down()
         with self._wlock:
             sock = self.sock
             try:
@@ -560,6 +696,18 @@ class SidecarClient:
                 "hits": self.cache_hits,
                 "hit_bytes": self.cache_hit_bytes,
                 "service_epoch": self._service_epoch,
+            },
+            # Restart survival window: shim-local serving while the
+            # sidecar is away, plus the exactly-once tripwires.
+            "restart": {
+                "grace_s": self.restart_grace_s,
+                "windows": self.survival_windows,
+                "window_open": self._survival_open_peek(),
+                "survival_hits": self.survival_hits,
+                "survival_hit_bytes": self.survival_hit_bytes,
+                "queued_frames": self._rq_frames,
+                "shed_frames": self.restart_shed_frames,
+                "double_replies": self.double_replies,
             },
         }
         if sess is not None:
@@ -637,19 +785,30 @@ class SidecarClient:
             ge[:n] = self._grant_epoch
             gr = np.full(new, -1, np.int32)
             gr[:n] = self._grant_rule
+            gf = np.full(new, -1, np.int8)
+            gf[:n] = self._grant_framing
             self._grant_epoch = ge
             self._grant_rule = gr
+            self._grant_framing = gf
         return True
 
     def _on_cache_grant(self, payload: bytes) -> None:
-        conn_id, epoch, rule, flags = wire.unpack_cache_grant(payload)
+        conn_id, epoch, rule, flags, framing = wire.unpack_cache_grant(
+            payload
+        )
         if not self.flow_cache or not flags & wire.CACHE_FLAG_ALLOW:
+            return
+        code = _FRAMING_CODES.get(framing)
+        if code is None:
+            # A framing this shim build does not know: ignore the
+            # grant (the normal path serves — forward compatible).
             return
         if epoch > self._service_epoch:
             self._service_epoch = epoch
         if self._grant_ensure(conn_id):
             self._grant_epoch[conn_id] = epoch
             self._grant_rule[conn_id] = rule
+            self._grant_framing[conn_id] = code
 
     def _on_cache_revoke(self, payload: bytes) -> None:
         epoch = wire.unpack_cache_revoke(payload)
@@ -662,25 +821,120 @@ class SidecarClient:
         if conn_id < len(self._grant_epoch):
             self._grant_epoch[conn_id] = -1
             self._grant_rule[conn_id] = -1
+            self._grant_framing[conn_id] = -1
 
     def _reset_grants(self) -> None:
         """A (re)connected service has no memory of this session's
         grants; drop them all (fail-safe: the normal path serves)."""
         self._grant_epoch.fill(-1)
         self._grant_rule.fill(-1)
+        self._grant_framing.fill(-1)
 
     def _count_cache_hits(self, n: int, nbytes: int) -> None:
         self.cache_hits += n
         self.cache_hit_bytes += nbytes
+        if not self._alive:
+            # Served locally THROUGH a blackout: the hitless-restart
+            # proof counter (strictly increasing while the service is
+            # down, asserted by the soak and the restart bench).
+            self.survival_hits += n
+            self.survival_hit_bytes += nbytes
+            metrics.SidecarSurvivalHits.inc(amount=n)
         metrics.VerdictCacheHits.inc("shim", amount=n)
+
+    # -- restart survival window ------------------------------------------
+
+    def _survival_open(self) -> bool:
+        """True while the restart survival window is open.  The FIRST
+        check past the deadline closes it lazily: grants reset and any
+        held frames shed typed — traffic drives the expiry, no timer
+        thread (same idiom as the session-quarantine lazy heal)."""
+        until = self._survival_until
+        if until == 0.0:
+            return False
+        if time.monotonic() < until:
+            return True
+        self._survival_until = 0.0
+        self._reset_grants()
+        self._shed_restart_queue()
+        return False
+
+    def _survival_open_peek(self) -> bool:
+        """Side-effect-free read for status surfaces."""
+        return (
+            self._survival_until > 0.0
+            and time.monotonic() < self._survival_until
+        )
+
+    def _restart_enqueue(self, msg_type: int, parts, seq: int,
+                         ids) -> bool:
+        """Hold one non-granted async round through the window for a
+        same-seq resend after replay.  False = no room (the caller
+        owes the round a typed RESTARTING shed)."""
+        n = len(ids) if ids is not None else 1
+        with self._rq_lock:
+            if self._rq_frames + n > self.restart_queue_frames:
+                return False
+            self._restart_q.append((msg_type, parts, seq, ids))
+            self._rq_frames += n
+        return True
+
+    def _shed_restart_queue(self) -> None:
+        """Answer every held round with a typed RESTARTING shed (window
+        expired, or replay superseded) — never silently dropped."""
+        with self._rq_lock:
+            items = list(self._restart_q)
+            self._restart_q.clear()
+            self._rq_frames = 0
+        for _mt, _parts, seq, ids in items:
+            self.restart_shed_frames += len(ids) if ids is not None else 1
+            self._deliver_verdict(
+                self._shed_batch(seq, ids, int(FilterResult.RESTARTING))
+            )
+
+    def _flush_restart_queue(self) -> None:
+        """Replay completed: resend every held round with its ORIGINAL
+        seq (the resumed service answers it once — the exactly-once
+        contract's "new process" arm).  A send that fails here sheds
+        typed; the round never goes unanswered."""
+        with self._rq_lock:
+            items = list(self._restart_q)
+            self._restart_q.clear()
+            self._rq_frames = 0
+        for msg_type, parts, seq, ids in items:
+            try:
+                self._transport_send(
+                    msg_type, parts, seq=seq, conn_ids=ids
+                )
+            except SidecarUnavailable:
+                self.restart_shed_frames += (
+                    len(ids) if ids is not None else 1
+                )
+                self._deliver_verdict(
+                    self._shed_batch(
+                        seq, ids, int(FilterResult.RESTARTING)
+                    )
+                )
 
     def _grant_valid(self, conn_id: int) -> bool:
         return (
             self.flow_cache
+            and (self._alive or self._survival_open())
             and conn_id < len(self._grant_epoch)
             and self._grant_epoch[conn_id] == self._service_epoch
             and self._service_epoch >= 0
         )
+
+    def _grant_frame_aligned(self, conn_id: int, data: bytes) -> bool:
+        """Whole-frame check under the grant's own framing (the caller
+        verified _grant_valid, so the row and its framing code are
+        live)."""
+        if conn_id >= len(self._grant_framing):
+            return False
+        code = int(self._grant_framing[conn_id])
+        if code < 0:
+            return False
+        return _FRAMING_BY_CODE[code].payload_aligned(data)
 
     def _cached_batch(self, seq: int, ids: np.ndarray,
                       lengths) -> wire.VerdictBatch:
@@ -712,11 +966,17 @@ class SidecarClient:
         under the live epoch and frame-aligned — the bytes never cross
         the transport.  Partial hits keep the normal path (the
         service's Phase-A mask owns per-entry splitting).  ``tail_ok``
-        is a thunk returning the per-entry frame-alignment mask,
-        evaluated only after every cheap grant-table check has passed
-        — the common no-grants case (cache off service-side) never
-        pays the O(payload) CRLF scan."""
+        is a thunk taking the int64 conn ids and returning the
+        per-entry frame-alignment mask (keyed per entry on the grant's
+        own framing), evaluated only after every cheap grant-table
+        check has passed — the common no-grants case (cache off
+        service-side) never pays the O(payload) scan."""
         if not self.flow_cache or not len(ids):
+            return False
+        if not self._alive and not self._survival_open():
+            # Dead service, window closed (or just lazily expired —
+            # _survival_open reset the grants): the normal path owes
+            # the caller its typed failure.
             return False
         # Range-check the RAW u64 ids before the int64 view: a wire id
         # >= 2^63 would wrap negative and fancy-index the wrong grant
@@ -726,7 +986,7 @@ class SidecarClient:
         cids = ids.astype(np.int64)
         if not (self._grant_epoch[cids] == self._service_epoch).all():
             return False
-        if not tail_ok().all():
+        if not tail_ok(cids).all():
             return False
         nbytes = int(np.asarray(lengths, np.int64).sum())
         self._count_cache_hits(len(ids), nbytes)
@@ -747,19 +1007,47 @@ class SidecarClient:
             self._deliver_verdict(vb)
         return True
 
-    @staticmethod
-    def _blob_tail_ok(blob: bytes, lens: np.ndarray) -> np.ndarray:
+    def _blob_tail_ok(self, blob: bytes, lens: np.ndarray,
+                      cids: np.ndarray) -> np.ndarray:
         """Frame-alignment mask for a packed blob batch — the service's
         `_cache_item_hits` gate: a blob inconsistent with its lengths
         reads as a miss (never indexes past the buffer), else every
-        segment must be CRLF-terminated."""
+        segment must end at a frame boundary under ITS OWN grant's
+        framing.  The all-CRLF batch (the overwhelmingly common case)
+        keeps the single vectorized scan."""
         if len(blob) != int(lens.sum()):
             return np.zeros(len(lens), bool)
-        return segments_end_crlf(
-            np.frombuffer(blob, np.uint8),
-            np.concatenate(([0], np.cumsum(lens)))[:-1],
-            lens,
-        )
+        u8 = np.frombuffer(blob, np.uint8)
+        starts = np.concatenate(([0], np.cumsum(lens)))[:-1]
+        codes = self._grant_framing[cids]
+        if (codes == _CODE_CRLF).all():
+            return segments_end_crlf(u8, starts, lens)
+        out = np.zeros(len(lens), bool)
+        for code in np.unique(codes):
+            if code < 0:
+                continue  # no framing on record: miss
+            m = codes == code
+            out[m] = _FRAMING_BY_CODE[int(code)].segments_aligned(
+                u8, starts[m], lens[m]
+            )
+        return out
+
+    def _rows_aligned(self, rows: np.ndarray, lens: np.ndarray,
+                      cids: np.ndarray) -> np.ndarray:
+        """Per-framing twin of _blob_tail_ok for the fixed-width
+        matrix layout."""
+        codes = self._grant_framing[cids]
+        if (codes == _CODE_CRLF).all():
+            return rows_end_crlf(rows, lens)
+        out = np.zeros(len(lens), bool)
+        for code in np.unique(codes):
+            if code < 0:
+                continue
+            m = codes == code
+            out[m] = _FRAMING_BY_CODE[int(code)].rows_aligned(
+                rows[m], lens[m]
+            )
+        return out
 
     def detach_shm(self) -> None:
         """Gracefully return the session to the socket transport (call
@@ -799,6 +1087,17 @@ class SidecarClient:
         ``payload`` may be a list of buffers: the ring path writes them
         straight into the slot (the bulk rows/blob part is never
         re-materialized); only the socket fallback joins them."""
+        if self._alive and not self._reconnected.is_set():
+            # Session replay in progress on the fresh socket: the
+            # successor adopts handed-off conns lazily as the replay
+            # re-registers them, so a data frame racing the replay
+            # would surface UNKNOWN_CONNECTION for a conn the caller
+            # legitimately holds.  Typed-restarting instead: the
+            # caller's round is held for a same-seq resend after the
+            # replay (or shed typed RESTARTING) — never misanswered.
+            raise SidecarRestarting(
+                f"verdict service at {self.socket_path} is replaying"
+            )
         nbytes = (
             sum(len(p) for p in payload)
             if isinstance(payload, (list, tuple)) else len(payload)
@@ -813,9 +1112,7 @@ class SidecarClient:
             self._send(msg_type, _join(payload))
             return
         if not self._alive:
-            raise SidecarUnavailable(
-                f"verdict service at {self.socket_path} is down"
-            )
+            self._raise_down()
         reason = None
         pushed = False
         spree = False
@@ -913,6 +1210,20 @@ class SidecarClient:
             sess = self._shm
         if sess is not None:
             sess.inflight.pop(vb.seq, None)
+        # Cross-restart exactly-once tripwire: a seq must be answered
+        # ONCE — by the old process, the new process, or a typed local
+        # shed.  A second delivery (e.g. a shed raced by a late real
+        # verdict across the restart boundary) is counted and
+        # suppressed so the waiter/callback never observes it.
+        slot = vb.seq & (len(self._answered_ring) - 1)
+        if self._answered_ring[slot] == vb.seq:
+            self.double_replies += 1
+            log.error(
+                "double reply suppressed for seq %d (%d entries)",
+                vb.seq, vb.count,
+            )
+            return
+        self._answered_ring[slot] = vb.seq
         self._check_misroute(vb)
         cb = self.verdict_callback
         evt = self._pending.pop(vb.seq, None)
@@ -980,7 +1291,7 @@ class SidecarClient:
         release: list[wire.VerdictBatch] = []
         with self._localq_lock:
             if seq is not None:
-                self._rounds_out.discard(seq)
+                self._rounds_out.pop(seq, None)
                 for waits, _ in self._local_q:
                     waits.discard(seq)
             while self._local_q and not self._local_q[0][0]:
@@ -994,11 +1305,14 @@ class SidecarClient:
             sess.inflight.pop(seq, None)
 
     @staticmethod
-    def _shed_batch(seq: int, conn_ids) -> wire.VerdictBatch:
-        """A synthesized typed-SHED verdict batch — byte-for-byte the
-        entry shape the service's shed path produces, used when ring
-        frames the service never admitted must be answered locally
-        (zero silent loss on demotion)."""
+    def _shed_batch(seq: int, conn_ids,
+                    result: int = int(FilterResult.SHED)
+                    ) -> wire.VerdictBatch:
+        """A synthesized typed verdict batch (SHED by default,
+        RESTARTING for survival-window sheds) — byte-for-byte the
+        entry shape the service's shed path produces, used when frames
+        the service never admitted must be answered locally (zero
+        silent loss on demotion, disconnect, or window expiry)."""
         cids = np.ascontiguousarray(
             conn_ids if conn_ids is not None else [], "<u8"
         )
@@ -1007,7 +1321,7 @@ class SidecarClient:
         return wire.VerdictBatch(
             seq,
             cids,
-            np.full(n, int(FilterResult.SHED), "<u4"),
+            np.full(n, result, "<u4"),
             zeros,
             zeros,
             zeros,
@@ -1299,7 +1613,7 @@ class SidecarClient:
         with self._session_lock:
             modules = dict(self._modules)
             conn_args = dict(self._conn_args)
-            shims = list(self._shims.values())
+            shims = dict(self._shims)
         for caller_id, rec in modules.items():
             wire_id = self._raw_open_module(rec["params"], rec["debug"])
             self._mod_map[caller_id] = wire_id
@@ -1309,14 +1623,35 @@ class SidecarClient:
                     raise wire.WireError(
                         f"policy replay rejected: {status}"
                     )
+        restored: set[int] = set()
         for conn_id, args in conn_args.items():
-            res = self._raw_new_connection(conn_id, args)
+            # RETAINED claim: this shim's retained-buffer mirror
+            # survived the blackout intact (no round failed typed on
+            # it), so a warm successor may adopt the predecessor's
+            # mid-frame residue for the conn — the two sides then
+            # resume the identical parse state.
+            shim = shims.get(conn_id)
+            cflags = (
+                wire.CONN_FLAG_RETAINED
+                if shim is not None and shim.mirror_ok
+                else 0
+            )
+            res, rflags = self._raw_new_connection(conn_id, args, cflags)
             if res != int(FilterResult.OK):
                 log.warning(
                     "conn %d replay rejected: %d", conn_id, res
                 )
-        for shim in shims:
-            shim._reset_fail_closed()
+            elif rflags & wire.CONN_RESULT_FLAG_RESIDUE_ADOPTED:
+                restored.add(conn_id)
+        for conn_id, shim in shims.items():
+            # A conn whose residue the successor ADOPTED keeps its
+            # retained buffer and overshoot counters: the service
+            # mirror matches them byte for byte, so a frame split
+            # across the restart reassembles instead of being dropped.
+            # Every other conn resets fail-closed exactly as before —
+            # empty shim, empty (or discarded) service state, aligned.
+            if conn_id not in restored:
+                shim._reset_fail_closed()
         if self._closed:
             # close() raced the replay AFTER the initial check passed:
             # it may have shut the OLD socket just before the swap, so
@@ -1335,9 +1670,23 @@ class SidecarClient:
             # failed negotiation leaves the session serving on the
             # socket rung; _shm_negotiate never raises.
             self._shm_negotiate()
+        # Restart survival window closes on a completed replay: the
+        # grants the replay re-armed are live again (a conn whose replay
+        # was rejected already had its grant row dropped by the
+        # MSG_CONN_RESULT handler).  Epoch sync is downgrade-safe for
+        # the same reason.  Held restart-window frames resend LAST,
+        # after every conn exists service-side, under their ORIGINAL
+        # seqs — exactly-once from the caller's view.
+        self._survival_until = 0.0
+        if self.last_policy_epoch >= 0:
+            self._service_epoch = self.last_policy_epoch
+        # Un-gate the data plane BEFORE the flush: _transport_send
+        # holds mid-replay rounds typed while _reconnected is clear,
+        # and the flush's own same-seq resends must pass it.
+        self._reconnected.set()
+        self._flush_restart_queue()
         self.reconnects += 1
         metrics.SidecarClientReconnects.inc()
-        self._reconnected.set()
         log.info(
             "sidecar client reconnected to %s (%d modules, %d conns, "
             "transport=%s)",
@@ -1511,7 +1860,13 @@ class SidecarClient:
                     self._modules[module_id]["policies"] = payload
         return status
 
-    def _raw_new_connection(self, conn_id: int, args: tuple) -> int:
+    def _raw_new_connection(
+        self, conn_id: int, args: tuple, flags: int = 0,
+    ) -> tuple[int, int]:
+        """Replay-path registration; returns ``(result,
+        result_flags)``.  ``flags`` carries the RETAINED claim; the
+        reply's trailing flags word (absent on an old service — treated
+        as 0) reports whether handoff residue was adopted."""
         (module_id, proto, ingress, src_id, dst_id,
          src_addr, dst_addr, policy_name) = args
         got = self._control_rpc(
@@ -1520,12 +1875,18 @@ class SidecarClient:
                 wire.pack_new_connection(
                     self._wire_mod(module_id), conn_id, ingress, src_id,
                     dst_id, proto, src_addr, dst_addr, policy_name,
+                    flags,
                 ),
             ),
             wire.MSG_CONN_RESULT,
             retry=False,
         )
-        return int(np.frombuffer(got[8:], "<u4", 1)[0])
+        res = int(np.frombuffer(got[8:12], "<u4", 1)[0])
+        rflags = (
+            int(np.frombuffer(got[12:16], "<u4", 1)[0])
+            if len(got) >= 16 else 0
+        )
+        return res, rflags
 
     def new_connection(
         self,
@@ -1645,11 +2006,23 @@ class SidecarClient:
         ``_rounds_out`` BEFORE any bytes move — the cache tier's
         ordering gate must see the round in flight from the instant it
         can be answered.  A failed send retires the seq (no verdict
-        will ever come to retire it)."""
+        will ever come to retire it) — EXCEPT inside the restart
+        survival window, where the round is either held bounded for a
+        same-seq resend after replay or answered right here with a
+        typed RESTARTING shed; the caller sees success either way (the
+        answer arrives through the normal delivery path, exactly
+        once)."""
         with self._localq_lock:
-            self._rounds_out.add(seq)
+            self._rounds_out[seq] = ids
         try:
             self._transport_send(msg_type, parts, seq=seq, conn_ids=ids)
+        except SidecarRestarting:
+            if self._restart_enqueue(msg_type, parts, seq, ids):
+                return  # held: resent (same seq) after the replay
+            self.restart_shed_frames += len(ids)
+            self._deliver_verdict(
+                self._shed_batch(seq, ids, int(FilterResult.RESTARTING))
+            )
         except BaseException:
             self._round_settled(seq)
             raise
@@ -1664,7 +2037,8 @@ class SidecarClient:
             fl = np.asarray(flags, np.uint8)
             lens = np.asarray(lengths, np.int64)
             if not fl.any() and self._cache_try_local(
-                seq, ids, lens, lambda: self._blob_tail_ok(blob, lens),
+                seq, ids, lens,
+                lambda cids: self._blob_tail_ok(blob, lens, cids),
             ):
                 return
         parts = wire.pack_data_batch_parts(seq, ids, flags, lengths, blob)
@@ -1682,14 +2056,14 @@ class SidecarClient:
         if self.flow_cache and len(ids):
             li = np.asarray(lengths, np.int64)
 
-            def _tail_ok(n=len(ids)):
-                # rows_end_crlf owns the width bound (a malformed
-                # length reads as a miss); a rows buffer inconsistent
-                # with (n, width) reads as a miss too.
+            def _tail_ok(cids, n=len(ids)):
+                # The framing's rows_aligned owns the width bound (a
+                # malformed length reads as a miss); a rows buffer
+                # inconsistent with (n, width) reads as a miss too.
                 if width < 1 or len(rows_bytes) != n * width:
                     return np.zeros(n, bool)
                 rows = np.frombuffer(rows_bytes, np.uint8).reshape(n, width)
-                return rows_end_crlf(rows, li)
+                return self._rows_aligned(rows, li, cids)
 
             if self._cache_try_local(seq, ids, li, _tail_ok):
                 return
@@ -1713,7 +2087,8 @@ class SidecarClient:
         if self.flow_cache and len(ids):
             lens = np.asarray(lengths, np.int64)
             if self._cache_try_local(
-                seq, ids, lens, lambda: self._blob_tail_ok(blob, lens),
+                seq, ids, lens,
+                lambda cids: self._blob_tail_ok(blob, lens, cids),
             ):
                 return
         # Scatter-gather parts (wire.py owns the layout — see

@@ -145,6 +145,11 @@ class BatchDispatcher:
         self.shed_submits = 0
         self.shed_weight = 0
         self.stall_deposals = 0
+        # Handoff fence (service.handoff_surrender): once True, every
+        # non-force submit is refused — the admission-layer backstop
+        # behind the service's typed SHED_FENCED gate, so a zombie
+        # predecessor can never grow its queue after surrendering.
+        self.fenced = False
         # Cumulative wall-clock with a round in flight (worker OR
         # cut-through inline) — the dispatcher-busy half of the device
         # telemetry; the tracer's device-busy gauge covers the chip
@@ -186,6 +191,10 @@ class BatchDispatcher:
         session's DRR queue share; the charge is released wholesale
         when a round pops the queue."""
         with self._cond:
+            if not force and self.fenced:
+                self.shed_submits += 1
+                self.shed_weight += weight
+                return False
             if (
                 not force
                 and self.max_pending
@@ -218,6 +227,11 @@ class BatchDispatcher:
         with self._cond:
             admitted = False
             for item, weight in items:
+                if not force and self.fenced:
+                    self.shed_submits += 1
+                    self.shed_weight += weight
+                    refused.append(item)
+                    continue
                 if (
                     not force
                     and self.max_pending

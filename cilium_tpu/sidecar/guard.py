@@ -235,6 +235,59 @@ class DeviceGuard:
             target=run, daemon=True, name="device-reprobe"
         ).start()
 
+    # -- restart handoff --------------------------------------------------
+
+    def snapshot_state(self) -> dict:
+        """Serialize the latch + lifetime counters for the service's
+        restart handoff snapshot: a successor must inherit an open
+        quarantine (the device did not heal just because the proxy
+        restarted), and the operator's event counters must not reset
+        to zero mid-incident.  Every field here is consumed by
+        ``restore_state`` (lint R17 audits the pair)."""
+        with self._lock:
+            return {
+                "quarantined": self.quarantined,
+                "reason": self.reason,
+                "stalls": self.stalls,
+                "quarantine_events": self.quarantine_events,
+                "probes": self.probes,
+                "quarantined_total_s": self._quarantined_total_s,
+            }
+
+    def restore_state(self, snap: dict) -> None:
+        """Successor half: adopt the predecessor's latch.  Malformed or
+        empty input restores nothing (cold guard state is fail-open
+        toward the device, which is correct — the first stall re-trips
+        the latch).  Restoring an OPEN quarantine re-arms the probe
+        pacer so traffic heals it exactly as it would have in the
+        predecessor — including a restart racing the heal probe: the
+        in-flight probe died with the old process, the successor just
+        probes again."""
+        try:
+            quarantined = snap["quarantined"]
+            if not isinstance(quarantined, bool):
+                # A JSON snapshot writes a real bool; anything else is
+                # corruption — refuse the row whole (bool("garbage")
+                # would silently restore an OPEN quarantine).
+                return
+            reason = str(snap.get("reason", ""))
+            stalls = int(snap.get("stalls", 0))
+            events = int(snap.get("quarantine_events", 0))
+            probes = int(snap.get("probes", 0))
+            total_s = float(snap.get("quarantined_total_s", 0.0))
+        except (KeyError, TypeError, ValueError):
+            return
+        with self._lock:
+            self.stalls = stalls
+            self.quarantine_events = events
+            self.probes = probes
+            self._quarantined_total_s = total_s
+            if quarantined and not self.quarantined:
+                self.quarantined = True
+                self.reason = reason or "restored"
+                self._quarantined_at = time.monotonic()
+                self._last_probe = 0.0  # probe may fire immediately
+
     # -- observability ----------------------------------------------------
 
     def status(self) -> dict:

@@ -625,6 +625,19 @@ class ByteArena:
     def drop(self, conn_id: int) -> None:
         self.release(conn_id)
 
+    def peek(self, conn_id: int) -> bytes:
+        """Non-destructive read of a conn's columnar carry bytes.  The
+        restart-handoff snapshot serializes residue IN PLACE: the conn
+        must keep serving unchanged if the handoff is refused or the
+        predecessor outlives the surrender attempt."""
+        if not (0 <= conn_id < len(self._map)):
+            return b""
+        slot = int(self._map[conn_id])
+        if slot < 0:
+            return b""
+        off, ln = int(self.s_off[slot]), int(self.s_len[slot])
+        return self.buf[off : off + ln].tobytes()
+
     def has_residue(self, conn_id: int) -> bool:
         """True when this conn holds columnar carry state (bytes or the
         dead/overflowed latch) — the arena's contribution to the

@@ -30,6 +30,8 @@ per-request critical path.
 
 from __future__ import annotations
 
+import base64
+import binascii
 import functools
 import json
 import logging
@@ -70,7 +72,7 @@ from .reasm import (
     Reassembler,
     gather_segments,
 )
-from .shm import GenerationMismatch, RingError
+from .shm import GenerationMismatch, RingError, sweep_stale_segments
 from .trace import (
     PATH_CACHED,
     PATH_HOST,
@@ -96,6 +98,7 @@ from .transport import (
     REASON_PEER_DEATH,
     REASON_TORN_SLOT,
     REASON_VERDICT_RING_FULL,
+    SHED_FENCED,
     SHED_SESSION_QUARANTINED,
     SHED_SESSION_QUOTA,
     TRANSPORT_SOCKET,
@@ -128,6 +131,18 @@ def _engine_framing(engine):
     if spec is None:
         return None
     return FRAMINGS.get(spec())
+
+
+# In-process executable-cache handoff (keyed by socket path): a
+# surrendering service deposits its shape-keyed prewarm ledger here so a
+# same-process successor rebuilding the restored rule sources skips its
+# warm launches entirely.  jax's jit executable cache is process-global
+# and shape-keyed (the module-level _call_model trace twins), so
+# unchanged tables recompile NOTHING across a graceful handoff — this
+# ledger carries the "which shape signatures are fully warmed" half
+# that would otherwise die with the instance.  A cross-process
+# successor simply finds no deposit (cold prewarm; correct either way).
+_HANDOFF_SHAPE_CACHE: dict[str, dict] = {}
 
 
 def _gather_model(model, blob, offs, lens, remotes, width: int,
@@ -556,6 +571,38 @@ class VerdictService:
         self.policy_swaps = 0
         self.policy_swap_failures: dict[str, int] = {}
         self.last_swap_ms = 0.0
+        # Hitless restart (Envoy-hot-restart-style handoff + PR 1
+        # fencing semantics).  restart_generation is the monotonic
+        # fencing token: a successor that pulled our snapshot runs at
+        # generation+1, and the surrendered (fenced) predecessor
+        # rejects every late write TYPED — policy updates NACK
+        # FilterResult.FENCED, data frames shed SHED_FENCED — so a
+        # zombie old process can never serve a verdict the successor's
+        # epoch would contradict.
+        self.restart_generation = 1
+        self._fenced = False
+        self.fence_rejects = 0
+        self._path_released = False  # surrendered the socket path
+        self.handoff_at = 0.0  # monotonic: when WE surrendered
+        self.handoff_loaded_at = 0.0  # monotonic: snapshot restored
+        self.handoff_ts = 0.0  # predecessor's wall-clock stamp
+        # Restored-but-not-yet-replayed state from a predecessor's
+        # snapshot: consumed (popped) as clients replay their sessions,
+        # conns and grants against us — a replayed row matching the
+        # snapshot revalidates in place (counted); anything left over
+        # is just forgotten (the client replay is authoritative).
+        self._handoff_sessions: dict[str, dict] = {}
+        self._handoff_conns: dict[int, dict] = {}
+        self._handoff_grants: dict[int, tuple] = {}
+        self._handoff_residue: dict[int, dict] = {}
+        self._handoff_rules: list = []
+        self.handoff_session_restores = 0
+        self.handoff_conn_restores = 0
+        self.handoff_grant_restores = 0
+        self.handoff_residue_restores = 0
+        self.handoff_warm_shapes = 0
+        self.handoff_refused: dict[str, int] = {}
+        self.shm_stale_swept = 0  # startup /dev/shm orphan sweep
 
     # -- lifecycle --------------------------------------------------------
 
@@ -576,6 +623,29 @@ class VerdictService:
 
             self._prev_switch_interval = sys.getswitchinterval()
             sys.setswitchinterval(self.GIL_SWITCH_INTERVAL_S)
+        # Startup stale-segment sweep: a kill -9'd predecessor's shm
+        # orphans (owner pid dead, lease expired) are force-unlinked
+        # before serving — in-service reclaim timers die with their
+        # service, so without this sweep crash orphans leak until
+        # reboot.
+        self.shm_stale_swept = sweep_stale_segments(
+            self.config.shm_lease_s
+        )
+        if self.shm_stale_swept:
+            metrics.SidecarStaleSegmentsSwept.inc(
+                amount=self.shm_stale_swept
+            )
+            log.info(
+                "swept %d stale predecessor shm segments",
+                self.shm_stale_swept,
+            )
+        # Graceful takeover: if a live predecessor still owns the
+        # socket path, pull its handoff snapshot over the side channel
+        # BEFORE unlinking the path out from under it.  Any failure
+        # falls through to the cold-boot path below — cold state is
+        # always correct (stale-segment reclaim + grant revalidation +
+        # client replay), it just isn't warm.
+        self._pull_handoff()
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -610,10 +680,14 @@ class VerdictService:
         # one.  Unlink the path immediately for the same reason.
         if self._listener is not None:
             shutdown_close(self._listener)
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
+        if not self._path_released:
+            # A surrendered (fenced) service already released the path
+            # to its successor — unlinking here would delete the
+            # SUCCESSOR's fresh socket.
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
         # Close shim connections so their reader/writer peers see EOF
         # immediately (a restarting shim must not block in recv on a
         # dead service).
@@ -662,6 +736,272 @@ class VerdictService:
             t = threading.Thread(target=client.read_loop, daemon=True)
             t.start()
             self._threads.append(t)
+
+    # -- hitless restart: handoff snapshot / restore / fencing ------------
+
+    def snapshot_handoff(self) -> dict:
+        """Serialize the state a successor needs to serve warm: policy
+        epoch, session identities, conn registry rows, armed grant
+        rows, per-conn flow-buffer residue, the live rule-source index
+        and the quarantine latch — one versioned JSON-safe dict (the
+        Envoy hot-restart parent->child state transfer, over our side
+        channel).  Every field written here is consumed by
+        ``restore_handoff`` or explicitly versioned-out (lint R17
+        audits the pair)."""
+        with self._sess_lock:
+            sessions = [
+                {
+                    "identity": s.identity,
+                    "submitted": int(s.submitted),
+                    "answered": int(s.answered),
+                }
+                for s in self._sessions.values()
+                if s.named
+            ]
+        conns: list = []
+        grants: list = []
+        residue: list = []
+        rules: set = set()
+        with self._lock:
+            epoch = self.policy_epoch
+            for key in self._engines:
+                _mod, policy_name, ingress, port, proto = key
+                rules.add((policy_name, bool(ingress), int(port or 0),
+                           proto))
+            for cid, sc in self._conns.items():
+                c = sc.conn
+                conns.append({
+                    "conn_id": int(cid),
+                    "policy": c.policy_name,
+                    "ingress": bool(c.ingress),
+                    "src_id": int(c.src_id),
+                    "proto": c.parser_name,
+                })
+                # Residue lives wherever the conn's lane keeps it: the
+                # engine flow buffer (fast path), the columnar arena
+                # carry, or the oracle mirror in sc.bufs — composed in
+                # _demote_to_oracle's order (engine bytes precede
+                # arena carry precede the mirror) so the successor's
+                # oracle parses the stream exactly as the predecessor
+                # would have.  Reads are non-destructive: the conn
+                # keeps serving unchanged if the handoff aborts.
+                ro = b""
+                flows = getattr(sc.engine, "flows", None)
+                if flows is not None:
+                    flow = flows.get(cid)
+                    if flow is not None and getattr(
+                        flow, "buffer", None
+                    ):
+                        ro = bytes(flow.buffer)
+                if self._reasm is not None:
+                    ro += self._reasm.arena.peek(cid)
+                ro += bytes(sc.bufs[False])
+                rr = bytes(sc.bufs[True])
+                if ro or rr or sc.skip[False] or sc.skip[True]:
+                    residue.append({
+                        "conn_id": int(cid),
+                        "orig": base64.b64encode(ro).decode("ascii"),
+                        "reply": base64.b64encode(rr).decode("ascii"),
+                        "skip_orig": int(sc.skip[False]),
+                        "skip_reply": int(sc.skip[True]),
+                    })
+                if (
+                    self._flow_cache_on
+                    and cid < self._tab_size
+                    and self._tab_cache[cid] == 1
+                ):
+                    grants.append({
+                        "conn_id": int(cid),
+                        "epoch": int(self._tab_cache_epoch[cid]),
+                        "rule": int(self._tab_cache_rule[cid]),
+                    })
+        return {
+            "version": wire.HANDOFF_VERSION,
+            "generation": self.restart_generation,
+            "ts": time.time(),
+            "socket_path": self.socket_path,
+            "policy_epoch": epoch,
+            "sessions": sessions,
+            "conns": conns,
+            "grants": grants,
+            "residue": residue,
+            "rules": [
+                {"policy": p, "ingress": i, "port": pt, "proto": pr}
+                for p, i, pt, pr in sorted(rules)
+            ],
+            "guard": self.guard.snapshot_state(),
+        }
+
+    def restore_handoff(self, snap: dict) -> bool:
+        """Successor half: adopt a predecessor's snapshot.  Version-
+        gated (a FUTURE snapshot version is refused typed — cold boot
+        serves correctly); restores the committed policy epoch, the
+        restart generation (+1 — the fencing token), the quarantine
+        latch, and stages sessions/conns/grants/residue for the client
+        replay to revalidate row by row."""
+        try:
+            version = int(snap.get("version", -1))
+            generation = int(snap["generation"])
+            epoch = int(snap["policy_epoch"])
+        except (KeyError, TypeError, ValueError):
+            self.handoff_refused["malformed"] = (
+                self.handoff_refused.get("malformed", 0) + 1
+            )
+            return False
+        if version < 1 or version > wire.HANDOFF_VERSION:
+            # Versioned-out: a snapshot from a NEWER schema is refused
+            # whole (never half-parsed) — cold boot is always correct.
+            self.handoff_refused["version"] = (
+                self.handoff_refused.get("version", 0) + 1
+            )
+            return False
+        if snap.get("socket_path") != self.socket_path:
+            self.handoff_refused["path-mismatch"] = (
+                self.handoff_refused.get("path-mismatch", 0) + 1
+            )
+            return False
+        self.restart_generation = generation + 1
+        self.policy_epoch = epoch
+        self.handoff_ts = float(snap.get("ts") or 0.0)
+        self.handoff_loaded_at = time.monotonic()
+        self._handoff_sessions = {
+            r["identity"]: r
+            for r in snap.get("sessions") or []
+            if r.get("identity")
+        }
+        self._handoff_conns = {
+            int(r["conn_id"]): r for r in snap.get("conns") or []
+        }
+        self._handoff_grants = {
+            int(r["conn_id"]): (int(r["epoch"]), int(r["rule"]))
+            for r in snap.get("grants") or []
+        }
+        self._handoff_residue = {
+            int(r["conn_id"]): r for r in snap.get("residue") or []
+        }
+        self._handoff_rules = list(snap.get("rules") or [])
+        self.guard.restore_state(snap.get("guard") or {})
+        # Executable-cache adoption (same-process successor only): the
+        # restored rule sources rebuild into the SAME shape signatures,
+        # so the deposited prewarm ledger makes churn rebuilds skip
+        # their warm launches — no cold recompile of unchanged tables.
+        warmed = _HANDOFF_SHAPE_CACHE.pop(self.socket_path, None)
+        if warmed:
+            self._prewarmed_shapes.update(warmed)
+            self.handoff_warm_shapes = len(warmed)
+        metrics.SidecarRestartGeneration.set(
+            float(self.restart_generation)
+        )
+        log.info(
+            "handoff snapshot restored: generation %d -> %d, epoch %d, "
+            "%d sessions, %d conns, %d grants, %d residue rows, "
+            "%d warm shapes",
+            generation, self.restart_generation, epoch,
+            len(self._handoff_sessions), len(self._handoff_conns),
+            len(self._handoff_grants), len(self._handoff_residue),
+            self.handoff_warm_shapes,
+        )
+        return True
+
+    def handoff_surrender(
+        self, successor_gen: int, deadline_s: float
+    ) -> tuple[dict | None, str]:
+        """Predecessor half (runs on the requesting handler's reader
+        thread): quiesce, snapshot, fence, release the socket path.
+        After this returns the service is a ZOMBIE — it answers
+        nothing new (typed rejects only) and exists solely so late
+        writers get their typed refusal instead of silence.  A stale
+        claimant (generation <= ours, PR 1 fencing semantics) and a
+        second claimant (already fenced) are both refused typed."""
+        if 0 < successor_gen <= self.restart_generation:
+            self.handoff_refused["stale-generation"] = (
+                self.handoff_refused.get("stale-generation", 0) + 1
+            )
+            return None, (
+                f"stale successor generation {successor_gen} <= "
+                f"{self.restart_generation}"
+            )
+        with self._lock:
+            if self._fenced:
+                self.handoff_refused["already-fenced"] = (
+                    self.handoff_refused.get("already-fenced", 0) + 1
+                )
+                return None, "already fenced by an earlier successor"
+            self._fenced = True
+        # Quiesce bounded by the successor's declared deadline: rounds
+        # in flight at surrender are answered by THIS process (the
+        # cross-restart exactly-once contract's "old process" arm).
+        # The fence above already stops new data admission
+        # (_fanin_admit sheds SHED_FENCED), so the queue only drains.
+        self.dispatcher.flush(timeout=max(deadline_s, 0.0))
+        self.dispatcher.fenced = True
+        snap = self.snapshot_handoff()
+        # Deposit the warm-shape ledger for a same-process successor
+        # (see _HANDOFF_SHAPE_CACHE).
+        if self._prewarmed_shapes:
+            _HANDOFF_SHAPE_CACHE[self.socket_path] = dict(
+                self._prewarmed_shapes
+            )
+        # Release the listener and the path so the successor can bind:
+        # shutdown (not bare close) pops the acceptor thread out of
+        # accept() immediately.
+        listener = self._listener
+        if listener is not None:
+            shutdown_close(listener)
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        self._path_released = True
+        self.handoff_at = time.monotonic()
+        metrics.SidecarHandoffSurrenders.inc()
+        log.warning(
+            "handoff surrendered (generation %d, epoch %d): fenced, "
+            "socket path released", self.restart_generation,
+            snap["policy_epoch"],
+        )
+        return snap, ""
+
+    def _pull_handoff(self) -> None:
+        """Successor half of the side channel: dial the predecessor's
+        socket (we have not bound yet), request its snapshot
+        (MSG_HANDOFF), restore it.  Every failure — no predecessor,
+        dead socket (crash restart), timeout, refusal, malformed reply
+        — degrades to the cold-boot path, which is always correct."""
+        if not self.config.restart_handoff:
+            return
+        if not os.path.exists(self.socket_path):
+            return
+        deadline = self.config.handoff_deadline_s
+        try:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(deadline)
+            sock.connect(self.socket_path)
+        except OSError:
+            return  # crash restart: the path is a dead remnant
+        try:
+            wire.send_msg(
+                sock, wire.MSG_HANDOFF, wire.pack_handoff(0, deadline)
+            )
+            reader = wire.BufferedReader(sock)
+            while True:
+                msg_type, payload = reader.recv_msg()
+                if msg_type == wire.MSG_HANDOFF_REPLY:
+                    break
+            snap, err = wire.unpack_handoff_reply(payload)
+            if snap is None:
+                self.handoff_refused["predecessor"] = (
+                    self.handoff_refused.get("predecessor", 0) + 1
+                )
+                log.warning("handoff refused by predecessor: %s", err)
+                return
+            self.restore_handoff(snap)
+        except Exception:  # noqa: BLE001 — cold boot serves correctly
+            log.warning(
+                "handoff pull failed; starting cold", exc_info=True
+            )
+        finally:
+            shutdown_close(sock)
 
     # -- control plane (called from client reader threads) ----------------
 
@@ -714,6 +1054,25 @@ class VerdictService:
                 "swap_failures": dict(self.policy_swap_failures),
                 "pending_builds": self._build_queue.qsize(),
                 "last_swap_ms": self.last_swap_ms,
+            },
+            # Hitless-restart surface: the fencing generation, handoff
+            # age/restore counters (successor side), the zombie's typed
+            # rejects (predecessor side), and the startup orphan sweep.
+            "restart": {
+                "generation": self.restart_generation,
+                "fenced": self._fenced,
+                "fence_rejects": self.fence_rejects,
+                "handoff_age_s": (
+                    round(time.monotonic() - self.handoff_loaded_at, 3)
+                    if self.handoff_loaded_at else None
+                ),
+                "handoff_refused": dict(self.handoff_refused),
+                "session_restores": self.handoff_session_restores,
+                "conn_restores": self.handoff_conn_restores,
+                "grant_restores": self.handoff_grant_restores,
+                "residue_restores": self.handoff_residue_restores,
+                "warm_shapes": self.handoff_warm_shapes,
+                "stale_segments_swept": self.shm_stale_swept,
             },
             "requests": self.fast_log.requests,
             "denied": self.fast_log.denied,
@@ -805,6 +1164,13 @@ class VerdictService:
         serving; any failure is fail-closed — the previous epoch keeps
         serving bit-identically and the failure is typed
         (policy_swap_failures_total{reason})."""
+        if self._fenced:
+            # Zombie predecessor: the successor owns the epoch line now.
+            # Typed NACK — the caller retries against the new socket.
+            self.fence_rejects += 1
+            metrics.SidecarFenceRejects.inc("policy_update")
+            self._swap_failed("fenced")
+            return int(FilterResult.FENCED), self.policy_epoch
         ins = pl.find_instance(module_id)
         if ins is None:
             return int(FilterResult.INVALID_INSTANCE), self.policy_epoch
@@ -1306,21 +1672,31 @@ class VerdictService:
                 )
 
     def new_connection(self, module_id, conn_id, ingress, src_id, dst_id,
-                       proto, src_addr, dst_addr, policy_name, client):
-        """Returns ``(result, grant_or_None)``.  The registration grant
-        is NOT sent here: the caller delivers it AFTER the
-        MSG_CONN_RESULT reply, so the shim's post-RPC stale-grant drop
-        (conn-id reuse) is socket-ordered before the fresh grant and
-        can never erase it."""
+                       proto, src_addr, dst_addr, policy_name, flags=0,
+                       client=None):
+        """Returns ``(result, grant_or_None, result_flags)``.  The
+        registration grant is NOT sent here: the caller delivers it
+        AFTER the MSG_CONN_RESULT reply, so the shim's post-RPC
+        stale-grant drop (conn-id reuse) is socket-ordered before the
+        fresh grant and can never erase it.  ``flags`` carries the
+        shim's CONN_FLAG_RETAINED claim (session replay: its retained
+        buffers survived the restart untouched); ``result_flags``
+        answers with CONN_RESULT_FLAG_RESIDUE_ADOPTED when the
+        predecessor's mid-frame residue was installed for this conn."""
+        if self._fenced:
+            self.fence_rejects += 1
+            metrics.SidecarFenceRejects.inc("new_connection")
+            return int(FilterResult.FENCED), None, 0
         res, conn = pl.on_new_connection(
             module_id, proto, conn_id, ingress, src_id, dst_id,
             src_addr, dst_addr, policy_name,
         )
         if res != FilterResult.OK:
-            return int(res), None
+            return int(res), None, 0
         sc = _SidecarConn(conn, client, None, module_id=module_id)
         self._bind_engine(module_id, sc)
         rebind = False
+        adopted = False
         with self._lock:
             # Re-resolve against the CURRENT epoch's table: an epoch
             # swap may have committed between the bind above and this
@@ -1343,6 +1719,74 @@ class VerdictService:
                         self._rebind_inflight.add(conn_id)
                         rebind = True
             self._conns[conn_id] = sc
+            # Handoff restore: if the predecessor knew this conn under
+            # the SAME identity tuple, adopt its mid-frame flow-buffer
+            # residue so a frame split across the restart reassembles
+            # instead of misparsing.  Adoption is DOUBLY gated: the
+            # identity tuple must match (conn-id reuse across the
+            # restart drops the residue — fresh state is correct,
+            # stale bytes are not) AND the shim must claim RETAINED
+            # (its retained-buffer mirror survived the blackout with
+            # no typed-failed round).  Without the claim the shim has
+            # dropped its copy fail-closed, and installing the
+            # predecessor's bytes here would put the parser AHEAD of
+            # the shim's buffer — every subsequent op would land
+            # shifted, silently passing or dropping the wrong bytes.
+            # Grants are NOT restored here: _arm_flow_cache re-derives
+            # them under the restored epoch (revalidate-or-revoke), we
+            # only count the matches.
+            prev = self._handoff_conns.pop(conn_id, None)
+            if prev is not None:
+                if (
+                    prev.get("policy") == policy_name
+                    and prev.get("ingress") == bool(ingress)
+                    and prev.get("src_id") == int(src_id)
+                    and prev.get("proto") == proto
+                ):
+                    self.handoff_conn_restores += 1
+                    res_row = self._handoff_residue.pop(conn_id, None)
+                    if res_row is not None and (
+                        flags & wire.CONN_FLAG_RETAINED
+                    ):
+                        try:
+                            sc.bufs[False] = bytearray(
+                                base64.b64decode(res_row["orig"])
+                            )
+                            sc.bufs[True] = bytearray(
+                                base64.b64decode(res_row["reply"])
+                            )
+                            sc.skip[False] = int(res_row["skip_orig"])
+                            sc.skip[True] = int(res_row["skip_reply"])
+                            adopted = bool(
+                                sc.bufs[False] or sc.bufs[True]
+                                or sc.skip[False] or sc.skip[True]
+                            )
+                        except (KeyError, TypeError, ValueError,
+                                binascii.Error):
+                            sc.bufs = {False: bytearray(),
+                                       True: bytearray()}
+                            sc.skip = {False: 0, True: 0}
+                    if adopted:
+                        # Residue must be CONSUMED, and engine entries
+                        # never drain sc.bufs: enter through the
+                        # demoted-to-oracle state (exactly the
+                        # quarantine-demotion shape) so the oracle
+                        # serves the reassembled frame and
+                        # _maybe_rebind restores the device path once
+                        # the carry drains.  The racing-swap rebind
+                        # queued above would bind an engine over the
+                        # residue — cancel it; the heal path re-queues
+                        # after the drain.
+                        self.handoff_residue_restores += 1
+                        sc.engine = None
+                        sc.fast_ok = False
+                        sc.demoted_mod = module_id
+                        if rebind:
+                            rebind = False
+                            self._rebind_inflight.discard(conn_id)
+                else:
+                    self._handoff_residue.pop(conn_id, None)
+                    self._handoff_grants.pop(conn_id, None)
             if self._tab_ensure(conn_id):
                 self._tab_src[conn_id] = conn.src_id
                 self._tab_dirty[conn_id] = 0
@@ -1351,6 +1795,14 @@ class VerdictService:
             # static, so a flow arms AT REGISTRATION — pure-L3/L4 and
             # allow-all tables never pay a single device round.
             grant = self._arm_flow_cache(conn_id, sc)
+            hg = self._handoff_grants.pop(conn_id, None)
+            if hg is not None and grant is not None and hg[1] == grant[3]:
+                # Predecessor's grant survived revalidation: the fresh
+                # arm landed on the SAME rule row.  The epoch is NOT
+                # compared — the replay re-commits policy before conns
+                # register, so the re-derived grant is expected to
+                # carry the successor's newer epoch.
+                self.handoff_grant_restores += 1
         if rebind:
             self._build_queue.put(("rebind", (module_id, conn_id)))
         if self.flowlog is not None:
@@ -1365,7 +1817,9 @@ class VerdictService:
                 src_addr, dst_addr, proto, conn.port,
                 session=sess.id if sess is not None else 0,
             )
-        return int(res), grant
+        return int(res), grant, (
+            wire.CONN_RESULT_FLAG_RESIDUE_ADOPTED if adopted else 0
+        )
 
     _TAB_MAX = 1 << 22  # conns with larger ids use the entrywise path
 
@@ -1489,11 +1943,12 @@ class VerdictService:
         (verdict_cache_evictions_total) — eviction is capacity
         management, not invalidation: the victim's claim stays true
         for its epoch, so an already-delivered shim grant needs no
-        revoke.  Returns the ``(client, grant_payload)`` to send
-        OUTSIDE the lock, or None; shim-local grants stay CRLF-only
-        (the shim's pre-push alignment check is the CRLF tail — see
-        client.py; teaching it per-conn framings is ROADMAP 3c's
-        remaining half)."""
+        revoke.  Returns the ``(client, conn_id, epoch, rule,
+        framing_kind)`` grant to send OUTSIDE the lock, or None.
+        Shim-local grants carry the conn's framing kind (ROADMAP 3c):
+        the shim keys its pre-push alignment check off the grant row —
+        CRLF tail for r2d2, the length-prefix walk for DNS — so every
+        framing registered in reasm.FRAMINGS gets the local tier."""
         if not self._flow_cache_on or conn_id >= self._tab_size:
             return None
         engine = sc.engine
@@ -1521,12 +1976,10 @@ class VerdictService:
                 self._tab_cache_rule[conn_id] = rule
                 self._tab_seen_tick[conn_id] = self._next_cache_tick()
                 client = sc.client
-                if (
-                    client is not None
-                    and getattr(client, "cache_ok", False)
-                    and framing.kind == FRAMING_CRLF
+                if client is not None and getattr(
+                    client, "cache_ok", False
                 ):
-                    return client, conn_id, epoch, rule
+                    return client, conn_id, epoch, rule, framing.kind
                 return None
         if was_armed:
             self._cache_armed -= 1
@@ -1598,7 +2051,7 @@ class VerdictService:
         correctness).  Callers hold no ``_lock``."""
         live: list = []
         with self._lock:
-            for client, conn_id, epoch, rule in grants:
+            for client, conn_id, epoch, rule, fkind in grants:
                 sc = self._conns.get(conn_id)
                 if (
                     sc is not None
@@ -1610,7 +2063,9 @@ class VerdictService:
                 ):
                     live.append(
                         (client,
-                         wire.pack_cache_grant(conn_id, epoch, rule))
+                         wire.pack_cache_grant(
+                             conn_id, epoch, rule, framing=fkind
+                         ))
                     )
         for client, payload in live:
             try:
@@ -1927,6 +2382,14 @@ class VerdictService:
         if not identity:
             return
         identity = sess.identity  # length-capped form
+        # Handoff restore: a known identity reconnecting right after a
+        # graceful restart is EXEMPT from the storm history — the
+        # restart drove the reconnect, the pod is not crash-looping.
+        # (The exactly-once audit spans the boundary as a sum: old-
+        # process answers + new-process answers + typed local sheds.)
+        restored = self._handoff_sessions.pop(identity, None) is not None
+        if restored:
+            self.handoff_session_restores += 1
         storm_n = self.config.session_reconnect_storm
         now = time.monotonic()
         window = self.config.session_reconnect_window_s
@@ -1939,7 +2402,7 @@ class VerdictService:
                 sess.metric_identity = identity
             else:
                 sess.metric_identity = "other"
-            if not storm_n:
+            if not storm_n or restored:
                 return
             hist = self._ident_connects.get(identity)
             if hist is None:
@@ -2021,6 +2484,13 @@ class VerdictService:
         still queue behind it).  A session under its share is never
         refused — work conserving — and a flood's buffering lands on
         the flooder, typed, not on its neighbors' latency."""
+        if self._fenced:
+            # Fenced zombie predecessor: every data-plane frame after
+            # surrender is refused typed (never silently) so a slow
+            # shim that has not reconnected yet sees a clean shed.
+            self.fence_rejects += 1
+            metrics.SidecarFenceRejects.inc("data")
+            return SHED_FENCED
         if sess is None:
             return ""
         if sess.quarantined_now():
@@ -6831,13 +7301,16 @@ class _ClientHandler:
                     self.service.submit_close(wire.unpack_close(payload))
                 elif msg_type == wire.MSG_NEW_CONNECTION:
                     args = wire.unpack_new_connection(payload)
-                    res, grant = self.service.new_connection(
+                    res, grant, cflags = self.service.new_connection(
                         *args, client=self
                     )
+                    # Trailing result-flags word (RESIDUE_ADOPTED):
+                    # old shims stop reading after the u4 result.
                     self.send(
                         wire.MSG_CONN_RESULT,
                         np.array([args[1]], "<u8").tobytes()
-                        + np.array([res], "<u4").tobytes(),
+                        + np.array([res], "<u4").tobytes()
+                        + np.array([cflags], "<u4").tobytes(),
                     )
                     if grant is not None:
                         # After the reply: the shim's post-RPC stale-
@@ -6857,6 +7330,23 @@ class _ClientHandler:
                     )
                     self.send(
                         wire.MSG_ACK, wire.pack_ack_epoch(status, epoch)
+                    )
+                elif msg_type == wire.MSG_HANDOFF:
+                    # Successor side channel: the claimant dialed our
+                    # socket path.  Surrender runs on THIS reader
+                    # thread (quiesce, snapshot, fence, release the
+                    # path); a refusal is typed in the reply so the
+                    # claimant cold-boots instead of hanging.
+                    gen, deadline_s = wire.unpack_handoff(payload)
+                    if gen < 0:
+                        snap, err = None, "malformed handoff request"
+                    else:
+                        snap, err = svc.handoff_surrender(
+                            gen, deadline_s
+                        )
+                    self.send(
+                        wire.MSG_HANDOFF_REPLY,
+                        wire.pack_handoff_reply(snap, err),
                     )
                 elif msg_type == wire.MSG_STATUS:
                     self.send(

@@ -92,6 +92,73 @@ def _segment_name(kind: str) -> str:
     return f"ctpu-{kind}-{os.getpid()}-{secrets.token_hex(4)}"
 
 
+def _segment_owner_pid(name: str) -> int:
+    """Creator pid embedded in a ctpu segment name (-1 if unparseable).
+    The name IS the ownership record: no registry survives a kill -9,
+    but the pid in the filename does."""
+    parts = name.split("-")
+    if len(parts) < 4 or parts[0] != "ctpu":
+        return -1
+    try:
+        return int(parts[-2])
+    except ValueError:
+        return -1
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    return True
+
+
+def sweep_stale_segments(lease_s: float, shm_dir: str = "/dev/shm") -> int:
+    """Startup reclaim of predecessor orphans: unlink every ctpu-*
+    segment whose creator process is DEAD and whose file age exceeds
+    the lease.  A live service reclaims its own peers' segments via
+    lease timers; this sweep covers the window those timers cannot —
+    the service itself was kill -9'd, so a crashed shim's (or the dead
+    service's clients') segments have no survivor to reclaim them until
+    the NEXT service boots.  Returns the number of segments removed.
+
+    Safety: a segment whose creator is alive is never touched (its
+    lease timer, if any, belongs to a live service), and the age gate
+    keeps a segment created a moment before its owner's pid was
+    recycled from being misjudged.  Mapped pages of any straggler stay
+    valid after unlink (POSIX); only the name is reclaimed.
+    """
+    removed = 0
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return 0  # no tmpfs view (non-Linux) — nothing to sweep
+    now = time.time()
+    for name in names:
+        if not name.startswith("ctpu-"):
+            continue
+        pid = _segment_owner_pid(name)
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        path = os.path.join(shm_dir, name)
+        try:
+            age = now - os.stat(path).st_mtime
+        except OSError:
+            continue  # raced another sweeper
+        if pid != -1 and age <= lease_s:
+            continue
+        try:
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            pass  # raced another sweeper / permissions — not ours then
+    return removed
+
+
 class ShmRing:
     """One SPSC ring over one shared-memory segment.
 

@@ -62,6 +62,8 @@ SESSION_DEAD = "dead"
 # alongside the global queue_full/deadline/stall reasons).
 SHED_SESSION_QUOTA = "session_quota"          # DRR share exceeded
 SHED_SESSION_QUARANTINED = "session_quarantined"  # quarantine window
+SHED_FENCED = "fenced"          # zombie predecessor after handoff
+SHED_RESTARTING = "restarting"  # shim-side survival window overflow
 
 # Session quarantine reasons (sidecar_session_quarantines_total).
 QUARANTINE_FLOOD = "flood"                    # sustained over-quota push

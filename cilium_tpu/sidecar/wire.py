@@ -119,6 +119,42 @@ MSG_CACHE_REVOKE = 26
 # synthetic per-session identity.  JSON payload: {"identity": str}.
 MSG_SESSION_HELLO = 27
 
+# Hitless restart handoff (Envoy hot-restart analog, over the same
+# unix socket as everything else): a SUCCESSOR service dials its
+# predecessor's socket and sends MSG_HANDOFF with its own restart
+# generation.  The predecessor serializes its warm state (sessions,
+# conn tables, armed grants, policy epoch + rule sources) into the
+# versioned snapshot of snapshot_handoff(), FENCES itself — from that
+# instant every late control write is rejected typed and every late
+# data frame sheds typed (PR 1 kvstore fencing semantics: the zombie
+# must never answer as if it were still primary) — releases the
+# listening socket path, and replies MSG_HANDOFF_REPLY carrying the
+# snapshot JSON.  A predecessor too old to speak the protocol simply
+# drops the unknown message; the successor times out and boots cold
+# (the kill -9 path), which is always correct, just not warm.
+MSG_HANDOFF = 28
+MSG_HANDOFF_REPLY = 29
+
+# Conn-registration flags (optional trailing byte on
+# MSG_NEW_CONNECTION; absent = 0, so old shims interop unchanged).
+# RETAINED rides the session-replay re-registration: the shim still
+# holds this conn's retained-buffer mirror bytes from before the
+# restart (no round failed typed on it), so the successor may adopt
+# the predecessor's flow-buffer residue — both sides then resume the
+# SAME mid-frame parse state and a frame split across the restart
+# reassembles.  Without the flag the shim has dropped its copy
+# (fail-closed), and adopting service-side residue would desync the
+# op stream from the shim's buffer: the service must discard it.
+CONN_FLAG_RETAINED = 1
+
+# Conn-result flags (optional trailing u4 on MSG_CONN_RESULT; absent
+# = 0).  RESIDUE_ADOPTED answers RETAINED: the successor installed
+# the predecessor's mid-frame residue for this conn, so the shim must
+# KEEP its retained buffer and overshoot counters through the replay
+# instead of resetting fail-closed — the service mirror matches them
+# byte for byte.
+CONN_RESULT_FLAG_RESIDUE_ADOPTED = 1
+
 # OnIO op capacity per verdict entry (reference: cilium_proxylib.cc:199).
 MAX_OPS_PER_ENTRY = 16
 
@@ -268,12 +304,14 @@ def pack_new_connection(
     src_addr: str,
     dst_addr: str,
     policy_name: str,
+    flags: int = 0,
 ) -> bytes:
     return _NEWCONN.pack(module_id, conn_id, int(ingress), src_id, dst_id) + (
         _pack_str(proto)
         + _pack_str(src_addr)
         + _pack_str(dst_addr)
         + _pack_str(policy_name)
+        + bytes([flags & 0xFF])
     )
 
 
@@ -285,6 +323,9 @@ def unpack_new_connection(payload: bytes):
     src_addr, off = _unpack_str(mv, off)
     dst_addr, off = _unpack_str(mv, off)
     policy_name, off = _unpack_str(mv, off)
+    # Optional trailing flags byte: a payload from an older shim ends
+    # at policy_name — absent means 0 (no retained-mirror claim).
+    flags = int(mv[off]) if off < len(mv) else 0
     return (
         module_id,
         conn_id,
@@ -295,6 +336,7 @@ def unpack_new_connection(payload: bytes):
         src_addr,
         dst_addr,
         policy_name,
+        flags,
     )
 
 
@@ -736,13 +778,29 @@ def pack_cache_enable() -> bytes:
 
 
 def pack_cache_grant(conn_id: int, epoch: int, rule: int,
-                     flags: int = CACHE_FLAG_ALLOW) -> bytes:
-    """Arm one conn: byte-invariant (verdict, rule row) under epoch."""
-    return struct.pack("<QqiI", conn_id, epoch, rule, flags)
+                     flags: int = CACHE_FLAG_ALLOW,
+                     framing: str = "crlf") -> bytes:
+    """Arm one conn: byte-invariant (verdict, rule row) under epoch.
+
+    The trailing framing kind (reasm.FRAMING_*) tells the shim WHICH
+    frame-alignment gate guards its local short-circuit — a DNS grant
+    must check length-prefix closure, not CRLF tails.  Appended behind
+    the original 24-byte form so an old shim keeps working: it reads
+    the fixed prefix and ignores the tail, and unpack_cache_grant
+    degrades a short (legacy) payload to the CRLF kind, matching the
+    only framing grants were ever armed on before (the same
+    length-degrading compat move as unpack_ack_epoch)."""
+    return struct.pack("<QqiI", conn_id, epoch, rule, flags) + (
+        _pack_str(framing)
+    )
 
 
-def unpack_cache_grant(payload: bytes) -> tuple[int, int, int, int]:
-    return struct.unpack_from("<QqiI", payload, 0)
+def unpack_cache_grant(payload: bytes) -> tuple[int, int, int, int, str]:
+    conn_id, epoch, rule, flags = struct.unpack_from("<QqiI", payload, 0)
+    if len(payload) <= 24:
+        return conn_id, epoch, rule, flags, "crlf"
+    framing, _ = _unpack_str(memoryview(payload), 24)
+    return conn_id, epoch, rule, flags, framing
 
 
 def pack_cache_revoke(epoch: int) -> bytes:
@@ -775,6 +833,64 @@ def unpack_session_hello(payload: bytes) -> str:
         return str(req.get("identity") or "")
     except (ValueError, UnicodeDecodeError, AttributeError):
         return ""
+
+
+# --- restart handoff (MSG_HANDOFF*) --------------------------------------
+
+# Version of the handoff snapshot schema.  Bumped whenever a field
+# changes meaning; restore_handoff refuses a snapshot NEWER than it
+# understands (a downgrade must boot cold, never misread warm state)
+# and tolerates older ones via per-field defaults.
+HANDOFF_VERSION = 1
+
+
+def pack_handoff(generation: int, deadline_s: float = 5.0) -> bytes:
+    """Successor→predecessor: "serialize, fence yourself, step aside".
+
+    ``generation`` is the successor's restart generation — strictly
+    greater than the predecessor's, the fencing token late writes are
+    rejected against.  ``deadline_s`` bounds how long the predecessor
+    may spend quiescing before it must answer."""
+    import json as _json
+
+    return _json.dumps(
+        {"generation": int(generation), "deadline_s": float(deadline_s)}
+    ).encode()
+
+
+def unpack_handoff(payload: bytes) -> tuple[int, float]:
+    """Returns (successor generation, deadline_s); (-1, 0.0) on a
+    malformed payload — a broken handoff must not kill the read loop,
+    the predecessor just declines."""
+    import json as _json
+
+    try:
+        req = _json.loads(payload.decode()) if payload else {}
+        return int(req["generation"]), float(req.get("deadline_s", 5.0))
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+        return -1, 0.0
+
+
+def pack_handoff_reply(snapshot: dict | None, error: str = "") -> bytes:
+    """Predecessor→successor: the versioned snapshot, or a typed
+    refusal (snapshot None + error set)."""
+    import json as _json
+
+    return _json.dumps(
+        {"snapshot": snapshot, "error": error}
+    ).encode()
+
+
+def unpack_handoff_reply(payload: bytes) -> tuple[dict | None, str]:
+    import json as _json
+
+    try:
+        rep = _json.loads(payload.decode()) if payload else {}
+        snap = rep.get("snapshot")
+        return (snap if isinstance(snap, dict) else None,
+                str(rep.get("error") or ""))
+    except (ValueError, UnicodeDecodeError, AttributeError):
+        return None, "malformed handoff reply"
 
 
 # --- CLOSE / POLICY_UPDATE / ACK ----------------------------------------

@@ -351,6 +351,38 @@ SidecarShmReclaims = registry.counter(
     "lease expiry (a shim died without MSG_SHM_DETACH; the creator "
     "would otherwise leak the /dev/shm files until reboot)",
 )
+SidecarStaleSegmentsSwept = registry.counter(
+    "sidecar_shm_stale_segments_swept_total",
+    "Dead-owner /dev/shm segments force-unlinked by the STARTUP sweep "
+    "(a crashed predecessor's orphans, past lease — the in-service "
+    "lease timers died with it, so the successor reclaims at boot)",
+)
+# Hitless restart (sidecar/service.py handoff): generation is the
+# fencing token — a surrendered predecessor is a zombie whose late
+# writes are rejected typed, never silently dropped.
+SidecarRestartGeneration = registry.gauge(
+    "sidecar_restart_generation",
+    "This service's restart generation (monotonic across graceful "
+    "handoffs; 1 = cold boot with no adopted snapshot)",
+)
+SidecarHandoffSurrenders = registry.counter(
+    "sidecar_handoff_surrenders_total",
+    "Handoff snapshots surrendered to a successor (this process "
+    "fenced itself, quiesced in-flight rounds and released the "
+    "socket path)",
+)
+SidecarFenceRejects = registry.counter(
+    "sidecar_fence_rejects_total",
+    "Late writes rejected typed by a fenced zombie predecessor "
+    "(policy_update | data | new_connection)",
+    ("kind",),
+)
+SidecarSurvivalHits = registry.counter(
+    "sidecar_client_survival_hits_total",
+    "Frames answered from the shim-local grant table while the "
+    "sidecar was away (restart survival window open: grants served "
+    "until replay revalidates or the grace deadline revokes them)",
+)
 # Policy-table epoch churn (sidecar/service.py): each successful
 # compile-then-swap bumps the epoch gauge; failures are typed and the
 # OLD epoch keeps serving (fail-closed — a failed recompile is never a

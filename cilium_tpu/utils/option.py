@@ -285,6 +285,20 @@ class DaemonConfig:
     # arming but existing rows keep serving).
     flow_cache_entries: int = 1 << 20
 
+    # Hitless restart (sidecar/service.py handoff).  A starting
+    # service that finds a live predecessor on its socket path pulls a
+    # state handoff over the side channel (MSG_HANDOFF) before binding:
+    # sessions, conns, grants, policy epoch and flow-buffer residue
+    # carry over, and the predecessor is fenced (its late writes are
+    # rejected typed).  False boots cold unconditionally — the crash-
+    # restart path, which is always correct, just not warm.
+    restart_handoff: bool = True
+    # Bound on the whole handoff pull: the predecessor's quiesce
+    # (in-flight rounds answered by the OLD process) and the snapshot
+    # reply must land within this window, else the successor cold-
+    # boots.  Also the successor's dial/read socket timeout.
+    handoff_deadline_s: float = 5.0
+
     # Policy churn (sidecar/service.py epoch swap).  How long a
     # MSG_POLICY_UPDATE handler waits for the builder thread's staged
     # compile-then-swap to commit before acking UNKNOWN_ERROR (the
@@ -399,6 +413,8 @@ class DaemonConfig:
             raise ValueError("mesh_reprobe_interval_s must be >= 0")
         if self.flow_cache_entries < 0:
             raise ValueError("flow_cache_entries must be >= 0")
+        if self.handoff_deadline_s < 0:
+            raise ValueError("handoff_deadline_s must be >= 0")
 
 
 # Global config (reference: option.Config singleton).

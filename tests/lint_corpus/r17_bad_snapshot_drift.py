@@ -1,0 +1,40 @@
+"""R17 corpus (bad): every drift mode of a snapshot/restore pair.
+
+- ``snapshot_handoff`` writes ``"residue"`` but ``restore_handoff``
+  never reads nor names it — state that silently dies at the restart
+  boundary.
+- ``restore_handoff`` hard-requires ``snap["lease_s"]`` which the
+  snapshot never writes — every restore takes the malformed path and
+  the handoff degrades to a cold boot forever.
+- ``snapshot_rings`` has no restore twin at all.
+"""
+
+
+class Service:
+    def __init__(self):
+        self.epoch = 0
+        self.generation = 1
+        self.residue = {}
+
+    def snapshot_handoff(self) -> dict:
+        out = {
+            "version": 1,
+            "generation": self.generation,
+            "epoch": self.epoch,
+            "residue": dict(self.residue),  # EXPECT[R17]
+        }
+        return out
+
+    def restore_handoff(self, snap: dict) -> bool:
+        try:
+            self.generation = int(snap["generation"]) + 1
+            self.epoch = int(snap["epoch"])
+            lease = float(snap["lease_s"])  # EXPECT[R17]
+        except (KeyError, TypeError, ValueError):
+            return False
+        if int(snap.get("version", -1)) != 1:
+            return False
+        return lease >= 0
+
+    def snapshot_rings(self) -> dict:  # EXPECT[R17]
+        return {"data": 1, "verdict": 2}

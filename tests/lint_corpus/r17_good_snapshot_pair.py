@@ -1,0 +1,42 @@
+"""R17 corpus (good): a symmetric snapshot/restore pair.
+
+Every written field is consumed (hard read, tolerant .get, or the
+versioned-out mention for a retired field); every hard-required field
+is written; the pair lives in one module.
+"""
+
+
+class Service:
+    def __init__(self):
+        self.epoch = 0
+        self.generation = 1
+        self.sessions = {}
+
+    def snapshot_handoff(self) -> dict:
+        return {
+            "version": 2,
+            "generation": self.generation,
+            "epoch": self.epoch,
+            "sessions": [
+                {"identity": k, "answered": v}
+                for k, v in self.sessions.items()
+            ],
+        }
+
+    def restore_handoff(self, snap: dict) -> bool:
+        try:
+            self.generation = int(snap["generation"]) + 1
+        except (KeyError, TypeError, ValueError):
+            return False
+        if int(snap.get("version", -1)) > 2:
+            return False
+        # "lease_s" was versioned-out at v2: in-service lease timers
+        # re-arm from config, so the field is dropped on the floor by
+        # NAME (this mention is the R17 versioned-out escape).
+        _ = ("lease_s",)
+        self.epoch = int(snap.get("epoch") or 0)
+        for row in snap.get("sessions") or []:
+            ident = row.get("identity")
+            if ident:
+                self.sessions[ident] = int(row.get("answered") or 0)
+        return True

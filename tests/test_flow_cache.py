@@ -328,7 +328,7 @@ def test_client_local_answer_defers_behind_inflight_round(tmp_path):
         client.verdict_callback = lambda vb: got.append(vb.seq)
         b0 = client.bytes_pushed
         with client._localq_lock:
-            client._rounds_out.add(7_777)  # an unanswered earlier round
+            client._rounds_out[7_777] = None  # an unanswered earlier round
         client.send_batch(
             41, np.array([1], np.uint64), np.zeros(1, np.uint8),
             np.array([9], np.uint32), b"READ /g\r\n",

@@ -127,6 +127,7 @@ _CORPUS_CASES = [
     "r14_bad_reasm_bail_loss",
     "r15_bad_uncontained_drain",
     "r16_bad_unbucketed.py",
+    "r17_bad_snapshot_drift.py",
 ]
 
 _CORPUS_CLEAN = [
@@ -159,6 +160,7 @@ _CORPUS_CLEAN = [
     "r14_good_reasm_release",
     "r15_good_per_entry_try",
     "r16_good_bucketed.py",
+    "r17_good_snapshot_pair.py",
 ]
 
 
