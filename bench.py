@@ -2959,8 +2959,247 @@ def bench_rules_100k():
     }
 
 
+def bench_mesh_degraded():
+    """Partial mesh degradation (ISSUE 17): a live mesh-on service
+    loses one named device mid-run under concurrent traffic.  The
+    width ladder must demote typed, reshape off-path onto the
+    survivor mesh, publish the degraded capacity fraction into
+    admission, keep serving bit-correct verdicts the whole way, and
+    re-promote to full width when the device heals.  Emits:
+
+    - ``mesh_reshape_window_ms`` (smaller better): attributed fault
+      to reshaped-rung flip, as published by the service;
+    - ``mesh_degraded_capacity_frac`` (bigger better): the serving
+      fraction the reshaped rung retains of full width.
+
+    Asserted in-bench: zero silent loss (every op returns a typed
+    result; submitted==answered per session after quiesce), zero
+    double replies, the degraded admission cap strictly below the
+    full-width cap, and the shed rate while degraded bounded by the
+    capacity actually lost."""
+    import threading
+
+    from cilium_tpu.parallel.rulesharding import ShardedVerdictModel
+    from cilium_tpu.proxylib import (
+        NetworkPolicy, PortNetworkPolicy, PortNetworkPolicyRule,
+        FilterResult,
+    )
+    from cilium_tpu.proxylib import instance as inst_mod
+    from cilium_tpu.sidecar import SidecarClient, VerdictService
+    from cilium_tpu.utils.option import DaemonConfig
+
+    path = "/tmp/cilium_tpu_bench_mesh_degraded.sock"
+    inst_mod.reset_module_registry()
+    cfg = DaemonConfig(
+        batch_timeout_ms=0.0, batch_flows=256, dispatch_mode="jit",
+        mesh="on", mesh_rule_shards=2,
+        mesh_reprobe_interval_s=0.05,
+        device_reprobe_interval_s=1e9,
+    )
+    svc = VerdictService(path, cfg).start()
+    client = SidecarClient(path, timeout=120.0, identity="bench-mesh")
+    ok = int(FilterResult.OK)
+    # Reshape windows may legitimately shed (the admission cap is the
+    # capacity story); anything else typed is a bench failure.
+    typed_ok = {ok, int(FilterResult.SHED)}
+
+    def await_rung(rung, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = svc.status()["mesh"]
+            if st["rung"] == rung:
+                return st
+            time.sleep(0.01)
+        raise AssertionError(
+            f"rung {rung!r} never reached: {svc.status()['mesh']}"
+        )
+
+    try:
+        mod = client.open_module([])
+        res = client.policy_update(mod, [NetworkPolicy(
+            name="bench-mesh",
+            policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(
+                    port=80,
+                    rules=[
+                        PortNetworkPolicyRule(
+                            remote_policies=[2], l7_proto="r2d2",
+                            l7_rules=[
+                                {"cmd": "READ", "file": "/public/.*"},
+                                {"cmd": "HALT"},
+                            ],
+                        ),
+                    ],
+                )
+            ],
+        )])
+        assert res == ok
+        shims = []
+        for cid in range(1, 9):
+            res, shim = client.new_connection(
+                mod, "r2d2", cid, True, 2, 2, f"1.1.1.{cid}:{cid}",
+                "2.2.2.2:80", "bench-mesh",
+            )
+            assert res == ok
+            shims.append(shim)
+        # Warm every conn (first op resolves the mesh + builds the
+        # sharded engine) and pin the full-width surface.
+        for shim in shims:
+            res, _ = shim.on_io(False, b"READ /public/warm\r\n")
+            assert res == ok, res
+        st_full = svc.status()["mesh"]
+        assert st_full["rung"] == "full", st_full
+        full_devices = st_full["serving_devices"]
+        full_cap = svc.dispatcher.max_pending
+        assert full_devices >= 4, (
+            f"mesh_degraded needs a >=4-device full mesh, got "
+            f"{full_devices}"
+        )
+
+        stop = threading.Event()
+        results: list[tuple[float, int]] = []
+        lock = threading.Lock()
+        errs: list = []
+        frames = (b"READ /public/warm\r\n", b"HALT\r\n")
+
+        def loop(base):
+            i = 0
+            try:
+                while not stop.is_set():
+                    shim = shims[(base + i) % len(shims)]
+                    t0 = time.perf_counter()
+                    res, _ = shim.on_io(False, frames[i % 2])
+                    with lock:
+                        results.append((t0, res))
+                    assert res in typed_ok, res
+                    i += 1
+                    time.sleep(0.0005)
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=loop, args=(b,), daemon=True)
+                   for b in (0, 4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # steady full-width traffic
+
+        # Mid-run device loss: the NEXT sharded dispatch raises a
+        # PJRT-shaped error NAMING the device (the ladder's attribution
+        # source), and the probe seam marks it dead.  Self-disarming —
+        # the reshaped wrappers must serve cleanly after the fault.
+        lost_dev = full_devices - 1
+        orig = svc.__class__._jit_for.__get__(svc)
+
+        def arm_loss():
+            def lost_device(cache, model, trace_fn, arg_fn=None):
+                if isinstance(model, ShardedVerdictModel):
+                    def boom(*_a, **_k):
+                        svc._jit_for = orig
+                        raise RuntimeError(
+                            f"PJRT_Error: transfer to device "
+                            f"{lost_dev} failed"
+                        )
+
+                    return boom
+                return orig(cache, model, trace_fn, arg_fn)
+
+            svc._jit_for = lost_device
+            svc._device_probe_fn = lambda dev: dev.id != lost_dev
+
+        # Best-of-N (the bench's standard de-noising): full
+        # fault->reshape->heal cycles; the smallest window is the
+        # honest reading — a host stall or a cold-cache compile
+        # landing inside one cycle only INFLATES its window.  Cycle 0
+        # is compile-shadowed by construction (first executables at
+        # the survivor width); the warm cycles are the steady-state
+        # flip the metric tracks, so the cold one rides along in
+        # windows_ms as evidence but never wins the min.
+        CYCLES = 4
+        windows: list[float] = []
+        deg_spans: list[tuple[float, float]] = []
+        st_deg = None
+        deg_cap = full_cap
+        for _cycle in range(CYCLES):
+            arm_loss()
+            st_deg = await_rung("reshaped")
+            t_reshaped = time.perf_counter()
+            windows.append(st_deg["reshape_window_ms"])
+            assert st_deg["lost_devices"] == [lost_dev], st_deg
+            assert 0.0 < st_deg["capacity_frac"] < 1.0, st_deg
+            assert st_deg["serving_devices"] < full_devices, st_deg
+            deg_cap = svc.dispatcher.max_pending
+            assert 1 <= deg_cap < full_cap, (deg_cap, full_cap)
+
+            # Degraded-rung serving window: cycle 0 long enough to
+            # amortize the first post-flip dispatch (a fresh
+            # executable on the survivor mesh) so the shed-vs-capacity
+            # bound is measured over real steady-state traffic, not
+            # one compile-shadowed op.
+            time.sleep(2.0 if _cycle == 0 else 1.0)
+            deg_spans.append((t_reshaped, time.perf_counter()))
+            svc._device_probe_fn = lambda dev: True
+            st_back = await_rung("full")
+            assert st_back["repromotions"] == _cycle + 1, st_back
+            assert svc.dispatcher.max_pending == full_cap
+
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errs, errs
+
+        # Zero silent loss: every op above returned typed; the
+        # exactly-once surface balances after quiesce.
+        time.sleep(0.3)
+        rows = svc.status()["sessions"]["live"]
+        for row in rows:
+            assert row["submitted"] == row["answered"], row
+        assert client.double_replies == 0, client.double_replies
+        assert client.misrouted_verdicts == 0
+
+        # Shed rate vs capacity: while degraded, the shed fraction
+        # must not exceed the capacity actually lost (plus slack for
+        # the flip windows at both edges).
+        deg_ops = [(t0, r) for t0, r in results
+                   if any(a <= t0 < b for a, b in deg_spans)]
+        n_shed = sum(1 for _, r in deg_ops if r != ok)
+        shed_frac = n_shed / max(len(deg_ops), 1)
+        lost_frac = 1.0 - st_deg["capacity_frac"]
+        assert shed_frac <= lost_frac + 0.05, (
+            f"degraded shed {shed_frac:.3f} over lost-capacity bound "
+            f"{lost_frac:.3f}+0.05 ({n_shed}/{len(deg_ops)} ops)"
+        )
+
+        st = svc.status()["mesh"]
+        assert st["reshapes"] == CYCLES and st["repromotions"] == CYCLES
+        return {
+            "reshape_window_ms": min(windows),
+            "reshape_windows_ms": [round(w, 1) for w in windows],
+            "capacity_frac": st_deg["capacity_frac"],
+            "full_devices": full_devices,
+            "degraded_devices": st_deg["serving_devices"],
+            "lost_device": lost_dev,
+            "reshapes": st["reshapes"],
+            "repromotions": st["repromotions"],
+            "admission_cap_full": full_cap,
+            "admission_cap_degraded": deg_cap,
+            "ops_total": len(results),
+            "ops_degraded": len(deg_ops),
+            "shed_frac_degraded": shed_frac,
+        }
+    finally:
+        stop_evt = locals().get("stop")
+        if stop_evt is not None:
+            stop_evt.set()
+        client.close()
+        svc.stop()
+        inst_mod.reset_module_registry()
+
+
 def run_one(which: str) -> None:
-    if which in ("multichip_scaling", "rules_100k") and os.environ.get(
+    if which in ("multichip_scaling", "rules_100k", "mesh_degraded") \
+            and os.environ.get(
         "CILIUM_TPU_MULTICHIP"
     ) != "chip":
         # CPU smoke: the mesh configs need >1 device.  Request 4
@@ -3424,6 +3663,34 @@ def run_one(which: str) -> None:
             out["granted_served_frac"],
             granted_blackout_ops=out["granted_blackout_ops"],
         )
+    elif which == "mesh_degraded":
+        out = bench_mesh_degraded()
+        # Smaller-better: attributed fault to reshaped-rung flip, as
+        # published by the service's own ladder clock.  The capacity
+        # fraction the reshaped rung retains is its own bigger-better
+        # metric — the admission caps and the degraded shed fraction
+        # ride along as the coupling evidence.  Zero-silent-loss and
+        # shed-vs-capacity are asserted inside the bench.
+        _emit(
+            "mesh_reshape_window_ms", out["reshape_window_ms"], "ms",
+            1_000.0 / max(out["reshape_window_ms"], 1e-3),
+            windows_ms=out["reshape_windows_ms"],
+            lost_device=out["lost_device"],
+            reshapes=out["reshapes"],
+            repromotions=out["repromotions"],
+            ops_total=out["ops_total"],
+            ops_degraded=out["ops_degraded"],
+            shed_frac_degraded=round(out["shed_frac_degraded"], 4),
+        )
+        _emit(
+            "mesh_degraded_capacity_frac",
+            out["capacity_frac"], "frac",
+            out["capacity_frac"],
+            full_devices=out["full_devices"],
+            degraded_devices=out["degraded_devices"],
+            admission_cap_full=out["admission_cap_full"],
+            admission_cap_degraded=out["admission_cap_degraded"],
+        )
     elif which == "r2d2":
         rate, cpu = bench_r2d2()
         _emit("r2d2_l7_verdicts_per_sec_per_chip", rate, "verdicts/s",
@@ -3442,6 +3709,7 @@ CONFIGS = (
     "flow_observe_overhead", "policy_churn",
     "multichip_scaling", "rules_100k",
     "restart_blackout",
+    "mesh_degraded",
     "r2d2",
 )
 
@@ -3572,7 +3840,8 @@ def _check_regressions(lines: list[str],
                       "churn_swap_p99_ms",
                       "churn_served_p99_ms_delta",
                       "rules_100k_sharded_p99_ms",
-                      "restart_blackout_p99_ms"}
+                      "restart_blackout_p99_ms",
+                      "mesh_reshape_window_ms"}
     rc = 0
     seen: set = set()
     for line in lines:

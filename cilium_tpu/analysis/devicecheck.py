@@ -201,8 +201,11 @@ def _check_model(name, path, model):
 
 # Mesh aspect ratios the R10 gate traces every sharded step under —
 # both axes exercised alone and together so a spec that only works
-# when an axis is trivial cannot pass.
-_SHARD_MESHES = ((1, 1), (1, 2), (2, 1), (2, 2))
+# when an axis is trivial cannot pass.  The 4-wide rows cover the
+# flow widths the reshape ladder lands on (4 -> 2 -> 1) and the
+# >2-wide extents ROADMAP 5b's uncapped flow sharding serves; rows
+# the local device count cannot fill are skipped as before.
+_SHARD_MESHES = ((1, 1), (1, 2), (2, 1), (2, 2), (4, 1), (4, 2))
 
 _SHARD_PATH = "cilium_tpu/parallel/rulesharding.py"
 
@@ -245,7 +248,7 @@ def _step_jaxpr_findings(name: str, jx, fail) -> None:
 
 def _check_sharded():
     """R10: every sharded step in ``parallel/rulesharding.py`` traces
-    under 1x1, 1x2, 2x1 AND 2x2 (flows, rules) CPU meshes — shard_map
+    under every ``_SHARD_MESHES`` (flows, rules) CPU mesh — shard_map
     validates in_specs/out_specs against the step functions' actual
     arity and rank at trace time, so a drifted spec fails HERE instead
     of at first trace on a real multi-chip mesh.  On top of the trace:
@@ -380,6 +383,134 @@ def _check_sharded():
     return findings
 
 
+def check_reshape_ladder(build=None) -> list[Finding]:
+    """R10 reshape half: every DEGRADED rung the width ladder can land
+    on (lose a chip, reshape over the survivors — flow extent 4 -> 2
+    -> 1 at a preserved-or-halved rule extent) assembles through
+    ``mesh_model_from_family_rows`` and traces with the SAME structure
+    as full width: stacked-leaf shard arity against the rung's
+    RULE_AXIS, a retained single-chip fallback twin (the next
+    demotion's landing rung), repeat-trace jaxpr determinism, no
+    host-transfer primitives, and a width-INDEPENDENT primitive set —
+    a reshape may change shapes, never the stepped computation.
+    ``build`` is the assembly seam under audit, injectable so the
+    sensitivity unit can pin that a broken reshape model fails here.
+    Rungs the local device count cannot fill are skipped; a single
+    device has no mesh rungs at all (empty findings)."""
+    import jax
+
+    from ..parallel import rulesharding
+    from ..parallel.mesh import (
+        FLOW_AXIS,
+        RULE_AXIS,
+        flow_mesh,
+        reshape_mesh,
+    )
+    from ..proxylib.parsers.dns import DnsRule
+
+    if build is None:
+        build = rulesharding.mesh_model_from_family_rows
+
+    findings: list[Finding] = []
+
+    def fail(msg):
+        findings.append(Finding("R10", _SHARD_PATH, 0, 0, msg))
+
+    family_rows = {
+        "r2d2": [
+            (frozenset(), "OPEN", "/etc/.*"),
+            (frozenset({3}), "", "docs/[a-z]+"),
+            (frozenset({7}), "READ", "/pub/.*"),
+        ],
+        "dns": [
+            (frozenset(), DnsRule(name="www.example.com")),
+            (frozenset({3}), DnsRule(pattern="*.example.com")),
+        ],
+    }
+    devices = list(jax.devices())
+    # Full-width origin: the widest layout the local devices fill
+    # (8 CPU devices -> 4x2, 4 -> 2x2, 2 -> 2x1); rule extent 2 when
+    # possible so the rule-preserving half of reshape_mesh is on the
+    # audited path.
+    n_rule = 2 if len(devices) >= 4 else 1
+    n_flow = len(devices) // n_rule
+    if n_flow < 1 or n_flow * n_rule < 2:
+        return findings
+    n_flow = min(1 << (n_flow.bit_length() - 1), 4)
+    full = flow_mesh(n_flow=n_flow, n_rule=n_rule,
+                     devices=devices[: n_flow * n_rule])
+    # Walk the ladder: drop the tail chip one at a time and reshape
+    # over what remains, collecting each DISTINCT rung width.
+    rungs = [("full", full)]
+    seen = {(n_flow, n_rule)}
+    survivors = devices[: n_flow * n_rule]
+    while len(survivors) > 1:
+        survivors = survivors[:-1]
+        rung = reshape_mesh(survivors, n_rule,
+                            max_flow=full.shape[FLOW_AXIS])
+        if rung is None:
+            break
+        key = (rung.shape[FLOW_AXIS], rung.shape[RULE_AXIS])
+        if key in seen:
+            continue
+        seen.add(key)
+        rungs.append((f"{key[0]}x{key[1]}", rung))
+    args = _abstract_args()
+    prim_sets: dict[str, dict] = {}
+    for rung_name, mesh in rungs:
+        for family, rows in family_rows.items():
+            tag = f"reshape:{family}@{rung_name}"
+            try:
+                model = build(family, rows, mesh)
+            except Exception as e:  # noqa: BLE001
+                fail(f"[device-contract:{tag}] reshaped assembly "
+                     f"raised: {e!r}")
+                continue
+            if not isinstance(model, rulesharding.ShardedVerdictModel):
+                fail(f"[device-contract:{tag}] assembly folded to "
+                     f"{type(model).__name__} — these rows must build "
+                     f"a mesh-resident model at every rung")
+                continue
+            for prob in check_stacked_model(model.stacked, mesh):
+                fail(f"[device-contract:{tag}] {prob}")
+            if model.n_shards != mesh.shape[RULE_AXIS]:
+                fail(f"[device-contract:{tag}] shard_offsets arity "
+                     f"{model.n_shards} != rung RULE_AXIS extent "
+                     f"{mesh.shape[RULE_AXIS]} (stale full-width "
+                     f"offsets would mis-attribute global rule rows)")
+            if model.fallback is None:
+                fail(f"[device-contract:{tag}] reshaped model carries "
+                     f"no single-chip fallback twin — the NEXT device "
+                     f"loss on this rung would have nothing to demote "
+                     f"to")
+            try:
+                jx1 = jax.make_jaxpr(model.verdicts_attr)(*args)
+                jx2 = jax.make_jaxpr(model.verdicts_attr)(*args)
+            except Exception as e:  # noqa: BLE001
+                fail(f"[device-contract:{tag}] failed to trace the "
+                     f"reshaped attributed step: {e!r}")
+                continue
+            if str(jx1) != str(jx2):
+                fail(f"[device-contract:{tag}] two traces produced "
+                     f"DIFFERENT jaxprs — a nondeterministic reshape "
+                     f"rebuild recompiles per fault in production")
+            _step_jaxpr_findings(tag, jx1, fail)
+            prims = frozenset(
+                eqn.primitive.name for eqn in _iter_eqns(jx1.jaxpr)
+            )
+            prev = prim_sets.setdefault(family, {})
+            for other, oprims in prev.items():
+                if prims != oprims:
+                    fail(f"[device-contract:reshape:{family}] "
+                         f"primitive set differs between rungs "
+                         f"{other} and {rung_name}: "
+                         f"{sorted(prims ^ oprims)} — a degraded "
+                         f"width must change shapes, not the stepped "
+                         f"computation")
+            prev[rung_name] = prims
+    return findings
+
+
 def check_device_contracts() -> list[Finding]:
     """Run every abstract device-contract check; returns findings
     (empty = all contracts hold).  Safe without a TPU: everything runs
@@ -414,6 +545,7 @@ def check_device_contracts() -> list[Finding]:
     for name, path, model in _model_cases():
         findings.extend(_check_model(name, path, model))
     findings.extend(_check_sharded())
+    findings.extend(check_reshape_ladder())
     findings.extend(check_shape_closure())
     return findings
 

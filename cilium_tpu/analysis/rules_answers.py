@@ -71,6 +71,26 @@ HANDOFF_TERMINALS = {
 _GUARD_SUBSTRINGS = ("answered", "suppressed", "deposed", "is_shed",
                      "_shed_rounds", "drain_lock")
 
+# Control-plane job queues: a ``.put`` on these receivers enqueues
+# BUILDER work (epoch swaps, rebinds, mesh reshape/reprobe jobs, grant
+# pushes), never an admitted entry — it is not an entry hand-off, so
+# it neither discharges an admit root's accountability (R14.1) nor
+# makes its caller an answer site (R14.2).  Without this, the mesh
+# demote path (dispatch -> _mesh_guarded -> _demote_mesh -> reshape
+# job enqueue) would turn every model call into a phantom answer site.
+_CONTROL_QUEUE_RECEIVERS = ("_build_queue",)
+
+
+def _is_control_queue_call(call: ast.Call) -> bool:
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute)
+            and fn.attr in ("put", "put_nowait")):
+        return False
+    recv = fn.value
+    return isinstance(recv, ast.Attribute) and (
+        recv.attr in _CONTROL_QUEUE_RECEIVERS
+    )
+
 _ADMIT_EXACT = {"_shm_doorbell", "_shm_submit_records"}
 
 
@@ -148,7 +168,9 @@ class _AnswerState:
             direct = None
             for call, line, _c, _held, _keys in fi.calls:
                 name = call_func_name(call)
-                if name in ANSWER_TERMINALS or name in HANDOFF_TERMINALS:
+                if (name in ANSWER_TERMINALS
+                        or name in HANDOFF_TERMINALS) and not (
+                            _is_control_queue_call(call)):
                     direct = (name,)
                     break
             self.answers[fi.key] = direct is not None
@@ -193,7 +215,7 @@ class _AnswerState:
     def is_answer_event(self, call: ast.Call) -> bool:
         name = call_func_name(call)
         if name in ANSWER_TERMINALS or name in HANDOFF_TERMINALS:
-            return True
+            return not _is_control_queue_call(call)
         return any(
             self.answers.get(k) for k in self.call_keys.get(id(call), ())
         )

@@ -496,13 +496,35 @@ def cmd_sidecar_status(args):
             f"{k}={v}"
             for k, v in sorted((mesh.get("demotions") or {}).items())
         )
+        rung = mesh.get("rung") or (
+            "full" if mesh.get("active") else "fallback"
+        )
+        lost = mesh.get("lost_devices") or []
+        rfails = " ".join(
+            f"{k}={v}"
+            for k, v in sorted(
+                (mesh.get("reshape_failures") or {}).items()
+            )
+        )
         print(f"mesh: devices={mesh.get('devices', 0)} "
               f"(flows={mesh.get('flow_shards', 0)}, "
               f"rules={mesh.get('rule_shards', 0)}) "
-              f"{'ACTIVE' if mesh.get('active') else 'DEMOTED'}"
+              f"{'ACTIVE' if mesh.get('active') else 'DEMOTED'} "
+              f"rung={rung}"
+              + (f" serving={mesh.get('serving_devices')}"
+                 f"/{mesh.get('devices', 0)} "
+                 f"capacity={mesh.get('capacity_frac', 1.0):.2f}"
+                 if rung != "full" else "")
+              + (f" lost={','.join(str(x) for x in lost)}"
+                 if lost else "")
               + (f" reason={mesh.get('demoted')}" if mesh.get("demoted")
                  else "")
               + (f" demotions: {dem}" if dem else "")
+              + (f" reshapes={mesh.get('reshapes', 0)}"
+                 if mesh.get("reshapes") else "")
+              + (f" reshape_window={mesh.get('reshape_window_ms', 0):.0f}ms"
+                 if mesh.get("reshape_window_ms") else "")
+              + (f" reshape_failures: {rfails}" if rfails else "")
               + (f" repromotions={mesh.get('repromotions', 0)}"
                  if mesh.get("repromotions") else "")
               + (f" rebind_rebuilds={mesh.get('rebind_rebuilds', 0)}"

@@ -8,6 +8,17 @@ The TPU-native equivalent scales the verdict plane by sharding the *flow*
 which point the state axis shards too.
 """
 
-from .mesh import FLOW_AXIS, RULE_AXIS, flow_mesh, flow_sharding, replicated
+from .mesh import (
+    FLOW_AXIS,
+    RULE_AXIS,
+    flow_mesh,
+    flow_sharding,
+    mesh_extents,
+    replicated,
+    reshape_mesh,
+)
 
-__all__ = ["FLOW_AXIS", "RULE_AXIS", "flow_mesh", "flow_sharding", "replicated"]
+__all__ = [
+    "FLOW_AXIS", "RULE_AXIS", "flow_mesh", "flow_sharding",
+    "mesh_extents", "replicated", "reshape_mesh",
+]

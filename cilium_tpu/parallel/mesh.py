@@ -27,6 +27,30 @@ def flow_mesh(n_flow: int | None = None, n_rule: int = 1, devices=None) -> Mesh:
     return Mesh(devs, (FLOW_AXIS, RULE_AXIS))
 
 
+def mesh_extents(mode: str, rule_shards: int = 0, flow_shards: int = 0,
+                 n_devices: int = 0,
+                 max_flow: int = 32) -> tuple[int, int] | None:
+    """Pure extent resolution for ``serving_mesh`` — (n_flow, n_rule)
+    or None — split out so >32-wide layouts are unit-testable without
+    64 real devices.  The flow extent is floored to a power of two
+    (every power-of-two dispatch bucket then divides it).  The
+    ``max_flow`` cap applies only to the AUTO derivation (flow_shards
+    == 0): an EXPLICIT ``mesh_flow_shards`` may exceed the smallest
+    dispatch bucket — the service grows its minimum bucket to the flow
+    extent instead (ROADMAP 5b), so >32-device pods shard the flow
+    axis fully."""
+    if mode == "off":
+        return None
+    n_rule = max(rule_shards, 1)
+    n_flow = flow_shards or max(n_devices // n_rule, 1)
+    n_flow = 1 << (n_flow.bit_length() - 1)
+    if not flow_shards:
+        n_flow = min(n_flow, max_flow)
+    if n_flow * n_rule > n_devices:
+        return None
+    return n_flow, n_rule
+
+
 def serving_mesh(mode: str, rule_shards: int = 0, flow_shards: int = 0,
                  devices=None, max_flow: int = 32) -> Mesh | None:
     """Resolve a (flows, rules) SERVING mesh from the DaemonConfig
@@ -35,9 +59,9 @@ def serving_mesh(mode: str, rule_shards: int = 0, flow_shards: int = 0,
     the sidecar service and the daemon-side engine factory.  'auto'
     requires more than one REAL accelerator device (virtual CPU
     devices share the host's cores — a collective there only adds
-    overhead); 'on' forces a mesh at any device count.  The flow
-    extent is floored to a power of two (every power-of-two dispatch
-    bucket then divides it) and capped at ``max_flow``."""
+    overhead); 'on' forces a mesh at any device count.  Extent rules
+    (pow2 flooring, the auto-only ``max_flow`` cap) live in
+    ``mesh_extents``."""
     if mode == "off":
         return None
     if devices is None:
@@ -46,12 +70,39 @@ def serving_mesh(mode: str, rule_shards: int = 0, flow_shards: int = 0,
         len(devices) < 2 or devices[0].platform == "cpu"
     ):
         return None
-    n_rule = max(rule_shards, 1)
-    n_flow = flow_shards or max(len(devices) // n_rule, 1)
-    n_flow = min(1 << (n_flow.bit_length() - 1), max_flow)
-    if n_flow * n_rule > len(devices):
+    ext = mesh_extents(mode, rule_shards, flow_shards, len(devices),
+                       max_flow=max_flow)
+    if ext is None:
         return None
+    n_flow, n_rule = ext
     return flow_mesh(n_flow=n_flow, n_rule=n_rule, devices=devices)
+
+
+def reshape_mesh(survivors, rule_shards: int = 1,
+                 max_flow: int = 32) -> Mesh | None:
+    """Width-ladder rung: the widest bucketable (flows, rules) mesh
+    over a SURVIVING device subset after a partial loss.  The rule
+    extent is preserved when the survivors can still fill it (rule
+    sharding exists for HBM capacity — halving it doubles per-device
+    table memory) and halved only when they cannot; the flow extent is
+    the power-of-two floor of what remains, capped at ``max_flow`` so
+    every dispatch bucket still divides it.  None when fewer than two
+    devices survive in a usable layout — the service then holds the
+    single-chip fallback rung instead."""
+    survivors = list(survivors)
+    n = len(survivors)
+    n_rule = max(rule_shards, 1)
+    while n_rule > 1 and n_rule > n:
+        n_rule = max(n_rule // 2, 1)
+    n_flow = n // n_rule
+    if n_flow < 1:
+        return None
+    n_flow = 1 << (n_flow.bit_length() - 1)
+    if max_flow:
+        n_flow = min(n_flow, max_flow)
+    if n_flow * n_rule < 2:
+        return None
+    return flow_mesh(n_flow=n_flow, n_rule=n_rule, devices=survivors)
 
 
 def flow_sharding(mesh: Mesh) -> NamedSharding:

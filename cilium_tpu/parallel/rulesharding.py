@@ -770,6 +770,40 @@ def mesh_http_model(policy, ingress: bool, port: int, mesh):
     return mesh_http_model_from_rows(rows, mesh)
 
 
+def mesh_model_from_family_rows(family: str, rows: list, mesh):
+    """Build a ShardedVerdictModel for ``family`` ("r2d2" | "dns" |
+    "http") from already-flattened rule rows against an ARBITRARY mesh
+    — the width-ladder's one assembly seam: the service's off-path
+    reshape (and its parity probe) and the devicecheck reshape audit
+    both rebuild through here, so a degraded-width rebuild can never
+    drift from the full-width construction (same ``split_balanced``
+    re-balance, same re-derived ``shard_offsets``, same pow2 rule
+    buckets so the shape-keyed executable cache still hits)."""
+    n_shards = mesh.shape[RULE_AXIS]
+    if family == "r2d2":
+        fallback = build_r2d2_model_from_rows(rows, bucket=True)
+        stacked = build_sharded_r2d2_from_rows(rows, n_shards,
+                                               bucket=True)
+    elif family == "dns":
+        fallback = build_dns_model_from_rows(rows, bucket=True)
+        stacked = build_sharded_dns_from_rows(rows, n_shards,
+                                              bucket=True)
+    elif family == "http":
+        fallback = build_http_model(rows)
+        if isinstance(fallback, ConstVerdict):
+            return fallback
+        stacked = build_sharded_http_model(rows, n_shards)
+    else:
+        raise ValueError(f"unknown sharded family {family!r}")
+    if isinstance(fallback, ConstVerdict):
+        return fallback
+    return ShardedVerdictModel(
+        stacked, shard_offsets(len(rows), n_shards), mesh, family,
+        fallback=fallback,
+        match_kinds=getattr(fallback, "match_kinds", ()),
+    )
+
+
 def mesh_kafka_model(rules_with_remotes: list, mesh):
     """Mesh-resident kafka topic-ACL model from (remote_set, rule)
     rows."""

@@ -257,6 +257,15 @@ class BatchDispatcher:
     def pending_weight(self) -> int:
         return self._pending_weight
 
+    def scale_admission(self, max_pending: int) -> None:
+        """Re-point the global admission cap (the mesh width ladder's
+        capacity coupling: a degraded rung shrinks the queue so
+        overload sheds typed at the rung's ACTUAL capacity instead of
+        queueing into deadline sheds).  Taken under the lock so an
+        in-flight submit never reads a torn cap."""
+        with self._cond:
+            self.max_pending = int(max_pending)
+
     def oldest_age_s(self) -> float:
         """Age of the oldest queued item (0 when idle)."""
         with self._cond:

@@ -88,6 +88,15 @@ class DeviceGuard:
         self._probe_inflight = False
         self._last_probe = 0.0
         self._quarantined_at = 0.0
+        # Per-device health table (the mesh width ladder's fault
+        # attribution surface): one row per mesh device that ever
+        # raised, stalled, or vanished from the backend's device set —
+        # keyed by the stringified device id (JSON-safe for the
+        # restart handoff).  "lost" rows are the devices the off-path
+        # reshape holds out of the serving mesh; fault counters are
+        # lifetime (a healed device keeps its history so a flapping
+        # chip is visible to the operator).
+        self._devices: dict[str, dict] = {}
         # Cumulative seconds spent quarantined (closed intervals; the
         # live interval is added in status()) — the "how long were we
         # on the host fallback" device-telemetry number.
@@ -195,6 +204,54 @@ class DeviceGuard:
             except Exception:  # noqa: BLE001
                 log.exception("quarantine on_change hook failed")
 
+    # -- per-device health (mesh width ladder) ----------------------------
+
+    def record_device_fault(self, device, reason: str) -> None:
+        """Attribute one mesh fault (readback error, stall, vanish) to
+        a SPECIFIC device: the row flips to "lost" and the typed fault
+        counter bumps.  The reshape/re-promotion ladder reads the lost
+        set; the operator reads the lifetime counters."""
+        key = str(device)
+        with self._lock:
+            row = self._devices.setdefault(
+                key, {"state": "ok", "faults": {}, "heals": 0}
+            )
+            row["state"] = "lost"
+            row["faults"][reason] = row["faults"].get(reason, 0) + 1
+        log.warning("mesh device %s marked lost: %s", key, reason)
+
+    def mark_device_ok(self, device) -> None:
+        """A previously-lost device answered its probe: the row heals
+        (state "ok", heal counter bumps) — fault history is kept."""
+        key = str(device)
+        with self._lock:
+            row = self._devices.get(key)
+            if row is None or row["state"] == "ok":
+                return
+            row["state"] = "ok"
+            row["heals"] = row.get("heals", 0) + 1
+        log.warning("mesh device %s healed (probe succeeded)", key)
+
+    def lost_devices(self) -> list[str]:
+        with self._lock:
+            return sorted(
+                k for k, r in self._devices.items()
+                if r["state"] == "lost"
+            )
+
+    def device_table(self) -> dict:
+        """JSON-safe copy of the health table (status surface + the
+        restart handoff snapshot)."""
+        with self._lock:
+            return {
+                k: {
+                    "state": r["state"],
+                    "faults": dict(r["faults"]),
+                    "heals": int(r.get("heals", 0)),
+                }
+                for k, r in sorted(self._devices.items())
+            }
+
     # -- re-probe ---------------------------------------------------------
 
     def maybe_reprobe(self, probe_fn) -> None:
@@ -252,6 +309,14 @@ class DeviceGuard:
                 "quarantine_events": self.quarantine_events,
                 "probes": self.probes,
                 "quarantined_total_s": self._quarantined_total_s,
+                "devices": {
+                    k: {
+                        "state": r["state"],
+                        "faults": dict(r["faults"]),
+                        "heals": int(r.get("heals", 0)),
+                    }
+                    for k, r in self._devices.items()
+                },
             }
 
     def restore_state(self, snap: dict) -> None:
@@ -277,11 +342,35 @@ class DeviceGuard:
             total_s = float(snap.get("quarantined_total_s", 0.0))
         except (KeyError, TypeError, ValueError):
             return
+        # Versioned-in per-device health table (.get: absent in
+        # pre-PR-17 snapshots).  Rows are type-checked individually —
+        # a malformed row is dropped, never half-restored (a wrongly
+        # "lost" device would keep a healthy chip out of the mesh).
+        devices: dict = {}
+        for k, r in (snap.get("devices") or {}).items():
+            if not isinstance(r, dict):
+                continue
+            state = r.get("state")
+            if state not in ("ok", "lost"):
+                continue
+            try:
+                faults = {
+                    str(fk): int(fv)
+                    for fk, fv in (r.get("faults") or {}).items()
+                }
+                heals = int(r.get("heals", 0))
+            except (TypeError, ValueError):
+                continue
+            devices[str(k)] = {
+                "state": state, "faults": faults, "heals": heals,
+            }
         with self._lock:
             self.stalls = stalls
             self.quarantine_events = events
             self.probes = probes
             self._quarantined_total_s = total_s
+            if devices:
+                self._devices = devices
             if quarantined and not self.quarantined:
                 self.quarantined = True
                 self.reason = reason or "restored"
@@ -307,4 +396,13 @@ class DeviceGuard:
                 out["quarantined_for_s"] = round(
                     time.monotonic() - self._quarantined_at, 3
                 )
+            if self._devices:
+                out["devices"] = {
+                    k: {
+                        "state": r["state"],
+                        "faults": dict(r["faults"]),
+                        "heals": int(r.get("heals", 0)),
+                    }
+                    for k, r in sorted(self._devices.items())
+                }
             return out

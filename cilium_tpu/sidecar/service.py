@@ -37,11 +37,13 @@ import json
 import logging
 import os
 import queue
+import re
 import socket
 import struct
 import threading
 import time
 from collections import deque as _deque
+from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as _FuturesTimeout
 
 import numpy as np
@@ -445,17 +447,47 @@ class VerdictService:
         # Multi-chip sharded serving (parallel/rulesharding.py): the
         # (flows, rules) mesh resolves lazily at the FIRST engine
         # build (a service that never dispatches must not initialize a
-        # backend) and is guarded by _mesh_lock.  A lost/erroring mesh
-        # device demotes every sharded engine to its single-chip
-        # fallback executable in one pointer pass — typed, counted,
-        # status-surfaced, sticky until restart (the guard's
-        # quarantine/heal ladder keeps owning single-device health on
-        # the rung below).
+        # backend) and is guarded by _mesh_lock.  A faulting mesh rung
+        # walks a WIDTH LADDER instead of collapsing binary: full mesh
+        # -> reshaped mesh over the surviving devices -> single-chip
+        # fallback -> quarantine/host-oracle, every transition typed
+        # (the guard's quarantine/heal ladder keeps owning
+        # single-device health on the rung below).
         self._mesh = None
         self._mesh_resolved = False
         self._mesh_lock = threading.Lock()
         self._mesh_demoted: str | None = None
         self.mesh_demotions: dict[str, int] = {}
+        # Width-ladder rung state.  The rung is DERIVED, never stored:
+        # full = (_mesh_demoted None, _mesh_serving None); reshaped =
+        # (None, Mesh over survivors); fallback = (reason, *).
+        # _mesh_serving is the degraded mesh the engines currently
+        # dispatch on; _mesh_lost is the attributed dead device-id set
+        # (mirrors the DeviceGuard per-device health table).
+        self._mesh_serving = None
+        self._mesh_lost: set[int] = set()
+        self.mesh_reshapes = 0
+        self.mesh_reshape_failures: dict[str, int] = {}
+        # Fallback-width window of the LAST completed reshape (fault
+        # stamp -> reshaped flip), the bench drift-guard metric.
+        self.mesh_reshape_window_ms = 0.0
+        self._mesh_fault_at = 0.0
+        # Capacity fraction of the current rung (1.0 full, width ratio
+        # reshaped, 1/width fallback) — scales the admission queue cap
+        # and the DRR credit windows so a degraded mesh sheds typed at
+        # its actual capacity.
+        self._mesh_capacity = 1.0
+        # Test seam: per-device probe callable (device -> bool).  None
+        # uses the real put+readback probe.
+        self._device_probe_fn = None
+        # Mesh ladder state staged by restore_handoff, consumed at
+        # _resolve_mesh (a successor resumes reshaped instead of
+        # re-probing a known-dead chip).
+        self._handoff_mesh: dict | None = None
+        # ROADMAP 5b: an explicit flow extent wider than the smallest
+        # dispatch bucket grows the minimum bucket to match (set at
+        # _resolve_mesh, read by the _min_bucket property).
+        self._mesh_min_bucket = 0
         # Guarded re-promotion (ROADMAP 1b): demotion is no longer
         # sticky-until-restart — a timed re-probe (mirroring the
         # DeviceGuard quarantine heal, but on the policy-builder
@@ -830,6 +862,13 @@ class VerdictService:
                 for p, i, pt, pr in sorted(rules)
             ],
             "guard": self.guard.snapshot_state(),
+            # Mesh width-ladder rung: the successor resumes RESHAPED
+            # around the known-dead chips instead of re-probing them
+            # through a fault (consumed at _resolve_mesh).
+            "mesh": {
+                "lost": sorted(int(x) for x in self._mesh_lost),
+                "reshapes": int(self.mesh_reshapes),
+            },
         }
 
     def restore_handoff(self, snap: dict) -> bool:
@@ -881,6 +920,20 @@ class VerdictService:
         }
         self._handoff_rules = list(snap.get("rules") or [])
         self.guard.restore_state(snap.get("guard") or {})
+        # Versioned-in mesh ladder state (.get: absent in pre-PR-17
+        # snapshots — cold mesh resolution is always correct).  Staged
+        # only; consumed when the mesh actually resolves.
+        mesh_row = snap.get("mesh")
+        if isinstance(mesh_row, dict):
+            try:
+                self._handoff_mesh = {
+                    "lost": sorted(
+                        {int(x) for x in mesh_row.get("lost") or ()}
+                    ),
+                    "reshapes": int(mesh_row.get("reshapes") or 0),
+                }
+            except (TypeError, ValueError):
+                self._handoff_mesh = None
         # Executable-cache adoption (same-process successor only): the
         # restored rule sources rebuild into the SAME shape signatures,
         # so the deposited prewarm ledger makes churn rebuilds skip
@@ -1233,7 +1286,13 @@ class VerdictService:
                     # _send_cache_grants makes late delivery safe).
                     self._send_cache_grants(job)
                 elif kind == "mesh_reprobe":
-                    self._run_mesh_reprobe()
+                    self._run_mesh_ladder(immediate=False)
+                elif kind == "mesh_reshape":
+                    # Queued by _demote_mesh right at the fault: walk
+                    # DOWN the width ladder around the attributed dead
+                    # devices (never up — promotion is owned by the
+                    # paced re-probe above).
+                    self._run_mesh_ladder(immediate=True)
                 elif kind == "mesh_rebuild":
                     self._run_mesh_rebuild(*job)
             except Exception:  # noqa: BLE001 — builder must survive
@@ -2461,8 +2520,16 @@ class VerdictService:
                     1 for x in self._sessions.values()
                     if x.named or x.submitted
                 )
+            # The numerator is the mesh rung's ACTUAL capacity (PR 15
+            # queue split x the ladder's capacity fraction): a
+            # half-width mesh halves every session's credit window so
+            # degraded overload sheds typed at admission instead of
+            # queueing into deadline-shed p99 explosions.
+            entries = int(
+                self.config.shed_queue_entries * self._mesh_capacity
+            )
             self._share_val = max(
-                self.config.shed_queue_entries // max(n_sessions + 1, 2),
+                entries // max(n_sessions + 1, 2),
                 self.config.session_share_min,
             )
             self._share_ts = now
@@ -3715,7 +3782,14 @@ class VerdictService:
 
     @property
     def _min_bucket(self) -> int:
-        return self.MIN_BUCKET_GREEDY if self._inline_complete else self.MIN_BUCKET
+        # ROADMAP 5b: a mesh flow extent wider than the base floor
+        # grows the minimum bucket to match (set at _resolve_mesh), so
+        # every padded batch still divides across a >32-wide mesh.
+        base = (
+            self.MIN_BUCKET_GREEDY if self._inline_complete
+            else self.MIN_BUCKET
+        )
+        return max(base, self._mesh_min_bucket)
 
     def _buckets(self) -> list[int]:
         out = [self._min_bucket]
@@ -3823,6 +3897,22 @@ class VerdictService:
                         "rules=%d)", mesh.size,
                         mesh.shape[FLOW_AXIS], mesh.shape[RULE_AXIS],
                     )
+                    # ROADMAP 5b: an EXPLICIT flow extent beyond the
+                    # smallest dispatch bucket grows the minimum
+                    # bucket to the extent, so >32-device pods shard
+                    # the flow axis fully and every padded batch
+                    # still divides across the mesh.
+                    base = (
+                        self.MIN_BUCKET_GREEDY if self._inline_complete
+                        else self.MIN_BUCKET
+                    )
+                    fl = mesh.shape[FLOW_AXIS]
+                    if fl > base:
+                        self._mesh_min_bucket = fl
+                        log.info(
+                            "mesh flow extent %d grows the minimum "
+                            "dispatch bucket (%d -> %d)", fl, base, fl,
+                        )
                 elif self.config.mesh == "on":
                     log.warning(
                         "mesh=on but no (flows=%s, rules=%s) mesh "
@@ -3833,14 +3923,74 @@ class VerdictService:
                     )
             self._mesh = mesh
             self._mesh_resolved = True
-            metrics.MeshActive.set(1.0 if mesh is not None else 0.0)
+            if mesh is not None and self._handoff_mesh:
+                self._adopt_handoff_mesh(mesh)
+            self._handoff_mesh = None
+            metrics.MeshActive.set(
+                1.0 if mesh is not None and self._mesh_demoted is None
+                else 0.0
+            )
+            self._publish_mesh_capacity()
         return mesh
 
+    def _adopt_handoff_mesh(self, mesh) -> None:
+        """Resume the predecessor's ladder rung (under _mesh_lock, at
+        resolution): its attributed dead devices that still exist in
+        OUR mesh are marked lost up front, and serving starts directly
+        on the reshaped rung — a successor never re-probes a
+        known-dead chip through a fault.  Device ids that no longer
+        resolve are dropped (the backend was re-enumerated; the paced
+        re-probe re-adjudicates)."""
+        from ..parallel.mesh import FLOW_AXIS, RULE_AXIS, reshape_mesh
+
+        ho = self._handoff_mesh or {}
+        mesh_ids = {d.id for d in mesh.devices.flat}
+        lost = {int(x) for x in ho.get("lost") or ()} & mesh_ids
+        self.mesh_reshapes = int(ho.get("reshapes") or 0)
+        if not lost:
+            return
+        self._mesh_lost = set(lost)
+        already = set(self.guard.lost_devices())
+        for dev_id in sorted(lost):
+            if str(dev_id) not in already:
+                self.guard.record_device_fault(dev_id, "handoff")
+        metrics.MeshLostDevices.set(float(len(lost)))
+        survivors = [d for d in mesh.devices.flat if d.id not in lost]
+        target = None
+        if self.config.mesh_reshape:
+            with self._device_ctx():
+                target = reshape_mesh(
+                    survivors, mesh.shape[RULE_AXIS],
+                    max_flow=mesh.shape[FLOW_AXIS],
+                )
+        if target is not None:
+            self._mesh_serving = target
+            log.warning(
+                "mesh resumes RESHAPED from handoff: %d device(s) "
+                "lost %s, serving (flows=%d, rules=%d)", len(lost),
+                sorted(lost), target.shape[FLOW_AXIS],
+                target.shape[RULE_AXIS],
+            )
+        else:
+            self._mesh_demoted = "handoff-degraded"
+            self.mesh_demotions["handoff-degraded"] = (
+                self.mesh_demotions.get("handoff-degraded", 0) + 1
+            )
+            metrics.MeshDemotions.inc("handoff-degraded")
+            log.warning(
+                "mesh handoff carried %d lost device(s) and no "
+                "reshaped width fits: serving single-chip", len(lost),
+            )
+
     def _serving_mesh(self):
-        """Mesh for NEW engine builds: None once demoted — every model
-        compiled after the demotion is single-chip."""
+        """Mesh for NEW engine builds: the current rung's mesh — the
+        reshaped survivor mesh while degraded, None once demoted to
+        the fallback rung (every model compiled there is
+        single-chip)."""
         mesh = self._resolve_mesh()
-        return None if self._mesh_demoted is not None else mesh
+        if self._mesh_demoted is not None:
+            return None
+        return self._mesh_serving or mesh
 
     def _live_model(self, model):
         """Mesh-rung resolution for one dispatch: a demoted service
@@ -3851,61 +4001,200 @@ class VerdictService:
             return fb
         return model
 
-    def _demote_mesh(self, reason: str) -> None:
+    # Device-id attribution over a fault's text: backend runtimes name
+    # the failing chip ("TPU_3", "device 2", "cpu:1") in transfer and
+    # collective errors; the match is intersected with the mesh's own
+    # id set so a stray number never marks a device.
+    _DEV_ID_RE = re.compile(
+        r"(?:cpu|tpu|gpu|device)[ _:]{0,2}(\d+)", re.IGNORECASE
+    )
+
+    def _attribute_fault_devices(self, exc) -> set:
+        """Which mesh devices did this fault name?  Three sources, all
+        intersected with the full mesh's device ids: an explicit
+        ``failed_devices`` attribute on the exception, device ids
+        parsed from the message text, and devices that VANISHED from
+        the backend's device set (unplugged chip).  Empty when the
+        fault is not attributable to a chip — the demotion then holds
+        for the paced re-probe to adjudicate."""
+        mesh = self._mesh
+        if mesh is None:
+            return set()
+        ids: set = set()
+        if exc is not None:
+            for d in getattr(exc, "failed_devices", ()) or ():
+                try:
+                    ids.add(int(getattr(d, "id", d)))
+                except (TypeError, ValueError):
+                    continue
+            for m in self._DEV_ID_RE.finditer(str(exc)):
+                ids.add(int(m.group(1)))
+        mesh_ids = {d.id for d in mesh.devices.flat}
+        try:
+            import jax
+
+            live = {d.id for d in jax.devices()}
+            ids |= mesh_ids - live
+        except Exception:  # noqa: BLE001 — a dead backend attributes nothing
+            pass
+        return ids & mesh_ids
+
+    def _probe_mesh_device(self, dev) -> bool:
+        """One tiny put+readback against a single device: True when it
+        answers.  Runs off-path (builder thread / probe pool) only."""
+        import jax
+
+        arr = jax.device_put(np.arange(8, dtype=np.int32), dev)
+        return int(np.asarray(arr).sum()) == 28
+
+    def _probe_mesh_devices(self, devices) -> set:
+        """Probe every full-mesh device in a disposable bounded pool
+        (a HUNG device must cost one timeout, not wedge the builder
+        thread serially per chip) and return the dead id set; each
+        failure is recorded in the guard's per-device health table.
+        ``_device_probe_fn`` is the test seam."""
+        dead: set = set()
+        probe = self._device_probe_fn or self._probe_mesh_device
+        timeout = self.guard.timeout_s or 5.0
+        ex = ThreadPoolExecutor(
+            max_workers=min(max(len(devices), 1), 8),
+            thread_name_prefix="mesh-probe",
+        )
+        try:
+            futs = [(ex.submit(probe, d), d) for d in devices]
+            for fut, dev in futs:
+                try:
+                    ok = bool(fut.result(timeout))
+                except Exception:  # noqa: BLE001 — raise/timeout == dead
+                    ok = False
+                if not ok:
+                    dead.add(dev.id)
+                    self.guard.record_device_fault(
+                        dev.id, "probe-failed"
+                    )
+        finally:
+            ex.shutdown(wait=False)
+        return dead
+
+    def _publish_mesh_capacity(self) -> None:
+        """Publish the current rung's capacity fraction and scale
+        admission by it: the dispatcher's global queue cap and the DRR
+        credit numerator (_drr_share) both shrink to the degraded
+        width, so a half-width mesh sheds typed at its ACTUAL capacity
+        instead of queueing into deadline-shed p99 explosions."""
+        full = self._mesh
+        if full is None or full.size <= 0:
+            frac = 1.0
+        elif self._mesh_demoted is not None:
+            frac = 1.0 / float(full.size)
+        elif self._mesh_serving is not None:
+            frac = float(self._mesh_serving.size) / float(full.size)
+        else:
+            frac = 1.0
+        self._mesh_capacity = frac
+        metrics.MeshCapacity.set(frac)
+        entries = self.config.shed_queue_entries
+        if entries:
+            # Floor deep degradation at session_share_min so the cap
+            # never starves admission entirely — but the floor must
+            # never RAISE a small configured cap above its full-width
+            # value (the operator's bound wins at frac=1.0).
+            self.dispatcher.scale_admission(
+                min(entries,
+                    max(int(entries * frac),
+                        self.config.session_share_min))
+            )
+        # Invalidate the lazy DRR share so the very next admission
+        # sees the new fraction (not up to 50ms later).
+        self._share_ts = 0.0
+
+    def _demote_mesh(self, reason: str, exc=None) -> None:
         """PR 2 ladder, mesh rung: a lost/erroring mesh device demotes
         the whole service to the single-chip executables — one pointer
         pass under _lock, typed (mesh_demotions_total{reason}) and
         counted, never a wedged round.  The dispatch path never
-        resumes collectives on its own: re-promotion happens only
-        through the timed OFF-PATH re-probe (_run_mesh_reprobe) after
-        a fresh sharded executable proves bit-identical to the
-        fallback; until then every dispatch serves single-chip.  With
-        mesh_reprobe_interval_s = 0 the pre-PR-12 sticky-until-restart
-        behavior holds."""
+        resumes collectives on its own: the fault is attributed to its
+        device(s) (health table + _mesh_lost) and an IMMEDIATE
+        off-path reshape job walks the width ladder down around them
+        (_run_mesh_ladder) — the fallback rung covers only the rebuild
+        window; un-attributable faults hold demoted until the timed
+        re-probe re-adjudicates.  With mesh_reprobe_interval_s = 0 the
+        pre-PR-12 sticky-until-restart behavior holds."""
+        attributed = self._attribute_fault_devices(exc)
+        swapped = 0
+        first = False
         with self._lock:
-            if self._mesh_demoted is not None:
-                return
-            self._mesh_demoted = reason
-            # Pace the first re-probe one full interval after the
-            # demotion (a device that just failed rarely heals
-            # instantly).
-            self._mesh_reprobe_last = time.monotonic()
-            swapped = 0
-            for eng in self._engines.values():
-                m = getattr(eng, "model", None)
-                fb = getattr(m, "fallback", None)
-                if fb is not None:
-                    # Retain the sharded wrapper for re-promotion: its
-                    # tables are host-rebuildable state, and a flip
-                    # back after a successful probe is one pointer
-                    # pass.  If the devices are still bad, the next
-                    # sharded dispatch demotes again, typed — never a
-                    # crashed round.
-                    eng._mesh_model = m
-                    eng.model = fb
-                    # Sharded models are shape-keyed (dispatch_bare),
-                    # so no per-id cache entry exists to drop; the
-                    # compiled mesh executables stay in the shape
-                    # cache as inert entries (demoted dispatch
-                    # resolves through _live_model before any lookup).
-                    swapped += 1
+            # Fold the attribution in even when already demoted (a
+            # second chip dying on the fallback rung still belongs in
+            # the health table and the next reshape's dead set).
+            self._mesh_lost |= attributed
+            if self._mesh_demoted is None:
+                first = True
+                self._mesh_demoted = reason
+                self._mesh_serving = None
+                self._mesh_fault_at = time.monotonic()
+                # Pace the first re-probe one full interval after the
+                # demotion (a device that just failed rarely heals
+                # instantly).
+                self._mesh_reprobe_last = self._mesh_fault_at
+                for eng in self._engines.values():
+                    m = getattr(eng, "model", None)
+                    fb = getattr(m, "fallback", None)
+                    if fb is not None:
+                        # Retain the sharded wrapper for
+                        # re-promotion: its tables are
+                        # host-rebuildable state, and a flip back
+                        # after a successful probe is one pointer
+                        # pass.  A demotion FROM the reshaped rung
+                        # keeps the earlier FULL-width retained
+                        # wrapper (the reshaped model is rebuilt,
+                        # never retained).  If the devices are still
+                        # bad, the next sharded dispatch demotes
+                        # again, typed — never a crashed round.
+                        if getattr(eng, "_mesh_model", None) is None:
+                            eng._mesh_model = m
+                        eng.model = fb
+                        # Sharded models are shape-keyed
+                        # (dispatch_bare), so no per-id cache entry
+                        # exists to drop; the compiled mesh
+                        # executables stay in the shape cache as
+                        # inert entries (demoted dispatch resolves
+                        # through _live_model before any lookup).
+                        swapped += 1
+        for dev_id in sorted(attributed):
+            self.guard.record_device_fault(dev_id, reason)
+        if not first:
+            return
         self.mesh_demotions[reason] = (
             self.mesh_demotions.get(reason, 0) + 1
         )
         metrics.MeshDemotions.inc(reason)
         metrics.MeshActive.set(0.0)
+        metrics.MeshLostDevices.set(float(len(self._mesh_lost)))
+        self._publish_mesh_capacity()
         log.error(
             "mesh serving demoted to single-chip executables (%s): "
-            "%d engine(s) flipped", reason, swapped,
+            "%d engine(s) flipped, %d device(s) attributed", reason,
+            swapped, len(attributed),
         )
+        # Walk the ladder DOWN off-path right away (no paced wait):
+        # with attributed/probed-dead devices the builder rebuilds a
+        # reshaped mesh over the survivors and the fallback rung lasts
+        # only the rebuild window.
+        if self.config.mesh_reshape and self.config.mesh_reprobe_interval_s:
+            self._build_queue.put(("mesh_reshape", None))
 
     def _maybe_mesh_reprobe(self) -> None:
         """Traffic-driven re-promotion pacing (called once per dispatch
-        round, like guard.maybe_reprobe): while demoted, queue at most
-        one off-path mesh re-probe per mesh_reprobe_interval_s onto the
-        policy-builder thread.  0 disables (sticky demotion)."""
+        round, like guard.maybe_reprobe): while BELOW the full rung
+        (demoted or reshaped), queue at most one off-path ladder walk
+        per mesh_reprobe_interval_s onto the policy-builder thread —
+        the walk promotes back up (reshaped -> full, fallback ->
+        reshaped/full) as devices heal.  0 disables (sticky)."""
         interval = self.config.mesh_reprobe_interval_s
-        if self._mesh_demoted is None or not interval:
+        if not interval or (
+            self._mesh_demoted is None and self._mesh_serving is None
+        ):
             return
         if self.guard.quarantined:
             # Never queue a compile+dispatch against a quarantined
@@ -3934,137 +4223,299 @@ class VerdictService:
         (frozenset({9}), "", ""),
     )
 
-    def _run_mesh_reprobe(self) -> None:
-        """Builder-thread half of the mesh heal: rebuild ONE sharded
-        executable from scratch against the live mesh, run it beside
-        its single-chip twin over a probe batch, and require
-        bit-identical (allow, rule) output.  Success re-promotes: every
-        engine's retained sharded wrapper flips back in one pointer
-        pass under _lock (typed, counted); engines built DURING the
-        demotion stay single-chip until the next epoch swap rebuilds
-        them.  Failure leaves the demotion in place and the pacing
-        clock owns the retry."""
+    def _mesh_probe_batch(self):
+        """Probe batch shared by every ladder parity/materialization
+        check: five frames covering remote-gated literal, regex,
+        always-match and padding rows."""
+        b = max(self.MIN_BUCKET_GREEDY, self._mesh_min_bucket)
+        width = self.config.batch_width
+        data = np.zeros((b, width), np.uint8)
+        lens = np.zeros(b, np.int32)
+        rems = np.zeros(b, np.int32)
+        cases = [
+            (b"READ /public/app\r\n", 7),
+            (b"READ /public/app\r\n", 8),
+            (b"HALT\r\n", 3),
+            (b"WRITE /x\r\n", 9),
+            (b"RESET\r\n", 9),
+        ]
+        for i, (frame, rem) in enumerate(cases):
+            row = np.frombuffer(frame, np.uint8)
+            data[i, : len(row)] = row
+            lens[i] = len(row)
+            rems[i] = rem
+        return data, lens, rems
+
+    def _mesh_parity_probe(self, mesh) -> bool:
+        """Rebuild ONE sharded probe wrapper from scratch against
+        ``mesh``, run it beside its single-chip twin over the probe
+        batch, and require bit-identical (allow, rule) output — the
+        gate EVERY ladder flip (reshape or re-promotion) must pass
+        before any engine pointer moves."""
+        from ..parallel.mesh import RULE_AXIS
+        from ..parallel.rulesharding import (
+            ShardedVerdictModel,
+            build_sharded_r2d2_from_rows,
+            shard_offsets,
+        )
+        from ..models.r2d2 import build_r2d2_model_from_rows
+
+        rows = list(self._MESH_PROBE_ROWS)
+        n_shards = mesh.shape[RULE_AXIS]
+        with self._device_ctx():
+            probe = ShardedVerdictModel(
+                build_sharded_r2d2_from_rows(
+                    rows, n_shards, bucket=True
+                ),
+                shard_offsets(len(rows), n_shards),
+                mesh, "r2d2",
+                fallback=build_r2d2_model_from_rows(
+                    rows, bucket=True
+                ),
+            )
+        data, lens, rems = self._mesh_probe_batch()
+        fb = probe.fallback
+        with self._device_ctx():
+            _, _, a_s, r_s = probe.verdicts_attr(data, lens, rems)
+            _, _, a_f, r_f = fb.verdicts_attr(data, lens, rems)
+        return bool(
+            np.array_equal(np.asarray(a_s), np.asarray(a_f))
+            and np.array_equal(np.asarray(r_s), np.asarray(r_f))
+        )
+
+    def _reshape_failed(self, reason: str) -> None:
+        self.mesh_reshape_failures[reason] = (
+            self.mesh_reshape_failures.get(reason, 0) + 1
+        )
+
+    def _run_mesh_ladder(self, immediate: bool) -> None:
+        """Builder-thread walk of the mesh width ladder: adjudicate
+        the dead device set (per-device probes + the attributed
+        _mesh_lost), pick the target rung (full when nothing is dead,
+        else the widest bucketable mesh over the survivors), parity-
+        gate it against the single-chip twin, and flip every engine
+        onto it in one pointer pass.  ``immediate`` is the post-fault
+        job _demote_mesh queues: it only walks DOWN (a fault with no
+        attributable dead device holds the fallback rung for the
+        paced walk to adjudicate — transient XLA errors must not
+        promote themselves).  A second fault racing the walk aborts
+        the flip typed and falls through to the rung ITS demotion
+        chose.  Failure anywhere leaves the current rung in place and
+        the pacing clock owns the retry."""
         try:
+            full = self._mesh
+            if full is None:
+                return
             with self._lock:
-                if self._mesh_demoted is None:
-                    return
+                demoted = self._mesh_demoted
+                serving = self._mesh_serving
+                prev_lost = set(self._mesh_lost)
+            if demoted is None and serving is None:
+                return  # full rung — stale job
             # Re-checked on the builder thread: quarantine may have
             # latched between queueing and execution (same hung-device
             # hazard _maybe_mesh_reprobe gates against).
             if self.guard.quarantined:
                 return
-            mesh = self._mesh
-            if mesh is None:
-                return
-            from ..parallel.mesh import RULE_AXIS
-            from ..parallel.rulesharding import (
-                ShardedVerdictModel,
-                build_sharded_r2d2_from_rows,
-                shard_offsets,
-            )
-            from ..models.r2d2 import build_r2d2_model_from_rows
+            from ..parallel.mesh import FLOW_AXIS, RULE_AXIS, reshape_mesh
 
-            rows = list(self._MESH_PROBE_ROWS)
-            n_shards = mesh.shape[RULE_AXIS]
-            with self._device_ctx():
-                probe = ShardedVerdictModel(
-                    build_sharded_r2d2_from_rows(
-                        rows, n_shards, bucket=True
-                    ),
-                    shard_offsets(len(rows), n_shards),
-                    mesh, "r2d2",
-                    fallback=build_r2d2_model_from_rows(
-                        rows, bucket=True
-                    ),
+            # -- adjudicate the dead set -------------------------------
+            dead = self._probe_mesh_devices(list(full.devices.flat))
+            if immediate:
+                dead |= prev_lost
+                if not dead:
+                    return
+            else:
+                for dev_id in sorted(prev_lost - dead):
+                    self.guard.mark_device_ok(dev_id)
+            with self._lock:
+                self._mesh_lost = set(dead)
+            metrics.MeshLostDevices.set(float(len(dead)))
+            if demoted is None and serving is not None and dead == prev_lost:
+                return  # reshaped rung already matches the dead set
+            # -- pick the target rung ----------------------------------
+            d0 = sum(self.mesh_demotions.values())
+            target = None
+            if not dead:
+                target = full
+            elif self.config.mesh_reshape:
+                survivors = [
+                    d for d in full.devices.flat if d.id not in dead
+                ]
+                with self._device_ctx():
+                    target = reshape_mesh(
+                        survivors, full.shape[RULE_AXIS],
+                        max_flow=full.shape[FLOW_AXIS],
+                    )
+            if target is None:
+                reason = (
+                    "below-min-width" if self.config.mesh_reshape
+                    else "reshape-disabled"
                 )
-            b = self.MIN_BUCKET_GREEDY
-            width = self.config.batch_width
-            data = np.zeros((b, width), np.uint8)
-            lens = np.zeros(b, np.int32)
-            rems = np.zeros(b, np.int32)
-            cases = [
-                (b"READ /public/app\r\n", 7),
-                (b"READ /public/app\r\n", 8),
-                (b"HALT\r\n", 3),
-                (b"WRITE /x\r\n", 9),
-                (b"RESET\r\n", 9),
-            ]
-            for i, (frame, rem) in enumerate(cases):
-                row = np.frombuffer(frame, np.uint8)
-                data[i, : len(row)] = row
-                lens[i] = len(row)
-                rems[i] = rem
-            fb = probe.fallback
-            with self._device_ctx():
-                _, _, a_s, r_s = probe.verdicts_attr(data, lens, rems)
-                _, _, a_f, r_f = fb.verdicts_attr(data, lens, rems)
-            if not (
-                np.array_equal(np.asarray(a_s), np.asarray(a_f))
-                and np.array_equal(np.asarray(r_s), np.asarray(r_f))
-            ):
+                self._reshape_failed(reason)
+                if demoted is None:
+                    # Serving reshaped but the dead set grew past any
+                    # bucketable width: drop to the fallback rung via
+                    # the typed pointer pass, never a raw state write.
+                    self._demote_mesh(reason)
+                return
+            # -- parity-gate the target --------------------------------
+            if not self._mesh_parity_probe(target):
+                self._reshape_failed("parity")
                 log.warning(
-                    "mesh re-probe parity mismatch; demotion holds"
+                    "mesh ladder parity mismatch at (flows=%d, "
+                    "rules=%d); rung holds",
+                    target.shape[FLOW_AXIS], target.shape[RULE_AXIS],
                 )
                 return
-            # Probe one RETAINED wrapper too: its device buffers must
-            # still answer (a restarted device may have dropped them —
-            # then the flip-back would only re-demote, typed, so this
-            # probe keeps that churn off the dispatch path).
+            if target is full and serving is None:
+                self._promote_mesh_classic(d0)
+                return
+            # -- rebuild + flip (reshape down, or reshaped -> full) ----
+            builds = self._rebuild_engines_on(target)
+            flipped = 0
             with self._lock:
-                retained = [
-                    getattr(e, "_mesh_model", None)
-                    for e in self._engines.values()
-                ]
-            retained = [m for m in retained if m is not None]
-            if retained:
-                with self._device_ctx():
-                    out = retained[0](data, lens, rems)
-                    np.asarray(out[-1])
-            promoted = 0
-            rebuilds: list = []
-            with self._lock:
-                if self._mesh_demoted is None:
-                    return  # raced a concurrent heal
-                for eng in self._engines.values():
-                    mm = getattr(eng, "_mesh_model", None)
-                    if mm is not None:
-                        eng.model = mm
+                if sum(self.mesh_demotions.values()) != d0:
+                    # A second fault raced this walk: abort the flip
+                    # typed — the new demotion queued its own
+                    # immediate job, which re-walks the ladder with
+                    # the grown dead set (the next rung down).
+                    self._reshape_failed("raced-fault")
+                    return
+                for key, (eng, built, epoch0, old) in builds.items():
+                    cur = self._engines.get(key)
+                    if (
+                        cur is not eng
+                        or getattr(eng, "epoch", 0) != epoch0
+                        or eng.model is not old
+                    ):
+                        continue  # swapped mid-build: the swap built
+                        # against _serving_mesh already
+                    eng.model = built
+                    if target is full:
                         eng._mesh_model = None
-                        promoted += 1
+                    flipped += 1
+                self._mesh_serving = None if target is full else target
                 self._mesh_demoted = None
-                # ROADMAP 1c: engines BUILT while demoted hold plain
-                # single-chip models (no retained wrapper, no
-                # fallback attr) — queue their sharded rebuilds so
-                # they heal too instead of waiting for the next epoch
-                # swap.  (Re-promoted engines above now expose
-                # .fallback and drop out of this scan.)
-                if not self.config.seam_probe:
-                    for key, eng in self._engines.items():
-                        m = getattr(eng, "model", None)
-                        if (
-                            key[4] in ("r2d2", "http", "dns")
-                            and getattr(eng, "_mesh_model", None) is None
-                            and m is not None
-                            and not isinstance(m, ConstVerdict)
-                            and getattr(m, "fallback", None) is None
-                        ):
-                            rebuilds.append(
-                                (key, getattr(eng, "epoch", 0))
-                            )
-            for job in rebuilds:
-                self._build_queue.put(("mesh_rebuild", job))
-            self.mesh_repromotions += 1
-            metrics.MeshRepromotions.inc()
+            if target is full:
+                self.mesh_repromotions += 1
+                metrics.MeshRepromotions.inc()
+                log.info(
+                    "mesh serving re-promoted to full width after "
+                    "off-path parity probe (%d engine(s) rebuilt)",
+                    flipped,
+                )
+            else:
+                self.mesh_reshapes += 1
+                metrics.MeshReshapes.inc()
+                if serving is None and self._mesh_fault_at:
+                    self.mesh_reshape_window_ms = (
+                        time.monotonic() - self._mesh_fault_at
+                    ) * 1e3
+                log.warning(
+                    "mesh RESHAPED around %d dead device(s) %s: "
+                    "serving (flows=%d, rules=%d), %d engine(s) "
+                    "flipped", len(dead), sorted(dead),
+                    target.shape[FLOW_AXIS], target.shape[RULE_AXIS],
+                    flipped,
+                )
             metrics.MeshActive.set(1.0)
-            log.info(
-                "mesh serving re-promoted after off-path parity probe "
-                "(%d engine(s) flipped back)", promoted,
-            )
-        except Exception:  # noqa: BLE001 — demotion holds, retry paced
-            log.exception("mesh re-probe failed; demotion holds")
+            self._publish_mesh_capacity()
+        except Exception:  # noqa: BLE001 — rung holds, retry paced
+            log.exception("mesh ladder walk failed; rung holds")
         finally:
             with self._lock:
                 self._mesh_reprobe_inflight = False
+
+    def _promote_mesh_classic(self, d0: int) -> None:
+        """Fallback -> full promotion when every device answers: the
+        retained sharded wrappers flip back in one pointer pass under
+        _lock (typed, counted); engines built DURING the demotion get
+        their sharded rebuilds queued (ROADMAP 1c) instead of waiting
+        for the next epoch swap."""
+        data, lens, rems = self._mesh_probe_batch()
+        # Probe one RETAINED wrapper too: its device buffers must
+        # still answer (a restarted device may have dropped them —
+        # then the flip-back would only re-demote, typed, so this
+        # probe keeps that churn off the dispatch path).
+        with self._lock:
+            retained = [
+                getattr(e, "_mesh_model", None)
+                for e in self._engines.values()
+            ]
+        retained = [m for m in retained if m is not None]
+        if retained:
+            with self._device_ctx():
+                out = retained[0](data, lens, rems)
+                np.asarray(out[-1])
+        promoted = 0
+        rebuilds: list = []
+        with self._lock:
+            if self._mesh_demoted is None:
+                return  # raced a concurrent heal
+            if sum(self.mesh_demotions.values()) != d0:
+                return  # raced a concurrent fault
+            for eng in self._engines.values():
+                mm = getattr(eng, "_mesh_model", None)
+                if mm is not None:
+                    eng.model = mm
+                    eng._mesh_model = None
+                    promoted += 1
+            self._mesh_demoted = None
+            self._mesh_serving = None
+            # ROADMAP 1c: engines BUILT while demoted hold plain
+            # single-chip models (no retained wrapper, no
+            # fallback attr) — queue their sharded rebuilds so
+            # they heal too instead of waiting for the next epoch
+            # swap.  (Re-promoted engines above now expose
+            # .fallback and drop out of this scan.)
+            if not self.config.seam_probe:
+                for key, eng in self._engines.items():
+                    m = getattr(eng, "model", None)
+                    if (
+                        key[4] in ("r2d2", "http", "dns")
+                        and getattr(eng, "_mesh_model", None) is None
+                        and m is not None
+                        and not isinstance(m, ConstVerdict)
+                        and getattr(m, "fallback", None) is None
+                    ):
+                        rebuilds.append(
+                            (key, getattr(eng, "epoch", 0))
+                        )
+        for job in rebuilds:
+            self._build_queue.put(("mesh_rebuild", job))
+        self.mesh_repromotions += 1
+        metrics.MeshRepromotions.inc()
+        metrics.MeshActive.set(1.0)
+        self._publish_mesh_capacity()
+        log.info(
+            "mesh serving re-promoted after off-path parity probe "
+            "(%d engine(s) flipped back)", promoted,
+        )
+
+    def _rebuild_engines_on(self, mesh) -> dict:
+        """Off-path rebuild of every meshable engine's model against
+        ``mesh`` (the reshape fan-out): returns {key: (engine, built,
+        epoch0, old_model)} for the flip pass to apply under _lock
+        with staleness checks (engine replaced, epoch moved, model
+        pointer moved — any of which means an epoch swap already
+        rebuilt it against _serving_mesh)."""
+        with self._lock:
+            snap = [
+                (key, eng, getattr(eng, "epoch", 0),
+                 getattr(eng, "model", None))
+                for key, eng in self._engines.items()
+            ]
+        builds: dict = {}
+        for key, eng, epoch0, old in snap:
+            if key[4] not in ("r2d2", "http", "dns"):
+                continue
+            if old is None or isinstance(old, ConstVerdict):
+                continue
+            built = self._build_mesh_model_for(key, mesh)
+            if built is not None:
+                builds[key] = (eng, built, epoch0, old)
+        return builds
 
     def _run_mesh_rebuild(self, key: tuple, epoch0: int) -> None:
         """Builder-thread half of the ROADMAP 1c heal: rebuild ONE
@@ -4091,43 +4542,14 @@ class VerdictService:
             or getattr(model, "fallback", None) is not None
         ):
             return  # already sharded (or nothing to shard)
-        mesh = self._resolve_mesh()
+        # The CURRENT rung's mesh: a rebind while the service runs
+        # reshaped must shard onto the survivor mesh, never the full
+        # layout a dead chip would fault.
+        mesh = self._serving_mesh()
         if mesh is None:
             return
-        module_id, policy_name, ingress, port, proto = key
-        ins = pl.find_instance(module_id)
-        if ins is None:
-            return
-        policy = ins.policy_map().get(policy_name)
-        try:
-            with self._device_ctx():
-                # lint: disable=R12 -- off-path builder-thread rebuild (the mesh-heal rung), never the dispatch loop
-                if proto == "r2d2":
-                    from ..parallel.rulesharding import mesh_r2d2_model
-
-                    built = mesh_r2d2_model(policy, ingress, port, mesh)
-                elif proto == "dns":
-                    from ..parallel.rulesharding import mesh_dns_model
-
-                    built = mesh_dns_model(policy, ingress, port, mesh)
-                else:
-                    from ..parallel.rulesharding import mesh_http_model
-
-                    built = mesh_http_model(policy, ingress, port, mesh)
-                if getattr(built, "fallback", None) is None:
-                    return  # folded to a constant: nothing to flip
-                # Materialize one probe call so a broken mesh fails
-                # HERE (typed, demotion path) and not on dispatch.
-                w = self.config.batch_width
-                out = built(
-                    np.zeros((self.MIN_BUCKET_GREEDY, w), np.uint8),
-                    np.zeros(self.MIN_BUCKET_GREEDY, np.int32),
-                    np.zeros(self.MIN_BUCKET_GREEDY, np.int32),
-                )
-                np.asarray(out[-1])
-        except Exception:  # noqa: BLE001 — engine keeps single-chip
-            log.exception("mesh rebind rebuild failed; engine stays "
-                          "single-chip")
+        built = self._build_mesh_model_for(key, mesh)
+        if built is None:
             return
         with self._lock:
             if (
@@ -4144,6 +4566,53 @@ class VerdictService:
                     "sharded", key,
                 )
 
+    def _build_mesh_model_for(self, key: tuple, mesh):
+        """Off-path build of ONE engine's sharded model against
+        ``mesh`` (the single assembly seam shared by the rebind heal
+        and the reshape fan-out): resolve the engine's policy through
+        the module registry, build the family's sharded wrapper with
+        its single-chip twin, and materialize one probe call so a
+        broken mesh fails HERE (typed, demotion path) and never on
+        dispatch.  None when the policy folded to a constant, the
+        module is gone, or the build/probe fails — the engine then
+        keeps its current model."""
+        module_id, policy_name, ingress, port, proto = key
+        if proto not in ("r2d2", "http", "dns"):
+            return None
+        ins = pl.find_instance(module_id)
+        if ins is None:
+            return None
+        policy = ins.policy_map().get(policy_name)
+        try:
+            with self._device_ctx():
+                # lint: disable=R12 -- off-path builder-thread rebuild (the mesh-heal/reshape rung), never the dispatch loop
+                if proto == "r2d2":
+                    from ..parallel.rulesharding import mesh_r2d2_model
+
+                    built = mesh_r2d2_model(policy, ingress, port, mesh)
+                elif proto == "dns":
+                    from ..parallel.rulesharding import mesh_dns_model
+
+                    built = mesh_dns_model(policy, ingress, port, mesh)
+                else:
+                    from ..parallel.rulesharding import mesh_http_model
+
+                    built = mesh_http_model(policy, ingress, port, mesh)
+                if getattr(built, "fallback", None) is None:
+                    return None  # folded to a constant: nothing to flip
+                b = max(self.MIN_BUCKET_GREEDY, self._mesh_min_bucket)
+                w = self.config.batch_width
+                out = built(
+                    np.zeros((b, w), np.uint8),
+                    np.zeros(b, np.int32),
+                    np.zeros(b, np.int32),
+                )
+                np.asarray(out[-1])
+        except Exception:  # noqa: BLE001 — engine keeps its model
+            log.exception("mesh model build failed for %r", key)
+            return None
+        return built
+
     def _mesh_guarded(self, model, call):
         """Issue one device dispatch; when a SHARDED dispatch raises
         (lost mesh device, failed collective, transfer error), demote
@@ -4151,14 +4620,17 @@ class VerdictService:
         the round is answered instead of crashed."""
         try:
             return call(model)
-        except Exception:
+        except Exception as exc:
             fb = getattr(model, "fallback", None)
             if fb is None:
                 raise
             log.exception(
                 "sharded dispatch failed; demoting to single-chip"
             )
-            self._demote_mesh("device-call")
+            # The exception text carries the fault attribution (which
+            # shard/device raised) — the reshape ladder walks down
+            # around exactly those devices.
+            self._demote_mesh("device-call", exc=exc)
             return call(fb)
 
     def _mesh_status(self) -> dict | None:
@@ -4168,6 +4640,13 @@ class VerdictService:
             return None
         from ..parallel.mesh import FLOW_AXIS, RULE_AXIS
 
+        serving = self._mesh_serving
+        if self._mesh_demoted is not None:
+            rung = "fallback"
+        elif serving is not None:
+            rung = "reshaped"
+        else:
+            rung = "full"
         return {
             "devices": int(self._mesh.size),
             "flow_shards": int(self._mesh.shape[FLOW_AXIS]),
@@ -4177,6 +4656,19 @@ class VerdictService:
             "demotions": dict(self.mesh_demotions),
             "repromotions": self.mesh_repromotions,
             "rebind_rebuilds": self.mesh_rebind_rebuilds,
+            # Width-ladder state: the current rung, the width actually
+            # serving, the attributed dead set, and the admission
+            # coupling — the operator's one look at "how degraded".
+            "rung": rung,
+            "serving_devices": (
+                1 if rung == "fallback"
+                else int((serving or self._mesh).size)
+            ),
+            "lost_devices": sorted(self._mesh_lost),
+            "reshapes": self.mesh_reshapes,
+            "reshape_failures": dict(self.mesh_reshape_failures),
+            "capacity_frac": self._mesh_capacity,
+            "reshape_window_ms": self.mesh_reshape_window_ms,
         }
 
     def _model_call(self, model, data, lens, remotes, use_jit=None):

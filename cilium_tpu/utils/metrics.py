@@ -438,6 +438,25 @@ MeshRepromotions = registry.counter(
     "re-probe (one sharded executable rebuilt, parity-probed against "
     "the single-chip fallback, then one pointer flip back)",
 )
+MeshReshapes = registry.counter(
+    "mesh_reshapes_total",
+    "Width-ladder reshapes: sharded serving rebuilt over the "
+    "surviving device subset at a reduced bucketable width after a "
+    "partial device loss (the fallback rung covers only the rebuild "
+    "window, not until restart)",
+)
+MeshCapacity = registry.gauge(
+    "mesh_capacity_fraction",
+    "Serving capacity of the current mesh rung as a fraction of the "
+    "full mesh (1.0 full, width ratio reshaped, 1/width fallback); "
+    "admission (shed queue depth, DRR credit windows) scales by it so "
+    "a degraded mesh sheds typed at its actual capacity",
+)
+MeshLostDevices = registry.gauge(
+    "mesh_lost_devices",
+    "Devices currently attributed lost by the per-device health table "
+    "(readback error, stall, or vanishing from the backend device set)",
+)
 # Established-flow verdict cache (sidecar service Phase-A mask +
 # _classify_entry, shim client pre-push short-circuit, engine judge
 # steps).  Every hit is a device round, a wire round-trip, and a

@@ -261,8 +261,17 @@ class DaemonConfig:
     # FLOW_AXIS extent: batch axes shard across this many devices for
     # throughput.  0 = devices // rule_shards, floored to a power of
     # two (so every power-of-two dispatch bucket divides it) and
-    # capped at the smallest bucket.
+    # capped at the smallest bucket.  An EXPLICIT value may exceed the
+    # smallest dispatch bucket (ROADMAP 5b): the service grows its
+    # minimum bucket to the flow extent so >32-device pods shard the
+    # flow axis fully.
     mesh_flow_shards: int = 0
+    # Width-ladder reshape: after a partial device loss the policy
+    # builder thread rebuilds the sharded wrappers over the surviving
+    # devices at the next bucketable width (fallback covers only the
+    # rebuild window).  False keeps the binary pre-PR-17 ladder:
+    # any mesh fault demotes straight to the single-chip fallback.
+    mesh_reshape: bool = True
     # Guarded mesh re-promotion: after a mesh demotion, the policy
     # builder thread re-probes the mesh off-path at most once per this
     # interval (rebuild one sharded executable, parity-probe it against

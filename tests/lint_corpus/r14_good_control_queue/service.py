@@ -1,0 +1,29 @@
+"""R14 good: a builder-job enqueue beside an answer site.
+
+``_demote``'s ``self._build_queue.put`` enqueues CONTROL-PLANE work
+(a mesh reshape job) — no admitted entry rides it, so the model call
+that can demote is NOT an answer site and the real ``send_verdicts``
+below it needs no exclusivity guard against it.
+"""
+
+
+class Service:
+    def __init__(self, client, build_queue):
+        self.client = client
+        self._build_queue = build_queue
+        self.demoted = None
+
+    def _demote(self, reason):
+        self.demoted = reason
+        self._build_queue.put(("mesh_reshape", None))
+
+    def _guarded_call(self, fn, batch):
+        try:
+            return fn(batch)
+        except RuntimeError:
+            self._demote("device-call")
+            return fn(batch)
+
+    def run_round(self, fn, batch):
+        verdicts = self._guarded_call(fn, batch)
+        self.client.send_verdicts(batch.seq, verdicts, batch=batch)

@@ -1,0 +1,31 @@
+"""R17 corpus (bad): mesh-ladder handoff fields that drift.
+
+- ``snapshot_handoff`` writes the ``"mesh"`` degraded-width row but
+  ``restore_handoff`` never reads nor names it: the successor boots at
+  FULL width on a pod with a dead chip and rediscovers the loss the
+  hard way (a fault-and-demote outage the handoff existed to avoid).
+- ``restore_handoff`` hard-requires ``snap["capacity_frac"]`` which
+  the snapshot never writes — every restore takes the malformed path.
+"""
+
+
+class Service:
+    def __init__(self):
+        self.generation = 1
+        self.lost = set()
+        self.capacity = 1.0
+
+    def snapshot_handoff(self) -> dict:
+        return {
+            "version": 2,
+            "generation": self.generation,
+            "mesh": {"lost": sorted(self.lost)},  # EXPECT[R17]
+        }
+
+    def restore_handoff(self, snap: dict) -> bool:
+        try:
+            self.generation = int(snap["generation"]) + 1
+            self.capacity = float(snap["capacity_frac"])  # EXPECT[R17]
+        except (KeyError, TypeError, ValueError):
+            return False
+        return int(snap.get("version", -1)) <= 2

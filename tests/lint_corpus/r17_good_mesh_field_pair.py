@@ -1,0 +1,54 @@
+"""R17 corpus (good): mesh-ladder fields ride the handoff symmetric.
+
+The width ladder's degraded state ("mesh": lost device ids + reshape
+count, and the guard's per-device health rows) is written by the
+snapshot and consumed by the restore through the tolerant ``.get``
+form — the sanctioned versioned-in escape, so a v1 snapshot without
+the row still restores.
+"""
+
+
+class Service:
+    def __init__(self):
+        self.generation = 1
+        self.lost = set()
+        self.reshapes = 0
+        self.devices = {}
+        self._staged_mesh = None
+
+    def snapshot_handoff(self) -> dict:
+        return {
+            "version": 2,
+            "generation": self.generation,
+            "mesh": {
+                "lost": sorted(int(x) for x in self.lost),
+                "reshapes": int(self.reshapes),
+            },
+            "devices": {
+                k: {"state": r["state"], "heals": int(r["heals"])}
+                for k, r in self.devices.items()
+            },
+        }
+
+    def restore_handoff(self, snap: dict) -> bool:
+        try:
+            self.generation = int(snap["generation"]) + 1
+        except (KeyError, TypeError, ValueError):
+            return False
+        if int(snap.get("version", -1)) > 2:
+            return False
+        mesh_row = snap.get("mesh")
+        if isinstance(mesh_row, dict):
+            self._staged_mesh = {
+                "lost": [int(x) for x in mesh_row.get("lost") or []],
+                "reshapes": int(mesh_row.get("reshapes") or 0),
+            }
+        for key, row in (snap.get("devices") or {}).items():
+            if isinstance(row, dict) and row.get("state") in (
+                "ok", "lost"
+            ):
+                self.devices[str(key)] = {
+                    "state": row["state"],
+                    "heals": int(row.get("heals") or 0),
+                }
+        return True

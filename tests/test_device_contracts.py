@@ -214,6 +214,38 @@ def test_sharded_attr_step_contract():
         ), eqn.primitive.name
 
 
+def test_reshape_ladder_audit_clean_and_sensitive():
+    """The reshape-ladder audit is green on the real assembly seam
+    (``mesh_model_from_family_rows`` over degraded survivor meshes)
+    and actually FIRES when the reshape builds a broken model — a
+    stale 1-shard stack served on a 2-wide rung — so a future seam
+    regression cannot pass silently."""
+    from cilium_tpu.analysis.devicecheck import check_reshape_ladder
+    from cilium_tpu.parallel import rulesharding
+    from cilium_tpu.parallel.mesh import flow_mesh
+
+    findings = check_reshape_ladder()
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    def broken(family, rows, mesh):
+        # Assemble for a 1x1 mesh, then claim the rung's mesh: the
+        # stacked shard dim and offsets no longer match its RULE_AXIS.
+        one = flow_mesh(n_flow=1, n_rule=1,
+                        devices=list(mesh.devices.flat)[:1])
+        model = rulesharding.mesh_model_from_family_rows(
+            family, rows, one
+        )
+        model.mesh = mesh
+        return model
+
+    broken_findings = check_reshape_ladder(build=broken)
+    assert broken_findings, (
+        "broken reshape assembly produced no findings"
+    )
+    assert any("shard" in f.message.lower()
+               for f in broken_findings), broken_findings
+
+
 # --- 3. CLI surface -------------------------------------------------------
 
 def test_cli_device_contracts_flag(capsys):

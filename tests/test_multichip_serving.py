@@ -136,6 +136,10 @@ def test_mesh_service_serves_sharded_bit_identical(tmp_path):
             "devices": 8, "flow_shards": 4, "rule_shards": 2,
             "active": True, "demoted": None, "demotions": {},
             "repromotions": 0, "rebind_rebuilds": 0,
+            # Width-ladder surface (PR 17): full rung, nothing lost.
+            "rung": "full", "serving_devices": 8, "lost_devices": [],
+            "reshapes": 0, "reshape_failures": {},
+            "capacity_frac": 1.0, "reshape_window_ms": 0.0,
         }
         # Single-chip control, same traffic.
         inst.reset_module_registry()
@@ -592,3 +596,671 @@ def test_mesh_repromotes_after_heal_bit_identical(tmp_path):
         if svc is not None:
             svc.stop()
         inst.reset_module_registry()
+
+
+# --- width ladder (PR 17): shard-loss reshape --------------------------------
+
+def _arm_named_loss(svc, dev_id):
+    """One-shot sharded-dispatch fault NAMING a device (the ladder's
+    attribution source) plus a probe seam marking that device dead.
+    Self-disarming: the reshaped wrappers (still ShardedVerdictModel)
+    must serve cleanly after the fault, so the injector restores the
+    real _jit_for the moment it fires."""
+    orig = svc.__class__._jit_for.__get__(svc)
+
+    def lost_device(cache, model, trace_fn, arg_fn=None):
+        if isinstance(model, ShardedVerdictModel):
+            def boom(*_a, **_k):
+                svc._jit_for = orig
+                raise RuntimeError(
+                    f"PJRT_Error: transfer to device {dev_id} failed"
+                )
+
+            return boom
+        return orig(cache, model, trace_fn, arg_fn)
+
+    svc._jit_for = lost_device
+    svc._device_probe_fn = lambda dev, _d=dev_id: dev.id != _d
+
+
+def _await_rung(svc, rung, client=None, mod=None, timeout=60.0,
+                drive=False):
+    """Wait for the builder thread's ladder walk to land on ``rung``;
+    optionally keep traffic flowing (the paced re-probe is
+    traffic-driven)."""
+    deadline = time.monotonic() + timeout
+    i = 0
+    while time.monotonic() < deadline:
+        st = svc.status()["mesh"]
+        if st["rung"] == rung:
+            return st
+        if drive:
+            s = _conn(client, mod, 9000 + (i % 50), 3)
+            res, out = s.on_io(False, b"HALT\r\n")
+            assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+            s.close()
+            i += 1
+        time.sleep(0.05)
+    raise AssertionError(
+        f"rung {rung!r} never reached: {svc.status()['mesh']}"
+    )
+
+
+def test_mesh_reshape_serves_degraded_then_repromotes(tmp_path):
+    """The tentpole walk, fast-entry lane: an attributed device loss
+    demotes typed, the IMMEDIATE off-path reshape flips every engine
+    onto a survivor mesh (fallback covers only the rebuild window),
+    the reshaped rung serves bit-identically at a published capacity
+    fraction that scales admission, and the paced re-probe walks back
+    UP to full width when the device heals — all counted, zero loss."""
+    inst.reset_module_registry()
+    svc = client = None
+    try:
+        svc, client, mod = _start(
+            tmp_path, "mesh-reshape", batch_timeout_ms=0.0,
+            mesh_reprobe_interval_s=0.05,
+        )
+        shim = _conn(client, mod, 50, 1)
+        res, out = shim.on_io(False, b"READ /public/a.txt\r\n")
+        assert out == b"READ /public/a.txt\r\n"
+        full_share = svc._drr_share()
+
+        _arm_named_loss(svc, 3)
+        # The faulting round is still answered from the fallback twin
+        # in the SAME round (PR 11 contract: no round waits on the
+        # rebuild).
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        assert svc.status()["mesh"]["demotions"] == {"device-call": 1}
+
+        st = _await_rung(svc, "reshaped")
+        assert st["active"] is True and st["demoted"] is None
+        assert st["lost_devices"] == [3]
+        assert st["reshapes"] == 1
+        assert 1 <= st["serving_devices"] < 8
+        assert 0.0 < st["capacity_frac"] < 1.0
+        assert st["reshape_window_ms"] > 0.0
+        # Engines flipped onto the SURVIVOR mesh (sharded again, and
+        # the dead device is not in the serving layout).
+        eng = next(iter(svc._engines.values()))
+        assert isinstance(eng.model, ShardedVerdictModel)
+        serving_ids = {d.id for d in svc._mesh_serving.devices.flat}
+        assert 3 not in serving_ids
+        assert len(serving_ids) == st["serving_devices"]
+        # Capacity-aware admission: queue cap and DRR credit windows
+        # shrink to the degraded fraction.
+        assert svc.dispatcher.max_pending < svc.config.shed_queue_entries
+        assert svc._drr_share() <= full_share
+        # Guard health table attributes the chip, typed by reason.
+        table = svc.guard.device_table()
+        assert table["3"]["state"] == "lost"
+        assert table["3"]["faults"].get("device-call", 0) >= 1
+        # Bit-identical service on the reshaped rung, nothing lost.
+        for i, (frame, remote, want) in enumerate(TRAFFIC):
+            s2 = _conn(client, mod, 100 + i, remote)
+            res, out = s2.on_io(False, frame)
+            assert res == int(FilterResult.OK)
+            assert (out == frame) == want, (frame, out)
+            s2.close()
+        # New engine builds while reshaped shard onto the survivors.
+        assert svc._serving_mesh() is svc._mesh_serving
+
+        # Heal: the paced re-probe walks back up to full width.
+        svc._device_probe_fn = lambda dev: True
+        st = _await_rung(svc, "full", client, mod, drive=True)
+        assert st["repromotions"] == 1
+        assert st["lost_devices"] == []
+        assert st["capacity_frac"] == 1.0
+        assert st["serving_devices"] == 8
+        assert svc.dispatcher.max_pending == svc.config.shed_queue_entries
+        table = svc.guard.device_table()
+        assert table["3"]["state"] == "ok"
+        assert table["3"]["heals"] >= 1
+        # Full-width mesh serves bit-identically again.
+        eng = next(iter(svc._engines.values()))
+        assert isinstance(eng.model, ShardedVerdictModel)
+        for i, (frame, remote, want) in enumerate(TRAFFIC):
+            s2 = _conn(client, mod, 200 + i, remote)
+            res, out = s2.on_io(False, frame)
+            assert res == int(FilterResult.OK)
+            assert (out == frame) == want, (frame, out)
+            s2.close()
+        st = svc.status()
+        assert st["containment"]["shed_entries"] == 0
+        assert st["containment"]["batch_crashes"] == 0
+        assert st["containment"]["error_entries"] == 0
+    finally:
+        if client is not None:
+            client.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()
+
+
+def test_capacity_scaling_never_raises_a_small_cap(tmp_path):
+    """The session_share_min floor under the capacity coupling guards
+    deep degradation from starving admission — it must never RAISE an
+    operator's small shed_queue_entries above its configured value
+    (regression: mesh resolution at frac=1.0 once floored an 8-entry
+    cap up to 64, so the overload test's queue never shed)."""
+    inst.reset_module_registry()
+    svc = client = None
+    try:
+        svc, client, mod = _start(
+            tmp_path, "small-cap", shed_queue_entries=8,
+        )
+        shim = _conn(client, mod, 1, 3)
+        res, out = shim.on_io(False, b"HALT\r\n")  # resolves the mesh
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        assert svc.status()["mesh"]["rung"] == "full"
+        assert svc.dispatcher.max_pending == 8
+        # Degraded: the scaled cap floors at min(entries, share_min)
+        # — bounded by the configured cap on every rung.
+        _arm_named_loss(svc, 3)
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        _await_rung(svc, "reshaped", client, mod, drive=True)
+        assert 1 <= svc.dispatcher.max_pending <= 8
+    finally:
+        if client is not None:
+            client.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()
+
+
+# Dispatch lanes the device-loss injection must cover (satellite 3):
+# vec (pipelined single complete frames), fast-entry (greedy inline),
+# columnar (_process_columnar: split frames through the reassembler),
+# slow-async (engine slow path, reassembler off).  The HTTP-judge lane
+# has its own test below (different protocol plumbing).
+LANE_CONFIGS = {
+    "vec": (dict(batch_timeout_ms=2.0), False),
+    "fast-entry": (dict(batch_timeout_ms=0.0), False),
+    "columnar": (
+        dict(batch_timeout_ms=2.0, reasm_min_entries=1), True,
+    ),
+    "slow-async": (dict(batch_timeout_ms=2.0, reasm=False), True),
+}
+
+
+# The reassembler lanes carry two full chaos+control service pairs
+# each (~10s apiece on the CPU smoke); keep tier-1 on the two cheap
+# lanes and run the split-frame lanes in the slow suite.
+@pytest.mark.parametrize(
+    "lane",
+    [
+        pytest.param("columnar", marks=pytest.mark.slow),
+        "fast-entry",
+        pytest.param("slow-async", marks=pytest.mark.slow),
+        "vec",
+    ],
+)
+def test_mesh_reshape_per_lane_bit_identical(tmp_path, lane):
+    """Every dispatch lane drives fault -> reshape -> bit-identical
+    continued service -> re-promotion.  Outputs are compared against a
+    single-chip control service fed the identical byte sequence — the
+    ladder must be invisible in the reply stream."""
+    cfg_kw, split = LANE_CONFIGS[lane]
+
+    def run(name, mesh_mode, fault):
+        inst.reset_module_registry()
+        svc = client = None
+        try:
+            svc, client, mod = _start(
+                tmp_path, name, mesh=mesh_mode,
+                mesh_reprobe_interval_s=0.05, **cfg_kw,
+            )
+            outs = []
+
+            def burst(base):
+                for i, (frame, remote, _w) in enumerate(TRAFFIC):
+                    shim = _conn(client, mod, base + i, remote)
+                    if split and len(frame) > 6:
+                        r1, o1 = shim.on_io(False, frame[:6])
+                        assert r1 == int(FilterResult.OK)
+                        r2, o2 = shim.on_io(False, frame[6:])
+                        assert r2 == int(FilterResult.OK)
+                        outs.append((o1, o2))
+                    else:
+                        r1, o1 = shim.on_io(False, frame)
+                        assert r1 == int(FilterResult.OK)
+                        outs.append(o1)
+                    shim.close()
+
+            burst(100)
+            if fault:
+                _arm_named_loss(svc, 5)
+            burst(200)  # fault fires mid-burst; answered via fallback
+            if fault:
+                _await_rung(svc, "reshaped")
+                st = svc.status()["mesh"]
+                assert st["lost_devices"] == [5]
+                assert st["reshapes"] == 1
+            burst(300)  # reshaped rung (or full, for the control)
+            if fault:
+                svc._device_probe_fn = lambda dev: True
+                _await_rung(svc, "full", client, mod, drive=True)
+                assert svc.status()["mesh"]["repromotions"] == 1
+            burst(400)  # re-promoted full width
+            st = svc.status()
+            if fault:
+                assert st["containment"]["batch_crashes"] == 0
+                assert st["containment"]["error_entries"] == 0
+                assert st["containment"]["shed_entries"] == 0
+            return outs
+        finally:
+            if client is not None:
+                client.close()
+            if svc is not None:
+                svc.stop()
+            inst.reset_module_registry()
+
+    chaos = run(f"lane-{lane}", "on", fault=True)
+    control = run(f"lane-{lane}-ctrl", "off", fault=False)
+    assert chaos == control
+
+
+def test_http_judge_lane_reshapes_and_repromotes(tmp_path):
+    """The HTTP-judge lane walks the full ladder too: a named device
+    loss mid-request demotes typed, the off-path reshape restores a
+    SHARDED judge over the survivors, and the heal promotes back to
+    full width — verdicts correct at every rung."""
+    inst.reset_module_registry()
+    svc = client = None
+    try:
+        pol = NetworkPolicy(
+            name="http-mesh", policy=2,
+            ingress_per_port_policies=[
+                PortNetworkPolicy(port=80, rules=[
+                    PortNetworkPolicyRule(http_rules=[
+                        {"method": "GET", "path": "/public/.*"},
+                        {"method": "POST", "path": "/api/.*"},
+                    ])
+                ])
+            ],
+        )
+        cfg = DaemonConfig(
+            batch_flows=64, batch_timeout_ms=0.0, dispatch_mode="jit",
+            mesh="on", mesh_rule_shards=2,
+            device_reprobe_interval_s=1e9,
+            mesh_reprobe_interval_s=0.05,
+        )
+        svc = VerdictService(
+            str(tmp_path / "http-mesh-l.sock"), cfg
+        ).start()
+        client = SidecarClient(svc.socket_path, timeout=120.0)
+        mod = client.open_module([])
+        assert client.policy_update(mod, [pol]) == int(FilterResult.OK)
+
+        def req(cid, frame):
+            res, shim = client.new_connection(
+                mod, "http", cid, True, 1, 2,
+                f"1.1.1.{cid}:{1000 + cid}", "2.2.2.2:80", "http-mesh",
+            )
+            assert res == int(FilterResult.OK)
+            res, out = shim.on_io(False, frame)
+            assert res == int(FilterResult.OK)
+            shim.close()
+            return out
+
+        ok_req = b"GET /public/a HTTP/1.1\r\n\r\n"
+        bad_req = b"DELETE /x HTTP/1.1\r\n\r\n"
+        assert req(9, ok_req) == ok_req
+
+        _arm_named_loss(svc, 2)
+        # Faulting round still answered (fallback twin, same round).
+        assert req(10, ok_req) == ok_req
+        assert svc.status()["mesh"]["demotions"] == {"device-call": 1}
+        st = _await_rung(svc, "reshaped")
+        assert st["lost_devices"] == [2]
+        eng = next(
+            e for k, e in svc._engines.items() if k[4] == "http"
+        )
+        assert isinstance(eng.model, ShardedVerdictModel)
+        assert req(11, ok_req) == ok_req
+        assert req(12, bad_req) != bad_req  # still denying, reshaped
+
+        svc._device_probe_fn = lambda dev: True
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if svc.status()["mesh"]["rung"] == "full":
+                break
+            assert req(13, ok_req) == ok_req
+            time.sleep(0.05)
+        st = svc.status()["mesh"]
+        assert st["rung"] == "full" and st["repromotions"] == 1
+        assert req(14, ok_req) == ok_req
+        assert req(15, bad_req) != bad_req
+        assert svc.status()["containment"]["batch_crashes"] == 0
+        assert svc.fallback_entries == 0  # never host-judged rounds
+    finally:
+        if client is not None:
+            client.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()
+
+
+# --- chaos soak: repeated device loss under churn ------------------------
+
+def _churn_policy(j):
+    """Policy-churn payload under its OWN name — forces builder-thread
+    rebuild load without changing the truth table traffic asserts."""
+    return NetworkPolicy(
+        name="churn-pol", policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(port=80, rules=[
+                PortNetworkPolicyRule(
+                    l7_proto="r2d2",
+                    l7_rules=POLICY_RULES[: 1 + (j % len(POLICY_RULES))],
+                )
+            ])
+        ],
+    )
+
+
+def _chaos_soak(tmp_path, name, cycles, n_threads):
+    """Kill a different shard device each cycle, mid-burst, under
+    policy churn; every frame must still be answered exactly once with
+    the policy-truth verdict, and the ladder must end back at full."""
+    from test_sidecar import CORPUS, assert_parity, oracle_ops, \
+        r2d2_policy
+    from test_sidecar_faults import _open_conn, _shim_run
+
+    inst.reset_module_registry()
+    svc = None
+    clients = []
+    try:
+        svc, client, _mod = _start(
+            tmp_path, name, batch_timeout_ms=2.0,
+            mesh_reprobe_interval_s=0.05,
+        )
+        clients.append(client)
+        stop = threading.Event()
+        errors = []
+        counts = [0] * n_threads
+
+        # Sessions, modules, policies, and conns are set up
+        # SEQUENTIALLY (the contract under test is verdict serving
+        # during device loss, not control-plane races); the threads
+        # then drive persistent conns concurrently, each asserting
+        # bit-identical ops vs its HOST-ORACLE walk every pass.
+        def _slice(tid):
+            return CORPUS + [
+                f"READ /public/pod{tid}.txt\r\n".encode(),
+                b"HALT\r\n",
+            ]
+
+        shims, oracles = [], []
+        for tid in range(n_threads):
+            c = SidecarClient(svc.socket_path, timeout=120.0,
+                              identity=f"pod-{tid}")
+            clients.append(c)
+            _m, shim = _open_conn(c, 5000 + tid)
+            shims.append(shim)
+            oracles.append(oracle_ops(r2d2_policy(), _slice(tid)))
+        churn_c = SidecarClient(svc.socket_path, timeout=120.0,
+                                identity="pod-churn")
+        clients.append(churn_c)
+        churn_m = churn_c.open_module([])
+
+        def traffic(tid):
+            try:
+                while not stop.is_set():
+                    out = _shim_run(clients[tid + 1], shims[tid],
+                                    _slice(tid))
+                    assert_parity(out, oracles[tid])
+                    counts[tid] += 1
+            except Exception as exc:  # noqa: BLE001 - soak collector
+                errors.append((tid, "exc", repr(exc)))
+
+        def churn():
+            try:
+                j = 0
+                while not stop.is_set():
+                    # Full policy set each push (policy_update
+                    # REPLACES the instance's map, like an xDS
+                    # snapshot): churn-pol varies, the serving
+                    # policies ride along unchanged.
+                    res = churn_c.policy_update(
+                        churn_m,
+                        [r2d2_policy(), _policy(), _churn_policy(j)],
+                    )
+                    if res != int(FilterResult.OK):
+                        errors.append(("churn", j, res))
+                        return
+                    j += 1
+                    time.sleep(0.01)
+            except Exception as exc:  # noqa: BLE001 - soak collector
+                errors.append(("churn", "exc", repr(exc)))
+
+        threads = [
+            threading.Thread(target=traffic, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        threads.append(threading.Thread(target=churn, daemon=True))
+        for t in threads:
+            t.start()
+        try:
+            for cyc in range(cycles):
+                # Let the full-width mesh serve a burst first.
+                base = list(counts)
+                deadline = time.monotonic() + 60.0
+                while (time.monotonic() < deadline and not errors
+                       and any(c - b < 1
+                               for c, b in zip(counts, base))):
+                    time.sleep(0.02)
+                assert not errors, errors
+                dev = 1 + (cyc % 7)
+                _arm_named_loss(svc, dev)
+                st = _await_rung(svc, "reshaped", timeout=60.0)
+                assert st["lost_devices"] == [dev], st
+                assert not errors, errors
+                # Heal: traffic threads drive the paced re-probe.
+                svc._device_probe_fn = lambda d: True
+                st = _await_rung(svc, "full", timeout=60.0)
+                assert not errors, errors
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60.0)
+        assert not errors, errors
+        assert all(c > 0 for c in counts), counts
+        st = svc.status()
+        assert st["mesh"]["rung"] == "full"
+        assert st["mesh"]["reshapes"] == cycles
+        assert st["mesh"]["repromotions"] == cycles
+        assert st["containment"]["batch_crashes"] == 0
+        assert st["containment"]["error_entries"] == 0
+        assert st["containment"]["shed_entries"] == 0
+        # Exactly-once across every fault cycle: no session lost a
+        # round to the ladder (zero silent loss, zero double replies).
+        rows = {
+            r["identity"]: r for r in st["sessions"]["live"]
+        }
+        for tid in range(n_threads):
+            row = rows[f"pod-{tid}"]
+            assert row["submitted"] == row["answered"], row
+            assert row["shed"] == {}, row
+    finally:
+        for c in clients:
+            c.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()
+
+
+def test_mesh_device_loss_chaos_soak_fast(tmp_path):
+    """Tier-1 chaos soak: two fault->reshape->heal->full cycles under
+    concurrent traffic and policy churn, zero silent loss, zero double
+    replies, every verdict policy-true."""
+    _chaos_soak(tmp_path, "soak-fast", cycles=1, n_threads=2)
+
+
+@pytest.mark.slow
+def test_mesh_device_loss_chaos_soak_long(tmp_path):
+    """Longer soak (BENCH_FULL tier): five cycles, four traffic
+    threads — walks the ladder through most of the device set."""
+    _chaos_soak(tmp_path, "soak-long", cycles=5, n_threads=4)
+
+
+# --- ladder state across hitless restart (satellite 2) --------------------
+
+def test_mesh_ladder_survives_hitless_restart(tmp_path):
+    """snapshot_handoff carries the per-device health table and the
+    degraded width; a restored successor starts DIRECTLY on the
+    reshaped rung (no re-discovery outage) and can still walk back up
+    once the device heals."""
+    inst.reset_module_registry()
+    svc = client = fresh = client2 = None
+    path = str(tmp_path / "handoff-mesh.sock")
+    try:
+        cfg_kw = dict(
+            batch_flows=64, dispatch_mode="jit", batch_timeout_ms=0.0,
+            mesh="on", mesh_rule_shards=2,
+            device_reprobe_interval_s=1e9,
+            mesh_reprobe_interval_s=0.05,
+        )
+        svc = VerdictService(path, DaemonConfig(**cfg_kw)).start()
+        client = SidecarClient(svc.socket_path, timeout=120.0)
+        mod = client.open_module([])
+        assert client.policy_update(mod, [_policy()]) == int(
+            FilterResult.OK
+        )
+        shim = _conn(client, mod, 1, 3)
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert out == b"HALT\r\n"
+        _arm_named_loss(svc, 3)
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        _await_rung(svc, "reshaped")
+
+        snap = svc.snapshot_handoff()
+        assert snap["mesh"] == {"lost": [3], "reshapes": 1}
+        assert snap["guard"]["devices"]["3"]["state"] == "lost"
+        client.close()
+        client = None
+        svc.stop()
+        svc = None
+
+        fresh = VerdictService(path, DaemonConfig(**cfg_kw))
+        assert fresh.restore_handoff(snap) is True
+        # Device 3 is STILL dead across the restart.
+        fresh._device_probe_fn = lambda dev: dev.id != 3
+        fresh.start()
+        client2 = SidecarClient(fresh.socket_path, timeout=120.0)
+        mod2 = client2.open_module([])
+        assert client2.policy_update(mod2, [_policy()]) == int(
+            FilterResult.OK
+        )
+        # Mesh resolution is lazy (first engine build): drive a frame
+        # before inspecting the inherited rung.
+        s1 = _conn(client2, mod2, 1, 3)
+        res, out = s1.on_io(False, b"HALT\r\n")
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        s1.close()
+        st = fresh.status()["mesh"]
+        assert st["rung"] == "reshaped", st
+        assert st["lost_devices"] == [3]
+        assert st["reshapes"] == 1
+        assert 0.0 < st["capacity_frac"] < 1.0
+        assert 3 not in {d.id for d in fresh._mesh_serving.devices.flat}
+        assert fresh.guard.device_table()["3"]["state"] == "lost"
+        # Bit-identical service on the inherited reshaped rung.
+        for i, (frame, remote, want) in enumerate(TRAFFIC):
+            s2 = _conn(client2, mod2, 100 + i, remote)
+            res, out = s2.on_io(False, frame)
+            assert res == int(FilterResult.OK)
+            assert (out == frame) == want, (frame, out)
+            s2.close()
+        # Heal walks back up — inherited degradation is not sticky.
+        fresh._device_probe_fn = lambda dev: True
+        st = _await_rung(fresh, "full", client2, mod2, drive=True)
+        assert st["repromotions"] == 1
+        assert fresh.guard.device_table()["3"]["state"] == "ok"
+    finally:
+        for c in (client, client2):
+            if c is not None:
+                c.close()
+        for s in (svc, fresh):
+            if s is not None:
+                s.stop()
+        inst.reset_module_registry()
+
+
+# --- >32-wide layouts: degenerate shapes (satellite 1, ROADMAP 5b) --------
+
+def test_mesh_extents_64_wide_and_auto_cap():
+    from cilium_tpu.parallel import mesh_extents
+
+    # Explicit 64-wide flow split is honored (no max_flow cap).
+    assert mesh_extents("on", flow_shards=64, n_devices=64) == (64, 1)
+    assert mesh_extents("on", rule_shards=2, flow_shards=64,
+                        n_devices=128) == (64, 2)
+    # AUTO derivation still caps at max_flow.
+    assert mesh_extents("on", n_devices=128) == (32, 1)
+    assert mesh_extents("on", n_devices=128, max_flow=64) == (64, 1)
+    # pow2 floor; infeasible explicit extents resolve to None.
+    assert mesh_extents("on", flow_shards=48, n_devices=64) == (32, 1)
+    assert mesh_extents("on", flow_shards=64, n_devices=32) is None
+    assert mesh_extents("off") is None
+
+
+def test_reshape_mesh_rungs_on_real_devices():
+    import jax
+
+    from cilium_tpu.parallel import (
+        FLOW_AXIS, RULE_AXIS, reshape_mesh,
+    )
+
+    devs = jax.devices()
+    assert len(devs) == 8  # conftest forces 8 virtual CPU devices
+    # 7 survivors, rule extent 2 preserved: pow2 floor -> 2x2.
+    m = reshape_mesh(devs[:3] + devs[4:], rule_shards=2, max_flow=4)
+    assert (m.shape[FLOW_AXIS], m.shape[RULE_AXIS]) == (2, 2)
+    assert devs[3] not in set(m.devices.flat)
+    # 3 survivors still fill rule extent 2 -> 1x2.
+    m = reshape_mesh(devs[:3], rule_shards=2)
+    assert (m.shape[FLOW_AXIS], m.shape[RULE_AXIS]) == (1, 2)
+    # 2 survivors cannot fill rule extent 4 -> halved to 2.
+    m = reshape_mesh(devs[:2], rule_shards=4)
+    assert (m.shape[FLOW_AXIS], m.shape[RULE_AXIS]) == (1, 2)
+    # A lone survivor is below the minimum mesh width.
+    assert reshape_mesh(devs[:1], rule_shards=2) is None
+    assert reshape_mesh([], rule_shards=1) is None
+
+
+def test_sharded_split_64_wide_degenerate_shapes():
+    """64-way splits of tiny row sets: empty shards get never-matching
+    NFA rows, offsets stay monotone, and the stacked model's leading
+    shard dim is the full 64 — the shapes a >32-device pod builds."""
+    import jax
+
+    from cilium_tpu.parallel.rulesharding import (
+        build_sharded_r2d2_from_rows, shard_offsets, split_balanced,
+    )
+
+    rows = [
+        ([1, 3], "READ", "/public/.*"),
+        ([], "HALT", ""),
+        ([9], "WRITE", "^/tmp/"),
+        ([], "", "\\.txt$"),
+    ]
+    chunks = split_balanced(rows, 64)
+    assert len(chunks) == 64
+    assert [len(c) for c in chunks[:4]] == [1, 1, 1, 1]
+    assert all(not c for c in chunks[4:])
+    offs = np.asarray(shard_offsets(len(rows), 64))
+    assert offs.shape == (64,)
+    assert list(offs[:4]) == [0, 1, 2, 3]
+    assert all(int(o) == 4 for o in offs[4:])
+    assert all(b >= a for a, b in zip(offs, offs[1:]))
+
+    model = build_sharded_r2d2_from_rows(rows, 64, bucket=True)
+    lead = {
+        int(x.shape[0])
+        for x in jax.tree_util.tree_leaves(model)
+        if hasattr(x, "shape") and x.shape
+    }
+    assert lead == {64}

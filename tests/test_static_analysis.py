@@ -128,6 +128,7 @@ _CORPUS_CASES = [
     "r15_bad_uncontained_drain",
     "r16_bad_unbucketed.py",
     "r17_bad_snapshot_drift.py",
+    "r17_bad_mesh_field_drift.py",
 ]
 
 _CORPUS_CLEAN = [
@@ -158,9 +159,11 @@ _CORPUS_CLEAN = [
     "r14_good_fanin_slice",
     "r14_good_guarded_reply",
     "r14_good_reasm_release",
+    "r14_good_control_queue",
     "r15_good_per_entry_try",
     "r16_good_bucketed.py",
     "r17_good_snapshot_pair.py",
+    "r17_good_mesh_field_pair.py",
 ]
 
 
