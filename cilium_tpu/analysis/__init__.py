@@ -39,6 +39,20 @@ linter):
   R17 snapshot round-trip symmetry (snapshot_*/restore_* pairs:
       every written field consumed or versioned-out, no hard-
       required field unwritten, no twin missing)
+  R18 declared typestates (every state-field store is a declared
+      protocols.py edge, mediated through advance/guard/
+      require_edges; every counted edge's site emits its declared
+      metric token; the table itself is well-formed)
+  R19 column-store lock discipline (declared shared numpy column
+      families written only with the owning lock held — lexically
+      or at every call site; multi-column snapshots read in ONE
+      lock trip, never torn across separate acquisitions)
+  R20 wire-protocol lifecycle (each MSG_* matches its declared
+      direction, request/reply pairing, fire-and-forget and gate
+      rows; native-shim header enum values stay bit-identical)
+  R21 parity-coverage registry (every runtime-registered framing
+      family carries its full declared landing bar: model, oracle,
+      every-offset parity test, bench config, stress-mix slice)
   R0  lint pragma hygiene (malformed / unjustified suppressions)
 
 Layer 1 is the interprocedural engine (``callgraph.py``): a project-
@@ -47,7 +61,14 @@ blocking/lock summaries and a fixed-point taint pass — what upgrades
 R1/R2/R4 from per-module to whole-program.  Layer 2 is the device-
 contract pair: ``rules_device.py`` (AST half) and ``devicecheck.py``
 (abstract tracing of the REAL verdict models via eval_shape/make_jaxpr
-under JAX_PLATFORMS=cpu — no device, zero runtime cost).
+under JAX_PLATFORMS=cpu — no device, zero runtime cost).  Layer 3
+(v4) is the declared-protocol module (``protocols.py``): typestate
+transition tables, column-store families, wire lifecycle rows and
+engine landing bars as DATA.  The runtime imports and enforces them
+(``Typestate.advance`` raises ``ProtocolViolation`` on an undeclared
+edge) while ``rules_typestate``/``rules_columns``/``rules_protocol``/
+``rules_parity`` prove the tree against the SAME tables — deleting a
+declared edge fails both the checker and the runtime.
 
 Run ``bin/cilium-lint cilium_tpu/`` (see README "Invariants & lint");
 ``--ratchet`` gates the suppression count one-way downward,

@@ -98,6 +98,30 @@ RULE_DOCS = {
            "hard-required restore field may go unwritten, and no "
            "snapshot half may ship without its twin — the "
            "restart-handoff drift class",
+    "R18": "declared typestates: every state-field store must be a "
+           "declared edge of its protocols.py transition table "
+           "(mediated through advance/guard/require_edges), every "
+           "counted edge's site must emit its declared metric token, "
+           "and the table itself must be well-formed (reachable "
+           "states, declared endpoints) — silent state flips and "
+           "uncounted transitions are the bug class",
+    "R19": "column-store lock discipline: every write to a declared "
+           "shared numpy column family (subscript/slice/fill/np.add.at/"
+           "rebind) must be reachable only with the owning lock held "
+           "(lexically or at every call site), and a multi-column "
+           "snapshot must be read in ONE lock trip — torn reads across "
+           "separate acquisitions see half-mutated rows",
+    "R20": "wire-protocol lifecycle: each MSG_* must match its "
+           "declared WIRE_MESSAGES row — direction (who sends/handles "
+           "it), request/reply pairing (the handler reaches a send of "
+           "the declared reply), fire-and-forget consistency, gate "
+           "tokens referenced on both seam ends, and native-shim enum "
+           "values bit-identical on shared names",
+    "R21": "parity-coverage registry: every runtime-registered framing "
+           "family must declare (and actually ship) its landing bar — "
+           "columnar model, host oracle, every-byte-offset parity "
+           "test, bench config, and stress-mix slice — and every "
+           "declared family must be registered",
 }
 
 # ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
@@ -413,8 +437,12 @@ def all_rules():
         rules_handoff,
         rules_jit,
         rules_locks,
+        rules_columns,
         rules_metrics,
+        rules_parity,
+        rules_protocol,
         rules_sockets,
+        rules_typestate,
         rules_wire,
     )
 
@@ -436,6 +464,10 @@ def all_rules():
         rules_contain.check_r15,
         rules_device.check_r16,
         rules_handoff.check_r17,
+        rules_typestate.check_r18,
+        rules_columns.check_r19,
+        rules_protocol.check_r20,
+        rules_parity.check_r21,
     ]
 
 
@@ -449,6 +481,13 @@ def _run_rule_cached(rule, files):
 
     memo = get_graph(files).rule_memo
     key = f"{rule.__module__}.{rule.__qualname__}"
+    # Rules that consult files OUTSIDE the scanned set (the native
+    # header, tests/, bench.py) expose a ``memo_extra`` callable whose
+    # digest of that external state joins the memo key — otherwise an
+    # edit out there would re-serve stale findings from the memo.
+    extra = getattr(rule, "memo_extra", None)
+    if extra is not None:
+        key += ":" + extra(files)
     got = memo.get(key)
     if got is None:
         got = list(rule(files))

@@ -95,13 +95,19 @@ def _raise_reason(node: ast.Raise) -> str:
 
 
 def _is_stub_raise(node: ast.Raise) -> bool:
+    # NotImplementedError: abstract contract, not a crash path.
+    # ProtocolViolation: the typestate tables' fail-closed assertion —
+    # R18 statically proves every in-tree mediated transition is a
+    # declared edge, so these raises are machine-checked-unreachable
+    # invariant backstops; counting them would demand a pragma on
+    # every mediated state flip inside the hot loops.
     exc = node.exc
     name = ""
     if isinstance(exc, ast.Call):
         name = unparse(exc.func)
     elif exc is not None:
         name = unparse(exc)
-    return "NotImplementedError" in name
+    return "NotImplementedError" in name or "ProtocolViolation" in name
 
 
 # --- per-function facts ---------------------------------------------------

@@ -45,6 +45,11 @@ from dataclasses import asdict, dataclass, field
 
 import numpy as np
 
+from ..analysis.protocols import (
+    GRANT_ARMED,
+    GRANT_NONE,
+    GRANT_PROTOCOL,
+)
 from ..proxylib.types import DROP, ERROR, INJECT, MORE, PASS, FilterResult
 from ..utils import metrics
 from ..utils.backoff import Exponential
@@ -364,6 +369,15 @@ class SidecarClient:
         # grant's frame-alignment check — CRLF tail vs length-prefix
         # walk — so non-CRLF conns get the local tier too.
         self._grant_framing = np.empty(0, np.int8)
+        # Grant-table WRITE lock (reader thread grants/revokes vs the
+        # caller-thread close sweep vs the reconnect-loop reset; R19's
+        # declared owner for the _grant_* columns).  Reads stay
+        # deliberately lock-free: the epoch-equality liveness gate
+        # makes a torn READ at worst a missed short-circuit, and the
+        # row's data columns are published BEFORE the epoch (the gate)
+        # in _on_cache_grant, so a reader that passes _grant_valid
+        # never sees another grant's rule/framing.
+        self._glock = threading.Lock()
         self._service_epoch = 0
         self.cache_hits = 0
         self.cache_hit_bytes = 0
@@ -805,10 +819,18 @@ class SidecarClient:
             return
         if epoch > self._service_epoch:
             self._service_epoch = epoch
-        if self._grant_ensure(conn_id):
-            self._grant_epoch[conn_id] = epoch
-            self._grant_rule[conn_id] = rule
-            self._grant_framing[conn_id] = code
+        with self._glock:
+            if self._grant_ensure(conn_id):
+                # Publish order matters for the lock-free readers: the
+                # data columns (rule, framing) land BEFORE the epoch —
+                # the epoch-equality check in _grant_valid is the
+                # liveness gate, so a reader must never pass the gate
+                # and then read a previous grant's rule/framing.
+                self._grant_rule[conn_id] = rule
+                self._grant_framing[conn_id] = code
+                self._grant_epoch[conn_id] = GRANT_PROTOCOL.guard(
+                    GRANT_NONE, GRANT_ARMED, epoch
+                )
 
     def _on_cache_revoke(self, payload: bytes) -> None:
         epoch = wire.unpack_cache_revoke(payload)
@@ -818,17 +840,29 @@ class SidecarClient:
             self._service_epoch = epoch
 
     def _grant_drop(self, conn_id: int) -> None:
-        if conn_id < len(self._grant_epoch):
-            self._grant_epoch[conn_id] = -1
-            self._grant_rule[conn_id] = -1
-            self._grant_framing[conn_id] = -1
+        with self._glock:
+            if conn_id < len(self._grant_epoch):
+                # Tombstone the gate FIRST, then the data columns: the
+                # reverse of the grant publish order, so a concurrent
+                # lock-free reader never passes the epoch gate on a
+                # half-dropped row.
+                self._grant_epoch[conn_id] = GRANT_PROTOCOL.require_edges(
+                    (GRANT_ARMED, GRANT_NONE), GRANT_NONE
+                )
+                self._grant_rule[conn_id] = -1
+                self._grant_framing[conn_id] = -1
 
     def _reset_grants(self) -> None:
         """A (re)connected service has no memory of this session's
         grants; drop them all (fail-safe: the normal path serves)."""
-        self._grant_epoch.fill(-1)
-        self._grant_rule.fill(-1)
-        self._grant_framing.fill(-1)
+        with self._glock:
+            self._grant_epoch.fill(
+                GRANT_PROTOCOL.require_edges(
+                    (GRANT_ARMED, GRANT_NONE), GRANT_NONE
+                )
+            )
+            self._grant_rule.fill(-1)
+            self._grant_framing.fill(-1)
 
     def _count_cache_hits(self, n: int, nbytes: int) -> None:
         self.cache_hits += n

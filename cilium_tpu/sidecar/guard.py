@@ -31,6 +31,15 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from ..analysis.protocols import (
+    DEVICE_GUARD_PROTOCOL,
+    DEVICE_LOST,
+    DEVICE_OK,
+    GUARD_QUARANTINED,
+    GUARD_SERVING,
+    MESH_DEVICE_PROTOCOL,
+)
+
 log = logging.getLogger(__name__)
 
 
@@ -61,7 +70,10 @@ class DeviceGuard:
         self.fail_threshold = fail_threshold
         self.on_change = on_change
         self._lock = threading.Lock()
-        self.quarantined = False
+        # The quarantine latch is a DECLARED typestate (protocols.py):
+        # every flip routes through DEVICE_GUARD_PROTOCOL.advance, and
+        # the public ``quarantined`` bool is a read-only view.
+        self._latch = GUARD_SERVING
         self.reason = ""
         self.stalls = 0
         self.quarantine_events = 0
@@ -106,13 +118,19 @@ class DeviceGuard:
     def enabled(self) -> bool:
         return self.timeout_s > 0
 
+    @property
+    def quarantined(self) -> bool:
+        return self._latch == GUARD_QUARANTINED
+
     # -- transitions ------------------------------------------------------
 
     def quarantine(self, reason: str) -> None:
         with self._lock:
-            if self.quarantined:
+            if self._latch == GUARD_QUARANTINED:
                 return
-            self.quarantined = True
+            self._latch = DEVICE_GUARD_PROTOCOL.advance(
+                self._latch, GUARD_QUARANTINED
+            )
             self.reason = reason
             self.quarantine_events += 1
             self._quarantined_at = time.monotonic()
@@ -187,9 +205,11 @@ class DeviceGuard:
 
     def _heal(self) -> None:
         with self._lock:
-            if not self.quarantined:
+            if self._latch != GUARD_QUARANTINED:
                 return
-            self.quarantined = False
+            self._latch = DEVICE_GUARD_PROTOCOL.advance(
+                self._latch, GUARD_SERVING
+            )
             self.reason = ""
             self._crash_streak = 0
             self._tainted = False
@@ -214,9 +234,11 @@ class DeviceGuard:
         key = str(device)
         with self._lock:
             row = self._devices.setdefault(
-                key, {"state": "ok", "faults": {}, "heals": 0}
+                key, {"state": DEVICE_OK, "faults": {}, "heals": 0}
             )
-            row["state"] = "lost"
+            row["state"] = MESH_DEVICE_PROTOCOL.advance(
+                row["state"], DEVICE_LOST
+            )
             row["faults"][reason] = row["faults"].get(reason, 0) + 1
         log.warning("mesh device %s marked lost: %s", key, reason)
 
@@ -226,9 +248,11 @@ class DeviceGuard:
         key = str(device)
         with self._lock:
             row = self._devices.get(key)
-            if row is None or row["state"] == "ok":
+            if row is None or row["state"] == DEVICE_OK:
                 return
-            row["state"] = "ok"
+            row["state"] = MESH_DEVICE_PROTOCOL.advance(
+                row["state"], DEVICE_OK
+            )
             row["heals"] = row.get("heals", 0) + 1
         log.warning("mesh device %s healed (probe succeeded)", key)
 
@@ -236,7 +260,7 @@ class DeviceGuard:
         with self._lock:
             return sorted(
                 k for k, r in self._devices.items()
-                if r["state"] == "lost"
+                if r["state"] == DEVICE_LOST
             )
 
     def device_table(self) -> dict:
@@ -351,7 +375,7 @@ class DeviceGuard:
             if not isinstance(r, dict):
                 continue
             state = r.get("state")
-            if state not in ("ok", "lost"):
+            if state not in (DEVICE_OK, DEVICE_LOST):
                 continue
             try:
                 faults = {
@@ -371,8 +395,10 @@ class DeviceGuard:
             self._quarantined_total_s = total_s
             if devices:
                 self._devices = devices
-            if quarantined and not self.quarantined:
-                self.quarantined = True
+            if quarantined and self._latch != GUARD_QUARANTINED:
+                self._latch = DEVICE_GUARD_PROTOCOL.advance(
+                    self._latch, GUARD_QUARANTINED
+                )
                 self.reason = reason or "restored"
                 self._quarantined_at = time.monotonic()
                 self._last_probe = 0.0  # probe may fire immediately

@@ -57,6 +57,20 @@ from ..flowlog import (
 )
 from ..models.base import ConstVerdict
 from ..proxylib import instance as pl
+from ..analysis.protocols import (
+    CACHE_ARMED,
+    CACHE_DECLINED,
+    CACHE_UNARMED,
+    EPOCH_SWAP_PROTOCOL,
+    FLOW_CACHE_PROTOCOL,
+    MESH_FALLBACK,
+    MESH_FULL,
+    MESH_LADDER_PROTOCOL,
+    MESH_RESHAPED,
+    SWAP_COMMITTED,
+    SWAP_REJECTED,
+    SWAP_STAGED,
+)
 from ..proxylib.accesslog import EntryType, LogEntry
 from ..proxylib.npds import policy_from_dict
 from ..proxylib.types import DROP, ERROR, MORE, PASS, FilterResult, OpError
@@ -218,7 +232,8 @@ class EpochParityError(AssertionError):
 class _SwapJob:
     """One staged policy-table swap riding the builder queue."""
 
-    __slots__ = ("module_id", "staged_map", "done", "status", "epoch")
+    __slots__ = ("module_id", "staged_map", "done", "status", "epoch",
+                 "phase")
 
     def __init__(self, module_id: int, staged_map):
         self.module_id = module_id
@@ -226,6 +241,9 @@ class _SwapJob:
         self.done = threading.Event()
         self.status = int(FilterResult.UNKNOWN_ERROR)
         self.epoch = -1
+        # Typestate: staged -> committed | rejected, mediated through
+        # EPOCH_SWAP_PROTOCOL (a job never leaves the terminal states).
+        self.phase = SWAP_STAGED
 
 
 class _TabSnap:
@@ -1270,6 +1288,9 @@ class VerdictService:
                         return
                     if kind == "swap":
                         self._swap_failed("shutdown")
+                        job.phase = EPOCH_SWAP_PROTOCOL.advance(
+                            job.phase, SWAP_REJECTED
+                        )
                         job.status = int(FilterResult.UNKNOWN_ERROR)
                         job.epoch = self.policy_epoch
                         job.done.set()
@@ -1299,6 +1320,12 @@ class VerdictService:
                 log.exception("policy builder job failed")
                 if kind == "swap":
                     self._swap_failed("device-build")
+                    if job.phase == SWAP_STAGED:
+                        # A job that already reached a terminal phase
+                        # inside _run_swap stays there.
+                        job.phase = EPOCH_SWAP_PROTOCOL.advance(
+                            job.phase, SWAP_REJECTED
+                        )
                     job.status = int(FilterResult.POLICY_DROP)
                     job.epoch = self.policy_epoch
                     job.done.set()
@@ -1317,6 +1344,9 @@ class VerdictService:
         module_id = job.module_id
         ins = pl.find_instance(module_id)
         if ins is None:
+            self._swap_failed("no-instance")
+            job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
+                                                    SWAP_REJECTED)
             job.status = int(FilterResult.INVALID_INSTANCE)
             job.epoch = self.policy_epoch
             job.done.set()
@@ -1363,6 +1393,8 @@ class VerdictService:
         except EpochParityError:
             log.exception("policy swap rejected (epoch parity)")
             self._swap_failed("parity")
+            job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
+                                                    SWAP_REJECTED)
             job.status = int(FilterResult.POLICY_DROP)
             job.epoch = self.policy_epoch
             job.done.set()
@@ -1370,6 +1402,8 @@ class VerdictService:
         except Exception:  # noqa: BLE001 — fail closed, old epoch serves
             log.exception("policy swap rejected (device build)")
             self._swap_failed("device-build")
+            job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
+                                                    SWAP_REJECTED)
             job.status = int(FilterResult.POLICY_DROP)
             job.epoch = self.policy_epoch
             job.done.set()
@@ -1382,6 +1416,8 @@ class VerdictService:
         self._send_cache_revokes(epoch)
         self._commit_epoch(ins, mods, job.staged_map, new_engines,
                            epoch)
+        job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
+                                                SWAP_COMMITTED)
         job.status = int(FilterResult.OK)
         job.epoch = epoch
         job.done.set()
@@ -1432,7 +1468,11 @@ class VerdictService:
             if self._flow_cache_on and self._tab_size:
                 armed = self._tab_cache == 1
                 invalidated = int(armed.sum())
-                self._tab_cache[self._tab_cache != 0] = 0
+                self._tab_cache[self._tab_cache != 0] = (
+                    FLOW_CACHE_PROTOCOL.require_edges(
+                        (CACHE_ARMED, CACHE_DECLINED), CACHE_UNARMED
+                    )
+                )
                 self._tab_cache_epoch[:] = -1
                 self._tab_cache_rule[:] = -1
                 self._cache_armed = 0
@@ -2030,7 +2070,9 @@ class VerdictService:
                 rule = int(claim[1])
                 if not was_armed:
                     self._cache_armed += 1
-                self._tab_cache[conn_id] = 1
+                self._tab_cache[conn_id] = FLOW_CACHE_PROTOCOL.advance(
+                    self._tab_cache[conn_id], CACHE_ARMED
+                )
                 self._tab_cache_epoch[conn_id] = epoch
                 self._tab_cache_rule[conn_id] = rule
                 self._tab_seen_tick[conn_id] = self._next_cache_tick()
@@ -2046,7 +2088,9 @@ class VerdictService:
             # Mirror the status counter: an armed row losing its claim
             # on re-arm is an invalidation in both surfaces.
             metrics.VerdictCacheInvalidations.inc("re-arm")
-        self._tab_cache[conn_id] = 2
+        self._tab_cache[conn_id] = FLOW_CACHE_PROTOCOL.advance(
+            self._tab_cache[conn_id], CACHE_DECLINED
+        )
         self._tab_cache_epoch[conn_id] = epoch
         self._tab_cache_rule[conn_id] = -1
         return None
@@ -2063,6 +2107,7 @@ class VerdictService:
         ids = np.asarray(conn_ids, np.int64)
         ids = ids[(ids >= 0) & (ids < self._tab_size)]
         if len(ids):
+            # lint: disable=R19 -- deliberately lock-free on the dispatch hot path: _tab_seen_tick is an advisory LRU recency stamp; a race with table growth costs at worst one stale stamp (a marginally suboptimal eviction), never correctness, and taking _lock here would serialize every cache-hit round
             self._tab_seen_tick[ids] = self._next_cache_tick()
 
     def _evict_flow_cache_lru(self) -> None:
@@ -2076,7 +2121,10 @@ class VerdictService:
         if not len(armed):
             return
         victim = int(armed[np.argmin(self._tab_seen_tick[armed])])
-        self._tab_cache[victim] = 0  # unchecked: re-armable later
+        # Back to unarmed: re-armable later.
+        self._tab_cache[victim] = FLOW_CACHE_PROTOCOL.advance(
+            self._tab_cache[victim], CACHE_UNARMED
+        )
         self._tab_cache_epoch[victim] = -1
         self._tab_cache_rule[victim] = -1
         self._cache_armed -= 1
@@ -2095,7 +2143,9 @@ class VerdictService:
             self.cache_invalidations += 1
             if reason is not None:
                 metrics.VerdictCacheInvalidations.inc(reason)
-        self._tab_cache[conn_id] = 0
+        self._tab_cache[conn_id] = FLOW_CACHE_PROTOCOL.advance(
+            self._tab_cache[conn_id], CACHE_UNARMED
+        )
         self._tab_cache_epoch[conn_id] = -1
         self._tab_cache_rule[conn_id] = -1
 
@@ -2123,7 +2173,9 @@ class VerdictService:
                     live.append(
                         (client,
                          wire.pack_cache_grant(
-                             conn_id, epoch, rule, framing=fkind
+                             conn_id, epoch, rule,
+                             flags=wire.CACHE_FLAG_ALLOW,
+                             framing=fkind,
                          ))
                     )
         for client, payload in live:
@@ -2406,11 +2458,12 @@ class VerdictService:
         otherwise cycle the ring and bury the one dead row that
         mattered (the pod that crashed)."""
         relevant = sess.named or sess.submitted > 0
-        if relevant:
-            sess.mark_dead(sess.death_reason or reason)
-        else:
-            sess.state = SESSION_DEAD
-            sess.death_reason = sess.death_reason or reason
+        # Both arms route through the declared-edge mediation (R18):
+        # the control-plane arm records the death reason without
+        # bumping the typed metric, instead of flipping the state
+        # field bare (which would also skip the dead-stays-dead and
+        # declared-edge checks mark_dead enforces).
+        sess.mark_dead(sess.death_reason or reason, counted=relevant)
         with self._sess_lock:
             if self._sessions.pop(sess.id, None) is not None and relevant:
                 self._dead_sessions.append(sess.status())
@@ -3964,6 +4017,8 @@ class VerdictService:
                     max_flow=mesh.shape[FLOW_AXIS],
                 )
         if target is not None:
+            MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
+                                         MESH_RESHAPED)
             self._mesh_serving = target
             log.warning(
                 "mesh resumes RESHAPED from handoff: %d device(s) "
@@ -3972,6 +4027,8 @@ class VerdictService:
                 target.shape[RULE_AXIS],
             )
         else:
+            MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
+                                         MESH_FALLBACK)
             self._mesh_demoted = "handoff-degraded"
             self.mesh_demotions["handoff-degraded"] = (
                 self.mesh_demotions.get("handoff-degraded", 0) + 1
@@ -4108,6 +4165,17 @@ class VerdictService:
         # sees the new fraction (not up to 50ms later).
         self._share_ts = 0.0
 
+    def _mesh_rung(self) -> str:
+        """The CURRENT width-ladder rung, derived from the two mesh
+        pointers (the ladder is a ``derived``-kind typestate: no single
+        stored field, so flip sites validate their edge through
+        MESH_LADDER_PROTOCOL.advance against this derivation)."""
+        if self._mesh_demoted is not None:
+            return MESH_FALLBACK
+        if self._mesh_serving is not None:
+            return MESH_RESHAPED
+        return MESH_FULL
+
     def _demote_mesh(self, reason: str, exc=None) -> None:
         """PR 2 ladder, mesh rung: a lost/erroring mesh device demotes
         the whole service to the single-chip executables — one pointer
@@ -4130,6 +4198,8 @@ class VerdictService:
             self._mesh_lost |= attributed
             if self._mesh_demoted is None:
                 first = True
+                MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
+                                             MESH_FALLBACK)
                 self._mesh_demoted = reason
                 self._mesh_serving = None
                 self._mesh_fault_at = time.monotonic()
@@ -4395,6 +4465,10 @@ class VerdictService:
                     if target is full:
                         eng._mesh_model = None
                     flipped += 1
+                MESH_LADDER_PROTOCOL.advance(
+                    self._mesh_rung(),
+                    MESH_FULL if target is full else MESH_RESHAPED,
+                )
                 self._mesh_serving = None if target is full else target
                 self._mesh_demoted = None
             if target is full:
@@ -4461,6 +4535,7 @@ class VerdictService:
                     eng.model = mm
                     eng._mesh_model = None
                     promoted += 1
+            MESH_LADDER_PROTOCOL.advance(self._mesh_rung(), MESH_FULL)
             self._mesh_demoted = None
             self._mesh_serving = None
             # ROADMAP 1c: engines BUILT while demoted hold plain

@@ -24,6 +24,12 @@ from __future__ import annotations
 import threading
 import time
 
+from ..analysis.protocols import (
+    SESSION_ACTIVE,
+    SESSION_DEAD,
+    SESSION_PROTOCOL,
+    SESSION_QUARANTINED,
+)
 from ..utils import metrics
 from .shm import ShmRing
 
@@ -54,9 +60,10 @@ CREDIT_FLAG_QUARANTINED = 1
 # reasons below — a misbehaving pod can be quarantined, demoted or
 # shed without its neighbors losing a byte.
 
-SESSION_ACTIVE = "active"
-SESSION_QUARANTINED = "quarantined"
-SESSION_DEAD = "dead"
+# SESSION_ACTIVE / SESSION_QUARANTINED / SESSION_DEAD and the declared
+# transition table live in analysis/protocols.py (one definition: the
+# R18 lint pass and this runtime consume the SAME edges) and are
+# re-exported here for the historical import surface.
 
 # Session-scoped shed reasons (sidecar_session_shed_total labels,
 # alongside the global queue_full/deadline/stall reasons).
@@ -137,8 +144,13 @@ class SessionState:
         """Latch this session (and only this session) off the data
         plane for ``cooldown_s``: its submissions are answered with
         typed SHED immediately, its control plane keeps serving, and
-        the latch self-heals when the window passes."""
-        self.state = SESSION_QUARANTINED
+        the latch self-heals when the window passes.  A dead session
+        stays dead — quarantining a corpse is not a declared edge."""
+        if self.state == SESSION_DEAD:
+            return
+        self.state = SESSION_PROTOCOL.advance(
+            self.state, SESSION_QUARANTINED
+        )
         self.quarantine_reason = reason
         self.quarantined_until = time.monotonic() + cooldown_s
         self.quarantines[reason] = self.quarantines.get(reason, 0) + 1
@@ -152,16 +164,28 @@ class SessionState:
         if self.state != SESSION_QUARANTINED:
             return False
         if time.monotonic() >= self.quarantined_until:
-            self.state = SESSION_ACTIVE
+            # Declared-silent lazy heal (protocols.py: the quarantine
+            # OPEN was the counted event; the close is traffic-driven).
+            self.state = SESSION_PROTOCOL.advance(
+                self.state, SESSION_ACTIVE
+            )
             self.quarantine_reason = None
             return False
         return True
 
-    def mark_dead(self, reason: str) -> None:
+    def mark_dead(self, reason: str, counted: bool = True) -> None:
+        """Terminal edge.  ``counted=False`` records the death without
+        bumping the typed metric — the control-plane-session arm (a
+        session that never carried data is not an operator-facing
+        death), while still routing the transition through the ONE
+        declared-edge mediation point."""
         if self.state != SESSION_DEAD:
-            self.state = SESSION_DEAD
+            self.state = SESSION_PROTOCOL.advance(
+                self.state, SESSION_DEAD
+            )
             self.death_reason = reason
-            metrics.SidecarSessionDeaths.inc(reason)
+            if counted:
+                metrics.SidecarSessionDeaths.inc(reason)
 
     # -- accounting --------------------------------------------------------
 

@@ -22,6 +22,13 @@ except AttributeError:
     )
 
 
+# The lint corpus holds deliberately-broken KNOWN-BAD snippets for the
+# analyzer's regression suite — some (the R21 landing-bar twins) are
+# named test_*.py because the rule checks parity-test file naming.
+# They are analyzer INPUT, never runnable tests.
+collect_ignore = ["lint_corpus"]
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

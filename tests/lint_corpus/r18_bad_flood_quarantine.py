@@ -1,0 +1,38 @@
+"""KNOWN-BAD corpus (R18): the PR 15 DRR flood-quarantine shape.
+
+The multi-tenant fan-in's flood path flipped a session straight to
+``quarantined`` with a bare attribute store — skipping the mediated
+transition AND the typed quarantine counter, so a flood-quarantined
+tenant was invisible to operators until its verdicts stalled.  The
+mediated edge carries ``"SessionQuarantines"`` as its declared
+outcome; the bare store bypasses both the edge check and the count.
+"""
+
+from cilium_tpu.analysis.protocols import Typestate
+
+SESS_ACTIVE = "active"
+SESS_QUARANTINED = "quarantined"
+
+FANIN_SESSION = Typestate(
+    name="fanin_session",
+    owner="FaninSession",
+    field="state",
+    kind="attr",
+    states=(SESS_ACTIVE, SESS_QUARANTINED),
+    initial=SESS_ACTIVE,
+    edges={
+        (SESS_ACTIVE, SESS_QUARANTINED): "SessionQuarantines",
+        (SESS_QUARANTINED, SESS_ACTIVE): None,
+    },
+)
+
+
+class FaninSession:
+    def __init__(self) -> None:
+        self.state = SESS_ACTIVE
+        self.backlog = 0
+
+    def on_flood(self, backlog: int, cap: int) -> None:
+        self.backlog = backlog
+        if backlog > cap:
+            self.state = SESS_QUARANTINED  # EXPECT[R18]

@@ -1,0 +1,44 @@
+"""KNOWN-BAD corpus (R18): every typestate drift mode.
+
+- ``"wedged"`` is declared but no edge reaches it — the unreachable-
+  state shape a deleted edge leaves behind (the checker half of the
+  "delete an edge and both the checker and the runtime fail" pin).
+- ``shut`` flips the field with a bare store, skipping the mediated
+  transition that enforces the edge set at runtime.
+- ``reopen`` advances toward a state the table never declared.
+- ``close_silent`` rides a counted edge (outcome ``"port_closes"``)
+  but its function body never emits the token.
+"""
+
+from cilium_tpu.analysis.protocols import Typestate
+
+LIT_OPEN = "open"
+LIT_SHUT = "shut"
+
+PORT_PROTOCOL = Typestate(  # EXPECT[R18]
+    name="port",
+    owner="Port",
+    field="state",
+    kind="attr",
+    states=(LIT_OPEN, LIT_SHUT, "wedged"),
+    initial=LIT_OPEN,
+    edges={
+        (LIT_OPEN, LIT_SHUT): "port_closes",
+        (LIT_SHUT, LIT_OPEN): None,
+    },
+)
+
+
+class Port:
+    def __init__(self) -> None:
+        self.state = LIT_OPEN
+        self.port_closes = 0
+
+    def shut(self) -> None:
+        self.state = LIT_SHUT  # EXPECT[R18]
+
+    def reopen(self) -> None:
+        self.state = PORT_PROTOCOL.advance(self.state, "missing")  # EXPECT[R18]
+
+    def close_silent(self) -> None:
+        self.state = PORT_PROTOCOL.advance(self.state, LIT_SHUT)  # EXPECT[R18]

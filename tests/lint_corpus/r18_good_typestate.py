@@ -1,0 +1,35 @@
+"""KNOWN-GOOD corpus (R18): mediated transitions, declared edges, and
+the counted edge's token emitted at the transition site.  ``__init__``
+assigning the declared initial state is the one sanctioned bare store.
+"""
+
+from cilium_tpu.analysis.protocols import Typestate
+
+LIT_OPEN = "open"
+LIT_SHUT = "shut"
+
+PORT_PROTOCOL = Typestate(
+    name="port",
+    owner="Port",
+    field="state",
+    kind="attr",
+    states=(LIT_OPEN, LIT_SHUT),
+    initial=LIT_OPEN,
+    edges={
+        (LIT_OPEN, LIT_SHUT): "port_closes",
+        (LIT_SHUT, LIT_OPEN): None,
+    },
+)
+
+
+class Port:
+    def __init__(self) -> None:
+        self.state = LIT_OPEN
+        self.port_closes = 0
+
+    def shut(self) -> None:
+        self.state = PORT_PROTOCOL.advance(self.state, LIT_SHUT)
+        self.port_closes += 1
+
+    def reopen(self) -> None:
+        self.state = PORT_PROTOCOL.advance(self.state, LIT_OPEN)

@@ -1,0 +1,32 @@
+"""KNOWN-BAD corpus (R19): PR 12's stale-grant re-arm shape.
+
+The shim grant table's re-arm path skipped the grant lock, so a
+concurrent revoke's tombstone landed BETWEEN the two column stores —
+rule row from the new grant, epoch from the tombstone — and the shim
+kept short-circuiting on a stale rule for the life of the conn."""
+
+import threading
+
+import numpy as np
+
+COLUMN_STORES = (
+    {"name": "shim_grants", "owner": "ShimClient", "prefix": "_grant_",
+     "lock": "_glock"},
+)
+
+
+class ShimClient:
+    def __init__(self) -> None:
+        self._glock = threading.Lock()
+        self._grant_rule = np.full(8, -1, np.int64)
+        self._grant_epoch = np.full(8, -1, np.int64)
+
+    def on_grant(self, conn_id: int, rule: int, epoch: int) -> None:
+        with self._glock:
+            self._grant_rule[conn_id] = rule
+            self._grant_epoch[conn_id] = epoch
+
+    def rearm_after_revoke(self, conn_id: int, rule: int,
+                           epoch: int) -> None:
+        self._grant_rule[conn_id] = rule  # EXPECT[R19]
+        self._grant_epoch[conn_id] = epoch  # EXPECT[R19]

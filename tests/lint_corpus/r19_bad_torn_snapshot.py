@@ -1,0 +1,30 @@
+"""KNOWN-BAD corpus (R19): a two-column snapshot assembled across TWO
+separate owning-lock trips — a row mutated between them yields a
+state from one generation and an epoch from another."""
+
+import threading
+
+import numpy as np
+
+COLUMN_STORES = (
+    {"name": "rows", "owner": "Table", "prefix": "_col_",
+     "lock": "_lock"},
+)
+
+
+class Table:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._col_state = np.zeros(8, np.int8)
+        self._col_epoch = np.zeros(8, np.int64)
+
+    def read_row(self, i: int):  # EXPECT[R19]
+        with self._lock:
+            state = int(self._col_state[i])
+        with self._lock:
+            epoch = int(self._col_epoch[i])
+        return state, epoch
+
+    def read_row_ok(self, i: int):
+        with self._lock:
+            return int(self._col_state[i]), int(self._col_epoch[i])

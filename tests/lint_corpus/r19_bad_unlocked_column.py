@@ -1,0 +1,27 @@
+"""KNOWN-BAD corpus (R19): a shared-column write reachable with the
+owning lock never held — ``sloppy_touch`` is an unprotected entry
+point (zero scanned callers, no lexical lock)."""
+
+import threading
+
+import numpy as np
+
+COLUMN_STORES = (
+    {"name": "rows", "owner": "Table", "prefix": "_col_",
+     "lock": "_lock"},
+)
+
+
+class Table:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._col_state = np.zeros(8, np.int8)
+        self._col_epoch = np.zeros(8, np.int64)
+
+    def arm(self, i: int, epoch: int) -> None:
+        with self._lock:
+            self._col_state[i] = 1
+            self._col_epoch[i] = epoch
+
+    def sloppy_touch(self, i: int) -> None:
+        self._col_state[i] = 2  # EXPECT[R19]

@@ -1,0 +1,36 @@
+"""KNOWN-GOOD corpus (R19): every column write is lock-protected —
+lexically, or interprocedurally (``_store`` is unheld at the write but
+every scanned caller takes the owning lock first) — and the
+multi-column read takes its snapshot in ONE lock trip."""
+
+import threading
+
+import numpy as np
+
+COLUMN_STORES = (
+    {"name": "rows", "owner": "Table", "prefix": "_col_",
+     "lock": "_lock"},
+)
+
+
+class Table:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._col_state = np.zeros(8, np.int8)
+        self._col_epoch = np.zeros(8, np.int64)
+
+    def arm(self, i: int, epoch: int) -> None:
+        with self._lock:
+            self._store(i, 1, epoch)
+
+    def disarm(self, i: int) -> None:
+        with self._lock:
+            self._store(i, 0, -1)
+
+    def _store(self, i: int, v: int, epoch: int) -> None:
+        self._col_state[i] = v
+        self._col_epoch[i] = epoch
+
+    def snapshot(self, i: int):
+        with self._lock:
+            return int(self._col_state[i]), int(self._col_epoch[i])

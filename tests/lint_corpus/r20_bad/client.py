@@ -1,0 +1,16 @@
+from . import wire  # EXPECT[R20]
+
+
+def pump(sock):
+    send(sock, wire.MSG_ASK, b"")
+    send(sock, wire.MSG_FLOOD, b"")
+    reply = sock.recv(1)[0]
+    if reply == wire.MSG_ANSWER:
+        return True
+    if reply == wire.MSG_GHOST:
+        return None
+    return None
+
+
+def send(sock, msg_type, payload):
+    sock.sendall(bytes([msg_type]) + payload)

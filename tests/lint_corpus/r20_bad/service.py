@@ -1,0 +1,18 @@
+from . import wire  # EXPECT[R20]
+
+
+def handle(sock, msg_type, payload):  # EXPECT[R20]
+    if msg_type == wire.MSG_ASK:
+        return "ask"
+    if msg_type == wire.MSG_FLOOD:
+        send(sock, wire.MSG_FLOOD, payload)  # EXPECT[R20]
+        return "flood"
+    if msg_type == wire.MSG_ANSWER:
+        return None
+    if msg_type == wire.MSG_GHOST:
+        return None
+    return None
+
+
+def send(sock, msg_type, payload):
+    sock.sendall(bytes([msg_type]) + payload)

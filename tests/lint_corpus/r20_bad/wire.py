@@ -1,0 +1,15 @@
+"""KNOWN-BAD corpus (R20): lifecycle drift in every direction."""  # EXPECT[R20]
+
+MSG_ASK = 1
+MSG_ANSWER = 2
+MSG_FLOOD = 3
+MSG_GHOST = 4
+
+WIRE_MESSAGES = {  # EXPECT[R20]
+    "MSG_ASK": {"dir": "c2s", "reply": "MSG_ANSWER", "fnf": False,
+                "deferred": False, "gates": ()},
+    "MSG_ANSWER": {"dir": "s2c", "reply": None, "fnf": True,
+                   "deferred": False, "gates": ("ANSWER_GATE",)},
+    "MSG_FLOOD": {"dir": "c2s", "reply": "MSG_NOPE", "fnf": True,
+                  "deferred": False, "gates": ()},
+}

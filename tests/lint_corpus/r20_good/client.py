@@ -1,0 +1,18 @@
+from . import wire
+
+
+def request(sock):
+    send(sock, wire.MSG_PING, bytes([wire.PING_VERSION]))
+    reply = sock.recv(1)[0]
+    if reply == wire.MSG_PONG:
+        return True
+    return None
+
+
+def goodbye(sock):
+    send(sock, wire.MSG_BYE, b"")
+    return None
+
+
+def send(sock, msg_type, payload):
+    sock.sendall(bytes([msg_type]) + payload)

@@ -1,0 +1,18 @@
+from . import wire
+
+
+def handle(sock, msg_type, payload):
+    if msg_type == wire.MSG_PING:
+        if payload and payload[0] > wire.PING_VERSION:
+            return None
+        send(sock, wire.MSG_PONG, payload)
+        return "pong"
+    if msg_type == wire.MSG_BYE:
+        return "bye"
+    if msg_type == wire.MSG_PONG:
+        return None
+    return None
+
+
+def send(sock, msg_type, payload):
+    sock.sendall(bytes([msg_type]) + payload)

@@ -1,0 +1,18 @@
+"""KNOWN-GOOD corpus (R20): every message's lifecycle matches its
+declared row — directions honored, the request handler reaches its
+declared reply send, gates referenced on both seam ends."""
+
+MSG_PING = 1
+MSG_PONG = 2
+MSG_BYE = 3
+
+PING_VERSION = 1
+
+WIRE_MESSAGES = {
+    "MSG_PING": {"dir": "c2s", "reply": "MSG_PONG", "fnf": False,
+                 "deferred": False, "gates": ("PING_VERSION",)},
+    "MSG_PONG": {"dir": "s2c", "reply": None, "fnf": True,
+                 "deferred": False, "gates": ()},
+    "MSG_BYE": {"dir": "c2s", "reply": None, "fnf": True,
+                "deferred": False, "gates": ()},
+}

@@ -1,0 +1,4 @@
+"""Bench file that never names the family's bench config and carries
+no stress-mix slice for it."""
+
+CONFIGS = ("other",)

@@ -1,0 +1,15 @@
+"""Runtime framing registry half of the r21_bad twin: registers a
+framing with no declared family, omits a declared one."""
+
+FRAMING_LP = "lp"
+FRAMING_PHANTOM = "phantom"
+
+
+class Framing:
+    header_bytes = 2
+
+
+FRAMINGS = {
+    FRAMING_LP: Framing(),
+    FRAMING_PHANTOM: Framing(),
+}

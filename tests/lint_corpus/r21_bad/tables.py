@@ -1,0 +1,24 @@
+"""KNOWN-BAD corpus (R21): every landing-bar failure mode.
+
+- the runtime registers ``"phantom"`` with no declared family row;
+- family ``"ghost"`` declares a bar but is never registered;
+- family ``"lp"`` is registered but its model and oracle files do not
+  exist, its parity-test file lacks the declared test, its bench
+  config is never named by bench.py, and its stress slice rides no
+  harness.
+"""
+
+ENGINE_FAMILIES = (  # EXPECT[R21]
+    {"kind": "lp",
+     "model": "models/lp.py",
+     "oracle": "parsers/lp.py",
+     "parity_test": "test_lp.py::test_columnar_parity_every_byte_offset",
+     "bench_config": "lp",
+     "stress_slice": "LpMix"},
+    {"kind": "ghost",
+     "model": "models/ghost.py",
+     "oracle": "parsers/ghost.py",
+     "parity_test": "test_ghost.py::test_parity",
+     "bench_config": "ghost",
+     "stress_slice": "GhostMix"},
+)

@@ -1,0 +1,5 @@
+"""Parity-test file that LACKS the declared every-offset test."""
+
+
+def test_lp_smoke():
+    assert True

@@ -1,0 +1,8 @@
+"""Bench artifact for the r21_good landing bar: names the family's
+bench config and carries its stress-mix slice."""
+
+CONFIGS = ("lp",)
+
+
+class LpMix:
+    weight = 1

@@ -1,0 +1,5 @@
+"""Host-oracle parser artifact for the r21_good landing bar."""
+
+
+def parse(data):
+    return [(0, len(data))]

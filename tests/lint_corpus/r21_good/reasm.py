@@ -1,0 +1,12 @@
+"""Runtime framing registry half of the r21_good twin."""
+
+FRAMING_LP = "lp"
+
+
+class LpFraming:
+    header_bytes = 2
+
+
+FRAMINGS = {
+    FRAMING_LP: LpFraming(),
+}

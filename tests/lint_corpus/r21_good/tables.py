@@ -1,0 +1,13 @@
+"""KNOWN-GOOD corpus (R21): a self-contained landing bar — the
+declared family is registered, and all five artifacts (model, oracle,
+parity test, bench config, stress slice) resolve from the scanned
+directory itself."""
+
+ENGINE_FAMILIES = (
+    {"kind": "lp",
+     "model": "models/lp.py",
+     "oracle": "parsers/lp.py",
+     "parity_test": "test_lp.py::test_columnar_parity_every_byte_offset",
+     "bench_config": "lp",
+     "stress_slice": "LpMix"},
+)

@@ -26,6 +26,22 @@ PR 6 (interprocedural R2 — blocking-through-helper):
   process exit.  Sends are now SO_SNDTIMEO-bounded and a timed-out
   send tears the session down fail-closed (wakes the serve() recv,
   whose cleanup revokes leases and stops watches).
+
+v4 (R18-R21) triage fixes:
+
+- R19 @ sidecar/client.py: every grant-table write (_on_cache_grant /
+  _grant_drop / _reset_grants) now holds the declared _glock, and a
+  grant publishes its data columns (rule, framing) BEFORE the epoch
+  gate — a lock-free reader that passes _grant_valid can never read
+  another grant's rule/framing.
+- R18 @ sidecar/service.py + transport.py: the control-plane-session
+  death arm routes through mark_dead(counted=False) instead of a bare
+  state store — the transition stays on the declared edge set while
+  the operator-facing deaths counter keeps counting only data-plane
+  sessions.
+- R18/R20 runtime halves: the SAME protocols.py tables the static
+  checker proves against are what advance()/the grant send enforce at
+  runtime — deleting a declared edge fails BOTH.
 """
 
 import json
@@ -394,3 +410,112 @@ def test_lane_exit_dead_latch_answers_typed(tmp_path):
         assert not arena.has_slot(np.array([9]))[0]
     finally:
         s.close()
+
+
+# -- v4 (R18-R21) triage fixes ---------------------------------------------
+
+def test_grant_publish_order_and_lock_discipline(tmp_path):
+    """R19 fix: _on_cache_grant arms a row with its data columns
+    (rule, framing) published BEFORE the epoch gate, _grant_drop
+    tombstones the gate BEFORE clearing them (the reverse), and both
+    happen under the declared _glock.  Instrument the epoch column:
+    every gate write must observe the lock held and the data columns
+    in their before-the-gate state."""
+    from cilium_tpu.sidecar import wire
+    from cilium_tpu.sidecar.client import _FRAMING_CODES, SidecarClient
+
+    # A mute peer is enough: the grant path never touches the socket.
+    path = str(tmp_path / "svc.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(1)
+    client = SidecarClient(path)
+    peer, _ = srv.accept()
+    assert client._grant_ensure(7)
+    seen = []
+
+    class GateProbe:
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __len__(self):
+            return len(self.arr)
+
+        def __getitem__(self, i):
+            return self.arr[i]
+
+        def __setitem__(self, i, v):
+            seen.append((
+                "arm" if int(v) >= 0 else "tombstone",
+                client._glock.locked(),
+                int(client._grant_rule[i]),
+                int(client._grant_framing[i]),
+            ))
+            self.arr[i] = v
+
+    client._grant_epoch = GateProbe(client._grant_epoch)
+    code = _FRAMING_CODES["crlf"]
+    try:
+        client._on_cache_grant(wire.pack_cache_grant(7, 0, 5))
+        assert client._grant_valid(7)
+        client._grant_drop(7)
+        assert not client._grant_valid(7)
+    finally:
+        client._grant_epoch = client._grant_epoch.arr
+        client.close()
+        peer.close()
+        srv.close()
+    assert seen == [
+        # Arming: rule/framing already published when the gate opens.
+        ("arm", True, 5, code),
+        # Dropping: gate closes while rule/framing are still intact.
+        ("tombstone", True, 5, code),
+    ], seen
+
+
+def test_control_plane_session_death_uncounted():
+    """R18 fix: the control-plane-session death arm routes through
+    mark_dead(counted=False) — the transition is validated against
+    the declared edge set but the operator-facing deaths counter
+    counts only data-plane sessions."""
+    from cilium_tpu.analysis.protocols import SESSION_DEAD
+    from cilium_tpu.sidecar.transport import SessionState
+    from cilium_tpu.utils import metrics
+
+    base = metrics.SidecarSessionDeaths.get("closed")
+    s = SessionState(1)
+    s.mark_dead("closed", counted=False)
+    assert s.state == SESSION_DEAD
+    assert metrics.SidecarSessionDeaths.get("closed") == base
+
+    s2 = SessionState(2)
+    s2.mark_dead("closed")
+    assert metrics.SidecarSessionDeaths.get("closed") == base + 1
+    # The terminal edge is idempotent — a second death never
+    # double-counts.
+    s2.mark_dead("closed")
+    assert metrics.SidecarSessionDeaths.get("closed") == base + 1
+
+
+def test_undeclared_session_edge_raises_typed():
+    """The runtime half of the delete-an-edge acceptance bar: the
+    SAME protocols.py table R18 proves against is what advance()
+    enforces — an undeclared transition (dead -> active, session
+    resurrection) raises the typed ProtocolViolation; a declared one
+    returns the stored value."""
+    import pytest
+
+    from cilium_tpu.analysis.protocols import (
+        SESSION_ACTIVE,
+        SESSION_DEAD,
+        SESSION_PROTOCOL,
+        ProtocolViolation,
+    )
+
+    assert SESSION_PROTOCOL.advance(
+        SESSION_PROTOCOL.value(SESSION_ACTIVE), SESSION_DEAD
+    ) == SESSION_PROTOCOL.value(SESSION_DEAD)
+    with pytest.raises(ProtocolViolation):
+        SESSION_PROTOCOL.advance(
+            SESSION_PROTOCOL.value(SESSION_DEAD), SESSION_ACTIVE
+        )

@@ -129,6 +129,13 @@ _CORPUS_CASES = [
     "r16_bad_unbucketed.py",
     "r17_bad_snapshot_drift.py",
     "r17_bad_mesh_field_drift.py",
+    "r18_bad_typestate.py",
+    "r18_bad_flood_quarantine.py",
+    "r19_bad_unlocked_column.py",
+    "r19_bad_torn_snapshot.py",
+    "r19_bad_stale_grant_rearm.py",
+    "r20_bad",
+    "r21_bad",
 ]
 
 _CORPUS_CLEAN = [
@@ -164,6 +171,10 @@ _CORPUS_CLEAN = [
     "r16_good_bucketed.py",
     "r17_good_snapshot_pair.py",
     "r17_good_mesh_field_pair.py",
+    "r18_good_typestate.py",
+    "r19_good_locked_column.py",
+    "r20_good",
+    "r21_good",
 ]
 
 
@@ -421,6 +432,152 @@ def test_r14_r15_fixed_tree_sites_stay_fixed():
     assert "columnar_dead" in src
 
 
+# --- 2b. R18-R21: named pins + in-tree mutation sensitivity ---------------
+#
+# The corpus twins prove each rule fires on synthetic shapes.  These
+# prove the rules are WIRED TO THE SHIPPED TABLES: textually mutate a
+# copy of the real declared table (or a real runtime file) and the
+# checker must fire — a refactor that silently disconnects a rule
+# from protocols.py fails here, not in production.
+
+PROTOCOLS = os.path.join(PKG, "analysis", "protocols.py")
+TRANSPORT = os.path.join(PKG, "sidecar", "transport.py")
+CLIENT = os.path.join(PKG, "sidecar", "client.py")
+REASM = os.path.join(PKG, "sidecar", "reasm.py")
+
+
+def _mutate(tmp_path, src_path, old, new, count=1):
+    with open(src_path, "r", encoding="utf-8") as f:
+        src = f.read()
+    assert src.count(old) == count, (
+        f"mutation anchor drifted in {os.path.basename(src_path)}: "
+        f"{src.count(old)}x {old!r}"
+    )
+    out = tmp_path / os.path.basename(src_path)
+    out.write_text(src.replace(old, new), encoding="utf-8")
+    return str(out)
+
+
+def _rule_findings(paths, rule):
+    active, _ = split_findings(analyze_paths(list(paths)))
+    return [f for f in active if f.rule == rule]
+
+
+def test_r18_flood_quarantine_bare_store_pinned_exactly_once():
+    """The PR 15 DRR flood-quarantine shape, pinned by name: a bare
+    ``self.state = SESS_QUARANTINED`` in the flood handler bypasses
+    the declared-edge mediation — exactly one R18 finding."""
+    path = os.path.join(CORPUS, "r18_bad_flood_quarantine.py")
+    active, _ = split_findings(analyze_paths([path]))
+    r18 = [f for f in active if f.rule == "R18"]
+    assert len(r18) == 1, [f.render() for f in active]
+    assert "bare store" in r18[0].message
+    assert r18[0].symbol.endswith("on_flood")
+
+
+def test_r19_stale_grant_rearm_pinned_exactly_twice():
+    """The PR 12 stale-grant re-arm shape, pinned by name: BOTH
+    unlocked grant-column stores in the re-arm path fire R19 (one
+    finding per store, not one per function)."""
+    path = os.path.join(CORPUS, "r19_bad_stale_grant_rearm.py")
+    active, _ = split_findings(analyze_paths([path]))
+    r19 = [f for f in active if f.rule == "R19"]
+    assert len(r19) == 2, [f.render() for f in active]
+    assert all(f.symbol.endswith("rearm_after_revoke") for f in r19)
+    assert all("owning lock" in f.message for f in r19)
+
+
+def test_r21_bad_corpus_multiplicity():
+    """Every hole in the r21_bad landing bar is a SEPARATE finding
+    anchored at the ENGINE_FAMILIES decl line — the corpus marker SET
+    collapses them to one, so pin the exact count here."""
+    path = os.path.join(CORPUS, "r21_bad")
+    active, _ = split_findings(analyze_paths([path]))
+    r21 = [f for f in active if f.rule == "R21"]
+    assert len(r21) == 12, "\n".join(f.render() for f in r21)
+    assert len({(f.path, f.line) for f in r21}) == 1
+
+
+def test_r18_mutation_deleting_declared_edges_is_caught(tmp_path):
+    """Delete BOTH declared in-edges of the session 'dead' state from
+    a copy of the shipped table: the state becomes unreachable (a
+    finding at the Typestate decl) and the real transport.py
+    mark_dead() advance becomes statically dead (a finding at the
+    advance site).  This is the static half of the delete-an-edge
+    acceptance bar; the runtime half lives in
+    test_lint_regressions.py."""
+    mut = _mutate(
+        tmp_path, PROTOCOLS,
+        '        (SESSION_ACTIVE, SESSION_DEAD): "SidecarSessionDeaths",\n'
+        '        (SESSION_QUARANTINED, SESSION_DEAD):'
+        ' "SidecarSessionDeaths",\n',
+        "",
+    )
+    r18 = _rule_findings([mut, TRANSPORT], "R18")
+    msgs = " | ".join(f.message for f in r18)
+    assert "no in-edge" in msgs and "unreachable" in msgs, msgs
+    assert "NO declared in-edge" in msgs, msgs
+    assert any(os.path.basename(f.path) == "transport.py"
+               for f in r18), [f.render() for f in r18]
+
+
+def test_r18_mutation_unmediated_store_is_caught(tmp_path):
+    """Replace the mediated quarantine transition in a copy of the
+    real transport.py with a bare store: R18 fires at the store."""
+    mut = _mutate(
+        tmp_path, TRANSPORT,
+        "        self.state = SESSION_PROTOCOL.advance(\n"
+        "            self.state, SESSION_QUARANTINED\n"
+        "        )\n",
+        "        self.state = SESSION_QUARANTINED\n",
+    )
+    r18 = _rule_findings([PROTOCOLS, mut], "R18")
+    assert any(
+        "bare store" in f.message and f.symbol.endswith(".quarantine")
+        for f in r18
+    ), [f.render() for f in r18]
+
+
+def test_r19_mutation_dropping_grant_lock_is_caught(tmp_path):
+    """Revert this generation's grant-locking fix in a copy of the
+    real client.py (every ``with self._glock:`` trip becomes an
+    unlocked block): R19 flags the now lock-free grant-column
+    writes."""
+    mut = _mutate(tmp_path, CLIENT, "with self._glock:", "if True:",
+                  count=3)
+    r19 = _rule_findings([PROTOCOLS, mut], "R19")
+    assert r19, "dropping _glock must re-fire R19"
+    assert any("_grant_" in f.message for f in r19), (
+        [f.render() for f in r19]
+    )
+
+
+def test_r20_mutation_unknown_reply_is_caught(tmp_path):
+    """Point MSG_STATUS's declared reply at an unknown message in a
+    copy of the shipped table: the table-consistency half fires with
+    no seam files in the scan at all."""
+    mut = _mutate(
+        tmp_path, PROTOCOLS,
+        '"dir": "c2s", "reply": "MSG_STATUS_REPLY", "fnf": False,',
+        '"dir": "c2s", "reply": "MSG_NOPE", "fnf": False,',
+    )
+    r20 = _rule_findings([mut], "R20")
+    assert any("MSG_NOPE" in f.message and "not a declared" in f.message
+               for f in r20), [f.render() for f in r20]
+
+
+def test_r21_mutation_family_rename_breaks_both_directions(tmp_path):
+    """Rename the declared 'dns' family in a copy of the shipped
+    table while the real reasm.py still registers 'dns': R21 reports
+    the orphan registration AND the dead declared bar."""
+    mut = _mutate(tmp_path, PROTOCOLS, '{"kind": "dns",',
+                  '{"kind": "dnsx",')
+    r21 = _rule_findings([mut, REASM], "R21")
+    msgs = " | ".join(f.message for f in r21)
+    assert "'dns'" in msgs and "no ENGINE_FAMILIES row" in msgs, msgs
+    assert "'dnsx'" in msgs and "not registered" in msgs, msgs
+
+
 # --- 3. CLI contract ------------------------------------------------------
 
 def test_cli_clean_file_exits_zero(capsys):
@@ -490,7 +647,7 @@ def test_cli_list_rules(capsys):
     out = capsys.readouterr().out
     for rule in ("R1", "R2", "R3", "R4", "R5", "R6", "R7",
                  "R8", "R9", "R10", "R11", "R12", "R13", "R14",
-                 "R15", "R16"):
+                 "R15", "R16", "R17", "R18", "R19", "R20", "R21"):
         assert f"{rule} " in out
 
 
@@ -648,6 +805,24 @@ def test_cli_diff_filters_device_contract_findings(diff_repo, capsys,
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "pretend drift" not in out
+
+
+def test_cli_diff_precommit_smoke_covers_r18(diff_repo, capsys,
+                                             monkeypatch):
+    """The pre-commit path exercises the v4 whole-program rules: an
+    uncommitted file with a bare typestate store is reported by a
+    --diff run (the declared-table extraction and the store check
+    both survive the narrowed report)."""
+    monkeypatch.chdir(diff_repo)
+    (diff_repo / "bad.py").unlink()
+    with open(os.path.join(CORPUS, "r18_bad_flood_quarantine.py"),
+              encoding="utf-8") as f:
+        (diff_repo / "session.py").write_text(f.read())
+    rc = lint_main(["--diff", "HEAD", "--no-baseline", "."])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "R18" in out and "session.py" in out
+    assert "clean.py" not in out
 
 
 def test_cli_sarif_report(capsys):
