@@ -53,6 +53,9 @@ linter):
   R21 parity-coverage registry (every runtime-registered framing
       family carries its full declared landing bar: model, oracle,
       every-offset parity test, bench config, stress-mix slice)
+  R22 fail-closed recorder coverage (every FAIL_CLOSED row names a
+      declared typestate edge or marker token AND reaches a flight-
+      recorder emit site — no invisible fail-closed transitions)
   R0  lint pragma hygiene (malformed / unjustified suppressions)
 
 Layer 1 is the interprocedural engine (``callgraph.py``): a project-

@@ -122,6 +122,13 @@ RULE_DOCS = {
            "columnar model, host oracle, every-byte-offset parity "
            "test, bench config, and stress-mix slice — and every "
            "declared family must be registered",
+    "R22": "fail-closed recorder coverage: every FAIL_CLOSED row must "
+           "name a declared typestate edge (or carry a marker token) "
+           "and reach a flight-recorder emit site — a mediated "
+           "transition into the edge's target state, or a "
+           "record_mark/broadcast_mark call carrying the token — so "
+           "no declared fail-closed transition is invisible to the "
+           "incident timeline and its postmortem bundle",
 }
 
 # ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
@@ -430,6 +437,7 @@ def _collect_py(paths) -> list[str]:
 def all_rules():
     from . import (
         rules_answers,
+        rules_blackbox,
         rules_cache,
         rules_compile,
         rules_contain,
@@ -468,6 +476,7 @@ def all_rules():
         rules_columns.check_r19,
         rules_protocol.check_r20,
         rules_parity.check_r21,
+        rules_blackbox.check_r22,
     ]
 
 

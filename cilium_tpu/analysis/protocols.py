@@ -24,7 +24,11 @@ Also declared here, for the same one-definition reason:
   bit-identical to their Python twins (R20);
 - ``ENGINE_FAMILIES`` — the ROADMAP "landing bar" registry: model +
   host oracle + every-offset parity test + bench config + stress-mix
-  slice per registered ``reasm.FRAMINGS`` engine family (R21).
+  slice per registered ``reasm.FRAMINGS`` engine family (R21);
+- ``FAIL_CLOSED`` — the declared fail-closed surface: every typestate
+  edge (plus the two non-typestate markers) that narrows a serving
+  tier, and therefore must both reach the flight recorder and trigger
+  a postmortem bundle (R22).
 
 Serving-path cost: everything in this module is an import-time
 constant.  ``advance``/``guard`` are two dict lookups and run only at
@@ -38,6 +42,40 @@ from __future__ import annotations
 
 class ProtocolViolation(RuntimeError):
     """An undeclared typestate transition was attempted at runtime."""
+
+
+# -- transition observer (flight recorder hook) ---------------------------
+#
+# ONE process-wide hook, installed by the sidecar flight recorder
+# (``sidecar/blackbox.py``).  Every mediated transition that VALIDATES
+# (advance/guard/require_edges) reports ``(table, frm, to, outcome)``
+# here AFTER the edge check — an undeclared edge still raises before
+# any observation happens.  The hook is ``None`` by default, so the
+# unobserved cost is one attribute load + ``is None`` test at each
+# (control-plane) transition site, and its invocation is contained: a
+# broken observer can never turn a legal transition into a failure.
+# This module must stay importable without the sidecar package — the
+# recorder pushes its callback in; nothing here imports it.
+
+_TRANSITION_OBSERVER = None
+
+
+def set_transition_observer(fn) -> None:
+    """Install (or clear, with ``None``) the process-wide transition
+    observer.  Called by the flight recorder at service start/stop;
+    analysis-side code never sets it."""
+    global _TRANSITION_OBSERVER
+    _TRANSITION_OBSERVER = fn
+
+
+def _observe(name, frm, to, outcome) -> None:
+    obs = _TRANSITION_OBSERVER
+    if obs is None:
+        return
+    try:
+        obs(name, frm, to, outcome)
+    except Exception:  # noqa: BLE001 -- observer faults must never fail a legal transition
+        pass
 
 
 class Typestate:
@@ -138,6 +176,8 @@ class Typestate:
                 f"{self.name}: undeclared transition "
                 f"{frm!r} -> {to!r}"
             )
+        if _TRANSITION_OBSERVER is not None:
+            _observe(self.name, frm, to, self.edges[(frm, to)])
         return self.value(to)
 
     def guard(self, frm, to, value):
@@ -150,6 +190,8 @@ class Typestate:
                 f"{self.name}: undeclared transition "
                 f"{frm!r} -> {to!r}"
             )
+        if _TRANSITION_OBSERVER is not None:
+            _observe(self.name, frm, to, self.edges[(frm, to)])
         return value
 
     def require_edges(self, frms, to):
@@ -165,6 +207,9 @@ class Typestate:
                     f"{self.name}: undeclared transition "
                     f"{frm!r} -> {to!r}"
                 )
+        if _TRANSITION_OBSERVER is not None:
+            for frm in frms:
+                _observe(self.name, frm, to, self.edges[(frm, to)])
         return self.value(to)
 
 
@@ -332,6 +377,82 @@ GRANT_PROTOCOL = Typestate(
 
 
 # =========================================================================
+# Declared fail-closed surface (R22).  Every row here is an event that
+# NARROWS a serving tier — the transitions an operator reconstructing
+# an incident must be able to see.  ``kind="edge"`` rows name a
+# declared typestate edge (validated against the tables above at
+# import time); ``kind="marker"`` rows name the two fail-closed events
+# with no typestate table, recorded via ``blackbox.record_mark`` /
+# ``blackbox.broadcast_mark``.  The flight recorder arms a postmortem
+# bundle on every row, and the R22 lint pass proves each row reaches a
+# recorder emit site — a declared fail-closed edge invisible to the
+# recorder is a finding.
+# =========================================================================
+
+FAIL_CLOSED = (
+    {"kind": "edge", "table": "session",
+     "edge": (SESSION_ACTIVE, SESSION_QUARANTINED)},
+    {"kind": "edge", "table": "session",
+     "edge": (SESSION_QUARANTINED, SESSION_QUARANTINED)},
+    {"kind": "edge", "table": "session",
+     "edge": (SESSION_ACTIVE, SESSION_DEAD)},
+    {"kind": "edge", "table": "session",
+     "edge": (SESSION_QUARANTINED, SESSION_DEAD)},
+    {"kind": "edge", "table": "device_guard",
+     "edge": (GUARD_SERVING, GUARD_QUARANTINED)},
+    {"kind": "edge", "table": "mesh_device",
+     "edge": (DEVICE_OK, DEVICE_LOST)},
+    {"kind": "edge", "table": "mesh_device",
+     "edge": (DEVICE_LOST, DEVICE_LOST)},
+    {"kind": "edge", "table": "mesh_ladder",
+     "edge": (MESH_FULL, MESH_FALLBACK)},
+    {"kind": "edge", "table": "mesh_ladder",
+     "edge": (MESH_RESHAPED, MESH_FALLBACK)},
+    # Reshapes are descents only when entered from a WIDER rung; the
+    # fallback -> reshaped edge is an ascent (heal) and is excluded.
+    {"kind": "edge", "table": "mesh_ladder",
+     "edge": (MESH_FULL, MESH_RESHAPED)},
+    {"kind": "edge", "table": "mesh_ladder",
+     "edge": (MESH_RESHAPED, MESH_RESHAPED)},
+    {"kind": "edge", "table": "epoch_swap",
+     "edge": (SWAP_STAGED, SWAP_REJECTED)},
+    {"kind": "marker", "token": "shm_demotion"},
+    {"kind": "marker", "token": "kvstore_degraded"},
+)
+
+# Runtime lookup forms: the recorder checks membership per transition.
+FAIL_CLOSED_EDGES = frozenset(
+    (row["table"],) + tuple(row["edge"])
+    for row in FAIL_CLOSED if row["kind"] == "edge"
+)
+FAIL_CLOSED_MARKERS = frozenset(
+    row["token"] for row in FAIL_CLOSED if row["kind"] == "marker"
+)
+
+_PROTOCOLS_BY_NAME = {
+    p.name: p
+    for p in (SESSION_PROTOCOL, DEVICE_GUARD_PROTOCOL,
+              MESH_DEVICE_PROTOCOL, MESH_LADDER_PROTOCOL,
+              FLOW_CACHE_PROTOCOL, EPOCH_SWAP_PROTOCOL, GRANT_PROTOCOL)
+}
+
+for _row in FAIL_CLOSED:
+    if _row["kind"] == "edge":
+        _p = _PROTOCOLS_BY_NAME.get(_row["table"])
+        if _p is None or tuple(_row["edge"]) not in _p.edges:
+            raise ProtocolViolation(
+                f"FAIL_CLOSED: row {_row!r} names an undeclared table "
+                f"or edge"
+            )
+        del _p
+    elif _row["kind"] != "marker":
+        raise ProtocolViolation(
+            f"FAIL_CLOSED: row {_row!r} has an unknown kind"
+        )
+del _row
+
+
+# =========================================================================
 # Column-store lock discipline (R19).  Every write to a column whose
 # attribute name starts with ``prefix`` on a ``owner`` instance must be
 # reachable only with ``lock`` held (lexically or through every
@@ -448,6 +569,12 @@ WIRE_MESSAGES = {
         "deferred": False, "gates": ("HANDOFF_VERSION",)},
     "MSG_HANDOFF_REPLY": {
         "dir": "peer", "reply": None, "fnf": True,
+        "deferred": False, "gates": ()},
+    "MSG_TIMELINE": {
+        "dir": "c2s", "reply": "MSG_TIMELINE_REPLY", "fnf": False,
+        "deferred": False, "gates": ()},
+    "MSG_TIMELINE_REPLY": {
+        "dir": "s2c", "reply": None, "fnf": True,
         "deferred": False, "gates": ()},
 }
 

@@ -643,6 +643,21 @@ def cmd_sidecar_status(args):
                 for stage, rec in stages.items()
             )
             print(f"  [{path}] {cells}")
+    tl = st.get("timeline") or {}
+    if tl:
+        tiers = " ".join(
+            f"{k}={v}" for k, v in sorted((tl.get("tiers") or {}).items())
+        )
+        last = tl.get("last_postmortem") or {}
+        print(f"timeline: {tl.get('events', 0)}/{tl.get('ring', 0)} events "
+              f"(seq {tl.get('seq', 0)}), "
+              f"{tl.get('fail_closed_events', 0)} fail-closed, "
+              f"{tl.get('postmortems', 0)} postmortem(s)"
+              + (f" tiers: {tiers}" if tiers else ""))
+        if last:
+            print(f"  last postmortem: {last.get('trigger', '?')} "
+                  f"seq={last.get('seq')} events={last.get('events')}"
+                  + (f" -> {last['path']}" if last.get("path") else ""))
     return 0
 
 
@@ -684,6 +699,75 @@ def cmd_sidecar_trace(args):
         print(f"  {s['kind']:<6} path={s['path']:<6} seq={s['seq']:<8} "
               f"conn={s['conn_id']:<6} n={s['entries']:<5} "
               f"e2e={s['e2e_us'] / 1e3:.3f}ms{sess}{reason} {stages}")
+    return 0
+
+
+_TIMELINE_ID_KEYS = ("reason", "session", "conn", "epoch", "device", "n")
+
+
+def _format_timeline_event(ev: dict) -> str:
+    """One human line per flight-recorder event: seq, wall clock,
+    table, edge, and whatever correlation ids the transition site
+    annotated (reason/session/conn/epoch/device)."""
+    import time as _time
+
+    ts = _time.strftime("%H:%M:%S", _time.localtime(ev.get("t", 0)))
+    frm, to = (ev.get("edge") or ["?", "?"])[:2]
+    ids = " ".join(
+        f"{k}={ev[k]}" for k in _TIMELINE_ID_KEYS if ev.get(k) is not None
+    )
+    flag = " FAIL-CLOSED" if ev.get("fail_closed") else ""
+    return (f"  {ev.get('seq', 0):<7} {ts} {ev.get('table', '?'):<12} "
+            f"{frm}->{to}{flag}" + (f" {ids}" if ids else ""))
+
+
+def cmd_sidecar_timeline(args):
+    """Dump the verdict service's flight recorder: the declared-edge
+    incident timeline, windowed occupancy samples, and postmortem
+    bundle summaries from every fail-closed transition."""
+    from .sidecar import SidecarClient, SidecarUnavailable
+
+    try:
+        cl = SidecarClient(args.address, timeout=3.0)
+    except OSError as e:
+        print(f"Error: cannot reach verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        out = cl.timeline(n=args.n, since=args.since, table=args.table)
+    except (SidecarUnavailable, TimeoutError) as e:
+        print(f"Error: verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        cl.close()
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    events = out.get("events", [])
+    tl = out.get("timeline", {})
+    tiers = " ".join(
+        f"{k}={v}" for k, v in sorted((tl.get("tiers") or {}).items())
+    )
+    print(f"{args.address}: {len(events)} event(s) of "
+          f"{tl.get('events', 0)} ringed (seq {tl.get('seq', 0)}, "
+          f"{tl.get('fail_closed_events', 0)} fail-closed)"
+          + (f" tiers: {tiers}" if tiers else ""))
+    for ev in events:
+        print(_format_timeline_event(ev))
+    occ = out.get("occupancy", [])
+    if occ:
+        recent = occ[-5:]
+        cells = " ".join(
+            f"[busy={b.get('busy', 0):.2f} occ={b.get('occupancy', 0):.2f} "
+            f"q={b.get('queue_max', 0)}]" for b in recent
+        )
+        print(f"occupancy ({len(occ)} bucket(s), newest last): {cells}")
+    for pm in out.get("postmortems", []):
+        print(f"postmortem: {pm.get('trigger', '?')} seq={pm.get('seq')} "
+              f"events={pm.get('events')}"
+              + (f" reason={pm['reason']}" if pm.get("reason") else "")
+              + (f" -> {pm['path']}" if pm.get("path") else ""))
     return 0
 
 
@@ -972,6 +1056,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "id (see `cilium sidecar status` sessions)")
     x.add_argument("--json", action="store_true")
     x.set_defaults(fn=cmd_sidecar_trace)
+    x = sc.add_parser(
+        "timeline",
+        help="flight-recorder ring: declared-edge incident timeline, "
+             "occupancy buckets, and postmortem bundle summaries",
+    )
+    x.add_argument("--address", required=True,
+                   help="verdict service unix socket path")
+    x.add_argument("-n", type=int, default=100, help="max events")
+    x.add_argument("--since", type=int, default=0,
+                   help="only events with seq strictly greater "
+                        "(incremental tail cursor)")
+    x.add_argument("--table", default=None,
+                   help="typestate table filter (session, device_guard, "
+                        "mesh_device, mesh_ladder, flow_cache, "
+                        "epoch_swap, mark, overload)")
+    x.add_argument("--json", action="store_true")
+    x.set_defaults(fn=cmd_sidecar_timeline)
 
     x = sub.add_parser(
         "observe",

@@ -52,6 +52,7 @@ from ..monitor import (
 )
 from ..policy import Repository, Rule, SearchContext, Tracing, init_entities
 from ..proxy import ProxyManager
+from ..sidecar import blackbox
 from ..utils import defaults
 from ..utils.controller import ControllerManager, ControllerParams
 from ..utils.logging import get_logger
@@ -349,6 +350,10 @@ class Daemon:
             self._kvstore_degraded = True
         KvstoreDegraded.set(1)
         KvstoreDegradedEvents.inc()
+        # Fail-closed marker: lands in every installed flight recorder
+        # (the daemon has no recorder of its own — a co-hosted verdict
+        # service's ring is where the incident timeline lives).
+        blackbox.broadcast_mark("kvstore_degraded", reason=reason)
         log.with_field("reason", reason).warning(
             "kvstore degraded: continuing on cached identities"
         )
@@ -363,6 +368,7 @@ class Daemon:
                 return
             self._kvstore_degraded = False
         KvstoreDegraded.set(0)
+        blackbox.broadcast_mark("kvstore_restored")
         log.info("kvstore connectivity restored")
         self.monitor.send_agent_notification(
             AGENT_NOTIFY_KVSTORE_RESTORED, "kvstore connectivity restored"

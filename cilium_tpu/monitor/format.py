@@ -13,6 +13,7 @@ from .monitor import (
     MSG_TYPE_DEBUG,
     MSG_TYPE_DROP,
     MSG_TYPE_POLICY_VERDICT,
+    MSG_TYPE_POSTMORTEM,
     MSG_TYPE_TRACE,
     MonitorEvent,
 )
@@ -85,6 +86,16 @@ def format_event(ev: MonitorEvent) -> str:
                 f"e2e={sv.get('e2e_us', 0) / 1e3:.2f}ms{reason} {stages}"
             )
         return f"{ts} TRACE: {p}"
+    if ev.type == MSG_TYPE_POSTMORTEM:
+        # Flight-recorder bundle (sidecar/blackbox.py): the fail-closed
+        # edge that fired it, how deep the captured ring is, and where
+        # the full bundle landed (if a bundle_dir was configured).
+        reason = f" reason={p['reason']}" if p.get("reason") else ""
+        path = f" bundle={p['path']}" if p.get("path") else ""
+        return (
+            f"{ts} POSTMORTEM: trigger={p.get('trigger', '?')} "
+            f"seq={p.get('seq')} events={p.get('events')}{reason}{path}"
+        )
     if ev.type == MSG_TYPE_DEBUG:
         return f"{ts} DEBUG: {p}"
     return f"{ts} UNKNOWN({ev.type}): {p}"

@@ -21,6 +21,10 @@ MSG_TYPE_TRACE = 4
 MSG_TYPE_POLICY_VERDICT = 5
 MSG_TYPE_ACCESS_LOG = 6
 MSG_TYPE_AGENT = 7
+# Flight-recorder postmortem bundle (sidecar/blackbox.py): emitted on a
+# fail-closed typestate transition so `cilium monitor` surfaces the
+# incident without polling the timeline RPC.
+MSG_TYPE_POSTMORTEM = 8
 
 # Agent notification codes (reference: pkg/monitor AgentNotify*).
 AGENT_NOTIFY_START = 2

@@ -1825,6 +1825,22 @@ class SidecarClient:
         )
         return json.loads(got.decode())
 
+    def timeline(self, n: int = 100, since: int = 0,
+                 table: str | None = None) -> dict:
+        """Flight-recorder dump (MSG_TIMELINE round trip): the declared-
+        edge incident timeline, occupancy buckets, and postmortem
+        summaries — the `cilium sidecar timeline` surface.  ``since``
+        filters to events with seq strictly greater (incremental tail);
+        ``table`` pins one typestate table."""
+        req: dict = {"n": int(n), "since": int(since)}
+        if table:
+            req["table"] = table
+        got = self._control_rpc(
+            lambda: (wire.MSG_TIMELINE, json.dumps(req).encode()),
+            wire.MSG_TIMELINE_REPLY,
+        )
+        return json.loads(got.decode())
+
     def observe(self, n: int = 100, verdict: str | None = None,
                 path: str | None = None, rule: int | None = None,
                 conn: int | None = None,

@@ -39,6 +39,7 @@ from ..analysis.protocols import (
     GUARD_SERVING,
     MESH_DEVICE_PROTOCOL,
 )
+from . import blackbox
 
 log = logging.getLogger(__name__)
 
@@ -128,9 +129,10 @@ class DeviceGuard:
         with self._lock:
             if self._latch == GUARD_QUARANTINED:
                 return
-            self._latch = DEVICE_GUARD_PROTOCOL.advance(
-                self._latch, GUARD_QUARANTINED
-            )
+            with blackbox.annotate(reason=reason):
+                self._latch = DEVICE_GUARD_PROTOCOL.advance(
+                    self._latch, GUARD_QUARANTINED
+                )
             self.reason = reason
             self.quarantine_events += 1
             self._quarantined_at = time.monotonic()
@@ -207,9 +209,10 @@ class DeviceGuard:
         with self._lock:
             if self._latch != GUARD_QUARANTINED:
                 return
-            self._latch = DEVICE_GUARD_PROTOCOL.advance(
-                self._latch, GUARD_SERVING
-            )
+            with blackbox.annotate(reason="probe-heal"):
+                self._latch = DEVICE_GUARD_PROTOCOL.advance(
+                    self._latch, GUARD_SERVING
+                )
             self.reason = ""
             self._crash_streak = 0
             self._tainted = False
@@ -236,9 +239,10 @@ class DeviceGuard:
             row = self._devices.setdefault(
                 key, {"state": DEVICE_OK, "faults": {}, "heals": 0}
             )
-            row["state"] = MESH_DEVICE_PROTOCOL.advance(
-                row["state"], DEVICE_LOST
-            )
+            with blackbox.annotate(reason=reason, device=key):
+                row["state"] = MESH_DEVICE_PROTOCOL.advance(
+                    row["state"], DEVICE_LOST
+                )
             row["faults"][reason] = row["faults"].get(reason, 0) + 1
         log.warning("mesh device %s marked lost: %s", key, reason)
 
@@ -250,9 +254,10 @@ class DeviceGuard:
             row = self._devices.get(key)
             if row is None or row["state"] == DEVICE_OK:
                 return
-            row["state"] = MESH_DEVICE_PROTOCOL.advance(
-                row["state"], DEVICE_OK
-            )
+            with blackbox.annotate(reason="probe-heal", device=key):
+                row["state"] = MESH_DEVICE_PROTOCOL.advance(
+                    row["state"], DEVICE_OK
+                )
             row["heals"] = row.get("heals", 0) + 1
         log.warning("mesh device %s healed (probe succeeded)", key)
 
@@ -396,9 +401,10 @@ class DeviceGuard:
             if devices:
                 self._devices = devices
             if quarantined and self._latch != GUARD_QUARANTINED:
-                self._latch = DEVICE_GUARD_PROTOCOL.advance(
-                    self._latch, GUARD_QUARANTINED
-                )
+                with blackbox.annotate(reason=reason or "restored"):
+                    self._latch = DEVICE_GUARD_PROTOCOL.advance(
+                        self._latch, GUARD_QUARANTINED
+                    )
                 self.reason = reason or "restored"
                 self._quarantined_at = time.monotonic()
                 self._last_probe = 0.0  # probe may fire immediately

@@ -78,7 +78,7 @@ from ..runtime.batch import R2d2BatchEngine
 from ..utils import flowdebug, metrics
 from ..utils.option import DaemonConfig
 from ..utils.sockutil import shutdown_close
-from . import wire
+from . import blackbox, wire
 from .dispatch import BatchDispatcher
 from .guard import DeviceGuard
 from .reasm import (
@@ -368,6 +368,23 @@ class VerdictService:
             stage_metrics=self.config.trace_stage_metrics,
             batch_capacity=self.config.batch_flows,
         )
+        # Flight recorder: always-on incident timeline fed from the
+        # protocols.py transition observer (every mediated typestate
+        # edge), overload markers, and a per-round occupancy sampler
+        # riding the tracer's finish_round.  Fail-closed edges trigger
+        # postmortem bundles on a detached thread (blackbox.py) — the
+        # enrichment providers below take this service's locks, which
+        # is exactly why they must never run on the transition thread.
+        self.recorder = blackbox.FlightRecorder(
+            ring=self.config.timeline_ring,
+            bundle_dir=self.config.timeline_bundle_dir,
+            slow_only=self.config.timeline_slow_only,
+        )
+        self.recorder.stage_provider = self.tracer.status
+        self.recorder.status_provider = self._postmortem_status
+        self.recorder.occupancy_probe = self._occupancy_probe
+        self.recorder.install()
+        self.tracer.recorder = self.recorder
         # Containment telemetry (status/metrics).
         self.shed_entries = 0
         self.batch_crashes = 0
@@ -722,6 +739,10 @@ class VerdictService:
 
     def stop(self) -> None:
         self._stopped = True
+        # Deregister from the process-wide transition observer first: a
+        # stopping service must not record (or bundle) its neighbors'
+        # edges in multi-service processes (handoff).
+        self.recorder.uninstall()
         # shutdown BEFORE close: the acceptor thread parked in accept()
         # holds the fd, and a bare close() defers the kernel teardown —
         # the listener would keep accepting into its backlog and a
@@ -1166,6 +1187,10 @@ class VerdictService:
             # Latency decomposition (sidecar/trace.py): per-stage means
             # by serving path + span/exemplar counters.
             "latency": self.tracer.status(),
+            # Flight recorder (sidecar/blackbox.py): timeline ring
+            # occupancy, fail-closed event/bundle counters, unified
+            # serving-tier rungs.
+            "timeline": self.recorder.status(),
             # Flow-record ring occupancy (flowlog/): None = disabled.
             "flowlog": (
                 self.flowlog.stats() if self.flowlog is not None else None
@@ -1218,6 +1243,36 @@ class VerdictService:
             "spans": self.tracer.spans(n, kind, session=session),
             "latency": self.tracer.status(),
         }
+
+    def timeline_dump(self, n: int = 100, since: int = 0,
+                      table: str | None = None) -> dict:
+        """Timeline snapshot for `cilium sidecar timeline`
+        (MSG_TIMELINE): declared-edge events (filtered by minimum seq
+        and/or table), occupancy buckets, postmortem summaries, and
+        the recorder's own status."""
+        return self.recorder.dump(n=n, since=since, table=table)
+
+    def _postmortem_status(self) -> dict:
+        """The status() sections a postmortem bundle carries — the
+        fail-closed-relevant subset (mesh rung, guard ladder, policy
+        epoch, dispatcher depth), NOT the full status: bundles must
+        stay small enough to write under incident load.  Runs on the
+        recorder's bundle thread only (takes this service's locks)."""
+        full = self.status()
+        return {
+            k: full.get(k)
+            for k in ("mesh", "containment", "policy", "dispatcher",
+                      "sessions", "transport", "flow_cache")
+        }
+
+    def _occupancy_probe(self) -> tuple:
+        """Queue depth + admission headroom for the occupancy sampler
+        (plain attribute reads — called once per dispatch round)."""
+        d = self.dispatcher
+        cap = d.max_pending
+        depth = d.pending_weight
+        headroom = (max(cap - depth, 0) / cap) if cap else None
+        return depth, headroom
 
     def close_module(self, module_id: int) -> None:
         pl.close_module(module_id)
@@ -1288,9 +1343,11 @@ class VerdictService:
                         return
                     if kind == "swap":
                         self._swap_failed("shutdown")
-                        job.phase = EPOCH_SWAP_PROTOCOL.advance(
-                            job.phase, SWAP_REJECTED
-                        )
+                        with blackbox.annotate(reason="shutdown",
+                                               epoch=self.policy_epoch):
+                            job.phase = EPOCH_SWAP_PROTOCOL.advance(
+                                job.phase, SWAP_REJECTED
+                            )
                         job.status = int(FilterResult.UNKNOWN_ERROR)
                         job.epoch = self.policy_epoch
                         job.done.set()
@@ -1323,9 +1380,11 @@ class VerdictService:
                     if job.phase == SWAP_STAGED:
                         # A job that already reached a terminal phase
                         # inside _run_swap stays there.
-                        job.phase = EPOCH_SWAP_PROTOCOL.advance(
-                            job.phase, SWAP_REJECTED
-                        )
+                        with blackbox.annotate(reason="device-build",
+                                               epoch=self.policy_epoch):
+                            job.phase = EPOCH_SWAP_PROTOCOL.advance(
+                                job.phase, SWAP_REJECTED
+                            )
                     job.status = int(FilterResult.POLICY_DROP)
                     job.epoch = self.policy_epoch
                     job.done.set()
@@ -1345,8 +1404,10 @@ class VerdictService:
         ins = pl.find_instance(module_id)
         if ins is None:
             self._swap_failed("no-instance")
-            job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
-                                                    SWAP_REJECTED)
+            with blackbox.annotate(reason="no-instance",
+                                   epoch=self.policy_epoch):
+                job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
+                                                        SWAP_REJECTED)
             job.status = int(FilterResult.INVALID_INSTANCE)
             job.epoch = self.policy_epoch
             job.done.set()
@@ -1393,8 +1454,9 @@ class VerdictService:
         except EpochParityError:
             log.exception("policy swap rejected (epoch parity)")
             self._swap_failed("parity")
-            job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
-                                                    SWAP_REJECTED)
+            with blackbox.annotate(reason="parity", epoch=epoch):
+                job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
+                                                        SWAP_REJECTED)
             job.status = int(FilterResult.POLICY_DROP)
             job.epoch = self.policy_epoch
             job.done.set()
@@ -1402,8 +1464,9 @@ class VerdictService:
         except Exception:  # noqa: BLE001 — fail closed, old epoch serves
             log.exception("policy swap rejected (device build)")
             self._swap_failed("device-build")
-            job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
-                                                    SWAP_REJECTED)
+            with blackbox.annotate(reason="device-build", epoch=epoch):
+                job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
+                                                        SWAP_REJECTED)
             job.status = int(FilterResult.POLICY_DROP)
             job.epoch = self.policy_epoch
             job.done.set()
@@ -1416,8 +1479,9 @@ class VerdictService:
         self._send_cache_revokes(epoch)
         self._commit_epoch(ins, mods, job.staged_map, new_engines,
                            epoch)
-        job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
-                                                SWAP_COMMITTED)
+        with blackbox.annotate(reason="committed", epoch=epoch):
+            job.phase = EPOCH_SWAP_PROTOCOL.advance(job.phase,
+                                                    SWAP_COMMITTED)
         job.status = int(FilterResult.OK)
         job.epoch = epoch
         job.done.set()
@@ -1468,11 +1532,12 @@ class VerdictService:
             if self._flow_cache_on and self._tab_size:
                 armed = self._tab_cache == 1
                 invalidated = int(armed.sum())
-                self._tab_cache[self._tab_cache != 0] = (
-                    FLOW_CACHE_PROTOCOL.require_edges(
-                        (CACHE_ARMED, CACHE_DECLINED), CACHE_UNARMED
+                with blackbox.annotate(reason="epoch-flip", epoch=epoch):
+                    self._tab_cache[self._tab_cache != 0] = (
+                        FLOW_CACHE_PROTOCOL.require_edges(
+                            (CACHE_ARMED, CACHE_DECLINED), CACHE_UNARMED
+                        )
                     )
-                )
                 self._tab_cache_epoch[:] = -1
                 self._tab_cache_rule[:] = -1
                 self._cache_armed = 0
@@ -2070,9 +2135,13 @@ class VerdictService:
                 rule = int(claim[1])
                 if not was_armed:
                     self._cache_armed += 1
-                self._tab_cache[conn_id] = FLOW_CACHE_PROTOCOL.advance(
-                    self._tab_cache[conn_id], CACHE_ARMED
-                )
+                with blackbox.annotate(reason="arm", conn=conn_id,
+                                       epoch=epoch):
+                    self._tab_cache[conn_id] = (
+                        FLOW_CACHE_PROTOCOL.advance(
+                            self._tab_cache[conn_id], CACHE_ARMED
+                        )
+                    )
                 self._tab_cache_epoch[conn_id] = epoch
                 self._tab_cache_rule[conn_id] = rule
                 self._tab_seen_tick[conn_id] = self._next_cache_tick()
@@ -2088,9 +2157,11 @@ class VerdictService:
             # Mirror the status counter: an armed row losing its claim
             # on re-arm is an invalidation in both surfaces.
             metrics.VerdictCacheInvalidations.inc("re-arm")
-        self._tab_cache[conn_id] = FLOW_CACHE_PROTOCOL.advance(
-            self._tab_cache[conn_id], CACHE_DECLINED
-        )
+        with blackbox.annotate(reason="no-claim", conn=conn_id,
+                               epoch=epoch):
+            self._tab_cache[conn_id] = FLOW_CACHE_PROTOCOL.advance(
+                self._tab_cache[conn_id], CACHE_DECLINED
+            )
         self._tab_cache_epoch[conn_id] = epoch
         self._tab_cache_rule[conn_id] = -1
         return None
@@ -2122,9 +2193,10 @@ class VerdictService:
             return
         victim = int(armed[np.argmin(self._tab_seen_tick[armed])])
         # Back to unarmed: re-armable later.
-        self._tab_cache[victim] = FLOW_CACHE_PROTOCOL.advance(
-            self._tab_cache[victim], CACHE_UNARMED
-        )
+        with blackbox.annotate(reason="lru-evict", conn=victim):
+            self._tab_cache[victim] = FLOW_CACHE_PROTOCOL.advance(
+                self._tab_cache[victim], CACHE_UNARMED
+            )
         self._tab_cache_epoch[victim] = -1
         self._tab_cache_rule[victim] = -1
         self._cache_armed -= 1
@@ -2143,9 +2215,10 @@ class VerdictService:
             self.cache_invalidations += 1
             if reason is not None:
                 metrics.VerdictCacheInvalidations.inc(reason)
-        self._tab_cache[conn_id] = FLOW_CACHE_PROTOCOL.advance(
-            self._tab_cache[conn_id], CACHE_UNARMED
-        )
+        with blackbox.annotate(reason=reason or "close", conn=conn_id):
+            self._tab_cache[conn_id] = FLOW_CACHE_PROTOCOL.advance(
+                self._tab_cache[conn_id], CACHE_UNARMED
+            )
         self._tab_cache_epoch[conn_id] = -1
         self._tab_cache_rule[conn_id] = -1
 
@@ -3278,6 +3351,9 @@ class VerdictService:
             # rate would over-report).
             self.shed_entries += n
             metrics.SidecarShedTotal.inc(reason, amount=n)
+            # Overload marker for the incident timeline: one coalesced
+            # ring event per shed reason per window, never per entry.
+            self.recorder.record_overload(reason, n)
             sess = getattr(client, "session", None)
             if sess is not None:
                 # Session-scoped attribution (fan-in): the operator can
@@ -3351,6 +3427,7 @@ class VerdictService:
         records it queued) are round-suppressed."""
         self.guard.record_stall("dispatch-stall")
         metrics.DeviceStalls.inc()
+        self.recorder.record_overload("stall_deposal", len(items))
         # A wedged round on a mesh is indistinguishable here from a
         # lost mesh device: drop to the single-chip rung BEFORE the
         # quarantine ladder re-probes, so the heal path resumes on an
@@ -4017,8 +4094,9 @@ class VerdictService:
                     max_flow=mesh.shape[FLOW_AXIS],
                 )
         if target is not None:
-            MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
-                                         MESH_RESHAPED)
+            with blackbox.annotate(reason="handoff-resume"):
+                MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
+                                             MESH_RESHAPED)
             self._mesh_serving = target
             log.warning(
                 "mesh resumes RESHAPED from handoff: %d device(s) "
@@ -4027,8 +4105,9 @@ class VerdictService:
                 target.shape[RULE_AXIS],
             )
         else:
-            MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
-                                         MESH_FALLBACK)
+            with blackbox.annotate(reason="handoff-degraded"):
+                MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
+                                             MESH_FALLBACK)
             self._mesh_demoted = "handoff-degraded"
             self.mesh_demotions["handoff-degraded"] = (
                 self.mesh_demotions.get("handoff-degraded", 0) + 1
@@ -4198,8 +4277,9 @@ class VerdictService:
             self._mesh_lost |= attributed
             if self._mesh_demoted is None:
                 first = True
-                MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
-                                             MESH_FALLBACK)
+                with blackbox.annotate(reason=reason):
+                    MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
+                                                 MESH_FALLBACK)
                 self._mesh_demoted = reason
                 self._mesh_serving = None
                 self._mesh_fault_at = time.monotonic()
@@ -4465,10 +4545,13 @@ class VerdictService:
                     if target is full:
                         eng._mesh_model = None
                     flipped += 1
-                MESH_LADDER_PROTOCOL.advance(
-                    self._mesh_rung(),
-                    MESH_FULL if target is full else MESH_RESHAPED,
-                )
+                with blackbox.annotate(
+                    reason="repromote" if target is full else "reshape"
+                ):
+                    MESH_LADDER_PROTOCOL.advance(
+                        self._mesh_rung(),
+                        MESH_FULL if target is full else MESH_RESHAPED,
+                    )
                 self._mesh_serving = None if target is full else target
                 self._mesh_demoted = None
             if target is full:
@@ -4535,7 +4618,9 @@ class VerdictService:
                     eng.model = mm
                     eng._mesh_model = None
                     promoted += 1
-            MESH_LADDER_PROTOCOL.advance(self._mesh_rung(), MESH_FULL)
+            with blackbox.annotate(reason="probe-heal"):
+                MESH_LADDER_PROTOCOL.advance(self._mesh_rung(),
+                                             MESH_FULL)
             self._mesh_demoted = None
             self._mesh_serving = None
             # ROADMAP 1c: engines BUILT while demoted hold plain
@@ -7468,6 +7553,11 @@ class _ClientHandler:
         if old is not None:
             old.close()
         rep["generation"] = peer.generation
+        # Transport promoted: timeline mark + re-arm the postmortem
+        # latch (a successful attach is the heal for shm demotion).
+        self.service.recorder.record_mark(
+            "shm_attach", session=self.session.id
+        )
         log.info(
             "shm transport attached (generation %d, %d data slots)",
             peer.generation, peer.data.slots,
@@ -7598,6 +7688,9 @@ class _ClientHandler:
         with self._wlock:
             if not shm.quarantine(reason):
                 return
+            self.service.recorder.record_mark(
+                "shm_demotion", reason=reason, session=self.session.id
+            )
             try:
                 # lint: disable=R2 -- the quarantined credit must serialize with verdict-ring writes under this handler's write lock (see docstring); SO_SNDTIMEO bounds a wedge
                 self._send_credit_locked(CREDIT_FLAG_QUARANTINED)
@@ -7665,6 +7758,11 @@ class _ClientHandler:
                 # _wlock — same latch-and-credit ordering contract as
                 # _shm_quarantine).
                 if shm.quarantine(REASON_OVERSIZE_SPREE):
+                    self.service.recorder.record_mark(
+                        "shm_demotion",
+                        reason=REASON_OVERSIZE_SPREE,
+                        session=self.session.id,
+                    )
                     try:
                         # lint: disable=R2 -- quarantined credit under the held handler write lock, same contract as _shm_quarantine
                         self._send_credit_locked(CREDIT_FLAG_QUARANTINED)
@@ -7942,6 +8040,28 @@ class _ClientHandler:
                         json.dumps(
                             self.service.trace_dump(
                                 n, kind, session=session
+                            )
+                        ).encode(),
+                    )
+                elif msg_type == wire.MSG_TIMELINE:
+                    # Same containment as MSG_TRACE: a malformed
+                    # diagnostic request degrades to defaults, never
+                    # kills the shim connection's read loop.
+                    try:
+                        req = json.loads(payload.decode()) if payload else {}
+                        n = int(req.get("n", 100))
+                        since = int(req.get("since", 0))
+                        table = req.get("table")
+                        if table is not None:
+                            table = str(table)
+                    except (ValueError, TypeError, AttributeError,
+                            UnicodeDecodeError):
+                        n, since, table = 100, 0, None
+                    self.send(
+                        wire.MSG_TIMELINE_REPLY,
+                        json.dumps(
+                            self.service.timeline_dump(
+                                n=n, since=since, table=table
                             )
                         ).encode(),
                     )

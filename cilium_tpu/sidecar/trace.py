@@ -196,6 +196,10 @@ class VerdictTracer:
         # Optional fan-out for slow exemplars.
         self.monitor = None          # monitor.Monitor (notify())
         self.access_logger = None    # accesslog.logger.AccessLogger (log())
+        # Optional flight recorder (blackbox.FlightRecorder): fed the
+        # same per-round numbers the busy gauge uses, so the occupancy
+        # time-series costs no extra stamps.
+        self.recorder = None
 
     # -- round lifecycle --------------------------------------------------
 
@@ -281,6 +285,13 @@ class VerdictTracer:
                     e2e, stages, session=session,
                 )
                 sample = False  # one sampled span per round
+        rec = self.recorder
+        if rec is not None:
+            try:
+                rec.sample_round(rt.n, self.batch_capacity,
+                                 stages[STAGE_DEVICE], now)
+            except Exception:  # noqa: BLE001 — recorder must not cost the round
+                pass
 
     def record_shed(self, seq: int, n: int, arrival: float, conn0: int,
                     reason: str, session: int = 0) -> None:

@@ -31,6 +31,7 @@ from ..analysis.protocols import (
     SESSION_QUARANTINED,
 )
 from ..utils import metrics
+from . import blackbox
 from .shm import ShmRing
 
 TRANSPORT_SOCKET = "socket"
@@ -148,9 +149,10 @@ class SessionState:
         stays dead — quarantining a corpse is not a declared edge."""
         if self.state == SESSION_DEAD:
             return
-        self.state = SESSION_PROTOCOL.advance(
-            self.state, SESSION_QUARANTINED
-        )
+        with blackbox.annotate(reason=reason, session=self.id):
+            self.state = SESSION_PROTOCOL.advance(
+                self.state, SESSION_QUARANTINED
+            )
         self.quarantine_reason = reason
         self.quarantined_until = time.monotonic() + cooldown_s
         self.quarantines[reason] = self.quarantines.get(reason, 0) + 1
@@ -166,9 +168,11 @@ class SessionState:
         if time.monotonic() >= self.quarantined_until:
             # Declared-silent lazy heal (protocols.py: the quarantine
             # OPEN was the counted event; the close is traffic-driven).
-            self.state = SESSION_PROTOCOL.advance(
-                self.state, SESSION_ACTIVE
-            )
+            with blackbox.annotate(reason="window-expired",
+                                   session=self.id):
+                self.state = SESSION_PROTOCOL.advance(
+                    self.state, SESSION_ACTIVE
+                )
             self.quarantine_reason = None
             return False
         return True
@@ -180,9 +184,10 @@ class SessionState:
         death), while still routing the transition through the ONE
         declared-edge mediation point."""
         if self.state != SESSION_DEAD:
-            self.state = SESSION_PROTOCOL.advance(
-                self.state, SESSION_DEAD
-            )
+            with blackbox.annotate(reason=reason, session=self.id):
+                self.state = SESSION_PROTOCOL.advance(
+                    self.state, SESSION_DEAD
+                )
             self.death_reason = reason
             if counted:
                 metrics.SidecarSessionDeaths.inc(reason)

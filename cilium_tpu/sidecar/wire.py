@@ -135,6 +135,14 @@ MSG_SESSION_HELLO = 27
 MSG_HANDOFF = 28
 MSG_HANDOFF_REPLY = 29
 
+# Flight-recorder timeline (sidecar/blackbox.py): the client asks for
+# the incident timeline — declared-edge events, occupancy buckets and
+# postmortem summaries — with JSON request filters {"n", "since",
+# "table"}; the reply is the recorder's dump() as JSON.  Same
+# request/reply control shape as MSG_TRACE.
+MSG_TIMELINE = 30
+MSG_TIMELINE_REPLY = 31
+
 # Conn-registration flags (optional trailing byte on
 # MSG_NEW_CONNECTION; absent = 0, so old shims interop unchanged).
 # RETAINED rides the session-replay re-registration: the shim still

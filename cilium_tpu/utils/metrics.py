@@ -557,3 +557,25 @@ KvstoreEvents = registry.counter(
     "transport failures) bridged from kvstore/net.py KvstoreCounters",
     ("scope", "event"),
 )
+
+# Flight-recorder surface (sidecar/blackbox.py).  ServingTier unifies
+# the per-subsystem degradation ladders into ONE scrapeable gauge —
+# 0 is the full-speed rung, higher is narrower (mesh: full/reshaped/
+# fallback = 0/1/2; guard: serving/quarantined = 0/1; cache: armed/
+# disarmed = 0/1; transport: shm/socket = 0/1) — fed from the same
+# typestate-observer hook that feeds the incident timeline.  Set only
+# on tier CHANGE (control-plane transitions), never per entry.
+ServingTier = registry.gauge(
+    "serving_tier",
+    "Current degradation-ladder rung per subsystem (0 = full speed, "
+    "higher = narrower serving tier), unified across mesh, device "
+    "guard, flow cache, and shm transport",
+    ("subsystem",),
+)
+SidecarPostmortems = registry.counter(
+    "sidecar_postmortem_bundles_total",
+    "Postmortem bundles written by the flight recorder on fail-closed "
+    "transitions, labeled by the triggering typestate table (or "
+    "'mark' for non-typestate markers)",
+    ("trigger",),
+)

@@ -336,6 +336,20 @@ class DaemonConfig:
     # Span ring capacity (bounded; oldest spans are evicted).
     trace_ring: int = 512
 
+    # Flight recorder (sidecar/blackbox.py).  Always-on incident
+    # timeline: every mediated typestate transition + overload markers
+    # land in a bounded ring; fail-closed edges trigger automatic
+    # postmortem bundles.  timeline_ring is the event ring capacity.
+    timeline_ring: int = 512
+    # Directory postmortem bundles are serialized to as JSON files
+    # ("" keeps bundles in-memory only — they still ride the monitor
+    # stream and the MSG_TIMELINE reply).
+    timeline_bundle_dir: str = ""
+    # True drops routine declared-silent edges (outcome None, not
+    # fail-closed) from the ring — the low-noise setting; fail-closed
+    # edges and counted transitions are always recorded.
+    timeline_slow_only: bool = False
+
     # Flow-level verdict observability (flowlog/): per-flow records
     # with device-side rule attribution, populated per ROUND from all
     # decision layers and queryable via `cilium observe`/MSG_OBSERVE.
@@ -414,6 +428,8 @@ class DaemonConfig:
             )
         if self.flowlog_ring <= 0:
             raise ValueError("flowlog_ring must be positive")
+        if self.timeline_ring <= 0:
+            raise ValueError("timeline_ring must be positive")
         if self.mesh not in ("auto", "on", "off"):
             raise ValueError(f"invalid mesh {self.mesh!r}")
         if self.mesh_rule_shards < 0 or self.mesh_flow_shards < 0:

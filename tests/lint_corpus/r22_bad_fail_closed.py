@@ -1,0 +1,53 @@
+"""KNOWN-BAD corpus (R22): every fail-closed coverage drift mode.
+
+- a fail-closed edge with NO mediated transition site anywhere (the
+  recorder hooks mediation, so the incident can never be captured);
+- an edge row naming a typestate table that was never declared;
+- an edge row naming an edge its table does not declare;
+- a marker token that never reaches record_mark/broadcast_mark;
+- a marker row with no token at all;
+- a row of unknown kind.
+
+The ``ring`` table itself is R18-clean (every state reachable, the one
+transition mediated) so only the R22 coverage layer fires.
+"""
+
+from cilium_tpu.analysis.protocols import Typestate
+
+R_OK = "ok"
+R_DEGRADED = "degraded"
+R_DEAD = "dead"
+
+RING_PROTOCOL = Typestate(
+    name="ring",
+    owner="Ring",
+    field="state",
+    kind="attr",
+    states=(R_OK, R_DEGRADED, R_DEAD),
+    initial=R_OK,
+    edges={
+        (R_OK, R_DEGRADED): None,
+        (R_DEGRADED, R_OK): None,
+        (R_DEGRADED, R_DEAD): None,
+    },
+)
+
+FAIL_CLOSED = (
+    {"kind": "edge", "table": "ring", "edge": (R_OK, R_DEGRADED)},
+    {"kind": "edge", "table": "ring", "edge": (R_DEGRADED, R_DEAD)},  # EXPECT[R22]
+    {"kind": "edge", "table": "ghost", "edge": (R_OK, R_DEAD)},  # EXPECT[R22]
+    {"kind": "edge", "table": "ring", "edge": (R_OK, R_DEAD)},  # EXPECT[R22]
+    {"kind": "marker", "token": "ring_torn"},  # EXPECT[R22]
+    {"kind": "marker"},  # EXPECT[R22]
+    {"kind": "trap"},  # EXPECT[R22]
+)
+
+
+class Ring:
+    def __init__(self) -> None:
+        self.state = R_OK
+
+    def degrade(self) -> None:
+        # The ONLY mediated site: covers the ok -> degraded row; the
+        # degraded -> dead descent has no site and no record path.
+        self.state = RING_PROTOCOL.advance(self.state, R_DEGRADED)

@@ -1,0 +1,57 @@
+"""KNOWN-GOOD corpus (R22): a fully covered fail-closed surface.
+
+Every declared row reaches a recorder emit site: the ok -> degraded
+descent through an ``advance`` into the target state, the
+degraded -> dead descent through a ``guard`` naming the exact pair,
+and both marker tokens through ``record_mark`` / ``broadcast_mark``
+calls carrying the token string.
+"""
+
+from cilium_tpu.analysis.protocols import Typestate
+
+R_OK = "ok"
+R_DEGRADED = "degraded"
+R_DEAD = "dead"
+
+RING_PROTOCOL = Typestate(
+    name="ring",
+    owner="Ring",
+    field="state",
+    kind="attr",
+    states=(R_OK, R_DEGRADED, R_DEAD),
+    initial=R_OK,
+    edges={
+        (R_OK, R_DEGRADED): None,
+        (R_DEGRADED, R_OK): None,
+        (R_DEGRADED, R_DEAD): None,
+    },
+)
+
+FAIL_CLOSED = (
+    {"kind": "edge", "table": "ring", "edge": (R_OK, R_DEGRADED)},
+    {"kind": "edge", "table": "ring", "edge": (R_DEGRADED, R_DEAD)},
+    {"kind": "marker", "token": "ring_torn"},
+    {"kind": "marker", "token": "store_degraded"},
+)
+
+
+def broadcast_mark(token, **ids):
+    del token, ids
+
+
+class Ring:
+    def __init__(self, recorder) -> None:
+        self.state = R_OK
+        self.recorder = recorder
+
+    def degrade(self) -> None:
+        self.state = RING_PROTOCOL.advance(self.state, R_DEGRADED)
+
+    def bury(self) -> None:
+        self.state = RING_PROTOCOL.guard(R_DEGRADED, R_DEAD, self.state)
+
+    def torn(self) -> None:
+        self.recorder.record_mark("ring_torn", reason="torn-slot")
+
+    def store_down(self) -> None:
+        broadcast_mark("store_degraded", reason="unreachable")

@@ -1,0 +1,387 @@
+"""Flight recorder unit contracts (sidecar/blackbox.py).
+
+The recorder is always-on: every mediated protocols.py transition in
+the process lands in its ring.  These tests pin the pieces the e2e
+device-loss walk (test_multichip_serving) exercises only implicitly:
+ring bounds, annotation nesting, overload coalescing, occupancy
+bucketing, the postmortem latch (one bundle per descent, debounce,
+re-arm on heal), the slow-only filter, the serving-tier gauge, the
+read-side filters, and the process-wide registry fan-out.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from cilium_tpu.analysis import protocols as proto
+from cilium_tpu.sidecar import blackbox
+from cilium_tpu.sidecar.blackbox import FlightRecorder, annotate
+
+
+def _install(**kw):
+    rec = FlightRecorder(**kw)
+    rec.install()
+    return rec
+
+
+def _await(pred, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"{what} never held")
+
+
+def _lose_device():
+    """One fail-closed transition through the REAL choke point."""
+    proto.MESH_DEVICE_PROTOCOL.advance(proto.DEVICE_OK,
+                                       proto.DEVICE_LOST)
+
+
+def _heal_device():
+    """The matching re-arm edge (mesh_device back to its initial)."""
+    proto.MESH_DEVICE_PROTOCOL.advance(proto.DEVICE_LOST,
+                                       proto.DEVICE_OK)
+
+
+def test_install_uninstall_and_fanout():
+    """Recorders register in a module tuple; ONE observer fans out to
+    all of them, and clearing the last one clears the observer."""
+    a = _install()
+    b = _install()
+    try:
+        proto.SESSION_PROTOCOL.advance(proto.SESSION_QUARANTINED,
+                                       proto.SESSION_ACTIVE)
+        assert len(a.ring) == 1 and len(b.ring) == 1
+        assert a.ring[0]["table"] == "session"
+        assert a.ring[0]["edge"] == ["quarantined", "active"]
+    finally:
+        a.uninstall()
+        b.uninstall()
+    assert proto._TRANSITION_OBSERVER is None
+    # Uninstalled: further transitions record nowhere.
+    proto.SESSION_PROTOCOL.advance(proto.SESSION_QUARANTINED,
+                                   proto.SESSION_ACTIVE)
+    assert len(a.ring) == 1
+
+
+def test_ring_is_bounded_and_seq_monotonic():
+    rec = _install(ring=4)
+    try:
+        for i in range(10):
+            rec.record_mark(f"m{i}")
+        assert len(rec.ring) == 4
+        seqs = [e["seq"] for e in rec.ring]
+        assert seqs == sorted(seqs)
+        assert [e["edge"][1] for e in rec.ring] == [
+            "m6", "m7", "m8", "m9"
+        ]
+        assert rec.status()["seq"] == seqs[-1]
+    finally:
+        rec.uninstall()
+
+
+def test_annotate_nesting_inner_wins_and_pops():
+    rec = _install()
+    try:
+        with annotate(reason="outer", session=7):
+            with annotate(reason="inner", conn=3):
+                proto.SESSION_PROTOCOL.advance(
+                    proto.SESSION_QUARANTINED, proto.SESSION_ACTIVE
+                )
+            proto.SESSION_PROTOCOL.advance(
+                proto.SESSION_QUARANTINED, proto.SESSION_ACTIVE
+            )
+        proto.SESSION_PROTOCOL.advance(proto.SESSION_QUARANTINED,
+                                       proto.SESSION_ACTIVE)
+        inner, outer, bare = list(rec.ring)
+        assert inner["reason"] == "inner" and inner["conn"] == 3
+        assert inner["session"] == 7  # outer frame still visible
+        assert outer["reason"] == "outer" and "conn" not in outer
+        assert "reason" not in bare and "session" not in bare
+    finally:
+        rec.uninstall()
+
+
+def test_annotations_are_thread_local():
+    rec = _install()
+    try:
+        seen = []
+
+        def other():
+            proto.SESSION_PROTOCOL.advance(proto.SESSION_QUARANTINED,
+                                           proto.SESSION_ACTIVE)
+            seen.append(True)
+
+        with annotate(reason="mine"):
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert seen
+        assert "reason" not in rec.ring[0]
+    finally:
+        rec.uninstall()
+
+
+def test_fail_closed_latch_one_bundle_per_descent():
+    """The first fail-closed edge bundles; the cascade's later edges
+    are suppressed; the heal (re-arm edge) re-opens the latch."""
+    rec = _install()
+    try:
+        with annotate(reason="unit-loss", device="9"):
+            _lose_device()
+        _await(lambda: rec.bundles_written == 1, what="first bundle")
+        assert rec.fail_closed_events == 1
+        assert rec.status()["armed"] is False
+        pm = rec.postmortems[0]
+        assert pm["trigger"] == "mesh_device:ok->lost"
+        assert pm["reason"] == "unit-loss"
+        # Cascade continues: same descent, no second bundle.
+        _lose_device()  # lost -> lost, still fail-closed
+        time.sleep(0.05)
+        assert rec.bundles_written == 1
+        assert rec.bundles_suppressed == 1
+        assert rec.fail_closed_events == 2
+        # Heal re-arms; the NEXT descent gets its own bundle even
+        # inside the debounce window.
+        _heal_device()
+        assert rec.status()["armed"] is True
+        _lose_device()
+        _await(lambda: rec.bundles_written == 2, what="second bundle")
+        assert len(rec.postmortems) == 2
+    finally:
+        rec.uninstall()
+
+
+def test_debounce_floor_expires_without_a_heal():
+    """A cascade that never heals still gets a fresh bundle once the
+    time floor passes (the latch is a rate bound, not a one-shot)."""
+    rec = _install()
+    rec.debounce_s = 0.0
+    try:
+        _lose_device()
+        _await(lambda: rec.bundles_written == 1, what="first bundle")
+        _lose_device()
+        _await(lambda: rec.bundles_written == 2, what="floor bundle")
+        assert rec.bundles_suppressed == 0
+    finally:
+        rec.uninstall()
+
+
+def test_bundle_snapshot_trigger_last_and_file_written(tmp_path):
+    bdir = tmp_path / "bundles"
+    rec = _install(bundle_dir=str(bdir))
+    notified = []
+    rec.monitor = type("M", (), {"notify": lambda _s, ev:
+                                 notified.append(ev)})()
+    rec.stage_provider = lambda: {"stage": "ok"}
+    rec.status_provider = lambda: {"mesh": {"rung": "fallback"}}
+    try:
+        rec.record_mark("warmup")
+        with annotate(reason="unit-loss"):
+            _lose_device()
+        _await(lambda: rec.bundles_written == 1, what="bundle")
+        pm = rec.postmortems[0]
+        assert pm["path"] is not None and pm["events"] == 2
+        with open(pm["path"], encoding="utf-8") as f:
+            bundle = json.load(f)
+        # Snapshot under the latch: the triggering edge is LAST.
+        assert bundle["events"][-1]["edge"] == ["ok", "lost"]
+        assert bundle["events"][-1]["fail_closed"] is True
+        assert bundle["events"][0]["edge"] == ["-", "warmup"]
+        assert bundle["stages"] == {"stage": "ok"}
+        assert bundle["status"] == {"mesh": {"rung": "fallback"}}
+        from cilium_tpu.monitor.monitor import MSG_TYPE_POSTMORTEM
+        # bundles_written lands before the monitor fan-out on the
+        # bundle thread; wait for the notification itself.
+        _await(lambda: notified, what="monitor notify")
+        assert [ev.type for ev in notified] == [MSG_TYPE_POSTMORTEM]
+        assert notified[0].payload["trigger"] == "mesh_device:ok->lost"
+    finally:
+        rec.uninstall()
+
+
+def test_broken_enrichment_still_yields_a_bundle():
+    rec = _install()
+    rec.stage_provider = lambda: 1 / 0
+    rec.status_provider = lambda: 1 / 0
+    rec.monitor = type("M", (), {"notify": lambda _s, ev: 1 / 0})()
+    try:
+        _lose_device()
+        _await(lambda: rec.bundles_written == 1, what="bundle")
+        assert rec.postmortems[0]["trigger"] == "mesh_device:ok->lost"
+    finally:
+        rec.uninstall()
+
+
+def test_overload_coalescing_one_event_per_kind_per_window():
+    rec = _install()
+    try:
+        rec.record_overload("shed-queue", 5)
+        rec.record_overload("shed-queue", 3)
+        rec.record_overload("stall_deposal", 1)
+        sheds = rec.events(table="overload")
+        assert len(sheds) == 2
+        by_kind = {e["edge"][1]: e for e in sheds}
+        assert by_kind["shed-queue"]["n"] == 8  # accumulated in place
+        assert by_kind["stall_deposal"]["n"] == 1
+    finally:
+        rec.uninstall()
+
+
+def test_occupancy_buckets_fold_rounds():
+    rec = _install()
+    rec.occupancy_probe = lambda: (12, 0.25)
+    try:
+        t0 = 100.0
+        rec.sample_round(48, 64, 0.4, now=t0)
+        rec.sample_round(16, 64, 0.2, now=t0 + 0.5)
+        rec.sample_round(64, 64, 0.1, now=t0 + 1.5)  # closes bucket 1
+        occ = rec.occupancy()
+        assert len(occ) == 2
+        closed, open_ = occ
+        assert closed["rounds"] == 2 and closed["items"] == 64
+        assert closed["occupancy"] == 0.5  # 64 / (64 + 64)
+        assert closed["busy"] == 0.6       # 0.4s + 0.2s per 1s bucket
+        assert closed["queue_max"] == 12
+        assert closed["headroom_min"] == 0.25
+        assert open_["rounds"] == 1 and open_["occupancy"] == 1.0
+    finally:
+        rec.uninstall()
+
+
+def test_occupancy_probe_fault_does_not_cost_the_round():
+    rec = _install()
+    rec.occupancy_probe = lambda: 1 / 0
+    try:
+        rec.sample_round(8, 64, 0.1)
+        occ = rec.occupancy()
+        assert occ[-1]["rounds"] == 1 and occ[-1]["queue_max"] == 0
+    finally:
+        rec.uninstall()
+
+
+def test_slow_only_keeps_counted_and_fail_closed_edges():
+    """slow_only drops declared-silent chatter (outcome None) but a
+    counted edge and every fail-closed edge still land."""
+    rec = _install(slow_only=True)
+    try:
+        # Declared-silent (flow_cache unarmed -> armed): dropped.
+        proto.FLOW_CACHE_PROTOCOL.advance(0, proto.CACHE_ARMED)
+        assert len(rec.ring) == 0
+        # Counted (mesh_ladder reshaped -> full): kept.
+        proto.MESH_LADDER_PROTOCOL.advance(proto.MESH_RESHAPED,
+                                           proto.MESH_FULL)
+        assert [e["table"] for e in rec.ring] == ["mesh_ladder"]
+        # Fail-closed: always kept (it feeds the bundle snapshot).
+        _lose_device()
+        assert [e["table"] for e in rec.ring] == [
+            "mesh_ladder", "mesh_device"
+        ]
+    finally:
+        rec.uninstall()
+
+
+def test_serving_tier_gauge_follows_edges_and_marks():
+    rec = _install()
+    try:
+        assert rec.status()["tiers"] == {
+            "mesh": 0, "guard": 0, "cache": 0, "transport": 0
+        }
+        proto.MESH_LADDER_PROTOCOL.advance(proto.MESH_FULL,
+                                           proto.MESH_FALLBACK)
+        proto.DEVICE_GUARD_PROTOCOL.advance(proto.GUARD_SERVING,
+                                            proto.GUARD_QUARANTINED)
+        rec.record_mark("shm_demotion", reason="peer-crash")
+        tiers = rec.status()["tiers"]
+        assert tiers["mesh"] == 2 and tiers["guard"] == 1
+        assert tiers["transport"] == 1
+        # Recovery edges walk every gauge back to the full rung.
+        proto.MESH_LADDER_PROTOCOL.advance(proto.MESH_FALLBACK,
+                                           proto.MESH_FULL)
+        proto.DEVICE_GUARD_PROTOCOL.advance(proto.GUARD_QUARANTINED,
+                                            proto.GUARD_SERVING)
+        rec.record_mark("shm_attach", session=1)
+        tiers = rec.status()["tiers"]
+        assert tiers == {"mesh": 0, "guard": 0, "cache": 0,
+                         "transport": 0}
+    finally:
+        rec.uninstall()
+
+
+def test_marks_shm_and_kvstore_are_fail_closed():
+    rec = _install()
+    try:
+        rec.record_mark("shm_demotion", reason="oversize-spree",
+                        session=4)
+        _await(lambda: rec.bundles_written == 1, what="shm bundle")
+        assert rec.postmortems[0]["trigger"] == "mark:-->shm_demotion"
+        # shm_attach re-arms; the kvstore marker then bundles too.
+        rec.record_mark("shm_attach", session=4)
+        rec.record_mark("kvstore_degraded", reason="lease-lost")
+        _await(lambda: rec.bundles_written == 2, what="kv bundle")
+        ev = rec.events(table="mark")
+        assert [e["edge"][1] for e in ev] == [
+            "shm_demotion", "shm_attach", "kvstore_degraded"
+        ]
+        assert ev[0]["fail_closed"] is True
+        assert "fail_closed" not in ev[1]
+        assert ev[0]["session"] == 4
+    finally:
+        rec.uninstall()
+
+
+def test_broadcast_mark_reaches_every_recorder_and_is_contained():
+    assert blackbox._RECORDERS == ()
+    blackbox.broadcast_mark("kvstore_degraded")  # no-op, no recorders
+    a = _install()
+    b = _install()
+    b.record_mark = lambda *a_, **k: 1 / 0  # a broken sink
+    try:
+        blackbox.broadcast_mark("kvstore_restored", reason="rejoined")
+        assert [e["edge"][1] for e in a.ring] == ["kvstore_restored"]
+        assert a.ring[0]["reason"] == "rejoined"
+    finally:
+        a.uninstall()
+        b.uninstall()
+
+
+def test_events_filters_since_table_n():
+    rec = _install()
+    try:
+        rec.record_mark("one")
+        proto.SESSION_PROTOCOL.advance(proto.SESSION_QUARANTINED,
+                                       proto.SESSION_ACTIVE)
+        rec.record_mark("two")
+        rec.record_mark("three")
+        assert [e["edge"][1] for e in rec.events(table="mark")] == [
+            "one", "two", "three"
+        ]
+        first = rec.ring[0]["seq"]
+        assert [e["edge"][1] for e in rec.events(since=first + 1,
+                                                 table="mark")] == [
+            "two", "three"
+        ]
+        assert [e["edge"][1] for e in rec.events(n=1, table="mark")
+                ] == ["three"]
+        assert rec.events(table="nope") == []
+        d = rec.dump(n=2, table="mark")
+        assert set(d) == {"events", "occupancy", "postmortems",
+                          "timeline"}
+        assert len(d["events"]) == 2
+    finally:
+        rec.uninstall()
+
+
+def test_observer_faults_never_fail_a_legal_transition():
+    proto.set_transition_observer(lambda *a: 1 / 0)
+    try:
+        out = proto.SESSION_PROTOCOL.advance(
+            proto.SESSION_QUARANTINED, proto.SESSION_ACTIVE
+        )
+        assert out == proto.SESSION_ACTIVE
+    finally:
+        proto.set_transition_observer(None)

@@ -22,6 +22,7 @@ pinned here, on the conftest 8-device CPU mesh:
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 
@@ -1103,6 +1104,166 @@ def test_mesh_device_loss_chaos_soak_long(tmp_path):
     """Longer soak (BENCH_FULL tier): five cycles, four traffic
     threads — walks the ladder through most of the device set."""
     _chaos_soak(tmp_path, "soak-long", cycles=5, n_threads=4)
+
+
+# --- flight recorder: the incident timeline through the device walk -------
+
+def _await_bundles(rec, n, timeout=30.0):
+    """Postmortem enrichment rides its own daemon thread (the
+    fail-closed edge fires under service locks); wait for the
+    written-bundle counter to land."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if rec.bundles_written >= n:
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"{n} bundle(s) never written: {rec.status()}")
+
+
+def test_flight_recorder_records_device_loss_cascade(tmp_path):
+    """The device-loss walk — descent, heal, re-promotion, second
+    descent — lands in the flight recorder as an ORDERED declared-edge
+    sequence: every recorded transition is an edge its protocols.py
+    table declares, the fail-closed flags match the declared
+    FAIL_CLOSED surface exactly, each descent yields exactly ONE
+    postmortem bundle whose LAST event is the triggering edge, and the
+    heal re-arms the latch so the second descent gets its own bundle."""
+    from cilium_tpu.analysis import protocols as proto
+
+    inst.reset_module_registry()
+    svc = client = None
+    try:
+        svc, client, mod = _start(
+            tmp_path, "recorder-walk", batch_timeout_ms=0.0,
+            mesh_reprobe_interval_s=0.05,
+            timeline_bundle_dir=str(tmp_path / "bundles"),
+        )
+        rec = svc.recorder
+        shim = _conn(client, mod, 60, 1)
+        res, out = shim.on_io(False, b"READ /public/a.txt\r\n")
+        assert res == int(FilterResult.OK)
+        seq0 = rec.status()["seq"]
+        assert rec.status()["armed"] is True
+
+        # -- first descent -------------------------------------------
+        _arm_named_loss(svc, 3)
+        res, out = shim.on_io(False, b"HALT\r\n")
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        _await_rung(svc, "reshaped")
+        _await_bundles(rec, 1)
+
+        evs = rec.events(n=512, since=seq0)
+        assert evs, "descent recorded nothing"
+        # Edge-for-edge validation against the DECLARED tables: every
+        # recorded typestate transition is one of its table's edges,
+        # and its fail-closed flag matches the declared surface.
+        for ev in evs:
+            if ev["table"] in ("mark", "overload"):
+                continue
+            table = proto._PROTOCOLS_BY_NAME[ev["table"]]
+            edge = tuple(ev["edge"])
+            assert edge in table.edges, ev
+            want_fc = (ev["table"],) + edge in proto.FAIL_CLOSED_EDGES
+            assert bool(ev.get("fail_closed")) == want_fc, ev
+        # The cascade's shape, in ring order: the faulting round's
+        # off-path demotion, then the guard attributing the chip, then
+        # the builder's reshape onto the survivors.
+        ladder = [tuple(e["edge"]) for e in evs
+                  if e["table"] == "mesh_ladder"]
+        assert ladder == [("full", "fallback"), ("fallback", "reshaped")]
+        # The guard attributes the chip once typed ("device-call");
+        # the paced re-probe may re-attribute it ("probe-failed",
+        # the declared lost->lost self-edge) before the reshape lands.
+        dev_evs = [e for e in evs if e["table"] == "mesh_device"]
+        assert [tuple(e["edge"]) for e in dev_evs][0] == ("ok", "lost")
+        assert all(tuple(e["edge"]) == ("lost", "lost")
+                   for e in dev_evs[1:])
+        # Correlation ids rode the thread-local annotation in.
+        assert dev_evs[0]["device"] == "3"
+        assert dev_evs[0]["reason"] == "device-call"
+        assert all(e["device"] == "3" and e["reason"] == "probe-failed"
+                   for e in dev_evs[1:])
+        demote = next(e for e in evs if e["table"] == "mesh_ladder"
+                      and e["edge"] == ["full", "fallback"])
+        assert demote["reason"] == "device-call"
+        reshape = next(e for e in evs
+                       if e["edge"] == ["fallback", "reshaped"])
+        assert reshape["reason"] == "reshape"
+        assert demote["seq"] < dev_evs[0]["seq"] < reshape["seq"]
+
+        # Exactly ONE bundle for the whole cascade: several fail-closed
+        # edges fired; the latch folded them into one postmortem.
+        st = rec.status()
+        assert st["fail_closed_events"] >= 2
+        assert st["postmortems"] == 1 and len(rec.postmortems) == 1
+        assert st["armed"] is False
+        assert st["tiers"]["mesh"] == 1  # reshaped rung on the gauge
+        pm = rec.postmortems[0]
+        assert pm["trigger"] == "mesh_ladder:full->fallback"
+        assert pm["seq"] == demote["seq"]
+        assert pm["reason"] == "device-call"
+        # The bundle file: the triggering edge lands LAST (the ring is
+        # snapshotted under the latch, before the cascade's later
+        # edges append).
+        assert pm["path"] is not None
+        with open(pm["path"], encoding="utf-8") as f:
+            bundle = json.load(f)
+        assert bundle["trigger"] == pm["trigger"]
+        assert bundle["events"][-1]["seq"] == demote["seq"]
+        assert bundle["events"][-1]["edge"] == ["full", "fallback"]
+        assert bundle["status"] is not None  # enrichment providers ran
+        # The wire surface serves the same ring (MSG_TIMELINE RPC).
+        reply = client.timeline(n=512, since=seq0, table="mesh_ladder")
+        assert [tuple(e["edge"]) for e in reply["events"]] == ladder
+        assert reply["timeline"]["postmortems"] == 1
+
+        # -- heal: the ascent re-arms the latch ----------------------
+        seq1 = rec.status()["seq"]
+        svc._device_probe_fn = lambda dev: True
+        _await_rung(svc, "full", client, mod, drive=True)
+        evs = rec.events(n=512, since=seq1)
+        # A probe already in flight may land one more lost->lost row;
+        # the heal itself is exactly one lost->ok, probe-attributed.
+        heal = [e for e in evs if e["table"] == "mesh_device"
+                and tuple(e["edge"]) == ("lost", "ok")]
+        assert len(heal) == 1 and heal[0]["reason"] == "probe-heal"
+        assert heal[0]["device"] == "3"
+        promote = [e for e in evs if e["table"] == "mesh_ladder"]
+        assert [tuple(e["edge"]) for e in promote] == [
+            ("reshaped", "full")
+        ]
+        assert promote[0]["reason"] == "repromote"
+        # No NEW descent on the way up: the only fail-closed rows an
+        # ascent may carry are straggler lost->lost re-attributions.
+        assert all(tuple(e["edge"]) == ("lost", "lost")
+                   for e in evs if e.get("fail_closed"))
+        st = rec.status()
+        assert st["armed"] is True
+        assert st["tiers"]["mesh"] == 0
+
+        # -- second descent: one bundle PER descent ------------------
+        seq2 = rec.status()["seq"]
+        _arm_named_loss(svc, 5)
+        s2 = _conn(client, mod, 61, 3)
+        res, out = s2.on_io(False, b"HALT\r\n")
+        assert res == int(FilterResult.OK) and out == b"HALT\r\n"
+        s2.close()
+        _await_rung(svc, "reshaped")
+        _await_bundles(rec, 2)
+        assert rec.status()["postmortems"] == 2
+        pm2 = rec.postmortems[-1]
+        assert pm2["trigger"] == "mesh_ladder:full->fallback"
+        assert pm2["seq"] > seq2
+        dev2 = [e for e in rec.events(n=512, since=seq2)
+                if e["table"] == "mesh_device"]
+        assert {e["device"] for e in dev2} == {"5"}
+        assert tuple(dev2[0]["edge"]) == ("ok", "lost")
+    finally:
+        if client is not None:
+            client.close()
+        if svc is not None:
+            svc.stop()
+        inst.reset_module_registry()
 
 
 # --- ladder state across hitless restart (satellite 2) --------------------

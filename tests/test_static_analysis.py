@@ -136,6 +136,7 @@ _CORPUS_CASES = [
     "r19_bad_stale_grant_rearm.py",
     "r20_bad",
     "r21_bad",
+    "r22_bad_fail_closed.py",
 ]
 
 _CORPUS_CLEAN = [
@@ -175,6 +176,7 @@ _CORPUS_CLEAN = [
     "r19_good_locked_column.py",
     "r20_good",
     "r21_good",
+    "r22_good_fail_closed.py",
 ]
 
 
@@ -254,6 +256,27 @@ def test_catches_dead_metric_and_hot_loop_observe():
     active, _ = split_findings(analyze_paths([path]))
     assert [f.rule for f in active] == ["R7", "R7", "R7"]
     assert all("hot loop" in f.message for f in active)
+
+
+def test_r22_fail_closed_coverage_pins():
+    """R22's drift modes pinned by message — the uncovered descent,
+    the ghost table, the undeclared edge, the unrecorded marker, the
+    tokenless marker, the unknown kind — with exactly one finding per
+    bad row (the corpus marker SET cannot see multiplicity)."""
+    path = os.path.join(CORPUS, "r22_bad_fail_closed.py")
+    active, _ = split_findings(analyze_paths([path]))
+    assert active and all(f.rule == "R22" for f in active)
+    lines = [f.line for f in active]
+    assert len(lines) == len(set(lines)), (
+        f"duplicate R22 findings at lines {sorted(lines)}"
+    )
+    msgs = " | ".join(f.message for f in active)
+    assert "no mediated transition site" in msgs
+    assert "undeclared typestate table 'ghost'" in msgs
+    assert "not a declared edge" in msgs
+    assert "record_mark/broadcast_mark" in msgs
+    assert "no token string" in msgs
+    assert "unknown kind" in msgs
 
 
 def test_interprocedural_lock_graph_spans_two_modules():
@@ -526,10 +549,10 @@ def test_r18_mutation_unmediated_store_is_caught(tmp_path):
     real transport.py with a bare store: R18 fires at the store."""
     mut = _mutate(
         tmp_path, TRANSPORT,
-        "        self.state = SESSION_PROTOCOL.advance(\n"
-        "            self.state, SESSION_QUARANTINED\n"
-        "        )\n",
-        "        self.state = SESSION_QUARANTINED\n",
+        "            self.state = SESSION_PROTOCOL.advance(\n"
+        "                self.state, SESSION_QUARANTINED\n"
+        "            )\n",
+        "            self.state = SESSION_QUARANTINED\n",
     )
     r18 = _rule_findings([PROTOCOLS, mut], "R18")
     assert any(
@@ -564,6 +587,22 @@ def test_r20_mutation_unknown_reply_is_caught(tmp_path):
     r20 = _rule_findings([mut], "R20")
     assert any("MSG_NOPE" in f.message and "not a declared" in f.message
                for f in r20), [f.render() for f in r20]
+
+
+def test_r22_mutation_unrecorded_marker_is_caught(tmp_path):
+    """Rename the declared shm_demotion marker in a copy of the
+    shipped FAIL_CLOSED table while the real service.py still marks
+    'shm_demotion': R22 reports the now-unrecordable marker."""
+    mut = _mutate(
+        tmp_path, PROTOCOLS,
+        '{"kind": "marker", "token": "shm_demotion"},',
+        '{"kind": "marker", "token": "shm_demolition"},',
+    )
+    svc = os.path.join(PKG, "sidecar", "service.py")
+    r22 = _rule_findings([mut, svc], "R22")
+    assert any("'shm_demolition'" in f.message
+               and "record_mark/broadcast_mark" in f.message
+               for f in r22), [f.render() for f in r22]
 
 
 def test_r21_mutation_family_rename_breaks_both_directions(tmp_path):
