@@ -2326,6 +2326,221 @@ def bench_timeline_overhead():
     }
 
 
+def bench_ledger_overhead():
+    """Cost of the always-on device-economics ledger (PR 20): the
+    ledger rides the verdict round only through
+    ``VerdictTracer.finish_round`` calling ``DeviceLedger.stamp_round``
+    once per ROUND (one formation-provenance stamp: trigger counter,
+    occupancy/age fold, µs histogram) — compile events fire on
+    trace/compile, which warm serving performs zero of, so the serving
+    path pays exactly this stamp.  Same paired methodology as
+    timeline_overhead: per-round tracer cost over 20k rounds with the
+    ledger attached vs detached (flight recorder attached in BOTH arms
+    — this bench isolates the ledger's own cost), against the r2d2
+    model's measured per-round serving time."""
+    import threading as _threading
+
+    from cilium_tpu.models.r2d2 import build_r2d2_model
+    from cilium_tpu.proxylib import (
+        NetworkPolicy,
+        PortNetworkPolicy,
+        PortNetworkPolicyRule,
+        find_instance,
+        open_module,
+        reset_module_registry,
+    )
+    from cilium_tpu.sidecar.blackbox import FlightRecorder
+    from cilium_tpu.sidecar.ledger import DeviceLedger
+    from cilium_tpu.sidecar.trace import VerdictTracer
+
+    policy_cfg = NetworkPolicy(
+        name="bench-ledger",
+        policy=2,
+        ingress_per_port_policies=[
+            PortNetworkPolicy(
+                port=80,
+                rules=[
+                    PortNetworkPolicyRule(
+                        l7_proto="r2d2",
+                        l7_rules=[
+                            {"cmd": "READ", "file": "/public/.*"},
+                            {"cmd": "HALT"},
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+    reset_module_registry()
+    mod = open_module([], True)
+    ins = find_instance(mod)
+    ins.policy_update([policy_cfg])
+    model = build_r2d2_model(
+        ins.policy_map()["bench-ledger"], ingress=True, port=80
+    )
+    rng = random.Random(13)
+    F, L = 2048, 64
+    data = np.zeros((F, L), np.uint8)
+    lengths = np.zeros((F,), np.int32)
+    for i in range(F):
+        m = f"READ /public/f{rng.randrange(1000)}.txt\r\n".encode()
+        data[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lengths[i] = len(m)
+    remotes = np.ones((F,), np.int32)
+    fn = type(model).__call__
+    rate = _pipelined_rate(fn, (model, data, lengths, remotes), F)
+    round_s = F / rate
+
+    def tracer_cost(with_ledger: bool) -> float:
+        tr = VerdictTracer(
+            sample_every=4096, slow_ms=1e9, ring=512,
+            stage_metrics=True, batch_capacity=F,
+        )
+        rec = FlightRecorder(ring=512)
+        rec.occupancy_probe = lambda: (3, 0.5)
+        tr.recorder = rec
+        if with_ledger:
+            tr.ledger = DeviceLedger(ring=512)
+        # The popping thread's formation stamp (what _pop_locked /
+        # begin_inline_round brand the worker with) — present in BOTH
+        # arms so begin_round's read is paid identically; only the
+        # ledger's stamp_round differs.
+        _threading.current_thread()._disp_pop = {
+            "trigger": "size-full", "depth": 3, "age_s": 2e-4,
+            "bytes": 65536,
+        }
+        K = 20_000
+        try:
+            t0 = time.perf_counter()
+            for i in range(K):
+                rt = tr.begin_round("vec", F, 0.0)
+                rt.formed()
+                rt.submitted()
+                rt.completed()
+                rt.drained()
+                tr.finish_round(rt, ((i, F, 0.0, 1),))
+            return (time.perf_counter() - t0) / K
+        finally:
+            del _threading.current_thread()._disp_pop
+
+    cost_on = min(tracer_cost(True) for _ in range(3))
+    cost_off = min(tracer_cost(False) for _ in range(3))
+    rate_on = F / (round_s + cost_on)
+    rate_off = F / (round_s + cost_off)
+    overhead = max(1.0 - rate_on / rate_off, 0.0)
+    print(
+        f"bench ledger_overhead: round={round_s * 1e6:.1f}us "
+        f"ledger_on={cost_on * 1e6:.2f}us "
+        f"ledger_off={cost_off * 1e6:.2f}us "
+        f"implied {rate_off:,.0f}/s -> {rate_on:,.0f}/s "
+        f"({overhead:.4%} loss)",
+        file=sys.stderr,
+    )
+    # The acceptance contract: the always-on ledger costs <2%
+    # throughput vs the ledger detached.
+    assert overhead < 0.02, (
+        f"device-ledger overhead {overhead:.3%} exceeds the 2% budget"
+    )
+    reset_module_registry()
+    return {
+        "overhead_pct": overhead * 100.0,
+        "round_us": round_s * 1e6,
+        "ledger_on_us": cost_on * 1e6,
+        "ledger_off_us": cost_off * 1e6,
+        "implied_rate_on": rate_on,
+        "implied_rate_off": rate_off,
+    }
+
+
+def bench_load_knee():
+    """The p99-vs-throughput knee (ROADMAP item 4's regression floor),
+    derived from the formation telemetry the ledger stamps per round.
+
+    Method: the colocated open-loop harness (latbench — same seam-probe
+    service and Poisson generator as the latency bench) measures a
+    saturation reference by offering well past capacity and taking the
+    achieved rate; then sweeps ~6 offered-load fractions of it.  Each
+    point records the open-loop p99 and the service ledger's formation
+    delta (per-trigger round counts, occupancy, queue age): below the
+    knee formation is deadline/idle-driven with low occupancy, past it
+    size-full rounds and queue age dominate and p99 inflects.  The
+    knee is the highest swept fraction whose p99 stays within 2x the
+    lightest point's p99 — the regression floor for latency-tiered
+    dispatch work."""
+    from cilium_tpu.sidecar import latbench
+
+    sock = "/tmp/cilium_tpu_bench_knee.sock"
+    bench = latbench.LatencyBench(
+        sock,
+        verdict_device="cpu",
+        seam_probe=True,
+        batch_timeout_ms=0.0,
+        client_timeout_ms=0.3,
+        batch_flows=8192,
+        client_batch=2048,
+    )
+    try:
+        # Saturation reference: offer far past capacity; the achieved
+        # rate IS the closed-loop ceiling of this host.
+        sat = bench.run_rate(5_000_000, 100_000, seed=3)
+        max_rate = sat.achieved_rate
+        svc = bench.service
+        fracs = (0.2, 0.4, 0.6, 0.8, 0.9, 1.0)
+        points = []
+        prev_form = svc.ledger.formation()
+
+        def _rounds(form):
+            return {t: rec.get("rounds", 0) for t, rec in form.items()}
+
+        for frac in fracs:
+            rate = max(int(max_rate * frac), 1_000)
+            n = min(60_000, max(20_000, int(rate * 0.5)))
+            r = bench.run_rate(rate, n, seed=7)
+            form = svc.ledger.formation()
+            prev_r, cur_r = _rounds(prev_form), _rounds(form)
+            delta = {
+                t: cur_r.get(t, 0) - prev_r.get(t, 0)
+                for t in cur_r
+                if cur_r.get(t, 0) - prev_r.get(t, 0) > 0
+            }
+            points.append({
+                "frac": frac,
+                "offered_rate": rate,
+                "achieved_rate": round(r.achieved_rate),
+                "p99_ms": round(r.p99_ms, 3),
+                "p50_ms": round(r.p50_ms, 3),
+                "formation_rounds": delta,
+                "occ_mean": {
+                    t: rec.get("occ_mean", 0.0)
+                    for t, rec in form.items()
+                },
+            })
+            prev_form = form
+        base_p99 = points[0]["p99_ms"]
+        knee_frac, knee_p99 = fracs[0], base_p99
+        for pt in points:
+            if pt["p99_ms"] <= 2.0 * base_p99:
+                knee_frac, knee_p99 = pt["frac"], pt["p99_ms"]
+        print(
+            f"bench load_knee: max_rate={max_rate:,.0f}/s knee at "
+            f"{knee_frac:.0%} offered (p99 {knee_p99:.2f}ms, base "
+            f"{base_p99:.2f}ms); sweep "
+            + " ".join(
+                f"{p['frac']:.0%}={p['p99_ms']:.2f}ms" for p in points
+            ),
+            file=sys.stderr,
+        )
+        return {
+            "knee_throughput_frac": knee_frac,
+            "knee_p99_ms": knee_p99,
+            "max_rate": round(max_rate),
+            "base_p99_ms": base_p99,
+            "points": points,
+        }
+    finally:
+        bench.close()
+
+
 def bench_flow_observe_overhead():
     """Cost of always-on flow records + device-side rule attribution
     (PR 5): the flow observability layer rides the exact vec hot path,
@@ -3630,6 +3845,37 @@ def run_one(which: str) -> None:
             implied_rate_off=round(out["implied_rate_off"]),
             budget_pct=2.0,
         )
+    elif which == "ledger_overhead":
+        out = bench_ledger_overhead()
+        # Smaller is better; same scoring shape as timeline_overhead.
+        # The <2% contract is asserted inside the bench.
+        _emit(
+            "ledger_overhead_pct", out["overhead_pct"], "%",
+            2.0 / max(out["overhead_pct"], 0.1),
+            round_us=round(out["round_us"], 1),
+            ledger_on_us=round(out["ledger_on_us"], 2),
+            ledger_off_us=round(out["ledger_off_us"], 2),
+            implied_rate_on=round(out["implied_rate_on"]),
+            implied_rate_off=round(out["implied_rate_off"]),
+            budget_pct=2.0,
+        )
+    elif which == "load_knee":
+        out = bench_load_knee()
+        # Higher knee fraction is better: the load level the service
+        # sustains before p99 doubles off its light-load floor.
+        _emit(
+            "knee_throughput_frac", out["knee_throughput_frac"], "frac",
+            out["knee_throughput_frac"],
+            max_rate=out["max_rate"],
+            base_p99_ms=out["base_p99_ms"],
+            points=out["points"],
+        )
+        # Smaller is better: p99 AT the knee (the usable-load tail).
+        _emit(
+            "knee_p99_ms", out["knee_p99_ms"], "ms",
+            1.0 / max(out["knee_p99_ms"], 0.25),
+            knee_throughput_frac=out["knee_throughput_frac"],
+        )
     elif which == "flow_observe_overhead":
         out = bench_flow_observe_overhead()
         # Smaller is better; same scoring shape as the trace-overhead
@@ -3839,7 +4085,8 @@ CONFIGS = (
     "datapath", "stress",
     "kvstore_failover", "verdict_overload", "fanin_concurrent",
     "verdict_trace_overhead",
-    "flow_observe_overhead", "timeline_overhead", "policy_churn",
+    "flow_observe_overhead", "timeline_overhead", "ledger_overhead",
+    "load_knee", "policy_churn",
     "multichip_scaling", "rules_100k",
     "restart_blackout",
     "mesh_degraded",
@@ -3863,6 +4110,8 @@ ONCHIP_METRICS = (
     ("flow_cache_hit_rate", "flow_cache"),
     ("fanin_aggregate_verdicts_per_s", "fanin_concurrent"),
     ("fanin_p99_ms_at_16", "fanin_concurrent"),
+    ("knee_throughput_frac", "load_knee"),
+    ("knee_p99_ms", "load_knee"),
 )
 
 

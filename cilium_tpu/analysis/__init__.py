@@ -56,6 +56,10 @@ linter):
   R22 fail-closed recorder coverage (every FAIL_CLOSED row names a
       declared typestate edge or marker token AND reaches a flight-
       recorder emit site — no invisible fail-closed transitions)
+  R23 unledgered compile site (every executable-producing call
+      reachable from the dispatch or policy-builder roots routes
+      through the device-economics ledger — complete per-cause
+      compile census, asserted zero-compile warm churn)
   R0  lint pragma hygiene (malformed / unjustified suppressions)
 
 Layer 1 is the interprocedural engine (``callgraph.py``): a project-

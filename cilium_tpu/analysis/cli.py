@@ -211,7 +211,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="cilium-lint",
         description="whole-program concurrency & device-contract "
-                    "invariant analyzer (rules R0-R21; see README "
+                    "invariant analyzer (rules R0-R23; see README "
                     "'Invariants & lint')",
     )
     p.add_argument("paths", nargs="*", default=["cilium_tpu"],

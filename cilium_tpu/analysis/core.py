@@ -129,6 +129,13 @@ RULE_DOCS = {
            "record_mark/broadcast_mark call carrying the token — so "
            "no declared fail-closed transition is invisible to the "
            "incident timeline and its postmortem bundle",
+    "R23": "unledgered compile site: every executable-producing call "
+           "(jit/prewarm/engine- and mesh-model builds) reachable from "
+           "the dispatch or policy-builder roots of the hot modules "
+           "must route through the device-economics ledger "
+           "(record_compile/broadcast_compile or a cause_scope) so the "
+           "per-cause compile census is complete and warm-churn-is-"
+           "zero-compiles stays an asserted invariant",
 }
 
 # ``# lint: disable=R1,R2 -- why this is safe`` (em-dash also accepted).
@@ -444,6 +451,7 @@ def all_rules():
         rules_device,
         rules_handoff,
         rules_jit,
+        rules_ledger,
         rules_locks,
         rules_columns,
         rules_metrics,
@@ -477,6 +485,7 @@ def all_rules():
         rules_protocol.check_r20,
         rules_parity.check_r21,
         rules_blackbox.check_r22,
+        rules_ledger.check_r23,
     ]
 
 

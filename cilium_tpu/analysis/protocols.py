@@ -576,6 +576,12 @@ WIRE_MESSAGES = {
     "MSG_TIMELINE_REPLY": {
         "dir": "s2c", "reply": None, "fnf": True,
         "deferred": False, "gates": ()},
+    "MSG_LEDGER": {
+        "dir": "c2s", "reply": "MSG_LEDGER_REPLY", "fnf": False,
+        "deferred": False, "gates": ()},
+    "MSG_LEDGER_REPLY": {
+        "dir": "s2c", "reply": None, "fnf": True,
+        "deferred": False, "gates": ()},
 }
 
 # Native-shim coexistence: the C header's enum constants mirror the

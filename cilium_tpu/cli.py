@@ -658,6 +658,24 @@ def cmd_sidecar_status(args):
             print(f"  last postmortem: {last.get('trigger', '?')} "
                   f"seq={last.get('seq')} events={last.get('events')}"
                   + (f" -> {last['path']}" if last.get("path") else ""))
+    led = st.get("ledger") or {}
+    if led:
+        causes = " ".join(
+            f"{k}={v}" for k, v in sorted((led.get("by_cause") or {}).items())
+        )
+        print(f"ledger: {led.get('compiles', 0)} compile(s) "
+              f"({led.get('compile_seconds', 0.0):.3f}s total), "
+              f"{led.get('executables_resident', 0)} executable(s) "
+              f"resident, {led.get('dispatch_path_compiles', 0)} on "
+              f"dispatch path"
+              + (f" causes: {causes}" if causes else ""))
+        for trig, rec in sorted((led.get("formation") or {}).items()):
+            print(f"  [{trig}] rounds={rec.get('rounds', 0)} "
+                  f"occ={rec.get('occ_mean', 0.0):.2f} "
+                  f"age_mean={rec.get('age_mean_s', 0.0) * 1e6:.0f}us "
+                  f"age_max={rec.get('age_max_s', 0.0) * 1e6:.0f}us "
+                  f"depth_max={rec.get('depth_max', 0)} "
+                  f"bytes={rec.get('bytes', 0)}")
     return 0
 
 
@@ -768,6 +786,73 @@ def cmd_sidecar_timeline(args):
               f"events={pm.get('events')}"
               + (f" reason={pm['reason']}" if pm.get("reason") else "")
               + (f" -> {pm['path']}" if pm.get("path") else ""))
+    return 0
+
+
+_LEDGER_ID_KEYS = ("rules", "mesh", "epoch", "kind", "on_dispatch_path")
+
+
+def _format_ledger_event(ev: dict) -> str:
+    """One human line per compile-ledger event: seq, wall clock, cause,
+    engine family, compile seconds, and the shape/correlation ids the
+    recording site attached."""
+    import time as _time
+
+    ts = _time.strftime("%H:%M:%S", _time.localtime(ev.get("t", 0)))
+    ids = " ".join(
+        f"{k}={ev[k]}" for k in _LEDGER_ID_KEYS if ev.get(k) not in (None,
+                                                                     False)
+    )
+    shape = f" shape={ev['shape']}" if ev.get("shape") else ""
+    return (f"  {ev.get('seq', 0):<7} {ts} {ev.get('cause', '?'):<16} "
+            f"{ev.get('family', '?'):<18} {ev.get('seconds', 0.0):.3f}s"
+            + (f" {ids}" if ids else "") + shape)
+
+
+def cmd_sidecar_ledger(args):
+    """Dump the verdict service's device-economics ledger: per-cause
+    trace/compile events, per-trigger batch-formation provenance, and
+    the resident-executable census."""
+    from .sidecar import SidecarClient, SidecarUnavailable
+
+    try:
+        cl = SidecarClient(args.address, timeout=3.0)
+    except OSError as e:
+        print(f"Error: cannot reach verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        out = cl.ledger(n=args.n, since=args.since, cause=args.cause)
+    except (SidecarUnavailable, TimeoutError) as e:
+        print(f"Error: verdict service at {args.address}: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        cl.close()
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    events = out.get("compiles", [])
+    led = out.get("ledger", {})
+    causes = " ".join(
+        f"{k}={v}" for k, v in sorted((led.get("by_cause") or {}).items())
+    )
+    print(f"{args.address}: {len(events)} compile(s) of "
+          f"{led.get('compiles', 0)} recorded (seq {led.get('seq', 0)}, "
+          f"{led.get('executables_resident', 0)} resident, "
+          f"{led.get('dispatch_path_compiles', 0)} on dispatch path)"
+          + (f" causes: {causes}" if causes else ""))
+    for ev in events:
+        print(_format_ledger_event(ev))
+    form = out.get("formation", {})
+    for trig, rec in sorted(form.items()):
+        print(f"formation [{trig}]: rounds={rec.get('rounds', 0)} "
+              f"items={rec.get('items', 0)} "
+              f"occ={rec.get('occ_mean', 0.0):.2f} "
+              f"age_mean={rec.get('age_mean_s', 0.0) * 1e6:.0f}us "
+              f"age_max={rec.get('age_max_s', 0.0) * 1e6:.0f}us "
+              f"depth_max={rec.get('depth_max', 0)} "
+              f"bytes={rec.get('bytes', 0)}")
     return 0
 
 
@@ -1073,6 +1158,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "epoch_swap, mark, overload)")
     x.add_argument("--json", action="store_true")
     x.set_defaults(fn=cmd_sidecar_timeline)
+    x = sc.add_parser(
+        "ledger",
+        help="device-economics ledger: per-cause compile events, "
+             "batch-formation provenance, resident-executable census",
+    )
+    x.add_argument("--address", required=True,
+                   help="verdict service unix socket path")
+    x.add_argument("-n", type=int, default=100,
+                   help="max compile events")
+    x.add_argument("--since", type=int, default=0,
+                   help="only events with seq strictly greater "
+                        "(incremental tail cursor)")
+    x.add_argument("--cause", default=None,
+                   help="compile-cause filter (cold, prewarm, "
+                        "churn-new-shape, churn-vocab, mesh-reshape, "
+                        "repromotion, heal-rebind)")
+    x.add_argument("--json", action="store_true")
+    x.set_defaults(fn=cmd_sidecar_ledger)
 
     x = sub.add_parser(
         "observe",

@@ -11,6 +11,7 @@ OnIO contract (reference: envoy/cilium_proxylib.cc:125).
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -439,9 +440,22 @@ def create_engine_for_redirect(daemon, redirect):
     if f is None:
         return None
     identity_cache = daemon.get_identity_cache()
+    t0 = time.perf_counter()
     model = build_model_for_filter(
         f, identity_cache, mesh=_daemon_mesh(daemon)
     )
+    # Daemon-side engine builds land in any installed device ledger by
+    # broadcast (the daemon holds no service handle); cause rides the
+    # enclosing scope, cold by default.
+    try:
+        from ..sidecar import ledger as _ledger
+
+        _ledger.broadcast_compile(
+            str(f.l7_parser or "l7"), time.perf_counter() - t0,
+            kind="engine-build",
+        )
+    except Exception:  # noqa: BLE001 — ledger must not cost the build
+        pass
     common = dict(
         logger=daemon.access_logger,
         monitor=daemon.monitor,

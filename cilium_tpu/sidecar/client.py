@@ -1841,6 +1841,23 @@ class SidecarClient:
         )
         return json.loads(got.decode())
 
+    def ledger(self, n: int = 100, since: int = 0,
+               cause: str | None = None) -> dict:
+        """Device-economics dump (MSG_LEDGER round trip): the compile
+        ledger (per-cause trace/compile events), batch-formation
+        provenance, and the resident-executable census — the `cilium
+        sidecar ledger` surface.  ``since`` filters to events with seq
+        strictly greater (incremental tail); ``cause`` pins one compile
+        cause (cold/prewarm/churn-new-shape/...)."""
+        req: dict = {"n": int(n), "since": int(since)}
+        if cause:
+            req["cause"] = cause
+        got = self._control_rpc(
+            lambda: (wire.MSG_LEDGER, json.dumps(req).encode()),
+            wire.MSG_LEDGER_REPLY,
+        )
+        return json.loads(got.decode())
+
     def observe(self, n: int = 100, verdict: str | None = None,
                 path: str | None = None, rule: int | None = None,
                 conn: int | None = None,

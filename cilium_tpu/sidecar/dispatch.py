@@ -57,6 +57,8 @@ import threading
 import time
 from typing import Any, Callable
 
+from ..utils import metrics
+
 log = logging.getLogger(__name__)
 
 
@@ -95,6 +97,12 @@ class BatchDispatcher:
         self._done = threading.Condition(self._lock)
         self._pending: list[Any] = []
         self._pending_weight = 0
+        # Byte-weighted outstanding work (PR 15 remainder): payload
+        # bytes admitted and not yet popped.  Charged beside weight at
+        # submit, drained wholesale at pop; the drr_outstanding_bytes
+        # gauge is sampled once per ROUND at pop (bytes at issue), so
+        # the per-entry admission path never touches the registry.
+        self._pending_bytes = 0
         # Per-session queued weight (fan-in DRR): sessions passed to
         # submit/submit_many get their q_weight bumped under _cond and
         # zeroed WHOLESALE at every pop — the pop takes the entire
@@ -183,13 +191,13 @@ class BatchDispatcher:
     # -- admission --------------------------------------------------------
 
     def submit(self, item: Any, weight: int = 1, force: bool = False,
-               session: Any = None) -> bool:
+               session: Any = None, nbytes: int = 0) -> bool:
         """Queue one item; False means the admission cap refused it (the
         caller owes the peer a typed SHED response — weight-0/control
         items pass ``force=True`` and are never refused).  ``session``
-        (a transport.SessionState) charges the admitted weight to that
-        session's DRR queue share; the charge is released wholesale
-        when a round pops the queue."""
+        (a transport.SessionState) charges the admitted weight (and
+        ``nbytes`` payload bytes) to that session's DRR queue share;
+        the charge is released wholesale when a round pops the queue."""
         with self._cond:
             if not force and self.fenced:
                 self.shed_submits += 1
@@ -207,8 +215,10 @@ class BatchDispatcher:
                 self._oldest_ts = time.perf_counter()
             self._pending.append(item)
             self._pending_weight += weight
+            self._pending_bytes += nbytes
             if session is not None:
                 session.q_weight += weight
+                session.q_bytes += nbytes
                 self._q_sessions.add(session)
             self._cond.notify()
         return True
@@ -222,11 +232,15 @@ class BatchDispatcher:
         admitting the prefix; refused items are RETURNED and the caller
         owes each a typed SHED response (exactly submit()'s contract).
         ``session`` charges admitted weight as in submit() — one drain
-        is one session's frames, so one charge target covers the run."""
+        is one session's frames, so one charge target covers the run.
+        Entries may be ``(item, weight, nbytes)`` triples to charge
+        payload bytes to the byte-weighted outstanding gauge."""
         refused: list[Any] = []
         with self._cond:
             admitted = False
-            for item, weight in items:
+            for entry in items:
+                item, weight = entry[0], entry[1]
+                nbytes = entry[2] if len(entry) > 2 else 0
                 if not force and self.fenced:
                     self.shed_submits += 1
                     self.shed_weight += weight
@@ -245,8 +259,10 @@ class BatchDispatcher:
                     self._oldest_ts = time.perf_counter()
                 self._pending.append(item)
                 self._pending_weight += weight
+                self._pending_bytes += nbytes
                 if session is not None:
                     session.q_weight += weight
+                    session.q_bytes += nbytes
                     self._q_sessions.add(session)
                 admitted = True
             if admitted:
@@ -313,7 +329,8 @@ class BatchDispatcher:
         rid = getattr(threading.current_thread(), "_disp_round", None)
         return rid is not None and rid in self._shed_rounds
 
-    def begin_inline_round(self, batch: list[Any]) -> int | None:
+    def begin_inline_round(self, batch: list[Any],
+                           nbytes: int = 0) -> int | None:
         """Arm the stall watchdog for a cut-through round (caller holds
         the in-process lock).  Without this a device call hung inside
         an inline round on an otherwise IDLE service is invisible —
@@ -329,7 +346,16 @@ class BatchDispatcher:
             self._round_start = time.perf_counter()
             self._current_batch = batch
             self.round_seq += 1
-            threading.current_thread()._disp_round = self.round_seq
+            thread = threading.current_thread()
+            thread._disp_round = self.round_seq
+            # Cut-through bypasses the queue entirely: depth/age are 0
+            # by construction; nbytes is the inline item's own payload.
+            thread._disp_pop = {
+                "trigger": "cut-through",
+                "depth": 0,
+                "age_s": 0.0,
+                "bytes": int(nbytes),
+            }
             return self.round_seq
 
     def end_inline_round(self, rid: int) -> None:
@@ -350,21 +376,39 @@ class BatchDispatcher:
 
     # -- worker -----------------------------------------------------------
 
-    def _pop_locked(self) -> list[Any]:
+    def _pop_locked(self, trigger: str = "idle-greedy") -> list[Any]:
         self._busy = True  # before the clear — see __init__ note
         self._round_start = time.perf_counter()
         self.round_seq += 1
         # _pop_locked runs on the worker thread itself (via _take), so
-        # the round id can be recorded directly on it.
-        threading.current_thread()._disp_round = self.round_seq
+        # the round id can be recorded directly on it — and so can the
+        # round's formation provenance (why the batch was issued, how
+        # deep the queue was, how old its head was, bytes at issue),
+        # which the service folds into the RoundTrace.  One stamp per
+        # ROUND, never per entry.
+        thread = threading.current_thread()
+        thread._disp_round = self.round_seq
+        age_s = (time.perf_counter() - self._oldest_ts
+                 if self._pending else 0.0)
+        thread._disp_pop = {
+            "trigger": trigger,
+            "depth": len(self._pending),
+            "age_s": age_s,
+            "bytes": self._pending_bytes,
+        }
+        # Sampled once per round: the queue's byte-weighted outstanding
+        # work the instant it drains (bytes at issue).
+        metrics.DrrOutstandingBytes.set(self._pending_bytes)
         batch = self._pending
         self._current_batch = batch
         self._pending = []
         self._pending_weight = 0
+        self._pending_bytes = 0
         # The pop takes the WHOLE queue: every session's queued charge
         # drains with it (DRR share replenished at service pace).
         for sess in self._q_sessions:
             sess.q_weight = 0
+            sess.q_bytes = 0
         self._q_sessions.clear()
         return batch
 
@@ -390,15 +434,15 @@ class BatchDispatcher:
                     self._cond.wait()
                     continue
                 if self._stopped:
-                    return self._pop_locked(), False
+                    return self._pop_locked("flush"), False
                 if self._pending_weight >= self.max_batch:
-                    return self._pop_locked(), False
+                    return self._pop_locked("size-full"), False
                 if self._pending:
                     if self.timeout_s <= 0:  # greedy mode
-                        return self._pop_locked(), False
+                        return self._pop_locked("idle-greedy"), False
                     wait = self.timeout_s - (time.perf_counter() - self._oldest_ts)
                     if wait <= 0:
-                        return self._pop_locked(), True
+                        return self._pop_locked("deadline"), True
                     self._cond.wait(wait)
                 else:
                     self._cond.wait()

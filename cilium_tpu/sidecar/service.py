@@ -79,6 +79,7 @@ from ..utils import flowdebug, metrics
 from ..utils.option import DaemonConfig
 from ..utils.sockutil import shutdown_close
 from . import blackbox, wire
+from . import ledger as ledger_mod
 from .dispatch import BatchDispatcher
 from .guard import DeviceGuard
 from .reasm import (
@@ -385,6 +386,16 @@ class VerdictService:
         self.recorder.occupancy_probe = self._occupancy_probe
         self.recorder.install()
         self.tracer.recorder = self.recorder
+        # Device-economics ledger: every executable-producing site
+        # routes through ledger.record_compile (lint R23 proves it)
+        # and every dispatch round's formation stamp rides the
+        # tracer's finish_round — compile causes and batch-formation
+        # provenance become recorded data (ledger.py).
+        self.ledger = ledger_mod.DeviceLedger(
+            ring=self.config.timeline_ring,
+        )
+        self.ledger.install()
+        self.tracer.ledger = self.ledger
         # Containment telemetry (status/metrics).
         self.shed_entries = 0
         self.batch_crashes = 0
@@ -743,6 +754,7 @@ class VerdictService:
         # stopping service must not record (or bundle) its neighbors'
         # edges in multi-service processes (handoff).
         self.recorder.uninstall()
+        self.ledger.uninstall()
         # shutdown BEFORE close: the acceptor thread parked in accept()
         # holds the fd, and a bare close() defers the kernel teardown —
         # the listener would keep accepting into its backlog and a
@@ -1191,6 +1203,14 @@ class VerdictService:
             # occupancy, fail-closed event/bundle counters, unified
             # serving-tier rungs.
             "timeline": self.recorder.status(),
+            # Device-economics ledger (sidecar/ledger.py): compile
+            # causes, the dispatch-path-compile invariant counter,
+            # resident executables, and per-trigger batch-formation
+            # provenance.
+            "ledger": {
+                **self.ledger.status(),
+                "formation": self.ledger.formation(),
+            },
             # Flow-record ring occupancy (flowlog/): None = disabled.
             "flowlog": (
                 self.flowlog.stats() if self.flowlog is not None else None
@@ -1251,6 +1271,13 @@ class VerdictService:
         and/or table), occupancy buckets, postmortem summaries, and
         the recorder's own status."""
         return self.recorder.dump(n=n, since=since, table=table)
+
+    def ledger_dump(self, n: int = 100, since: int = 0,
+                    cause: str | None = None) -> dict:
+        """Ledger snapshot for `cilium sidecar ledger` (MSG_LEDGER):
+        compile events (filtered by minimum seq and/or cause), the
+        per-trigger formation summary, and the ledger's own status."""
+        return self.ledger.dump(n=n, since=since, cause=cause)
 
     def _postmortem_status(self) -> dict:
         """The status() sections a postmortem bundle carries — the
@@ -1428,29 +1455,37 @@ class VerdictService:
                     ENGINE_PROTOS
                 ):
                     keys.add(self._engine_key_for(sc.module_id, sc.conn))
+            prior_engines = dict(self._engines)
         new_engines: dict[tuple, object] = {}
         try:
-            for key in sorted(keys, key=repr):
-                _mod, policy_name, ingress, port, proto = key
-                policy = job.staged_map.get(policy_name)
-                with self._device_ctx():
-                    eng = self._make_engine(
-                        ins, policy, policy_name, ingress, port, proto
-                    )
-                if (
-                    self.config.policy_epoch_parity
-                    and not self.config.seam_probe
-                ):
-                    if proto == "r2d2":
-                        self._assert_epoch_parity(
-                            eng, policy, ingress, port
+            # Any trace the rebuild provokes is churn by definition;
+            # _rebuild_cause refines new-shape vs. vocab per engine, the
+            # scope catches jit misses the classifier can't see.
+            with ledger_mod.cause_scope(
+                ledger_mod.CAUSE_CHURN_NEW_SHAPE, epoch=epoch
+            ):
+                for key in sorted(keys, key=repr):
+                    _mod, policy_name, ingress, port, proto = key
+                    policy = job.staged_map.get(policy_name)
+                    with self._device_ctx():
+                        eng = self._make_engine(
+                            ins, policy, policy_name, ingress, port,
+                            proto, prior=prior_engines.get(key),
                         )
-                    elif proto == "dns":
-                        self._assert_epoch_parity_dns(
-                            eng, policy, ingress, port
-                        )
-                eng.epoch = epoch
-                new_engines[key] = eng
+                    if (
+                        self.config.policy_epoch_parity
+                        and not self.config.seam_probe
+                    ):
+                        if proto == "r2d2":
+                            self._assert_epoch_parity(
+                                eng, policy, ingress, port
+                            )
+                        elif proto == "dns":
+                            self._assert_epoch_parity_dns(
+                                eng, policy, ingress, port
+                            )
+                    eng.epoch = epoch
+                    new_engines[key] = eng
         except EpochParityError:
             log.exception("policy swap rejected (epoch parity)")
             self._swap_failed("parity")
@@ -1518,9 +1553,10 @@ class VerdictService:
                 # shape-keyed entries are the churn executable cache
                 # and deliberately survive the swap.
                 mid = id(getattr(eng, "model", None))
-                self._jit_cache.pop(mid, None)
-                self._jit_gather.pop(mid, None)
-                self._jit_attr.pop(mid, None)
+                for cache in (self._jit_cache, self._jit_gather,
+                              self._jit_attr):
+                    if cache.pop(mid, None) is not None:
+                        self.ledger.executable_evicted((id(cache), mid))
             async_pending = set(self._async_pending)
             # Verdict-cache invalidation is the epoch key itself (a
             # stale hit is structurally impossible once policy_epoch
@@ -1698,7 +1734,9 @@ class VerdictService:
         grant = None
         try:
             if sc is not None and sc.engine is None:
-                self._bind_engine(module_id, sc)
+                with ledger_mod.cause_scope(ledger_mod.CAUSE_HEAL_REBIND,
+                                            epoch=self.policy_epoch):
+                    self._bind_engine(module_id, sc)
                 with self._lock:
                     if self._conns.get(conn_id) is sc:
                         self._tab_set_engine(
@@ -2352,6 +2390,7 @@ class VerdictService:
             policy = ins.policy_map().get(conn.policy_name)
             with self._device_ctx():
                 # lint: disable=R12 -- first-bind cold path off the dispatch loop (reader/builder thread, once per engine key); churn recompiles ride the policy builder
+                # lint: disable=R23 -- the cold first-bind IS ledgered: no cause_scope here is the contract — record_compile inside _make_engine defaults the cause to "cold", and _run_rebind wraps this call in the heal-rebind scope (an inner scope here would mask it)
                 built = self._make_engine(
                     ins, policy, conn.policy_name, conn.ingress,
                     conn.port, proto,
@@ -2370,10 +2409,15 @@ class VerdictService:
         sc.fast_ok = proto in FAST_PROTOS
 
     def _make_engine(self, ins, policy, policy_name: str, ingress: bool,
-                     port: int, proto: str):
+                     port: int, proto: str, prior=None):
         """Compile one engine from an EXPLICIT policy object — shared
         by the first-bind path (live map) and the epoch builder
-        (staged map), so the two can never drift."""
+        (staged map), so the two can never drift.
+
+        ``prior`` is the engine this build replaces (epoch swaps pass
+        the outgoing generation); the ledger uses its model's shape key
+        to classify the rebuild as vocab churn vs. new-shape churn."""
+        t0 = time.perf_counter()
         if proto == "r2d2":
             from ..models.r2d2 import build_r2d2_model
 
@@ -2400,7 +2444,7 @@ class VerdictService:
                 max_buffer=self.config.max_flow_buffer,
                 attr_enabled=self._flow_observe,
             )
-            self.prewarm(eng)
+            self._finish_engine_build(eng, proto, prior, t0)
             return eng
         if proto == "dns":
             # The DNS engine rung: same scalar contract as r2d2 (the
@@ -2425,7 +2469,7 @@ class VerdictService:
                 max_buffer=self.config.max_flow_buffer,
                 attr_enabled=self._flow_observe,
             )
-            self.prewarm(eng)
+            self._finish_engine_build(eng, proto, prior, t0)
             return eng
         from ..runtime.l7engine import (
             CassandraBatchEngine,
@@ -2480,7 +2524,75 @@ class VerdictService:
         eng.judge_dispatch = functools.partial(
             self._engine_judge_dispatch, eng
         )
+        # l7 engines have no prewarm rung (the judge executable traces
+        # lazily through the shared jit caches, where the ledger's shim
+        # times it); the recorded unit here is the host-side automaton
+        # build itself.
+        try:
+            self.ledger.record_compile(
+                proto, time.perf_counter() - t0,
+                cause=self._rebuild_cause(model, prior),
+                shape=self._model_shape_key(model),
+                rules=self._rule_bucket_of(model),
+                kind="engine-build", epoch=self.policy_epoch,
+            )
+        except Exception:  # noqa: BLE001 — ledger must not cost the build
+            pass
         return eng
+
+    def _finish_engine_build(self, eng, proto: str, prior, t0: float) -> None:
+        """Prewarm a freshly built engine and ledger the build — but
+        ONLY when the prewarm actually launched a trace.  A same-bucket
+        epoch swap lands on warm executables end to end and must record
+        ZERO compile events; that silence is the asserted invariant the
+        churn soak pins (warm churn performs no compiles)."""
+        warmed = self.prewarm(eng)
+        if not warmed:
+            return
+        model = getattr(eng, "model", None)
+        try:
+            self.ledger.record_compile(
+                proto, time.perf_counter() - t0,
+                # Explicit cause when we can classify the rebuild from
+                # the shape delta; None falls through to the enclosing
+                # cause_scope (mesh-reshape / repromotion / heal-rebind)
+                # and finally to "cold" on the first bind.
+                cause=self._rebuild_cause(model, prior),
+                shape=self._model_shape_key(model),
+                rules=self._rule_bucket_of(model),
+                kind="engine-build", epoch=self.policy_epoch,
+            )
+        except Exception:  # noqa: BLE001 — ledger must not cost the build
+            pass
+
+    def _rebuild_cause(self, model, prior):
+        """Classify an epoch rebuild from the shape delta against the
+        engine it replaces: rule bucket held but automaton axes moved →
+        vocab churn (new DFA/NFA state counts at the same bucket); any
+        bucket/structure change → new-shape churn.  None (→ enclosing
+        scope / cold) when there is no prior generation."""
+        if prior is None:
+            return None
+        prior_model = getattr(prior, "model", None)
+        if prior_model is None or model is None:
+            return ledger_mod.CAUSE_CHURN_NEW_SHAPE
+        old_b = self._rule_bucket_of(prior_model)
+        new_b = self._rule_bucket_of(model)
+        if old_b is not None and old_b == new_b:
+            return ledger_mod.CAUSE_CHURN_VOCAB
+        return ledger_mod.CAUSE_CHURN_NEW_SHAPE
+
+    @staticmethod
+    def _rule_bucket_of(model):
+        """Best-effort padded rule-row bucket: the leading dim of the
+        per-rule match table (cmd_len for r2d2, name_len for dns);
+        None for models without one (SeamProbe, l7 judge models)."""
+        for attr in ("cmd_len", "name_len"):
+            v = getattr(model, attr, None)
+            shp = getattr(v, "shape", None)
+            if shp:
+                return int(shp[0])
+        return None
 
     def _engine_judge_dispatch(self, eng, data, lengths, remotes):
         """(complete, len, allow, rule-or-None) for an l7 engine's
@@ -2759,6 +2871,17 @@ class VerdictService:
 
     # -- data plane (dispatcher worker thread only) -----------------------
 
+    @staticmethod
+    def _batch_nbytes(batch) -> int:
+        """Payload bytes a queued batch will put on the device at
+        issue (blob length for DataBatch, summed row lengths for
+        MatrixBatch) — the byte-weighted half of queue occupancy."""
+        blob = getattr(batch, "blob", None)
+        if blob is not None:
+            return len(blob)
+        lens = getattr(batch, "lengths", None)
+        return int(lens.sum()) if lens is not None else 0
+
     def submit_data(self, client, batch: wire.DataBatch,
                     backlogged: bool = False) -> None:
         if not batch.arrival:  # wire unpack stamps ingress; keep it
@@ -2774,7 +2897,8 @@ class VerdictService:
         if not backlogged and self._try_cut_through(item):
             return
         if not self.dispatcher.submit(item, weight=batch.count,
-                                      session=sess):
+                                      session=sess,
+                                      nbytes=self._batch_nbytes(batch)):
             self._shed_item(item, "queue_full")
 
     def submit_matrix(self, client, mb: wire.MatrixBatch,
@@ -2792,7 +2916,8 @@ class VerdictService:
         if not backlogged and self._try_cut_through(item):
             return
         if not self.dispatcher.submit(item, weight=mb.count,
-                                      session=sess):
+                                      session=sess,
+                                      nbytes=self._batch_nbytes(mb)):
             self._shed_item(item, "queue_full")
 
     def _try_cut_through(self, item) -> bool:
@@ -2842,7 +2967,9 @@ class VerdictService:
             # call hung HERE on an idle service would otherwise never
             # be detected — no deposal, no quarantine, no typed reply,
             # one wedged shim reader.
-            rid = disp.begin_inline_round([item])
+            rid = disp.begin_inline_round(
+                [item], nbytes=self._batch_nbytes(item[2])
+            )
             if rid is None:
                 return False
             self.inline_batches += 1
@@ -3291,7 +3418,8 @@ class VerdictService:
             if reason:
                 self._shed_item(item, reason)
             else:
-                items.append((item, batch.count))
+                items.append((item, batch.count,
+                              self._batch_nbytes(batch)))
         for item in self.dispatcher.submit_many(items, session=sess):
             self._shed_item(item, "queue_full")
 
@@ -3959,21 +4087,81 @@ class VerdictService:
         if key is not None:
             fn = cache.get(key)  # lint: disable=R13 -- shape-keyed executable cache: keys are TABLE SHAPES, not table contents, so entries are epoch-independent by construction and deliberately survive swaps (the churn executable cache)
             if fn is None:
-                import jax
-
                 self._evict_shape_entries(cache)
                 # lint: disable=R12 -- cache-miss only: every serving shape is prewarmed off-path at engine build/swap; a miss here is the documented lazy greedy-mode gather compile (local, cheap)
-                fn = jax.jit(arg_fn)
+                fn = self._ledgered_jit(cache, key, arg_fn, model)
                 cache[key] = fn  # lint: disable=R13 -- shape-keyed by design (see the read above): same-bucketed churn MUST hit this entry across epochs
             return functools.partial(fn, model.dispatch_bare())
         ent = cache.get(id(model))  # lint: disable=R13 -- id-keyed entries die WITH their model: _commit_epoch pops them at the pointer flip, so no entry can outlive its epoch
         if ent is None:
-            import jax
-
             # lint: disable=R12 -- cache-miss only: prewarm traces every bucket shape at engine build (builder/reader thread); dispatch rounds only ever hit this dict
-            ent = (model, jax.jit(trace_fn))
+            fn = self._ledgered_jit(cache, id(model), trace_fn, model,
+                                    id_keyed=True)
+            ent = (model, fn)
             cache[id(model)] = ent  # lint: disable=R13 -- id-keyed: popped by _commit_epoch at the flip (see the read above)
         return ent[1]
+
+    def _ledgered_jit(self, cache: dict, key, trace_fn, model,
+                      id_keyed: bool = False):
+        """THE jit half of the ledger choke point (ledger.py): wrap a
+        fresh executable so its FIRST invocation — where jax actually
+        traces and compiles — is timed and recorded, then swap the
+        bare executable into the cache (zero steady-state overhead:
+        later lookups bypass the shim entirely).  The cause comes from
+        the recording thread's ledger scope (the first call runs
+        immediately after the miss, on the missing thread, so the
+        miss-site scope is still live); an unscoped miss whose shape
+        key was previously EVICTED records churn-new-shape — the
+        evict-then-reuse retrace is churn cost, not a cold start —
+        and any other unscoped miss records cold."""
+        import jax
+
+        # lint: disable=R12 -- this IS the ledger choke point the hot-path pragmas above refer to; the wrap is lazy (trace happens at first call) and misses only ever happen for un-prewarmed shapes
+        jfn = jax.jit(trace_fn)
+        led = self.ledger
+        rkey = (id(cache), key)
+        cause = None
+        if ledger_mod.current_scope() is None and led.was_evicted(rkey):
+            cause = ledger_mod.CAUSE_CHURN_NEW_SHAPE
+        led.executable_resident(rkey)
+        family = type(model).__name__
+        shape_sig = None if id_keyed else key
+        # Which executable FAMILY this cache serves: the same model
+        # shape legitimately traces once per role (gather vs direct vs
+        # attribution are distinct executables), and the census must
+        # keep them apart or a first-use attr trace masks a gather
+        # re-trace.
+        role = (
+            "gather" if cache is self._jit_gather
+            else "attr" if cache is self._jit_attr
+            else "direct"
+        )
+        done = []
+
+        def shim(*args):
+            t0 = time.perf_counter()
+            out = jfn(*args)
+            if not done:
+                done.append(True)
+                try:
+                    led.record_compile(
+                        family, time.perf_counter() - t0, cause=cause,
+                        shape=shape_sig, kind="jit", role=role,
+                        epoch=self.policy_epoch,
+                    )
+                    # Retire the shim: the cache entry becomes the
+                    # bare executable.
+                    if id_keyed:
+                        ent = cache.get(key)
+                        if ent is not None and ent[1] is shim:
+                            cache[key] = (ent[0], jfn)  # lint: disable=R13 -- same id-keyed entry being replaced in place (epoch lifecycle unchanged)
+                    elif cache.get(key) is shim:
+                        cache[key] = jfn  # lint: disable=R13 -- same shape-keyed entry being replaced in place (see _jit_for)
+                except Exception:  # noqa: BLE001 -- accounting must not cost the round
+                    pass
+            return out
+
+        return shim
 
     # Distinct table-shape signatures a shape-keyed cache may hold
     # before the oldest are evicted: bounds executable memory on a
@@ -3993,6 +4181,12 @@ class VerdictService:
             victim = shape_keys.pop(0)
             cache.pop(victim, None)
             self._prewarmed_shapes.pop(victim, None)
+            # THE resident-executable decrement (one definition,
+            # ledger-owned): the gauge moves here and at the id-keyed
+            # epoch retirement, nowhere else — and the ledger's
+            # evicted-key memory makes a later reuse of this shape
+            # record churn-new-shape, not cold.
+            self.ledger.executable_evicted((id(cache), victim))
 
     # -- multi-chip mesh rung ---------------------------------------------
 
@@ -4419,6 +4613,7 @@ class VerdictService:
                 ),
                 shard_offsets(len(rows), n_shards),
                 mesh, "r2d2",
+                # lint: disable=R23 -- parity-probe twin: built, compared, and discarded in this function — never a resident serving executable, so ledgering it would inflate the compile census with probe noise
                 fallback=build_r2d2_model_from_rows(
                     rows, bucket=True
                 ),
@@ -4522,7 +4717,12 @@ class VerdictService:
                 self._promote_mesh_classic(d0)
                 return
             # -- rebuild + flip (reshape down, or reshaped -> full) ----
-            builds = self._rebuild_engines_on(target)
+            with ledger_mod.cause_scope(
+                ledger_mod.CAUSE_REPROMOTION if target is full
+                else ledger_mod.CAUSE_MESH_RESHAPE,
+                epoch=self.policy_epoch,
+            ):
+                builds = self._rebuild_engines_on(target)
             flipped = 0
             with self._lock:
                 if sum(self.mesh_demotions.values()) != d0:
@@ -4708,7 +4908,11 @@ class VerdictService:
         mesh = self._serving_mesh()
         if mesh is None:
             return
-        built = self._build_mesh_model_for(key, mesh)
+        # A demotion-era engine healing onto the promoted mesh is the
+        # tail of the repromotion, so its build books under that cause.
+        with ledger_mod.cause_scope(ledger_mod.CAUSE_REPROMOTION,
+                                    epoch=self.policy_epoch):
+            built = self._build_mesh_model_for(key, mesh)
         if built is None:
             return
         with self._lock:
@@ -4743,6 +4947,7 @@ class VerdictService:
         if ins is None:
             return None
         policy = ins.policy_map().get(policy_name)
+        t0 = time.perf_counter()
         try:
             with self._device_ctx():
                 # lint: disable=R12 -- off-path builder-thread rebuild (the mesh-heal/reshape rung), never the dispatch loop
@@ -4771,6 +4976,20 @@ class VerdictService:
         except Exception:  # noqa: BLE001 — engine keeps its model
             log.exception("mesh model build failed for %r", key)
             return None
+        # Cause rides the caller's scope: mesh-reshape from the ladder
+        # walk, repromotion from the full-width flip / 1c heal.
+        try:
+            self.ledger.record_compile(
+                proto, time.perf_counter() - t0,
+                shape=self._model_shape_key(built),
+                rules=self._rule_bucket_of(built),
+                mesh=tuple(sorted(
+                    (getattr(mesh, "shape", None) or {}).items()
+                )),
+                kind="engine-build", epoch=self.policy_epoch,
+            )
+        except Exception:  # noqa: BLE001 — ledger must not cost the build
+            pass
         return built
 
     def _mesh_guarded(self, model, call):
@@ -4965,36 +5184,47 @@ class VerdictService:
         key = self._model_shape_key(model)
         if key is None:
             return
-        while len(self._prewarmed_shapes) >= self.SHAPE_CACHE_MAX:
-            self._prewarmed_shapes.pop(
-                next(iter(self._prewarmed_shapes))
-            )
+        # No private eviction loop here (the PR 20 dedupe): a warmed
+        # shape lives in at least one shape-keyed jit cache, and
+        # _evict_shape_entries — the ONE eviction path, which also
+        # moves the ledger's resident gauge — pops this dict alongside
+        # the cache entry, so this book stays bounded by the caches'
+        # SHAPE_CACHE_MAX without a second definition of "resident".
         self._prewarmed_shapes[key] = True
 
-    def prewarm(self, engine) -> None:
+    def prewarm(self, engine) -> bool:
         """Compile the engine model for every bucket shape up front so
         the first real batch never pays a compile.  Shape-cached models
         (r2d2) whose executable already exists — churn rebuilding a
-        same-bucketed table — skip the warm launches entirely."""
+        same-bucketed table — skip the warm launches entirely.
+        Returns True when any warm launch actually ran (the signal the
+        engine-build ledger record is gated on: a fully-warm rebuild
+        produced no executable and records nothing).  Warm launches
+        record cause ``prewarm`` — off-path warming is its own cause
+        regardless of what provoked the build; the provoking cause
+        (cold/churn/mesh) rides the engine-build record instead."""
         if isinstance(engine.model, ConstVerdict):
-            return
-        if not self._dispatch_resolved:
-            with self._dispatch_lock:
-                if not self._dispatch_resolved:
-                    # lint: disable=R12 -- one-time dispatch-mode probe at the FIRST prewarm ever (double-checked): the lock exists precisely to run this measurement once; prewarm runs on reader/builder threads, never dispatch
-                    self._measure_dispatch_mode(engine)
-                    self._dispatch_resolved = True
-        self._prewarm_model(engine.model)
-        fb = getattr(engine.model, "fallback", None)
-        if fb is not None:
-            # The demotion rung warms at build too: a device-loss flip
-            # must not pay its first single-chip compile on the
-            # dispatch path.
-            self._prewarm_model(fb)
+            return False
+        with ledger_mod.cause_scope(ledger_mod.CAUSE_PREWARM,
+                                    epoch=self.policy_epoch):
+            if not self._dispatch_resolved:
+                with self._dispatch_lock:
+                    if not self._dispatch_resolved:
+                        # lint: disable=R12 -- one-time dispatch-mode probe at the FIRST prewarm ever (double-checked): the lock exists precisely to run this measurement once; prewarm runs on reader/builder threads, never dispatch
+                        self._measure_dispatch_mode(engine)
+                        self._dispatch_resolved = True
+            warmed = self._prewarm_model(engine.model)
+            fb = getattr(engine.model, "fallback", None)
+            if fb is not None:
+                # The demotion rung warms at build too: a device-loss
+                # flip must not pay its first single-chip compile on
+                # the dispatch path.
+                warmed = self._prewarm_model(fb) or warmed
+        return warmed
 
-    def _prewarm_model(self, model) -> None:
+    def _prewarm_model(self, model) -> bool:
         if self._shape_key_cached(self._prewarmed_shapes, model):
-            return
+            return False
         width = self.config.batch_width
         for b in self._buckets():
             # The attributed variant is the serving-path call when flow
@@ -5024,6 +5254,7 @@ class VerdictService:
                 )
                 np.asarray(allow)
         self._mark_shape_prewarmed(model)
+        return True
 
     @staticmethod
     def _framing_alignment_mask(snap, eng_idx, cand, aligner):
@@ -8062,6 +8293,28 @@ class _ClientHandler:
                         json.dumps(
                             self.service.timeline_dump(
                                 n=n, since=since, table=table
+                            )
+                        ).encode(),
+                    )
+                elif msg_type == wire.MSG_LEDGER:
+                    # Same containment as MSG_TRACE: a malformed
+                    # diagnostic request degrades to defaults, never
+                    # kills the shim connection's read loop.
+                    try:
+                        req = json.loads(payload.decode()) if payload else {}
+                        n = int(req.get("n", 100))
+                        since = int(req.get("since", 0))
+                        cause = req.get("cause")
+                        if cause is not None:
+                            cause = str(cause)
+                    except (ValueError, TypeError, AttributeError,
+                            UnicodeDecodeError):
+                        n, since, cause = 100, 0, None
+                    self.send(
+                        wire.MSG_LEDGER_REPLY,
+                        json.dumps(
+                            self.service.ledger_dump(
+                                n=n, since=since, cause=cause
                             )
                         ).encode(),
                     )

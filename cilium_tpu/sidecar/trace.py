@@ -78,7 +78,7 @@ class RoundTrace:
 
     __slots__ = ("path", "n", "t_admit", "t_pop", "t_form", "t_submit",
                  "t_complete", "t_drain", "t_send", "ring_s", "swap_s",
-                 "reasm_s", "cache_s")
+                 "reasm_s", "cache_s", "formation")
 
     def __init__(self, path: str, n: int, t_admit: float, t_pop: float,
                  ring_s: float = 0.0, swap_s: float = 0.0):
@@ -112,6 +112,12 @@ class RoundTrace:
         # rendering) — carved out of batch_form like reasm; for a
         # fully-cached round this IS the round's host cost.
         self.cache_s = 0.0
+        # Batch-formation provenance (sidecar/ledger.py): the
+        # dispatcher's per-round pop stamp — trigger, queue depth,
+        # oldest-entry age and bytes at issue — captured at
+        # begin_round from the popping thread.  None when the round
+        # was begun off the dispatch path (no stamp, no guess).
+        self.formation = None
 
     def formed(self) -> None:
         if not self.t_form:
@@ -200,6 +206,10 @@ class VerdictTracer:
         # same per-round numbers the busy gauge uses, so the occupancy
         # time-series costs no extra stamps.
         self.recorder = None
+        # Optional device ledger (ledger.DeviceLedger): fed the
+        # formation stamp the dispatcher left on the popping thread —
+        # one stamp_round per round, riding this same close.
+        self.ledger = None
 
     # -- round lifecycle --------------------------------------------------
 
@@ -207,8 +217,15 @@ class VerdictTracer:
                     t_pop: float | None = None,
                     ring_s: float = 0.0,
                     swap_s: float = 0.0) -> RoundTrace:
-        return RoundTrace(path, n, t_admit, t_pop or time.monotonic(),
-                          ring_s, swap_s)
+        rt = RoundTrace(path, n, t_admit, t_pop or time.monotonic(),
+                        ring_s, swap_s)
+        # The dispatcher stamps formation provenance on the thread that
+        # popped (or inlined) the round; begin_round runs on that same
+        # thread, so the capture is a plain attribute read.
+        rt.formation = getattr(
+            threading.current_thread(), "_disp_pop", None
+        )
+        return rt
 
     def finish_round(self, rt: RoundTrace, batches=()) -> None:
         """Close a round: observe each stage once, the e2e histogram
@@ -291,6 +308,19 @@ class VerdictTracer:
                 rec.sample_round(rt.n, self.batch_capacity,
                                  stages[STAGE_DEVICE], now)
             except Exception:  # noqa: BLE001 — recorder must not cost the round
+                pass
+        led = self.ledger
+        form = rt.formation
+        if led is not None and form is not None:
+            try:
+                led.stamp_round(
+                    form.get("trigger", "idle-greedy"), rt.n,
+                    self.batch_capacity,
+                    depth=form.get("depth", 0),
+                    age_s=form.get("age_s", 0.0),
+                    bytes_at_issue=form.get("bytes", 0),
+                )
+            except Exception:  # noqa: BLE001 — ledger must not cost the round
                 pass
 
     def record_shed(self, seq: int, n: int, arrival: float, conn0: int,

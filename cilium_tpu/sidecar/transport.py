@@ -127,6 +127,11 @@ class SessionState:
         # share replenishes at once — deficit-round-robin over queue
         # slots, paced by service progress).
         self.q_weight = 0
+        # Byte-weighted twin of q_weight (PR 15 remainder): payload
+        # bytes queued on this session's behalf, charged/drained on
+        # the same dispatcher lock trips — entry count ≠ cost for
+        # mixed frame sizes, so heavy-frame tenants are visible.
+        self.q_bytes = 0
         # Flood strikes: over-quota sheds inside the strike window.
         self.strikes = 0
         self.strike_window_start = 0.0
@@ -211,6 +216,7 @@ class SessionState:
             "served": self.answered - shed_total,
             "shed": dict(self.shed),
             "q_weight": self.q_weight,
+            "q_bytes": self.q_bytes,
         }
         if self.state == SESSION_QUARANTINED:
             out["quarantine_reason"] = self.quarantine_reason

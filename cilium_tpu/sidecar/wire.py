@@ -143,6 +143,15 @@ MSG_HANDOFF_REPLY = 29
 MSG_TIMELINE = 30
 MSG_TIMELINE_REPLY = 31
 
+# Device-economics ledger (sidecar/ledger.py): the client asks for the
+# compile ledger and batch-formation provenance — per-cause compile
+# events, per-trigger round formation stats, resident-executable
+# census — with JSON request filters {"n", "since", "cause"}; the
+# reply is the ledger's dump() as JSON.  Same request/reply control
+# shape as MSG_TIMELINE.
+MSG_LEDGER = 32
+MSG_LEDGER_REPLY = 33
+
 # Conn-registration flags (optional trailing byte on
 # MSG_NEW_CONNECTION; absent = 0, so old shims interop unchanged).
 # RETAINED rides the session-replay re-registration: the shim still

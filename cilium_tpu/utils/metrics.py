@@ -579,3 +579,49 @@ SidecarPostmortems = registry.counter(
     "'mark' for non-typestate markers)",
     ("trigger",),
 )
+
+# Device-economics ledger (sidecar/ledger.py).  Two halves: the
+# compile ledger answers "why did a compile happen" (cause taxonomy:
+# cold / prewarm / churn-new-shape / churn-vocab / mesh-reshape /
+# repromotion / heal-rebind) and the formation half answers "why was
+# a batch issued" (trigger taxonomy: size-full / flush / deadline /
+# idle-greedy / cut-through).  Compile metrics fire per COMPILE
+# (control-plane rate); formation metrics fire once per ROUND, never
+# per entry.
+DeviceCompilesTotal = registry.counter(
+    "device_compiles_total",
+    "Executable-producing traces/compiles recorded by the device "
+    "ledger, by cause (cold|prewarm|churn-new-shape|churn-vocab|"
+    "mesh-reshape|repromotion|heal-rebind) and engine family",
+    ("cause", "family"),
+)
+DeviceCompileSeconds = registry.histogram(
+    "device_compile_seconds",
+    "Wall seconds per recorded trace/compile, by cause",
+    ("cause",),
+    buckets=DEFAULT_BUCKETS,
+)
+ExecutablesResident = registry.gauge(
+    "device_executables_resident",
+    "Shape-keyed executables currently resident in the serving "
+    "caches — the single definition shared by prewarm bookkeeping "
+    "and the SHAPE_CACHE_MAX eviction path",
+)
+BatchFormationRounds = registry.counter(
+    "batch_formation_rounds_total",
+    "Dispatch rounds by formation trigger (size-full|flush|deadline|"
+    "idle-greedy|cut-through) — one increment per round",
+    ("trigger",),
+)
+BatchFormationAge = registry.histogram(
+    "batch_formation_oldest_age_seconds",
+    "Oldest-entry queue age at pop per dispatch round, by formation "
+    "trigger — one observation per round",
+    ("trigger",),
+    buckets=MICRO_BUCKETS,
+)
+DrrOutstandingBytes = registry.gauge(
+    "drr_outstanding_bytes",
+    "Byte-weighted outstanding work across per-session DRR windows "
+    "(payload bytes admitted to the dispatcher and not yet popped)",
+)

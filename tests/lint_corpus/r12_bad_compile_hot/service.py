@@ -24,7 +24,7 @@ class Service:
         eng = self._engines.get(key)
         if eng is None:
             # Reached from _process: the round pays the whole trace.
-            eng = build_table_model(key)  # EXPECT[R12]
+            eng = build_table_model(key)  # EXPECT[R12] # EXPECT[R23]
             self._engines[key] = eng
         return eng
 

@@ -34,5 +34,8 @@ class Service:
         while self._build_queue:
             policy = self._build_queue.pop()
             eng = jax.jit(build_table_model(policy.key))
+            # Builder compiles are ledgered (R23): the census is what
+            # keeps warm-churn-is-zero-compiles an asserted invariant.
+            self.ledger.record_compile("table", 0.0, cause="churn-new-shape")
             with self._lock:
                 self._engines[policy.key] = eng

@@ -705,6 +705,16 @@ def test_mesh_reshape_serves_degraded_then_repromotes(tmp_path):
             s2.close()
         # New engine builds while reshaped shard onto the survivors.
         assert svc._serving_mesh() is svc._mesh_serving
+        # Device-economics ledger (PR 20): the reshape fan-out's
+        # survivor-mesh rebuilds booked under the mesh-reshape cause —
+        # off-path engine builds, each stamped with the mesh layout it
+        # was built against.
+        reshape_evs = svc.ledger.events(n=1000, cause="mesh-reshape")
+        assert reshape_evs, svc.ledger.events(n=1000)
+        for ev in reshape_evs:
+            assert ev["kind"] == "engine-build", ev
+            assert not ev["on_dispatch_path"], ev
+            assert ev["mesh"], ev
 
         # Heal: the paced re-probe walks back up to full width.
         svc._device_probe_fn = lambda dev: True
@@ -717,6 +727,18 @@ def test_mesh_reshape_serves_degraded_then_repromotes(tmp_path):
         table = svc.guard.device_table()
         assert table["3"]["state"] == "ok"
         assert table["3"]["heals"] >= 1
+        # The walk back up booked its full-width rebuilds under the
+        # repromotion cause — distinct in the census from both the
+        # demotion-era reshape and any cold start, so the ledger alone
+        # answers "what did that incident cost on-device?".
+        repro_evs = svc.ledger.events(n=1000, cause="repromotion")
+        assert repro_evs, svc.ledger.events(n=1000)
+        for ev in repro_evs:
+            assert ev["kind"] == "engine-build", ev
+            assert not ev["on_dispatch_path"], ev
+        by_cause = svc.ledger.status()["by_cause"]
+        assert by_cause.get("mesh-reshape", 0) >= 1, by_cause
+        assert by_cause.get("repromotion", 0) >= 1, by_cause
         # Full-width mesh serves bit-identically again.
         eng = next(iter(svc._engines.values()))
         assert isinstance(eng.model, ShardedVerdictModel)

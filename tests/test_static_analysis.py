@@ -137,6 +137,7 @@ _CORPUS_CASES = [
     "r20_bad",
     "r21_bad",
     "r22_bad_fail_closed.py",
+    "r23_bad_unledgered",
 ]
 
 _CORPUS_CLEAN = [
@@ -177,6 +178,7 @@ _CORPUS_CLEAN = [
     "r20_good",
     "r21_good",
     "r22_good_fail_closed.py",
+    "r23_good_ledgered",
 ]
 
 
@@ -277,6 +279,27 @@ def test_r22_fail_closed_coverage_pins():
     assert "record_mark/broadcast_mark" in msgs
     assert "no token string" in msgs
     assert "unknown kind" in msgs
+
+
+def test_r23_unledgered_compile_pins():
+    """R23's shapes pinned with exactly one finding per bad site (the
+    corpus marker SET cannot see multiplicity): the unledgered builder
+    trace, the mesh-ladder build, the rebind prewarm — and the twin
+    file's three ledgered forms (record_compile, cause_scope,
+    broadcast_compile) all silent."""
+    path = os.path.join(CORPUS, "r23_bad_unledgered")
+    active, _ = split_findings(analyze_paths([path]))
+    assert active and all(f.rule == "R23" for f in active)
+    lines = [f.line for f in active]
+    assert len(lines) == len(set(lines)), (
+        f"duplicate R23 findings at lines {sorted(lines)}"
+    )
+    msgs = " | ".join(f.message for f in active)
+    assert "unledgered compile site" in msgs
+    assert "warm-churn invariant" in msgs
+    syms = {f.symbol for f in active}
+    assert {"Service._policy_builder_loop", "Service._run_mesh_ladder",
+            "Service._run_rebind"} <= syms
 
 
 def test_interprocedural_lock_graph_spans_two_modules():
